@@ -1,0 +1,73 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+Task/actor/object core runtime (reference capability: ray core —
+python/ray/__init__.py surface) with a TPU-first ML stack on top:
+compiled-SPMD parallelism (ray_tpu.parallel), training (ray_tpu.train),
+tuning (ray_tpu.tune), datasets (ray_tpu.data), RL (ray_tpu.rllib), and
+serving (ray_tpu.serve).
+"""
+
+from ray_tpu._version import __version__  # noqa: F401
+from ray_tpu.core.runtime import (init, shutdown, is_initialized,
+                                  get_runtime)
+from ray_tpu.core.remote_function import remote
+from ray_tpu.core.actor import get_actor, kill, ActorHandle
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu.core.client import TaskError, GetTimeoutError, ActorDiedError
+from ray_tpu.core.placement_group import (placement_group,
+                                          remove_placement_group,
+                                          PlacementGroup,
+                                          PlacementGroupSchedulingStrategy)
+
+
+def put(value):
+    """Store an object and return a reference (reference: ray.put,
+    python/ray/_private/worker.py:2406)."""
+    return get_runtime().put(value)
+
+
+def get(refs, *, timeout=None):
+    """Resolve ObjectRef(s) to values (reference: ray.get,
+    python/ray/_private/worker.py:2273)."""
+    single = isinstance(refs, ObjectRef)
+    if single:
+        refs = [refs]
+    refs = list(refs)
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"ray_tpu.get takes ObjectRefs, got {type(r)}")
+    out = get_runtime().get(refs, timeout=timeout)
+    return out[0] if single else out
+
+
+def wait(refs, *, num_returns=1, timeout=None):
+    """Wait for num_returns of refs to be ready (reference: ray.wait)."""
+    refs = list(refs)
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return get_runtime().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def free(refs):
+    """Eagerly delete objects from the object plane."""
+    return get_runtime().free(list(refs))
+
+
+def available_resources():
+    rt = get_runtime()
+    return rt.client.request({"t": "state", "what": "resources"})["data"]["available"]
+
+
+def cluster_resources():
+    rt = get_runtime()
+    return rt.client.request({"t": "state", "what": "resources"})["data"]["total"]
+
+
+__all__ = [
+    "__version__", "init", "shutdown", "is_initialized", "remote", "put",
+    "get", "wait", "free", "get_actor", "kill", "ActorHandle", "ObjectRef",
+    "ObjectRefGenerator", "TaskError", "GetTimeoutError", "ActorDiedError",
+    "placement_group", "remove_placement_group", "PlacementGroup",
+    "PlacementGroupSchedulingStrategy", "available_resources",
+    "cluster_resources",
+]

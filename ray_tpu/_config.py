@@ -1,0 +1,138 @@
+"""Runtime configuration flag table.
+
+TPU-native analogue of the reference's ``RAY_CONFIG`` x-macro table
+(reference: src/ray/common/ray_config_def.h — 192 entries, env-overridable
+via ``RAY_<name>``, src/ray/common/ray_config.h:53).  Here every flag is a
+typed entry overridable via ``RAY_TPU_<NAME>`` environment variables, and a
+cluster-wide dict can be applied at init time (the analogue of Ray's
+``_system_config`` JSON that the GCS distributes, ray_config.cc:29).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+def _parse(ty: type, raw: str) -> Any:
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ty(raw)
+
+
+@dataclasses.dataclass
+class _Entry:
+    name: str
+    ty: type
+    default: Any
+    doc: str
+
+
+_TABLE: dict[str, _Entry] = {}
+
+
+def _define(name: str, ty: type, default: Any, doc: str) -> None:
+    _TABLE[name] = _Entry(name, ty, default, doc)
+
+
+# --- core object plumbing -------------------------------------------------
+_define("max_direct_call_object_size", int, 100 * 1024,
+        "Objects at or below this size are passed inline through the control "
+        "plane instead of the shared-memory store (reference: "
+        "ray_config_def.h:212 max_direct_call_object_size = 100KiB).")
+_define("task_rpc_inlined_bytes_limit", int, 10 * 1024 * 1024,
+        "Total inlined return bytes allowed per task reply "
+        "(reference: ray_config_def.h:496).")
+_define("object_store_memory", int, 2 * 1024 * 1024 * 1024,
+        "Bytes of shared memory reserved for the node object store.")
+_define("object_spilling_dir", str, "",
+        "Directory for spilled objects; empty = <session dir>/spill.")
+_define("object_store_full_delay_ms", int, 10,
+        "Backoff when the object store is full and eviction is in progress.")
+
+# --- scheduling -----------------------------------------------------------
+_define("num_workers", int, 0,
+        "Initial worker-pool size; 0 = number of host CPUs.")
+_define("max_workers", int, 64,
+        "Hard cap on worker processes per node (oversubscription for "
+        "blocked-on-get workers is allowed up to this).")
+_define("worker_register_timeout_s", float, 30.0,
+        "Seconds to wait for a spawned worker to register.")
+_define("scheduler_spread_threshold", float, 0.5,
+        "Critical-resource utilization under which nodes are considered "
+        "equally good and picked by top-k randomization (reference hybrid "
+        "policy, raylet/scheduling/policy/hybrid_scheduling_policy.h).")
+_define("lease_timeout_s", float, 30.0, "Worker lease grant timeout.")
+
+# --- fault tolerance ------------------------------------------------------
+_define("task_max_retries", int, 3,
+        "Default retries for tasks that die due to worker failure "
+        "(reference: task_manager.h:406).")
+_define("actor_max_restarts", int, 0, "Default actor restarts.")
+_define("health_check_period_ms", int, 1000,
+        "Node health-check cadence (reference: gcs_health_check_manager.cc).")
+_define("health_check_failure_threshold", int, 5,
+        "Missed health checks before a node is declared dead.")
+
+# --- TPU / gang -----------------------------------------------------------
+_define("tpu_gang_in_process", bool, True,
+        "Single-host fast path: run the TPU gang inline in the driver "
+        "process so jax device ownership stays with the driver.")
+_define("mesh_dcn_axis", str, "dcn",
+        "Name of the cross-slice (DCN) mesh axis.")
+
+# --- observability --------------------------------------------------------
+_define("metrics_report_interval_ms", int, 2000, "Metrics export cadence.")
+_define("task_events_buffer_size", int, 100_000,
+        "Max buffered task state events for the state API (reference: "
+        "core_worker/task_event_buffer.cc).")
+_define("log_to_driver", bool, True,
+        "Forward worker stdout/stderr lines to the driver.")
+
+ENV_PREFIX = "RAY_TPU_"
+
+
+class RayTpuConfig:
+    """Resolved flag values: defaults < system_config dict < environment."""
+
+    def __init__(self, system_config: dict[str, Any] | None = None):
+        self._values: dict[str, Any] = {}
+        for name, e in _TABLE.items():
+            val = e.default
+            if system_config and name in system_config:
+                val = e.ty(system_config[name])
+            raw = os.environ.get(ENV_PREFIX + name.upper())
+            if raw is None:
+                raw = os.environ.get(ENV_PREFIX + name)
+            if raw is not None:
+                val = _parse(e.ty, raw)
+            self._values[name] = val
+        if system_config:
+            unknown = set(system_config) - set(_TABLE)
+            if unknown:
+                raise ValueError(f"Unknown system_config keys: {sorted(unknown)}")
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self._values)
+
+
+_global_config: RayTpuConfig | None = None
+
+
+def get_config() -> RayTpuConfig:
+    global _global_config
+    if _global_config is None:
+        _global_config = RayTpuConfig()
+    return _global_config
+
+
+def set_config(cfg: RayTpuConfig) -> None:
+    global _global_config
+    _global_config = cfg

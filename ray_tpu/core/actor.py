@@ -1,0 +1,152 @@
+"""Actor classes and handles.
+
+Reference analogue: python/ray/actor.py (ActorClass:377, ActorClass._remote
+:659, ActorHandle:1022, _actor_method_call:1111, named actors w/ namespaces
+:581).  Method calls are ordered per-handle by sequence number; the node
+service's per-actor queue preserves submission order (reference:
+sequential_actor_submit_queue.h).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu.core.ids import ActorID, _Counter
+from ray_tpu.core.remote_function import (_pg_tuple, _resources_from_options,
+                                          _validate_options)
+from ray_tpu.core.runtime import get_runtime
+
+
+def _public_methods(cls) -> list[str]:
+    return [n for n in dir(cls)
+            if callable(getattr(cls, n, None)) and not n.startswith("__")]
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: Any = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name,
+                           num_returns=opts.get("num_returns",
+                                                self._num_returns))
+
+    def remote(self, *args, **kwargs):
+        return self._handle._actor_method_call(
+            self._name, args, kwargs, num_returns=self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Actor method '{self._name}' cannot be called "
+                        f"directly; use .remote().")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, methods: list[str],
+                 class_name: str = ""):
+        self._actor_id = actor_id
+        self._methods = set(methods)
+        self._class_name = class_name
+        self._seq = _Counter()
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._methods:
+            raise AttributeError(
+                f"Actor {self._class_name!r} has no method {name!r}")
+        return ActorMethod(self, name)
+
+    def _actor_method_call(self, method: str, args, kwargs, num_returns=1):
+        rt = get_runtime()
+        return rt.submit_actor_task(self._actor_id, self._seq.next(), method,
+                                    args, kwargs, num_returns=num_returns,
+                                    name=f"{self._class_name}.{method}")
+
+    def __reduce__(self):
+        return (_rebuild_handle,
+                (self._actor_id, sorted(self._methods), self._class_name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]}…)"
+
+
+def _rebuild_handle(actor_id, methods, class_name):
+    return ActorHandle(actor_id, methods, class_name)
+
+
+class ActorClass:
+    def __init__(self, cls: type, **options):
+        _validate_options(options)
+        self._cls = cls
+        self._options = options
+        self._function_id: Optional[str] = None
+        self._exported_to = None
+        self._export_lock = threading.Lock()
+
+    def options(self, **options) -> "ActorClass":
+        merged = {**self._options, **options}
+        ac = ActorClass(self._cls, **merged)
+        ac._function_id = self._function_id
+        ac._exported_to = self._exported_to
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        rt = get_runtime()
+        with self._export_lock:
+            if self._function_id is None or self._exported_to is not rt:
+                self._function_id = rt.export_function(self._cls)
+                self._exported_to = rt
+        o = self._options
+        methods = _public_methods(self._cls)
+        actor_id = rt.create_actor(
+            self._function_id, args, kwargs,
+            class_name=self._cls.__name__,
+            methods=methods,
+            name=o.get("name") or "",
+            namespace=o.get("namespace") or rt.namespace,
+            get_if_exists=bool(o.get("get_if_exists")),
+            resources=_resources_from_options(o),
+            num_tpus=float(o.get("num_tpus") or 0),
+            max_restarts=o.get("max_restarts",
+                               -1 if o.get("lifetime") == "detached" else 0),
+            max_concurrency=o.get("max_concurrency", 1),
+            placement_group=_pg_tuple(o))
+        return ActorHandle(actor_id, methods, self._cls.__name__)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated "
+            f"directly; use .remote().")
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_exported_to"] = None
+        state["_export_lock"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._export_lock = threading.Lock()
+
+
+def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    """Look up a named actor (reference: ray.get_actor,
+    _private/worker.py:2590).  Defaults to the namespace given to init()."""
+    rt = get_runtime()
+    reply = rt.client.request({"t": "get_named_actor", "name": name,
+                               "namespace": namespace or rt.namespace})
+    meta = reply["spec_meta"]
+    return ActorHandle(ActorID(reply["actor_id"]), meta["methods"],
+                       meta["class_name"])
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    get_runtime().kill_actor(actor.actor_id, no_restart=no_restart)

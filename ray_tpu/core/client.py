@@ -1,0 +1,245 @@
+"""NodeClient: every process's handle to the node service + object plane.
+
+The analogue of the reference CoreWorker's client half (reference:
+src/ray/core_worker/core_worker.h:278 — submit tasks, put/get objects, reach
+the control plane) minus task execution, which lives in
+``ray_tpu.core.worker`` / the driver executor thread.
+
+Request/response correlation is by ``reqid``; pushed messages (execute,
+pub, shutdown) are delivered to a handler callback on the receive thread.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import uuid
+from typing import Any, Callable, Optional
+
+from ray_tpu.core import protocol
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import SharedMemoryClient
+from ray_tpu.core.serialization import (SerializedObject, get_context)
+
+
+class GetTimeoutError(TimeoutError):
+    pass
+
+
+class TaskError(Exception):
+    """Wraps an exception raised inside a task, carrying the remote
+    traceback (reference: ray.exceptions.RayTaskError)."""
+
+    def __init__(self, cause: BaseException, remote_tb: str = ""):
+        self.cause = cause
+        self.remote_tb = remote_tb
+        super().__init__(f"{type(cause).__name__}: {cause}\n"
+                         f"--- remote traceback ---\n{remote_tb}")
+
+    def __reduce__(self):
+        # Preserve (cause, tb) structure across pickling; the default
+        # BaseException reduce would re-init with the formatted message.
+        return (type(self), (self.cause, self.remote_tb))
+
+
+class ActorDiedError(RuntimeError):
+    pass
+
+
+class NodeClient:
+    def __init__(self, address: str, kind: str, tpu: bool = False,
+                 push_handler: Optional[Callable[[dict], None]] = None):
+        self.address = address
+        self.kind = kind
+        self.worker_id = f"{kind}-{uuid.uuid4().hex[:12]}"
+        self.conn = protocol.connect(address)
+        self._reqid = 0
+        self._reqlock = threading.Lock()
+        self._replies: dict[int, queue.SimpleQueue] = {}
+        self._push_handler = push_handler
+        self._closed = threading.Event()
+        self._recv_thread = threading.Thread(target=self._recv_loop,
+                                             daemon=True,
+                                             name=f"raytpu-recv-{kind}")
+        self._recv_thread.start()
+        info = self.request({"t": "register", "kind": kind, "tpu": tpu,
+                             "worker_id": self.worker_id, "pid": os.getpid()})
+        self.session: str = info["session"]
+        self.node_id: str = info["node_id"]
+        self.config_dict: dict = info["config"]
+        self.shm = SharedMemoryClient(self.session)
+        self._serde = get_context()
+
+    # ----------------------------------------------------------- plumbing
+
+    def _next_reqid(self) -> int:
+        with self._reqlock:
+            self._reqid += 1
+            return self._reqid
+
+    def _recv_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                msg = self.conn.recv()
+            except protocol.ConnectionClosed:
+                self._closed.set()
+                # wake all pending requesters with an error
+                for q in list(self._replies.values()):
+                    q.put({"error": "node connection closed"})
+                if self._push_handler is not None:
+                    try:
+                        self._push_handler({"t": "shutdown"})
+                    except Exception:
+                        pass
+                return
+            except Exception:
+                continue
+            if msg.get("t") == "reply":
+                q = self._replies.pop(msg["reqid"], None)
+                if q is not None:
+                    q.put(msg)
+            elif self._push_handler is not None:
+                try:
+                    self._push_handler(msg)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+
+    def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        reqid = self._next_reqid()
+        msg["reqid"] = reqid
+        q: queue.SimpleQueue = queue.SimpleQueue()
+        self._replies[reqid] = q
+        self.conn.send(msg)
+        try:
+            reply = q.get(timeout=timeout)
+        except queue.Empty:
+            self._replies.pop(reqid, None)
+            raise GetTimeoutError(f"request {msg['t']} timed out") from None
+        if reply.get("error"):
+            raise RuntimeError(reply["error"])
+        return reply
+
+    def send(self, msg: dict) -> None:
+        self.conn.send(msg)
+
+    def close(self) -> None:
+        self._closed.set()
+        self.conn.close()
+        self.shm.shutdown()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    # ------------------------------------------------------- object plane
+
+    def put_object(self, object_id: ObjectID, value: Any,
+                   owner: Optional[str] = None,
+                   is_error: bool = False) -> int:
+        """Serialize and store; returns stored size."""
+        so = self._serde.serialize(value)
+        return self.put_serialized(object_id, so, owner=owner,
+                                   is_error=is_error)
+
+    def put_serialized(self, object_id: ObjectID, so: SerializedObject,
+                       owner: Optional[str] = None,
+                       is_error: bool = False) -> int:
+        size = so.total_bytes()
+        inline_limit = self.config_dict["max_direct_call_object_size"]
+        # Fire-and-forget: same-socket ordering guarantees the node sees the
+        # put before any later get/submit from this process (reference: Put
+        # is async in CoreWorker too, core_worker.h:500).
+        if size <= inline_limit or is_error:
+            self.send({"t": "put_inline", "object_id": object_id.binary(),
+                       "data": so.to_bytes(), "is_error": is_error,
+                       "owner": owner or self.worker_id})
+        else:
+            buf = self.shm.create(object_id, size)
+            _write_into(so, buf)
+            del buf
+            self.send({"t": "register_object",
+                       "object_id": object_id.binary(), "size": size,
+                       "owner": owner or self.worker_id})
+        return size
+
+    def get_objects(self, object_ids: list[ObjectID],
+                    timeout: Optional[float] = None) -> list[Any]:
+        reply = self.request({"t": "get_objects",
+                              "object_ids": [o.binary() for o in object_ids]},
+                             timeout=timeout)
+        out = []
+        shm_ids = []
+        try:
+            for oid, res in zip(object_ids, reply["results"]):
+                if res["loc"] == "shm":
+                    shm_ids.append(oid.binary())
+                    buf = self.shm.map(oid)
+                    so = SerializedObject.from_buffer(buf[:res["size"]])
+                else:
+                    so = SerializedObject.from_buffer(res["data"])
+                value = self._serde.deserialize(so)
+                if res.get("is_error"):
+                    if isinstance(value, BaseException):
+                        raise value
+                    raise RuntimeError(str(value))
+                out.append(value)
+        finally:
+            # ack: node pinned shm objects for this get; release now that
+            # this process has the segments mapped
+            if shm_ids:
+                self.send({"t": "release_pins", "object_ids": shm_ids})
+        return out
+
+    def wait(self, object_ids: list[ObjectID], num_returns: int,
+             timeout: Optional[float]) -> list[bytes]:
+        reply = self.request({"t": "wait",
+                              "object_ids": [o.binary() for o in object_ids],
+                              "num_returns": num_returns, "timeout": timeout})
+        return reply["ready"]
+
+    def free(self, object_ids: list[ObjectID]) -> None:
+        self.request({"t": "free_objects",
+                      "object_ids": [o.binary() for o in object_ids]})
+
+    # -------------------------------------------------------------- kv
+
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
+               namespace: str = "default") -> bool:
+        return self.request({"t": "kv_put", "key": key, "value": value,
+                             "overwrite": overwrite,
+                             "namespace": namespace})["added"]
+
+    def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        return self.request({"t": "kv_get", "key": key,
+                             "namespace": namespace})["value"]
+
+    def kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        return self.request({"t": "kv_del", "key": key,
+                             "namespace": namespace})["deleted"]
+
+    def kv_keys(self, prefix: bytes = b"",
+                namespace: str = "default") -> list[bytes]:
+        return self.request({"t": "kv_keys", "prefix": prefix,
+                             "namespace": namespace})["keys"]
+
+
+class _MemoryviewWriter:
+    """File-like writer over a memoryview so SerializedObject.write_to is
+    the single encoder of the wire layout."""
+
+    def __init__(self, buf: memoryview):
+        self._buf = buf
+        self._off = 0
+
+    def write(self, b) -> int:
+        mv = memoryview(b).cast("B") if not isinstance(b, bytes) else b
+        n = len(mv)
+        self._buf[self._off:self._off + n] = mv
+        self._off += n
+        return n
+
+
+def _write_into(so: SerializedObject, buf: memoryview) -> None:
+    so.write_to(_MemoryviewWriter(buf))

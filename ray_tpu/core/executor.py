@@ -1,0 +1,209 @@
+"""Task/actor execution engine.
+
+The analogue of the reference's executor half of CoreWorker (reference:
+src/ray/core_worker/core_worker.cc:2528 task_execution_callback →
+python/ray/_raylet.pyx:701 execute_task): fetch the function by id,
+resolve arguments, run user code, store returns (inline vs shm by size),
+report completion.  Used by worker processes (ray_tpu.core.worker) and by
+the driver's in-process TPU executor thread (single-host fast path — the
+driver keeps jax device ownership, SURVEY.md §7 design delta 1).
+"""
+
+from __future__ import annotations
+
+import inspect
+import queue
+import threading
+import traceback
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu.core.client import NodeClient, TaskError
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
+from ray_tpu.core.serialization import SerializedObject, get_context
+
+
+class _ArgSlot:
+    """Marker for a top-level ObjectRef argument resolved before execution."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+
+def make_message_queue() -> "queue.SimpleQueue":
+    """Create the executor inbox BEFORE connecting the client, so pushes
+    that arrive during registration are never dropped."""
+    return queue.SimpleQueue()
+
+
+def queue_push_handler(q: "queue.SimpleQueue"):
+    def push(msg: dict) -> None:
+        q.put(msg)
+    return push
+
+
+class Executor:
+    def __init__(self, client: NodeClient,
+                 msg_queue: Optional["queue.SimpleQueue"] = None):
+        self.client = client
+        self._functions: dict[str, Any] = {}
+        self._actors: dict[bytes, Any] = {}
+        self._actor_lock = threading.Lock()
+        self._serde = get_context()
+        self._queue = msg_queue if msg_queue is not None else queue.SimpleQueue()
+        self._shutdown = threading.Event()
+
+    # -- message pump ------------------------------------------------------
+
+    def push_handler(self, msg: dict) -> None:
+        """Called on the client's receive thread."""
+        self._queue.put(msg)
+
+    def run_loop(self) -> None:
+        """Blocking execution loop (reference:
+        CoreWorkerProcess::RunTaskExecutionLoop, core_worker_process.h:100)."""
+        while not self._shutdown.is_set():
+            msg = self._queue.get()
+            t = msg.get("t")
+            if t in ("stop", "shutdown", "exit"):
+                self._shutdown.set()
+                break
+            if t == "execute":
+                self.execute_task(msg["spec"])
+            elif t == "execute_actor":
+                self.execute_actor_task(msg["spec"])
+            elif t == "create_actor_exec":
+                self.create_actor(msg["spec"])
+
+    # -- function store ----------------------------------------------------
+
+    def _get_function(self, function_id: str):
+        fn = self._functions.get(function_id)
+        if fn is None:
+            reply = self.client.request({"t": "fetch_function",
+                                         "function_id": function_id})
+            fn = cloudpickle.loads(reply["pickled"])
+            self._functions[function_id] = fn
+        return fn
+
+    # -- argument resolution ----------------------------------------------
+
+    def _load_args(self, spec: dict):
+        blob_id = spec.get("arg_blob")
+        if blob_id is not None:
+            args, kwargs = self.client.get_objects([ObjectID(blob_id)])[0]
+        else:
+            so = SerializedObject.from_buffer(spec["args"])
+            args, kwargs = self._serde.deserialize(so)
+        ref_ids = [ObjectID(b) for b in spec.get("arg_ids", [])
+                   if b != blob_id]
+        if ref_ids:
+            values = self.client.get_objects(ref_ids)
+            args = [values[a.index] if isinstance(a, _ArgSlot) else a
+                    for a in args]
+            kwargs = {k: (values[v.index] if isinstance(v, _ArgSlot) else v)
+                      for k, v in kwargs.items()}
+        return list(args), dict(kwargs)
+
+    # -- return storage ----------------------------------------------------
+
+    def _store_returns(self, spec: dict, result: Any) -> None:
+        return_ids = [ObjectID(b) for b in spec["return_ids"]]
+        num_returns = spec.get("num_returns", 1)
+        if num_returns == "dynamic":
+            refs = []
+            task_id = TaskID(spec["task_id"])
+            for i, item in enumerate(result):
+                oid = ObjectID.for_task_return(task_id, i + 2)
+                self.client.put_object(oid, item, owner=self.client.worker_id)
+                refs.append(ObjectRef(oid, owner=self.client.worker_id))
+            self.client.put_object(return_ids[0], ObjectRefGenerator(refs),
+                                   owner=self.client.worker_id)
+            return
+        if num_returns == 0:
+            return
+        if num_returns == 1:
+            outs = [result]
+        else:
+            outs = list(result)
+            if len(outs) != num_returns:
+                raise ValueError(
+                    f"Task declared num_returns={num_returns} but returned "
+                    f"{len(outs)} values")
+        for oid, val in zip(return_ids, outs):
+            self.client.put_object(oid, val, owner=self.client.worker_id)
+
+    def _store_error(self, spec: dict, exc: BaseException, tb: str) -> None:
+        err = TaskError(exc, tb) if not isinstance(exc, TaskError) else exc
+        for b in spec["return_ids"]:
+            try:
+                self.client.put_object(ObjectID(b), err, is_error=True)
+            except Exception:
+                # even the error failed to serialize — store a plain one
+                self.client.put_object(
+                    ObjectID(b),
+                    TaskError(RuntimeError(
+                        f"unserializable {type(exc).__name__}: {exc}"), tb),
+                    is_error=True)
+
+    # -- execution ---------------------------------------------------------
+
+    def execute_task(self, spec: dict) -> None:
+        from ray_tpu.core.runtime import task_context
+        error = None
+        try:
+            fn = self._get_function(spec["function_id"])
+            args, kwargs = self._load_args(spec)
+            with task_context(TaskID(spec["task_id"])):
+                result = fn(*args, **kwargs)
+            self._store_returns(spec, result)
+        except BaseException as e:  # noqa: BLE001 — report all task errors
+            tb = traceback.format_exc()
+            error = f"{type(e).__name__}: {e}"
+            self._store_error(spec, e, tb)
+        self.client.send({"t": "task_done", "task_id": spec["task_id"],
+                          "error": error})
+
+    def create_actor(self, spec: dict) -> None:
+        error = None
+        try:
+            cls = self._get_function(spec["function_id"])
+            args, kwargs = self._load_args(spec)
+            from ray_tpu.core.runtime import task_context
+            with task_context(TaskID(spec["task_id"])):
+                instance = cls(*args, **kwargs)
+            with self._actor_lock:
+                self._actors[spec["actor_id"]] = instance
+        except BaseException as e:  # noqa: BLE001
+            error = (f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
+        self.client.send({"t": "actor_created", "actor_id": spec["actor_id"],
+                          "error": error})
+
+    def execute_actor_task(self, spec: dict) -> None:
+        from ray_tpu.core.runtime import task_context
+        error = None
+        try:
+            instance = self._actors.get(spec["actor_id"])
+            if instance is None:
+                raise RuntimeError("actor instance not found in this worker")
+            method = getattr(instance, spec["method"])
+            args, kwargs = self._load_args(spec)
+            with task_context(TaskID(spec["task_id"])):
+                result = method(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    import asyncio
+                    result = asyncio.run(result)
+            self._store_returns(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            error = f"{type(e).__name__}: {e}"
+            self._store_error(spec, e, tb)
+        self.client.send({"t": "task_done", "task_id": spec["task_id"],
+                          "error": error})
+
+    def get_actor_instance(self, actor_id: bytes) -> Optional[Any]:
+        return self._actors.get(actor_id)

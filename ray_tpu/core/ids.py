@@ -1,0 +1,151 @@
+"""Binary identifiers for jobs, tasks, actors and objects.
+
+Mirrors the capability (not the layout code) of the reference's ID scheme
+(reference: src/ray/common/id.h — JobID 4B, ActorID 16B, TaskID 24B,
+ObjectID 28B = TaskID + return index).  Deterministic derivation lets any
+process compute a task's return ObjectIds without coordination.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+JOB_ID_SIZE = 4
+ACTOR_ID_SIZE = 16
+TASK_ID_SIZE = 24
+OBJECT_ID_SIZE = 28
+NODE_ID_SIZE = 16
+PG_ID_SIZE = 16
+
+_NIL_TASK = b"\xff" * TASK_ID_SIZE
+
+
+class BaseId:
+    SIZE = 0
+    __slots__ = ("_bytes",)
+
+    def __init__(self, b: bytes):
+        if len(b) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(b)}")
+        self._bytes = b
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseId):
+    SIZE = JOB_ID_SIZE
+
+    @classmethod
+    def from_int(cls, i: int) -> "JobID":
+        return cls(i.to_bytes(JOB_ID_SIZE, "little"))
+
+
+class NodeID(BaseId):
+    SIZE = NODE_ID_SIZE
+
+
+class ActorID(BaseId):
+    SIZE = ACTOR_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID, parent_task: "TaskID", counter: int) -> "ActorID":
+        h = hashlib.sha1(parent_task.binary())
+        h.update(counter.to_bytes(8, "little"))
+        return cls(h.digest()[: ACTOR_ID_SIZE - JOB_ID_SIZE] + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[-JOB_ID_SIZE:])
+
+
+class TaskID(BaseId):
+    SIZE = TASK_ID_SIZE
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        return cls(b"\x00" * (TASK_ID_SIZE - JOB_ID_SIZE) + job_id.binary())
+
+    @classmethod
+    def of(cls, parent: "TaskID", counter: int) -> "TaskID":
+        h = hashlib.sha1(parent.binary())
+        h.update(counter.to_bytes(8, "little"))
+        return cls(h.digest()[: TASK_ID_SIZE - JOB_ID_SIZE]
+                   + parent.binary()[-JOB_ID_SIZE:])
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID, seq: int) -> "TaskID":
+        h = hashlib.sha1(b"actor:" + actor_id.binary())
+        h.update(seq.to_bytes(8, "little"))
+        return cls(h.digest()[: TASK_ID_SIZE - JOB_ID_SIZE]
+                   + actor_id.binary()[-JOB_ID_SIZE:])
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[-JOB_ID_SIZE:])
+
+
+class ObjectID(BaseId):
+    SIZE = OBJECT_ID_SIZE
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        """Return `index` (1-based, like the reference) of `task_id`."""
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Put ids use the high bit of the index to avoid colliding with
+        # return ids.
+        return cls(task_id.binary()
+                   + (put_index | 0x8000_0000).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:TASK_ID_SIZE])
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bytes[TASK_ID_SIZE:], "little")
+
+
+class PlacementGroupID(BaseId):
+    SIZE = PG_ID_SIZE
+
+
+class _Counter:
+    """Thread-safe monotonically increasing counter."""
+
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._v += 1
+            return self._v

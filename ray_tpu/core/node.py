@@ -1,0 +1,1156 @@
+"""Node service: the single-process control plane for one node.
+
+Combines, in one event loop, the capabilities the reference splits between
+the GCS server and the raylet:
+
+  * task scheduling + worker pool        (reference: src/ray/raylet/
+    node_manager.cc HandleRequestWorkerLease:1822, worker_pool.h,
+    local_task_manager.h dispatch loop)
+  * object directory + inline store + shm bookkeeping + spilling
+    (reference: core_worker memory_store.h, plasma store.h,
+    local_object_manager.h)
+  * actor directory, creation, restart   (reference: gcs_actor_manager.cc
+    HandleRegisterActor:249, SchedulePendingActors:1247)
+  * named actors, KV store, pubsub, function store, job table
+    (reference: gcs_kv_manager.cc, pubsub/, function_manager.py)
+  * placement groups (resource reservation; 2PC collapses to one phase on a
+    single node — reference: gcs_placement_group_scheduler.h:104 2PC)
+  * task state events for the state API  (reference: gcs_task_manager.cc)
+
+Runs either as a thread inside the driver (default, `ray_tpu.init()`) or as
+a standalone head process (`python -m ray_tpu.core.node`).  The scheduler is
+two-level-ready: `_schedule()` is the local half; a cluster half can route
+specs between multiple NodeService instances (multi-host, later milestone).
+"""
+
+from __future__ import annotations
+
+import os
+import selectors
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import traceback
+import pickle
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu._config import RayTpuConfig
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
+from ray_tpu.core.object_store import ObjectStoreCore
+from ray_tpu.core.protocol import dumps_frame
+
+_HDR = struct.Struct("<Q")
+
+# ---------------------------------------------------------------------------
+# records
+
+
+@dataclass
+class ClientRec:
+    conn_id: int
+    sock: socket.socket
+    kind: str = ""               # driver | worker | tpu_executor | observer
+    worker_id: str = ""
+    pid: int = 0
+    tpu: bool = False            # may execute TPU tasks
+    state: str = "idle"          # idle | busy | blocked
+    current_task: Optional[bytes] = None
+    dedicated_actor: Optional[ActorID] = None
+    rbuf: bytearray = field(default_factory=bytearray)
+    wbuf: bytearray = field(default_factory=bytearray)
+    held_pins: list = field(default_factory=list)
+    closed: bool = False
+
+
+@dataclass
+class ObjInfo:
+    state: str = "pending"       # pending | ready | error
+    loc: str = ""                # inline | shm
+    data: Optional[bytes] = None  # inline payload (SerializedObject wire bytes)
+    size: int = 0
+    owner: str = ""
+    is_error: bool = False
+    wait_waiters: list = field(default_factory=list)
+
+
+@dataclass
+class TaskRec:
+    spec: dict
+    state: str = "pending"       # pending | running | finished | failed
+    worker: Optional[int] = None
+    retries_left: int = 0
+    submitted_at: float = field(default_factory=time.time)
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    error: str = ""
+
+
+@dataclass
+class ActorRec:
+    actor_id: ActorID
+    spec: dict                   # creation spec (reusable for restart)
+    state: str = "pending"       # pending | alive | restarting | dead
+    conn_id: Optional[int] = None
+    name: str = ""
+    namespace: str = ""
+    restarts_left: int = 0
+    seq: int = 0
+    queue: deque = field(default_factory=deque)   # pending method-call specs
+    running: dict = field(default_factory=dict)   # task_id -> in-flight spec
+    max_concurrency: int = 1
+    death_cause: str = ""
+
+    @property
+    def inflight(self) -> int:
+        return len(self.running)
+
+
+@dataclass
+class PGRec:
+    pg_id: PlacementGroupID
+    bundles: list                # list[dict resource->qty]
+    strategy: str
+    state: str = "created"       # single-node: reserve succeeds or raises
+
+
+class NodeService:
+    def __init__(self, config: RayTpuConfig, session: str,
+                 session_dir: str, listen_host: str = "127.0.0.1",
+                 port: int = 0, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[dict] = None):
+        self.config = config
+        self.session = session
+        self.session_dir = session_dir
+        self.node_id = NodeID.from_random()
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+
+        ncpu = num_cpus if num_cpus is not None else float(os.cpu_count() or 1)
+        self.total_resources: dict[str, float] = {"CPU": ncpu}
+        if num_tpus:
+            self.total_resources["TPU"] = float(num_tpus)
+        if resources:
+            self.total_resources.update(resources)
+        self.available = dict(self.total_resources)
+
+        spill_dir = config.object_spilling_dir or os.path.join(session_dir, "spill")
+        self.store = ObjectStoreCore(session, config.object_store_memory, spill_dir)
+
+        self.sel = selectors.DefaultSelector()
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind((listen_host, port))
+        self.listener.listen(512)
+        self.listener.setblocking(False)
+        self.address = "%s:%d" % self.listener.getsockname()
+        self.sel.register(self.listener, selectors.EVENT_READ, None)
+
+        self._next_conn = 0
+        self.clients: dict[int, ClientRec] = {}
+        self.objects: dict[ObjectID, ObjInfo] = {}
+        self.tasks: dict[bytes, TaskRec] = {}
+        # Two-queue dispatch (reference: local_task_manager.h waiting →
+        # dispatch queues): tasks wait on deps, then join a runnable FIFO
+        # per executor class.
+        self.runnable_cpu: deque[dict] = deque()
+        self.runnable_tpu: deque[dict] = deque()
+        self.dep_waiting: dict[ObjectID, list] = {}  # oid -> waiting specs
+        self.actors: dict[ActorID, ActorRec] = {}
+        self.named_actors: dict[tuple[str, str], ActorID] = {}
+        self.kv: dict[tuple[str, bytes], bytes] = {}
+        self.functions: dict[str, bytes] = {}
+        self.pubsub: dict[str, set[int]] = {}
+        self.pgs: dict[PlacementGroupID, PGRec] = {}
+        self.pg_available: dict[tuple[bytes, int], dict] = {}  # (pg,bundle)->free
+        self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
+        self._spawning = 0
+        self._worker_procs: list[subprocess.Popen] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fn_waiters: dict[str, list] = {}
+        # Callbacks posted from timers/other threads; drained by the event
+        # loop so ALL state mutation happens on the loop thread.
+        self._posted: deque = deque()
+        self._posted_lock = threading.Lock()
+        # Batched-get bookkeeping: (conn_id, reqid) -> {ids, remaining}.
+        self._multigets: dict[tuple, dict] = {}
+        self._mg_by_oid: dict[ObjectID, set] = {}
+        self._last_tick = 0.0
+
+    def post(self, fn) -> None:
+        with self._posted_lock:
+            self._posted.append(fn)
+
+    def post_later(self, delay: float, fn) -> None:
+        t = threading.Timer(delay, lambda: self.post(fn))
+        t.daemon = True
+        t.start()
+
+    # ------------------------------------------------------------------ run
+
+    def start_thread(self) -> None:
+        self._thread = threading.Thread(target=self.run, name="raytpu-node",
+                                        daemon=True)
+        self._thread.start()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            while True:
+                with self._posted_lock:
+                    if not self._posted:
+                        break
+                    fn = self._posted.popleft()
+                try:
+                    fn()
+                except Exception:
+                    sys.stderr.write("[node] posted callback failed:\n"
+                                     + traceback.format_exc())
+            now = time.monotonic()
+            if now - self._last_tick > 0.25:
+                self._last_tick = now
+                # periodic re-dispatch: recovers from missed wakeups and
+                # re-evaluates worker-pool health (dead spawns etc.)
+                try:
+                    self._schedule()
+                except Exception:
+                    sys.stderr.write("[node] periodic schedule error:\n"
+                                     + traceback.format_exc())
+            try:
+                events = self.sel.select(timeout=0.05)
+            except OSError:
+                continue
+            for key, mask in events:
+                if key.data is None:
+                    self._accept()
+                else:
+                    rec: ClientRec = key.data
+                    try:
+                        if mask & selectors.EVENT_READ:
+                            self._on_readable(rec)
+                        if mask & selectors.EVENT_WRITE:
+                            self._on_writable(rec)
+                    except Exception:
+                        sys.stderr.write("[node] connection handler error:\n"
+                                         + traceback.format_exc())
+                        try:
+                            self._drop_client(rec)
+                        except Exception:
+                            sys.stderr.write("[node] drop_client error:\n"
+                                             + traceback.format_exc())
+        self._cleanup()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)
+
+    def _cleanup(self) -> None:
+        for rec in list(self.clients.values()):
+            try:
+                self._push(rec, {"t": "shutdown"})
+                self._flush(rec)
+            except Exception:
+                pass
+        deadline = time.time() + 2.0
+        for p in self._worker_procs:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for rec in list(self.clients.values()):
+            try:
+                rec.sock.close()
+            except OSError:
+                pass
+        self.listener.close()
+        self.sel.close()
+        self.store.shutdown()
+
+    # ----------------------------------------------------------------- io
+
+    def _accept(self) -> None:
+        try:
+            sock, _ = self.listener.accept()
+        except OSError:
+            return
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._next_conn += 1
+        rec = ClientRec(conn_id=self._next_conn, sock=sock)
+        self.clients[rec.conn_id] = rec
+        self.sel.register(sock, selectors.EVENT_READ, rec)
+
+    def _on_readable(self, rec: ClientRec) -> None:
+        try:
+            data = rec.sock.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop_client(rec)
+            return
+        if not data:
+            self._drop_client(rec)
+            return
+        rec.rbuf += data
+        while True:
+            if len(rec.rbuf) < _HDR.size:
+                break
+            (n,) = _HDR.unpack_from(rec.rbuf)
+            if len(rec.rbuf) < _HDR.size + n:
+                break
+            frame = bytes(rec.rbuf[_HDR.size:_HDR.size + n])
+            del rec.rbuf[:_HDR.size + n]
+            msg = pickle.loads(frame)
+            self._dispatch(rec, msg)
+
+    def _on_writable(self, rec: ClientRec) -> None:
+        if rec.wbuf:
+            try:
+                sent = rec.sock.send(rec.wbuf)
+                del rec.wbuf[:sent]
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._drop_client(rec)
+                return
+        if not rec.wbuf:
+            self.sel.modify(rec.sock, selectors.EVENT_READ, rec)
+
+    def _push(self, rec: ClientRec, msg: dict) -> None:
+        if rec.closed:
+            return
+        frame = dumps_frame(msg)
+        if rec.wbuf:
+            rec.wbuf += frame
+            return
+        try:
+            sent = rec.sock.send(frame)
+        except (BlockingIOError, InterruptedError):
+            sent = 0
+        except OSError:
+            self._drop_client(rec)
+            return
+        if sent < len(frame):
+            rec.wbuf += frame[sent:]
+            try:
+                self.sel.modify(rec.sock,
+                                selectors.EVENT_READ | selectors.EVENT_WRITE, rec)
+            except KeyError:
+                pass
+
+    def _flush(self, rec: ClientRec) -> None:
+        rec.sock.setblocking(True)
+        if rec.wbuf:
+            try:
+                rec.sock.sendall(bytes(rec.wbuf))
+            except OSError:
+                pass
+            rec.wbuf.clear()
+
+    def _reply(self, rec: ClientRec, reqid: int, **kw) -> None:
+        kw["t"] = "reply"
+        kw["reqid"] = reqid
+        self._push(rec, kw)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch(self, rec: ClientRec, msg: dict) -> None:
+        handler = getattr(self, "_h_" + msg["t"], None)
+        if handler is None:
+            if "reqid" in msg:
+                self._reply(rec, msg["reqid"], error=f"unknown message {msg['t']}")
+            return
+        try:
+            handler(rec, msg)
+        except Exception:
+            tb = traceback.format_exc()
+            sys.stderr.write(f"[node] handler {msg['t']} failed:\n{tb}")
+            if "reqid" in msg:
+                self._reply(rec, msg["reqid"], error=tb)
+
+    # -- registration
+
+    def _h_register(self, rec, m):
+        rec.kind = m["kind"]
+        rec.worker_id = m.get("worker_id", "")
+        rec.pid = m.get("pid", 0)
+        rec.tpu = bool(m.get("tpu", False))
+        if rec.kind in ("worker", "tpu_executor"):
+            self._spawning = max(0, self._spawning - 1)
+        self._reply(rec, m["reqid"], session=self.session,
+                    node_id=self.node_id.hex(), address=self.address,
+                    config=self.config.to_dict())
+        self._schedule()
+
+    # -- objects
+
+    def _h_put_inline(self, rec, m):
+        oid = ObjectID(m["object_id"])
+        info = self.objects.setdefault(oid, ObjInfo())
+        info.state = "error" if m.get("is_error") else "ready"
+        info.loc = "inline"
+        info.data = m["data"]
+        info.size = len(m["data"])
+        info.owner = m.get("owner", rec.worker_id)
+        info.is_error = bool(m.get("is_error"))
+        self._resolve_waiters(oid, info)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_register_object(self, rec, m):
+        oid = ObjectID(m["object_id"])
+        info = self.objects.setdefault(oid, ObjInfo())
+        info.state = "ready"
+        info.loc = "shm"
+        info.size = m["size"]
+        info.owner = m.get("owner", rec.worker_id)
+        self.store.register(oid, m["size"])
+        self._resolve_waiters(oid, info)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_get_objects(self, rec, m):
+        """Batched blocking get: reply once ALL requested objects resolve."""
+        ids = [ObjectID(b) for b in m["object_ids"]]
+        pending = [o for o in ids
+                   if self.objects.setdefault(o, ObjInfo()).state == "pending"]
+        if not pending:
+            self._reply_batch(rec, m["reqid"], ids)
+            return
+        key = (rec.conn_id, m["reqid"])
+        self._multigets[key] = {"ids": ids, "remaining": set(pending)}
+        for o in pending:
+            self._mg_by_oid.setdefault(o, set()).add(key)
+        if rec.state == "busy":
+            rec.state = "blocked"
+            self._release_task_cpu(rec)
+            self._schedule()
+
+    def _reply_batch(self, rec, reqid, ids):
+        results = []
+        for oid in ids:
+            info = self.objects[oid]
+            if info.loc == "shm":
+                if self.store.is_spilled(oid):
+                    self.store.restore(oid)
+                self.store.touch(oid)
+                # Pin until the client acks mapping (release_pins) so
+                # eviction can't unlink the segment mid-get (reference:
+                # plasma pins objects for the duration of a Get).
+                self.store.pin(oid)
+                rec.held_pins.append(oid)
+                results.append({"loc": "shm", "size": info.size,
+                                "is_error": info.is_error})
+            else:
+                results.append({"loc": "inline", "data": info.data,
+                                "is_error": info.is_error})
+        self._reply(rec, reqid, results=results)
+
+    def _h_release_pins(self, rec, m):
+        for b in m["object_ids"]:
+            oid = ObjectID(b)
+            if oid in rec.held_pins:
+                rec.held_pins.remove(oid)
+                self.store.unpin(oid)
+
+    def _resolve_waiters(self, oid: ObjectID, info: ObjInfo) -> None:
+        for key in self._mg_by_oid.pop(oid, ()):
+            mg = self._multigets.get(key)
+            if mg is None:
+                continue
+            mg["remaining"].discard(oid)
+            if not mg["remaining"]:
+                del self._multigets[key]
+                w = self.clients.get(key[0])
+                if w is not None:
+                    if w.state == "blocked":
+                        w.state = "busy"
+                    self._reply_batch(w, key[1], mg["ids"])
+        for conn_id, reqid, ids, num_returns, deadline in list(info.wait_waiters):
+            self._try_finish_wait(conn_id, reqid, ids, num_returns, deadline)
+        info.wait_waiters.clear()
+        # release tasks waiting on this dependency
+        for spec in self.dep_waiting.pop(oid, ()):
+            spec["_ndeps"] -= 1
+            if spec["_ndeps"] == 0:
+                self._make_runnable(spec)
+        self._schedule()
+
+    def _h_wait(self, rec, m):
+        ids = [ObjectID(b) for b in m["object_ids"]]
+        self._try_finish_wait(rec.conn_id, m["reqid"], ids, m["num_returns"],
+                              time.time() + m["timeout"] if m.get("timeout")
+                              is not None else None, first=True)
+
+    def _try_finish_wait(self, conn_id, reqid, ids, num_returns, deadline,
+                         first=False):
+        rec = self.clients.get(conn_id)
+        if rec is None:
+            return
+        ready = [o for o in ids
+                 if self.objects.get(o) is not None
+                 and self.objects[o].state != "pending"]
+        timed_out = deadline is not None and time.time() >= deadline
+        if len(ready) >= num_returns or timed_out:
+            if not timed_out:
+                ready = ready[:num_returns]
+            self._reply(rec, reqid, ready=[o.binary() for o in ready])
+            return
+        if first:
+            for o in ids:
+                info = self.objects.setdefault(o, ObjInfo())
+                if info.state == "pending":
+                    info.wait_waiters.append((conn_id, reqid, ids, num_returns,
+                                              deadline))
+            if deadline is not None:
+                self.post_later(max(0.0, deadline - time.time()),
+                                lambda: self._try_finish_wait(
+                                    conn_id, reqid, ids, num_returns, deadline))
+
+    def _h_free_objects(self, rec, m):
+        for b in m["object_ids"]:
+            oid = ObjectID(b)
+            self.objects.pop(oid, None)
+            self.store.delete(oid)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_object_stats(self, rec, m):
+        self._reply(rec, m["reqid"], stats=self.store.stats(),
+                    num_objects=len(self.objects))
+
+    # -- functions
+
+    def _h_register_function(self, rec, m):
+        self.functions[m["function_id"]] = m["pickled"]
+        for conn_id, reqid in self._fn_waiters.pop(m["function_id"], []):
+            w = self.clients.get(conn_id)
+            if w is not None:
+                self._reply(w, reqid, pickled=m["pickled"])
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_fetch_function(self, rec, m):
+        fid = m["function_id"]
+        if fid in self.functions:
+            self._reply(rec, m["reqid"], pickled=self.functions[fid])
+        else:
+            self._fn_waiters.setdefault(fid, []).append((rec.conn_id, m["reqid"]))
+
+    # -- tasks
+
+    def _h_submit_task(self, rec, m):
+        spec = m["spec"]
+        spec["submitter"] = rec.conn_id
+        tr = TaskRec(spec=spec, retries_left=spec.get("max_retries", 0))
+        self.tasks[spec["task_id"]] = tr
+        for b in spec["return_ids"]:
+            self.objects.setdefault(ObjectID(b), ObjInfo())
+        self._record_event(spec, "PENDING")
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+        self._enqueue_task(spec)
+
+    def _enqueue_task(self, spec: dict) -> None:
+        if not self._feasible(spec):
+            self._fail_task(spec, "Infeasible resource demand: "
+                            f"{self._demand(spec)} on {self.total_resources}")
+            return
+        ndeps = 0
+        for b in spec.get("arg_ids", []):
+            oid = ObjectID(b)
+            info = self.objects.setdefault(oid, ObjInfo())
+            if info.state == "pending":
+                ndeps += 1
+                self.dep_waiting.setdefault(oid, []).append(spec)
+        spec["_ndeps"] = ndeps
+        if ndeps == 0:
+            self._make_runnable(spec)
+            self._schedule()
+
+    def _make_runnable(self, spec: dict) -> None:
+        if spec.get("num_tpus"):
+            self.runnable_tpu.append(spec)
+        else:
+            self.runnable_cpu.append(spec)
+
+    def _h_task_done(self, rec, m):
+        tid = m["task_id"]
+        tr = self.tasks.get(tid)
+        if tr is not None:
+            tr.state = "failed" if m.get("error") else "finished"
+            tr.finished_at = time.time()
+            tr.error = m.get("error", "")
+            self._record_event(tr.spec, "FAILED" if m.get("error") else "FINISHED")
+        if rec.dedicated_actor is not None:
+            ar = self.actors.get(rec.dedicated_actor)
+            if ar is not None:
+                ar.running.pop(tid, None)
+                self._dispatch_actor_queue(ar)
+        else:
+            if rec.state in ("busy", "blocked"):
+                rec.state = "idle"
+            rec.current_task = None
+            if tr is not None and not tr.spec.get("_cpu_released"):
+                self._return_resources(tr.spec)
+        # unpin args
+        if tr is not None:
+            for b in tr.spec.get("arg_ids", []):
+                self.store.unpin(ObjectID(b))
+        self._schedule()
+
+    def _release_task_cpu(self, rec: ClientRec) -> None:
+        """Worker blocked on get: release its task's resources so the node
+        can keep making progress (reference: raylet releases CPU for
+        blocked workers)."""
+        if rec.current_task is None:
+            return
+        tr = self.tasks.get(rec.current_task)
+        if tr is not None and not tr.spec.get("_cpu_released"):
+            tr.spec["_cpu_released"] = True
+            self._return_resources(tr.spec)
+
+    def _demand(self, spec) -> dict:
+        d = dict(spec.get("resources") or {})
+        # Tasks default to 1 CPU; actors hold 0 CPU for their lifetime
+        # unless explicitly requested (reference: ray actor default
+        # num_cpus=0 after creation, ray_option_utils.py).
+        d.setdefault("CPU", 0.0 if spec.get("kind") == "actor_create" else 1.0)
+        if spec.get("num_tpus"):
+            d["TPU"] = float(spec["num_tpus"])
+        return d
+
+    def _try_acquire(self, spec) -> bool:
+        demand = self._demand(spec)
+        pg = spec.get("placement_group")
+        if pg is not None:
+            key = (pg[0], pg[1])
+            free = self.pg_available.get(key)
+            if free is None:
+                return False
+            if all(free.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+                for k, v in demand.items():
+                    free[k] = free.get(k, 0.0) - v
+                return True
+            return False
+        if all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+            return True
+        return False
+
+    def _return_resources(self, spec) -> None:
+        demand = self._demand(spec)
+        pg = spec.get("placement_group")
+        if pg is not None:
+            free = self.pg_available.get((pg[0], pg[1]))
+            if free is not None:
+                for k, v in demand.items():
+                    free[k] = free.get(k, 0.0) + v
+            return
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def _feasible(self, spec) -> bool:
+        demand = self._demand(spec)
+        if spec.get("placement_group"):
+            return True
+        return all(self.total_resources.get(k, 0.0) + 1e-9 >= v
+                   for k, v in demand.items())
+
+    def _args_ready(self, spec) -> bool:
+        for b in spec.get("arg_ids", []):
+            info = self.objects.get(ObjectID(b))
+            if info is None or info.state == "pending":
+                return False
+        return True
+
+    def _schedule(self) -> None:
+        """FIFO dispatch from the runnable queues (reference:
+        LocalTaskManager::DispatchScheduledTasksToWorkers,
+        local_task_manager.cc:101).  O(1) amortized per event: stops at the
+        first queue head that cannot be placed."""
+        for q, tpu in ((self.runnable_cpu, False), (self.runnable_tpu, True)):
+            while q:
+                spec = q[0]
+                w = self._find_idle_worker(tpu=tpu)
+                if w is None:
+                    if not tpu:
+                        self._maybe_spawn_worker()
+                    break
+                if not self._try_acquire(spec):
+                    break
+                q.popleft()
+                self._dispatch_task(w, spec)
+
+    def _find_idle_worker(self, tpu: bool) -> Optional[ClientRec]:
+        for rec in self.clients.values():
+            if (rec.kind in ("worker", "tpu_executor") and rec.state == "idle"
+                    and rec.dedicated_actor is None and rec.tpu == tpu):
+                return rec
+        return None
+
+    def _dispatch_task(self, w: ClientRec, spec: dict) -> None:
+        tr = self.tasks[spec["task_id"]]
+        tr.state = "running"
+        tr.worker = w.conn_id
+        tr.started_at = time.time()
+        w.state = "busy"
+        w.current_task = spec["task_id"]
+        for b in spec.get("arg_ids", []):
+            self.store.pin(ObjectID(b))
+        self._record_event(spec, "RUNNING")
+        self._push(w, {"t": "execute", "spec": spec})
+
+    def _fail_task(self, spec: dict, error: str) -> None:
+        tr = self.tasks.get(spec["task_id"])
+        if tr is not None:
+            tr.state = "failed"
+            tr.error = error
+        err = pickle.dumps(RuntimeError(error))
+        from ray_tpu.core.serialization import SerializedObject
+        data = SerializedObject(inband=err).to_bytes()
+        for b in spec["return_ids"]:
+            oid = ObjectID(b)
+            info = self.objects.setdefault(oid, ObjInfo())
+            info.state = "error"
+            info.loc = "inline"
+            info.data = data
+            info.is_error = True
+            self._resolve_waiters(oid, info)
+
+    def _maybe_spawn_worker(self, tpu: bool = False) -> None:
+        if tpu:
+            return  # TPU executors are registered by the driver, not spawned
+        # Self-heal the in-flight spawn counter against crashed spawns.
+        alive_procs = sum(1 for p in self._worker_procs if p.poll() is None)
+        registered = sum(1 for c in self.clients.values()
+                         if c.kind == "worker" and not c.tpu)
+        self._spawning = max(0, alive_procs - registered)
+        # Demand-driven pool growth (reference: worker_pool.h capped startup
+        # concurrency :192): one worker per waiting task/actor, capped.
+        n_actors_waiting = sum(
+            1 for a in self.actors.values()
+            if a.state in ("pending", "restarting") and a.conn_id is None
+            and not a.spec.get("num_tpus"))
+        idle = sum(1 for c in self.clients.values()
+                   if c.kind == "worker" and not c.tpu and c.state == "idle"
+                   and c.dedicated_actor is None)
+        # Tasks can only run while CPU is available, so a pool larger than
+        # the free CPUs is waste; placement-group tasks draw on their
+        # bundle reservation instead, and actors hold no CPU — both always
+        # need a process.  Concurrent startups are capped (reference:
+        # worker_pool.h maximum_startup_concurrency :192,717).
+        n_pg = sum(1 for s in self.runnable_cpu if s.get("placement_group"))
+        cpu_demand = min(len(self.runnable_cpu) - n_pg,
+                         max(0, int(self.available.get("CPU", 0.0))))
+        demand = cpu_demand + n_pg + n_actors_waiting
+        max_concurrent_startup = max(2, os.cpu_count() or 1)
+        want = min(demand - idle - self._spawning,
+                   self.config.max_workers - registered - self._spawning,
+                   max_concurrent_startup - self._spawning)
+        for _ in range(max(0, want)):
+            self._spawning += 1
+            self._spawn_worker_proc()
+
+    def _spawn_worker_proc(self) -> None:
+        env = dict(os.environ)
+        # Workers must not steal the TPU from the driver: force CPU jax.
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS", "")
+        env["RAY_TPU_SESSION"] = self.session
+        logdir = os.path.join(self.session_dir, "logs")
+        idx = len(self._worker_procs)
+        out = open(os.path.join(logdir, f"worker-{idx}.out"), "ab", buffering=0)
+        err = open(os.path.join(logdir, f"worker-{idx}.err"), "ab", buffering=0)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker",
+             "--address", self.address, "--session", self.session],
+            env=env, stdout=out, stderr=err, start_new_session=True)
+        self._worker_procs.append(proc)
+
+    # -- actors
+
+    def _h_create_actor(self, rec, m):
+        spec = m["spec"]
+        actor_id = ActorID(spec["actor_id"])
+        name = spec.get("name") or ""
+        ns = spec.get("namespace") or "default"
+        if name:
+            key = (ns, name)
+            if key in self.named_actors and \
+                    self.actors[self.named_actors[key]].state != "dead":
+                if spec.get("get_if_exists"):
+                    self._reply(rec, m["reqid"],
+                                actor_id=self.named_actors[key].binary(),
+                                existing=True)
+                    return
+                self._reply(rec, m["reqid"],
+                            error=f"Actor name '{name}' already taken in "
+                                  f"namespace '{ns}'")
+                return
+            self.named_actors[key] = actor_id
+        ar = ActorRec(actor_id=actor_id, spec=spec, name=name, namespace=ns,
+                      restarts_left=spec.get("max_restarts", 0),
+                      max_concurrency=spec.get("max_concurrency", 1))
+        self.actors[actor_id] = ar
+        self._reply(rec, m["reqid"], actor_id=actor_id.binary())
+        self._place_actor(ar)
+
+    def _place_actor(self, ar: ActorRec) -> None:
+        needs_tpu = bool(ar.spec.get("num_tpus"))
+        w = self._find_idle_worker(tpu=needs_tpu)
+        if w is None:
+            self._maybe_spawn_worker(tpu=needs_tpu)
+            self.post_later(0.05, lambda: self._place_actor_if_pending(ar))
+            return
+        if not self._try_acquire(ar.spec):
+            self.post_later(0.05, lambda: self._place_actor_if_pending(ar))
+            return
+        w.dedicated_actor = ar.actor_id
+        w.state = "busy"
+        ar.conn_id = w.conn_id
+        self._push(w, {"t": "create_actor_exec", "spec": ar.spec})
+
+    def _place_actor_if_pending(self, ar: ActorRec) -> None:
+        if ar.state in ("pending", "restarting") and ar.conn_id is None:
+            self._place_actor(ar)
+
+    def _h_actor_created(self, rec, m):
+        ar = self.actors.get(ActorID(m["actor_id"]))
+        if ar is None:
+            return
+        if m.get("error"):
+            ar.state = "dead"
+            ar.death_cause = m["error"]
+            self._fail_actor_queue(ar, m["error"])
+            rec.dedicated_actor = None
+            rec.state = "idle"
+            self._return_resources(ar.spec)
+        else:
+            ar.state = "alive"
+            self._publish("actor_state",
+                          {"actor_id": ar.actor_id.hex(), "state": "alive"})
+            self._dispatch_actor_queue(ar)
+
+    def _h_submit_actor_task(self, rec, m):
+        spec = m["spec"]
+        actor_id = ActorID(spec["actor_id"])
+        ar = self.actors.get(actor_id)
+        for b in spec["return_ids"]:
+            self.objects.setdefault(ObjectID(b), ObjInfo())
+        self.tasks[spec["task_id"]] = TaskRec(spec=spec)
+        self._record_event(spec, "PENDING")
+        if ar is None or ar.state == "dead":
+            cause = ar.death_cause if ar else "actor not found"
+            self._fail_task(spec, f"Actor is dead: {cause}")
+            return
+        ar.queue.append(spec)
+        self._dispatch_actor_queue(ar)
+
+    def _dispatch_actor_queue(self, ar: ActorRec) -> None:
+        if ar.state != "alive" or ar.conn_id is None:
+            return
+        w = self.clients.get(ar.conn_id)
+        if w is None:
+            return
+        while ar.queue and ar.inflight < ar.max_concurrency:
+            spec = ar.queue.popleft()
+            if not self._args_ready(spec):
+                # actors preserve submission order: put back and stop
+                ar.queue.appendleft(spec)
+                self._wait_args_then(spec, lambda: self._dispatch_actor_queue(ar))
+                return
+            ar.running[spec["task_id"]] = spec
+            tr = self.tasks.get(spec["task_id"])
+            if tr is not None:
+                tr.state = "running"
+                tr.started_at = time.time()
+                tr.worker = w.conn_id
+            self._record_event(spec, "RUNNING")
+            self._push(w, {"t": "execute_actor", "spec": spec})
+
+    def _wait_args_then(self, spec, cb) -> None:
+        remaining = [ObjectID(b) for b in spec.get("arg_ids", [])
+                     if self.objects.get(ObjectID(b), ObjInfo()).state == "pending"]
+        if not remaining:
+            cb()
+            return
+        # Poll via the event loop until the dependency lands (v1; the
+        # reference stages deps through the DependencyManager).
+        self.post_later(0.02, lambda: self._wait_args_then(spec, cb))
+
+    def _fail_actor_queue(self, ar: ActorRec, error: str) -> None:
+        while ar.queue:
+            self._fail_task(ar.queue.popleft(), f"Actor died: {error}")
+
+    def _h_kill_actor(self, rec, m):
+        actor_id = ActorID(m["actor_id"])
+        ar = self.actors.get(actor_id)
+        if ar is None:
+            if "reqid" in m:
+                self._reply(rec, m["reqid"], ok=False)
+            return
+        no_restart = m.get("no_restart", True)
+        if no_restart:
+            ar.restarts_left = 0
+        w = self.clients.get(ar.conn_id) if ar.conn_id is not None else None
+        if w is not None:
+            self._push(w, {"t": "exit"})
+        else:
+            ar.state = "dead"
+            ar.death_cause = "killed"
+            self._fail_actor_queue(ar, "killed")
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_get_named_actor(self, rec, m):
+        key = (m.get("namespace") or "default", m["name"])
+        aid = self.named_actors.get(key)
+        if aid is None or self.actors[aid].state == "dead":
+            self._reply(rec, m["reqid"], error="not found")
+        else:
+            ar = self.actors[aid]
+            self._reply(rec, m["reqid"], actor_id=aid.binary(), spec_meta={
+                "methods": ar.spec.get("methods", []),
+                "class_name": ar.spec.get("class_name", "")})
+
+    def _h_list_named_actors(self, rec, m):
+        out = [{"namespace": ns, "name": n}
+               for (ns, n), aid in self.named_actors.items()
+               if self.actors[aid].state != "dead"
+               and (m.get("all_namespaces") or ns == (m.get("namespace")
+                                                      or "default"))]
+        self._reply(rec, m["reqid"], actors=out)
+
+    # -- placement groups (single node: reservation only)
+
+    def _h_create_pg(self, rec, m):
+        pg_id = PlacementGroupID(m["pg_id"])
+        bundles = m["bundles"]
+        # single-node prepare+commit in one step
+        total: dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total[k] = total.get(k, 0.0) + v
+        if not all(self.available.get(k, 0.0) + 1e-9 >= v
+                   for k, v in total.items()):
+            self._reply(rec, m["reqid"],
+                        error=f"Cannot reserve bundles {total}; "
+                              f"available {self.available}")
+            return
+        for k, v in total.items():
+            self.available[k] -= v
+        self.pgs[pg_id] = PGRec(pg_id=pg_id, bundles=bundles,
+                                strategy=m.get("strategy", "PACK"))
+        for i, b in enumerate(bundles):
+            self.pg_available[(pg_id.binary(), i)] = dict(b)
+        self._reply(rec, m["reqid"], ok=True)
+
+    def _h_remove_pg(self, rec, m):
+        pg_id = PlacementGroupID(m["pg_id"])
+        pg = self.pgs.pop(pg_id, None)
+        if pg is not None:
+            for i, b in enumerate(pg.bundles):
+                self.pg_available.pop((pg_id.binary(), i), None)
+                for k, v in b.items():
+                    self.available[k] = self.available.get(k, 0.0) + v
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    # -- kv / pubsub
+
+    def _h_kv_put(self, rec, m):
+        key = (m.get("namespace") or "default", m["key"])
+        if m.get("overwrite", True) or key not in self.kv:
+            self.kv[key] = m["value"]
+            added = True
+        else:
+            added = False
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], added=added)
+
+    def _h_kv_get(self, rec, m):
+        self._reply(rec, m["reqid"],
+                    value=self.kv.get((m.get("namespace") or "default",
+                                       m["key"])))
+
+    def _h_kv_del(self, rec, m):
+        existed = self.kv.pop((m.get("namespace") or "default", m["key"]),
+                              None) is not None
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], deleted=existed)
+
+    def _h_kv_keys(self, rec, m):
+        ns = m.get("namespace") or "default"
+        prefix = m.get("prefix", b"")
+        self._reply(rec, m["reqid"],
+                    keys=[k for (n, k) in self.kv if n == ns
+                          and k.startswith(prefix)])
+
+    def _h_subscribe(self, rec, m):
+        self.pubsub.setdefault(m["channel"], set()).add(rec.conn_id)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_publish(self, rec, m):
+        self._publish(m["channel"], m["data"])
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _publish(self, channel: str, data: Any) -> None:
+        for conn_id in list(self.pubsub.get(channel, ())):
+            w = self.clients.get(conn_id)
+            if w is not None:
+                self._push(w, {"t": "pub", "channel": channel, "data": data})
+
+    # -- state API
+
+    def _record_event(self, spec: dict, state: str) -> None:
+        self.task_events.append({
+            "task_id": spec["task_id"].hex() if isinstance(spec["task_id"], bytes)
+            else spec["task_id"],
+            "name": spec.get("name", ""),
+            "state": state,
+            "actor_id": spec.get("actor_id", b"").hex()
+            if spec.get("actor_id") else None,
+            "time": time.time(),
+        })
+
+    def _h_state(self, rec, m):
+        what = m["what"]
+        if what == "tasks":
+            out = [{"task_id": tid.hex(), "name": tr.spec.get("name", ""),
+                    "state": tr.state, "error": tr.error,
+                    "submitted_at": tr.submitted_at,
+                    "duration": (tr.finished_at - tr.started_at)
+                    if tr.finished_at else None}
+                   for tid, tr in self.tasks.items()]
+        elif what == "actors":
+            out = [{"actor_id": aid.hex(), "state": ar.state,
+                    "name": ar.name, "namespace": ar.namespace,
+                    "class_name": ar.spec.get("class_name", ""),
+                    "pending_calls": len(ar.queue)}
+                   for aid, ar in self.actors.items()]
+        elif what == "objects":
+            out = [{"object_id": oid.hex(), "state": info.state,
+                    "loc": info.loc, "size": info.size}
+                   for oid, info in self.objects.items()]
+        elif what == "workers":
+            out = [{"worker_id": c.worker_id, "kind": c.kind, "pid": c.pid,
+                    "state": c.state, "tpu": c.tpu}
+                   for c in self.clients.values()
+                   if c.kind in ("worker", "tpu_executor")]
+        elif what == "nodes":
+            out = [{"node_id": self.node_id.hex(), "address": self.address,
+                    "resources": self.total_resources,
+                    "available": self.available, "alive": True}]
+        elif what == "task_events":
+            out = list(self.task_events)
+        elif what == "resources":
+            out = {"total": self.total_resources, "available": self.available}
+        else:
+            out = []
+        self._reply(rec, m["reqid"], data=out)
+
+    def _h_ping(self, rec, m):
+        self._reply(rec, m["reqid"], ok=True, time=time.time())
+
+    # -- disconnect handling
+
+    def _drop_client(self, rec: ClientRec) -> None:
+        if rec.closed:
+            return
+        rec.closed = True
+        try:
+            self.sel.unregister(rec.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            rec.sock.close()
+        except OSError:
+            pass
+        self.clients.pop(rec.conn_id, None)
+        for oid in rec.held_pins:
+            self.store.unpin(oid)
+        rec.held_pins.clear()
+        # fail or retry the running task (reference: worker death →
+        # owner retries, task_manager.h:406)
+        if rec.current_task is not None:
+            tr = self.tasks.get(rec.current_task)
+            if tr is not None and tr.state == "running":
+                if not tr.spec.get("_cpu_released"):
+                    self._return_resources(tr.spec)
+                tr.spec.pop("_cpu_released", None)
+                if tr.retries_left > 0:
+                    tr.retries_left -= 1
+                    tr.state = "pending"
+                    self._make_runnable(tr.spec)
+                else:
+                    self._fail_task(tr.spec,
+                                    f"Worker died while running task "
+                                    f"(pid={rec.pid})")
+        if rec.dedicated_actor is not None:
+            ar = self.actors.get(rec.dedicated_actor)
+            if ar is not None and ar.state != "dead":
+                self._return_resources(ar.spec)
+                ar.conn_id = None
+                # In-flight method calls die with the worker: fail them so
+                # callers see an actor-death error instead of hanging
+                # (reference: actor task fate on actor death,
+                # direct_actor_task_submitter.h DisconnectActor).
+                for spec in list(ar.running.values()):
+                    self._fail_task(spec,
+                                    f"Actor died while executing method "
+                                    f"'{spec.get('method', '?')}' "
+                                    f"(pid={rec.pid})")
+                ar.running.clear()
+                if ar.restarts_left != 0:
+                    if ar.restarts_left > 0:
+                        ar.restarts_left -= 1
+                    ar.state = "restarting"
+                    self._publish("actor_state", {"actor_id": ar.actor_id.hex(),
+                                                  "state": "restarting"})
+                    self._place_actor(ar)
+                else:
+                    ar.state = "dead"
+                    ar.death_cause = f"worker process died (pid={rec.pid})"
+                    self._publish("actor_state", {"actor_id": ar.actor_id.hex(),
+                                                  "state": "dead"})
+                    self._fail_actor_queue(ar, ar.death_cause)
+        if rec.kind == "driver":
+            # single-driver node: driver gone → shut down
+            self._stop.set()
+        self._schedule()
+
+
+def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser(description="ray_tpu head node service")
+    parser.add_argument("--port", type=int, default=6379)
+    parser.add_argument("--session", default=None)
+    parser.add_argument("--session-dir", default=None)
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    args = parser.parse_args()
+    import uuid
+    session = args.session or uuid.uuid4().hex
+    session_dir = args.session_dir or os.path.join(
+        "/tmp/ray_tpu", f"session_{session[:8]}")
+    svc = NodeService(RayTpuConfig(), session, session_dir, port=args.port,
+                      num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    print(f"ray_tpu node service listening on {svc.address} "
+          f"(session {session})", flush=True)
+    try:
+        svc.run()
+    except KeyboardInterrupt:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""ObjectRef — a future-like handle to a value in the object plane.
+
+Capability parity with the reference's ObjectRef surface
+(reference: python/ray/_raylet.pyx ObjectRef; python/ray/includes/object_ref.pxi):
+await-able, hashable, picklable (travels inside task args), and resolvable
+via ``ray_tpu.get``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+from typing import Any, Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: Optional[str] = None):
+        self._id = object_id
+        self._owner = owner  # worker id string of the owner process
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    @property
+    def owner(self) -> Optional[str]:
+        return self._owner
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self.hex()[:16]}…)"
+
+    # Block-on-result convenience (same as calling ray_tpu.get(ref)).
+    def get(self, timeout: Optional[float] = None) -> Any:
+        from ray_tpu.core.runtime import get_runtime
+        return get_runtime().get([self], timeout=timeout)[0]
+
+    def future(self) -> concurrent.futures.Future:
+        from ray_tpu.core.runtime import get_runtime
+        return get_runtime().as_future(self)
+
+    def __await__(self):
+        fut = self.future()
+        return asyncio.wrap_future(fut).__await__()
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id, self._owner))
+
+
+class ObjectRefGenerator:
+    """Iterator over a dynamic number of task returns
+    (reference: num_returns="dynamic" → ObjectRefGenerator, _raylet.pyx:172)."""
+
+    def __init__(self, refs: list[ObjectRef]):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]

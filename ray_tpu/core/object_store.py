@@ -1,0 +1,218 @@
+"""Node-local shared-memory object store.
+
+Capability analogue of plasma (reference: src/ray/object_manager/plasma/
+store.h:55 — node-local immutable shared-memory objects, zero-copy reads,
+refcount + LRU eviction, fallback spill to disk).  v1 backs each large
+object with one POSIX shm segment (``multiprocessing.shared_memory``);
+small objects (≤ max_direct_call_object_size) never reach this store — they
+live inline in the control plane, mirroring the reference's in-process
+memory store (src/ray/core_worker/store_provider/memory_store/).
+
+The store has two halves:
+  * ``ObjectStoreCore`` — bookkeeping that lives in the node service
+    (sizes, refcounts, LRU order, spill state).
+  * ``SharedMemoryClient`` — used by every worker/driver to create or map
+    segments by name (zero-copy ``memoryview`` reads).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+def _segment_name(session: str, object_id: ObjectID) -> str:
+    # Full object-id hex: the return/put index lives in the LAST 4 bytes,
+    # so any truncation that drops the tail collides across puts.
+    return f"rt_{session[:8]}_{object_id.hex()}"
+
+
+class SharedMemoryClient:
+    """Create/map shm segments. One per process."""
+
+    def __init__(self, session: str):
+        self._session = session
+        self._open: dict[str, shared_memory.SharedMemory] = {}
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        name = _segment_name(self._session, object_id)
+        seg = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        self._open[name] = seg
+        return seg.buf[:size]
+
+    def map(self, object_id: ObjectID) -> memoryview:
+        name = _segment_name(self._session, object_id)
+        seg = self._open.get(name)
+        if seg is None:
+            seg = shared_memory.SharedMemory(name=name)
+            self._open[name] = seg
+        return seg.buf
+
+    def close(self, object_id: ObjectID) -> None:
+        name = _segment_name(self._session, object_id)
+        seg = self._open.pop(name, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except BufferError:
+                # A zero-copy view is still alive in this process; the
+                # segment stays mapped until process exit.
+                self._open[name] = seg
+
+    def unlink(self, object_id: ObjectID) -> None:
+        name = _segment_name(self._session, object_id)
+        seg = self._open.pop(name, None)
+        try:
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except (FileNotFoundError, BufferError):
+            pass
+
+    def shutdown(self) -> None:
+        for seg in self._open.values():
+            try:
+                seg.close()
+            except BufferError:
+                pass
+        self._open.clear()
+
+
+@dataclass
+class _Entry:
+    size: int
+    in_shm: bool                  # False once spilled
+    spill_path: Optional[str] = None
+    pin_count: int = 0            # task-arg / get pins
+    created_at: float = field(default_factory=time.monotonic)
+    last_access: float = field(default_factory=time.monotonic)
+
+
+class ObjectStoreCore:
+    """Bookkeeping for the node's shm budget: admission, eviction, spill.
+
+    Eviction: refcount-aware LRU (reference: plasma eviction_policy.h);
+    unpinned objects spill to disk when the budget is exceeded (reference:
+    local_object_manager.h spilling via IO workers — here spill is done by
+    the node service thread itself in v1).
+    """
+
+    def __init__(self, session: str, capacity: int, spill_dir: str):
+        self.session = session
+        self.capacity = capacity
+        self.used = 0
+        self.spill_dir = spill_dir
+        self.entries: dict[ObjectID, _Entry] = {}
+        self._shm = SharedMemoryClient(session)
+        os.makedirs(spill_dir, exist_ok=True)
+        self.num_spilled = 0
+        self.num_restored = 0
+
+    def register(self, object_id: ObjectID, size: int) -> None:
+        if object_id in self.entries:
+            return
+        self.entries[object_id] = _Entry(size=size, in_shm=True)
+        self.used += size
+        if self.used > self.capacity:
+            self._evict(self.used - self.capacity)
+
+    def pin(self, object_id: ObjectID) -> None:
+        e = self.entries.get(object_id)
+        if e is not None:
+            e.pin_count += 1
+            e.last_access = time.monotonic()
+
+    def unpin(self, object_id: ObjectID) -> None:
+        e = self.entries.get(object_id)
+        if e is not None and e.pin_count > 0:
+            e.pin_count -= 1
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self.entries
+
+    def is_spilled(self, object_id: ObjectID) -> Optional[str]:
+        e = self.entries.get(object_id)
+        return e.spill_path if e is not None and not e.in_shm else None
+
+    def touch(self, object_id: ObjectID) -> None:
+        e = self.entries.get(object_id)
+        if e is not None:
+            e.last_access = time.monotonic()
+
+    def restore(self, object_id: ObjectID) -> None:
+        """Bring a spilled object back into shm."""
+        e = self.entries[object_id]
+        if e.in_shm:
+            return
+        with open(e.spill_path, "rb") as f:
+            data = f.read()
+        buf = self._shm.create(object_id, len(data))
+        buf[:] = data
+        del buf
+        e.in_shm = True
+        self.used += e.size
+        os.unlink(e.spill_path)
+        e.spill_path = None
+        self.num_restored += 1
+        if self.used > self.capacity:
+            self._evict(self.used - self.capacity)
+
+    def delete(self, object_id: ObjectID) -> None:
+        e = self.entries.pop(object_id, None)
+        if e is None:
+            return
+        if e.in_shm:
+            self.used -= e.size
+            self._shm.unlink(object_id)
+        elif e.spill_path:
+            try:
+                os.unlink(e.spill_path)
+            except FileNotFoundError:
+                pass
+
+    def _evict(self, nbytes: int) -> int:
+        """Spill unpinned objects, oldest-access first, until `nbytes` freed."""
+        victims = sorted(
+            (oid for oid, e in self.entries.items()
+             if e.in_shm and e.pin_count == 0),
+            key=lambda oid: self.entries[oid].last_access)
+        freed = 0
+        for oid in victims:
+            if freed >= nbytes:
+                break
+            freed += self._spill(oid)
+        return freed
+
+    def _spill(self, object_id: ObjectID) -> int:
+        e = self.entries[object_id]
+        path = os.path.join(self.spill_dir, object_id.hex())
+        buf = self._shm.map(object_id)
+        with open(path, "wb") as f:
+            f.write(buf[: e.size])
+        del buf
+        self._shm.unlink(object_id)
+        e.in_shm = False
+        e.spill_path = path
+        self.used -= e.size
+        self.num_spilled += 1
+        return e.size
+
+    def stats(self) -> dict:
+        return {
+            "num_objects": len(self.entries),
+            "used_bytes": self.used,
+            "capacity_bytes": self.capacity,
+            "num_spilled": self.num_spilled,
+            "num_restored": self.num_restored,
+        }
+
+    def shutdown(self) -> None:
+        for oid in list(self.entries):
+            self.delete(oid)
+        self._shm.shutdown()

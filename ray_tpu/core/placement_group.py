@@ -1,0 +1,62 @@
+"""Placement groups: atomic reservation of resource bundles.
+
+Reference analogue: python/ray/util/placement_group.py +
+gcs_placement_group_manager.h:222 / gcs_placement_group_scheduler.h:265
+(2PC prepare/commit across raylets).  On a single node the 2PC collapses to
+one reservation step in the node service; the strategy field is kept so the
+multi-node scheduler (later milestone) can pack/spread bundles.  The TPU
+delta (SURVEY.md §7 design delta 3): bundles may demand "TPU" with slice
+topology handled by the gang layer on top (ray_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.core.ids import PlacementGroupID
+from ray_tpu.core.runtime import get_runtime
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+@dataclass
+class PlacementGroup:
+    id: PlacementGroupID
+    bundles: list
+    strategy: str
+
+    def ready(self):
+        """Returns an ObjectRef resolving when the group is reserved.
+        Single-node reservation is synchronous, so this is immediate."""
+        return get_runtime().put(True)
+
+    @property
+    def bundle_specs(self) -> list:
+        return list(self.bundles)
+
+
+def placement_group(bundles: list[dict], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    norm = [{k: float(v) for k, v in b.items()} for b in bundles]
+    pg_id = PlacementGroupID.from_random()
+    rt = get_runtime()
+    rt.client.request({"t": "create_pg", "pg_id": pg_id.binary(),
+                       "bundles": norm, "strategy": strategy, "name": name})
+    return PlacementGroup(pg_id, norm, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    get_runtime().client.request({"t": "remove_pg", "pg_id": pg.id.binary()})
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    """Reference analogue: python/ray/util/scheduling_strategies.py:15."""
+    placement_group: PlacementGroup
+    placement_group_bundle_index: int = 0
+    placement_group_capture_child_tasks: Optional[bool] = None

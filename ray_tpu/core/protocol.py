@@ -1,0 +1,94 @@
+"""Control-plane wire protocol: length-prefixed pickled messages.
+
+The analogue of the reference's gRPC control plane (reference: src/ray/rpc/
++ src/ray/protobuf/*.proto).  v1 uses pickled dicts over TCP/Unix sockets —
+the message *surface* mirrors the reference's RPC inventory (SURVEY.md
+Appendix A); the encoding is an implementation detail behind this module so
+it can be swapped for protobuf/gRPC without touching callers.
+
+Bulk object payloads do NOT travel through this plane (they go through the
+shared-memory store) except for inline objects ≤ max_direct_call_object_size,
+mirroring the reference's inline-return rule (ray_config_def.h:212).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+_HDR = struct.Struct("<Q")
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class Connection:
+    """Framed, thread-safe-send connection over a stream socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+        self._recv_buf = b""
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
+            if sock.family != socket.AF_UNIX else None
+
+    def send(self, msg: dict) -> None:
+        data = pickle.dumps(msg, protocol=5)
+        with self._send_lock:
+            try:
+                self.sock.sendall(_HDR.pack(len(data)) + data)
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+
+    def recv(self, timeout: Optional[float] = None) -> dict:
+        self.sock.settimeout(timeout)
+        try:
+            hdr = self._recv_exact(_HDR.size)
+            (n,) = _HDR.unpack(hdr)
+            data = self._recv_exact(n)
+        except (ConnectionResetError, OSError) as e:
+            if isinstance(e, socket.timeout):
+                raise
+            raise ConnectionClosed(str(e)) from e
+        finally:
+            self.sock.settimeout(None)
+        return pickle.loads(data)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            chunk = self.sock.recv(min(n - got, 1 << 20))
+            if not chunk:
+                raise ConnectionClosed("peer closed")
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+def connect(address: str, timeout: float = 30.0) -> Connection:
+    if address.startswith("unix://"):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(address[len("unix://"):])
+    else:
+        host, port = address.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.settimeout(None)
+    return Connection(sock)
+
+
+def dumps_frame(msg: dict) -> bytes:
+    data = pickle.dumps(msg, protocol=5)
+    return _HDR.pack(len(data)) + data

@@ -1,0 +1,119 @@
+"""@remote functions and the options surface.
+
+Reference analogue: python/ray/remote_function.py (RemoteFunction:35,
+_remote:241) and option validation (_private/ray_option_utils.py).
+TPU delta: ``num_tpus`` replaces ``num_gpus`` and routes the task to the
+in-process TPU executor (driver keeps device ownership — SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+from ray_tpu.core.runtime import get_runtime
+
+_VALID_OPTIONS = {
+    "name", "num_returns", "num_cpus", "num_tpus", "resources",
+    "max_retries", "max_restarts", "max_concurrency", "namespace",
+    "get_if_exists", "placement_group", "placement_group_bundle_index",
+    "scheduling_strategy", "lifetime", "runtime_env",
+}
+
+
+def _validate_options(opts: dict) -> None:
+    unknown = set(opts) - _VALID_OPTIONS
+    if unknown:
+        raise ValueError(f"Unknown options: {sorted(unknown)}. "
+                         f"Valid: {sorted(_VALID_OPTIONS)}")
+    nr = opts.get("num_returns")
+    if nr is not None and nr != "dynamic" and (not isinstance(nr, int) or nr < 0):
+        raise ValueError(f"num_returns must be a non-negative int or "
+                         f"'dynamic', got {nr!r}")
+
+
+def _resources_from_options(opts: dict) -> dict:
+    res = dict(opts.get("resources") or {})
+    if opts.get("num_cpus") is not None:
+        res["CPU"] = float(opts["num_cpus"])
+    return res
+
+
+def _pg_tuple(opts: dict):
+    strategy = opts.get("scheduling_strategy")
+    pg = opts.get("placement_group")
+    idx = opts.get("placement_group_bundle_index", 0)
+    if strategy is not None and hasattr(strategy, "placement_group"):
+        pg = strategy.placement_group
+        idx = strategy.placement_group_bundle_index or 0
+    if pg is None:
+        return None
+    from ray_tpu.core.placement_group import PlacementGroup
+    if isinstance(pg, PlacementGroup):
+        return (pg.id.binary(), idx)
+    return (pg, idx)
+
+
+class RemoteFunction:
+    def __init__(self, fn, **options):
+        _validate_options(options)
+        self._function = fn
+        self._options = options
+        self._function_id: Optional[str] = None
+        self._exported_to = None
+        functools.update_wrapper(self, fn)
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = {**self._options, **options}
+        rf = RemoteFunction(self._function, **merged)
+        rf._function_id = self._function_id
+        rf._exported_to = self._exported_to
+        return rf
+
+    def remote(self, *args, **kwargs):
+        rt = get_runtime()
+        # Re-export when the runtime changed (shutdown + re-init): the new
+        # node has an empty function store.
+        if self._function_id is None or self._exported_to is not rt:
+            self._function_id = rt.export_function(self._function)
+            self._exported_to = rt
+        o = self._options
+        return rt.submit_task(
+            self._function_id, args, kwargs,
+            name=o.get("name") or self._function.__qualname__,
+            num_returns=o.get("num_returns", 1),
+            resources=_resources_from_options(o),
+            num_tpus=float(o.get("num_tpus") or 0),
+            max_retries=o.get("max_retries",
+                              rt.client.config_dict["task_max_retries"]),
+            placement_group=_pg_tuple(o))
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._function.__qualname__}' cannot be "
+            f"called directly; use .remote().")
+
+    def __getstate__(self):
+        # The runtime handle is process-local (holds sockets) — the
+        # receiving process re-exports against its own runtime.
+        state = self.__dict__.copy()
+        state["_exported_to"] = None
+        return state
+
+
+def remote(*args, **options):
+    """``@remote`` / ``@remote(num_tpus=1, ...)`` for functions and classes
+    (reference: ray.remote decorator, python/ray/__init__.py surface)."""
+    from ray_tpu.core.actor import ActorClass
+    import inspect
+
+    def decorator(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, **options)
+        return RemoteFunction(obj, **options)
+
+    if len(args) == 1 and not options and callable(args[0]):
+        return decorator(args[0])
+    if args:
+        raise TypeError("@remote takes only keyword options")
+    return decorator

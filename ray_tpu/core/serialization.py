@@ -1,0 +1,138 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Capability parity with the reference's SerializationContext
+(reference: python/ray/_private/serialization.py:92,438,358) — cloudpickle
+for arbitrary Python, protocol-5 buffer callbacks so large numpy / jax host
+arrays are carried as raw buffers (zero-copy from the shared-memory object
+store on read), and custom reducers for ObjectRef / ActorHandle so they can
+travel inside task arguments with ownership information intact.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import cloudpickle
+
+
+@dataclass
+class SerializedObject:
+    """In-band pickle bytes + out-of-band raw buffers.
+
+    Wire layout (for the object store):
+      [8B inband length][inband][8B nbufs][(8B len, payload) ...]
+    """
+
+    inband: bytes
+    buffers: list = field(default_factory=list)  # buffer-protocol objects
+    # ObjectRefs found inside the serialized value (nested refs). The owner
+    # must keep these alive while the outer object lives (reference:
+    # reference_count.h borrower tracking — scoped down here).
+    nested_refs: list = field(default_factory=list)
+
+    def total_bytes(self) -> int:
+        return (16 + len(self.inband)
+                + sum(8 + len(memoryview(b).cast("B")) for b in self.buffers))
+
+    def to_bytes(self) -> bytes:
+        out = io.BytesIO()
+        self.write_to(out)
+        return out.getvalue()
+
+    def write_to(self, f) -> None:
+        f.write(len(self.inband).to_bytes(8, "little"))
+        f.write(self.inband)
+        f.write(len(self.buffers).to_bytes(8, "little"))
+        for b in self.buffers:
+            mv = memoryview(b).cast("B")
+            f.write(len(mv).to_bytes(8, "little"))
+            f.write(mv)
+
+    @classmethod
+    def from_buffer(cls, data) -> "SerializedObject":
+        """Parse from a buffer, keeping zero-copy views into `data`."""
+        mv = memoryview(data)
+        off = 0
+        n = int.from_bytes(mv[off:off + 8], "little"); off += 8
+        inband = bytes(mv[off:off + n]); off += n
+        nbuf = int.from_bytes(mv[off:off + 8], "little"); off += 8
+        bufs = []
+        for _ in range(nbuf):
+            ln = int.from_bytes(mv[off:off + 8], "little"); off += 8
+            bufs.append(mv[off:off + ln]); off += ln
+        return cls(inband=inband, buffers=bufs)
+
+
+class SerializationContext:
+    """Per-process serializer with pluggable custom reducers."""
+
+    def __init__(self):
+        # type -> (serializer, deserializer); applied via a cloudpickle
+        # reducer_override-style dispatch table.
+        self._custom: dict[type, tuple[Callable, Callable]] = {}
+        self._out_of_band_threshold = 1024  # buffers below this stay in-band
+
+    def register_custom_serializer(self, cls: type,
+                                   serializer: Callable,
+                                   deserializer: Callable) -> None:
+        self._custom[cls] = (serializer, deserializer)
+
+    # -- serialize ---------------------------------------------------------
+
+    def serialize(self, value: Any) -> SerializedObject:
+        buffers: list = []
+        nested_refs: list = []
+        threshold = self._out_of_band_threshold
+        custom = self._custom
+
+        def buffer_callback(buf: pickle.PickleBuffer):
+            raw = buf.raw()
+            if len(raw) < threshold:
+                return True  # serialize in-band
+            buffers.append(raw)
+            return False
+
+        class _Pickler(cloudpickle.Pickler):
+            def reducer_override(self, obj):  # noqa: N802
+                from ray_tpu.core.object_ref import ObjectRef
+                if isinstance(obj, ObjectRef):
+                    nested_refs.append(obj)
+                    return (_deserialize_object_ref, (obj.binary(), obj.owner))
+                for klass, (ser, de) in custom.items():
+                    if isinstance(obj, klass):
+                        return (_apply_custom, (de, ser(obj)))
+                return NotImplemented
+
+        f = io.BytesIO()
+        p = _Pickler(f, protocol=5, buffer_callback=buffer_callback)
+        p.dump(value)
+        return SerializedObject(inband=f.getvalue(), buffers=buffers,
+                                nested_refs=nested_refs)
+
+    # -- deserialize -------------------------------------------------------
+
+    def deserialize(self, so: SerializedObject) -> Any:
+        return pickle.loads(so.inband, buffers=so.buffers)
+
+
+def _apply_custom(deserializer, payload):
+    return deserializer(payload)
+
+
+def _deserialize_object_ref(binary: bytes, owner):
+    from ray_tpu.core.object_ref import ObjectRef
+    from ray_tpu.core.ids import ObjectID
+    return ObjectRef(ObjectID(binary), owner=owner)
+
+
+_context: SerializationContext | None = None
+
+
+def get_context() -> SerializationContext:
+    global _context
+    if _context is None:
+        _context = SerializationContext()
+    return _context

@@ -1,0 +1,45 @@
+"""Worker process entry point.
+
+The analogue of the reference's default_worker.py (reference:
+python/ray/_private/workers/default_worker.py + worker.py main_loop:764):
+connect to the node service, register, and block in the execution loop.
+Spawned by the node service's worker pool (JAX forced to CPU so the driver
+keeps TPU ownership — see node.py _spawn_worker_proc).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True)
+    parser.add_argument("--session", required=True)
+    args = parser.parse_args()
+
+    from ray_tpu.core.client import NodeClient
+    from ray_tpu.core.executor import (Executor, make_message_queue,
+                                       queue_push_handler)
+    from ray_tpu.core import runtime as rt
+
+    inbox = make_message_queue()
+    client = NodeClient(args.address, kind="worker",
+                        push_handler=queue_push_handler(inbox))
+    executor = Executor(client, msg_queue=inbox)
+
+    # Make the public API (ray_tpu.get/put/remote/...) work inside tasks.
+    rt.attach_worker_runtime(client, executor)
+
+    try:
+        executor.run_loop()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

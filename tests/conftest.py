@@ -1,0 +1,30 @@
+"""Test fixtures.
+
+Multi-device tests run on a virtual 8-device CPU mesh (the analogue of the
+reference's multi-raylet-in-one-machine Cluster fixture,
+python/ray/tests/conftest.py:375) — real TPU hardware is not required.
+"""
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture
+def rt_init():
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+    return jax.devices("cpu")

@@ -1,0 +1,138 @@
+"""Actor API tests (reference analogue: python/ray/tests/test_actor.py,
+test_named_actors, actor restart paths of test_failure.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, n=1):
+        self.v += n
+        return self.v
+
+    def value(self):
+        return self.v
+
+    def fail(self):
+        raise RuntimeError("method error")
+
+    def pid(self):
+        import os
+        return os.getpid()
+
+
+def test_actor_basic(rt):
+    c = Counter.remote(10)
+    assert rt.get(c.inc.remote(), timeout=60) == 11
+    assert rt.get(c.inc.remote(5), timeout=60) == 16
+    assert rt.get(c.value.remote(), timeout=60) == 16
+
+
+def test_actor_method_ordering(rt):
+    c = Counter.remote(0)
+    refs = [c.inc.remote() for _ in range(20)]
+    # sequential queue: results must be 1..20 in submission order
+    assert rt.get(refs, timeout=60) == list(range(1, 21))
+
+
+def test_actor_method_error(rt):
+    c = Counter.remote(0)
+    with pytest.raises(ray_tpu.TaskError, match="method error"):
+        rt.get(c.fail.remote(), timeout=60)
+    # actor survives a method error
+    assert rt.get(c.inc.remote(), timeout=60) == 1
+
+
+def test_actor_state_isolated(rt):
+    a = Counter.remote(0)
+    b = Counter.remote(100)
+    rt.get([a.inc.remote(), b.inc.remote()], timeout=60)
+    assert rt.get(a.value.remote(), timeout=60) == 1
+    assert rt.get(b.value.remote(), timeout=60) == 101
+
+
+def test_named_actor(rt):
+    Counter.options(name="named_cnt").remote(7)
+    h = ray_tpu.get_actor("named_cnt")
+    assert rt.get(h.value.remote(), timeout=60) == 7
+
+
+def test_named_actor_duplicate_raises(rt):
+    Counter.options(name="dup_cnt").remote(0)
+    with pytest.raises(Exception, match="already taken"):
+        Counter.options(name="dup_cnt").remote(0)
+
+
+def test_get_if_exists(rt):
+    a = Counter.options(name="gie_cnt").remote(5)
+    rt.get(a.value.remote(), timeout=60)
+    b = Counter.options(name="gie_cnt", get_if_exists=True).remote(99)
+    assert rt.get(b.value.remote(), timeout=60) == 5
+
+
+def test_get_missing_named_actor_raises(rt):
+    with pytest.raises(Exception, match="not found"):
+        ray_tpu.get_actor("does_not_exist")
+
+
+def test_kill_actor(rt):
+    c = Counter.remote(0)
+    rt.get(c.inc.remote(), timeout=60)
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(Exception):
+        rt.get(c.value.remote(), timeout=20)
+
+
+def test_actor_restart(rt):
+    c = Counter.options(max_restarts=1).remote(0)
+    old_pid = rt.get(c.pid.remote(), timeout=60)
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    import os
+    import signal
+    os.kill(old_pid, signal.SIGKILL)
+    # state is lost but the actor comes back on a fresh worker
+    deadline = time.time() + 60
+    new_pid = None
+    while time.time() < deadline:
+        try:
+            new_pid = rt.get(c.pid.remote(), timeout=30)
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert new_pid is not None and new_pid != old_pid
+
+
+def test_actor_handle_in_task(rt):
+    c = Counter.remote(0)
+
+    @ray_tpu.remote
+    def bump(handle):
+        return rt.get(handle.inc.remote())
+
+    assert rt.get(bump.remote(c), timeout=60) == 1
+    assert rt.get(c.value.remote(), timeout=60) == 1
+
+
+def test_unknown_method_raises(rt):
+    c = Counter.remote(0)
+    with pytest.raises(AttributeError):
+        c.nope.remote()

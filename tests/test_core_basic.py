@@ -1,0 +1,158 @@
+"""Core task API tests (reference analogue: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+def test_simple_task(rt):
+    assert rt.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_task_with_kwargs(rt):
+    @ray_tpu.remote
+    def f(a, b=10):
+        return a * b
+
+    assert rt.get(f.remote(2), timeout=60) == 20
+    assert rt.get(f.remote(2, b=3), timeout=60) == 6
+
+
+def test_chained_tasks(rt):
+    r1 = add.remote(1, 1)
+    r2 = add.remote(r1, 1)
+    r3 = add.remote(r2, r1)
+    assert rt.get(r3, timeout=60) == 5
+
+
+def test_nested_tasks(rt):
+    @ray_tpu.remote
+    def outer(x):
+        return rt.get(add.remote(x, 1)) * 2
+
+    assert rt.get(outer.remote(5), timeout=60) == 12
+
+
+def test_nested_object_ref_in_structure(rt):
+    ref = rt.put(41)
+
+    @ray_tpu.remote
+    def deref(d):
+        # nested refs are NOT auto-resolved (same as the reference)
+        return rt.get(d["ref"]) + 1
+
+    assert rt.get(deref.remote({"ref": ref}), timeout=60) == 42
+
+
+def test_task_error_propagates(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaput")
+
+    with pytest.raises(ray_tpu.TaskError, match="kaput"):
+        rt.get(boom.remote(), timeout=60)
+
+
+def test_error_through_dependency(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaput")
+
+    ref = add.remote(boom.remote(), 1)
+    with pytest.raises(ray_tpu.TaskError, match="kaput"):
+        rt.get(ref, timeout=60)
+
+
+def test_num_returns(rt):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert rt.get([a, b, c], timeout=60) == [1, 2, 3]
+
+
+def test_dynamic_returns(rt):
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    g = rt.get(gen.remote(5), timeout=60)
+    assert len(g) == 5
+    assert [rt.get(r) for r in g] == [0, 1, 4, 9, 16]
+
+
+def test_options_override(rt):
+    f = add.options(name="my_add")
+    assert rt.get(f.remote(2, 3), timeout=60) == 5
+
+
+def test_wait(rt):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast_ref = slow.remote(0.01)
+    slow_ref = slow.remote(5.0)
+    ready, not_ready = rt.wait([fast_ref, slow_ref], num_returns=1,
+                               timeout=30)
+    assert ready == [fast_ref]
+    assert not_ready == [slow_ref]
+
+
+def test_wait_timeout(rt):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    ref = slow.remote()
+    ready, not_ready = rt.wait([ref], num_returns=1, timeout=0.2)
+    assert ready == []
+    assert not_ready == [ref]
+
+
+def test_large_arg_roundtrip(rt):
+    arr = np.random.rand(500_000).astype(np.float32)  # ~2MB > inline limit
+
+    @ray_tpu.remote
+    def mean(x):
+        return float(np.mean(x))
+
+    assert abs(rt.get(mean.remote(arr), timeout=60) - arr.mean()) < 1e-5
+
+
+def test_call_remote_function_directly_raises(rt):
+    with pytest.raises(TypeError, match="remote"):
+        add(1, 2)
+
+
+def test_get_type_validation(rt):
+    with pytest.raises(TypeError):
+        rt.get(42)
+
+
+def test_many_small_tasks(rt):
+    refs = [add.remote(i, i) for i in range(100)]
+    assert rt.get(refs, timeout=120) == [2 * i for i in range(100)]
+
+
+def test_cluster_resources(rt):
+    total = rt.cluster_resources()
+    assert total["CPU"] == 2.0

@@ -1,0 +1,127 @@
+"""Failure handling: worker death, task retries, infeasible tasks
+(reference analogue: python/ray/tests/test_failure.py,
+test_component_failures.py — worker-kill fault injection mirrors
+NodeKillerActor, _private/test_utils.py:1337)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_task_retry_on_worker_death(rt):
+    marker = f"/tmp/raytpu_retry_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=2)
+    def die_once(path):
+        import os as _os
+        if not _os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("1")
+            _os.kill(_os.getpid(), signal.SIGKILL)
+        return "survived"
+
+    assert rt.get(die_once.remote(marker), timeout=120) == "survived"
+    os.unlink(marker)
+
+
+def test_task_no_retry_fails(rt):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    with pytest.raises(Exception, match="died"):
+        rt.get(die.remote(), timeout=120)
+
+
+def test_infeasible_task_fails_fast(rt):
+    @ray_tpu.remote(num_cpus=128)
+    def big():
+        return 1
+
+    with pytest.raises(Exception, match="Infeasible"):
+        rt.get(big.remote(), timeout=60)
+
+
+def test_actor_death_fails_pending_calls(rt):
+    @ray_tpu.remote
+    class Crasher:
+        def crash(self):
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        def ok(self):
+            return 1
+
+    a = Crasher.remote()
+    assert rt.get(a.ok.remote(), timeout=60) == 1
+    crash_ref = a.crash.remote()
+    follow_ref = a.ok.remote()
+    for ref in (crash_ref, follow_ref):
+        with pytest.raises(Exception):
+            rt.get(ref, timeout=60)
+
+
+def test_driver_sees_worker_logs_dir(rt):
+    session_dir = rt.get_runtime().session_dir
+    assert os.path.isdir(os.path.join(session_dir, "logs"))
+
+
+def test_state_api_surfaces(rt):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    rt.get(noop.remote(), timeout=60)
+    client = rt.get_runtime().client
+    tasks = client.request({"t": "state", "what": "tasks"})["data"]
+    assert any(t["state"] == "finished" for t in tasks)
+    nodes = client.request({"t": "state", "what": "nodes"})["data"]
+    assert nodes[0]["alive"]
+    workers = client.request({"t": "state", "what": "workers"})["data"]
+    assert len(workers) >= 1
+
+
+def test_inflight_actor_call_fails_fast_on_death(rt):
+    """In-flight method calls must fail promptly when the actor dies,
+    not hang until timeout (code-review finding)."""
+    import time as _time
+
+    @ray_tpu.remote
+    class Sleeper:
+        def slow_crash(self):
+            import os as _os
+            _time.sleep(0.2)
+            _os.kill(_os.getpid(), signal.SIGKILL)
+
+    s = Sleeper.remote()
+    ref = s.slow_crash.remote()
+    t0 = _time.time()
+    with pytest.raises(Exception, match="died"):
+        rt.get(ref, timeout=30)
+    # fails via death detection, far sooner than the 30s get timeout
+    assert _time.time() - t0 < 25
+
+
+def test_namespace_scoping(rt):
+    @ray_tpu.remote
+    class N:
+        def ok(self):
+            return 1
+
+    N.options(name="ns_actor", namespace="team_a").remote()
+    with pytest.raises(Exception, match="not found"):
+        ray_tpu.get_actor("ns_actor", namespace="team_b")
+    h = ray_tpu.get_actor("ns_actor", namespace="team_a")
+    assert rt.get(h.ok.remote(), timeout=60) == 1
