@@ -9,6 +9,7 @@ sequential_actor_submit_queue.h).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Optional
 
@@ -51,6 +52,9 @@ class ActorHandle:
         self._methods = set(methods)
         self._class_name = class_name
         self._seq = _Counter()
+        # fresh nonce per handle instance (incl. unpickled copies) so
+        # callers in different processes never collide on task ids
+        self._nonce = os.urandom(8)
 
     @property
     def actor_id(self) -> ActorID:
@@ -66,7 +70,8 @@ class ActorHandle:
 
     def _actor_method_call(self, method: str, args, kwargs, num_returns=1):
         rt = get_runtime()
-        return rt.submit_actor_task(self._actor_id, self._seq.next(), method,
+        return rt.submit_actor_task(self._actor_id, self._nonce,
+                                    self._seq.next(), method,
                                     args, kwargs, num_returns=num_returns,
                                     name=f"{self._class_name}.{method}")
 

@@ -170,11 +170,12 @@ class NodeClient:
                               "object_ids": [o.binary() for o in object_ids]},
                              timeout=timeout)
         out = []
-        shm_ids = []
+        shm_ids = [oid.binary() for oid, res in zip(object_ids,
+                                                    reply["results"])
+                   if res["loc"] == "shm"]
         try:
             for oid, res in zip(object_ids, reply["results"]):
                 if res["loc"] == "shm":
-                    shm_ids.append(oid.binary())
                     buf = self.shm.map(oid)
                     so = SerializedObject.from_buffer(buf[:res["size"]])
                 else:

@@ -78,6 +78,9 @@ class Executor:
                 self.execute_actor_task(msg["spec"])
             elif t == "create_actor_exec":
                 self.create_actor(msg["spec"])
+            elif t == "destroy_actor":
+                with self._actor_lock:
+                    self._actors.pop(msg["actor_id"], None)
 
     # -- function store ----------------------------------------------------
 

@@ -102,8 +102,12 @@ class TaskID(BaseId):
                    + parent.binary()[-JOB_ID_SIZE:])
 
     @classmethod
-    def for_actor_task(cls, actor_id: ActorID, seq: int) -> "TaskID":
-        h = hashlib.sha1(b"actor:" + actor_id.binary())
+    def for_actor_task(cls, actor_id: ActorID, caller_nonce: bytes,
+                      seq: int) -> "TaskID":
+        # caller_nonce disambiguates handles held by different processes —
+        # without it, two callers' seq counters would collide on the same
+        # task id (reference: TaskID embeds the caller's task id).
+        h = hashlib.sha1(b"actor:" + actor_id.binary() + caller_nonce)
         h.update(seq.to_bytes(8, "little"))
         return cls(h.digest()[: TASK_ID_SIZE - JOB_ID_SIZE]
                    + actor_id.binary()[-JOB_ID_SIZE:])

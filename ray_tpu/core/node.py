@@ -217,6 +217,7 @@ class NodeService:
                 # re-evaluates worker-pool health (dead spawns etc.)
                 try:
                     self._schedule()
+                    self._expire_stale_pins()
                 except Exception:
                     sys.stderr.write("[node] periodic schedule error:\n"
                                      + traceback.format_exc())
@@ -443,7 +444,7 @@ class NodeService:
                 # eviction can't unlink the segment mid-get (reference:
                 # plasma pins objects for the duration of a Get).
                 self.store.pin(oid)
-                rec.held_pins.append(oid)
+                rec.held_pins.append((oid, time.monotonic()))
                 results.append({"loc": "shm", "size": info.size,
                                 "is_error": info.is_error})
             else:
@@ -452,11 +453,30 @@ class NodeService:
         self._reply(rec, reqid, results=results)
 
     def _h_release_pins(self, rec, m):
-        for b in m["object_ids"]:
-            oid = ObjectID(b)
-            if oid in rec.held_pins:
-                rec.held_pins.remove(oid)
+        ids = {ObjectID(b) for b in m["object_ids"]}
+        kept = []
+        for oid, ts in rec.held_pins:
+            if oid in ids:
+                ids.discard(oid)
                 self.store.unpin(oid)
+            else:
+                kept.append((oid, ts))
+        rec.held_pins[:] = kept
+
+    def _expire_stale_pins(self) -> None:
+        """Get-replies whose ack never arrived (client timeout/death race)
+        must not pin objects forever."""
+        cutoff = time.monotonic() - 120.0
+        for rec in self.clients.values():
+            if not rec.held_pins:
+                continue
+            kept = []
+            for oid, ts in rec.held_pins:
+                if ts < cutoff:
+                    self.store.unpin(oid)
+                else:
+                    kept.append((oid, ts))
+            rec.held_pins[:] = kept
 
     def _resolve_waiters(self, oid: ObjectID, info: ObjInfo) -> None:
         for key in self._mg_by_oid.pop(oid, ()):
@@ -515,6 +535,20 @@ class NodeService:
     def _h_free_objects(self, rec, m):
         for b in m["object_ids"]:
             oid = ObjectID(b)
+            info = self.objects.get(oid)
+            if info is not None and (info.state == "pending"
+                                     or oid in self._mg_by_oid
+                                     or info.wait_waiters
+                                     or oid in self.dep_waiting):
+                # fail anyone blocked on it before it vanishes
+                err = pickle.dumps(RuntimeError(
+                    f"Object {oid.hex()[:16]} was freed"))
+                from ray_tpu.core.serialization import SerializedObject
+                info.state = "error"
+                info.loc = "inline"
+                info.data = SerializedObject(inband=err).to_bytes()
+                info.is_error = True
+                self._resolve_waiters(oid, info)
             self.objects.pop(oid, None)
             self.store.delete(oid)
         if "reqid" in m:
@@ -795,6 +829,12 @@ class NodeService:
                                   f"namespace '{ns}'")
                 return
             self.named_actors[key] = actor_id
+        if not self._feasible(spec):
+            self.named_actors.pop((ns, name), None) if name else None
+            self._reply(rec, m["reqid"],
+                        error=f"Infeasible actor resource demand: "
+                              f"{self._demand(spec)} on {self.total_resources}")
+            return
         ar = ActorRec(actor_id=actor_id, spec=spec, name=name, namespace=ns,
                       restarts_left=spec.get("max_restarts", 0),
                       max_concurrency=spec.get("max_concurrency", 1))
@@ -812,8 +852,12 @@ class NodeService:
         if not self._try_acquire(ar.spec):
             self.post_later(0.05, lambda: self._place_actor_if_pending(ar))
             return
-        w.dedicated_actor = ar.actor_id
-        w.state = "busy"
+        if not w.tpu:
+            # CPU actors get a dedicated worker process (reference: one
+            # worker per actor); the in-process TPU executor is shared —
+            # it hosts all TPU actors and tasks in the driver.
+            w.dedicated_actor = ar.actor_id
+            w.state = "busy"
         ar.conn_id = w.conn_id
         self._push(w, {"t": "create_actor_exec", "spec": ar.spec})
 
@@ -829,8 +873,10 @@ class NodeService:
             ar.state = "dead"
             ar.death_cause = m["error"]
             self._fail_actor_queue(ar, m["error"])
-            rec.dedicated_actor = None
-            rec.state = "idle"
+            if rec.dedicated_actor == ar.actor_id:
+                rec.dedicated_actor = None
+                rec.state = "idle"
+            ar.conn_id = None
             self._return_resources(ar.spec)
         else:
             ar.state = "alive"
@@ -867,6 +913,8 @@ class NodeService:
                 self._wait_args_then(spec, lambda: self._dispatch_actor_queue(ar))
                 return
             ar.running[spec["task_id"]] = spec
+            for b in spec.get("arg_ids", []):
+                self.store.pin(ObjectID(b))
             tr = self.tasks.get(spec["task_id"])
             if tr is not None:
                 tr.state = "running"
@@ -900,14 +948,32 @@ class NodeService:
         if no_restart:
             ar.restarts_left = 0
         w = self.clients.get(ar.conn_id) if ar.conn_id is not None else None
-        if w is not None:
+        if w is not None and not w.tpu:
             self._push(w, {"t": "exit"})
+        elif w is not None:
+            # shared in-process TPU executor: destroy only this actor's
+            # instance, keep the executor alive for other work
+            self._push(w, {"t": "destroy_actor",
+                           "actor_id": actor_id.binary()})
+            self._mark_actor_dead(ar, "killed")
         else:
-            ar.state = "dead"
-            ar.death_cause = "killed"
-            self._fail_actor_queue(ar, "killed")
+            self._mark_actor_dead(ar, "killed")
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
+
+    def _mark_actor_dead(self, ar: ActorRec, cause: str) -> None:
+        if ar.state == "dead":
+            return
+        ar.state = "dead"
+        ar.death_cause = cause
+        ar.conn_id = None
+        for spec in list(ar.running.values()):
+            self._fail_task(spec, f"Actor died: {cause}")
+        ar.running.clear()
+        self._fail_actor_queue(ar, cause)
+        self._return_resources(ar.spec)
+        self._publish("actor_state", {"actor_id": ar.actor_id.hex(),
+                                      "state": "dead"})
 
     def _h_get_named_actor(self, rec, m):
         key = (m.get("namespace") or "default", m["name"])
@@ -1076,7 +1142,7 @@ class NodeService:
         except OSError:
             pass
         self.clients.pop(rec.conn_id, None)
-        for oid in rec.held_pins:
+        for oid, _ts in rec.held_pins:
             self.store.unpin(oid)
         rec.held_pins.clear()
         # fail or retry the running task (reference: worker death →
@@ -1095,9 +1161,9 @@ class NodeService:
                     self._fail_task(tr.spec,
                                     f"Worker died while running task "
                                     f"(pid={rec.pid})")
-        if rec.dedicated_actor is not None:
-            ar = self.actors.get(rec.dedicated_actor)
-            if ar is not None and ar.state != "dead":
+        conn_actors = [a for a in self.actors.values()
+                       if a.conn_id == rec.conn_id and a.state != "dead"]
+        for ar in conn_actors:
                 self._return_resources(ar.spec)
                 ar.conn_id = None
                 # In-flight method calls die with the worker: fail them so

@@ -41,7 +41,15 @@ class SharedMemoryClient:
 
     def create(self, object_id: ObjectID, size: int) -> memoryview:
         name = _segment_name(self._session, object_id)
-        seg = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True,
+                                             size=max(size, 1))
+        except FileExistsError:
+            # stale segment from a retried task whose first attempt died
+            # mid-store (object ids are deterministic) — replace it
+            self.unlink(object_id)
+            seg = shared_memory.SharedMemory(name=name, create=True,
+                                             size=max(size, 1))
         self._open[name] = seg
         return seg.buf[:size]
 

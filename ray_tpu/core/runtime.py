@@ -204,9 +204,10 @@ class Runtime:
         reply = self.client.request({"t": "create_actor", "spec": spec})
         return ActorID(reply["actor_id"])
 
-    def submit_actor_task(self, actor_id: ActorID, seq: int, method: str,
+    def submit_actor_task(self, actor_id: ActorID, caller_nonce: bytes,
+                          seq: int, method: str,
                           args, kwargs, *, num_returns=1, name: str = ""):
-        task_id = TaskID.for_actor_task(actor_id, seq)
+        task_id = TaskID.for_actor_task(actor_id, caller_nonce, seq)
         n_ret = 1 if num_returns == "dynamic" else max(num_returns, 0)
         return_ids = [ObjectID.for_task_return(task_id, i + 1)
                       for i in range(max(n_ret, 1))]

@@ -1,0 +1,18 @@
+"""TPU-native parallelism: meshes, shardings, collectives, gangs,
+ring attention, pipeline parallelism."""
+
+from ray_tpu.parallel.mesh import (MeshSpec, create_mesh, create_hybrid_mesh,
+                                   mesh_shape, data_axes, batch_sharding,
+                                   replicated)
+from ray_tpu.parallel.sharding import (DEFAULT_LLM_RULES, spec_for,
+                                       sharding_for, tree_shardings,
+                                       constrain)
+from ray_tpu.parallel import collectives
+from ray_tpu.parallel.gang import TpuGang, GangConfig, form_gang
+
+__all__ = [
+    "MeshSpec", "create_mesh", "create_hybrid_mesh", "mesh_shape",
+    "data_axes", "batch_sharding", "replicated", "DEFAULT_LLM_RULES",
+    "spec_for", "sharding_for", "tree_shardings", "constrain",
+    "collectives", "TpuGang", "GangConfig", "form_gang",
+]

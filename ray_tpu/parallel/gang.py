@@ -1,0 +1,125 @@
+"""Gang: slice-aware SPMD worker group.
+
+The TPU-defining layer (SURVEY.md §7 M3).  Replaces the reference's
+WorkerGroup + out-of-band NCCL rendezvous (reference:
+train/_internal/worker_group.py:92 + train/torch/config.py:69
+_setup_torch_process_group) with slice-native formation:
+
+  * single host (this round's fast path): ONE in-process member owns all
+    local chips — jax is single-controller per host, so the driver itself
+    drives the mesh; no process hop, no serialization of arrays.
+  * multi host: one member process per TPU host, co-initialized with
+    ``jax.distributed.initialize`` (coordinator = rank-0 member), each
+    running the same compiled program (SPMD).  Members are actors with
+    ``num_tpus`` resources so the scheduler places them on TPU hosts.
+
+The gang is the unit of fault tolerance: a member death breaks the ICI
+mesh, so recovery = rebuild the gang and restore from checkpoint
+(reference restart-based analogue: backend_executor.py:571 _restart).
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from ray_tpu.parallel.mesh import batch_sharding, create_mesh, mesh_shape
+
+
+@dataclass
+class GangConfig:
+    mesh_axes: dict[str, int] = field(default_factory=lambda: {"dp": -1})
+    num_hosts: int = 1
+    use_cpu_devices: bool = False  # tests: virtual CPU mesh
+
+
+class TpuGang:
+    """Handle to a formed gang.  `run(fn, *args)` executes `fn` inside the
+    mesh context on every member (single-host: inline)."""
+
+    def __init__(self, config: Optional[GangConfig] = None,
+                 devices: Optional[list] = None):
+        self.config = config or GangConfig()
+        if devices is None:
+            devices = (jax.devices("cpu") if self.config.use_cpu_devices
+                       else jax.devices())
+        self.devices = devices
+        self.mesh: Mesh = create_mesh(self.config.mesh_axes, devices=devices)
+        self.num_hosts = self.config.num_hosts
+
+    # -- info -------------------------------------------------------------
+
+    @property
+    def axis_sizes(self) -> dict[str, int]:
+        return mesh_shape(self.mesh)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self, fn: Callable, *args, **kwargs) -> Any:
+        """Execute fn with the gang mesh active (single-host inline)."""
+        with self.mesh:
+            return fn(*args, **kwargs)
+
+    def put_batch(self, batch: Any) -> Any:
+        """Host batch pytree -> sharded jax.Arrays over the data axes."""
+        sh = batch_sharding(self.mesh)
+        return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+    def shutdown(self) -> None:
+        pass
+
+
+def form_gang(mesh_axes: Optional[dict[str, int]] = None,
+              use_cpu_devices: bool = False) -> TpuGang:
+    return TpuGang(GangConfig(mesh_axes=mesh_axes or {"dp": -1},
+                              use_cpu_devices=use_cpu_devices))
+
+
+# ---------------------------------------------------------------------------
+# multi-host formation (skeleton — exercised via dryrun in round 1)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class GangMember:
+    """Actor body for one host's member process (multi-host path).
+
+    Placed with ``num_tpus=<chips per host>`` so the scheduler reserves a
+    whole host's chips; rank 0's address is the jax.distributed
+    coordinator (the analogue of the reference's TCP-store rendezvous on
+    the rank-0 train worker, train/torch/config.py:69).
+    """
+
+    def __init__(self, rank: int, world: int, coordinator: str):
+        self.rank = rank
+        self.world = world
+        self.coordinator = coordinator
+        self._initialized = False
+
+    def setup(self) -> str:
+        import jax as _jax
+        if self.world > 1 and not self._initialized:
+            _jax.distributed.initialize(
+                coordinator_address=self.coordinator,
+                num_processes=self.world, process_id=self.rank)
+            self._initialized = True
+        return f"rank{self.rank}: {len(_jax.devices())} global devices"
+
+    def run(self, pickled_fn: bytes, *args):
+        import cloudpickle
+        fn = cloudpickle.loads(pickled_fn)
+        return fn(self.rank, *args)

@@ -1,0 +1,108 @@
+"""Logical-axis sharding rules.
+
+The TPU-native replacement for per-framework process-group setup
+(reference capability: torch DDP wraps modules per-rank,
+python/ray/train/torch/config.py:113 — here parallelism is declared as a
+mapping from *logical* tensor axes to mesh axes and applied with pjit;
+XLA inserts the collectives).  Same idea as flax's logical partitioning,
+kept dependency-light so any pytree of params works.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# rules: logical axis name -> mesh axis (or tuple of mesh axes, or None)
+Rules = dict[str, Union[str, tuple[str, ...], None]]
+
+# A sensible default for transformer LLMs on a dp/fsdp/tp/sp mesh
+# (scaling-book style: batch over dp+fsdp, params sharded over fsdp,
+# heads/mlp over tp, sequence over sp).
+DEFAULT_LLM_RULES: Rules = {
+    "batch": ("dcn", "dp", "fsdp"),
+    "seq": "sp",
+    "embed": None,
+    "mlp": "tp",
+    "heads": "tp",
+    "kv": None,
+    "qkv": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "layers": None,
+    "stage": "pp",
+}
+
+
+def _prune(rule, mesh: Mesh):
+    """Drop mesh axes absent from `mesh` (or of size 1)."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if rule is None:
+        return None
+    if isinstance(rule, str):
+        rule = (rule,)
+    kept = tuple(a for a in rule if shape.get(a, 1) > 1)
+    if not kept:
+        return None
+    return kept if len(kept) > 1 else kept[0]
+
+
+def spec_for(logical_axes: Sequence[Optional[str]], rules: Rules,
+             mesh: Mesh) -> PartitionSpec:
+    """logical axes of one array -> PartitionSpec on `mesh`."""
+    used: set = set()
+    out = []
+    for ax in logical_axes:
+        if ax is None:
+            out.append(None)
+            continue
+        rule = _prune(rules.get(ax), mesh)
+        # a mesh axis may appear at most once in a spec
+        if rule is not None:
+            axes = (rule,) if isinstance(rule, str) else rule
+            axes = tuple(a for a in axes if a not in used)
+            used.update(axes)
+            rule = axes if len(axes) > 1 else (axes[0] if axes else None)
+            if rule == ():
+                rule = None
+        out.append(rule)
+    return PartitionSpec(*out)
+
+
+def sharding_for(logical_axes: Sequence[Optional[str]], rules: Rules,
+                 mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_axes, rules, mesh))
+
+
+def tree_shardings(logical_tree: Any, rules: Rules, mesh: Mesh) -> Any:
+    """Map a pytree whose leaves are tuples of logical axis names to a
+    pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: sharding_for(axes, rules, mesh),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(e, (str, type(None))) for e in x))
+
+
+def infer_param_logical_axes(params: Any) -> Any:
+    """Heuristic logical axes for a params pytree when the model doesn't
+    declare them: shard the largest dim of ≥2D params over fsdp-style
+    'embed'/'mlp' axes, replicate the rest.  Used as a fallback — models
+    in ray_tpu.models declare axes explicitly."""
+    def leaf_axes(x):
+        if not hasattr(x, "ndim") or x.ndim < 2:
+            return (None,) * getattr(x, "ndim", 0) if hasattr(x, "ndim") else None
+        axes: list[Optional[str]] = [None] * x.ndim
+        axes[int(max(range(x.ndim), key=lambda i: x.shape[i]))] = "mlp"
+        return tuple(axes)
+
+    return jax.tree.map(leaf_axes, params)
+
+
+def constrain(x: Any, logical_axes: Sequence[Optional[str]], rules: Rules,
+              mesh: Mesh) -> Any:
+    """with_sharding_constraint by logical axes (no-op outside jit)."""
+    return jax.lax.with_sharding_constraint(
+        x, sharding_for(logical_axes, rules, mesh))

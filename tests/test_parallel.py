@@ -1,0 +1,170 @@
+"""Mesh/sharding/collectives tests on the virtual 8-device CPU mesh
+(analogue of the reference's multi-node-in-one-machine fixtures)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ray_tpu.parallel import (create_mesh, mesh_shape, spec_for,
+                              DEFAULT_LLM_RULES, collectives as col)
+
+
+@pytest.fixture(scope="module")
+def devices():
+    d = jax.devices("cpu")
+    assert len(d) >= 8, "conftest must force 8 CPU devices"
+    return d
+
+
+def test_mesh_creation(devices):
+    mesh = create_mesh({"dp": 2, "tp": 4}, devices=devices[:8])
+    assert mesh_shape(mesh) == {"dp": 2, "tp": 4}
+
+
+def test_mesh_fill_axis(devices):
+    mesh = create_mesh({"dp": -1, "tp": 2}, devices=devices[:8])
+    assert mesh_shape(mesh) == {"dp": 4, "tp": 2}
+
+
+def test_mesh_invalid_shape(devices):
+    with pytest.raises(ValueError):
+        create_mesh({"dp": 3, "tp": 3}, devices=devices[:8])
+
+
+def test_spec_for_rules(devices):
+    mesh = create_mesh({"dp": 2, "tp": 4}, devices=devices[:8])
+    spec = spec_for(("batch", "seq", "embed"), DEFAULT_LLM_RULES, mesh)
+    assert spec == PartitionSpec("dp", None, None)
+    spec = spec_for(("embed", "mlp"), DEFAULT_LLM_RULES, mesh)
+    assert spec == PartitionSpec(None, "tp")
+
+
+def test_spec_no_duplicate_axes(devices):
+    mesh = create_mesh({"dp": 2, "tp": 4}, devices=devices[:8])
+    # heads and qkv both map to tp — tp may be used only once
+    spec = spec_for(("heads", "qkv"), DEFAULT_LLM_RULES, mesh)
+    used = [a for a in spec if a is not None]
+    assert len(used) <= 1
+
+
+def test_compiled_allreduce(devices):
+    mesh = create_mesh({"dp": 8}, devices=devices[:8])
+
+    @jax.jit
+    def f(x):
+        def inner(x):
+            return col.allreduce(x, "dp")
+        from jax import shard_map
+        return shard_map(inner, mesh=mesh, in_specs=PartitionSpec("dp"),
+                         out_specs=PartitionSpec("dp"))(x)
+
+    x = jnp.arange(8.0)
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_compiled_allgather_and_scatter(devices):
+    mesh = create_mesh({"dp": 8}, devices=devices[:8])
+    from jax import shard_map
+
+    @jax.jit
+    def gather(x):
+        return shard_map(lambda v: col.allgather(v, "dp"),
+                         mesh=mesh, in_specs=PartitionSpec("dp"),
+                         out_specs=PartitionSpec(None), check_vma=False)(x)
+
+    x = jnp.arange(8.0)
+    np.testing.assert_allclose(np.asarray(gather(x)), np.arange(8.0))
+
+    @jax.jit
+    def rs(x):
+        return shard_map(lambda v: col.reducescatter(v, "dp"),
+                         mesh=mesh, in_specs=PartitionSpec(None),
+                         out_specs=PartitionSpec("dp"), check_vma=False)(x)
+
+    out = rs(jnp.ones(8))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 8.0))
+
+
+def test_compiled_broadcast_and_permute(devices):
+    mesh = create_mesh({"dp": 8}, devices=devices[:8])
+    from jax import shard_map
+
+    @jax.jit
+    def bc(x):
+        return shard_map(lambda v: col.broadcast(v, "dp", root=3),
+                         mesh=mesh, in_specs=PartitionSpec("dp"),
+                         out_specs=PartitionSpec("dp"))(x)
+
+    out = np.asarray(bc(jnp.arange(8.0)))
+    np.testing.assert_allclose(out, np.full(8, 3.0))
+
+    @jax.jit
+    def shift(x):
+        return shard_map(
+            lambda v: col.permute(v, "dp", col.ring_perm(8, 1)),
+            mesh=mesh, in_specs=PartitionSpec("dp"),
+            out_specs=PartitionSpec("dp"))(x)
+
+    out = np.asarray(shift(jnp.arange(8.0)))
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_gang_single_host(devices):
+    from ray_tpu.parallel import form_gang
+    gang = form_gang({"dp": 2, "tp": 4}, use_cpu_devices=True)
+    assert gang.num_devices == 8
+    assert gang.axis_sizes == {"dp": 2, "tp": 4}
+
+    batch = {"x": np.ones((8, 4), np.float32)}
+    sharded = gang.put_batch(batch)
+    assert sharded["x"].shape == (8, 4)
+
+    def train_like(b):
+        return jnp.sum(b["x"])
+
+    assert float(gang.run(train_like, sharded)) == 32.0
+
+
+def test_host_plane_collectives_between_actors():
+    """Out-of-band CPU collectives between actor processes (the Gloo
+    analogue; reference: python/ray/util/collective/tests)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        @ray_tpu.remote
+        class Member:
+            def __init__(self, rank, world):
+                from ray_tpu.parallel.collectives import CollectiveGroup
+                self.g = CollectiveGroup("grp", world, rank)
+                self.rank = rank
+
+            def do_allreduce(self):
+                return self.g.allreduce(np.full(3, float(self.rank + 1)))
+
+            def do_bcast(self):
+                return self.g.broadcast(
+                    np.arange(4.0) if self.rank == 0 else None, root=0)
+
+            def do_gather(self):
+                return self.g.allgather(np.array([self.rank]))
+
+        world = 2
+        members = [Member.remote(r, world) for r in range(world)]
+        outs = ray_tpu.get([m.do_allreduce.remote() for m in members],
+                           timeout=120)
+        for o in outs:
+            np.testing.assert_allclose(o, np.full(3, 3.0))
+        outs = ray_tpu.get([m.do_bcast.remote() for m in members],
+                           timeout=120)
+        for o in outs:
+            np.testing.assert_allclose(o, np.arange(4.0))
+        outs = ray_tpu.get([m.do_gather.remote() for m in members],
+                           timeout=120)
+        for o in outs:
+            np.testing.assert_allclose(np.concatenate(o), [0, 1])
+    finally:
+        ray_tpu.shutdown()
