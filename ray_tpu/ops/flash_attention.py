@@ -1,0 +1,191 @@
+"""Pallas TPU flash attention (block-wise, online softmax).
+
+Forward is a pallas kernel: one grid step per (batch·head, q-block); the
+kv stream for that head is processed in VMEM-resident blocks with an
+online-softmax carry, so the O(s²) score matrix never touches HBM and the
+matmuls stay MXU-shaped ([block_q × d] @ [d × block_k]).  Causal masking
+prunes the kv loop to the lower triangle.
+
+Backward is a custom VJP that recomputes probabilities block-by-block from
+the saved logsumexp (the standard flash trade: extra FLOPs for O(s·block)
+memory), written in plain jax so XLA fuses it; it runs anywhere.
+
+Reference capability context: the reference framework has no fused
+attention of its own (it rides torch/CUDA kernels); this is the TPU-native
+equivalent of that dependency, per SURVEY.md §7's "pallas kernels for the
+hot ops".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *,
+                scale: float, causal: bool, block_k: int, kv_len: int):
+    qi = pl.program_id(1)
+    block_q, d = q_ref.shape
+
+    q = q_ref[...].astype(jnp.float32)  # [bq, d]
+    q_offset = qi * block_q
+
+    num_kv_blocks = pl.cdiv(kv_len, block_k)
+    if causal:
+        # kv blocks strictly above the diagonal contribute nothing
+        last_needed = jnp.minimum(
+            (q_offset + block_q + block_k - 1) // block_k, num_kv_blocks)
+    else:
+        last_needed = num_kv_blocks
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            row = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)  # [bq, bk]
+        l_next = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return acc, m_next, l_next
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, last_needed, body, (acc0, m0, l0))
+
+    l = jnp.maximum(l, 1e-30)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    b, h, sq, d = q.shape
+    kv_len = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, kv_len)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, kv_len, d)
+    vf = v.reshape(b * h, kv_len, d)
+
+    grid = (b * h, pl.cdiv(sq, block_q))
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, kv_len=kv_len)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, kv_len, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, kv_len, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
+def _interpret_mode() -> bool:
+    # pallas TPU lowering needs a TPU; tests exercise the kernel on CPU
+    # through the interpreter.
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    s = (q.shape[-1] ** -0.5) if scale is None else scale
+    return _flash_fwd(q, k, v, s, causal, block_q, block_k)
+
+
+def flash_attention(q, k, v, *, scale: Optional[float] = None,
+                    causal: bool = True, block_q: int = 512,
+                    block_k: int = 512):
+    """Fused attention, [batch, heads, seq, head_dim] layout."""
+    return _flash(q, k, v, scale, causal, block_q, block_k)
+
+
+def _fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    s = (q.shape[-1] ** -0.5) if scale is None else scale
+    out = _flash_fwd(q, k, v, s, causal, block_q, block_k)
+    return out, (q, k, v, out)
+
+
+def _bwd_rule(scale, causal, block_q, block_k, res, do):
+    q, k, v, out = res
+    s = (q.shape[-1] ** -0.5) if scale is None else scale
+    b, h, sq, d = q.shape
+    kv_len = k.shape[2]
+    bk = min(block_k, kv_len)
+    nk = kv_len // bk if kv_len % bk == 0 else None
+    if nk is None:
+        # ragged kv — fall back to one full-matrix block
+        bk, nk = kv_len, 1
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # [b,h,sq]
+    row = jnp.arange(sq)[:, None] + (kv_len - sq)
+
+    kb = k.reshape(b, h, nk, bk, d).astype(jnp.float32)
+    vb = v.reshape(b, h, nk, bk, d).astype(jnp.float32)
+
+    # recompute logsumexp block-wise (the flash trade: FLOPs for memory)
+    def lse_step(carry, j):
+        m_prev, l_prev = carry
+        kj = kb[:, :, j]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kj,
+                            preferred_element_type=jnp.float32) * s
+        if causal:
+            col = j * bk + jnp.arange(bk)[None, :]
+            logits = jnp.where(row >= col, logits, NEG_INF)
+        m_cur = jnp.max(logits, axis=-1)
+        m_next = jnp.maximum(m_prev, m_cur)
+        l_next = (l_prev * jnp.exp(m_prev - m_next)
+                  + jnp.sum(jnp.exp(logits - m_next[..., None]), axis=-1))
+        return (m_next, l_next), None
+
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (m, l), _ = jax.lax.scan(lse_step, (m0, l0), jnp.arange(nk))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+
+    def kv_step(dq, j):
+        kj = kb[:, :, j]  # [b,h,bk,d]
+        vj = vb[:, :, j]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kj,
+                            preferred_element_type=jnp.float32) * s
+        if causal:
+            col = j * bk + jnp.arange(bk)[None, :]
+            logits = jnp.where(row >= col, logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])  # [b,h,sq,bk]
+        dvj = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vj)
+        ds = p * (dp - delta[..., None])  # [b,h,sq,bk]
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kj) * s
+        dkj = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * s
+        return dq, (dkj, dvj)
+
+    dq0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(kv_step, dq0, jnp.arange(nk))
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, kv_len, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, kv_len, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,105 @@
+"""Attention kernels: flash (pallas, interpreted on CPU) and ring
+attention vs the reference implementation."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops import attention, flash_attention, mha_reference, ring_attention
+
+
+def _qkv(key, b=2, h=4, s=256, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, s, d), dtype)
+    k = jax.random.normal(kk, (b, h, s, d), dtype)
+    v = jax.random.normal(kv, (b, h, s, d), dtype)
+    return q, k, v
+
+
+# On TPU the MXU runs f32 matmuls at bf16-ish precision by default, so two
+# correct implementations with different blocking differ at ~1e-2.
+TOL = dict(atol=2e-2, rtol=2e-2) if jax.default_backend() == "tpu" \
+    else dict(atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    out_ref = mha_reference(q, k, v, causal=causal)
+    out_flash = flash_attention(q, k, v, causal=causal,
+                                block_q=128, block_k=128)
+    np.testing.assert_allclose(out_ref, out_flash, **TOL)
+
+
+def test_flash_grads_match_reference():
+    q, k, v = _qkv(jax.random.PRNGKey(1), s=128)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=128, block_k=64) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        a, b = np.asarray(a), np.asarray(b)
+        # a handful of elements hit the worst-case MXU rounding; bound the
+        # bulk tightly and the tail loosely
+        assert np.mean(np.abs(a - b)) < 1e-3
+        np.testing.assert_allclose(a, b, atol=0.1, rtol=0.1)
+
+
+def test_attention_dispatch_runs():
+    q, k, v = _qkv(jax.random.PRNGKey(2), s=128)
+    out = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, mha_reference(q, k, v, causal=True),
+                               **TOL)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_reference(cpu_mesh_devices, causal):
+    b, h, s, d = 2, 2, 256, 32
+    q, k, v = _qkv(jax.random.PRNGKey(3), b=b, h=h, s=s, d=d)
+    mesh = Mesh(np.array(cpu_mesh_devices[:4]), ("sp",))
+    shd = NamedSharding(mesh, P(None, None, "sp", None))
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, "sp", causal=causal)
+
+    out = jax.jit(shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None)))(
+        jax.device_put(q, shd), jax.device_put(k, shd),
+        jax.device_put(v, shd))
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               **TOL)
+
+
+def test_ring_attention_grad(cpu_mesh_devices):
+    b, h, s, d = 1, 2, 128, 16
+    q, k, v = _qkv(jax.random.PRNGKey(4), b=b, h=h, s=s, d=d)
+    mesh = Mesh(np.array(cpu_mesh_devices[:4]), ("sp",))
+    shd = NamedSharding(mesh, P(None, None, "sp", None))
+    spec = P(None, None, "sp", None)
+
+    def ring_loss(q, k, v):
+        f = shard_map(lambda a, b_, c: ring_attention(a, b_, c, "sp"),
+                      mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        return jnp.sum(f(q, k, v) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(
+        jax.device_put(q, shd), jax.device_put(k, shd),
+        jax.device_put(v, shd))
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   **TOL)
