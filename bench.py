@@ -1,0 +1,76 @@
+"""Headline benchmark: GPT-2 124M training throughput (tokens/sec).
+
+North-star config #2 (BASELINE.json): GPT-2 124M data-parallel training.
+Baseline = 180k tokens/s, a published-class A100 bf16 number for GPT-2
+124M with flash attention (nanoGPT-era single-A100 throughput); the
+north-star target is ≥90% of the A100 equivalent (BASELINE.md), so
+vs_baseline ≥ 0.9 meets target on a v5e-class chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import gpt
+    from ray_tpu.train.step import make_train_step
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        cfg = gpt.GPTConfig.gpt2_124m(remat=True)
+        batch, seq, steps, warmup = 16, 1024, 20, 3
+    else:  # CPU smoke mode so the bench always produces a line
+        cfg = gpt.GPTConfig(vocab_size=2048, max_seq=256, d_model=256,
+                            n_heads=8, n_layers=4, d_ff=1024, remat=False,
+                            dtype=jnp.float32)
+        batch, seq, steps, warmup = 8, 256, 5, 1
+
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+
+    def loss(p, b):
+        return gpt.loss_fn(p, b, cfg)
+
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    init_fn, step_fn = make_train_step(loss, tx, mesh=None)
+    state = init_fn(params)
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size,
+        dtype=jnp.int32)
+    b = {"tokens": tokens}
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, b)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, b)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    toks_per_sec = batch * seq * steps / dt
+    baseline = 180_000.0  # A100-class GPT-2 124M tokens/s (see docstring)
+    out = {
+        "metric": "gpt2_124m_train_throughput" if on_tpu
+                  else "gpt2_cpu_smoke_train_throughput",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(toks_per_sec / baseline, 4),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

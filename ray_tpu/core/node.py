@@ -798,6 +798,14 @@ class NodeService:
         env["JAX_PLATFORMS"] = "cpu"
         env.setdefault("XLA_FLAGS", "")
         env["RAY_TPU_SESSION"] = self.session
+        # Propagate the driver's import path so functions/classes pickled
+        # by reference (module-level defs in driver-side scripts) resolve
+        # in workers — the minimal slice of the reference's runtime-env
+        # working_dir propagation (reference:
+        # python/ray/_private/runtime_env/working_dir.py capability).
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] +
+            [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
         logdir = os.path.join(self.session_dir, "logs")
         idx = len(self._worker_procs)
         out = open(os.path.join(logdir, f"worker-{idx}.out"), "ab", buffering=0)

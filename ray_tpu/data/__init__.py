@@ -1,0 +1,20 @@
+"""ray_tpu.data: block-parallel datasets feeding sharded device batches
+(reference capability: python/ray/data — SURVEY.md §2.4; §7 M7)."""
+
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data import block
+from ray_tpu.data.preprocessor import (BatchMapper, Chain, Concatenator,
+                                       LabelEncoder, MinMaxScaler,
+                                       Preprocessor, StandardScaler)
+
+from_items = Dataset.from_items
+range = Dataset.range  # noqa: A001 - mirrors reference API name
+from_numpy = Dataset.from_numpy
+read_csv = Dataset.read_csv
+read_parquet = Dataset.read_parquet
+
+__all__ = [
+    "Dataset", "block", "from_items", "range", "from_numpy", "read_csv",
+    "read_parquet", "Preprocessor", "BatchMapper", "Chain", "StandardScaler",
+    "MinMaxScaler", "LabelEncoder", "Concatenator",
+]

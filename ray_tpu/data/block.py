@@ -1,0 +1,68 @@
+"""Blocks: the unit of distributed data.
+
+Reference capability: ray.data blocks (python/ray/data/_internal/
+arrow_block.py, pandas_block.py — Arrow/pandas/list formats).  Here a
+block is a **column dict of numpy arrays** — the layout `device_put`
+wants, so the path from disk to HBM is: block → slice → jax.Array with
+zero format conversions at feed time.  List-of-rows blocks are accepted
+at the edges and normalized.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Union
+
+import numpy as np
+
+Block = dict  # str -> np.ndarray, all columns equal length
+
+
+def normalize(data) -> Block:
+    """rows (list of dicts / scalars) or columns (dict of arrays) → Block."""
+    if isinstance(data, dict):
+        return {k: np.asarray(v) for k, v in data.items()}
+    if isinstance(data, np.ndarray):
+        return {"data": data}
+    rows = list(data)
+    if not rows:
+        return {}
+    if isinstance(rows[0], dict):
+        keys = rows[0].keys()
+        return {k: np.asarray([r[k] for r in rows]) for k in keys}
+    return {"data": np.asarray(rows)}
+
+
+def num_rows(block: Block) -> int:
+    for v in block.values():
+        return len(v)
+    return 0
+
+
+def size_bytes(block: Block) -> int:
+    return sum(v.nbytes for v in block.values())
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in block.items()}
+
+
+def concat(blocks: list[Block]) -> Block:
+    blocks = [b for b in blocks if num_rows(b)]
+    if not blocks:
+        return {}
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def to_rows(block: Block) -> list[dict]:
+    n = num_rows(block)
+    keys = list(block.keys())
+    return [{k: block[k][i] for k in keys} for i in range(n)]
+
+
+def take_rows(block: Block, idx: np.ndarray) -> Block:
+    return {k: v[idx] for k, v in block.items()}
+
+
+def schema(block: Block) -> dict:
+    return {k: (v.dtype, v.shape[1:]) for k, v in block.items()}

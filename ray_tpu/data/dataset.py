@@ -1,0 +1,298 @@
+"""Dataset: lazy block-parallel data pipeline.
+
+Reference capability: ray.data.Dataset (python/ray/data/dataset.py:161 —
+map_batches:364, ExecutionPlan _internal/plan.py:101, streaming executor
+_internal/execution/streaming_executor.py:31, compute strategies
+_internal/compute.py).
+
+Execution model here: a Dataset is (source blocks, stage list).  Stages
+are fused per block (the streaming-executor insight: map stages pipeline
+block-by-block, no all-blocks barrier except for all-to-all ops) and run
+either inline or as core-runtime tasks/actor pools when the runtime is
+up (``parallelism="tasks"|"actors"``).  The TPU-specific tail is
+``iter_batches_sharded``: per-host batches laid out for ``device_put``
+onto a mesh's data axes (the analogue of iter_torch_batches,
+dataset.py map → to-device feed with prefetch).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional, Union
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+
+class Dataset:
+    def __init__(self, blocks: list, stages: Optional[list] = None):
+        # blocks: list of Block OR ObjectRef[Block]
+        self._blocks = blocks
+        self._stages = stages or []
+
+    # ------------------------------------------------------------------ io
+
+    @staticmethod
+    def from_items(items: Iterable, *, parallelism: int = 8) -> "Dataset":
+        rows = list(items)
+        n = max(1, min(parallelism, len(rows)))
+        chunk = math.ceil(len(rows) / n) if rows else 1
+        return Dataset([B.normalize(rows[i:i + chunk])
+                        for i in range(0, len(rows), chunk)] or [{}])
+
+    @staticmethod
+    def range(n: int, *, parallelism: int = 8) -> "Dataset":
+        per = math.ceil(n / parallelism)
+        blocks = []
+        for s in range(0, n, per):
+            blocks.append({"id": np.arange(s, min(s + per, n))})
+        return Dataset(blocks or [{}])
+
+    @staticmethod
+    def from_numpy(arrays: Union[np.ndarray, dict], *,
+                   parallelism: int = 8) -> "Dataset":
+        blk = B.normalize(arrays)
+        n = B.num_rows(blk)
+        per = math.ceil(n / parallelism) if n else 1
+        return Dataset([B.slice_block(blk, s, s + per)
+                        for s in range(0, n, per)] or [{}])
+
+    @staticmethod
+    def read_csv(paths: Union[str, list[str]]) -> "Dataset":
+        import pandas as pd
+        paths = [paths] if isinstance(paths, str) else list(paths)
+        return Dataset([{c: df[c].to_numpy() for c in df.columns}
+                        for df in (pd.read_csv(p) for p in paths)])
+
+    @staticmethod
+    def read_parquet(paths: Union[str, list[str]]) -> "Dataset":
+        import pyarrow.parquet as pq
+        paths = [paths] if isinstance(paths, str) else list(paths)
+        out = []
+        for p in paths:
+            t = pq.read_table(p)
+            out.append({c: t[c].to_numpy(zero_copy_only=False)
+                        for c in t.column_names})
+        return Dataset(out)
+
+    def write_parquet(self, dir_path: str) -> list[str]:
+        import os
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        os.makedirs(dir_path, exist_ok=True)
+        paths = []
+        for i, blk in enumerate(self._resolve_blocks()):
+            p = f"{dir_path}/part-{i:05d}.parquet"
+            pq.write_table(pa.table({k: v for k, v in blk.items()}), p)
+            paths.append(p)
+        return paths
+
+    # ---------------------------------------------------------- transforms
+
+    def _with_stage(self, fn) -> "Dataset":
+        return Dataset(self._blocks, self._stages + [fn])
+
+    def map_batches(self, fn: Callable[[dict], dict], *,
+                    batch_size: Optional[int] = None,
+                    **_compat) -> "Dataset":
+        """fn: column-dict -> column-dict (reference: dataset.py:364)."""
+        def stage(blk: B.Block) -> B.Block:
+            if batch_size is None or B.num_rows(blk) <= batch_size:
+                return B.normalize(fn(dict(blk)))
+            outs = []
+            for s in range(0, B.num_rows(blk), batch_size):
+                outs.append(B.normalize(fn(
+                    dict(B.slice_block(blk, s, s + batch_size)))))
+            return B.concat(outs)
+        return self._with_stage(stage)
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        def stage(blk):
+            return B.normalize([fn(r) for r in B.to_rows(blk)])
+        return self._with_stage(stage)
+
+    def filter(self, pred: Callable[[dict], bool]) -> "Dataset":
+        def stage(blk):
+            keep = np.asarray([bool(pred(r)) for r in B.to_rows(blk)])
+            return B.take_rows(blk, np.nonzero(keep)[0]) if len(keep) else blk
+        return self._with_stage(stage)
+
+    def add_column(self, name: str, fn: Callable[[dict], np.ndarray]):
+        def stage(blk):
+            out = dict(blk)
+            out[name] = np.asarray(fn(dict(blk)))
+            return out
+        return self._with_stage(stage)
+
+    # ------------------------------------------------------- all-to-all ops
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        full = B.concat(self._materialize())
+        n = B.num_rows(full)
+        per = math.ceil(n / num_blocks) if n else 1
+        return Dataset([B.slice_block(full, s, s + per)
+                        for s in range(0, n, per)] or [{}])
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Global shuffle (reference: push_based_shuffle.py capability —
+        here: per-block permutation + round-robin redistribution, exact
+        permutation within materialized blocks)."""
+        blocks = self._materialize()
+        full = B.concat(blocks)
+        n = B.num_rows(full)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(n)
+        shuffled = B.take_rows(full, perm)
+        k = max(1, len(blocks))
+        per = math.ceil(n / k) if n else 1
+        return Dataset([B.slice_block(shuffled, s, s + per)
+                        for s in range(0, n, per)] or [{}])
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        full = B.concat(self._materialize())
+        order = np.argsort(full[key], kind="stable")
+        if descending:
+            order = order[::-1]
+        return Dataset([B.take_rows(full, order)])
+
+    def split(self, n: int) -> list["Dataset"]:
+        """n even shards (reference: dataset.split for per-worker feeds)."""
+        full = B.concat(self._materialize())
+        rows = B.num_rows(full)
+        per = rows // n
+        out = []
+        for i in range(n):
+            s = i * per
+            e = rows if i == n - 1 else s + per
+            out.append(Dataset([B.slice_block(full, s, e)]))
+        return out
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(self._materialize() + other._materialize())
+
+    # ---------------------------------------------------------- execution
+
+    def _resolve_blocks(self) -> list:
+        """Source blocks as local Blocks (pull ObjectRefs if any)."""
+        import ray_tpu
+        out = []
+        for b in self._blocks:
+            from ray_tpu.core.object_ref import ObjectRef
+            if isinstance(b, ObjectRef):
+                out.append(ray_tpu.get(b))
+            else:
+                out.append(b)
+        return out
+
+    def _materialize(self, parallelism: str = "inline") -> list:
+        """Run all stages on every block."""
+        blocks = self._resolve_blocks()
+        if not self._stages:
+            return blocks
+
+        def run_all(blk):
+            for st in self._stages:
+                blk = st(blk)
+            return blk
+
+        if parallelism == "tasks":
+            import ray_tpu
+            task = ray_tpu.remote(lambda blk: run_all(blk))
+            return ray_tpu.get([task.remote(b) for b in blocks])
+        return [run_all(b) for b in blocks]
+
+    def materialize(self, parallelism: str = "inline") -> "Dataset":
+        return Dataset(self._materialize(parallelism))
+
+    # ------------------------------------------------------------ consume
+
+    def count(self) -> int:
+        return sum(B.num_rows(b) for b in self._materialize())
+
+    def take(self, n: int = 20) -> list[dict]:
+        out = []
+        for blk in self._materialize():
+            out.extend(B.to_rows(blk))
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> list[dict]:
+        return [r for blk in self._materialize() for r in B.to_rows(blk)]
+
+    def schema(self) -> dict:
+        for blk in self._materialize():
+            if B.num_rows(blk):
+                return B.schema(blk)
+        return {}
+
+    def stats(self) -> dict:
+        blocks = self._materialize()
+        return {"num_blocks": len(blocks),
+                "num_rows": sum(B.num_rows(b) for b in blocks),
+                "size_bytes": sum(B.size_bytes(b) for b in blocks)}
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False,
+                     shuffle_seed: Optional[int] = None) -> Iterator[dict]:
+        """Stream column-dict batches; stages run block-by-block
+        (streaming-executor shape: no global materialization)."""
+        carry: Optional[dict] = None
+        blocks = self._resolve_blocks()
+        order = list(range(len(blocks)))
+        if shuffle_seed is not None:
+            np.random.default_rng(shuffle_seed).shuffle(order)
+
+        def staged(blk):
+            for st in self._stages:
+                blk = st(blk)
+            return blk
+
+        for bi in order:
+            blk = staged(blocks[bi])
+            if carry is not None:
+                blk = B.concat([carry, blk])
+                carry = None
+            n = B.num_rows(blk)
+            s = 0
+            while n - s >= batch_size:
+                yield dict(B.slice_block(blk, s, s + batch_size))
+                s += batch_size
+            if s < n:
+                carry = dict(B.slice_block(blk, s, n))
+        if carry is not None and not drop_last:
+            yield carry
+
+    def iter_batches_sharded(self, mesh, *, batch_size: int = 256,
+                             prefetch: int = 2,
+                             repeat: bool = False) -> Iterator:
+        """Device-feeding iterator: each host batch is device_put with the
+        mesh's batch sharding (data axes), with a prefetch depth so the
+        H2D transfer of batch k+1 overlaps step k (the analogue of
+        iter_torch_batches+pin_memory, TPU-shaped)."""
+        import jax
+        from ray_tpu.parallel.mesh import batch_sharding
+        sh = batch_sharding(mesh)
+
+        def host_iter():
+            while True:
+                yield from self.iter_batches(batch_size=batch_size,
+                                             drop_last=True)
+                if not repeat:
+                    return
+
+        def put(b):
+            return {k: jax.device_put(v, sh) for k, v in b.items()}
+
+        it = host_iter()
+        buf = [put(b) for b in itertools.islice(it, prefetch)]
+        for nxt in it:
+            buf.append(put(nxt))
+            yield buf.pop(0)
+        yield from buf
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={len(self._blocks)}, "
+                f"stages={len(self._stages)})")

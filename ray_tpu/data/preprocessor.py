@@ -1,0 +1,144 @@
+"""Preprocessors: fit/transform over Datasets.
+
+Reference capability: ray.data.preprocessors (python/ray/data/
+preprocessors/ — scalers, encoders, BatchMapper, Chain; AIR Preprocessor
+base python/ray/data/preprocessor.py).  Stats are computed with one pass
+over the blocks; transform is a map_batches stage, so it fuses into the
+feeding pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+
+class Preprocessor:
+    _fitted = False
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._fitted and type(self)._fit is not Preprocessor._fit:
+            raise RuntimeError(f"{type(self).__name__} not fitted")
+        return ds.map_batches(self._transform_batch)
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    # subclass hooks
+    def _fit(self, ds):
+        pass
+
+    def _transform_batch(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    def __init__(self, columns: list[str]):
+        self.columns = columns
+        self.stats: dict = {}
+
+    def _fit(self, ds):
+        blocks = ds._materialize()
+        for c in self.columns:
+            vals = np.concatenate([b[c] for b in blocks if c in b])
+            self.stats[c] = (float(vals.mean()), float(vals.std() + 1e-12))
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mu, sd = self.stats[c]
+            out[c] = (batch[c] - mu) / sd
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: list[str]):
+        self.columns = columns
+        self.stats: dict = {}
+
+    def _fit(self, ds):
+        blocks = ds._materialize()
+        for c in self.columns:
+            vals = np.concatenate([b[c] for b in blocks if c in b])
+            lo, hi = float(vals.min()), float(vals.max())
+            self.stats[c] = (lo, max(hi - lo, 1e-12))
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            lo, rng = self.stats[c]
+            out[c] = (batch[c] - lo) / rng
+        return out
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Optional[np.ndarray] = None
+
+    def _fit(self, ds):
+        blocks = ds._materialize()
+        vals = np.concatenate([b[self.label_column] for b in blocks])
+        self.classes_ = np.unique(vals)
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        out[self.label_column] = np.searchsorted(
+            self.classes_, batch[self.label_column]).astype(np.int32)
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Concatenate feature columns into one matrix column (the shape
+    device feeds want)."""
+
+    def __init__(self, columns: list[str], output_column: str = "features",
+                 drop: bool = True):
+        self.columns, self.output_column, self.drop = columns, output_column, drop
+        self._fitted = True
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        mats = [np.atleast_2d(batch[c].astype(np.float32).reshape(
+            len(batch[c]), -1)) for c in self.columns]
+        out[self.output_column] = np.concatenate(mats, axis=1)
+        if self.drop:
+            for c in self.columns:
+                out.pop(c, None)
+        return out
+
+
+class BatchMapper(Preprocessor):
+    def __init__(self, fn: Callable[[dict], dict]):
+        self.fn = fn
+        self._fitted = True
+
+    def _transform_batch(self, batch):
+        return self.fn(batch)
+
+
+class Chain(Preprocessor):
+    def __init__(self, *steps: Preprocessor):
+        self.steps = steps
+
+    def _fit(self, ds):
+        for s in self.steps:
+            ds = s.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        for s in self.steps:
+            ds = s.transform(ds)
+        return ds
+
+    def fit_transform(self, ds):
+        self.fit(ds)
+        self._fitted = True
+        return self.transform(ds)

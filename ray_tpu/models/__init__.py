@@ -1,0 +1,15 @@
+"""Model zoo: TPU-first reference models for train/tune/rllib/serve.
+
+The reference framework ships no model library of its own (it trains
+user-supplied torch/TF models — e.g. the ResNet/GPT configs in its AIR
+benchmarks, doc/source/ray-air/benchmarks.rst); here the flagship models
+are part of the framework so every layer above (train, tune, rllib,
+serve, bench) exercises the same TPU-native compute path: pure-jax
+pytree params with logical sharding axes, scan-over-layers, pallas
+attention, bf16 matmuls on the MXU.
+"""
+
+from ray_tpu.models.gpt import (GPT, GPTConfig)
+from ray_tpu.models.mlp import (MLP, MLPConfig)
+
+__all__ = ["GPT", "GPTConfig", "MLP", "MLPConfig"]

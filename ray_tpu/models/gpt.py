@@ -1,0 +1,294 @@
+"""GPT: decoder-only transformer, TPU-first.
+
+Flagship model of the framework (north-star config: GPT-2 124M DP×8, see
+BASELINE.md).  Design choices are all MXU/HBM-driven:
+
+  * params are a plain pytree with per-leaf *logical axes* — sharding is
+    declarative (parallel.sharding rules map logical→mesh axes; pjit/XLA
+    inserts the collectives).  dp/fsdp/tp/sp all come from the same
+    forward function with different rules, no model rewrite.
+  * layers are STACKED (leading ``layers`` dim) and the forward runs
+    ``lax.scan`` over them: one compiled layer body regardless of depth,
+    so compile time is O(1) in n_layers and XLA pipelines the weight
+    loads.
+  * attention dispatches to the pallas flash kernel on TPU, and to
+    shard_map'd ring attention when the mesh has an ``sp`` axis (exact
+    long-context attention, kv rotating over the ICI ring).
+  * optional ``remat`` wraps the scanned body in jax.checkpoint —
+    activation memory O(sqrt) trade per the HBM charter.
+  * activations run in ``cfg.dtype`` (bf16 by default), params and the
+    softmax/logsumexp accumulators in f32.
+
+The reference has no analogue (it rides torch models); capability parity
+target is the GPT-2 124M benchmark workload in
+release/air_tests/air_benchmarks (SURVEY.md §6 north-star configs).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.parallel.sharding import (DEFAULT_LLM_RULES, Rules, spec_for)
+
+try:  # jax>=0.9 top-level export
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # gpt-2 vocab padded to a multiple of 128
+    max_seq: int = 1024
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.0             # framework trains with no dropout by default
+    dtype: Any = jnp.bfloat16        # activation dtype (MXU-native)
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    tie_embeddings: bool = True
+    attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def gpt2_124m(**kw) -> "GPTConfig":
+        return GPTConfig(**{**dict(d_model=768, n_heads=12, n_layers=12,
+                                   d_ff=3072, max_seq=1024), **kw})
+
+    @staticmethod
+    def tiny(**kw) -> "GPTConfig":
+        """Test-sized config (CPU-mesh friendly)."""
+        return GPTConfig(**{**dict(vocab_size=512, max_seq=128, d_model=64,
+                                   n_heads=4, n_layers=2, d_ff=128,
+                                   remat=False), **kw})
+
+
+# -- params ----------------------------------------------------------------
+
+# logical axes per leaf; "layers" is the scan dim and never mesh-mapped
+# (rules map it to None; pp would shard it — see DEFAULT_LLM_RULES).
+PARAM_AXES = {
+    "wte": ("vocab", "embed"),
+    "wpe": (None, "embed"),
+    "ln_f_scale": ("embed",),
+    "ln_f_bias": ("embed",),
+    "layers": {
+        "ln1_scale": ("layers", "embed"),
+        "ln1_bias": ("layers", "embed"),
+        "wqkv": ("layers", "embed", "qkv"),
+        "wo": ("layers", "heads", "embed"),  # [L, d, d]: in-dim is head-major
+        "bo": ("layers", "embed"),
+        "ln2_scale": ("layers", "embed"),
+        "ln2_bias": ("layers", "embed"),
+        "w_up": ("layers", "embed", "mlp"),
+        "b_up": ("layers", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "b_down": ("layers", "embed"),
+    },
+}
+
+
+def param_logical_axes(cfg: GPTConfig):
+    axes = dict(PARAM_AXES)
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(cfg: GPTConfig, rng: jax.Array):
+    """GPT-2 style init: N(0, 0.02), residual projections scaled by
+    1/sqrt(2*n_layers)."""
+    k = iter(jax.random.split(rng, 16))
+    d, L, f = cfg.d_model, cfg.n_layers, cfg.d_ff
+    std = 0.02
+    res_std = std / math.sqrt(2 * L)
+    pd = cfg.param_dtype
+
+    def norm(key, shape, s=std):
+        return (jax.random.normal(key, shape) * s).astype(pd)
+
+    params = {
+        "wte": norm(next(k), (cfg.vocab_size, d)),
+        "wpe": norm(next(k), (cfg.max_seq, d), 0.01),
+        "ln_f_scale": jnp.ones((d,), pd),
+        "ln_f_bias": jnp.zeros((d,), pd),
+        "layers": {
+            "ln1_scale": jnp.ones((L, d), pd),
+            "ln1_bias": jnp.zeros((L, d), pd),
+            "wqkv": norm(next(k), (L, d, 3 * d)),
+            "wo": norm(next(k), (L, d, d), res_std),
+            "bo": jnp.zeros((L, d), pd),
+            "ln2_scale": jnp.ones((L, d), pd),
+            "ln2_bias": jnp.zeros((L, d), pd),
+            "w_up": norm(next(k), (L, d, f)),
+            "b_up": jnp.zeros((L, f), pd),
+            "w_down": norm(next(k), (L, f, d), res_std),
+            "b_down": jnp.zeros((L, d), pd),
+        },
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(next(k), (d, cfg.vocab_size))
+    return params
+
+
+def num_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+# -- forward ---------------------------------------------------------------
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _constrain(x, logical, mesh, rules):
+    if mesh is None:
+        return x
+    spec = spec_for(logical, rules, mesh)
+    return lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec))
+
+
+def _attend(q, k, v, cfg: GPTConfig, mesh: Optional[Mesh], rules: Rules):
+    """[b, h, s, hd] attention; ring attention when seq is sp-sharded."""
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        spec = spec_for(("batch", "heads", "seq", "kv"), rules, mesh)
+        ring = partial(ring_attention, axis_name="sp", causal=True)
+        return shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+    return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+
+
+def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
+            rules: Rules = DEFAULT_LLM_RULES):
+    """tokens [b, s] int32 → logits [b, s, vocab] (f32).
+
+    With a mesh, activations carry sharding constraints so pjit lays out
+    batch over dp/fsdp, heads/mlp over tp, seq over sp; without one it is
+    an ordinary single-device jax function.
+    """
+    b, s = tokens.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+
+    x = params["wte"][tokens] + params["wpe"][:s][None, :, :]
+    x = x.astype(cfg.dtype)
+    x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+    def layer(x, lp):
+        y = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+        qkv = jnp.einsum("bsd,de->bse", y, lp["wqkv"].astype(cfg.dtype))
+        qkv = _constrain(qkv, ("batch", "seq", "qkv"), mesh, rules)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # [b, s, d] -> [b, h, s, hd]
+            return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+
+        o = _attend(heads(q), heads(k), heads(v), cfg, mesh, rules)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        o = jnp.einsum("bsd,de->bse", o, lp["wo"].astype(cfg.dtype)) \
+            + lp["bo"].astype(cfg.dtype)
+        x = x + o
+        x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+        y = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+        u = jnp.einsum("bsd,df->bsf", y, lp["w_up"].astype(cfg.dtype)) \
+            + lp["b_up"].astype(cfg.dtype)
+        u = _constrain(u, ("batch", "seq", "mlp"), mesh, rules)
+        u = jax.nn.gelu(u)
+        dn = jnp.einsum("bsf,fd->bsd", u, lp["w_down"].astype(cfg.dtype)) \
+            + lp["b_down"].astype(cfg.dtype)
+        x = x + dn
+        x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
+        return x, None
+
+    body = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = lax.scan(body, x, params["layers"])
+
+    x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    w_out = (params["wte"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, w_out.astype(cfg.dtype))
+    logits = _constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
+            rules: Rules = DEFAULT_LLM_RULES):
+    """Next-token cross-entropy.  batch = {"tokens": [b, s+1] int32} or
+    {"tokens": [b, s], "targets": [b, s]}."""
+    tokens = batch["tokens"]
+    if "targets" in batch:
+        inp, tgt = tokens, batch["targets"]
+    else:
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inp, cfg, mesh=mesh, rules=rules)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def generate(params, cfg: GPTConfig, prompt, max_new: int, *,
+             rng: Optional[jax.Array] = None, temperature: float = 1.0):
+    """Greedy/sampled decode via lax.scan (static shapes — the whole loop
+    is one compiled program).  prompt [b, s0] int32, returns [b, s0+max_new].
+    Simple full-recompute decode (no kv cache yet — serve layer owns the
+    incremental-decode path)."""
+    b, s0 = prompt.shape
+    total = s0 + max_new
+    if total > cfg.max_seq:
+        raise ValueError(f"{total} exceeds max_seq {cfg.max_seq}")
+    toks = jnp.zeros((b, total), jnp.int32).at[:, :s0].set(prompt)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def step(carry, i):
+        toks, rng = carry
+        logits = forward(params, toks, cfg)[:, i - 1, :]
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1)
+        else:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        toks = toks.at[:, i].set(nxt.astype(jnp.int32))
+        return (toks, rng), None
+
+    (toks, _), _ = lax.scan(step, (toks, rng), jnp.arange(s0, total))
+    return toks
+
+
+class GPT:
+    """OO convenience wrapper over the functional API."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init(self, rng):
+        return init_params(self.cfg, rng)
+
+    def logical_axes(self):
+        return param_logical_axes(self.cfg)
+
+    def apply(self, params, tokens, **kw):
+        return forward(params, tokens, self.cfg, **kw)
+
+    def loss(self, params, batch, **kw):
+        return loss_fn(params, batch, self.cfg, **kw)

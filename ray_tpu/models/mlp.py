@@ -1,0 +1,72 @@
+"""MLP classifier: the minimal model for tests and examples.
+
+Analogue of the toy torch modules the reference's train/tune tests build
+inline (e.g. python/ray/train/tests/test_torch_trainer.py); kept in the
+zoo so examples/tests share one implementation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: tuple = (128, 128)
+    out_dim: int = 10
+    dtype: Any = jnp.float32
+
+
+def init_params(cfg: MLPConfig, rng: jax.Array):
+    dims = (cfg.in_dim, *cfg.hidden, cfg.out_dim)
+    keys = jax.random.split(rng, len(dims) - 1)
+    return {
+        f"layer{i}": {
+            "w": (jax.random.normal(keys[i], (dims[i], dims[i + 1]))
+                  * (2.0 / dims[i]) ** 0.5).astype(cfg.dtype),
+            "b": jnp.zeros((dims[i + 1],), cfg.dtype),
+        }
+        for i in range(len(dims) - 1)
+    }
+
+
+def forward(params, x, cfg: MLPConfig):
+    n = len(params)
+    for i in range(n):
+        lp = params[f"layer{i}"]
+        x = x @ lp["w"] + lp["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params, batch, cfg: MLPConfig):
+    """batch = {"x": [b, in_dim], "y": [b] int labels}"""
+    logits = forward(params, batch["x"], cfg)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, batch["y"][:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def accuracy(params, batch, cfg: MLPConfig):
+    logits = forward(params, batch["x"], cfg)
+    return jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32))
+
+
+class MLP:
+    def __init__(self, cfg: MLPConfig):
+        self.cfg = cfg
+
+    def init(self, rng):
+        return init_params(self.cfg, rng)
+
+    def apply(self, params, x):
+        return forward(params, x, self.cfg)
+
+    def loss(self, params, batch):
+        return loss_fn(params, batch, self.cfg)

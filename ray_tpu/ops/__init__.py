@@ -5,12 +5,10 @@ delegates kernels to torch/CUDA; here the compute path is jax/XLA with
 pallas kernels for ops XLA does not fuse well, per the repo build charter).
 """
 
-from ray_tpu.ops.attention import (attention, mha_reference,
-                                   AttentionConfig)
+from ray_tpu.ops.attention import attention, mha_reference
 from ray_tpu.ops.flash_attention import flash_attention
 from ray_tpu.ops.ring_attention import ring_attention
 
 __all__ = [
-    "attention", "mha_reference", "AttentionConfig", "flash_attention",
-    "ring_attention",
+    "attention", "mha_reference", "flash_attention", "ring_attention",
 ]

@@ -9,23 +9,10 @@ differentiable and numerically interchangeable (tests assert allclose).
 
 from __future__ import annotations
 
-import functools
-from dataclasses import dataclass
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-
-
-@dataclass(frozen=True)
-class AttentionConfig:
-    causal: bool = True
-    # None → 1/sqrt(head_dim)
-    scale: Optional[float] = None
-    # force an implementation: "flash" | "reference" | None (auto)
-    impl: Optional[str] = None
-    block_q: int = 512
-    block_k: int = 512
 
 
 def _scale_for(q, scale):
@@ -82,6 +69,10 @@ def attention(q, k, v, *, causal: bool = True,
         impl = ("flash" if _on_tpu() and tile_ok and mask is None
                 else "reference")
     if impl == "flash":
+        if mask is not None:
+            raise ValueError(
+                "flash impl has no custom-mask support; use "
+                "impl='reference' (causal masking is built in)")
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k)
     if impl == "reference":

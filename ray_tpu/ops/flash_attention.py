@@ -29,12 +29,17 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *,
-                scale: float, causal: bool, block_k: int, kv_len: int):
+                scale: float, causal: bool, block_k: int, kv_len: int,
+                q_len: int):
     qi = pl.program_id(1)
     block_q, d = q_ref.shape
 
     q = q_ref[...].astype(jnp.float32)  # [bq, d]
-    q_offset = qi * block_q
+    # global key position of each q row's diagonal: cross-length causal
+    # (decode with kv cache) puts q at the TAIL of the kv sequence, same
+    # convention as mha_reference's (k_len - q_len) offset
+    q_offset = qi * block_q + (kv_len - q_len)
+    ragged = kv_len % block_k != 0
 
     num_kv_blocks = pl.cdiv(kv_len, block_k)
     if causal:
@@ -51,11 +56,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        col = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if ragged:
+            # the last block's ds() clamps its start, re-reading earlier
+            # keys — mask out columns past kv_len (clamped ds shifts the
+            # window back by (block_k - rem), so recompute real positions)
+            start = jnp.minimum(j * block_k, kv_len - block_k)
+            col = start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = col >= j * block_k
+            s = jnp.where(valid, s, NEG_INF)
         if causal:
             row = q_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            col = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(row >= col, s, NEG_INF)
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
         m_next = jnp.maximum(m_prev, m_cur)
@@ -85,7 +99,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 
     grid = (b * h, pl.cdiv(sq, block_q))
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k, kv_len=kv_len)
+                               block_k=block_k, kv_len=kv_len, q_len=sq)
     out = pl.pallas_call(
         kernel,
         grid=grid,

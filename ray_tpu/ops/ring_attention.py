@@ -25,8 +25,7 @@ NEG_INF = -1e30
 
 
 def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
-                   scale: Optional[float] = None,
-                   impl: Optional[str] = None) -> jax.Array:
+                   scale: Optional[float] = None) -> jax.Array:
     """Exact attention, q/k/v = local shards [b, h, s_local, d].
 
     Global sequence order = shard order along `axis_name` (shard i holds
@@ -64,7 +63,12 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (acc, m_next, l_next, k_nxt, v_nxt), None
 
-    acc0, m0, l0 = jax.lax.pvary(
+    if hasattr(jax.lax, "pcast"):  # jax>=0.9 spelling of pvary
+        def _pvary(x, axes):
+            return jax.lax.pcast(x, axes, to="varying")
+    else:  # pragma: no cover - older jax
+        _pvary = jax.lax.pvary
+    acc0, m0, l0 = _pvary(
         (jnp.zeros((b, h, sl, d), jnp.float32),
          jnp.full((b, h, sl, 1), NEG_INF, jnp.float32),
          jnp.zeros((b, h, sl, 1), jnp.float32)), (axis_name,))

@@ -72,6 +72,13 @@ def create_mesh(axes: Optional[dict[str, int]] = None,
     """
     devices = list(devices) if devices is not None else jax.devices()
     spec = MeshSpec(dict(axes) if axes else {"dp": -1})
+    requested = math.prod(v for v in spec.axes.values() if v != -1)
+    if (-1 not in spec.axes.values() and requested < len(devices)
+            and len(devices) % requested == 0):
+        # fewer devices asked for than exist (e.g. a dp=4 test mesh on an
+        # 8-device host): use a prefix — the gang owns whole hosts, but a
+        # mesh may be a sub-slice
+        devices = devices[:requested]
     resolved = spec.resolved(len(devices))
     shape = tuple(resolved.values())
     try:

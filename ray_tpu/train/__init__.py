@@ -1,0 +1,22 @@
+"""ray_tpu.train: gang-scheduled SPMD training (reference capability:
+python/ray/train — SURVEY.md §2.4; TPU-first redesign per §7 M4)."""
+
+from ray_tpu.train.checkpoint import (AsyncCheckpointer, Checkpoint,
+                                      CheckpointManager)
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.jax_trainer import JaxTrainer
+from ray_tpu.train.result import Result
+from ray_tpu.train.step import (TrainState, make_train_step, shard_batch,
+                                state_shardings)
+from ray_tpu.train.trainer import (BaseTrainer, DataParallelTrainer,
+                                   TrainingFailedError)
+from ray_tpu.train import session
+
+__all__ = [
+    "Checkpoint", "CheckpointManager", "AsyncCheckpointer",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "Result", "TrainState", "make_train_step", "shard_batch",
+    "state_shardings", "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
+    "TrainingFailedError", "session",
+]

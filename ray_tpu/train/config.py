@@ -1,0 +1,63 @@
+"""Typed run/scaling configs (reference capability: python/ray/air/config.py
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig — same roles,
+TPU-topology-aware fields instead of num_gpus floats)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How to scale training.
+
+    Where the reference exposes ``num_workers``×``use_gpu``
+    (air/config.py ScalingConfig), parallelism here is a *mesh spec*:
+    named axis sizes laid over the gang's devices (dp/fsdp/tp/sp/ep/pp;
+    -1 = fill).  ``num_hosts`` scales over TPU hosts (one gang member per
+    host, jax.distributed); within a host all chips are always used —
+    that is the SPMD unit, not a tunable.
+    """
+    mesh: dict[str, int] = field(default_factory=lambda: {"dp": -1})
+    num_hosts: int = 1
+    use_cpu_devices: bool = False       # tests: virtual CPU device mesh
+    # reference-compat aliases: ScalingConfig(num_workers=8) on a CPU mesh
+    num_workers: Optional[int] = None
+
+    def __post_init__(self):
+        if self.num_workers is not None and self.mesh == {"dp": -1}:
+            self.mesh = {"dp": self.num_workers}
+
+
+@dataclass
+class FailureConfig:
+    """Restart-based FT (reference: air/config.py FailureConfig;
+    restart semantics per train/_internal/backend_executor.py:571 —
+    on TPU a member loss breaks the ICI mesh, so recovery is always
+    rebuild-gang + restore-from-checkpoint)."""
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """(reference: air/config.py CheckpointConfig)"""
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0        # steps between checkpoints; 0 = off
+    checkpoint_at_end: bool = True
+    async_write: bool = True
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None   # local dir or mounted FS
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 1
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        return os.path.join(base, self.name or "run")

@@ -1,0 +1,106 @@
+"""JaxTrainer: declarative model+optimizer training (the framework-native
+trainer — the reference's closest analogues are its framework trainers,
+e.g. TorchTrainer wrapping DDP setup; here the "backend" is a sharded
+compiled train step from train.step).
+
+Give it a loss_fn, param init, optax optimizer, a batch iterator and a
+mesh spec; it builds the sharded step, runs it, reports metrics, and
+checkpoints periodically.  TP/PP/SP/FSDP are *config*, not code: they are
+just different mesh axes + sharding rules on the same loss_fn.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import optax
+
+from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
+from ray_tpu.train import session
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.step import make_train_step, shard_batch
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+class JaxTrainer(DataParallelTrainer):
+    def __init__(self, *, loss_fn: Callable,
+                 init_params: Callable[[jax.Array], Any],
+                 optimizer: optax.GradientTransformation,
+                 train_data: Iterable,
+                 num_steps: int,
+                 params_logical: Any = None,
+                 rules: Rules = DEFAULT_LLM_RULES,
+                 eval_fn: Optional[Callable] = None,
+                 eval_every: int = 0,
+                 report_every: int = 10,
+                 checkpoint_every: int = 0,
+                 seed: int = 0,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 **kw):
+        self._opts = dict(
+            loss_fn=loss_fn, init_params=init_params, optimizer=optimizer,
+            train_data=train_data, num_steps=num_steps,
+            params_logical=params_logical, rules=rules, eval_fn=eval_fn,
+            eval_every=eval_every, report_every=report_every,
+            checkpoint_every=checkpoint_every, seed=seed)
+        super().__init__(self._train_loop, scaling_config=scaling_config,
+                         run_config=run_config, **kw)
+
+    def _train_loop(self, _cfg):
+        o = self._opts
+        mesh = self.gang.mesh
+        loss_fn = o["loss_fn"]
+        # model loss_fns that take mesh/rules get them bound here
+        try:
+            import inspect
+            sig = inspect.signature(loss_fn)
+            if "mesh" in sig.parameters:
+                import functools
+                loss_fn = functools.partial(loss_fn, mesh=mesh,
+                                            rules=o["rules"])
+        except (ValueError, TypeError):
+            pass
+
+        init_fn, step_fn = make_train_step(
+            loss_fn, o["optimizer"], mesh=mesh,
+            params_logical=o["params_logical"], rules=o["rules"])
+
+        restored = session.get_checkpoint()
+        params = o["init_params"](jax.random.PRNGKey(o["seed"]))
+        state = init_fn(params)
+        start_step = 0
+        if restored is not None:
+            payload = restored.to_dict()
+            host_params = payload["params"]
+            state = init_fn(jax.tree.map(lambda _, h: h, params, host_params))
+            start_step = int(payload.get("step", 0))
+
+        data_iter = iter(o["train_data"])
+        t0 = time.perf_counter()
+        tokens_done = 0
+        for i in range(start_step, o["num_steps"]):
+            batch = next(data_iter)
+            batch = shard_batch(batch, mesh)
+            state, metrics = step_fn(state, batch)
+            leaf = jax.tree.leaves(batch)[0]
+            tokens_done += int(leaf.shape[0]) * (
+                int(leaf.shape[1]) if leaf.ndim > 1 else 1)
+
+            is_last = i + 1 == o["num_steps"]
+            if (i + 1) % o["report_every"] == 0 or is_last:
+                m = {k: float(v) for k, v in metrics.items()}
+                dt = time.perf_counter() - t0
+                m.update(step=i + 1, throughput=tokens_done / max(dt, 1e-9))
+                if (o["eval_fn"] is not None and o["eval_every"]
+                        and (i + 1) % o["eval_every"] == 0):
+                    m["eval"] = float(o["eval_fn"](state.params))
+                ckpt = None
+                if (o["checkpoint_every"]
+                        and (i + 1) % o["checkpoint_every"] == 0) or is_last:
+                    ckpt = {"params": jax.tree.map(lambda x: x, state.params),
+                            "step": i + 1}
+                session.report(m, checkpoint=ckpt)
+        self.final_state = state

@@ -1,0 +1,21 @@
+"""Result of a training run (reference: python/ray/air/result.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class Result:
+    metrics: dict = field(default_factory=dict)     # last reported metrics
+    checkpoint: Optional[Checkpoint] = None
+    error: Optional[BaseException] = None
+    path: Optional[str] = None                      # run directory
+    metrics_history: list = field(default_factory=list)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint

@@ -1,0 +1,79 @@
+"""Per-worker training session: ``report(metrics, checkpoint=...)``.
+
+Reference capability: ray.air.session (python/ray/air/session.py:41
+session.report) + the per-worker _TrainSession thread/queue handoff
+(train/_internal/session.py:63,325).  Here the single-host fast path has
+no thread hop: the training loop runs in the driver (or gang member)
+process and report() appends to an in-process buffer the trainer drains;
+multi-host members report through the object store.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class _SessionState:
+    world_rank: int = 0
+    world_size: int = 1
+    results: list = field(default_factory=list)
+    latest_checkpoint: Optional[Checkpoint] = None
+    checkpoint_cb: Any = None     # callable(dict) -> path, set by trainer
+    stop_requested: bool = False
+
+
+_local = threading.local()
+
+
+def _state() -> _SessionState:
+    st = getattr(_local, "session", None)
+    if st is None:
+        raise RuntimeError(
+            "No active train session — session.* calls are only valid "
+            "inside a train_loop_per_worker launched by a Trainer.")
+    return st
+
+
+def _start(world_rank=0, world_size=1, checkpoint_cb=None,
+           latest_checkpoint=None) -> _SessionState:
+    st = _SessionState(world_rank=world_rank, world_size=world_size,
+                       checkpoint_cb=checkpoint_cb,
+                       latest_checkpoint=latest_checkpoint)
+    _local.session = st
+    return st
+
+
+def _end():
+    _local.session = None
+
+
+def report(metrics: dict, *, checkpoint: Optional[dict] = None) -> None:
+    """Report metrics (and optionally a checkpoint payload) for this step
+    (reference: air/session.py:41)."""
+    st = _state()
+    entry = dict(metrics)
+    if checkpoint is not None and st.checkpoint_cb is not None:
+        path = st.checkpoint_cb(checkpoint)
+        entry["_checkpoint_path"] = path
+    st.results.append(entry)
+    if st.stop_requested:
+        raise StopIteration("session stop requested")
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Checkpoint to resume from, if the trainer restored one
+    (reference: session.get_checkpoint)."""
+    return _state().latest_checkpoint
+
+
+def get_world_rank() -> int:
+    return _state().world_rank
+
+
+def get_world_size() -> int:
+    return _state().world_size

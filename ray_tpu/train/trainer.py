@@ -1,0 +1,150 @@
+"""Trainers: gang-scheduled SPMD training with restart-based FT.
+
+Reference capability: train.DataParallelTrainer
+(python/ray/train/data_parallel_trainer.py:56) + BackendExecutor
+(train/_internal/backend_executor.py:43 — placement group, worker gang,
+restart loop :571).  TPU shape (SURVEY.md §7 M4): the worker group is a
+TpuGang (one SPMD program over a named mesh), the "backend" is jax
+itself — there is no process-group setup step because collectives are
+compiled into the program.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+import jax
+
+from ray_tpu.parallel.gang import GangConfig, TpuGang
+from ray_tpu.train import session as _session
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.result import Result
+
+logger = logging.getLogger("ray_tpu.train")
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BaseTrainer:
+    """fit() drives the run; subclasses define what one attempt does
+    (reference: train/base_trainer.py:344 fit — whose delegation *into
+    Tune* for a 1-trial run we deliberately do not copy: a plain train
+    run should not drag in a tuner; instead Tune wraps trainers, see
+    ray_tpu.tune)."""
+
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # subclass hook: one full training attempt in an active session
+    def _attempt(self) -> None:
+        raise NotImplementedError
+
+    def fit(self) -> Result:
+        run_dir = self.run_config.resolved_storage_path()
+        os.makedirs(run_dir, exist_ok=True)
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(run_dir, "checkpoints"),
+            num_to_keep=ckpt_cfg.num_to_keep,
+            async_write=ckpt_cfg.async_write)
+        max_failures = self.run_config.failure_config.max_failures
+        restore = self.resume_from_checkpoint or manager.latest()
+
+        attempt, error = 0, None
+        results: list = []
+        while True:
+            st = _session._start(
+                world_rank=0,
+                world_size=self.scaling_config.num_hosts,
+                checkpoint_cb=lambda data: manager.save(data),
+                latest_checkpoint=restore)
+            try:
+                self._attempt()
+                error = None
+                break
+            except StopIteration:
+                error = None
+                break
+            except Exception as e:  # restart-based FT
+                error = e
+                attempt += 1
+                logger.warning("training attempt %d failed: %s", attempt, e)
+                if attempt > max_failures:
+                    break
+                manager.flush()
+                restore = manager.latest()  # rebuild from last checkpoint
+            finally:
+                results.extend(st.results)
+                _session._end()
+
+        manager.flush()
+        metrics = results[-1] if results else {}
+        res = Result(metrics=metrics, checkpoint=manager.latest(),
+                     error=error, path=run_dir, metrics_history=results)
+        if error is not None and max_failures >= 0:
+            raise TrainingFailedError(
+                f"Training failed after {attempt} attempt(s): {error}\n"
+                + "".join(traceback.format_exception(error))) from error
+        return res
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Runs ``train_loop_per_worker(config)`` on the gang
+    (reference: data_parallel_trainer.py:56; training_loop :347).
+
+    Single-host: the loop runs in-process with the gang's mesh active —
+    jax is single-controller per host, so there is no worker hop and no
+    pickling of arrays.  Multi-host: one member process per host executes
+    the same loop (SPMD), coordinated via jax.distributed.
+    """
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[dict] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self._loop = train_loop_per_worker
+        self._loop_config = train_loop_config or {}
+        self._datasets = datasets or {}
+        self._gang: Optional[TpuGang] = None
+
+    @property
+    def gang(self) -> TpuGang:
+        if self._gang is None:
+            sc = self.scaling_config
+            self._gang = TpuGang(GangConfig(
+                mesh_axes=dict(sc.mesh), num_hosts=sc.num_hosts,
+                use_cpu_devices=sc.use_cpu_devices))
+        return self._gang
+
+    def _attempt(self) -> None:
+        gang = self.gang
+        st = _session._state()
+        st.world_size = gang.num_hosts
+        cfg = dict(self._loop_config)
+        if self._datasets:
+            cfg["datasets"] = {
+                name: ds.iter_batches_sharded(gang.mesh)
+                if hasattr(ds, "iter_batches_sharded") else ds
+                for name, ds in self._datasets.items()}
+        with gang.mesh:
+            if self._loop.__code__.co_argcount == 0:
+                self._loop()
+            else:
+                self._loop(cfg)

@@ -1,0 +1,40 @@
+"""ray_tpu.tune: hyperparameter search (reference capability:
+python/ray/tune — SURVEY.md §2.4; build plan §7 M5)."""
+
+from typing import Optional
+
+from ray_tpu.tune import _report_bridge
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
+                                 Searcher, choice, grid_search, loguniform,
+                                 randint, uniform)
+from ray_tpu.tune.trainable import Trainable, FunctionTrainable, wrap_function
+from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
+
+
+def report(metrics: dict, *, checkpoint: Optional[dict] = None) -> None:
+    """Report one step's metrics from inside a function trainable
+    (reference: tune.report / air session.report)."""
+    bridge = _report_bridge.current()
+    if bridge is None:
+        raise RuntimeError("tune.report() called outside a tune trial")
+    bridge.report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> Optional[dict]:
+    """Restore payload for this trial, if the runner restored one."""
+    bridge = _report_bridge.current()
+    if bridge is None:
+        raise RuntimeError("tune.get_checkpoint() outside a tune trial")
+    return bridge.get_checkpoint()
+
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "Trial", "Trainable",
+    "FunctionTrainable", "wrap_function", "report", "get_checkpoint",
+    "choice", "uniform", "loguniform", "randint", "grid_search",
+    "BasicVariantGenerator", "ConcurrencyLimiter", "Searcher",
+    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
+    "TrialScheduler",
+]

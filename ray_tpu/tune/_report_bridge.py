@@ -1,0 +1,100 @@
+"""Bridge between imperative ``tune.report(...)`` calls inside a user
+function and the stepwise Trainable interface the trial runner drives.
+
+The function runs in a worker thread; each report() hands one result to
+the runner and blocks until the runner asks for the next step — so a
+function trainable behaves exactly like a class trainable from the
+scheduler's point of view (reference: tune/trainable/function_trainable.py
+uses the same thread+queue handoff, _RunnerThread)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+_bridges: dict[int, "Bridge"] = {}   # fn-thread ident -> bridge
+
+
+class StopTrial(BaseException):
+    """Raised inside the fn thread when the trial is stopped early."""
+
+
+class Bridge:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._result: Optional[dict] = None
+        self._consumed = True
+        self._stop = False
+        self._finished = False
+        self._error: Optional[BaseException] = None
+        self.latest_checkpoint: Optional[dict] = None
+        self.restore_payload: Optional[dict] = None
+
+    # -- called from the fn thread ----------------------------------------
+
+    def report(self, metrics: dict, *, checkpoint: Optional[dict] = None):
+        with self._cond:
+            if self._stop:
+                raise StopTrial()
+            if checkpoint is not None:
+                self.latest_checkpoint = checkpoint
+            self._result = dict(metrics)
+            self._consumed = False
+            self._cond.notify_all()
+            while not self._consumed and not self._stop:
+                self._cond.wait()
+            if self._stop:
+                raise StopTrial()
+
+    def get_checkpoint(self) -> Optional[dict]:
+        return self.restore_payload
+
+    # -- called from the runner -------------------------------------------
+
+    def drive(self, fn: Callable, config: dict):
+        def run():
+            _bridges[threading.get_ident()] = self
+            try:
+                fn(config)
+            except StopTrial:
+                pass
+            except BaseException as e:
+                self._error = e
+            finally:
+                _bridges.pop(threading.get_ident(), None)
+                with self._cond:
+                    self._finished = True
+                    self._cond.notify_all()
+
+        thread = threading.Thread(target=run, daemon=True)
+        thread.start()
+        while True:
+            with self._cond:
+                while self._consumed and not self._finished:
+                    self._cond.wait()
+                if not self._consumed:
+                    result = self._result
+                    self._consumed = True
+                    self._cond.notify_all()
+                else:  # finished with no pending result
+                    if self._error is not None:
+                        raise self._error
+                    return
+            yield result
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+
+
+def current() -> Optional[Bridge]:
+    return _bridges.get(threading.get_ident())
+
+
+def push(bridge: Bridge) -> Bridge:   # kept for API symmetry
+    return bridge
+
+
+def pop(token):
+    pass

@@ -1,0 +1,148 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Reference capability: tune.schedulers (python/ray/tune/schedulers/ —
+async_hyperband.py ASHA, pbt.py PBT, fifo.py).  Decisions are made on
+every reported result; the runner applies them (stop / pause / exploit).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial, result: Optional[dict]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference: schedulers/fifo.py)."""
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving
+    (reference: tune/schedulers/async_hyperband.py AsyncHyperBandScheduler).
+
+    Rungs at grace_period·rf^k; a trial reaching a rung is stopped unless
+    its metric is in the top 1/reduction_factor of results recorded at
+    that rung so far (async: no waiting for a full bracket).
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        assert mode in ("min", "max")
+        self.metric, self.mode = metric, mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        self.rungs: list[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> recorded metric values
+        self._recorded: dict[int, list[float]] = defaultdict(list)
+
+    def _better(self, v, cutoff):
+        return v <= cutoff if self.mode == "min" else v >= cutoff
+
+    def on_result(self, trial, result) -> str:
+        t = result.get("training_iteration", 0)
+        v = result.get(self.metric)
+        if v is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in reversed(self.rungs):
+            if t == rung:
+                rec = self._recorded[rung]
+                rec.append(float(v))
+                k = max(1, len(rec) // self.rf)
+                ordered = sorted(rec, reverse=(self.mode == "max"))
+                cutoff = ordered[k - 1]
+                return CONTINUE if self._better(float(v), cutoff) else STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): at each
+    perturbation_interval, bottom-quantile trials exploit (copy weights
+    of) a top-quantile trial and explore (perturb) its hyperparams.
+
+    The runner calls ``on_result`` and, when it returns an exploit
+    directive via ``pending_exploits``, clones the source trial's
+    checkpoint into the target before the next step.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        assert mode in ("min", "max")
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self._scores: dict[str, float] = {}
+        self._last_perturb: dict[str, int] = {}
+        # trial_id -> (source_trial_id, new_config)
+        self.pending_exploits: dict[str, tuple] = {}
+
+    def _quantiles(self):
+        items = sorted(self._scores.items(), key=lambda kv: kv[1],
+                       reverse=(self.mode == "max"))
+        n = len(items)
+        k = max(1, int(n * self.quantile))
+        top = [tid for tid, _ in items[:k]]
+        bottom = [tid for tid, _ in items[-k:]] if n > 1 else []
+        return top, bottom
+
+    def _explore(self, config: dict) -> dict:
+        from ray_tpu.tune.search import Domain
+        out = dict(config)
+        for k, spec in self.mutations.items():
+            if self.rng.random() < self.resample_p or k not in out:
+                if isinstance(spec, Domain):
+                    out[k] = spec.sample(self.rng)
+                elif isinstance(spec, list):
+                    out[k] = self.rng.choice(spec)
+                elif callable(spec):
+                    out[k] = spec()
+            else:
+                cur = out[k]
+                if isinstance(cur, (int, float)):
+                    out[k] = cur * self.rng.choice([0.8, 1.2])
+        return out
+
+    def on_result(self, trial, result) -> str:
+        v = result.get(self.metric)
+        t = result.get("training_iteration", 0)
+        if v is None:
+            return CONTINUE
+        self._scores[trial.trial_id] = float(v)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last >= self.interval and len(self._scores) > 1:
+            self._last_perturb[trial.trial_id] = t
+            top, bottom = self._quantiles()
+            if trial.trial_id in bottom and top:
+                src = self.rng.choice(
+                    [tid for tid in top if tid != trial.trial_id] or top)
+                new_cfg = self._explore(trial.config)
+                self.pending_exploits[trial.trial_id] = (src, new_cfg)
+        return CONTINUE
+
+    def on_complete(self, trial, result):
+        self._scores.pop(trial.trial_id, None)

@@ -1,0 +1,202 @@
+"""Search spaces + searchers.
+
+Reference capability: tune.search (python/ray/tune/search/ —
+basic_variant.py grid/random, ConcurrencyLimiter) and the sample-space
+API (tune/search/sample.py).  External-library searchers (hyperopt,
+optuna, …) are out of scope by design: the built-in generator covers
+grid/random, and the Searcher interface below is the plug point.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional
+
+
+# -- sample spaces ---------------------------------------------------------
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+@dataclass
+class Choice(Domain):
+    values: list
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class GridSearch:
+    values: list
+
+
+def choice(values) -> Choice:
+    return Choice(list(values))
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(list(values))
+
+
+# -- searchers -------------------------------------------------------------
+
+class Searcher:
+    """Plug point for search algorithms (reference: tune/search/searcher.py).
+
+    suggest(trial_id) -> config dict or None (exhausted);
+    on_trial_complete(trial_id, result) feeds outcomes back.
+    """
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product × num_samples random draws
+    (reference: tune/search/basic_variant.py)."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._variants = self._expand()
+        self._idx = 0
+
+    def _expand(self) -> list[dict]:
+        grid_keys, grid_vals = [], []
+
+        def collect(prefix, space):
+            for k, v in space.items():
+                key = (*prefix, k)
+                if isinstance(v, GridSearch):
+                    grid_keys.append(key)
+                    grid_vals.append(v.values)
+                elif isinstance(v, dict):
+                    collect(key, v)
+
+        collect((), self.param_space)
+        combos = list(itertools.product(*grid_vals)) if grid_keys else [()]
+
+        out = []
+        for _ in range(self.num_samples):
+            for combo in combos:
+                grid_assign = dict(zip(grid_keys, combo))
+                out.append(self._materialize((), self.param_space,
+                                             grid_assign))
+        return out
+
+    def _materialize(self, prefix, space, grid_assign) -> dict:
+        cfg = {}
+        for k, v in space.items():
+            key = (*prefix, k)
+            if isinstance(v, GridSearch):
+                cfg[k] = grid_assign[key]
+            elif isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            elif isinstance(v, dict):
+                cfg[k] = self._materialize(key, v, grid_assign)
+            elif callable(v) and not isinstance(v, type):
+                cfg[k] = v()          # tune.sample_from-style lambda
+            else:
+                cfg[k] = v
+        return cfg
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._idx >= len(self._variants):
+            return None
+        cfg = self._variants[self._idx]
+        self._idx += 1
+        return cfg
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+
+class ConcurrencyLimiter(Searcher):
+    """(reference: tune/search/concurrency_limiter.py) — caps in-flight
+    suggestions; the trial runner also enforces max_concurrent_trials,
+    this exists for API parity when wrapping custom searchers."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return "PENDING"
+        cfg = self.searcher.suggest(trial_id)
+        if isinstance(cfg, dict):
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)
+
+
+def resolve_config(space_or_cfg: dict, rng: Optional[random.Random] = None):
+    """Sample every Domain in a (possibly nested) dict — used by PBT
+    explore and one-off config materialization."""
+    rng = rng or random.Random()
+    out = {}
+    for k, v in space_or_cfg.items():
+        if isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, GridSearch):
+            out[k] = rng.choice(v.values)
+        elif isinstance(v, dict):
+            out[k] = resolve_config(v, rng)
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,126 @@
+"""Trainable API (reference: tune/trainable/trainable.py:66 — train/step/
+save/restore — and function_trainable.py wrap_function).
+
+A Trainable is a stepwise training process the scheduler can stop,
+checkpoint, and clone (PBT exploit).  Function trainables run their
+function one "virtual step" per reported result via a generator bridge —
+no thread, matching the single-controller design of the runtime.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Optional
+
+
+class Trainable:
+    """Subclass API: setup(config), step() -> result dict,
+    save_checkpoint() -> dict, load_checkpoint(dict)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = config or {}
+        self._iteration = 0
+        self.setup(self.config)
+
+    # -- subclass hooks ----------------------------------------------------
+
+    def setup(self, config: dict):
+        pass
+
+    def step(self) -> dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> dict:
+        return {}
+
+    def load_checkpoint(self, checkpoint: dict):
+        pass
+
+    def reset_config(self, new_config: dict) -> bool:
+        """PBT explore hook; return True if handled without restart."""
+        return False
+
+    def cleanup(self):
+        pass
+
+    # -- runner-facing API (reference: trainable.py train:321/save:450) ----
+
+    def train(self) -> dict:
+        result = self.step()
+        self._iteration += 1
+        result.setdefault("training_iteration", self._iteration)
+        return result
+
+    def save(self) -> dict:
+        return {"_iteration": self._iteration,
+                "payload": self.save_checkpoint()}
+
+    def restore(self, saved: dict):
+        self._iteration = saved.get("_iteration", 0)
+        self.load_checkpoint(saved.get("payload", {}))
+
+    @property
+    def iteration(self) -> int:
+        return self._iteration
+
+
+class FunctionTrainable(Trainable):
+    """Wraps ``def train_fn(config)`` that calls ``tune.report(...)``.
+
+    The function runs as a generator: each ``report`` yields one result
+    to the runner (reference: function_trainable.py — which uses a
+    thread + queue; a generator keeps it deterministic and 1-process).
+    """
+
+    _fn: Callable = None  # set by wrap_function subclass
+
+    def setup(self, config):
+        self._gen = None          # created lazily so restore() can precede
+        self._bridge = None
+        self._done = False
+        self._restore_payload = None
+
+    def _ensure_gen(self):
+        if self._gen is None:
+            from ray_tpu.tune import _report_bridge
+            self._bridge = _report_bridge.Bridge()
+            self._bridge.restore_payload = self._restore_payload
+            self._gen = self._bridge.drive(self._fn, self.config)
+
+    def step(self) -> dict:
+        if self._done:
+            return {**getattr(self, "_last_metrics", {}), "done": True}
+        self._ensure_gen()
+        try:
+            result = next(self._gen)
+            self._last_metrics = dict(result)
+            return dict(result)
+        except StopIteration:
+            self._done = True
+            # final "done" result carries the last reported metrics so
+            # get_best_result sees them (reference: tune marks the last
+            # result with done=True rather than emitting an empty one)
+            return {**getattr(self, "_last_metrics", {}), "done": True}
+
+    def save_checkpoint(self) -> dict:
+        # function trainables checkpoint through tune.report(checkpoint=)
+        if self._bridge is not None and self._bridge.latest_checkpoint:
+            return self._bridge.latest_checkpoint
+        return {}
+
+    def load_checkpoint(self, checkpoint):
+        self._restore_payload = checkpoint
+
+    def cleanup(self):
+        if self._bridge is not None:
+            self._bridge.stop()
+
+
+def wrap_function(fn: Callable) -> type:
+    """Make a Trainable class from a function (reference:
+    function_trainable.py wrap_function)."""
+    return type(f"func_{getattr(fn, '__name__', 'trainable')}",
+                (FunctionTrainable,), {"_fn": staticmethod(fn)})

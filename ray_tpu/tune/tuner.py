@@ -1,0 +1,226 @@
+"""Tuner + trial-runner event loop.
+
+Reference capability: tune.Tuner.fit (tuner.py:315) → tune.run
+(tune.py:175) → TrialRunner.step (execution/trial_runner.py:272,938) with
+RayTrialExecutor running each trial as a remote Trainable actor.
+
+Execution here has two modes:
+  * in-process (default): trials step round-robin in the driver — the
+    right shape for a single TPU host where trials time-share the chip
+    and actor hops would only add pickling;
+  * actor mode (``use_actors=True``): each trial is a core-runtime actor
+    (ray_tpu.core), giving process isolation and CPU parallelism — the
+    analogue of the reference executor, riding our own public actor API
+    exactly as the reference rides ray core (SURVEY.md layer rule L7).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Union
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.result import Result
+from ray_tpu.tune import schedulers as _sched
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
+                                     TrialScheduler)
+from ray_tpu.tune.trainable import Trainable, wrap_function
+
+
+@dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    use_actors: bool = False
+    seed: Optional[int] = None
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: dict
+    status: str = "PENDING"      # PENDING/RUNNING/TERMINATED/ERROR
+    last_result: dict = field(default_factory=dict)
+    history: list = field(default_factory=list)
+    error: Optional[str] = None
+    runner: Any = None           # Trainable or actor handle
+    checkpoint: Optional[dict] = None
+
+    @property
+    def iterations(self) -> int:
+        return self.last_result.get("training_iteration", 0)
+
+
+class ResultGrid:
+    """(reference: tune/result_grid.py)"""
+
+    def __init__(self, trials: list[Trial], metric: str, mode: str,
+                 path: str):
+        self.trials = trials
+        self.metric, self.mode = metric, mode
+        self.path = path
+
+    def __len__(self):
+        return len(self.trials)
+
+    def __getitem__(self, i) -> Result:
+        t = self.trials[i]
+        return Result(metrics=t.last_result, path=self.path,
+                      metrics_history=t.history,
+                      error=RuntimeError(t.error) if t.error else None)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self.metric
+        mode = mode or self.mode
+        scored = [t for t in self.trials if metric in t.last_result]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        best = (min if mode == "min" else max)(
+            scored, key=lambda t: t.last_result[metric])
+        return Result(metrics=best.last_result, path=self.path,
+                      metrics_history=best.history)
+
+    @property
+    def errors(self):
+        return [t.error for t in self.trials if t.error]
+
+
+class _ActorTrialShim:
+    """Runs a Trainable inside a core-runtime actor."""
+
+    def __init__(self, trainable_cls_bytes: bytes, config: dict):
+        cls = pickle.loads(trainable_cls_bytes)
+        self._t = cls(config)
+
+    def train(self):
+        return self._t.train()
+
+    def save(self):
+        return self._t.save()
+
+    def restore(self, saved):
+        return self._t.restore(saved)
+
+    def cleanup(self):
+        self._t.cleanup()
+
+
+class Tuner:
+    """(reference: tune/tuner.py Tuner.fit:315)"""
+
+    def __init__(self, trainable: Union[Callable, type],
+                 *, param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig(name="tune")
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self.trainable_cls = trainable
+        elif callable(trainable):
+            self.trainable_cls = wrap_function(trainable)
+        else:
+            raise TypeError("trainable must be a function or Trainable")
+        self.param_space = param_space or {}
+
+    # -- executor helpers --------------------------------------------------
+
+    def _make_runner(self, trial: Trial):
+        if self.tune_config.use_actors:
+            import cloudpickle
+            import ray_tpu
+            cls_bytes = cloudpickle.dumps(self.trainable_cls)
+            Actor = ray_tpu.remote(_ActorTrialShim)
+            trial.runner = Actor.remote(cls_bytes, trial.config)
+            trial._is_actor = True
+        else:
+            trial.runner = self.trainable_cls(trial.config)
+            trial._is_actor = False
+        if trial.checkpoint is not None:
+            self._runner_call(trial, "restore", trial.checkpoint)
+
+    def _runner_call(self, trial: Trial, method: str, *args):
+        if getattr(trial, "_is_actor", False):
+            import ray_tpu
+            return ray_tpu.get(
+                getattr(trial.runner, method).remote(*args), timeout=600)
+        return getattr(trial.runner, method)(*args)
+
+    # -- the event loop ----------------------------------------------------
+
+    def fit(self) -> ResultGrid:
+        tc = self.tune_config
+        run_dir = self.run_config.resolved_storage_path()
+        os.makedirs(run_dir, exist_ok=True)
+
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples, seed=tc.seed)
+        scheduler = tc.scheduler or FIFOScheduler()
+
+        trials: list[Trial] = []
+        n = 0
+        while True:
+            cfg = searcher.suggest(f"trial_{n:05d}")
+            if cfg is None or cfg == "PENDING":
+                break
+            trials.append(Trial(trial_id=f"trial_{n:05d}", config=cfg))
+            n += 1
+
+        live: list[Trial] = []
+        pending = list(trials)
+        max_live = tc.max_concurrent_trials or len(trials)
+
+        # round-robin stepping (reference TrialRunner.step:938 analogue)
+        while pending or live:
+            while pending and len(live) < max_live:
+                t = pending.pop(0)
+                try:
+                    self._make_runner(t)
+                    t.status = "RUNNING"
+                    live.append(t)
+                except Exception:
+                    t.status = "ERROR"
+                    t.error = traceback.format_exc()
+                    scheduler.on_complete(t, None)
+            for t in list(live):
+                try:
+                    result = self._runner_call(t, "train")
+                except Exception:
+                    t.status = "ERROR"
+                    t.error = traceback.format_exc()
+                    live.remove(t)
+                    scheduler.on_complete(t, None)
+                    searcher.on_trial_complete(t.trial_id, None)
+                    continue
+                t.last_result = result
+                t.history.append(result)
+                done = result.get("done", False)
+                decision = scheduler.on_result(t, result)
+                # PBT exploit: clone src weights + new config
+                exploits = getattr(scheduler, "pending_exploits", None)
+                if exploits and t.trial_id in exploits:
+                    src_id, new_cfg = exploits.pop(t.trial_id)
+                    src = next(x for x in trials if x.trial_id == src_id)
+                    if src.runner is not None:
+                        saved = self._runner_call(src, "save")
+                        t.config = new_cfg
+                        self._runner_call(t, "cleanup")
+                        t.checkpoint = saved
+                        self._make_runner(t)
+                if done or decision == STOP:
+                    t.status = "TERMINATED"
+                    live.remove(t)
+                    self._runner_call(t, "cleanup")
+                    scheduler.on_complete(t, t.last_result)
+                    searcher.on_trial_complete(t.trial_id, t.last_result)
+        return ResultGrid(trials, tc.metric, tc.mode, run_dir)

@@ -6,12 +6,20 @@ python/ray/tests/conftest.py:375) — real TPU hardware is not required.
 """
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU platform.  NOTE: in some environments jax is pre-imported
+# by a sitecustomize hook with the platform pinned via env, so setting
+# JAX_PLATFORMS here is not enough — config.update after import is the
+# reliable override.  XLA_FLAGS must still be set before the CPU backend
+# initializes (first jax.devices() call), which this import-time hook is.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,112 @@
+"""Data layer tests (reference analogue: python/ray/data/tests —
+test_dataset.py map/filter/shuffle/split, preprocessor tests)."""
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+from ray_tpu.data import (BatchMapper, Chain, Concatenator, LabelEncoder,
+                          StandardScaler)
+
+
+def test_range_count_take():
+    ds = rd.range(100, parallelism=7)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_from_items_rows():
+    ds = rd.from_items([{"a": i, "b": i * 2} for i in range(10)])
+    assert ds.count() == 10
+    assert ds.take(1)[0]["b"] == 0
+    assert set(ds.schema().keys()) == {"a", "b"}
+
+
+def test_map_batches_and_filter():
+    ds = (rd.range(50)
+          .map_batches(lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+          .filter(lambda r: r["sq"] % 2 == 0))
+    rows = ds.take_all()
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+    assert all(r["sq"] % 2 == 0 for r in rows)
+
+
+def test_repartition_shuffle_sort():
+    ds = rd.range(40, parallelism=4).repartition(8)
+    assert ds.stats()["num_blocks"] == 8
+    sh = rd.range(40).random_shuffle(seed=0)
+    ids = [r["id"] for r in sh.take_all()]
+    assert sorted(ids) == list(range(40))
+    assert ids != list(range(40))
+    st = sh.sort("id")
+    assert [r["id"] for r in st.take(3)] == [0, 1, 2]
+
+
+def test_split_even():
+    parts = rd.range(10).split(3)
+    counts = [p.count() for p in parts]
+    assert sum(counts) == 10
+    assert counts[:2] == [3, 3]
+
+
+def test_iter_batches_sizes():
+    ds = rd.range(25, parallelism=3)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=10)]
+    assert sizes == [10, 10, 5]
+    sizes = [len(b["id"])
+             for b in ds.iter_batches(batch_size=10, drop_last=True)]
+    assert sizes == [10, 10]
+
+
+def test_iter_batches_sharded_mesh():
+    import jax
+    from ray_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh({"dp": 4}, devices=jax.devices("cpu")[:4])
+    ds = rd.from_numpy({"x": np.arange(64, dtype=np.float32)})
+    batches = list(ds.iter_batches_sharded(mesh, batch_size=16))
+    assert len(batches) == 4
+    x = batches[0]["x"]
+    assert isinstance(x, jax.Array)
+    assert x.sharding.num_devices == 4
+
+
+def test_csv_parquet_roundtrip(tmp_path):
+    import pandas as pd
+    p = tmp_path / "t.csv"
+    pd.DataFrame({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0]}).to_csv(
+        p, index=False)
+    ds = rd.read_csv(str(p))
+    assert ds.count() == 3
+    paths = ds.write_parquet(str(tmp_path / "pq"))
+    ds2 = rd.read_parquet(paths)
+    assert ds2.count() == 3
+    assert ds2.take(1)[0]["b"] == 4.0
+
+
+def test_preprocessors():
+    ds = rd.from_numpy({"x": np.arange(10, dtype=np.float64),
+                        "label": np.array(list("abbaabbaba"))})
+    sc = StandardScaler(["x"])
+    out = sc.fit_transform(ds)
+    xs = np.array([r["x"] for r in out.take_all()])
+    assert abs(xs.mean()) < 1e-9
+    le = LabelEncoder("label")
+    enc = le.fit_transform(ds)
+    labs = {r["label"] for r in enc.take_all()}
+    assert labs == {0, 1}
+
+
+def test_chain_and_concatenator():
+    ds = rd.from_numpy({"x": np.arange(8, dtype=np.float64),
+                        "y": np.arange(8, dtype=np.float64) * 3})
+    chain = Chain(StandardScaler(["x", "y"]),
+                  Concatenator(["x", "y"], "features"))
+    out = chain.fit_transform(ds)
+    row = out.take(1)[0]
+    assert row["features"].shape == (2,)
+
+
+def test_map_batches_as_tasks(rt_init):
+    ds = rd.range(20, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] + 1})
+    assert ds.materialize(parallelism="tasks").count() == 20

@@ -103,3 +103,47 @@ def test_ring_attention_grad(cpu_mesh_devices):
     for a, b_ in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    **TOL)
+
+
+def test_flash_cross_length_causal():
+    """Decode-with-kv-cache shape: q shorter than kv, causal offset must
+    match mha_reference's (k_len - q_len) convention."""
+    import jax, jax.numpy as jnp
+    from ray_tpu.ops.attention import mha_reference
+    from ray_tpu.ops.flash_attention import flash_attention
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (1, 2, 64, 64))
+    k = jax.random.normal(k2, (1, 2, 128, 64))
+    v = jax.random.normal(k3, (1, 2, 128, 64))
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    ref = mha_reference(q, k, v, causal=True)
+    import numpy as np
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_ragged_kv_blocks():
+    """kv_len not a multiple of block_k: the clamped last block must not
+    double-count keys."""
+    import jax, jax.numpy as jnp, numpy as np
+    from ray_tpu.ops.attention import mha_reference
+    from ray_tpu.ops.flash_attention import flash_attention
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(k1, (1, 1, 96, 64))
+    k = jax.random.normal(k2, (1, 1, 96, 64))
+    v = jax.random.normal(k3, (1, 1, 96, 64))
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal,
+                              block_q=32, block_k=64)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_attention_mask_flash_raises():
+    import jax, jax.numpy as jnp, pytest as _pytest
+    from ray_tpu.ops.attention import attention
+    q = jnp.zeros((1, 1, 8, 16))
+    mask = jnp.ones((1, 1, 8, 8), bool)
+    with _pytest.raises(ValueError):
+        attention(q, q, q, mask=mask, impl="flash")

@@ -1,0 +1,149 @@
+"""Train layer tests (reference test-strategy analogue:
+python/ray/train/tests/test_backend.py, test_torch_trainer.py — small
+worker counts on CPU devices; SURVEY.md §4.5)."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import gpt, mlp
+from ray_tpu.train import (Checkpoint, CheckpointManager, JaxTrainer,
+                           DataParallelTrainer, RunConfig, ScalingConfig,
+                           TrainingFailedError, session)
+from ray_tpu.train.config import CheckpointConfig, FailureConfig
+from ray_tpu.train.step import make_train_step, shard_batch
+from ray_tpu.parallel.mesh import create_mesh
+from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES
+
+
+def _batches(cfg, batch=4, seq=32, seed=0):
+    # one fixed batch repeated — loss must then decrease monotonically
+    rng = np.random.default_rng(seed)
+    b = {"tokens": jnp.asarray(rng.integers(
+        0, cfg.vocab_size, (batch, seq + 1)), jnp.int32)}
+    while True:
+        yield b
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    data = {"params": {"w": np.arange(6.0).reshape(2, 3)}, "step": 7}
+    ck = Checkpoint.from_dict(data, str(tmp_path / "ck"))
+    out = ck.to_dict()
+    assert out["step"] == 7
+    np.testing.assert_array_equal(out["params"]["w"], data["params"]["w"])
+
+
+def test_checkpoint_manager_keep_n(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), num_to_keep=2)
+    for i in range(4):
+        mgr.save({"i": i})
+    mgr.flush()
+    assert mgr.latest().to_dict()["i"] == 3
+    kept = sorted(os.listdir(tmp_path))
+    assert len(kept) == 2
+
+
+def test_data_parallel_trainer_session(tmp_path):
+    seen = []
+
+    def loop(config):
+        assert session.get_world_rank() == 0
+        for i in range(3):
+            session.report({"i": i})
+        seen.append(config["lr"])
+
+    t = DataParallelTrainer(
+        loop, train_loop_config={"lr": 0.1},
+        scaling_config=ScalingConfig(mesh={"dp": 4}, use_cpu_devices=True),
+        run_config=RunConfig(name="t1", storage_path=str(tmp_path)))
+    res = t.fit()
+    assert seen == [0.1]
+    assert res.metrics["i"] == 2
+    assert len(res.metrics_history) == 3
+
+
+def test_trainer_restart_ft(tmp_path):
+    """Worker failure → restart from latest checkpoint
+    (reference capability: backend_executor.py:571 _restart)."""
+    attempts = []
+
+    def loop(config):
+        attempts.append(1)
+        restored = session.get_checkpoint()
+        start = restored.to_dict()["step"] if restored else 0
+        for i in range(start, 4):
+            session.report({"step": i}, checkpoint={"step": i + 1})
+            if i == 1 and len(attempts) == 1:
+                raise RuntimeError("simulated worker death")
+
+    t = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(mesh={"dp": 2}, use_cpu_devices=True),
+        run_config=RunConfig(name="ft", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)))
+    res = t.fit()
+    assert len(attempts) == 2
+    assert res.metrics["step"] == 3
+    # second attempt resumed from step 2, not 0
+    steps_attempt2 = [m["step"] for m in res.metrics_history[2:]]
+    assert steps_attempt2[0] == 2
+
+
+def test_trainer_failure_exhausted(tmp_path):
+    def loop(config):
+        raise RuntimeError("always dies")
+
+    t = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(mesh={"dp": 2}, use_cpu_devices=True),
+        run_config=RunConfig(name="dead", storage_path=str(tmp_path)))
+    with pytest.raises(TrainingFailedError):
+        t.fit()
+
+
+def test_jax_trainer_gpt_dp(tmp_path):
+    """GPT on a dp×tp mesh end to end with checkpointing (M4 exit test,
+    scaled to the CPU mesh)."""
+    cfg = gpt.GPTConfig.tiny()
+    tr = JaxTrainer(
+        loss_fn=lambda p, b, mesh=None, rules=None: gpt.loss_fn(
+            p, b, cfg, mesh=mesh, rules=rules),
+        init_params=lambda rng: gpt.init_params(cfg, rng),
+        optimizer=optax.adam(1e-2),
+        train_data=_batches(cfg),
+        num_steps=6,
+        params_logical=gpt.param_logical_axes(cfg),
+        report_every=2, checkpoint_every=3,
+        scaling_config=ScalingConfig(mesh={"dp": 2, "tp": 2, "fsdp": 2},
+                                     use_cpu_devices=True),
+        run_config=RunConfig(name="gpt_dp", storage_path=str(tmp_path)))
+    res = tr.fit()
+    assert res.metrics["step"] == 6
+    hist = [m["loss"] for m in res.metrics_history]
+    assert hist[-1] < hist[0]
+    assert res.checkpoint is not None
+    payload = res.checkpoint.to_dict()
+    assert payload["step"] == 6
+
+
+def test_sharded_state_layout():
+    """Params land sharded per rules: wqkv last dim over tp."""
+    cfg = gpt.GPTConfig.tiny()
+    mesh = create_mesh({"dp": 2, "tp": 4}, devices=jax.devices("cpu"))
+    init_fn, _ = make_train_step(
+        lambda p, b: gpt.loss_fn(p, b, cfg, mesh=mesh),
+        optax.adam(1e-3), mesh=mesh,
+        params_logical=gpt.param_logical_axes(cfg))
+    state = init_fn(gpt.init_params(cfg, jax.random.PRNGKey(0)))
+    wqkv = state.params["layers"]["wqkv"]
+    spec = wqkv.sharding.spec
+    assert spec[-1] == "tp"
+    # adam m mirrors the param sharding
+    m_leaf = jax.tree.leaves(
+        state.opt_state, is_leaf=lambda x: isinstance(x, jax.Array))
+    assert any(getattr(x, "sharding", None) == wqkv.sharding
+               for x in m_leaf if hasattr(x, "shape")
+               and x.shape == wqkv.shape)

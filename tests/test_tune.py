@@ -1,0 +1,161 @@
+"""Tune tests (reference test-strategy analogue: python/ray/tune/tests —
+trial runner, searchers, schedulers on toy objective functions)."""
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune import (ASHAScheduler, PopulationBasedTraining, TuneConfig,
+                          Tuner)
+
+
+def _objective(config):
+    # quadratic bowl: best at x = 3
+    for i in range(5):
+        loss = (config["x"] - 3.0) ** 2 + 0.1 * i
+        tune.report({"loss": loss})
+
+
+def test_grid_search(tmp_path):
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert abs(best.metrics["loss"] - 0.4) < 1e-6  # x=3 after 5 steps
+
+
+def test_random_search_num_samples(tmp_path):
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.uniform(-5, 5)},
+        tune_config=TuneConfig(num_samples=6, seed=0),
+        run_config=RunConfig(name="rand", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 6
+    xs = {t.config["x"] for t in grid.trials}
+    assert len(xs) == 6  # all distinct draws
+
+
+def test_class_trainable(tmp_path):
+    class Quad(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.val = 10.0
+
+        def step(self):
+            self.val *= 0.5
+            return {"loss": self.val, "done": self.val < 1.0}
+
+        def save_checkpoint(self):
+            return {"val": self.val}
+
+        def load_checkpoint(self, ck):
+            self.val = ck["val"]
+
+    tuner = Tuner(Quad, param_space={"x": 1.0},
+                  run_config=RunConfig(name="cls", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert grid[0].metrics["loss"] < 1.0
+    assert grid[0].metrics["training_iteration"] == 4
+
+
+def test_asha_stops_bad_trials(tmp_path):
+    def slow_objective(config):
+        for i in range(20):
+            tune.report({"loss": config["x"] + 100.0 / (i + 1)})
+
+    sched = ASHAScheduler(metric="loss", mode="min", max_t=20,
+                          grace_period=2, reduction_factor=2)
+    tuner = Tuner(
+        slow_objective,
+        param_space={"x": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+        tune_config=TuneConfig(scheduler=sched),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    iters = sorted(t.iterations for t in grid.trials)
+    # at least one trial cut before max_t, the best one ran to the end
+    assert iters[0] < 20
+    assert iters[-1] == 20
+
+
+def test_pbt_exploits(tmp_path):
+    class Walker(tune.Trainable):
+        def setup(self, config):
+            self.lr = config["lr"]
+            self.score = 0.0
+
+        def step(self):
+            # good lr climbs fast
+            self.score += self.lr
+            return {"score": self.score}
+
+        def save_checkpoint(self):
+            return {"score": self.score, "lr": self.lr}
+
+        def load_checkpoint(self, ck):
+            self.score = ck["score"]
+
+        def reset_config(self, cfg):
+            self.lr = cfg["lr"]
+            return True
+
+    sched = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=1)
+    tuner = Tuner(
+        Walker,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=sched),
+        run_config=RunConfig(name="pbt", storage_path=str(tmp_path)))
+
+    # drive manually for a bounded number of steps via ASHA-style max:
+    # simpler — wrap objective count inside trainable: run 8 iterations
+    class Walker8(Walker):
+        def step(self):
+            r = super().step()
+            r["done"] = self._iteration >= 7
+            return r
+
+    tuner.trainable_cls = Walker8
+    grid = tuner.fit()
+    scores = [t.last_result["score"] for t in grid.trials]
+    # the weak trial was lifted by exploiting the strong one's weights
+    assert min(scores) > 0.08 * 8
+
+
+def test_function_trainable_checkpoint_restore(tmp_path):
+    def fn(config):
+        ck = tune.get_checkpoint()
+        start = ck["i"] if ck else 0
+        for i in range(start, 3):
+            tune.report({"i": i}, checkpoint={"i": i + 1})
+
+    cls = tune.wrap_function(fn)
+    t = cls({})
+    r1 = t.train()
+    assert r1["i"] == 0
+    saved = t.save()
+    t2 = cls({})
+    t2.restore(saved)
+    out = [t2.train()["i"] for _ in range(2)]
+    assert out == [1, 2]
+
+
+def test_actor_mode(tmp_path):
+    import ray_tpu
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        tuner = Tuner(
+            _objective,
+            param_space={"x": tune.grid_search([1.0, 3.0])},
+            tune_config=TuneConfig(use_actors=True,
+                                   max_concurrent_trials=2),
+            run_config=RunConfig(name="act", storage_path=str(tmp_path)))
+        grid = tuner.fit()
+        assert len(grid) == 2
+        assert not grid.errors
+    finally:
+        ray_tpu.shutdown()
