@@ -126,6 +126,11 @@ class ActorClass:
             placement_group=_pg_tuple(o))
         return ActorHandle(actor_id, methods, self._cls.__name__)
 
+    def bind(self, *args, **kwargs):
+        """Lazy actor-DAG node (reference: ray DAG ClassNode .bind)."""
+        from ray_tpu.dag.dag_node import ClassNode
+        return ClassNode(self._cls, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor class '{self._cls.__name__}' cannot be instantiated "

@@ -88,6 +88,12 @@ class RemoteFunction:
                               rt.client.config_dict["task_max_retries"]),
             placement_group=_pg_tuple(o))
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: ray DAG .bind, dag/dag_node.py)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+        return FunctionNode(self._function, args, kwargs,
+                            options=self._options)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Remote function '{self._function.__qualname__}' cannot be "

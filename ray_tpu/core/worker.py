@@ -14,6 +14,16 @@ import sys
 
 
 def main() -> None:
+    # Workers must not touch the TPU (the driver owns it) — and the
+    # JAX_PLATFORMS env the spawner sets is not enough on hosts whose
+    # sitecustomize pre-imports jax with a platform plugin pinned, so
+    # force the CPU platform via config before any backend initializes.
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--address", required=True)
     parser.add_argument("--session", required=True)

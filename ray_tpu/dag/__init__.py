@@ -1,0 +1,14 @@
+"""ray_tpu.dag: lazy task/actor DAGs.
+
+Reference capability: python/ray/dag/ (DAGNode dag_node.py:23,
+FunctionNode/ClassNode/InputNode, dag.execute()) — the base layer for
+Serve graphs and Workflow.  ``fn.bind(*args)`` builds nodes; execute()
+topologically evaluates, submitting bound remote functions through the
+core runtime when it is initialized (else inline).
+"""
+
+from ray_tpu.dag.dag_node import (ClassNode, DAGNode, FunctionNode,
+                                  InputNode, MultiOutputNode)
+
+__all__ = ["DAGNode", "FunctionNode", "ClassNode", "InputNode",
+           "MultiOutputNode"]

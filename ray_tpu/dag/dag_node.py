@@ -1,0 +1,179 @@
+"""DAG nodes (reference: python/ray/dag/dag_node.py:23 DAGNode,
+function_node.py, input_node.py; executed bottom-up like
+dag.execute())."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional
+
+_ids = itertools.count()
+
+
+class DAGNode:
+    """A lazily-bound computation node."""
+
+    def __init__(self, args: tuple = (), kwargs: Optional[dict] = None):
+        self._bound_args = args
+        self._bound_kwargs = kwargs or {}
+        self._id = next(_ids)
+
+    # -- graph traversal ---------------------------------------------------
+
+    def _children(self):
+        for a in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                yield a
+
+    def _apply_recursive(self, fn, memo: dict):
+        if self._id in memo:
+            return memo[self._id]
+        args = tuple(a._apply_recursive(fn, memo) if isinstance(a, DAGNode)
+                     else a for a in self._bound_args)
+        kwargs = {k: (v._apply_recursive(fn, memo) if isinstance(v, DAGNode)
+                      else v) for k, v in self._bound_kwargs.items()}
+        out = fn(self, args, kwargs)
+        memo[self._id] = out
+        return out
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, *input_args, _resolve: bool = True, **input_kwargs):
+        """Evaluate the DAG (reference: dag_node.py execute).  Uses the
+        core runtime for FunctionNodes when initialized; ObjectRefs flow
+        between nodes so the scheduler sees real dependencies."""
+        import ray_tpu
+        use_runtime = ray_tpu.is_initialized()
+        memo: dict = {}
+
+        def run(node, args, kwargs):
+            return node._execute_impl(args, kwargs, input_args,
+                                      input_kwargs, use_runtime)
+
+        out = self._apply_recursive(run, memo)
+        if _resolve and use_runtime:
+            from ray_tpu.core.object_ref import ObjectRef
+
+            def resolve(x):
+                if isinstance(x, ObjectRef):
+                    return ray_tpu.get(x, timeout=300)
+                if isinstance(x, (list, tuple)):
+                    return type(x)(resolve(v) for v in x)
+                return x
+
+            out = resolve(out)
+        return out
+
+    def _execute_impl(self, args, kwargs, input_args, input_kwargs,
+                      use_runtime):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time input (reference: input_node.py).
+    Supports `with InputNode() as x:` for API parity."""
+
+    def __init__(self, index: int = 0):
+        super().__init__()
+        self.index = index
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def _execute_impl(self, args, kwargs, input_args, input_kwargs,
+                      use_runtime):
+        return input_args[self.index]
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, fn: Callable, args, kwargs,
+                 options: Optional[dict] = None):
+        super().__init__(args, kwargs)
+        self._fn = fn
+        self._options = options or {}
+
+    def _execute_impl(self, args, kwargs, input_args, input_kwargs,
+                      use_runtime):
+        if use_runtime:
+            import ray_tpu
+            rf = ray_tpu.remote(self._fn)
+            if self._options:
+                rf = rf.options(**self._options)
+            return rf.remote(*args, **kwargs)
+        # inline: resolve nothing, just call
+
+        def deref(x):
+            return x
+
+        return self._fn(*[deref(a) for a in args],
+                        **{k: deref(v) for k, v in kwargs.items()})
+
+
+class ClassNode(DAGNode):
+    """A bound actor-constructor node; method .bind on its result gives
+    ClassMethodNodes (reference: class_node.py)."""
+
+    def __init__(self, cls: type, args, kwargs):
+        super().__init__(args, kwargs)
+        self._cls = cls
+        self._instance = None
+
+    def _execute_impl(self, args, kwargs, input_args, input_kwargs,
+                      use_runtime):
+        if self._instance is None:
+            if use_runtime:
+                import ray_tpu
+                self._instance = ray_tpu.remote(self._cls).remote(
+                    *args, **kwargs)
+            else:
+                self._instance = self._cls(*args, **kwargs)
+        return self._instance
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClassMethodBinder(self, name)
+
+
+class _ClassMethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._class_node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method: str, args, kwargs):
+        super().__init__((class_node, *args), kwargs)
+        self._method = method
+
+    def _execute_impl(self, args, kwargs, input_args, input_kwargs,
+                      use_runtime):
+        instance, *rest = args
+        if use_runtime:
+            return getattr(instance, self._method).remote(*rest, **kwargs)
+        return getattr(instance, self._method)(*rest, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several leaves (reference: output_node.py)."""
+
+    def __init__(self, outputs: list):
+        super().__init__(tuple(outputs))
+
+    def _execute_impl(self, args, kwargs, input_args, input_kwargs,
+                      use_runtime):
+        return list(args)
+
+
+def bind_function(fn: Callable, *args, _options=None, **kwargs):
+    return FunctionNode(fn, args, kwargs, options=_options)
+
+
+def bind_class(cls: type, *args, **kwargs) -> ClassNode:
+    return ClassNode(cls, args, kwargs)

@@ -1,0 +1,18 @@
+"""ray_tpu.rllib: reinforcement learning (reference capability: rllib/ —
+SURVEY.md §2.4; §7 M6: CPU rollout actors + compiled TPU learner)."""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rllib.env import CartPole, VectorEnv, make_env
+from ray_tpu.rllib.impala import Impala, ImpalaConfig, vtrace
+from ray_tpu.rllib.policy import (JaxPolicy, PolicyConfig, compute_gae,
+                                  init_policy_params, policy_forward)
+from ray_tpu.rllib.ppo import PPO, PPOConfig, ppo_loss
+from ray_tpu.rllib.rollout_worker import RolloutWorker
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "WorkerSet", "CartPole", "VectorEnv",
+    "make_env", "Impala", "ImpalaConfig", "vtrace", "JaxPolicy",
+    "PolicyConfig", "compute_gae", "init_policy_params", "policy_forward",
+    "PPO", "PPOConfig", "ppo_loss", "RolloutWorker", "SampleBatch",
+]

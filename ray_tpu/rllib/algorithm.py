@@ -1,0 +1,179 @@
+"""Algorithm base + config.
+
+Reference capability: rllib/algorithms/algorithm.py:150 Algorithm
+(a Tune Trainable; step:744, training_step:1322) and AlgorithmConfig.
+Same shape here: Algorithm extends ray_tpu.tune.Trainable so every
+algorithm tunes/checkpoints through the same machinery, and
+``training_step`` is the override point.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+
+from ray_tpu.tune.trainable import Trainable
+
+
+@dataclass
+class AlgorithmConfig:
+    env: Union[str, Callable] = "CartPole-v1"
+    num_rollout_workers: int = 0     # 0 = sample inline in the driver
+    num_envs_per_worker: int = 4
+    rollout_length: int = 64
+    gamma: float = 0.99
+    lam: float = 0.95
+    lr: float = 3e-4
+    train_batch_size: int = 1024
+    minibatch_size: int = 256
+    num_epochs: int = 4
+    hiddens: tuple = (64, 64)
+    seed: int = 0
+    use_actors: Optional[bool] = None  # None = actors iff workers>0 & rt up
+
+    # fluent API parity (reference AlgorithmConfig.environment/rollouts/...)
+    def environment(self, env) -> "AlgorithmConfig":
+        return replace(self, env=env)
+
+    def rollouts(self, *, num_rollout_workers=None,
+                 num_envs_per_worker=None,
+                 rollout_length=None) -> "AlgorithmConfig":
+        out = self
+        if num_rollout_workers is not None:
+            out = replace(out, num_rollout_workers=num_rollout_workers)
+        if num_envs_per_worker is not None:
+            out = replace(out, num_envs_per_worker=num_envs_per_worker)
+        if rollout_length is not None:
+            out = replace(out, rollout_length=rollout_length)
+        return out
+
+    def training(self, **kw) -> "AlgorithmConfig":
+        return replace(self, **kw)
+
+    def build(self, algo_cls=None) -> "Algorithm":
+        cls = algo_cls or getattr(self, "_algo_cls", None)
+        if cls is None:
+            raise ValueError("pass algo_cls or use PPOConfig/ImpalaConfig")
+        return cls({"_config": self})
+
+
+class WorkerSet:
+    """Driver-side handle to N rollout workers (reference:
+    rllib/evaluation/worker_set.py:78).  Inline mode keeps one local
+    worker; actor mode spawns core-runtime actors and fans sample()
+    out in parallel."""
+
+    def __init__(self, config: AlgorithmConfig):
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+        self.config = config
+        n = max(1, config.num_rollout_workers)
+        use_actors = config.use_actors
+        if use_actors is None:
+            import ray_tpu
+            use_actors = (config.num_rollout_workers > 0
+                          and ray_tpu.is_initialized())
+        self.use_actors = use_actors
+        kw = dict(num_envs=config.num_envs_per_worker,
+                  rollout_length=config.rollout_length,
+                  gamma=config.gamma, lam=config.lam,
+                  hiddens=config.hiddens)
+        if use_actors:
+            import ray_tpu
+            Actor = ray_tpu.remote(RolloutWorker)
+            self.workers = [
+                Actor.remote(config.env, seed=config.seed + 1000 * i, **kw)
+                for i in range(n)]
+        else:
+            self.workers = [
+                RolloutWorker(config.env, seed=config.seed + 1000 * i, **kw)
+                for i in range(n)]
+        # local probe worker for obs/action dims
+        self._probe = (self.workers[0] if not use_actors
+                       else RolloutWorker(config.env, seed=config.seed, **kw))
+
+    @property
+    def obs_dim(self):
+        return self._probe.cfg.obs_dim
+
+    @property
+    def num_actions(self):
+        return self._probe.cfg.num_actions
+
+    def sample_sync(self):
+        """(reference: execution/rollout_ops.py:21
+        synchronous_parallel_sample)"""
+        from ray_tpu.rllib.sample_batch import SampleBatch
+        if self.use_actors:
+            import ray_tpu
+            batches = ray_tpu.get([w.sample.remote() for w in self.workers],
+                                  timeout=600)
+            rets = ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers],
+                timeout=600)
+        else:
+            batches = [w.sample() for w in self.workers]
+            rets = [w.episode_returns() for w in self.workers]
+        flat_rets = [r for rs in rets for r in rs]
+        return SampleBatch.concat_samples(
+            [SampleBatch(b) for b in batches]), flat_rets
+
+    def sync_weights(self, weights) -> None:
+        """(reference: WorkerSet.sync_weights — weights ride the object
+        store once, workers fetch the same ref)"""
+        if self.use_actors:
+            import ray_tpu
+            ref = ray_tpu.put(weights)
+            ray_tpu.get([w.set_weights.remote(ref) for w in self.workers],
+                        timeout=600)
+        else:
+            for w in self.workers:
+                w.set_weights(weights)
+
+    def stop(self):
+        if self.use_actors:
+            import ray_tpu
+            for w in self.workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+
+
+class Algorithm(Trainable):
+    """(reference: algorithms/algorithm.py Algorithm(Trainable))"""
+
+    _default_config: Callable[[], AlgorithmConfig] = AlgorithmConfig
+
+    def setup(self, config: dict):
+        cfg = config.get("_config")
+        if cfg is None:
+            base = self._default_config()
+            known = {k: v for k, v in config.items()
+                     if hasattr(base, k)}
+            cfg = replace(base, **known)
+        self.config: AlgorithmConfig = cfg
+        self._timesteps = 0
+        self._ep_returns: list[float] = []
+        self._build()
+
+    # subclass hooks
+    def _build(self):
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def step(self) -> dict:
+        t0 = time.perf_counter()
+        result = self.training_step()
+        dt = time.perf_counter() - t0
+        result.setdefault("timesteps_total", self._timesteps)
+        if self._ep_returns:
+            recent = self._ep_returns[-100:]
+            result["episode_reward_mean"] = float(np.mean(recent))
+        result["env_steps_per_sec"] = result.get("steps_this_iter", 0) / dt
+        return result

@@ -1,0 +1,107 @@
+"""Environments: vectorized rollout envs.
+
+Reference capability: rllib/env/vector_env.py VectorEnv + gym adapter.
+A built-in pure-numpy CartPole keeps the framework's tests and examples
+dependency-light (gymnasium is used when the env id isn't built in);
+the vector wrapper auto-resets sub-envs, matching the reference's
+_env_runner semantics (evaluation/sampler.py:529).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+
+
+class CartPole:
+    """Classic control CartPole-v1 dynamics (numpy, single env)."""
+
+    MAX_STEPS = 500
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+        self.observation_dim = 4
+        self.num_actions = 2
+        self.state = None
+        self.t = 0
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self.t = 0
+        return self.state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self.state
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(th), np.sin(th)
+        temp = (force + 0.05 * th_dot ** 2 * sinth) / 1.1
+        th_acc = (9.8 * sinth - costh * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * costh ** 2 / 1.1))
+        x_acc = temp - 0.05 * th_acc * costh / 1.1
+        tau = 0.02
+        self.state = np.array([x + tau * x_dot, x_dot + tau * x_acc,
+                               th + tau * th_dot, th_dot + tau * th_acc])
+        self.t += 1
+        done = bool(abs(self.state[0]) > 2.4 or abs(self.state[2]) > 0.2095
+                    or self.t >= self.MAX_STEPS)
+        return self.state.astype(np.float32), 1.0, done, {}
+
+
+class GymEnvAdapter:
+    """gymnasium env → the 4-tuple interface used here."""
+
+    def __init__(self, env_id: str, seed: Optional[int] = None):
+        import gymnasium
+        self.env = gymnasium.make(env_id)
+        self._seed = seed
+        self.observation_dim = int(np.prod(self.env.observation_space.shape))
+        self.num_actions = int(self.env.action_space.n)
+
+    def reset(self):
+        obs, _ = self.env.reset(seed=self._seed)
+        self._seed = None
+        return np.asarray(obs, np.float32).reshape(-1)
+
+    def step(self, action):
+        obs, rew, term, trunc, info = self.env.step(int(action))
+        return (np.asarray(obs, np.float32).reshape(-1), float(rew),
+                bool(term or trunc), info)
+
+
+def make_env(env: Union[str, Callable], seed: Optional[int] = None):
+    if callable(env):
+        return env()
+    if env in ("CartPole-v1", "CartPole"):
+        return CartPole(seed)
+    return GymEnvAdapter(env, seed)
+
+
+class VectorEnv:
+    """N sub-envs stepped in lockstep with auto-reset
+    (reference: rllib/env/vector_env.py VectorEnvWrapper)."""
+
+    def __init__(self, env: Union[str, Callable], num_envs: int,
+                 seed: int = 0):
+        self.envs = [make_env(env, seed + i) for i in range(num_envs)]
+        self.num_envs = num_envs
+        self.observation_dim = self.envs[0].observation_dim
+        self.num_actions = self.envs[0].num_actions
+        self._obs = None
+
+    def reset(self) -> np.ndarray:
+        self._obs = np.stack([e.reset() for e in self.envs])
+        return self._obs
+
+    def step(self, actions: np.ndarray):
+        obs, rews, dones = [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, d, _ = e.step(a)
+            if d:
+                o = e.reset()
+            obs.append(o)
+            rews.append(r)
+            dones.append(d)
+        self._obs = np.stack(obs)
+        return (self._obs, np.asarray(rews, np.float32),
+                np.asarray(dones, bool))

@@ -1,0 +1,108 @@
+"""JaxPolicy: actor-critic policy with a compiled action path.
+
+Reference capability: rllib/policy/torch_policy.py:65 TorchPolicy
+(compute_actions, loss, multi-GPU towers :495,553).  TPU redesign: the
+policy is a pure pytree + jitted functions — no towers: the learner mesh
+shards the train step (dp over batch), and rollout workers run the same
+compute_actions jitted on CPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class PolicyConfig:
+    obs_dim: int
+    num_actions: int
+    hiddens: tuple = (64, 64)
+
+
+def init_policy_params(cfg: PolicyConfig, rng: jax.Array):
+    dims = (cfg.obs_dim, *cfg.hiddens)
+    keys = jax.random.split(rng, len(dims) + 1)
+    params = {}
+    for i in range(len(dims) - 1):
+        params[f"fc{i}"] = {
+            "w": (jax.random.normal(keys[i], (dims[i], dims[i + 1]))
+                  * np.sqrt(2.0 / dims[i])).astype(jnp.float32),
+            "b": jnp.zeros((dims[i + 1],), jnp.float32)}
+    params["pi"] = {
+        "w": (jax.random.normal(keys[-2], (dims[-1], cfg.num_actions))
+              * 0.01).astype(jnp.float32),
+        "b": jnp.zeros((cfg.num_actions,), jnp.float32)}
+    params["vf"] = {
+        "w": (jax.random.normal(keys[-1], (dims[-1], 1)) * 1.0
+              ).astype(jnp.float32),
+        "b": jnp.zeros((1,), jnp.float32)}
+    return params
+
+
+def policy_forward(params, obs):
+    """obs [B, obs_dim] → (logits [B, A], value [B])."""
+    x = obs
+    i = 0
+    while f"fc{i}" in params:
+        lp = params[f"fc{i}"]
+        x = jnp.tanh(x @ lp["w"] + lp["b"])
+        i += 1
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[:, 0]
+    return logits, value
+
+
+class JaxPolicy:
+    """Holds params + jitted sample/value functions."""
+
+    def __init__(self, cfg: PolicyConfig, seed: int = 0):
+        self.cfg = cfg
+        self.params = init_policy_params(cfg, jax.random.PRNGKey(seed))
+        self._rng = jax.random.PRNGKey(seed + 1)
+
+        @jax.jit
+        def _act(params, rng, obs):
+            logits, value = policy_forward(params, obs)
+            rng, sub = jax.random.split(rng)
+            actions = jax.random.categorical(sub, logits, axis=-1)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), actions]
+            return rng, actions, logp, value, logits
+
+        self._act = _act
+
+    def compute_actions(self, obs: np.ndarray):
+        """(reference: TorchPolicy.compute_actions) → actions, logp, vf."""
+        self._rng, actions, logp, value, logits = self._act(
+            self.params, self._rng, jnp.asarray(obs))
+        return (np.asarray(actions), np.asarray(logp), np.asarray(value),
+                np.asarray(logits))
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+def compute_gae(rewards, values, dones, last_value, *, gamma=0.99,
+                lam=0.95):
+    """Generalized advantage estimation over a [T, B] rollout
+    (reference: rllib/evaluation/postprocessing.py compute_advantages)."""
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    last_gae = np.zeros_like(last_value)
+    next_value = last_value
+    for t in reversed(range(T)):
+        nonterminal = 1.0 - dones[t].astype(np.float32)
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    value_targets = adv + values
+    return adv, value_targets

@@ -1,0 +1,162 @@
+"""PPO: clipped surrogate objective, TPU-compiled learner.
+
+Reference capability: rllib/algorithms/ppo/ppo.py:350 training_step —
+synchronous_parallel_sample → standardize advantages →
+multi_gpu_train_one_step (torch_policy.py:495,553 tower loop).  TPU
+redesign: the whole SGD epoch loop (minibatch slicing included) is ONE
+jitted program via lax.scan over minibatches — no per-minibatch Python
+dispatch, batch sharded over dp when the learner owns a mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as SB
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rllib.policy import (PolicyConfig, init_policy_params,
+                                  policy_forward)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class PPOConfig(AlgorithmConfig):
+    clip_param: float = 0.2
+    vf_clip_param: float = 10.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.0
+    kl_target: float = 0.2
+
+    def build(self, algo_cls=None) -> "PPO":
+        return PPO({"_config": self})
+
+
+def ppo_loss(params, batch, *, clip, vf_clip, vf_coeff, ent_coeff):
+    logits, value = policy_forward(params, batch[SB.OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch[SB.ACTIONS][:, None], axis=1)[:, 0]
+    ratio = jnp.exp(logp - batch[SB.LOGP])
+    adv = batch[SB.ADVANTAGES]
+    surr = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+    pi_loss = -jnp.mean(surr)
+
+    vf_err = value - batch[SB.VALUE_TARGETS]
+    vf_clipped = batch[SB.VF_PREDS] + jnp.clip(
+        value - batch[SB.VF_PREDS], -vf_clip, vf_clip)
+    vf_err2 = jnp.maximum(
+        vf_err ** 2, (vf_clipped - batch[SB.VALUE_TARGETS]) ** 2)
+    vf_loss = 0.5 * jnp.mean(vf_err2)
+
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    kl = jnp.mean(batch[SB.LOGP] - logp)
+    total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy, "kl": kl}
+
+
+def make_ppo_update(cfg: PPOConfig, tx):
+    """Jitted full update: epochs × minibatches via lax.scan
+    (the multi_gpu_train_one_step analogue, compiled)."""
+    loss_fn = partial(ppo_loss, clip=cfg.clip_param,
+                      vf_clip=cfg.vf_clip_param,
+                      vf_coeff=cfg.vf_loss_coeff,
+                      ent_coeff=cfg.entropy_coeff)
+
+    @jax.jit
+    def update(params, opt_state, rng, batch):
+        n = batch[SB.OBS].shape[0]
+        mb = cfg.minibatch_size
+        num_mb = n // mb
+
+        # standardize advantages across the train batch
+        adv = batch[SB.ADVANTAGES]
+        batch = dict(batch)
+        batch[SB.ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        def epoch(carry, rng_e):
+            params, opt_state = carry
+            perm = jax.random.permutation(rng_e, n)
+            shuf = {k: v[perm] for k, v in batch.items()}
+
+            def mb_step(carry, i):
+                params, opt_state = carry
+                sl = {k: jax.lax.dynamic_slice_in_dim(v, i * mb, mb)
+                      for k, v in shuf.items()}
+                (l, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, sl)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), {**aux, "total_loss": l}
+
+            (params, opt_state), metrics = jax.lax.scan(
+                mb_step, (params, opt_state), jnp.arange(num_mb))
+            return (params, opt_state), metrics
+
+        rngs = jax.random.split(rng, cfg.num_epochs)
+        (params, opt_state), metrics = jax.lax.scan(
+            epoch, (params, opt_state), rngs)
+        mean_metrics = jax.tree.map(lambda x: x.mean(), metrics)
+        return params, opt_state, mean_metrics
+
+    return update
+
+
+class PPO(Algorithm):
+    _default_config = PPOConfig
+
+    def _build(self):
+        cfg = self.config
+        self.workers = WorkerSet(cfg)
+        pcfg = PolicyConfig(obs_dim=self.workers.obs_dim,
+                            num_actions=self.workers.num_actions,
+                            hiddens=tuple(cfg.hiddens))
+        self.params = init_policy_params(pcfg, jax.random.PRNGKey(cfg.seed))
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = make_ppo_update(cfg, self.tx)
+        self._rng = jax.random.PRNGKey(cfg.seed + 7)
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        batches, steps = [], 0
+        while steps < cfg.train_batch_size:
+            b, rets = self.workers.sample_sync()
+            self._ep_returns.extend(rets)
+            batches.append(b)
+            steps += b.count
+        train_batch = SampleBatch.concat_samples(batches)
+        self._timesteps += train_batch.count
+
+        jb = {k: jnp.asarray(v) for k, v in train_batch.items()
+              if k in (SB.OBS, SB.ACTIONS, SB.LOGP, SB.ADVANTAGES,
+                       SB.VALUE_TARGETS, SB.VF_PREDS)}
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, sub, jb)
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+        out = {k: float(v) for k, v in metrics.items()}
+        out["steps_this_iter"] = train_batch.count
+        return out
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.opt_state = self.tx.init(self.params)
+        self._timesteps = ck.get("timesteps", 0)
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+
+    def cleanup(self):
+        self.workers.stop()

@@ -1,0 +1,72 @@
+"""ray_tpu.serve: model serving (reference capability: python/ray/serve —
+SURVEY.md §2.4; §7 M8 controller/proxy/replica triangle)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import (AutoscalingConfig, Deployment,
+                                      DeploymentOptions, deployment)
+from ray_tpu.serve.handle import DeploymentHandle, ServeResponse
+from ray_tpu.serve.http_proxy import HttpProxy
+
+_controller: Optional[ServeController] = None
+_proxy: Optional[HttpProxy] = None
+
+
+def _get_controller() -> ServeController:
+    global _controller
+    if _controller is None:
+        _controller = ServeController()
+    return _controller
+
+
+def run(dep: Deployment, *, use_actors: Optional[bool] = None,
+        http: bool = False, port: int = 0) -> DeploymentHandle:
+    """Deploy and return a handle (reference: serve.run api.py:455)."""
+    global _proxy
+    ctrl = _get_controller()
+    state = ctrl.deploy(dep, use_actors=use_actors)
+    if http and _proxy is None:
+        _proxy = HttpProxy(ctrl, port=port)
+        _proxy.start()
+    return DeploymentHandle(state)
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(_get_controller().get(name))
+
+
+def delete(name: str) -> None:
+    _get_controller().delete(name)
+
+
+def proxy_address() -> Optional[str]:
+    return f"http://{_proxy.host}:{_proxy.port}" if _proxy else None
+
+
+def status() -> dict:
+    ctrl = _get_controller()
+    return {name: {"replicas": len(st.replicas),
+                   "ongoing_per_replica": st.ongoing_per_replica()}
+            for name, st in ctrl.deployments.items()}
+
+
+def shutdown() -> None:
+    global _controller, _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None
+    if _controller is not None:
+        _controller.shutdown()
+        _controller = None
+
+
+__all__ = [
+    "deployment", "Deployment", "DeploymentOptions", "AutoscalingConfig",
+    "DeploymentHandle", "ServeResponse", "ServeController", "HttpProxy",
+    "batch", "run", "get_handle", "delete", "shutdown", "status",
+    "proxy_address",
+]

@@ -1,0 +1,125 @@
+"""@serve.batch: dynamic request batching.
+
+Reference capability: python/ray/serve/batching.py @serve.batch — queue
+individual calls, flush when max_batch_size is reached or
+batch_wait_timeout_s elapses, fan results back out.  The decorated
+method receives a LIST of requests and must return a list of equal
+length.  This is the serving-side MXU lever: one batched forward instead
+of N singles.
+
+Each replica INSTANCE gets its own batcher (descriptor protocol) — a
+shared class-level queue would route one replica's requests into
+another's state and break the router's per-replica accounting.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class _Batcher:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._queue: list[tuple[Any, Future]] = []
+        self._timer: Optional[threading.Timer] = None
+
+    def submit(self, instance, item) -> Future:
+        fut: Future = Future()
+        flush_now = False
+        with self._lock:
+            self._queue.append((item, fut))
+            if len(self._queue) >= self.max_batch_size:
+                flush_now = True
+            elif self._timer is None:
+                self._timer = threading.Timer(
+                    self.timeout, self._flush, (instance,))
+                self._timer.daemon = True
+                self._timer.start()
+        if flush_now:
+            self._flush(instance)
+        return fut
+
+    def _flush(self, instance):
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            batch, self._queue = self._queue, []
+        if not batch:
+            return
+        items = [b[0] for b in batch]
+        try:
+            results = (self.fn(instance, items) if instance is not None
+                       else self.fn(items))
+            if len(results) != len(items):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} "
+                    f"results for {len(items)} requests")
+            for (_, fut), r in zip(batch, results):
+                fut.set_result(r)
+        except BaseException as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+class _BatchDescriptor:
+    """Binds a per-instance batcher on attribute access; calling the
+    descriptor object directly covers free-function deployments."""
+
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._wait = batch_wait_timeout_s
+        self._attr = f"__batcher_{fn.__name__}"
+        self._free_batcher: Optional[_Batcher] = None
+        self._free_lock = threading.Lock()
+        functools.update_wrapper(self, fn)
+
+    def __set_name__(self, owner, name):
+        self._attr = f"__batcher_{name}"
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        batcher = obj.__dict__.get(self._attr)
+        if batcher is None:
+            batcher = obj.__dict__.setdefault(
+                self._attr, _Batcher(self._fn, self._max, self._wait))
+
+        def bound(item):
+            return batcher.submit(obj, item).result()
+
+        functools.update_wrapper(bound, self._fn)
+        bound._batcher = batcher
+        return bound
+
+    def __call__(self, item):
+        # free-function form: one module-level batcher, fn(items)
+        with self._free_lock:
+            if self._free_batcher is None:
+                self._free_batcher = _Batcher(self._fn, self._max, self._wait)
+        return self._free_batcher.submit(None, item).result()
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: calls collect into lists (reference: serve/batching.py).
+
+    The wrapped call BLOCKS until its result is ready, so replica
+    concurrency (threads / max_concurrent_queries) provides the overlap
+    that fills batches.
+    """
+
+    def wrap(fn):
+        return _BatchDescriptor(fn, max_batch_size, batch_wait_timeout_s)
+
+    return wrap(_fn) if _fn is not None else wrap

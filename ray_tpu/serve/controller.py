@@ -1,0 +1,223 @@
+"""Serve control plane: replica lifecycle + routing.
+
+Reference capability: the controller/proxy/replica triangle —
+ServeController reconciliation (python/ray/serve/controller.py:60 +
+_private/deployment_state.py:962,1812), Router/ReplicaSet round-robin
+with max-concurrent backpressure (_private/router.py:261,62,221), replica
+autoscaling from ongoing-request load (_private/autoscaling_policy.py:10).
+
+Single-host shape: the controller is a driver-side object; replicas are
+core-runtime actors when the runtime is up (process isolation, parallel
+queries) or in-process objects otherwise.  Reconciliation runs inline on
+deploy/delete and on the autoscaler tick — the reference's control loop
+collapsed to its fixed points, same observable behavior.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.serve.deployment import Deployment
+
+
+class _InProcReplica:
+    def __init__(self, deployment: Deployment):
+        self._user = deployment.build_replica()
+
+    def handle_request(self, method: str, args, kwargs):
+        target = (self._user if method == "__call__"
+                  else getattr(self._user, method))
+        if method == "__call__" and not callable(target):
+            target = self._user.__call__
+        return target(*args, **kwargs)
+
+    def health(self):
+        return True
+
+
+class _ActorReplicaShim:
+    """The actor-side wrapper (reference: RayServeReplica
+    _private/replica.py:260)."""
+
+    def __init__(self, deployment_bytes: bytes):
+        import cloudpickle
+        self._dep: Deployment = cloudpickle.loads(deployment_bytes)
+        self._user = self._dep.build_replica()
+
+    def handle_request(self, method: str, args, kwargs):
+        target = (self._user if method == "__call__"
+                  else getattr(self._user, method))
+        if method == "__call__" and not callable(target):
+            target = self._user.__call__
+        return target(*args, **kwargs)
+
+    def health(self):
+        return True
+
+
+@dataclass
+class ReplicaHandle:
+    impl: Any                      # _InProcReplica or actor handle
+    is_actor: bool
+    ongoing: int = 0               # in-flight queries (router-side count)
+
+
+class DeploymentState:
+    """Tracks one deployment's replicas (reference:
+    deployment_state.py DeploymentState; states collapsed to
+    RUNNING/dead)."""
+
+    def __init__(self, deployment: Deployment, use_actors: bool):
+        self.deployment = deployment
+        self.use_actors = use_actors
+        self.replicas: list[ReplicaHandle] = []
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+        self.scale_to(deployment.options.num_replicas)
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def _start_replica(self) -> ReplicaHandle:
+        if self.use_actors:
+            import cloudpickle
+            import ray_tpu
+            Actor = ray_tpu.remote(_ActorReplicaShim)
+            h = Actor.remote(cloudpickle.dumps(self.deployment))
+            return ReplicaHandle(h, True)
+        return ReplicaHandle(_InProcReplica(self.deployment), False)
+
+    def scale_to(self, n: int) -> None:
+        n = max(0, n)
+        with self._lock:
+            while len(self.replicas) < n:
+                self.replicas.append(self._start_replica())
+            while len(self.replicas) > n:
+                r = self.replicas.pop()
+                if r.is_actor:
+                    import ray_tpu
+                    try:
+                        ray_tpu.kill(r.impl)
+                    except Exception:
+                        pass
+
+    def restart_dead(self) -> int:
+        """Health-check replicas; replace dead ones (reference:
+        deployment_state reconciliation of FAILED replicas)."""
+        replaced = 0
+        with self._lock:
+            for i, r in enumerate(self.replicas):
+                ok = True
+                if r.is_actor:
+                    import ray_tpu
+                    try:
+                        ok = ray_tpu.get(r.impl.health.remote(), timeout=30)
+                    except Exception:
+                        ok = False
+                if not ok:
+                    self.replicas[i] = self._start_replica()
+                    replaced += 1
+        return replaced
+
+    # -- routing -----------------------------------------------------------
+
+    def assign_replica(self) -> ReplicaHandle:
+        """Round-robin among replicas with free slots; block if all are
+        at max_concurrent_queries (reference: router.py:221
+        assign_replica backpressure)."""
+        maxq = self.deployment.options.max_concurrent_queries
+        while True:
+            with self._lock:
+                if self.replicas:
+                    for _ in range(len(self.replicas)):
+                        i = next(self._rr) % len(self.replicas)
+                        r = self.replicas[i]
+                        if r.ongoing < maxq:
+                            r.ongoing += 1
+                            return r
+            time.sleep(0.001)
+
+    def release(self, r: ReplicaHandle):
+        with self._lock:
+            r.ongoing = max(0, r.ongoing - 1)
+
+    def ongoing_per_replica(self) -> float:
+        with self._lock:
+            if not self.replicas:
+                return 0.0
+            return sum(r.ongoing for r in self.replicas) / len(self.replicas)
+
+    def autoscale_tick(self) -> None:
+        auto = self.deployment.options.autoscaling
+        if auto is None:
+            return
+        load = self.ongoing_per_replica()
+        desired = len(self.replicas)
+        if load > auto.target_ongoing_requests:
+            desired += 1
+        elif load < auto.target_ongoing_requests / 2:
+            desired -= 1
+        desired = min(max(desired, auto.min_replicas), auto.max_replicas)
+        if desired != len(self.replicas):
+            self.scale_to(desired)
+
+
+class ServeController:
+    """(reference: serve/controller.py ServeController — deployment map +
+    reconciliation; here driver-side, exposed via ray_tpu.serve.api)"""
+
+    def __init__(self):
+        self.deployments: dict[str, DeploymentState] = {}
+        self._autoscale_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def deploy(self, deployment: Deployment,
+               use_actors: Optional[bool] = None) -> DeploymentState:
+        if use_actors is None:
+            use_actors = deployment.options.use_actors
+        if use_actors is None:
+            import ray_tpu
+            use_actors = ray_tpu.is_initialized()
+        existing = self.deployments.get(deployment.name)
+        if existing is not None:
+            existing.scale_to(0)
+        st = DeploymentState(deployment, use_actors)
+        self.deployments[deployment.name] = st
+        self._ensure_autoscaler()
+        return st
+
+    def delete(self, name: str) -> None:
+        st = self.deployments.pop(name, None)
+        if st is not None:
+            st.scale_to(0)
+
+    def get(self, name: str) -> DeploymentState:
+        if name not in self.deployments:
+            raise KeyError(f"no deployment named {name!r}")
+        return self.deployments[name]
+
+    def _ensure_autoscaler(self):
+        if self._autoscale_thread is not None:
+            return
+
+        def tick():
+            while not self._stop.wait(0.25):
+                for st in list(self.deployments.values()):
+                    try:
+                        st.autoscale_tick()
+                    except Exception:
+                        traceback.print_exc()
+
+        self._autoscale_thread = threading.Thread(target=tick, daemon=True)
+        self._autoscale_thread.start()
+
+    def shutdown(self):
+        self._stop.set()
+        for name in list(self.deployments):
+            self.delete(name)
+        self._autoscale_thread = None
+        self._stop = threading.Event()

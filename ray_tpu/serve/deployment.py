@@ -1,0 +1,96 @@
+"""Deployments: the serveable unit.
+
+Reference capability: @serve.deployment (python/ray/serve/deployment.py)
+with num_replicas / max_concurrent_queries / autoscaling options, and
+the user class contract (__call__ or named methods; async optional).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Union
+
+
+@dataclass
+class AutoscalingConfig:
+    """(reference: serve autoscaling_policy.py calculate_desired_num_replicas
+    — scale to keep per-replica ongoing requests near the target)"""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+
+
+@dataclass
+class DeploymentOptions:
+    name: str = ""
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    autoscaling: Optional[AutoscalingConfig] = None
+    ray_actor_options: dict = field(default_factory=dict)
+    use_actors: Optional[bool] = None    # None = actors iff runtime up
+
+
+class Deployment:
+    """A configured (not yet running) deployment; ``serve.run`` turns it
+    into replicas (reference: Deployment.bind/deploy split)."""
+
+    def __init__(self, cls_or_fn: Union[type, Callable],
+                 options: DeploymentOptions,
+                 init_args: tuple = (), init_kwargs: Optional[dict] = None):
+        self._target = cls_or_fn
+        self.options = options
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs or {}
+
+    @property
+    def name(self) -> str:
+        return self.options.name or getattr(
+            self._target, "__name__", "deployment")
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        d = copy.copy(self)
+        d.init_args = args
+        d.init_kwargs = kwargs
+        return d
+
+    def set_options(self, **kw) -> "Deployment":
+        d = copy.copy(self)
+        d.options = copy.copy(self.options)
+        for k, v in kw.items():
+            setattr(d.options, k, v)
+        return d
+
+    def build_replica(self):
+        """Instantiate the user target (one replica's worth)."""
+        t = self._target
+        if isinstance(t, type):
+            return t(*self.init_args, **self.init_kwargs)
+        # bare function deployment: wrap as single-method callable
+        fn = t
+
+        class _FnReplica:
+            def __call__(self, *a, **kw):
+                return fn(*a, **kw)
+
+        return _FnReplica()
+
+
+def deployment(cls_or_fn=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 8,
+               autoscaling_config: Optional[dict] = None,
+               ray_actor_options: Optional[dict] = None):
+    """@serve.deployment decorator (reference: serve/api.py deployment)."""
+
+    def wrap(target):
+        auto = (AutoscalingConfig(**autoscaling_config)
+                if isinstance(autoscaling_config, dict)
+                else autoscaling_config)
+        return Deployment(target, DeploymentOptions(
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            autoscaling=auto,
+            ray_actor_options=ray_actor_options or {}))
+
+    return wrap(cls_or_fn) if cls_or_fn is not None else wrap

@@ -1,0 +1,98 @@
+"""DeploymentHandle: the client side of a deployment.
+
+Reference capability: serve handles (python/ray/serve/handle.py
+RayServeHandle.remote → router → replica).  ``handle.remote(...)``
+returns a future-like; ``.result()`` blocks.  Actor replicas return
+ObjectRefs (query runs in the replica process); in-process replicas run
+on a worker thread pool so concurrent queries still overlap.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Optional
+
+from ray_tpu.serve.controller import DeploymentState, ReplicaHandle
+
+
+def _is_timeout(e: BaseException) -> bool:
+    from concurrent.futures import TimeoutError as FutTimeout
+    try:
+        from ray_tpu.core.client import GetTimeoutError
+    except ImportError:  # pragma: no cover
+        GetTimeoutError = ()
+    return isinstance(e, (FutTimeout, TimeoutError, GetTimeoutError))
+
+
+class ServeResponse:
+    """Future-like wrapper (reference: DeploymentResponse)."""
+
+    def __init__(self, resolve, cancel_release):
+        self._resolve = resolve
+        self._release = cancel_release
+        self._done = False
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done:
+            try:
+                self._value = self._resolve(timeout)
+            except BaseException as e:
+                if _is_timeout(e):
+                    # request is still executing on the replica — keep
+                    # its concurrency slot held and let the caller retry
+                    raise
+                self._error = e
+            self._release()
+            self._done = True
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class DeploymentHandle:
+    _pool: Optional[ThreadPoolExecutor] = None
+    _pool_lock = threading.Lock()
+
+    def __init__(self, state: DeploymentState, method: str = "__call__"):
+        self._state = state
+        self._method = method
+
+    @property
+    def deployment_name(self) -> str:
+        return self._state.deployment.name
+
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._state, method_name)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._state, name)
+
+    @classmethod
+    def _ensure_pool(cls) -> ThreadPoolExecutor:
+        with cls._pool_lock:
+            if cls._pool is None:
+                cls._pool = ThreadPoolExecutor(max_workers=32)
+        return cls._pool
+
+    def remote(self, *args, **kwargs) -> ServeResponse:
+        state, method = self._state, self._method
+        replica = state.assign_replica()
+        if replica.is_actor:
+            ref = replica.impl.handle_request.remote(method, args, kwargs)
+
+            def resolve(timeout):
+                import ray_tpu
+                return ray_tpu.get(ref, timeout=timeout or 120)
+        else:
+            fut: Future = self._ensure_pool().submit(
+                replica.impl.handle_request, method, args, kwargs)
+
+            def resolve(timeout):
+                return fut.result(timeout)
+
+        return ServeResponse(resolve, lambda: state.release(replica))

@@ -1,0 +1,99 @@
+"""HTTP ingress: JSON-over-HTTP proxy in front of the controller.
+
+Reference capability: HTTPProxyActor/uvicorn ingress
+(python/ray/serve/_private/http_proxy.py:399,230 — route → deployment →
+replica).  Stdlib ThreadingHTTPServer keeps it dependency-free; each
+request thread blocks on the handle, so max_concurrent_queries
+backpressure applies end to end.
+
+Routes: POST/GET /<deployment> with a JSON body → the deployment's
+__call__ gets the parsed JSON (or the raw body string if not JSON);
+response is JSON-encoded.  GET /-/healthz and /-/routes are control
+endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _jsonable(x):
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    if isinstance(x, np.generic):
+        return x.item()
+    if isinstance(x, dict):
+        return {k: _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    try:
+        import jax
+        if isinstance(x, jax.Array):
+            return np.asarray(x).tolist()
+    except Exception:
+        pass
+    return x
+
+
+class HttpProxy:
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.serve.handle import DeploymentHandle
+        self.controller = controller
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code: int, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _route(self):
+                path = self.path.strip("/").split("?")[0]
+                if path == "-/healthz":
+                    return self._reply(200, {"status": "ok"})
+                if path == "-/routes":
+                    return self._reply(
+                        200, sorted(controller.deployments.keys()))
+                name = path.split("/")[0]
+                try:
+                    state = controller.get(name)
+                except KeyError:
+                    return self._reply(404, {"error": f"no route /{name}"})
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    arg = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    arg = raw.decode("utf-8", "replace")
+                handle = DeploymentHandle(state)
+                try:
+                    out = handle.remote(arg).result(timeout=120)
+                    self._reply(200, {"result": _jsonable(out)})
+                except Exception as e:
+                    self._reply(500, {"error": str(e)})
+
+            do_GET = _route
+            do_POST = _route
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -89,6 +89,7 @@ class AsyncCheckpointer:
         self._lock = threading.Lock()
         self._pending: Optional[tuple] = None
         self._thread: Optional[threading.Thread] = None
+        self._running = False     # drain-loop liveness, guarded by _lock
         self._error: Optional[BaseException] = None
         self.last_path: Optional[str] = None
 
@@ -96,7 +97,11 @@ class AsyncCheckpointer:
         host = _to_host(data)  # synchronous D2H; disk write is async
         with self._lock:
             self._pending = (host, path)
-            if self._thread is None or not self._thread.is_alive():
+            # _running flips false only under this lock (in _drain), so
+            # a save racing the drain thread's exit always restarts it —
+            # is_alive() alone races with the loop's decision to return
+            if not self._running:
+                self._running = True
                 self._thread = threading.Thread(target=self._drain,
                                                 daemon=True)
                 self._thread.start()
@@ -105,6 +110,7 @@ class AsyncCheckpointer:
         while True:
             with self._lock:
                 if self._pending is None:
+                    self._running = False
                     return
                 host, path = self._pending
                 self._pending = None
@@ -115,9 +121,14 @@ class AsyncCheckpointer:
                 self._error = e
 
     def wait(self):
-        t = self._thread
-        if t is not None:
-            t.join()
+        while True:
+            with self._lock:
+                t = self._thread
+                busy = self._running or self._pending is not None
+            if not busy:
+                break
+            if t is not None:
+                t.join(timeout=0.05)
         if self._error is not None:
             err, self._error = self._error, None
             raise err

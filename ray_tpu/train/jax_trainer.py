@@ -15,12 +15,13 @@ import time
 from typing import Any, Callable, Iterable, Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
 from ray_tpu.train import session
 from ray_tpu.train.config import RunConfig, ScalingConfig
-from ray_tpu.train.step import make_train_step, shard_batch
+from ray_tpu.train.step import TrainState, make_train_step, shard_batch
 from ray_tpu.train.trainer import DataParallelTrainer
 
 
@@ -74,11 +75,30 @@ class JaxTrainer(DataParallelTrainer):
         start_step = 0
         if restored is not None:
             payload = restored.to_dict()
-            host_params = payload["params"]
-            state = init_fn(jax.tree.map(lambda _, h: h, params, host_params))
             start_step = int(payload.get("step", 0))
 
+            def put_like(cur, host):
+                if isinstance(cur, jax.Array):
+                    return jax.device_put(host, cur.sharding)
+                return host
+
+            # full-state restore: params AND optimizer moments AND step —
+            # re-initializing the optimizer would spike the effective LR
+            # after every failover (adam bias correction restarts)
+            state = TrainState(
+                step=put_like(state.step,
+                              jnp.asarray(start_step, jnp.int32)),
+                params=jax.tree.map(put_like, state.params,
+                                    payload["params"]),
+                opt_state=(jax.tree.map(put_like, state.opt_state,
+                                        payload["opt_state"])
+                           if "opt_state" in payload else state.opt_state))
+
         data_iter = iter(o["train_data"])
+        # replay the iterator to the resume point so deterministic feeds
+        # don't re-consume the leading batches
+        for _ in range(start_step):
+            next(data_iter)
         t0 = time.perf_counter()
         tokens_done = 0
         for i in range(start_step, o["num_steps"]):
@@ -100,7 +120,8 @@ class JaxTrainer(DataParallelTrainer):
                 ckpt = None
                 if (o["checkpoint_every"]
                         and (i + 1) % o["checkpoint_every"] == 0) or is_last:
-                    ckpt = {"params": jax.tree.map(lambda x: x, state.params),
+                    ckpt = {"params": state.params,
+                            "opt_state": state.opt_state,
                             "step": i + 1}
                 session.report(m, checkpoint=ckpt)
         self.final_state = state

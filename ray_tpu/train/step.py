@@ -39,24 +39,28 @@ jax.tree_util.register_dataclass(
 
 def state_shardings(mesh: Mesh, params_logical: Any, rules: Rules,
                     params: Any, tx: optax.GradientTransformation):
-    """Shardings for a TrainState: params by rules; opt-state leaves
-    inherit the sharding of the param they mirror (adam m/v have param
-    shape); scalars replicated."""
+    """Shardings for a TrainState: params by rules; opt-state subtrees
+    that mirror the params pytree (adam mu/nu, momentum, …) get the same
+    shardings; everything else (counts, scalars) replicated.  Matching
+    is STRUCTURAL, not by shape — two same-shaped params with different
+    rules must keep their own shardings."""
     p_sh = tree_shardings(params_logical, rules, mesh)
     rep = replicated(mesh)
-
-    # Build opt state structurally to map shardings leaf-by-leaf.
     opt_state = jax.eval_shape(tx.init, params)
-    flat_p, _ = jax.tree.flatten(p_sh)
-    shape_to_sh = {}
-    for p_leaf, sh in zip(jax.tree.leaves(jax.eval_shape(lambda x: x, params)),
-                          flat_p):
-        shape_to_sh.setdefault(p_leaf.shape, sh)
+    p_struct = jax.tree.structure(params)
 
-    def opt_leaf_sharding(leaf):
-        return shape_to_sh.get(getattr(leaf, "shape", None), rep)
+    def map_node(node):
+        if jax.tree.structure(node) == p_struct:
+            return p_sh
+        if isinstance(node, tuple) and not hasattr(node, "shape"):
+            mapped = [map_node(c) for c in node]
+            return (type(node)(*mapped) if hasattr(node, "_fields")
+                    else tuple(mapped))
+        if isinstance(node, list):
+            return [map_node(c) for c in node]
+        return jax.tree.map(lambda _: rep, node)
 
-    o_sh = jax.tree.map(opt_leaf_sharding, opt_state)
+    o_sh = map_node(opt_state)
     return TrainState(step=rep, params=p_sh, opt_state=o_sh)
 
 
@@ -106,8 +110,6 @@ def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation, *,
                 {"loss": loss, "grad_norm": gnorm})
 
     if mesh is not None:
-        def in_shardings():
-            return (st_sh, batch_sharding(mesh))
         # jit lazily so init_fn can run first and fix shardings
         compiled = {}
 

@@ -168,22 +168,28 @@ class Tuner:
         scheduler = tc.scheduler or FIFOScheduler()
 
         trials: list[Trial] = []
-        n = 0
-        while True:
-            cfg = searcher.suggest(f"trial_{n:05d}")
-            if cfg is None or cfg == "PENDING":
-                break
-            trials.append(Trial(trial_id=f"trial_{n:05d}", config=cfg))
-            n += 1
-
         live: list[Trial] = []
-        pending = list(trials)
-        max_live = tc.max_concurrent_trials or len(trials)
+        exhausted = False
+        n = 0
+        max_live = tc.max_concurrent_trials or float("inf")
 
-        # round-robin stepping (reference TrialRunner.step:938 analogue)
-        while pending or live:
-            while pending and len(live) < max_live:
-                t = pending.pop(0)
+        # round-robin stepping (reference TrialRunner.step:938 analogue);
+        # trials are suggested LAZILY so capacity-limited searchers
+        # (ConcurrencyLimiter) get asked again as slots free up
+        while True:
+            made_progress = False
+            while not exhausted and len(live) < max_live:
+                tid = f"trial_{n:05d}"
+                cfg = searcher.suggest(tid)
+                if cfg is None:
+                    exhausted = True
+                    break
+                if cfg == "PENDING":   # searcher at capacity; retry later
+                    break
+                made_progress = True
+                t = Trial(trial_id=tid, config=cfg)
+                n += 1
+                trials.append(t)
                 try:
                     self._make_runner(t)
                     t.status = "RUNNING"
@@ -192,6 +198,11 @@ class Tuner:
                     t.status = "ERROR"
                     t.error = traceback.format_exc()
                     scheduler.on_complete(t, None)
+                    searcher.on_trial_complete(t.trial_id, None)
+            if not live:
+                if exhausted or not made_progress:
+                    break   # done, or searcher wedged with nothing live
+                continue
             for t in list(live):
                 try:
                     result = self._runner_call(t, "train")

@@ -1,0 +1,8 @@
+"""ray_tpu.util: user utilities over the core API (reference capability:
+python/ray/util — ActorPool, Queue; the collective API lives in
+ray_tpu.parallel.collectives)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Queue", "Empty", "Full"]

@@ -1,0 +1,75 @@
+"""ActorPool (reference capability: python/ray/util/actor_pool.py —
+map/map_unordered/submit/get_next over a fixed set of actors)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+
+class ActorPool:
+    def __init__(self, actors: list):
+        import ray_tpu
+        self._rt = ray_tpu
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list = []
+
+    def submit(self, fn: Callable, value) -> None:
+        """fn(actor, value) -> ObjectRef."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor, fn)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def _return_actor(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def get_next(self, timeout: Optional[float] = None):
+        """Next result in submission order."""
+        if self._next_return_index not in self._index_to_future:
+            raise StopIteration("no more results")
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        idx, actor, fn = self._future_to_actor.pop(future)
+        try:
+            return self._rt.get(future, timeout=timeout or 300)
+        finally:
+            self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: Optional[float] = None):
+        if not self._future_to_actor:
+            raise StopIteration("no more results")
+        ready, _ = self._rt.wait(list(self._future_to_actor),
+                                 num_returns=1, timeout=timeout or 300)
+        future = ready[0]
+        idx, actor, fn = self._future_to_actor.pop(future)
+        self._index_to_future.pop(idx, None)
+        try:
+            return self._rt.get(future, timeout=timeout or 300)
+        finally:
+            self._return_actor(actor)
+
+    def map(self, fn: Callable, values: Iterable):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable):
+        for v in values:
+            self.submit(fn, v)
+        while self._future_to_actor or self._pending_submits:
+            yield self.get_next_unordered()

@@ -1,0 +1,86 @@
+"""Distributed Queue (reference capability: python/ray/util/queue.py —
+an actor-backed FIFO shared between tasks/actors)."""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self.q: deque = deque()
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self.q) >= self.maxsize:
+            return False
+        self.q.append(item)
+        return True
+
+    def get(self):
+        if not self.q:
+            return False, None
+        return True, self.q.popleft()
+
+    def qsize(self) -> int:
+        return len(self.q)
+
+    def empty(self) -> bool:
+        return not self.q
+
+
+class Queue:
+    """Client; the state lives in a named actor so every process sees the
+    same queue."""
+
+    def __init__(self, maxsize: int = 0, *, name: Optional[str] = None):
+        import ray_tpu
+        self._rt = ray_tpu
+        opts = {"name": name, "get_if_exists": True} if name else {}
+        Act = ray_tpu.remote(_QueueActor)
+        if opts:
+            Act = Act.options(**opts)
+        self._actor = Act.remote(maxsize)
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            ok = self._rt.get(self._actor.put.remote(item), timeout=60)
+            if ok:
+                return
+            if not block or (deadline and time.time() > deadline):
+                raise Full()
+            time.sleep(0.01)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            ok, item = self._rt.get(self._actor.get.remote(), timeout=60)
+            if ok:
+                return item
+            if not block or (deadline and time.time() > deadline):
+                raise Empty()
+            time.sleep(0.01)
+
+    def put_nowait(self, item):
+        return self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return self._rt.get(self._actor.qsize.remote(), timeout=60)
+
+    def empty(self) -> bool:
+        return self._rt.get(self._actor.empty.remote(), timeout=60)

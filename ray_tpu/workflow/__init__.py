@@ -1,0 +1,20 @@
+"""ray_tpu.workflow: durable DAG execution.
+
+Reference capability: python/ray/workflow (SURVEY.md §2.4) — workflow.run
+(api.py), WorkflowExecutor (workflow_executor.py), durable storage of
+every task result (workflow_storage.py), resume after failure.
+
+Shape here: a DAG (ray_tpu.dag) executed with write-through memoization —
+every task's result is persisted under
+``<storage>/<workflow_id>/tasks/<task_id>`` before its consumers run; a
+re-run (resume) of the same workflow id skips every task whose result is
+already durable.  Task ids are structural (topo index + callable name),
+stable across processes for identically-constructed DAGs.
+"""
+
+from ray_tpu.workflow.execution import (WorkflowStorage, cancel, delete,
+                                        get_output, get_status, list_all,
+                                        resume, run, run_async)
+
+__all__ = ["run", "run_async", "resume", "get_status", "get_output",
+           "list_all", "cancel", "delete", "WorkflowStorage"]

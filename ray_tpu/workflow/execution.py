@@ -1,0 +1,196 @@
+"""Workflow executor + storage (reference: python/ray/workflow/
+workflow_executor.py, workflow_storage.py, api.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Optional
+
+from ray_tpu.dag.dag_node import DAGNode
+
+_DEFAULT_ROOT = os.path.join(tempfile.gettempdir(), "ray_tpu_workflows")
+
+
+class WorkflowStorage:
+    """Durable KV under a filesystem root (reference: workflow_storage.py
+    over _private/storage.py — any mounted FS works)."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or _DEFAULT_ROOT
+        os.makedirs(self.root, exist_ok=True)
+
+    def _wf_dir(self, workflow_id: str) -> str:
+        return os.path.join(self.root, workflow_id)
+
+    def put_task_result(self, workflow_id: str, task_id: str, value) -> None:
+        d = os.path.join(self._wf_dir(workflow_id), "tasks")
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{task_id}.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, os.path.join(d, task_id))
+
+    def get_task_result(self, workflow_id: str, task_id: str):
+        p = os.path.join(self._wf_dir(workflow_id), "tasks", task_id)
+        if not os.path.exists(p):
+            raise KeyError(task_id)
+        with open(p, "rb") as f:
+            return pickle.load(f)
+
+    def has_task_result(self, workflow_id: str, task_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self._wf_dir(workflow_id), "tasks", task_id))
+
+    def set_status(self, workflow_id: str, status: str,
+                   extra: Optional[dict] = None) -> None:
+        d = self._wf_dir(workflow_id)
+        os.makedirs(d, exist_ok=True)
+        meta = {"status": status, "updated_at": time.time(), **(extra or {})}
+        tmp = os.path.join(d, ".status.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(d, "status.json"))
+
+    def get_status(self, workflow_id: str) -> Optional[dict]:
+        p = os.path.join(self._wf_dir(workflow_id), "status.json")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f)
+
+    def list_workflows(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(d for d in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, d)))
+
+    def delete(self, workflow_id: str) -> None:
+        shutil.rmtree(self._wf_dir(workflow_id), ignore_errors=True)
+
+
+def _topo_task_ids(dag: DAGNode) -> dict[int, str]:
+    """Structural task ids: dfs-postorder index + callable name
+    (stable for identically-built DAGs — the resume contract)."""
+    order: list = []
+    seen: set[int] = set()
+
+    def walk(node: DAGNode):
+        if node._id in seen:
+            return
+        seen.add(node._id)
+        for c in node._children():
+            walk(c)
+        order.append(node)
+
+    walk(dag)
+    ids = {}
+    for i, node in enumerate(order):
+        name = (getattr(getattr(node, "_fn", None), "__name__", None)
+                or getattr(getattr(node, "_cls", None), "__name__", None)
+                or type(node).__name__)
+        ids[node._id] = f"{i:04d}_{name}"
+    return ids
+
+
+class _WorkflowRun:
+    def __init__(self, workflow_id: str, storage: WorkflowStorage):
+        self.workflow_id = workflow_id
+        self.storage = storage
+
+    def execute(self, dag: DAGNode, *input_args) -> Any:
+        st = self.storage
+        wf = self.workflow_id
+        task_ids = _topo_task_ids(dag)
+        st.set_status(wf, "RUNNING")
+        memo: dict = {}
+
+        def run_node(node, args, kwargs):
+            tid = task_ids[node._id]
+            if st.has_task_result(wf, tid):
+                return st.get_task_result(wf, tid)
+            out = node._execute_impl(args, kwargs, input_args, {}, False)
+            st.put_task_result(wf, tid, out)
+            return out
+
+        try:
+            result = dag._apply_recursive(run_node, memo)
+        except Exception:
+            st.set_status(wf, "FAILED")
+            raise
+        st.put_task_result(wf, "__output__", result)
+        st.set_status(wf, "SUCCESSFUL")
+        return result
+
+
+# -- module API (reference: workflow/api.py) -------------------------------
+
+_storage = WorkflowStorage()
+_dags: dict[str, tuple] = {}     # workflow_id -> (dag, args) for resume
+
+
+def _sto(storage: Optional[str]) -> WorkflowStorage:
+    return WorkflowStorage(storage) if storage else _storage
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None) -> Any:
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1000)}"
+    _dags[workflow_id] = (dag, args, storage)
+    return _WorkflowRun(workflow_id, _sto(storage)).execute(dag, *args)
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None):
+    """Returns a joinable thread-backed future."""
+    from concurrent.futures import ThreadPoolExecutor
+    ex = ThreadPoolExecutor(max_workers=1)
+    return ex.submit(run, dag, *args, workflow_id=workflow_id,
+                     storage=storage)
+
+
+def resume(workflow_id: str, dag: Optional[DAGNode] = None, *args,
+           storage: Optional[str] = None) -> Any:
+    """Re-run: durable task results short-circuit (reference:
+    workflow.resume).  The DAG must be re-supplied (or have been run in
+    this process) — code is not persisted, results are."""
+    if dag is None:
+        if workflow_id not in _dags:
+            raise ValueError(
+                f"resume({workflow_id!r}) needs the dag (code is not "
+                "persisted)")
+        dag, args, storage = _dags[workflow_id]
+    return _WorkflowRun(workflow_id, _sto(storage)).execute(dag, *args)
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None
+               ) -> Optional[str]:
+    meta = _sto(storage).get_status(workflow_id)
+    return meta["status"] if meta else None
+
+
+def get_output(workflow_id: str, storage: Optional[str] = None):
+    return _sto(storage).get_task_result(workflow_id, "__output__")
+
+
+def list_all(storage: Optional[str] = None) -> list[tuple[str, str]]:
+    st = _sto(storage)
+    out = []
+    for wf in st.list_workflows():
+        meta = st.get_status(wf)
+        out.append((wf, meta["status"] if meta else "UNKNOWN"))
+    return out
+
+
+def cancel(workflow_id: str, storage: Optional[str] = None) -> None:
+    _sto(storage).set_status(workflow_id, "CANCELED")
+
+
+def delete(workflow_id: str, storage: Optional[str] = None) -> None:
+    _sto(storage).delete(workflow_id)
+    _dags.pop(workflow_id, None)

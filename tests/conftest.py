@@ -36,3 +36,8 @@ def rt_init():
 def cpu_mesh_devices():
     import jax
     return jax.devices("cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: longer learning/convergence tests")

@@ -1,0 +1,103 @@
+"""DAG + workflow tests (reference analogue: python/ray/dag tests and
+python/ray/workflow/tests — basic chains, resume-after-failure)."""
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def mul(a, b):
+    return a * b
+
+
+def test_dag_inline_execute():
+    with InputNode() as x:
+        d = add.bind(mul.bind(x, 2), 3)   # 2x + 3
+    assert d.execute(5) == 13
+
+
+def test_dag_multi_output():
+    with InputNode() as x:
+        d = MultiOutputNode([add.bind(x, 1), mul.bind(x, 10)])
+    assert d.execute(4) == [5, 40]
+
+
+def test_dag_diamond_shared_node():
+    calls = []
+
+    @ray_tpu.remote
+    def tracked(x):
+        calls.append(x)
+        return x + 1
+
+    with InputNode() as x:
+        shared = tracked.bind(x)
+        d = add.bind(shared, shared)
+    assert d.execute(1) == 4
+    assert calls == [1]  # shared node ran once
+
+
+def test_dag_through_runtime(rt_init):
+    with InputNode() as x:
+        d = add.bind(mul.bind(x, 3), mul.bind(x, 4))  # 3x + 4x
+    assert d.execute(2) == 14
+
+
+def test_actor_dag_inline():
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, n):
+            self.v += n
+            return self.v
+
+    c = Counter.bind(10)
+    d = c.add.bind(5)
+    assert d.execute() == 15
+
+
+def test_workflow_run_and_status(tmp_path):
+    with InputNode() as x:
+        d = add.bind(mul.bind(x, 2), 1)
+    out = workflow.run(d, 7, workflow_id="wf1", storage=str(tmp_path))
+    assert out == 15
+    assert workflow.get_status("wf1", storage=str(tmp_path)) == "SUCCESSFUL"
+    assert workflow.get_output("wf1", storage=str(tmp_path)) == 15
+    assert ("wf1", "SUCCESSFUL") in workflow.list_all(storage=str(tmp_path))
+
+
+def test_workflow_resume_skips_done(tmp_path):
+    calls = []
+
+    @ray_tpu.remote
+    def flaky(x):
+        calls.append("flaky")
+        if calls.count("flaky") == 1:
+            raise RuntimeError("transient")
+        return x * 10
+
+    @ray_tpu.remote
+    def expensive(x):
+        calls.append("expensive")
+        return x + 1
+
+    with InputNode() as x:
+        d = flaky.bind(expensive.bind(x))
+
+    with pytest.raises(RuntimeError):
+        workflow.run(d, 4, workflow_id="wf2", storage=str(tmp_path))
+    assert workflow.get_status("wf2", storage=str(tmp_path)) == "FAILED"
+    # resume: expensive's durable result is reused, flaky reruns
+    out = workflow.resume("wf2", storage=str(tmp_path))
+    assert out == 50
+    assert calls == ["expensive", "flaky", "flaky"]
+    assert workflow.get_status("wf2", storage=str(tmp_path)) == "SUCCESSFUL"

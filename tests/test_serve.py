@@ -1,0 +1,169 @@
+"""Serve tests (reference analogue: python/ray/serve/tests — HTTP against
+a local serve instance, handle calls, batching, autoscaling logic)."""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ray_tpu import serve
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    serve.shutdown()
+
+
+def test_handle_call_inproc():
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return 2 * x
+
+    h = serve.run(Doubler, use_actors=False)
+    assert h.remote(21).result() == 42
+
+
+def test_function_deployment_and_methods():
+    @serve.deployment(name="adder")
+    def add_one(x):
+        return x + 1
+
+    h = serve.run(add_one, use_actors=False)
+    assert h.remote(1).result() == 2
+
+    @serve.deployment
+    class Multi:
+        def __call__(self, x):
+            return x
+
+        def square(self, x):
+            return x * x
+
+    h2 = serve.run(Multi, use_actors=False)
+    assert h2.square.remote(5).result() == 25
+
+
+def test_bind_init_args():
+    @serve.deployment
+    class Scaled:
+        def __init__(self, k):
+            self.k = k
+
+        def __call__(self, x):
+            return self.k * x
+
+    h = serve.run(Scaled.bind(10), use_actors=False)
+    assert h.remote(4).result() == 40
+
+
+def test_num_replicas_and_status():
+    @serve.deployment(num_replicas=3)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    serve.run(Echo, use_actors=False)
+    st = serve.status()
+    assert st["Echo"]["replicas"] == 3
+
+
+def test_http_proxy_roundtrip():
+    @serve.deployment
+    class Greeter:
+        def __call__(self, req):
+            name = (req or {}).get("name", "world")
+            return {"hello": name}
+
+    serve.run(Greeter, use_actors=False, http=True)
+    addr = serve.proxy_address()
+    with urllib.request.urlopen(f"{addr}/-/healthz", timeout=10) as r:
+        assert json.load(r)["status"] == "ok"
+    req = urllib.request.Request(
+        f"{addr}/Greeter", data=json.dumps({"name": "tpu"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.load(r)["result"] == {"hello": "tpu"}
+    with urllib.request.urlopen(f"{addr}/-/routes", timeout=10) as r:
+        assert json.load(r) == ["Greeter"]
+
+
+def test_batching_collects():
+    calls = []
+
+    @serve.deployment(max_concurrent_queries=16)
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def handle(self, items):
+            calls.append(len(items))
+            return [i * 10 for i in items]
+
+        def __call__(self, x):
+            return self.handle(x)
+
+    h = serve.run(Batched, use_actors=False)
+    rs = [h.remote(i) for i in range(8)]
+    out = sorted(r.result(timeout=30) for r in rs)
+    assert out == [0, 10, 20, 30, 40, 50, 60, 70]
+    assert max(calls) > 1  # at least one real batch formed
+
+
+def test_actor_replicas(rt_init):
+    @serve.deployment(num_replicas=2)
+    class PidEcho:
+        def __call__(self, _):
+            import os
+            return os.getpid()
+
+    h = serve.run(PidEcho, use_actors=True)
+    pids = {h.remote(None).result(timeout=60) for _ in range(6)}
+    assert len(pids) >= 1
+    import os
+    assert os.getpid() not in pids  # really ran out-of-process
+
+
+def test_autoscaling_math():
+    from ray_tpu.serve.controller import DeploymentState
+    from ray_tpu.serve.deployment import (AutoscalingConfig, Deployment,
+                                          DeploymentOptions)
+
+    @serve.deployment(autoscaling_config={"min_replicas": 1,
+                                          "max_replicas": 3,
+                                          "target_ongoing_requests": 1.0})
+    class Slow:
+        def __call__(self, x):
+            return x
+
+    st = DeploymentState(Slow, use_actors=False)
+    assert len(st.replicas) == 1
+    st.replicas[0].ongoing = 5  # fake load
+    st.autoscale_tick()
+    assert len(st.replicas) == 2
+    for r in st.replicas:
+        r.ongoing = 0
+    st.autoscale_tick()
+    assert len(st.replicas) == 1
+
+
+def test_batching_per_instance_isolation():
+    @serve.deployment
+    class Stateful:
+        def __init__(self):
+            self.seen = []
+
+        @serve.batch(max_batch_size=2, batch_wait_timeout_s=0.02)
+        def handle(self, items):
+            self.seen.extend(items)
+            return [(id(self), i) for i in items]
+
+        def __call__(self, x):
+            return self.handle(x)
+
+    a, b = Stateful.build_replica(), Stateful.build_replica()
+    ra = a.handle(1)
+    rb = b.handle(2)
+    assert a.seen == [1] and b.seen == [2]  # no cross-instance leakage
+    assert ra[1] != rb[1] or ra[0] != rb[0]

@@ -159,3 +159,18 @@ def test_actor_mode(tmp_path):
         assert not grid.errors
     finally:
         ray_tpu.shutdown()
+
+
+def test_concurrency_limiter_runs_all(tmp_path):
+    from ray_tpu.tune import BasicVariantGenerator, ConcurrencyLimiter
+    limiter = ConcurrencyLimiter(
+        BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=5,
+                              seed=0),
+        max_concurrent=2)
+    tuner = Tuner(
+        _objective,
+        tune_config=TuneConfig(search_alg=limiter),
+        run_config=RunConfig(name="lim", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert len(grid) == 5  # all samples ran despite the cap
+    assert all(t.status == "TERMINATED" for t in grid.trials)

@@ -1,0 +1,42 @@
+"""Util tests (reference analogue: python/ray/tests/test_actor_pool.py,
+test_queue.py)."""
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Queue
+
+
+@ray_tpu.remote
+class Doubler:
+    def work(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map(rt_init):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+
+
+def test_actor_pool_unordered(rt_init):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = sorted(pool.map_unordered(lambda a, v: a.work.remote(v), range(5)))
+    assert out == [0, 2, 4, 6, 8]
+
+
+def test_queue_fifo(rt_init):
+    q = Queue()
+    for i in range(4):
+        q.put(i)
+    assert q.qsize() == 4
+    assert [q.get() for _ in range(4)] == [0, 1, 2, 3]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+
+def test_queue_shared_by_name(rt_init):
+    q1 = Queue(name="shared_q")
+    q2 = Queue(name="shared_q")
+    q1.put("hello")
+    assert q2.get(timeout=30) == "hello"
