@@ -1,0 +1,104 @@
+/* rt_store — node-local shared-memory object store (C API).
+ *
+ * Capability analogue of the reference's plasma store
+ * (reference: src/ray/object_manager/plasma/store.h:55 — node-local
+ * immutable shared-memory objects; dlmalloc over mmap'd shm
+ * plasma/dlmalloc.cc; refcount-aware eviction eviction_policy.h), built
+ * TPU-host-native: one mmap'd POSIX shm arena per node, an in-shm
+ * first-fit free-list allocator with coalescing, an open-addressing
+ * object table, and a process-shared robust mutex so every worker
+ * process on the host can create/seal/get objects with zero-copy reads.
+ *
+ * All offsets returned are relative to the arena base so each process
+ * can resolve them against its own mapping.  Clients load this library
+ * via ctypes (no pybind11 in the image) and mmap /dev/shm/<name>
+ * themselves for the data plane.
+ */
+#ifndef RT_STORE_H
+#define RT_STORE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define RT_ID_SIZE 28  /* ObjectID width, matches ray_tpu.core.ids */
+
+/* Error codes (negative returns). */
+#define RT_OK 0
+#define RT_ERR_EXISTS -1
+#define RT_ERR_OOM -2
+#define RT_ERR_NOT_FOUND -3
+#define RT_ERR_NOT_SEALED -4
+#define RT_ERR_IN_USE -5
+#define RT_ERR_TABLE_FULL -6
+#define RT_ERR_SYS -7
+
+/* Object states (rt_obj_contains return values). */
+#define RT_STATE_ABSENT 0
+#define RT_STATE_CREATED 1
+#define RT_STATE_SEALED 2
+
+typedef struct rt_store rt_store; /* opaque per-process handle */
+
+/* Create the arena (head/node service).  capacity = data heap bytes;
+ * table_slots = object table capacity (power of two recommended).
+ * Returns NULL on failure.  If the segment already exists, attaches. */
+rt_store *rt_store_create(const char *name, uint64_t capacity,
+                          uint32_t table_slots);
+
+/* Attach to an existing arena (worker).  NULL if absent/invalid. */
+rt_store *rt_store_attach(const char *name);
+
+/* Unmap (does not destroy the segment). */
+void rt_store_detach(rt_store *s);
+
+/* Remove the shm segment from the system (after all detach). */
+int rt_store_destroy(const char *name);
+
+/* Total size of the mapping in bytes (mmap this much from the shm file). */
+uint64_t rt_store_map_bytes(rt_store *s);
+
+/* Allocate an object.  Returns data offset (>=0) or RT_ERR_*. */
+int64_t rt_obj_create(rt_store *s, const uint8_t *id, uint64_t size);
+
+/* Mark immutable; only sealed objects are gettable. */
+int rt_obj_seal(rt_store *s, const uint8_t *id);
+
+/* Get a sealed object: refcount++, returns offset, fills *size.
+ * RT_ERR_NOT_FOUND / RT_ERR_NOT_SEALED otherwise. */
+int64_t rt_obj_get(rt_store *s, const uint8_t *id, uint64_t *size_out);
+
+/* Lookup without touching the refcount (node-side spill/inspection). */
+int64_t rt_obj_lookup(rt_store *s, const uint8_t *id, uint64_t *size_out);
+
+/* Drop one reference taken by rt_obj_get. */
+int rt_obj_release(rt_store *s, const uint8_t *id);
+
+/* Delete an object and free its block.  Fails with RT_ERR_IN_USE if the
+ * refcount is nonzero (a process still holds a zero-copy view). */
+int rt_obj_delete(rt_store *s, const uint8_t *id);
+
+/* RT_STATE_* for the id. */
+int rt_obj_contains(rt_store *s, const uint8_t *id);
+
+uint64_t rt_obj_refcount(rt_store *s, const uint8_t *id);
+
+/* LRU eviction candidates: sealed, refcount==0, oldest-access first,
+ * until their sizes sum to >= nbytes.  Writes up to max_out ids into
+ * out_ids (RT_ID_SIZE bytes each); returns the count. */
+int rt_evict_candidates(rt_store *s, uint64_t nbytes, uint8_t *out_ids,
+                        int max_out);
+
+/* Stats. */
+uint64_t rt_store_used(rt_store *s);
+uint64_t rt_store_capacity(rt_store *s);
+uint64_t rt_store_num_objects(rt_store *s);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* RT_STORE_H */

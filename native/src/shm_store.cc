@@ -1,0 +1,471 @@
+/* Shared-memory object store implementation.  See rt_store.h.
+ *
+ * Layout of the shm segment (all offsets relative to base):
+ *   [Header][Slot x table_slots][heap ...]
+ * The heap is managed by a first-fit free list sorted by offset with
+ * two-sided coalescing on free.  Everything mutable lives inside the
+ * segment under one process-shared robust pthread mutex, so any worker
+ * process can allocate/seal/get concurrently and a crashed holder
+ * cannot wedge the store.
+ */
+#include "rt_store.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <new>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545354'4f524531ULL; /* "RTSTORE1" */
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kAlign = 64;
+constexpr uint64_t kMinSplit = 128; /* don't split blocks smaller than this */
+
+inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+enum SlotState : uint32_t {
+  SLOT_EMPTY = 0,
+  SLOT_CREATED = 1,
+  SLOT_SEALED = 2,
+  SLOT_TOMBSTONE = 3,
+};
+
+struct Slot {
+  uint8_t id[RT_ID_SIZE];
+  uint32_t state;
+  uint64_t offset; /* data offset from base */
+  uint64_t size;   /* user size */
+  uint32_t refcount;
+  uint32_t pad_;
+  uint64_t lru_tick;
+};
+
+/* Heap block header.  `size` includes the header and is kAlign-aligned.
+ * When free, `next` is the offset of the next free block (0 = end of
+ * list); when allocated, `next` == kInUse. */
+constexpr uint64_t kInUse = ~0ULL;
+struct Block {
+  uint64_t size;
+  uint64_t next;
+};
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t table_slots;
+  uint64_t capacity;   /* heap bytes */
+  uint64_t used;       /* sum of live user sizes */
+  uint64_t table_offset;
+  uint64_t heap_offset;
+  uint64_t heap_end;
+  uint64_t free_head;  /* offset of first free Block, 0 = none */
+  uint64_t lru_clock;
+  uint64_t num_objects;
+  pthread_mutex_t lock;
+};
+
+} // namespace
+
+struct rt_store {
+  void *base;
+  uint64_t map_bytes;
+  Header *hdr() const { return static_cast<Header *>(base); }
+  Slot *slots() const {
+    return reinterpret_cast<Slot *>(static_cast<char *>(base) +
+                                    hdr()->table_offset);
+  }
+  Block *block_at(uint64_t off) const {
+    return reinterpret_cast<Block *>(static_cast<char *>(base) + off);
+  }
+};
+
+namespace {
+
+/* Robust lock: recover consistency if a holder died. */
+void lock_hdr(Header *h) {
+  int rc = pthread_mutex_lock(&h->lock);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->lock);
+}
+void unlock_hdr(Header *h) { pthread_mutex_unlock(&h->lock); }
+
+uint64_t hash_id(const uint8_t *id) {
+  /* FNV-1a over the 28-byte id. */
+  uint64_t h = 1469598103934665603ULL;
+  for (int i = 0; i < RT_ID_SIZE; ++i) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+/* Find the slot for `id`; returns nullptr if absent.  If `for_insert`,
+ * returns the first reusable slot (empty/tombstone) when absent, or
+ * nullptr if the table is full. */
+Slot *find_slot(rt_store *s, const uint8_t *id, bool for_insert) {
+  Header *h = s->hdr();
+  Slot *tab = s->slots();
+  uint32_t n = h->table_slots;
+  uint64_t start = hash_id(id) & (n - 1);
+  Slot *insert_at = nullptr;
+  for (uint32_t i = 0; i < n; ++i) {
+    Slot *sl = &tab[(start + i) & (n - 1)];
+    if (sl->state == SLOT_EMPTY) {
+      if (for_insert) return insert_at ? insert_at : sl;
+      return nullptr;
+    }
+    if (sl->state == SLOT_TOMBSTONE) {
+      if (!insert_at) insert_at = sl;
+      continue;
+    }
+    if (memcmp(sl->id, id, RT_ID_SIZE) == 0) return sl;
+  }
+  return for_insert ? insert_at : nullptr;
+}
+
+/* First-fit allocation from the sorted free list.  Returns data offset
+ * (past the Block header) or 0 on OOM. */
+uint64_t heap_alloc(rt_store *s, uint64_t user_size) {
+  Header *h = s->hdr();
+  uint64_t need = align_up(user_size + sizeof(Block), kAlign);
+  uint64_t prev_off = 0;
+  uint64_t off = h->free_head;
+  while (off) {
+    Block *b = s->block_at(off);
+    if (b->size >= need) {
+      uint64_t remainder = b->size - need;
+      uint64_t next = b->next;
+      if (remainder >= kMinSplit) {
+        uint64_t tail_off = off + need;
+        Block *tail = s->block_at(tail_off);
+        tail->size = remainder;
+        tail->next = next;
+        next = tail_off;
+        b->size = need;
+      }
+      if (prev_off)
+        s->block_at(prev_off)->next = next;
+      else
+        h->free_head = next;
+      b->next = kInUse;
+      return off + sizeof(Block);
+    }
+    prev_off = off;
+    off = b->next;
+  }
+  return 0;
+}
+
+/* Free a block, keeping the list sorted by offset and coalescing with
+ * adjacent free blocks on both sides. */
+void heap_free(rt_store *s, uint64_t data_off) {
+  Header *h = s->hdr();
+  uint64_t off = data_off - sizeof(Block);
+  Block *b = s->block_at(off);
+  b->next = 0;
+  /* find insertion point (prev < off < cur) */
+  uint64_t prev_off = 0, cur = h->free_head;
+  while (cur && cur < off) {
+    prev_off = cur;
+    cur = s->block_at(cur)->next;
+  }
+  b->next = cur;
+  if (prev_off)
+    s->block_at(prev_off)->next = off;
+  else
+    h->free_head = off;
+  /* coalesce forward */
+  if (cur && off + b->size == cur) {
+    Block *nb = s->block_at(cur);
+    b->size += nb->size;
+    b->next = nb->next;
+  }
+  /* coalesce backward */
+  if (prev_off) {
+    Block *pb = s->block_at(prev_off);
+    if (prev_off + pb->size == off) {
+      pb->size += b->size;
+      pb->next = b->next;
+    }
+  }
+}
+
+rt_store *map_store(int fd, bool init, uint64_t capacity,
+                    uint32_t table_slots) {
+  uint64_t map_bytes;
+  if (init) {
+    uint64_t table_bytes = align_up(uint64_t(table_slots) * sizeof(Slot),
+                                    kAlign);
+    uint64_t hdr_bytes = align_up(sizeof(Header), kAlign);
+    /* heap gets `capacity` bytes plus block-header overhead slack */
+    uint64_t heap_bytes = align_up(capacity + capacity / 8 + (1 << 20),
+                                   kAlign);
+    map_bytes = hdr_bytes + table_bytes + heap_bytes;
+    if (ftruncate(fd, off_t(map_bytes)) != 0) return nullptr;
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0) return nullptr;
+    map_bytes = uint64_t(st.st_size);
+    if (map_bytes < sizeof(Header)) return nullptr;
+  }
+  void *base = mmap(nullptr, map_bytes, PROT_READ | PROT_WRITE, MAP_SHARED,
+                    fd, 0);
+  if (base == MAP_FAILED) return nullptr;
+  rt_store *s = new (std::nothrow) rt_store{base, map_bytes};
+  if (!s) {
+    munmap(base, map_bytes);
+    return nullptr;
+  }
+  Header *h = s->hdr();
+  if (init) {
+    memset(base, 0, sizeof(Header));
+    h->version = kVersion;
+    h->table_slots = table_slots;
+    h->capacity = capacity;
+    h->table_offset = align_up(sizeof(Header), kAlign);
+    uint64_t table_bytes = align_up(uint64_t(table_slots) * sizeof(Slot),
+                                    kAlign);
+    h->heap_offset = h->table_offset + table_bytes;
+    h->heap_end = map_bytes;
+    memset(s->slots(), 0, table_bytes);
+    /* one big free block */
+    Block *b = s->block_at(h->heap_offset);
+    b->size = h->heap_end - h->heap_offset;
+    b->next = 0;
+    h->free_head = h->heap_offset;
+    pthread_mutexattr_t at;
+    pthread_mutexattr_init(&at);
+    pthread_mutexattr_setpshared(&at, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&at, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&h->lock, &at);
+    pthread_mutexattr_destroy(&at);
+    __sync_synchronize();
+    h->magic = kMagic; /* publish: attachers poll for the magic */
+  } else if (h->magic != kMagic) {
+    munmap(base, map_bytes);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+} // namespace
+
+extern "C" {
+
+rt_store *rt_store_create(const char *name, uint64_t capacity,
+                          uint32_t table_slots) {
+  /* round table to a power of two */
+  uint32_t n = 1;
+  while (n < table_slots) n <<= 1;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    if (errno == EEXIST) return rt_store_attach(name);
+    return nullptr;
+  }
+  rt_store *s = map_store(fd, /*init=*/true, capacity, n);
+  close(fd);
+  if (!s) shm_unlink(name);
+  return s;
+}
+
+rt_store *rt_store_attach(const char *name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  rt_store *s = map_store(fd, /*init=*/false, 0, 0);
+  close(fd);
+  return s;
+}
+
+void rt_store_detach(rt_store *s) {
+  if (!s) return;
+  munmap(s->base, s->map_bytes);
+  delete s;
+}
+
+int rt_store_destroy(const char *name) {
+  return shm_unlink(name) == 0 ? RT_OK : RT_ERR_SYS;
+}
+
+uint64_t rt_store_map_bytes(rt_store *s) { return s->map_bytes; }
+
+int64_t rt_obj_create(rt_store *s, const uint8_t *id, uint64_t size) {
+  Header *h = s->hdr();
+  lock_hdr(h);
+  Slot *sl = find_slot(s, id, /*for_insert=*/true);
+  if (!sl) {
+    unlock_hdr(h);
+    return RT_ERR_TABLE_FULL;
+  }
+  if (sl->state == SLOT_CREATED || sl->state == SLOT_SEALED) {
+    unlock_hdr(h);
+    return RT_ERR_EXISTS;
+  }
+  uint64_t off = heap_alloc(s, size ? size : 1);
+  if (!off) {
+    unlock_hdr(h);
+    return RT_ERR_OOM;
+  }
+  memcpy(sl->id, id, RT_ID_SIZE);
+  sl->state = SLOT_CREATED;
+  sl->offset = off;
+  sl->size = size;
+  sl->refcount = 0;
+  sl->lru_tick = ++h->lru_clock;
+  h->used += size;
+  h->num_objects++;
+  unlock_hdr(h);
+  return int64_t(off);
+}
+
+int rt_obj_seal(rt_store *s, const uint8_t *id) {
+  Header *h = s->hdr();
+  lock_hdr(h);
+  Slot *sl = find_slot(s, id, false);
+  if (!sl) {
+    unlock_hdr(h);
+    return RT_ERR_NOT_FOUND;
+  }
+  sl->state = SLOT_SEALED;
+  unlock_hdr(h);
+  return RT_OK;
+}
+
+static int64_t obj_find(rt_store *s, const uint8_t *id, uint64_t *size_out,
+                        bool take_ref) {
+  Header *h = s->hdr();
+  lock_hdr(h);
+  Slot *sl = find_slot(s, id, false);
+  if (!sl) {
+    unlock_hdr(h);
+    return RT_ERR_NOT_FOUND;
+  }
+  if (sl->state != SLOT_SEALED) {
+    unlock_hdr(h);
+    return RT_ERR_NOT_SEALED;
+  }
+  if (take_ref) sl->refcount++;
+  sl->lru_tick = ++h->lru_clock;
+  if (size_out) *size_out = sl->size;
+  int64_t off = int64_t(sl->offset);
+  unlock_hdr(h);
+  return off;
+}
+
+int64_t rt_obj_get(rt_store *s, const uint8_t *id, uint64_t *size_out) {
+  return obj_find(s, id, size_out, /*take_ref=*/true);
+}
+
+int64_t rt_obj_lookup(rt_store *s, const uint8_t *id, uint64_t *size_out) {
+  return obj_find(s, id, size_out, /*take_ref=*/false);
+}
+
+int rt_obj_release(rt_store *s, const uint8_t *id) {
+  Header *h = s->hdr();
+  lock_hdr(h);
+  Slot *sl = find_slot(s, id, false);
+  if (!sl) {
+    unlock_hdr(h);
+    return RT_ERR_NOT_FOUND;
+  }
+  if (sl->refcount > 0) sl->refcount--;
+  unlock_hdr(h);
+  return RT_OK;
+}
+
+int rt_obj_delete(rt_store *s, const uint8_t *id) {
+  Header *h = s->hdr();
+  lock_hdr(h);
+  Slot *sl = find_slot(s, id, false);
+  if (!sl) {
+    unlock_hdr(h);
+    return RT_ERR_NOT_FOUND;
+  }
+  if (sl->refcount > 0) {
+    unlock_hdr(h);
+    return RT_ERR_IN_USE;
+  }
+  heap_free(s, sl->offset);
+  h->used -= sl->size;
+  h->num_objects--;
+  sl->state = SLOT_TOMBSTONE;
+  /* Tombstone reclamation: if the next probe slot is EMPTY, this
+   * tombstone (and any run of tombstones before it) cannot be part of
+   * any live probe chain — convert the run back to EMPTY so absent-id
+   * probes stay short even after heavy id churn. */
+  {
+    Slot *tab = s->slots();
+    uint32_t n = h->table_slots;
+    uint32_t i = uint32_t(sl - tab);
+    if (tab[(i + 1) & (n - 1)].state == SLOT_EMPTY) {
+      while (tab[i].state == SLOT_TOMBSTONE) {
+        tab[i].state = SLOT_EMPTY;
+        i = (i + n - 1) & (n - 1);
+      }
+    }
+  }
+  unlock_hdr(h);
+  return RT_OK;
+}
+
+int rt_obj_contains(rt_store *s, const uint8_t *id) {
+  Header *h = s->hdr();
+  lock_hdr(h);
+  Slot *sl = find_slot(s, id, false);
+  int st = RT_STATE_ABSENT;
+  if (sl) {
+    if (sl->state == SLOT_CREATED) st = RT_STATE_CREATED;
+    else if (sl->state == SLOT_SEALED) st = RT_STATE_SEALED;
+  }
+  unlock_hdr(h);
+  return st;
+}
+
+uint64_t rt_obj_refcount(rt_store *s, const uint8_t *id) {
+  Header *h = s->hdr();
+  lock_hdr(h);
+  Slot *sl = find_slot(s, id, false);
+  uint64_t rc = sl ? sl->refcount : 0;
+  unlock_hdr(h);
+  return rc;
+}
+
+int rt_evict_candidates(rt_store *s, uint64_t nbytes, uint8_t *out_ids,
+                        int max_out) {
+  Header *h = s->hdr();
+  lock_hdr(h);
+  Slot *tab = s->slots();
+  uint32_t n = h->table_slots;
+  int count = 0;
+  uint64_t freed = 0;
+  /* selection sort over evictable slots by lru_tick — candidate sets are
+   * small (bounded by max_out), table scans are cheap vs. an eviction */
+  uint64_t last_tick = 0;
+  while (count < max_out && freed < nbytes) {
+    Slot *best = nullptr;
+    for (uint32_t i = 0; i < n; ++i) {
+      Slot *sl = &tab[i];
+      if (sl->state != SLOT_SEALED || sl->refcount != 0) continue;
+      if (sl->lru_tick <= last_tick) continue;
+      if (!best || sl->lru_tick < best->lru_tick) best = sl;
+    }
+    if (!best) break;
+    last_tick = best->lru_tick;
+    memcpy(out_ids + size_t(count) * RT_ID_SIZE, best->id, RT_ID_SIZE);
+    freed += best->size;
+    count++;
+  }
+  unlock_hdr(h);
+  return count;
+}
+
+uint64_t rt_store_used(rt_store *s) { return s->hdr()->used; }
+uint64_t rt_store_capacity(rt_store *s) { return s->hdr()->capacity; }
+uint64_t rt_store_num_objects(rt_store *s) { return s->hdr()->num_objects; }
+
+} /* extern "C" */

@@ -1,0 +1,156 @@
+/* Unit + multiprocess tests for rt_store (run via `make test`).
+ * Mirrors the coverage style of the reference's plasma tests
+ * (reference: src/ray/object_manager/test/) with plain asserts. */
+#include "rt_store.h"
+
+#include <assert.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static void make_id(uint8_t *id, int n) {
+  memset(id, 0, RT_ID_SIZE);
+  memcpy(id, &n, sizeof(n));
+}
+
+static void test_basic() {
+  const char *name = "/rt_test_basic";
+  rt_store_destroy(name);
+  rt_store *s = rt_store_create(name, 1 << 20, 256);
+  assert(s);
+  uint8_t id[RT_ID_SIZE];
+  make_id(id, 1);
+
+  int64_t off = rt_obj_create(s, id, 1000);
+  assert(off > 0);
+  assert(rt_obj_contains(s, id) == RT_STATE_CREATED);
+  /* not gettable until sealed */
+  uint64_t sz = 0;
+  assert(rt_obj_get(s, id, &sz) == RT_ERR_NOT_SEALED);
+  /* duplicate create rejected */
+  assert(rt_obj_create(s, id, 10) == RT_ERR_EXISTS);
+
+  char *base = nullptr;
+  {
+    /* write through our own mapping */
+    rt_store *s2 = rt_store_attach(name);
+    assert(s2);
+    rt_store_detach(s2);
+  }
+  assert(rt_obj_seal(s, id) == RT_OK);
+  int64_t off2 = rt_obj_get(s, id, &sz);
+  assert(off2 == off && sz == 1000);
+  assert(rt_obj_refcount(s, id) == 1);
+  /* in-use delete rejected */
+  assert(rt_obj_delete(s, id) == RT_ERR_IN_USE);
+  assert(rt_obj_release(s, id) == RT_OK);
+  assert(rt_obj_delete(s, id) == RT_OK);
+  assert(rt_obj_contains(s, id) == RT_STATE_ABSENT);
+  assert(rt_store_num_objects(s) == 0);
+  (void)base;
+  rt_store_detach(s);
+  rt_store_destroy(name);
+  printf("test_basic ok\n");
+}
+
+static void test_alloc_reuse() {
+  const char *name = "/rt_test_alloc";
+  rt_store_destroy(name);
+  rt_store *s = rt_store_create(name, 1 << 20, 256);
+  assert(s);
+  uint8_t id[RT_ID_SIZE];
+  /* fill, free all, then a big alloc must fit again (coalescing) */
+  int n = 0;
+  for (;; ++n) {
+    make_id(id, n);
+    int64_t off = rt_obj_create(s, id, 60000);
+    if (off == RT_ERR_OOM) break;
+    assert(off > 0);
+    rt_obj_seal(s, id);
+  }
+  assert(n >= 16);
+  for (int i = 0; i < n; ++i) {
+    make_id(id, i);
+    assert(rt_obj_delete(s, id) == RT_OK);
+  }
+  assert(rt_store_used(s) == 0);
+  make_id(id, 9999);
+  int64_t off = rt_obj_create(s, id, 900000);
+  assert(off > 0);
+  rt_store_detach(s);
+  rt_store_destroy(name);
+  printf("test_alloc_reuse ok (%d blocks)\n", n);
+}
+
+static void test_eviction_order() {
+  const char *name = "/rt_test_evict";
+  rt_store_destroy(name);
+  rt_store *s = rt_store_create(name, 1 << 20, 256);
+  uint8_t id[RT_ID_SIZE];
+  for (int i = 0; i < 4; ++i) {
+    make_id(id, i);
+    assert(rt_obj_create(s, id, 1000) > 0);
+    rt_obj_seal(s, id);
+  }
+  /* touch 0 so 1 becomes LRU; pin 1? no — get 0 bumps its tick */
+  make_id(id, 0);
+  uint64_t sz;
+  rt_obj_get(s, id, &sz);
+  rt_obj_release(s, id);
+  uint8_t out[4 * RT_ID_SIZE];
+  int c = rt_evict_candidates(s, 1500, out, 4);
+  assert(c == 2);
+  int got0, got1;
+  memcpy(&got0, out, sizeof(int));
+  memcpy(&got1, out + RT_ID_SIZE, sizeof(int));
+  assert(got0 == 1 && got1 == 2); /* oldest ticks first, 0 was refreshed */
+  /* pinned objects are never candidates */
+  make_id(id, 1);
+  rt_obj_get(s, id, &sz);
+  c = rt_evict_candidates(s, 100, out, 4);
+  memcpy(&got0, out, sizeof(int));
+  assert(c >= 1 && got0 == 2);
+  rt_store_detach(s);
+  rt_store_destroy(name);
+  printf("test_eviction_order ok\n");
+}
+
+static void test_multiprocess() {
+  const char *name = "/rt_test_mp";
+  rt_store_destroy(name);
+  rt_store *s = rt_store_create(name, 1 << 22, 1024);
+  assert(s);
+  uint8_t id[RT_ID_SIZE];
+  make_id(id, 42);
+
+  pid_t pid = fork();
+  if (pid == 0) {
+    /* child: create, write, seal */
+    rt_store *c = rt_store_attach(name);
+    assert(c);
+    int64_t off = rt_obj_create(c, id, 256);
+    assert(off > 0);
+    _exit(0);
+  }
+  int status = 0;
+  waitpid(pid, &status, 0);
+  assert(WIFEXITED(status) && WEXITSTATUS(status) == 0);
+  /* parent sees the child's object */
+  assert(rt_obj_contains(s, id) == RT_STATE_CREATED);
+  assert(rt_obj_seal(s, id) == RT_OK);
+  uint64_t sz = 0;
+  assert(rt_obj_get(s, id, &sz) > 0 && sz == 256);
+  rt_store_detach(s);
+  rt_store_destroy(name);
+  printf("test_multiprocess ok\n");
+}
+
+int main() {
+  test_basic();
+  test_alloc_reuse();
+  test_eviction_order();
+  test_multiprocess();
+  printf("ALL OK\n");
+  return 0;
+}

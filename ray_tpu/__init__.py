@@ -53,6 +53,12 @@ def free(refs):
     return get_runtime().free(list(refs))
 
 
+def object_store_stats():
+    """Node object-store stats (size, spill counters, backend)."""
+    rt = get_runtime()
+    return rt.client.request({"t": "object_stats"})["stats"]
+
+
 def available_resources():
     rt = get_runtime()
     return rt.client.request({"t": "state", "what": "resources"})["data"]["available"]

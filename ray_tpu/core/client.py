@@ -19,7 +19,7 @@ from typing import Any, Callable, Optional
 
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ObjectID
-from ray_tpu.core.object_store import SharedMemoryClient
+from ray_tpu.core.object_store import ObjectExists, make_shm_client
 from ray_tpu.core.serialization import (SerializedObject, get_context)
 
 
@@ -68,7 +68,9 @@ class NodeClient:
         self.session: str = info["session"]
         self.node_id: str = info["node_id"]
         self.config_dict: dict = info["config"]
-        self.shm = SharedMemoryClient(self.session)
+        self.shm = make_shm_client(self.session,
+                                   native=bool(info.get("native_store")),
+                                   on_full=self._need_space)
         self._serde = get_context()
 
     # ----------------------------------------------------------- plumbing
@@ -133,6 +135,10 @@ class NodeClient:
     def closed(self) -> bool:
         return self._closed.is_set()
 
+    def _need_space(self, nbytes: int) -> None:
+        """Arena full: ask the node to spill, then the caller retries."""
+        self.request({"t": "need_space", "nbytes": int(nbytes)})
+
     # ------------------------------------------------------- object plane
 
     def put_object(self, object_id: ObjectID, value: Any,
@@ -156,9 +162,13 @@ class NodeClient:
                        "data": so.to_bytes(), "is_error": is_error,
                        "owner": owner or self.worker_id})
         else:
-            buf = self.shm.create(object_id, size)
-            _write_into(so, buf)
-            del buf
+            try:
+                buf = self.shm.create(object_id, size)
+                _write_into(so, buf)
+                del buf
+                self.shm.seal(object_id)
+            except ObjectExists:
+                pass  # identical value already stored (retried put)
             self.send({"t": "register_object",
                        "object_id": object_id.binary(), "size": size,
                        "owner": owner or self.worker_id})

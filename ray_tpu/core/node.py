@@ -41,7 +41,8 @@ from typing import Any, Optional
 
 from ray_tpu._config import RayTpuConfig
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
-from ray_tpu.core.object_store import ObjectStoreCore
+from ray_tpu.core.object_store import (NativeObjectStoreCore,
+                                       make_object_store_core)
 from ray_tpu.core.protocol import dumps_frame
 
 _HDR = struct.Struct("<Q")
@@ -139,7 +140,9 @@ class NodeService:
         self.available = dict(self.total_resources)
 
         spill_dir = config.object_spilling_dir or os.path.join(session_dir, "spill")
-        self.store = ObjectStoreCore(session, config.object_store_memory, spill_dir)
+        self.store = make_object_store_core(session,
+                                            config.object_store_memory,
+                                            spill_dir)
 
         self.sel = selectors.DefaultSelector()
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -385,7 +388,9 @@ class NodeService:
             self._spawning = max(0, self._spawning - 1)
         self._reply(rec, m["reqid"], session=self.session,
                     node_id=self.node_id.hex(), address=self.address,
-                    config=self.config.to_dict())
+                    config=self.config.to_dict(),
+                    native_store=isinstance(self.store,
+                                            NativeObjectStoreCore))
         self._schedule()
 
     # -- objects
@@ -451,6 +456,14 @@ class NodeService:
                 results.append({"loc": "inline", "data": info.data,
                                 "is_error": info.is_error})
         self._reply(rec, reqid, results=results)
+
+    def _h_need_space(self, rec, m):
+        # A client's arena allocation failed: spill unpinned objects
+        # (reference: plasma create_request_queue.h queues client creates
+        # until eviction frees memory — here the client blocks on this
+        # request and retries).
+        freed = self.store.evict_for(int(m["nbytes"]))
+        self._reply(rec, m["reqid"], freed=freed)
 
     def _h_release_pins(self, rec, m):
         ids = {ObjectID(b) for b in m["object_ids"]}

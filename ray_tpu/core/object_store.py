@@ -32,12 +32,30 @@ def _segment_name(session: str, object_id: ObjectID) -> str:
     return f"rt_{session[:8]}_{object_id.hex()}"
 
 
+def arena_name(session: str) -> str:
+    """Name of the node-wide native shm arena for a session."""
+    return f"rta_{session[:8]}"
+
+
+def native_store_enabled() -> bool:
+    """Native C++ arena store is the default; RAY_TPU_NATIVE_STORE=0
+    falls back to the pure-python per-object-segment store."""
+    if os.environ.get("RAY_TPU_NATIVE_STORE", "1") == "0":
+        return False
+    from ray_tpu import native
+    return native.available()
+
+
 class SharedMemoryClient:
     """Create/map shm segments. One per process."""
 
     def __init__(self, session: str):
         self._session = session
         self._open: dict[str, shared_memory.SharedMemory] = {}
+
+    def seal(self, object_id: ObjectID) -> None:
+        """Per-object segments are implicitly sealed by the register
+        message ordering; the native arena needs an explicit seal."""
 
     def create(self, object_id: ObjectID, size: int) -> memoryview:
         name = _segment_name(self._session, object_id)
@@ -163,7 +181,11 @@ class ObjectStoreCore:
         buf = self._shm.create(object_id, len(data))
         buf[:] = data
         del buf
+        self._shm.seal(object_id)
         e.in_shm = True
+        # refresh recency: the end-of-restore eviction pass must not pick
+        # the object we just brought back
+        e.last_access = time.monotonic()
         self.used += e.size
         os.unlink(e.spill_path)
         e.spill_path = None
@@ -183,6 +205,10 @@ class ObjectStoreCore:
                 os.unlink(e.spill_path)
             except FileNotFoundError:
                 pass
+
+    def evict_for(self, nbytes: int) -> int:
+        """Free >= nbytes (client need-space requests)."""
+        return self._evict(nbytes)
 
     def _evict(self, nbytes: int) -> int:
         """Spill unpinned objects, oldest-access first, until `nbytes` freed."""
@@ -224,3 +250,243 @@ class ObjectStoreCore:
         for oid in list(self.entries):
             self.delete(oid)
         self._shm.shutdown()
+
+
+# --------------------------------------------------------------------------
+# Native (C++) arena backend — one mmap'd shm arena per session, allocator
+# and object table in shared memory (native/src/shm_store.cc), the
+# capability analogue of plasma's dlmalloc-over-shm
+# (reference: src/ray/object_manager/plasma/{store.h,dlmalloc.cc}).
+# --------------------------------------------------------------------------
+
+
+class ObjectExists(Exception):
+    """A sealed object with this id is already in the store; the put is
+    an idempotent no-op (the value is deterministic for a given id)."""
+
+
+class NativeShmClient:
+    """SharedMemoryClient-compatible facade over the session arena.
+
+    ``create`` retries through an ``on_full`` callback (a synchronous
+    "need space" request to the node service, the analogue of plasma's
+    queued create requests, plasma/create_request_queue.h).
+    """
+
+    def __init__(self, session: str, on_full=None):
+        from ray_tpu.native.store import attach_with_retry
+        self._arena = attach_with_retry(arena_name(session))
+        self._on_full = on_full
+
+    def create(self, object_id: ObjectID, size: int):
+        from ray_tpu.native.store import (NativeObjectExists,
+                                          NativeStoreFull)
+        attempts = 0
+        while True:
+            try:
+                return self._arena.create(object_id.binary(), size)
+            except NativeObjectExists:
+                raise ObjectExists(object_id.hex()) from None
+            except NativeStoreFull:
+                attempts += 1
+                if self._on_full is None or attempts > 20:
+                    raise
+                self._on_full(size)
+
+    def seal(self, object_id: ObjectID) -> None:
+        self._arena.seal(object_id.binary())
+
+    def map(self, object_id: ObjectID):
+        arr = self._arena.get(object_id.binary())
+        if arr is None:
+            raise KeyError(f"object {object_id.hex()} not in arena")
+        return arr
+
+    def close(self, object_id: ObjectID) -> None:
+        # release is GC-driven (weakref.finalize on the mapped array)
+        pass
+
+    def unlink(self, object_id: ObjectID) -> None:
+        self._arena.delete(object_id.binary())
+
+    def shutdown(self) -> None:
+        self._arena.detach()
+
+
+def make_shm_client(session: str, native: bool, on_full=None):
+    """Client-side factory: the node tells clients (register reply)
+    whether the session runs the native arena."""
+    if native:
+        return NativeShmClient(session, on_full=on_full)
+    return SharedMemoryClient(session)
+
+
+class _NodeArenaClient:
+    """Node-side SharedMemoryClient-compatible facade over the arena.
+
+    ``create`` evicts (spills) through the owning core when the arena is
+    full; ``map`` is a refcount-free lookup (the node holds pins while it
+    reads, so GC-driven release is unnecessary on this side).
+    """
+
+    def __init__(self, core: "NativeObjectStoreCore"):
+        self._core = core
+
+    def create(self, object_id: ObjectID, size: int):
+        from ray_tpu.native.store import NativeStoreFull
+        for _ in range(8):
+            try:
+                return self._core._arena.create(object_id.binary(), size)
+            except NativeStoreFull:
+                freed = self._core._drain_pending_deletes()
+                freed += self._core._evict(size)
+                if freed == 0:
+                    break
+        raise NativeStoreFull(size)
+
+    def seal(self, object_id: ObjectID) -> None:
+        self._core._arena.seal(object_id.binary())
+
+    def map(self, object_id: ObjectID):
+        buf = self._core._arena.lookup(object_id.binary())
+        if buf is None:
+            raise KeyError(f"object {object_id.hex()} not in arena")
+        return buf
+
+    def close(self, object_id: ObjectID) -> None:
+        pass
+
+    def unlink(self, object_id: ObjectID) -> None:
+        e = self._core.entries.get(object_id)
+        self._core._delete_or_defer(object_id, e.size if e else 0)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class NativeObjectStoreCore(ObjectStoreCore):
+    """Node-side bookkeeping over the native arena.
+
+    Pin/LRU/spill policy stays in Python (it needs protocol context);
+    allocation, the object table, and zero-copy reads are C++.  Deletes
+    of objects with live zero-copy views are deferred until the native
+    refcount drains (plasma parallels: eviction_policy.h refcount-aware
+    eviction).
+    """
+
+    def __init__(self, session: str, capacity: int, spill_dir: str):
+        from ray_tpu.native.store import NativeArena
+        self.session = session
+        self.capacity = capacity
+        self.used = 0
+        self.spill_dir = spill_dir
+        self.entries: dict[ObjectID, _Entry] = {}
+        self._arena = NativeArena(arena_name(session), capacity=capacity,
+                                  create=True)
+        try:
+            self._shm = _NodeArenaClient(self)
+            os.makedirs(spill_dir, exist_ok=True)
+        except Exception:
+            self._arena.destroy()
+            raise
+        self.num_spilled = 0
+        self.num_restored = 0
+        # deferred deletes (live zero-copy views): id -> size, still
+        # counted in self.used until the arena block is truly reclaimed
+        self._pending_delete: dict[ObjectID, int] = {}
+
+    def register(self, object_id: ObjectID, size: int) -> None:
+        # a re-created deterministic id supersedes any deferred delete;
+        # its bytes were still counted in `used`, so drop them before
+        # the base register re-adds the entry
+        pending = self._pending_delete.pop(object_id, None)
+        if pending is not None:
+            self.used -= pending
+        super().register(object_id, size)
+
+    def evict_for(self, nbytes: int) -> int:
+        """Free >= nbytes from the arena (client need-space requests)."""
+        freed = self._drain_pending_deletes()
+        if freed < nbytes:
+            freed += self._evict(nbytes - freed)
+        return freed
+
+    def _delete_or_defer(self, object_id: ObjectID, size: int) -> bool:
+        """Arena delete; defer while zero-copy views hold native refs."""
+        from ray_tpu.native.store import RT_ERR_IN_USE
+        rc = self._arena.delete_rc(object_id.binary())
+        if rc == RT_ERR_IN_USE:
+            self._pending_delete[object_id] = size
+            return False
+        return rc == 0
+
+    def delete(self, object_id: ObjectID) -> None:
+        e = self.entries.pop(object_id, None)
+        if e is None:
+            return
+        if e.in_shm:
+            # memory is only un-counted once the block is reclaimed
+            if self._delete_or_defer(object_id, e.size):
+                self.used -= e.size
+        elif e.spill_path:
+            try:
+                os.unlink(e.spill_path)
+            except FileNotFoundError:
+                pass
+
+    def _spill(self, object_id: ObjectID) -> int:
+        e = self.entries[object_id]
+        id_bytes = object_id.binary()
+        buf = self._arena.lookup(id_bytes)
+        if buf is None:
+            return 0
+        path = os.path.join(self.spill_dir, object_id.hex())
+        with open(path, "wb") as f:
+            f.write(buf[: e.size])
+        del buf
+        if not self._arena.delete(id_bytes):
+            # a zero-copy view is alive somewhere; can't reclaim yet
+            os.unlink(path)
+            return 0
+        e.in_shm = False
+        e.spill_path = path
+        self.used -= e.size
+        self.num_spilled += 1
+        return e.size
+
+    def _drain_pending_deletes(self) -> int:
+        from ray_tpu.native.store import RT_ERR_IN_USE
+        freed = 0
+        for oid, size in list(self._pending_delete.items()):
+            rc = self._arena.delete_rc(oid.binary())
+            if rc != RT_ERR_IN_USE:
+                # deleted now, or already gone (NOT_FOUND): stop tracking
+                self._pending_delete.pop(oid, None)
+                self.used -= size
+                freed += size
+        return freed
+
+    def stats(self) -> dict:
+        s = super().stats()
+        s["native"] = True
+        s["arena_used_bytes"] = self._arena.used
+        s["arena_num_objects"] = self._arena.num_objects
+        return s
+
+    def shutdown(self) -> None:
+        for oid in list(self.entries):
+            self.delete(oid)
+        self._arena.destroy()
+
+
+def make_object_store_core(session: str, capacity: int, spill_dir: str):
+    """Node-side factory: native C++ arena when buildable, else python."""
+    if native_store_enabled():
+        try:
+            return NativeObjectStoreCore(session, capacity, spill_dir)
+        except Exception as e:
+            import logging
+            logging.getLogger("ray_tpu").warning(
+                "native object store unavailable (%s: %s); falling back "
+                "to the pure-python store", type(e).__name__, e)
+    return ObjectStoreCore(session, capacity, spill_dir)

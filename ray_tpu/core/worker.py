@@ -14,15 +14,17 @@ import sys
 
 
 def main() -> None:
-    # Workers must not touch the TPU (the driver owns it) — and the
-    # JAX_PLATFORMS env the spawner sets is not enough on hosts whose
-    # sitecustomize pre-imports jax with a platform plugin pinned, so
-    # force the CPU platform via config before any backend initializes.
-    try:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    # Workers must not touch the TPU (the driver owns it).  The spawner
+    # sets JAX_PLATFORMS=cpu, which covers any later jax import; the
+    # config override below is only needed on hosts whose sitecustomize
+    # PRE-imports jax with a platform plugin pinned — in that case jax
+    # is already in sys.modules and this costs nothing.  Avoid importing
+    # jax ourselves: it adds ~1-2s spawn latency for pure-CPU workloads.
+    if "jax" in sys.modules:
+        try:
+            sys.modules["jax"].config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--address", required=True)

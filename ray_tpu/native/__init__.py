@@ -1,0 +1,99 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The image has no pybind11, so Python reaches the C++ runtime through a
+plain C ABI (reference reaches its C++ CoreWorker through one Cython
+module, python/ray/_raylet.pyx:1490 — here the binding is ctypes over
+extern "C").  The shared library is built on demand with `make` (g++ is
+in the image); the build is cached next to this package.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB_NAME = "librt_store.so"
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)),
+                           "native")
+_lock = threading.Lock()
+_lib = None
+_load_error: Exception | None = None
+
+
+def _build() -> None:
+    subprocess.run(["make", "-s", "all"], cwd=_NATIVE_DIR, check=True,
+                   capture_output=True)
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if needed) librt_store.so; raises on failure."""
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            raise _load_error
+        path = os.path.join(_PKG_DIR, _LIB_NAME)
+        try:
+            if not os.path.exists(path):
+                _build()
+            lib = ctypes.CDLL(path)
+            _declare(lib)
+            _lib = lib
+            return lib
+        except Exception as e:  # missing toolchain, bad arch, ...
+            _load_error = e
+            raise
+
+
+def available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.rt_store_create.restype = ctypes.c_void_p
+    lib.rt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_uint32]
+    lib.rt_store_attach.restype = ctypes.c_void_p
+    lib.rt_store_attach.argtypes = [ctypes.c_char_p]
+    lib.rt_store_detach.argtypes = [ctypes.c_void_p]
+    lib.rt_store_destroy.argtypes = [ctypes.c_char_p]
+    lib.rt_store_destroy.restype = ctypes.c_int
+    lib.rt_store_map_bytes.restype = ctypes.c_uint64
+    lib.rt_store_map_bytes.argtypes = [ctypes.c_void_p]
+    lib.rt_obj_create.restype = ctypes.c_int64
+    lib.rt_obj_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint64]
+    lib.rt_obj_seal.restype = ctypes.c_int
+    lib.rt_obj_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_obj_get.restype = ctypes.c_int64
+    lib.rt_obj_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_obj_lookup.restype = ctypes.c_int64
+    lib.rt_obj_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+    lib.rt_obj_release.restype = ctypes.c_int
+    lib.rt_obj_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_obj_delete.restype = ctypes.c_int
+    lib.rt_obj_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_obj_contains.restype = ctypes.c_int
+    lib.rt_obj_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_obj_refcount.restype = ctypes.c_uint64
+    lib.rt_obj_refcount.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rt_evict_candidates.restype = ctypes.c_int
+    lib.rt_evict_candidates.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                        u8p, ctypes.c_int]
+    lib.rt_store_used.restype = ctypes.c_uint64
+    lib.rt_store_used.argtypes = [ctypes.c_void_p]
+    lib.rt_store_capacity.restype = ctypes.c_uint64
+    lib.rt_store_capacity.argtypes = [ctypes.c_void_p]
+    lib.rt_store_num_objects.restype = ctypes.c_uint64
+    lib.rt_store_num_objects.argtypes = [ctypes.c_void_p]

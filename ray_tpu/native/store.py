@@ -1,0 +1,212 @@
+"""Python client for the native shm arena store (native/src/shm_store.cc).
+
+One arena per session on the host; every process maps it once and reads
+objects as zero-copy slices.  Lifetime safety for zero-copy reads: `get`
+takes a native refcount and ties its release to the garbage collection
+of a numpy wrapper that every deserialized view transitively references
+(the capability the reference gets from plasma client buffer tracking,
+src/ray/object_manager/plasma/client.cc).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import time
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu import native as _native
+
+ID_SIZE = 28
+
+RT_ERR_EXISTS = -1
+RT_ERR_OOM = -2
+RT_ERR_NOT_FOUND = -3
+RT_ERR_NOT_SEALED = -4
+RT_ERR_IN_USE = -5
+
+
+class NativeStoreError(RuntimeError):
+    pass
+
+
+class NativeStoreFull(NativeStoreError):
+    pass
+
+
+class NativeObjectExists(NativeStoreError):
+    """A sealed object with this id already exists (idempotent re-put)."""
+
+
+def _check_id(id_bytes: bytes) -> bytes:
+    if len(id_bytes) != ID_SIZE:
+        raise ValueError(f"object id must be {ID_SIZE} bytes")
+    return id_bytes
+
+
+class NativeArena:
+    """Per-process handle to the session's shm arena."""
+
+    def __init__(self, name: str, capacity: Optional[int] = None,
+                 create: bool = False, table_slots: int = 1 << 16):
+        self._lib = _native.load_library()
+        self._name = name.encode()
+        if create:
+            assert capacity is not None
+            self._h = self._lib.rt_store_create(self._name, capacity,
+                                                table_slots)
+        else:
+            self._h = self._lib.rt_store_attach(self._name)
+        if not self._h:
+            raise NativeStoreError(f"cannot open arena {name!r}")
+        # map the data plane: /dev/shm/<name> is the same segment
+        nbytes = self._lib.rt_store_map_bytes(self._h)
+        fd = os.open(f"/dev/shm/{name}", os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, nbytes)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mm)
+        # id -> outstanding native refs taken by this process (released
+        # on finalizer or shutdown)
+        self._refs: dict[bytes, int] = {}
+
+    # -- object ops --------------------------------------------------------
+
+    def create(self, id_bytes: bytes, size: int) -> memoryview:
+        """Allocate and return a writable view (seal when done)."""
+        _check_id(id_bytes)
+        off = self._lib.rt_obj_create(self._h, id_bytes, size)
+        if off == RT_ERR_OOM:
+            raise NativeStoreFull(size)
+        if off == RT_ERR_EXISTS:
+            # Deterministic ids: a retried task re-creates its returns.
+            # SEALED → the value is already here (task determinism):
+            # idempotent no-op for the caller.  CREATED → the first
+            # attempt died mid-write (a live writer is never concurrent
+            # with a retry: the node doesn't double-dispatch); unsealed
+            # objects can carry no read refs, so delete succeeds and we
+            # allocate fresh.
+            if self.contains(id_bytes) == 2:  # RT_STATE_SEALED
+                raise NativeObjectExists(id_bytes.hex())
+            self._lib.rt_obj_delete(self._h, id_bytes)
+            off = self._lib.rt_obj_create(self._h, id_bytes, size)
+            if off == RT_ERR_OOM:
+                raise NativeStoreFull(size)
+        if off < 0:
+            raise NativeStoreError(f"create failed: {off}")
+        return self._view[off:off + size]
+
+    def seal(self, id_bytes: bytes) -> None:
+        self._lib.rt_obj_seal(self._h, _check_id(id_bytes))
+
+    def get(self, id_bytes: bytes) -> Optional[np.ndarray]:
+        """Zero-copy read of a sealed object.
+
+        Returns a uint8 ndarray over the arena.  A native reference is
+        held until the array (and every view derived from it) is GC'd.
+        """
+        _check_id(id_bytes)
+        size = ctypes.c_uint64()
+        off = self._lib.rt_obj_get(self._h, id_bytes, ctypes.byref(size))
+        if off < 0:
+            return None
+        self._refs[id_bytes] = self._refs.get(id_bytes, 0) + 1
+        arr = np.frombuffer(self._view, dtype=np.uint8,
+                            count=size.value, offset=off)
+        weakref.finalize(arr, self._release_cb, id_bytes)
+        return arr
+
+    def _release_cb(self, id_bytes: bytes) -> None:
+        if not self._h:
+            return  # finalizer fired after detach
+        n = self._refs.get(id_bytes, 0)
+        if n <= 0:
+            return
+        if n == 1:
+            self._refs.pop(id_bytes, None)
+        else:
+            self._refs[id_bytes] = n - 1
+        try:
+            self._lib.rt_obj_release(self._h, id_bytes)
+        except Exception:
+            pass
+
+    def lookup(self, id_bytes: bytes) -> Optional[memoryview]:
+        """Refcount-free view (node-side spill; caller must hold a pin)."""
+        size = ctypes.c_uint64()
+        off = self._lib.rt_obj_lookup(self._h, _check_id(id_bytes),
+                                      ctypes.byref(size))
+        if off < 0:
+            return None
+        return self._view[off:off + size.value]
+
+    def delete(self, id_bytes: bytes) -> bool:
+        return self.delete_rc(id_bytes) == 0
+
+    def delete_rc(self, id_bytes: bytes) -> int:
+        """Delete returning the raw status (0, RT_ERR_IN_USE, ...)."""
+        return self._lib.rt_obj_delete(self._h, _check_id(id_bytes))
+
+    def contains(self, id_bytes: bytes) -> int:
+        return self._lib.rt_obj_contains(self._h, _check_id(id_bytes))
+
+    def refcount(self, id_bytes: bytes) -> int:
+        return self._lib.rt_obj_refcount(self._h, _check_id(id_bytes))
+
+    def evict_candidates(self, nbytes: int, max_out: int = 256) -> list[bytes]:
+        buf = (ctypes.c_uint8 * (ID_SIZE * max_out))()
+        n = self._lib.rt_evict_candidates(self._h, nbytes, buf, max_out)
+        raw = bytes(buf)
+        return [raw[i * ID_SIZE:(i + 1) * ID_SIZE] for i in range(n)]
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    @property
+    def used(self) -> int:
+        return self._lib.rt_store_used(self._h)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.rt_store_capacity(self._h)
+
+    @property
+    def num_objects(self) -> int:
+        return self._lib.rt_store_num_objects(self._h)
+
+    def detach(self) -> None:
+        if self._h:
+            # Entries still in _refs back zero-copy views that are ALIVE
+            # in this process — releasing them would let another process
+            # reuse the memory under the live view (silent corruption).
+            # Leak the refcounts instead; the node defers those deletes
+            # and the arena is destroyed with the session anyway.
+            self._refs.clear()
+            self._lib.rt_store_detach(self._h)
+            self._h = None
+            self._view.release()
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # zero-copy views still alive; freed at process exit
+
+    def destroy(self) -> None:
+        name = self._name
+        self.detach()
+        self._lib.rt_store_destroy(name)
+
+
+def attach_with_retry(name: str, timeout: float = 5.0) -> NativeArena:
+    """Attach, waiting for the node service to create the arena."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return NativeArena(name)
+        except NativeStoreError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.01)

@@ -87,7 +87,9 @@ class DeploymentHandle:
 
             def resolve(timeout):
                 import ray_tpu
-                return ray_tpu.get(ref, timeout=timeout or 120)
+                # timeout=None means block until done (matches the
+                # in-process Future path) — do not invent a deadline
+                return ray_tpu.get(ref, timeout=timeout)
         else:
             fut: Future = self._ensure_pool().submit(
                 replica.impl.handle_request, method, args, kwargs)

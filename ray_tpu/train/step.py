@@ -49,9 +49,15 @@ def state_shardings(mesh: Mesh, params_logical: Any, rules: Rules,
     opt_state = jax.eval_shape(tx.init, params)
     p_struct = jax.tree.structure(params)
 
+    # If params is one bare array, every leaf matches p_struct
+    # structurally — require a shape match too, so scalar opt-state
+    # leaves (adam counts) don't inherit a rank>0 partition spec.
+    p_is_leaf = p_struct == jax.tree.structure(0)
+
     def map_node(node):
         if jax.tree.structure(node) == p_struct:
-            return p_sh
+            if not p_is_leaf or getattr(node, "shape", None) == params.shape:
+                return p_sh
         if isinstance(node, tuple) and not hasattr(node, "shape"):
             mapped = [map_node(c) for c in node]
             return (type(node)(*mapped) if hasattr(node, "_fields")

@@ -54,6 +54,9 @@ class ActorPool:
             raise StopIteration("no more results")
         ready, _ = self._rt.wait(list(self._future_to_actor),
                                  num_returns=1, timeout=timeout or 300)
+        if not ready:
+            raise TimeoutError(
+                f"no result became ready within {timeout or 300}s")
         future = ready[0]
         idx, actor, fn = self._future_to_actor.pop(future)
         self._index_to_future.pop(idx, None)
