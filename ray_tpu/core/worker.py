@@ -13,18 +13,55 @@ import argparse
 import sys
 
 
+def _install_jax_cpu_pin() -> None:
+    """Meta-path hook: pin jax to the CPU platform as soon as it finishes
+    importing, no matter what platform plugins do with JAX_PLATFORMS."""
+    import importlib.util
+    import types
+
+    class _JaxCpuPin:
+        _busy = False
+
+        def find_spec(self, name, path=None, target=None):
+            if name != "jax" or _JaxCpuPin._busy:
+                return None
+            _JaxCpuPin._busy = True
+            try:
+                spec = importlib.util.find_spec(name)
+            finally:
+                _JaxCpuPin._busy = False
+            if spec is None or spec.loader is None:
+                return None
+            orig = spec.loader
+
+            def exec_module(module):
+                orig.exec_module(module)
+                try:
+                    module.config.update("jax_platforms", "cpu")
+                except Exception:
+                    pass
+
+            spec.loader = types.SimpleNamespace(
+                create_module=orig.create_module, exec_module=exec_module)
+            return spec
+
+    sys.meta_path.insert(0, _JaxCpuPin())
+
+
 def main() -> None:
     # Workers must not touch the TPU (the driver owns it).  The spawner
-    # sets JAX_PLATFORMS=cpu, which covers any later jax import; the
-    # config override below is only needed on hosts whose sitecustomize
-    # PRE-imports jax with a platform plugin pinned — in that case jax
-    # is already in sys.modules and this costs nothing.  Avoid importing
-    # jax ourselves: it adds ~1-2s spawn latency for pure-CPU workloads.
+    # sets JAX_PLATFORMS=cpu, but ambient platform plugins can override
+    # the env var, so pin via jax.config too: immediately if jax is
+    # already imported (sitecustomize pre-import), else via a post-import
+    # hook the moment user code imports it.  Avoid importing jax
+    # ourselves: it adds ~1-2s spawn latency for pure-CPU workloads.
     if "jax" in sys.modules:
         try:
             sys.modules["jax"].config.update("jax_platforms", "cpu")
         except Exception:
             pass
+    else:
+        _install_jax_cpu_pin()
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--address", required=True)

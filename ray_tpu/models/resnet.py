@@ -1,0 +1,225 @@
+"""ResNet: TPU-first residual CNN (north-star config #1, ResNet-18/CIFAR).
+
+Reference capability: the torch ResNet workloads in the reference's AIR
+benchmarks (doc/source/ray-air/benchmarks.rst:166-174 — GPU image
+training) and its train examples; the reference ships no model code of
+its own.  TPU-first choices:
+
+  * NHWC activations + HWIO kernels — the conv layout XLA:TPU tiles onto
+    the MXU without transposes (channels on the lane dimension).
+  * BatchNorm statistics are plain ``jnp.mean`` over the batch axis: under
+    pjit with a dp-sharded batch the reduction is GLOBAL (XLA inserts the
+    cross-replica psum), so distributed BN comes for free — no
+    SyncBatchNorm machinery like torch DDP needs.
+  * activations in ``cfg.dtype`` (bf16 by default on TPU), BN statistics
+    and residual adds accumulate in f32.
+  * functional (params, state) pairs — batch stats are explicit carry,
+    so the train step stays a pure jittable function.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 10
+    stage_sizes: tuple = (2, 2, 2, 2)      # resnet-18
+    num_filters: int = 64
+    bottleneck: bool = False               # True for resnet-50/101/152
+    cifar_stem: bool = True                # 3x3/s1 stem, no maxpool
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @staticmethod
+    def resnet18(**kw) -> "ResNetConfig":
+        return ResNetConfig(**{**dict(stage_sizes=(2, 2, 2, 2)), **kw})
+
+    @staticmethod
+    def resnet34(**kw) -> "ResNetConfig":
+        return ResNetConfig(**{**dict(stage_sizes=(3, 4, 6, 3)), **kw})
+
+    @staticmethod
+    def resnet50(**kw) -> "ResNetConfig":
+        return ResNetConfig(**{**dict(stage_sizes=(3, 4, 6, 3),
+                                      bottleneck=True), **kw})
+
+    @staticmethod
+    def tiny(**kw) -> "ResNetConfig":
+        """Test-sized config."""
+        return ResNetConfig(**{**dict(stage_sizes=(1, 1), num_filters=8,
+                                      dtype=jnp.float32), **kw})
+
+
+# -- init ------------------------------------------------------------------
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    std = np.sqrt(2.0 / fan_in)
+    return (jax.random.normal(key, (kh, kw, cin, cout)) * std).astype(dtype)
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def _block_channels(cfg: ResNetConfig, stage: int) -> tuple:
+    width = cfg.num_filters * (2 ** stage)
+    return (width, width * 4) if cfg.bottleneck else (width, width)
+
+
+def init_params(cfg: ResNetConfig, rng: jax.Array):
+    """Returns (params, state) — state holds BN running statistics."""
+    keys = iter(jax.random.split(rng, 256))
+    pd = cfg.param_dtype
+    stem_cin = 3
+    if cfg.cifar_stem:
+        stem = _conv_init(next(keys), 3, 3, stem_cin, cfg.num_filters, pd)
+    else:
+        stem = _conv_init(next(keys), 7, 7, stem_cin, cfg.num_filters, pd)
+    params = {"stem_conv": stem, "stem_bn": _bn_init(cfg.num_filters, pd)}
+    state = {"stem_bn": _bn_state(cfg.num_filters)}
+
+    cin = cfg.num_filters
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        width, cout = _block_channels(cfg, s)
+        for b in range(n_blocks):
+            name = f"stage{s}_block{b}"
+            blk, bst = {}, {}
+            if cfg.bottleneck:
+                shapes = [(1, 1, cin, width), (3, 3, width, width),
+                          (1, 1, width, cout)]
+            else:
+                shapes = [(3, 3, cin, width), (3, 3, width, cout)]
+            for i, (kh, kw, ci, co) in enumerate(shapes):
+                blk[f"conv{i}"] = _conv_init(next(keys), kh, kw, ci, co, pd)
+                blk[f"bn{i}"] = _bn_init(co, pd)
+                bst[f"bn{i}"] = _bn_state(co)
+            if cin != cout or (b == 0 and s > 0):
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout, pd)
+                blk["proj_bn"] = _bn_init(cout, pd)
+                bst["proj_bn"] = _bn_state(cout)
+            params[name] = blk
+            state[name] = bst
+            cin = cout
+
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (cin, cfg.num_classes))
+              * 0.01).astype(pd),
+        "b": jnp.zeros((cfg.num_classes,), pd)}
+    return params, state
+
+
+# -- forward ---------------------------------------------------------------
+
+def _bn(x, p, st, cfg: ResNetConfig, train: bool):
+    """BatchNorm over (N, H, W).  Returns (y, new_stats)."""
+    xf = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        m = cfg.bn_momentum
+        new = {"mean": m * st["mean"] + (1 - m) * mean,
+               "var": m * st["var"] + (1 - m) * var}
+    else:
+        mean, var = st["mean"], st["var"]
+        new = st
+    y = (xf - mean) * lax.rsqrt(var + cfg.bn_eps)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype), new
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=_DN)
+
+
+def forward(params, state, x, cfg: ResNetConfig, *, train: bool = True):
+    """x [N, H, W, 3] → (logits [N, classes] f32, new_state)."""
+    x = x.astype(cfg.dtype)
+    new_state = {}
+    stride0 = 1 if cfg.cifar_stem else 2
+    x = _conv(x, params["stem_conv"], stride0)
+    x, new_state["stem_bn"] = _bn(x, params["stem_bn"], state["stem_bn"],
+                                  cfg, train)
+    x = jax.nn.relu(x)
+    if not cfg.cifar_stem:
+        x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        for b in range(n_blocks):
+            name = f"stage{s}_block{b}"
+            blk, bst = params[name], state[name]
+            nst = {}
+            stride = 2 if (b == 0 and s > 0) else 1
+            resid = x
+            y = x
+            n_convs = 3 if cfg.bottleneck else 2
+            for i in range(n_convs):
+                cs = stride if i == (1 if cfg.bottleneck else 0) else 1
+                y = _conv(y, blk[f"conv{i}"], cs)
+                y, nst[f"bn{i}"] = _bn(y, blk[f"bn{i}"], bst[f"bn{i}"],
+                                       cfg, train)
+                if i < n_convs - 1:
+                    y = jax.nn.relu(y)
+            if "proj" in blk:
+                resid = _conv(resid, blk["proj"], stride)
+                resid, nst["proj_bn"] = _bn(resid, blk["proj_bn"],
+                                            bst["proj_bn"], cfg, train)
+            x = jax.nn.relu(y + resid)
+            new_state[name] = nst
+
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    h = params["head"]
+    logits = x @ h["w"].astype(jnp.float32) + h["b"].astype(jnp.float32)
+    return logits, new_state
+
+
+def loss_fn(params, state, batch, cfg: ResNetConfig, *, train: bool = True):
+    """batch = {"x": [N,H,W,3], "y": [N] int labels} →
+    (loss, (new_state, metrics))."""
+    logits, new_state = forward(params, state, batch["x"], cfg, train=train)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, batch["y"][:, None], axis=-1)[:, 0]
+    loss = jnp.mean(logz - gold)
+    acc = jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32))
+    return loss, (new_state, {"accuracy": acc})
+
+
+def num_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+class ResNet:
+    """OO convenience wrapper over the functional API."""
+
+    def __init__(self, cfg: ResNetConfig):
+        self.cfg = cfg
+
+    def init(self, rng):
+        return init_params(self.cfg, rng)
+
+    def apply(self, params, state, x, **kw):
+        return forward(params, state, x, self.cfg, **kw)
+
+    def loss(self, params, state, batch, **kw):
+        return loss_fn(params, state, batch, self.cfg, **kw)

@@ -1,0 +1,330 @@
+"""RL model catalog: fcnet / visionnet / LSTM / GTrXL trunks + actor-critic
+heads.
+
+Reference capability: rllib/models/catalog.py (ModelCatalog) and the
+torch nets under rllib/models/torch/{fcnet,visionnet,recurrent_net,
+attention_net}.py (attention_net.py = GTrXL).  TPU redesign: every net is
+a pure-jax (params pytree, forward fn) pair; recurrent state is an
+explicit carry threaded with ``lax.scan`` so whole rollout windows
+compile to one program, and the same trunk runs jitted on CPU rollout
+workers and sharded on the TPU learner mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ray_tpu.ops.attention import attention
+
+Activation = Callable[[jax.Array], jax.Array]
+
+_ACTS = {"tanh": jnp.tanh, "relu": jax.nn.relu, "gelu": jax.nn.gelu,
+         "swish": jax.nn.swish}
+
+
+def _dense_init(key, din, dout, scale=None, dtype=jnp.float32):
+    std = np.sqrt(2.0 / din) if scale is None else scale
+    return {"w": (jax.random.normal(key, (din, dout)) * std).astype(dtype),
+            "b": jnp.zeros((dout,), dtype)}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+# -- FCNet (reference: rllib/models/torch/fcnet.py) ------------------------
+
+@dataclass(frozen=True)
+class FCNetConfig:
+    in_dim: int
+    hiddens: tuple = (256, 256)
+    activation: str = "tanh"
+
+    @property
+    def out_dim(self) -> int:
+        return self.hiddens[-1]
+
+
+def fcnet_init(cfg: FCNetConfig, rng):
+    dims = (cfg.in_dim, *cfg.hiddens)
+    keys = jax.random.split(rng, len(dims) - 1)
+    return {f"fc{i}": _dense_init(keys[i], dims[i], dims[i + 1])
+            for i in range(len(dims) - 1)}
+
+
+def fcnet_forward(params, x, cfg: FCNetConfig):
+    act = _ACTS[cfg.activation]
+    i = 0
+    while f"fc{i}" in params:
+        x = act(_dense(params[f"fc{i}"], x))
+        i += 1
+    return x
+
+
+# -- VisionNet (reference: rllib/models/torch/visionnet.py) ----------------
+
+@dataclass(frozen=True)
+class VisionNetConfig:
+    """Atari-style CNN trunk.  NHWC in/out (TPU conv layout)."""
+    in_shape: tuple = (84, 84, 4)
+    # (out_channels, kernel, stride) per conv layer
+    conv_filters: tuple = ((16, 8, 4), (32, 4, 2))
+    hidden: int = 256
+    activation: str = "relu"
+
+    @property
+    def out_dim(self) -> int:
+        return self.hidden
+
+
+def visionnet_init(cfg: VisionNetConfig, rng):
+    keys = iter(jax.random.split(rng, len(cfg.conv_filters) + 2))
+    params = {}
+    h, w, cin = cfg.in_shape
+    for i, (cout, k, s) in enumerate(cfg.conv_filters):
+        fan_in = k * k * cin
+        params[f"conv{i}"] = (
+            jax.random.normal(next(keys), (k, k, cin, cout))
+            * np.sqrt(2.0 / fan_in)).astype(jnp.float32)
+        h = -(-h // s)
+        w = -(-w // s)
+        cin = cout
+    params["fc"] = _dense_init(next(keys), h * w * cin, cfg.hidden)
+    return params
+
+
+def visionnet_forward(params, x, cfg: VisionNetConfig):
+    """x [B, H, W, C] (uint8 or float) → features [B, hidden]."""
+    act = _ACTS[cfg.activation]
+    if x.dtype == jnp.uint8:
+        x = x.astype(jnp.float32) / 255.0
+    for i, (cout, k, s) in enumerate(cfg.conv_filters):
+        x = lax.conv_general_dilated(
+            x, params[f"conv{i}"].astype(x.dtype), (s, s), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = act(x)
+    x = x.reshape(x.shape[0], -1)
+    return act(_dense(params["fc"], x))
+
+
+# -- LSTM (reference: rllib/models/torch/recurrent_net.py) -----------------
+
+@dataclass(frozen=True)
+class LSTMNetConfig:
+    in_dim: int
+    cell_size: int = 256
+
+    @property
+    def out_dim(self) -> int:
+        return self.cell_size
+
+
+def lstm_init(cfg: LSTMNetConfig, rng):
+    k1, k2 = jax.random.split(rng)
+    d, c = cfg.in_dim, cfg.cell_size
+    return {"wx": _dense_init(k1, d, 4 * c, scale=np.sqrt(1.0 / d)),
+            "wh": _dense_init(k2, c, 4 * c, scale=np.sqrt(1.0 / c))}
+
+
+def lstm_initial_state(cfg: LSTMNetConfig, batch: int):
+    z = jnp.zeros((batch, cfg.cell_size), jnp.float32)
+    return (z, z)
+
+
+def lstm_forward(params, x, carry, cfg: LSTMNetConfig):
+    """x [B, T, D], carry (h, c) [B, cell] → ([B, T, cell], carry)."""
+
+    def cell(carry, xt):
+        h, c = carry
+        gates = _dense(params["wx"], xt) + _dense(params["wh"], h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    carry, ys = lax.scan(cell, carry, x.transpose(1, 0, 2))
+    return ys.transpose(1, 0, 2), carry
+
+
+# -- GTrXL (reference: rllib/models/torch/attention_net.py) ----------------
+
+@dataclass(frozen=True)
+class GTrXLConfig:
+    """Gated Transformer-XL trunk over an observation window."""
+    in_dim: int
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 128
+
+    @property
+    def out_dim(self) -> int:
+        return self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def gtrxl_init(cfg: GTrXLConfig, rng):
+    keys = iter(jax.random.split(rng, 3 + 6 * cfg.n_layers))
+    d, f = cfg.d_model, cfg.d_ff
+    params = {"embed": _dense_init(next(keys), cfg.in_dim, d)}
+    for i in range(cfg.n_layers):
+        params[f"layer{i}"] = {
+            "ln1_scale": jnp.ones((d,)), "ln1_bias": jnp.zeros((d,)),
+            "wqkv": _dense_init(next(keys), d, 3 * d, scale=0.02),
+            "wo": _dense_init(next(keys), d, d, scale=0.02),
+            # GRU-style gating (the "G" in GTrXL) — see _gate for the
+            # near-identity init
+            "wg_attn": _dense_init(next(keys), 2 * d, d, scale=0.02),
+            "ln2_scale": jnp.ones((d,)), "ln2_bias": jnp.zeros((d,)),
+            "w_up": _dense_init(next(keys), d, f, scale=0.02),
+            "w_down": _dense_init(next(keys), f, d, scale=0.02),
+            "wg_mlp": _dense_init(next(keys), 2 * d, d, scale=0.02),
+        }
+    return params
+
+
+def _gate(p, x, y):
+    """Sigmoid gate (1-g)·x + g·y — simplified GRU gating.  The -2.0 bias
+    makes g≈0.12 at init so each block starts near the identity/residual
+    path (the GTrXL stability trick)."""
+    g = jax.nn.sigmoid(_dense(p, jnp.concatenate([x, y], -1)) - 2.0)
+    return (1 - g) * x + g * y
+
+
+def gtrxl_forward(params, x, cfg: GTrXLConfig):
+    """x [B, T, in_dim] → features [B, T, d_model].  Causal within the
+    window (memory = the window itself; no cross-window cache)."""
+    from ray_tpu.models.gpt import _layer_norm
+    b, t, _ = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    x = _dense(params["embed"], x)
+    for i in range(cfg.n_layers):
+        lp = params[f"layer{i}"]
+        y = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+        qkv = _dense(lp["wqkv"], y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(b, t, h, hd).transpose(0, 2, 1, 3)
+
+        o = attention(heads(q), heads(k), heads(v), causal=True,
+                      impl="reference")
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.d_model)
+        o = jax.nn.relu(_dense(lp["wo"], o))
+        x = _gate(lp["wg_attn"], x, o)
+
+        y = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+        u = jax.nn.relu(_dense(lp["w_up"], y))
+        dn = jax.nn.relu(_dense(lp["w_down"], u))
+        x = _gate(lp["wg_mlp"], x, dn)
+    return x
+
+
+# -- actor-critic assembly (reference: rllib/models/catalog.py) ------------
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Catalog config: pick a trunk by name, heads are attached by
+    ActorCritic.  Mirrors the reference's model_config dict
+    (rllib/models/catalog.py)."""
+    kind: str = "fcnet"              # fcnet | visionnet | lstm | gtrxl
+    obs_shape: tuple = (4,)
+    num_actions: int = 2
+    fcnet_hiddens: tuple = (256, 256)
+    fcnet_activation: str = "tanh"
+    conv_filters: tuple = ((16, 8, 4), (32, 4, 2))
+    cell_size: int = 256
+    attn_dim: int = 64
+    attn_layers: int = 2
+
+
+def _trunk_for(cfg: ModelConfig):
+    if cfg.kind == "fcnet":
+        c = FCNetConfig(int(np.prod(cfg.obs_shape)), cfg.fcnet_hiddens,
+                        cfg.fcnet_activation)
+        return c, fcnet_init, lambda p, x, c=c: fcnet_forward(p, x, c)
+    if cfg.kind == "visionnet":
+        c = VisionNetConfig(tuple(cfg.obs_shape), cfg.conv_filters)
+        return c, visionnet_init, lambda p, x, c=c: visionnet_forward(p, x, c)
+    if cfg.kind == "lstm":
+        c = LSTMNetConfig(int(np.prod(cfg.obs_shape)), cfg.cell_size)
+        return c, lstm_init, None   # recurrent: handled by caller
+    if cfg.kind == "gtrxl":
+        c = GTrXLConfig(int(np.prod(cfg.obs_shape)), cfg.attn_dim,
+                        n_layers=cfg.attn_layers)
+        return c, gtrxl_init, None  # sequence trunk: handled by caller
+    raise ValueError(f"unknown model kind {cfg.kind!r}")
+
+
+class ActorCritic:
+    """Trunk + π/V heads; the unit the rllib policies consume.
+
+    apply(params, obs) → (logits, value) for feedforward trunks;
+    apply_seq(params, obs_seq, state) for lstm/gtrxl.
+    """
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+        self.trunk_cfg, self._trunk_init, self._trunk_fwd = _trunk_for(cfg)
+
+    @property
+    def is_recurrent(self) -> bool:
+        return self.cfg.kind in ("lstm", "gtrxl")
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        f = self.trunk_cfg.out_dim
+        return {"trunk": self._trunk_init(self.trunk_cfg, k1),
+                "pi": _dense_init(k2, f, self.cfg.num_actions, scale=0.01),
+                "vf": _dense_init(k3, f, 1, scale=1.0)}
+
+    def initial_state(self, batch: int):
+        if self.cfg.kind == "lstm":
+            return lstm_initial_state(self.trunk_cfg, batch)
+        return None
+
+    def apply(self, params, obs):
+        """Feedforward path: obs [B, ...] → (logits [B, A], value [B])."""
+        if self.cfg.kind == "visionnet":
+            feats = visionnet_forward(params["trunk"], obs, self.trunk_cfg)
+        else:
+            obs = obs.reshape(obs.shape[0], -1)
+            feats = self._trunk_fwd(params["trunk"], obs)
+        logits = _dense(params["pi"], feats)
+        value = _dense(params["vf"], feats)[:, 0]
+        return logits, value
+
+    def apply_seq(self, params, obs, state=None):
+        """Sequence path: obs [B, T, ...] → (logits [B,T,A], value [B,T],
+        new_state)."""
+        b, t = obs.shape[:2]
+        if self.cfg.kind == "visionnet":
+            feats = visionnet_forward(
+                params["trunk"], obs.reshape(b * t, *self.cfg.obs_shape),
+                self.trunk_cfg).reshape(b, t, -1)
+            logits = _dense(params["pi"], feats)
+            value = _dense(params["vf"], feats)[..., 0]
+            return logits, value, state
+        flat = obs.reshape(b, t, -1)
+        if self.cfg.kind == "lstm":
+            state = state if state is not None else self.initial_state(b)
+            feats, state = lstm_forward(params["trunk"], flat, state,
+                                        self.trunk_cfg)
+        elif self.cfg.kind == "gtrxl":
+            feats = gtrxl_forward(params["trunk"], flat, self.trunk_cfg)
+        else:
+            feats = self._trunk_fwd(params["trunk"],
+                                    flat.reshape(b * t, -1)).reshape(
+                                        b, t, -1)
+        logits = _dense(params["pi"], feats)
+        value = _dense(params["vf"], feats)[..., 0]
+        return logits, value, state

@@ -188,8 +188,8 @@ class NativeArena:
             self._refs.clear()
             self._lib.rt_store_detach(self._h)
             self._h = None
-            self._view.release()
             try:
+                self._view.release()
                 self._mm.close()
             except BufferError:
                 pass  # zero-copy views still alive; freed at process exit

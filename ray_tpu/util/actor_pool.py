@@ -45,7 +45,7 @@ class ActorPool:
         self._next_return_index += 1
         idx, actor, fn = self._future_to_actor.pop(future)
         try:
-            return self._rt.get(future, timeout=timeout or 300)
+            return self._rt.get(future, timeout=(300 if timeout is None else timeout))
         finally:
             self._return_actor(actor)
 
@@ -53,15 +53,15 @@ class ActorPool:
         if not self._future_to_actor:
             raise StopIteration("no more results")
         ready, _ = self._rt.wait(list(self._future_to_actor),
-                                 num_returns=1, timeout=timeout or 300)
+                                 num_returns=1, timeout=(300 if timeout is None else timeout))
         if not ready:
             raise TimeoutError(
-                f"no result became ready within {timeout or 300}s")
+                f"no result became ready within {(300 if timeout is None else timeout)}s")
         future = ready[0]
         idx, actor, fn = self._future_to_actor.pop(future)
         self._index_to_future.pop(idx, None)
         try:
-            return self._rt.get(future, timeout=timeout or 300)
+            return self._rt.get(future, timeout=(300 if timeout is None else timeout))
         finally:
             self._return_actor(actor)
 
