@@ -294,6 +294,10 @@ class ActorCritic:
 
     def apply(self, params, obs):
         """Feedforward path: obs [B, ...] → (logits [B, A], value [B])."""
+        if self.is_recurrent:
+            raise ValueError(
+                f"{self.cfg.kind} is recurrent/sequential — use "
+                "apply_seq(params, obs[B, T, ...], state)")
         if self.cfg.kind == "visionnet":
             feats = visionnet_forward(params["trunk"], obs, self.trunk_cfg)
         else:

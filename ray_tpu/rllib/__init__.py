@@ -1,18 +1,35 @@
 """ray_tpu.rllib: reinforcement learning (reference capability: rllib/ —
 SURVEY.md §2.4; §7 M6: CPU rollout actors + compiled TPU learner)."""
 
+from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rllib.bc import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.catalog import ModelCatalog
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, VectorEnv, make_env
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, vtrace
+from ray_tpu.rllib.offline import (JsonReader, JsonWriter,
+                                   importance_sampling_estimate)
 from ray_tpu.rllib.policy import (JaxPolicy, PolicyConfig, compute_gae,
                                   init_policy_params, policy_forward)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, ppo_loss
+from ray_tpu.rllib.replay_buffer import (MinSegmentTree,
+                                         PrioritizedReplayBuffer,
+                                         ReplayBuffer,
+                                         ReservoirReplayBuffer,
+                                         SumSegmentTree)
 from ray_tpu.rllib.rollout_worker import RolloutWorker
+from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "WorkerSet", "CartPole", "VectorEnv",
-    "make_env", "Impala", "ImpalaConfig", "vtrace", "JaxPolicy",
-    "PolicyConfig", "compute_gae", "init_policy_params", "policy_forward",
-    "PPO", "PPOConfig", "ppo_loss", "RolloutWorker", "SampleBatch",
+    "A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "WorkerSet",
+    "BC", "BCConfig", "MARWIL", "MARWILConfig", "ModelCatalog",
+    "DQN", "DQNConfig", "CartPole", "VectorEnv", "make_env",
+    "Impala", "ImpalaConfig", "vtrace", "JsonReader", "JsonWriter",
+    "importance_sampling_estimate", "JaxPolicy", "PolicyConfig",
+    "compute_gae", "init_policy_params", "policy_forward",
+    "PPO", "PPOConfig", "ppo_loss", "MinSegmentTree",
+    "PrioritizedReplayBuffer", "ReplayBuffer", "ReservoirReplayBuffer",
+    "SumSegmentTree", "RolloutWorker", "SAC", "SACConfig", "SampleBatch",
 ]

@@ -1,0 +1,104 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference capability: rllib/algorithms/a2c/ (a2c.py) — synchronous
+parallel sampling + one SGD step on the whole batch (no surrogate
+clipping, no epochs).  Shares the PPO plumbing: WorkerSet rollouts with
+GAE, single jitted update.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as SB
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rllib.policy import (PolicyConfig, init_policy_params,
+                                  policy_forward)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class A2CConfig(AlgorithmConfig):
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 40.0
+
+    def build(self, algo_cls=None) -> "A2C":
+        return A2C({"_config": self})
+
+
+def a2c_loss(params, batch, *, vf_coeff, ent_coeff):
+    logits, value = policy_forward(params, batch[SB.OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch[SB.ACTIONS][:, None], axis=1)[:, 0]
+    adv = batch[SB.ADVANTAGES]
+    pi_loss = -jnp.mean(logp * adv)
+    vf_loss = 0.5 * jnp.mean((value - batch[SB.VALUE_TARGETS]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
+class A2C(Algorithm):
+    _default_config = A2CConfig
+
+    def _build(self):
+        cfg = self.config
+        self.workers = WorkerSet(cfg)
+        pcfg = PolicyConfig(obs_dim=self.workers.obs_dim,
+                            num_actions=self.workers.num_actions,
+                            hiddens=tuple(cfg.hiddens))
+        self.params = init_policy_params(pcfg, jax.random.PRNGKey(cfg.seed))
+        self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                              optax.adam(cfg.lr))
+        self.opt_state = self.tx.init(self.params)
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            adv = batch[SB.ADVANTAGES]
+            batch = dict(batch)
+            batch[SB.ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+            (l, aux), grads = jax.value_and_grad(
+                a2c_loss, has_aux=True)(
+                    params, batch, vf_coeff=cfg.vf_loss_coeff,
+                    ent_coeff=cfg.entropy_coeff)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {**aux, "total_loss": l}
+
+        self._update = update
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+
+    def training_step(self) -> dict:
+        batch, rets = self.workers.sample_sync()
+        self._ep_returns.extend(rets)
+        self._timesteps += batch.count
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k in (SB.OBS, SB.ACTIONS, SB.ADVANTAGES,
+                       SB.VALUE_TARGETS)}
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, jb)
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+        out = {k: float(v) for k, v in metrics.items()}
+        out["steps_this_iter"] = batch.count
+        return out
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.opt_state = self.tx.init(self.params)
+        self._timesteps = ck.get("timesteps", 0)
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+
+    def cleanup(self):
+        self.workers.stop()

@@ -1,0 +1,145 @@
+"""BC / MARWIL: offline policy learning from logged sample batches.
+
+Reference capability: rllib/algorithms/{bc,marwil}/ — MARWIL is
+advantage-weighted behavior cloning (beta>0); BC is the beta=0 special
+case (plain imitation), exactly as in the reference where BC subclasses
+MARWIL.  Data comes from offline.JsonReader (or any SampleBatch); the
+update is one jitted program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as SB
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.policy import (PolicyConfig, init_policy_params,
+                                  policy_forward)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class MARWILConfig(AlgorithmConfig):
+    input_path: str = ""                 # offline data dir (JsonReader)
+    beta: float = 1.0                    # 0 → BC
+    vf_coeff: float = 1.0
+    batch_size: int = 256
+    moving_average_sqd_adv_norm: float = 100.0
+
+    def offline_data(self, input_path: str) -> "MARWILConfig":
+        from dataclasses import replace
+        return replace(self, input_path=input_path)
+
+    def build(self, algo_cls=None) -> "MARWIL":
+        return MARWIL({"_config": self})
+
+
+@dataclass
+class BCConfig(MARWILConfig):
+    beta: float = 0.0
+
+    def build(self, algo_cls=None) -> "BC":
+        return BC({"_config": self})
+
+
+class MARWIL(Algorithm):
+    _default_config = MARWILConfig
+
+    def _build(self):
+        cfg = self.config
+        if not cfg.input_path:
+            raise ValueError("MARWIL/BC require config.input_path "
+                             "(offline data)")
+        self.data = JsonReader(cfg.input_path).read_all()
+        obs = np.asarray(self.data[SB.OBS])
+        acts = np.asarray(self.data[SB.ACTIONS])
+        pcfg = PolicyConfig(obs_dim=obs.shape[-1],
+                            num_actions=int(acts.max()) + 1
+                            if acts.size else 2,
+                            hiddens=tuple(cfg.hiddens))
+        self.pcfg = pcfg
+        self.params = init_policy_params(pcfg, jax.random.PRNGKey(cfg.seed))
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(cfg.seed)
+        # moving average of squared advantage norm (reference:
+        # marwil_torch_policy.py ma_adv_norm)
+        self._ma_adv_norm = cfg.moving_average_sqd_adv_norm
+
+        beta, vf_coeff = cfg.beta, cfg.vf_coeff
+
+        @jax.jit
+        def update(params, opt_state, batch, ma_adv_norm):
+            def loss_fn(p):
+                logits, value = policy_forward(p, batch[SB.OBS])
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, batch[SB.ACTIONS][:, None], axis=1)[:, 0]
+                if beta == 0.0:
+                    pi_loss = -jnp.mean(logp)
+                    vf_loss = jnp.asarray(0.0)
+                    sqd_adv = jnp.asarray(0.0)
+                else:
+                    adv = batch[SB.VALUE_TARGETS] - value
+                    vf_loss = jnp.mean(adv ** 2)
+                    sqd_adv = jax.lax.stop_gradient(vf_loss)
+                    w = jnp.exp(beta * jax.lax.stop_gradient(
+                        adv / jnp.sqrt(ma_adv_norm + 1e-8)))
+                    w = jnp.minimum(w, 20.0)
+                    pi_loss = -jnp.mean(w * logp)
+                return pi_loss + vf_coeff * vf_loss, (pi_loss, vf_loss,
+                                                      sqd_adv)
+
+            (l, (pl, vl, sqd)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, l, pl, vl, sqd
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(self.data)
+        idx = self._rng.integers(0, n, cfg.batch_size)
+        cols = [SB.OBS, SB.ACTIONS]
+        if cfg.beta != 0.0:
+            cols.append(SB.VALUE_TARGETS)
+        batch = {k: jnp.asarray(np.asarray(self.data[k])[idx])
+                 for k in cols}
+        self.params, self.opt_state, l, pl, vl, sqd = self._update(
+            self.params, self.opt_state, batch,
+            jnp.asarray(self._ma_adv_norm))
+        if cfg.beta != 0.0:
+            # refresh the advantage-norm moving average from the update's
+            # own forward pass (no second host-side forward)
+            self._ma_adv_norm += 1e-6 * (float(sqd) - self._ma_adv_norm)
+        self._timesteps += cfg.batch_size
+        return {"total_loss": float(l), "policy_loss": float(pl),
+                "vf_loss": float(vl), "steps_this_iter": cfg.batch_size}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        logits, _ = policy_forward(self.params, jnp.asarray(obs))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.opt_state = self.tx.init(self.params)
+        self._timesteps = ck.get("timesteps", 0)
+
+
+class BC(MARWIL):
+    """Plain behavior cloning (reference: rllib/algorithms/bc/bc.py —
+    'BC is MARWIL with beta forced to 0')."""
+    _default_config = BCConfig

@@ -1,0 +1,56 @@
+"""ModelCatalog: obs-space → model selection for policies.
+
+Reference capability: rllib/models/catalog.py ModelCatalog
+(get_model_v2, get_action_dist) — maps env spaces + a model_config dict
+to a concrete network.  Here it maps to the framework-owned zoo
+(ray_tpu/models/zoo.py): fcnet for flat obs, visionnet for image obs,
+lstm/gtrxl when use_lstm/use_attention are set.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.models.zoo import ActorCritic, ModelConfig
+
+
+class ModelCatalog:
+    @staticmethod
+    def get_model(obs_shape: Sequence[int], num_actions: int,
+                  model_config: Optional[dict] = None) -> ActorCritic:
+        """Pick a trunk from the obs space + config flags, mirroring the
+        reference's dispatch: 3-D obs → visionnet, use_lstm → lstm,
+        use_attention → gtrxl, else fcnet."""
+        mc = dict(model_config or {})
+        if mc.get("use_lstm"):
+            kind = "lstm"
+        elif mc.get("use_attention"):
+            kind = "gtrxl"
+        elif len(obs_shape) == 3:
+            kind = "visionnet"
+        else:
+            kind = mc.get("kind", "fcnet")
+        cfg = ModelConfig(
+            kind=kind, obs_shape=tuple(obs_shape), num_actions=num_actions,
+            fcnet_hiddens=tuple(mc.get("fcnet_hiddens", (256, 256))),
+            fcnet_activation=mc.get("fcnet_activation", "tanh"),
+            conv_filters=tuple(mc.get("conv_filters",
+                                      ((16, 8, 4), (32, 4, 2)))),
+            cell_size=mc.get("lstm_cell_size", 256),
+            attn_dim=mc.get("attention_dim", 64),
+            attn_layers=mc.get("attention_num_layers", 2))
+        return ActorCritic(cfg)
+
+    @staticmethod
+    def get_action_dist(logits: np.ndarray, *, deterministic: bool = False,
+                        rng: Optional[np.random.Generator] = None
+                        ) -> np.ndarray:
+        """Categorical head (discrete actions only in v1)."""
+        if deterministic:
+            return logits.argmax(axis=-1)
+        rng = rng or np.random.default_rng()
+        z = rng.gumbel(size=logits.shape)
+        return (logits + z).argmax(axis=-1)

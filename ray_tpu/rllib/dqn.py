@@ -1,0 +1,267 @@
+"""DQN: double + dueling Q-learning with prioritized replay.
+
+Reference capability: rllib/algorithms/dqn/ (dqn.py, dqn_torch_policy.py)
++ simple_q — epsilon-greedy exploration, target network, double-DQN
+action selection, optional dueling heads, prioritized replay with
+importance weights.  TPU redesign: the whole update (Q loss, target
+bootstrapping, per-sample TD errors for priority refresh) is one jitted
+program; replay stays host-side numpy (two-tier model), one device
+transfer per train step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as SB
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import VectorEnv
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class DQNConfig(AlgorithmConfig):
+    buffer_size: int = 50_000
+    learning_starts: int = 1_000
+    target_update_freq: int = 500        # in env steps
+    train_intensity: float = 0.25        # grad steps per env step
+    batch_size: int = 64
+    double_q: bool = True
+    dueling: bool = True
+    prioritized_replay: bool = True
+    prioritized_alpha: float = 0.6
+    prioritized_beta: float = 0.4
+    n_step: int = 1
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 10_000
+    gamma: float = 0.99
+    lr: float = 5e-4
+
+    def build(self, algo_cls=None) -> "DQN":
+        return DQN({"_config": self})
+
+
+# -- Q network (trunk shared with the model zoo) ---------------------------
+
+def init_q_params(obs_dim: int, num_actions: int, hiddens, dueling: bool,
+                  rng):
+    from ray_tpu.models.zoo import FCNetConfig, _dense_init, fcnet_init
+    tcfg = FCNetConfig(obs_dim, tuple(hiddens), activation="relu")
+    keys = jax.random.split(rng, 3)
+    params = fcnet_init(tcfg, keys[0])
+    f = tcfg.out_dim
+    params["adv"] = _dense_init(keys[1], f, num_actions, scale=0.01)
+    if dueling:
+        params["val"] = _dense_init(keys[2], f, 1, scale=0.01)
+    return params
+
+
+def q_values(params, obs):
+    from ray_tpu.models.zoo import _dense
+    x = obs
+    i = 0
+    while f"fc{i}" in params:
+        x = jax.nn.relu(_dense(params[f"fc{i}"], x))
+        i += 1
+    adv = _dense(params["adv"], x)
+    if "val" in params:  # dueling decomposition
+        val = _dense(params["val"], x)
+        return val + adv - adv.mean(axis=-1, keepdims=True)
+    return adv
+
+
+def make_dqn_update(cfg: DQNConfig, tx):
+    gamma_n = cfg.gamma ** cfg.n_step
+
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        obs, actions = batch["obs"], batch["actions"]
+        rewards, dones = batch["rewards"], batch["dones"]
+        next_obs, weights = batch["next_obs"], batch["weights"]
+
+        q_next_target = q_values(target_params, next_obs)
+        if cfg.double_q:
+            sel = jnp.argmax(q_values(params, next_obs), axis=-1)
+        else:
+            sel = jnp.argmax(q_next_target, axis=-1)
+        q_boot = jnp.take_along_axis(q_next_target, sel[:, None], 1)[:, 0]
+        target = rewards + gamma_n * (1.0 - dones) * q_boot
+
+        def loss_fn(p):
+            q = jnp.take_along_axis(
+                q_values(p, obs), actions[:, None], 1)[:, 0]
+            td = q - jax.lax.stop_gradient(target)
+            # Huber
+            hub = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                            jnp.abs(td) - 0.5)
+            return jnp.mean(weights * hub), td
+
+        (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, jnp.abs(td)
+
+    return update
+
+
+class _NStepWindow:
+    """Per-env n-step return accumulator: emits (obs, action,
+    sum_{k<n} gamma^k r_{t+k}, done, obs_{t+n}) transitions; on episode
+    end all pending entries flush with done=1 and their actual
+    discounted return-to-termination (the bootstrap is masked by done,
+    so the shorter horizon is exact)."""
+
+    def __init__(self, n: int, gamma: float):
+        self.n, self.gamma = n, gamma
+        self.pending: list[list] = []  # [obs, action, reward_sum]
+
+    def push(self, obs, action, rew, done, next_obs) -> list[tuple]:
+        out = []
+        self.pending.append([obs, action, 0.0])
+        L = len(self.pending)
+        for i, e in enumerate(self.pending):
+            e[2] += rew * self.gamma ** (L - 1 - i)
+        if L == self.n:
+            o, a, r = self.pending.pop(0)
+            out.append((o, a, r, float(done), next_obs))
+        if done:
+            while self.pending:
+                o, a, r = self.pending.pop(0)
+                out.append((o, a, r, 1.0, next_obs))
+        return out
+
+
+class DQN(Algorithm):
+    _default_config = DQNConfig
+
+    def _build(self):
+        cfg = self.config
+        self.vec = VectorEnv(cfg.env, cfg.num_envs_per_worker,
+                             seed=cfg.seed)
+        self.obs_dim = self.vec.observation_dim
+        self.num_actions = self.vec.num_actions
+        self.params = init_q_params(self.obs_dim, self.num_actions,
+                                    cfg.hiddens, cfg.dueling,
+                                    jax.random.PRNGKey(cfg.seed))
+        self.target_params = self.params
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = make_dqn_update(cfg, self.tx)
+        self._qvals = jax.jit(q_values)
+        if cfg.prioritized_replay:
+            self.buffer = PrioritizedReplayBuffer(
+                cfg.buffer_size, cfg.prioritized_alpha, seed=cfg.seed)
+        else:
+            self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._obs = self.vec.reset()
+        self._rng = np.random.default_rng(cfg.seed + 1)
+        self._ep_rew = np.zeros(self.vec.num_envs, np.float32)
+        self._since_target_sync = 0
+        self._grad_debt = 0.0
+        self._nstep = [
+            _NStepWindow(cfg.n_step, cfg.gamma)
+            for _ in range(self.vec.num_envs)] if cfg.n_step > 1 else None
+
+    @property
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._timesteps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end
+                                           - cfg.epsilon_start)
+
+    def _act(self, obs) -> np.ndarray:
+        q = np.asarray(self._qvals(self.params, jnp.asarray(obs)))
+        greedy = q.argmax(axis=-1)
+        explore = self._rng.random(len(greedy)) < self.epsilon
+        rand = self._rng.integers(0, self.num_actions, len(greedy))
+        return np.where(explore, rand, greedy)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        B = self.vec.num_envs
+        steps, losses = 0, []
+        for _ in range(cfg.rollout_length):
+            actions = self._act(self._obs)
+            next_obs, rew, done = self.vec.step(actions)
+            if self._nstep is None:
+                self.buffer.add(SampleBatch({
+                    "obs": np.asarray(self._obs, np.float32),
+                    "actions": actions.astype(np.int64),
+                    "rewards": rew.astype(np.float32),
+                    "dones": done.astype(np.float32),
+                    "next_obs": np.asarray(next_obs, np.float32)}))
+            else:
+                rows = []
+                for e in range(B):
+                    rows += self._nstep[e].push(
+                        np.asarray(self._obs[e], np.float32),
+                        int(actions[e]), float(rew[e]), bool(done[e]),
+                        np.asarray(next_obs[e], np.float32))
+                if rows:
+                    o, a, r, d, no = zip(*rows)
+                    self.buffer.add(SampleBatch({
+                        "obs": np.stack(o),
+                        "actions": np.asarray(a, np.int64),
+                        "rewards": np.asarray(r, np.float32),
+                        "dones": np.asarray(d, np.float32),
+                        "next_obs": np.stack(no)}))
+            self._ep_rew += rew
+            for i in np.nonzero(done)[0]:
+                self._ep_returns.append(float(self._ep_rew[i]))
+                self._ep_rew[i] = 0.0
+            self._obs = next_obs
+            steps += B
+            self._timesteps += B
+            self._since_target_sync += B
+
+            if len(self.buffer) < cfg.learning_starts:
+                continue
+            self._grad_debt += cfg.train_intensity * B
+            while self._grad_debt >= 1.0:
+                self._grad_debt -= 1.0
+                losses.append(self._train_once())
+
+            if self._since_target_sync >= cfg.target_update_freq:
+                self.target_params = self.params
+                self._since_target_sync = 0
+
+        return {"steps_this_iter": steps,
+                "epsilon": self.epsilon,
+                "buffer_size": len(self.buffer),
+                "mean_td_loss": float(np.mean(losses)) if losses else 0.0}
+
+    def _train_once(self) -> float:
+        cfg = self.config
+        if isinstance(self.buffer, PrioritizedReplayBuffer):
+            batch = self.buffer.sample(cfg.batch_size,
+                                       beta=cfg.prioritized_beta)
+        else:
+            batch = self.buffer.sample(cfg.batch_size)
+            batch["weights"] = np.ones(cfg.batch_size, np.float32)
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "batch_indexes"}
+        self.params, self.opt_state, loss, td = self._update(
+            self.params, self.target_params, self.opt_state, jb)
+        if isinstance(self.buffer, PrioritizedReplayBuffer):
+            self.buffer.update_priorities(batch["batch_indexes"],
+                                          np.asarray(td))
+        return float(loss)
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.target_params = self.params
+        self.opt_state = self.tx.init(self.params)
+        self._timesteps = ck.get("timesteps", 0)

@@ -1,0 +1,205 @@
+"""SAC (discrete): twin soft-Q + entropy-regularized policy.
+
+Reference capability: rllib/algorithms/sac/ (sac.py, sac_torch_policy.py)
+— soft Q-learning with twin critics, stochastic policy, automatic
+entropy-temperature tuning.  Discrete-action variant (Christodoulou
+2019 formulation): expectations over the action simplex instead of the
+reparameterization trick.  One jitted update program covering critic,
+actor, and alpha; replay host-side.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import init_q_params, q_values
+from ray_tpu.rllib.env import VectorEnv
+from ray_tpu.rllib.policy import PolicyConfig, init_policy_params, \
+    policy_forward
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class SACConfig(AlgorithmConfig):
+    buffer_size: int = 50_000
+    learning_starts: int = 1_000
+    batch_size: int = 64
+    train_intensity: float = 0.25        # grad steps per env step
+    tau: float = 0.005                   # polyak target update
+    target_entropy: Optional[float] = None  # None = scale·log|A|
+    target_entropy_scale: float = 0.5
+    initial_alpha: float = 1.0
+    gamma: float = 0.99
+    lr: float = 3e-4
+
+    def build(self, algo_cls=None) -> "SAC":
+        return SAC({"_config": self})
+
+
+def make_sac_update(cfg: SACConfig, num_actions: int, tx_q, tx_pi, tx_a):
+    target_entropy = (cfg.target_entropy if cfg.target_entropy is not None
+                      else cfg.target_entropy_scale
+                      * float(np.log(num_actions)))
+
+    @jax.jit
+    def update(state, batch):
+        (q1, q2, q1_t, q2_t, pi, log_alpha,
+         opt_q1, opt_q2, opt_pi, opt_a) = state
+        obs, actions = batch["obs"], batch["actions"]
+        rewards, dones, next_obs = (batch["rewards"], batch["dones"],
+                                    batch["next_obs"])
+        alpha = jnp.exp(log_alpha)
+
+        # target: E_{a'~π}[min(Q1',Q2') - α logπ]
+        next_logits, _ = policy_forward(pi, next_obs)
+        next_p = jax.nn.softmax(next_logits)
+        next_logp = jax.nn.log_softmax(next_logits)
+        v_next = jnp.sum(next_p * (jnp.minimum(q_values(q1_t, next_obs),
+                                               q_values(q2_t, next_obs))
+                                   - alpha * next_logp), axis=-1)
+        target = rewards + cfg.gamma * (1.0 - dones) * v_next
+        target = jax.lax.stop_gradient(target)
+
+        def q_loss(qp):
+            q = jnp.take_along_axis(q_values(qp, obs), actions[:, None],
+                                    1)[:, 0]
+            return jnp.mean((q - target) ** 2)
+
+        l1, g1 = jax.value_and_grad(q_loss)(q1)
+        l2, g2 = jax.value_and_grad(q_loss)(q2)
+        u1, opt_q1 = tx_q.update(g1, opt_q1, q1)
+        q1 = optax.apply_updates(q1, u1)
+        u2, opt_q2 = tx_q.update(g2, opt_q2, q2)
+        q2 = optax.apply_updates(q2, u2)
+
+        def pi_loss(pp):
+            logits, _ = policy_forward(pp, obs)
+            p = jax.nn.softmax(logits)
+            logp = jax.nn.log_softmax(logits)
+            qmin = jnp.minimum(q_values(q1, obs), q_values(q2, obs))
+            return jnp.mean(jnp.sum(
+                p * (alpha * logp - jax.lax.stop_gradient(qmin)), axis=-1))
+
+        lp, gp = jax.value_and_grad(pi_loss)(pi)
+        up, opt_pi = tx_pi.update(gp, opt_pi, pi)
+        pi = optax.apply_updates(pi, up)
+
+        def alpha_loss(la):
+            logits, _ = policy_forward(pi, obs)
+            p = jax.nn.softmax(logits)
+            logp = jax.nn.log_softmax(logits)
+            entropy = -jnp.sum(p * logp, axis=-1)
+            return jnp.mean(jnp.exp(la)
+                            * jax.lax.stop_gradient(entropy
+                                                    - target_entropy))
+
+        la_l, ga = jax.value_and_grad(alpha_loss)(log_alpha)
+        ua, opt_a = tx_a.update(ga, opt_a)
+        log_alpha = optax.apply_updates(log_alpha, ua)
+
+        # polyak target sync
+        q1_t = jax.tree.map(lambda t, s: (1 - cfg.tau) * t + cfg.tau * s,
+                            q1_t, q1)
+        q2_t = jax.tree.map(lambda t, s: (1 - cfg.tau) * t + cfg.tau * s,
+                            q2_t, q2)
+        state = (q1, q2, q1_t, q2_t, pi, log_alpha,
+                 opt_q1, opt_q2, opt_pi, opt_a)
+        metrics = {"q_loss": 0.5 * (l1 + l2), "pi_loss": lp,
+                   "alpha": jnp.exp(log_alpha)}
+        return state, metrics
+
+    return update
+
+
+class SAC(Algorithm):
+    _default_config = SACConfig
+
+    def _build(self):
+        cfg = self.config
+        self.vec = VectorEnv(cfg.env, cfg.num_envs_per_worker,
+                             seed=cfg.seed)
+        obs_dim, num_actions = (self.vec.observation_dim,
+                                self.vec.num_actions)
+        self.num_actions = num_actions
+        k = jax.random.split(jax.random.PRNGKey(cfg.seed), 3)
+        q1 = init_q_params(obs_dim, num_actions, cfg.hiddens, False, k[0])
+        q2 = init_q_params(obs_dim, num_actions, cfg.hiddens, False, k[1])
+        pcfg = PolicyConfig(obs_dim=obs_dim, num_actions=num_actions,
+                            hiddens=tuple(cfg.hiddens))
+        pi = init_policy_params(pcfg, k[2])
+        log_alpha = jnp.log(jnp.asarray(cfg.initial_alpha))
+        self.tx_q = optax.adam(cfg.lr)
+        self.tx_pi = optax.adam(cfg.lr)
+        self.tx_a = optax.adam(cfg.lr)
+        self.state = (q1, q2, q1, q2, pi, log_alpha,
+                      self.tx_q.init(q1), self.tx_q.init(q2),
+                      self.tx_pi.init(pi), self.tx_a.init(log_alpha))
+        self._update = make_sac_update(cfg, num_actions, self.tx_q,
+                                       self.tx_pi, self.tx_a)
+
+        @jax.jit
+        def _sample_action(pi, rng, obs):
+            logits, _ = policy_forward(pi, obs)
+            rng, sub = jax.random.split(rng)
+            return rng, jax.random.categorical(sub, logits, axis=-1)
+
+        self._sample_action = _sample_action
+        self._rng = jax.random.PRNGKey(cfg.seed + 9)
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._obs = self.vec.reset()
+        self._ep_rew = np.zeros(self.vec.num_envs, np.float32)
+        self._grad_debt = 0.0
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        B = self.vec.num_envs
+        steps, metrics = 0, {}
+        for _ in range(cfg.rollout_length):
+            pi = self.state[4]
+            self._rng, act = self._sample_action(
+                pi, self._rng, jnp.asarray(self._obs, jnp.float32))
+            actions = np.asarray(act)
+            next_obs, rew, done = self.vec.step(actions)
+            self.buffer.add(SampleBatch({
+                "obs": np.asarray(self._obs, np.float32),
+                "actions": actions.astype(np.int64),
+                "rewards": rew.astype(np.float32),
+                "dones": done.astype(np.float32),
+                "next_obs": np.asarray(next_obs, np.float32)}))
+            self._ep_rew += rew
+            for i in np.nonzero(done)[0]:
+                self._ep_returns.append(float(self._ep_rew[i]))
+                self._ep_rew[i] = 0.0
+            self._obs = next_obs
+            steps += B
+            self._timesteps += B
+
+            if len(self.buffer) < cfg.learning_starts:
+                continue
+            self._grad_debt += cfg.train_intensity * B
+            while self._grad_debt >= 1.0:
+                self._grad_debt -= 1.0
+                batch = self.buffer.sample(cfg.batch_size)
+                jb = {k: jnp.asarray(v) for k, v in batch.items()
+                      if k != "batch_indexes"}
+                self.state, m = self._update(self.state, jb)
+                metrics = {k: float(v) for k, v in m.items()}
+
+        return {"steps_this_iter": steps,
+                "buffer_size": len(self.buffer), **metrics}
+
+    def save_checkpoint(self) -> dict:
+        return {"state": jax.tree.map(np.asarray, self.state),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.state = jax.tree.map(jnp.asarray, ck["state"])
+        self._timesteps = ck.get("timesteps", 0)
