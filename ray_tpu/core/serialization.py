@@ -104,7 +104,11 @@ class SerializationContext:
                 for klass, (ser, de) in custom.items():
                     if isinstance(obj, klass):
                         return (_apply_custom, (de, ser(obj)))
-                return NotImplemented
+                # delegate to cloudpickle's own reducer_override — it is
+                # what pickles local functions/classes by value; returning
+                # NotImplemented here would skip it and fall back to
+                # pickle's by-reference lookup, which fails for closures
+                return super().reducer_override(obj)
 
         f = io.BytesIO()
         p = _Pickler(f, protocol=5, buffer_callback=buffer_callback)

@@ -1,20 +1,34 @@
 """ray_tpu.data: block-parallel datasets feeding sharded device batches
 (reference capability: python/ray/data — SURVEY.md §2.4; §7 M7)."""
 
-from ray_tpu.data.dataset import Dataset
 from ray_tpu.data import block
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.groupby import AggregateFn, Count, GroupedData, Max, \
+    Min, Sum
+from ray_tpu.data.pipeline import DatasetPipeline
 from ray_tpu.data.preprocessor import (BatchMapper, Chain, Concatenator,
                                        LabelEncoder, MinMaxScaler,
-                                       Preprocessor, StandardScaler)
+                                       Normalizer, OneHotEncoder,
+                                       Preprocessor, RobustScaler,
+                                       SimpleImputer, StandardScaler)
 
 from_items = Dataset.from_items
 range = Dataset.range  # noqa: A001 - mirrors reference API name
 from_numpy = Dataset.from_numpy
+from_pandas = Dataset.from_pandas
 read_csv = Dataset.read_csv
 read_parquet = Dataset.read_parquet
+read_json = Dataset.read_json
+read_numpy = Dataset.read_numpy
+read_text = Dataset.read_text
+read_binary_files = Dataset.read_binary_files
 
 __all__ = [
-    "Dataset", "block", "from_items", "range", "from_numpy", "read_csv",
-    "read_parquet", "Preprocessor", "BatchMapper", "Chain", "StandardScaler",
-    "MinMaxScaler", "LabelEncoder", "Concatenator",
+    "Dataset", "DatasetPipeline", "GroupedData", "AggregateFn", "Count",
+    "Sum", "Min", "Max", "block", "from_items", "range", "from_numpy",
+    "from_pandas", "read_csv", "read_parquet", "read_json", "read_numpy",
+    "read_text", "read_binary_files", "Preprocessor", "BatchMapper",
+    "Chain", "StandardScaler", "MinMaxScaler", "LabelEncoder",
+    "Concatenator", "Normalizer", "OneHotEncoder", "RobustScaler",
+    "SimpleImputer",
 ]

@@ -26,6 +26,27 @@ import numpy as np
 from ray_tpu.data import block as B
 
 
+def _apply_stages(blk, stages, idx: int):
+    """THE stage fold — every execution path (inline, tasks, actors,
+    streaming) goes through this one function.  Stages are fn(blk) or,
+    when marked with ``_wants_index``, fn(blk, block_index) (used by
+    per-block-seeded ops like random_sample)."""
+    for st in stages:
+        blk = st(blk, idx) if getattr(st, "_wants_index", False) else st(blk)
+    return blk
+
+
+class _BlockWorker:
+    """Actor-pool block transformer (reference: ActorPoolStrategy,
+    _internal/compute.py — long-lived actors amortize stage setup)."""
+
+    def __init__(self, stages):
+        self._stages = stages
+
+    def run(self, blk, idx):
+        return _apply_stages(blk, self._stages, idx)
+
+
 class Dataset:
     def __init__(self, blocks: list, stages: Optional[list] = None):
         # blocks: list of Block OR ObjectRef[Block]
@@ -60,11 +81,92 @@ class Dataset:
                         for s in range(0, n, per)] or [{}])
 
     @staticmethod
+    def from_pandas(dfs) -> "Dataset":
+        dfs = dfs if isinstance(dfs, list) else [dfs]
+        return Dataset([{c: df[c].to_numpy() for c in df.columns}
+                        for df in dfs] or [{}])
+
+    def to_pandas(self):
+        import pandas as pd
+        full = B.concat(self._materialize())
+        return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                             for k, v in full.items()})
+
+    @staticmethod
     def read_csv(paths: Union[str, list[str]]) -> "Dataset":
         import pandas as pd
-        paths = [paths] if isinstance(paths, str) else list(paths)
+        paths = Dataset._expand_paths(paths)
         return Dataset([{c: df[c].to_numpy() for c in df.columns}
                         for df in (pd.read_csv(p) for p in paths)])
+
+    @staticmethod
+    def _expand_paths(paths) -> list[str]:
+        import glob
+        import os
+        paths = [paths] if isinstance(paths, str) else list(paths)
+        out = []
+        for p in paths:
+            if os.path.isdir(p):
+                out.extend(sorted(
+                    q for q in glob.glob(os.path.join(p, "*"))
+                    if os.path.isfile(q)))
+            elif any(c in p for c in "*?["):
+                out.extend(sorted(glob.glob(p)))
+            else:
+                out.append(p)
+        return out
+
+    @staticmethod
+    def read_json(paths: Union[str, list[str]]) -> "Dataset":
+        """Newline-delimited JSON, one block per file (reference:
+        python/ray/data/datasource/json_datasource.py)."""
+        import json
+        blocks = []
+        for p in Dataset._expand_paths(paths):
+            rows = []
+            with open(p) as f:
+                for line in f:
+                    if line.strip():
+                        rows.append(json.loads(line))
+            # key union across rows — JSON rows routinely have optional
+            # fields; missing values become None (object column)
+            keys: dict = {}
+            for r in rows:
+                keys.update(dict.fromkeys(r))
+            blocks.append({k: np.asarray([r.get(k) for r in rows])
+                           for k in keys})
+        return Dataset(blocks or [{}])
+
+    @staticmethod
+    def read_numpy(paths: Union[str, list[str]]) -> "Dataset":
+        blocks = []
+        for p in Dataset._expand_paths(paths):
+            arr = np.load(p, allow_pickle=False)
+            blocks.append({"data": arr} if isinstance(arr, np.ndarray)
+                          else {k: arr[k] for k in arr.files})
+        return Dataset(blocks or [{}])
+
+    @staticmethod
+    def read_text(paths: Union[str, list[str]]) -> "Dataset":
+        blocks = []
+        for p in Dataset._expand_paths(paths):
+            with open(p) as f:
+                lines = [ln.rstrip("\n") for ln in f]
+            blocks.append({"text": np.asarray(lines, dtype=object)})
+        return Dataset(blocks or [{}])
+
+    @staticmethod
+    def read_binary_files(paths: Union[str, list[str]],
+                          include_paths: bool = False) -> "Dataset":
+        blocks = []
+        for p in Dataset._expand_paths(paths):
+            with open(p, "rb") as f:
+                data = f.read()
+            blk = {"bytes": np.asarray([data], dtype=object)}
+            if include_paths:
+                blk["path"] = np.asarray([p], dtype=object)
+            blocks.append(blk)
+        return Dataset(blocks or [{}])
 
     @staticmethod
     def read_parquet(paths: Union[str, list[str]]) -> "Dataset":
@@ -83,9 +185,46 @@ class Dataset:
         import pyarrow.parquet as pq
         os.makedirs(dir_path, exist_ok=True)
         paths = []
-        for i, blk in enumerate(self._resolve_blocks()):
+        for i, blk in enumerate(self._materialize()):
             p = f"{dir_path}/part-{i:05d}.parquet"
             pq.write_table(pa.table({k: v for k, v in blk.items()}), p)
+            paths.append(p)
+        return paths
+
+    def write_csv(self, dir_path: str) -> list[str]:
+        import os
+        import pandas as pd
+        os.makedirs(dir_path, exist_ok=True)
+        paths = []
+        for i, blk in enumerate(self._materialize()):
+            p = f"{dir_path}/part-{i:05d}.csv"
+            pd.DataFrame(dict(blk)).to_csv(p, index=False)
+            paths.append(p)
+        return paths
+
+    def write_json(self, dir_path: str) -> list[str]:
+        import json
+        import os
+        os.makedirs(dir_path, exist_ok=True)
+        paths = []
+        for i, blk in enumerate(self._materialize()):
+            p = f"{dir_path}/part-{i:05d}.json"
+            with open(p, "w") as f:
+                for r in B.to_rows(blk):
+                    f.write(json.dumps(
+                        {k: (v.tolist() if isinstance(v, np.ndarray) else
+                             v.item() if hasattr(v, "item") else v)
+                         for k, v in r.items()}) + "\n")
+            paths.append(p)
+        return paths
+
+    def write_numpy(self, dir_path: str, column: str = "data") -> list[str]:
+        import os
+        os.makedirs(dir_path, exist_ok=True)
+        paths = []
+        for i, blk in enumerate(self._materialize()):
+            p = f"{dir_path}/part-{i:05d}.npy"
+            np.save(p, np.asarray(blk[column]), allow_pickle=False)
             paths.append(p)
         return paths
 
@@ -125,6 +264,51 @@ class Dataset:
             out[name] = np.asarray(fn(dict(blk)))
             return out
         return self._with_stage(stage)
+
+    def flat_map(self, fn: Callable[[dict], list]) -> "Dataset":
+        """fn: row → list of rows (reference: dataset.flat_map)."""
+        def stage(blk):
+            out = []
+            for r in B.to_rows(blk):
+                out.extend(fn(r))
+            return B.normalize(out)
+        return self._with_stage(stage)
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        def stage(blk):
+            return {k: v for k, v in blk.items() if k not in cols}
+        return self._with_stage(stage)
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        def stage(blk):
+            return {k: blk[k] for k in cols}
+        return self._with_stage(stage)
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        def stage(blk, idx):
+            n = B.num_rows(blk)
+            # per-block seed: a fixed seed must not replay the same row
+            # positions in every block
+            rng = np.random.default_rng(
+                None if seed is None else seed + idx)
+            keep = np.nonzero(rng.random(n) < fraction)[0]
+            return B.take_rows(blk, keep)
+        stage._wants_index = True
+        return self._with_stage(stage)
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (materializes only what it needs)."""
+        out, have = [], 0
+        for blk in self._iter_staged_blocks():
+            rows = B.num_rows(blk)
+            take = min(rows, n - have)
+            if take > 0:
+                out.append(dict(B.slice_block(blk, 0, take)))
+                have += take
+            if have >= n:
+                break
+        return Dataset(out or [{}])
 
     # ------------------------------------------------------- all-to-all ops
 
@@ -172,6 +356,84 @@ class Dataset:
     def union(self, other: "Dataset") -> "Dataset":
         return Dataset(self._materialize() + other._materialize())
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of equal-length datasets (reference:
+        dataset.zip; clashing names get a _1 suffix)."""
+        a = B.concat(self._materialize())
+        b = B.concat(other._materialize())
+        if B.num_rows(a) != B.num_rows(b):
+            raise ValueError("zip requires equal row counts")
+        out = dict(a)
+        for k, v in b.items():
+            out[k if k not in out else f"{k}_1"] = v
+        return Dataset([out])
+
+    def split_at_indices(self, indices: list[int]) -> list["Dataset"]:
+        full = B.concat(self._materialize())
+        n = B.num_rows(full)
+        bounds = [0] + list(indices) + [n]
+        return [Dataset([B.slice_block(full, bounds[i], bounds[i + 1])])
+                for i in range(len(bounds) - 1)]
+
+    def train_test_split(self, test_size: float = 0.25, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> tuple["Dataset", "Dataset"]:
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        full = B.concat(ds._materialize())
+        n = B.num_rows(full)
+        cut = n - int(n * test_size)
+        return (Dataset([B.slice_block(full, 0, cut)]),
+                Dataset([B.slice_block(full, cut, n)]))
+
+    def groupby(self, key: str):
+        from ray_tpu.data.groupby import GroupedData
+        return GroupedData(self, key)
+
+    # -- global aggregates -------------------------------------------------
+
+    def _column(self, col: str) -> np.ndarray:
+        parts = [np.asarray(b[col]) for b in self._materialize()
+                 if B.num_rows(b)]
+        return (np.concatenate(parts) if parts
+                else np.empty(0))
+
+    def sum(self, col: str):
+        return self._column(col).sum()
+
+    def mean(self, col: str):
+        return self._column(col).mean()
+
+    def min(self, col: str):
+        return self._column(col).min()
+
+    def max(self, col: str):
+        return self._column(col).max()
+
+    def std(self, col: str, ddof: int = 1):
+        return self._column(col).std(ddof=ddof)
+
+    def unique(self, col: str) -> list:
+        return np.unique(self._column(col)).tolist()
+
+    # -- pipelining --------------------------------------------------------
+
+    def window(self, *, blocks_per_window: int = 2):
+        """Split into a DatasetPipeline of block windows (reference:
+        dataset.window → DatasetPipeline)."""
+        from ray_tpu.data.pipeline import DatasetPipeline
+        blocks, stages = self._blocks, self._stages
+        nwin = max(1, math.ceil(len(blocks) / blocks_per_window))
+        def gen():
+            for i in range(0, len(blocks), blocks_per_window):
+                yield Dataset(blocks[i:i + blocks_per_window], list(stages))
+        return DatasetPipeline(gen, length=nwin)
+
+    def repeat(self, times: Optional[int] = None):
+        """Multi-epoch pipeline (reference: dataset.repeat)."""
+        return self.window(
+            blocks_per_window=len(self._blocks)).repeat(times)
+
     # ---------------------------------------------------------- execution
 
     def _resolve_blocks(self) -> list:
@@ -186,22 +448,39 @@ class Dataset:
                 out.append(b)
         return out
 
-    def _materialize(self, parallelism: str = "inline") -> list:
-        """Run all stages on every block."""
+    def _iter_staged_blocks(self) -> Iterator:
+        """Blocks with stages applied, one at a time (streaming shape)."""
+        for i, blk in enumerate(self._resolve_blocks()):
+            yield _apply_stages(blk, self._stages, i)
+
+    def _materialize(self, parallelism: str = "inline",
+                     num_actors: int = 2) -> list:
+        """Run all stages on every block.  parallelism: "inline" |
+        "tasks" | "actors" (reference compute strategies
+        _internal/compute.py: TaskPoolStrategy vs ActorPoolStrategy)."""
         blocks = self._resolve_blocks()
         if not self._stages:
             return blocks
 
-        def run_all(blk):
-            for st in self._stages:
-                blk = st(blk)
-            return blk
-
+        stages = self._stages
         if parallelism == "tasks":
             import ray_tpu
-            task = ray_tpu.remote(lambda blk: run_all(blk))
-            return ray_tpu.get([task.remote(b) for b in blocks])
-        return [run_all(b) for b in blocks]
+            task = ray_tpu.remote(_apply_stages)
+            return ray_tpu.get([task.remote(b, stages, i)
+                                for i, b in enumerate(blocks)])
+        if parallelism == "actors":
+            import ray_tpu
+            from ray_tpu.util.actor_pool import ActorPool
+            Worker = ray_tpu.remote(_BlockWorker)
+            actors = [Worker.remote(stages)
+                      for _ in range(min(num_actors, len(blocks)) or 1)]
+            pool = ActorPool(actors)
+            out = list(pool.map(lambda a, bi: a.run.remote(bi[1], bi[0]),
+                                list(enumerate(blocks))))
+            for a in actors:
+                ray_tpu.kill(a)
+            return out
+        return list(self._iter_staged_blocks())
 
     def materialize(self, parallelism: str = "inline") -> "Dataset":
         return Dataset(self._materialize(parallelism))
@@ -245,13 +524,8 @@ class Dataset:
         if shuffle_seed is not None:
             np.random.default_rng(shuffle_seed).shuffle(order)
 
-        def staged(blk):
-            for st in self._stages:
-                blk = st(blk)
-            return blk
-
         for bi in order:
-            blk = staged(blocks[bi])
+            blk = _apply_stages(blocks[bi], self._stages, bi)
             if carry is not None:
                 blk = B.concat([carry, blk])
                 carry = None

@@ -1,0 +1,147 @@
+"""GroupedData: groupby + aggregations over block datasets.
+
+Reference capability: ray.data GroupedData (python/ray/data/
+grouped_dataset.py — groupby().count/sum/mean/min/max/std/aggregate,
+map_groups) and the AggregateFn protocol (python/ray/data/
+aggregate.py).  Single-pass sort-free implementation: per-block partial
+aggregation by key (np.unique inverse indices), then a combine across
+blocks — the same shuffle-avoiding shape the reference's push-based
+shuffle aggregation uses, without the wire hop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+
+@dataclass
+class AggregateFn:
+    """(reference: python/ray/data/aggregate.py AggregateFn).  The
+    accumulator for a group starts from its first block's
+    ``accumulate_block`` partial (no separate empty-init state), partials
+    ``merge`` across blocks, and ``finalize`` maps the merged partial to
+    the output value."""
+    name: str                      # output column suffix
+    # accumulate over a per-group value array → partial
+    accumulate_block: Callable[[np.ndarray], np.ndarray]
+    # combine two partials
+    merge: Callable[[np.ndarray, np.ndarray], np.ndarray]
+    finalize: Callable = staticmethod(lambda x: x)
+
+
+def Sum(col):
+    return AggregateFn(f"sum({col})", lambda v: v.sum(), np.add), col
+
+
+def Min(col):
+    return AggregateFn(f"min({col})", lambda v: v.min(), np.minimum), col
+
+
+def Max(col):
+    return AggregateFn(f"max({col})", lambda v: v.max(), np.maximum), col
+
+
+def Count():
+    return AggregateFn("count()", lambda v: len(v), np.add), None
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._ds = dataset
+        self._key = key
+
+    # -- generic reduction over (key, column) pairs ------------------------
+
+    def _group_reduce(self, cols: list[Optional[str]], partial_fns,
+                      merge_fns, out_names):
+        """Partial-aggregate each block, merge across blocks."""
+        acc: dict = {}   # key value -> list of partials per aggregate
+        for blk in self._ds._materialize():
+            if not B.num_rows(blk):
+                continue
+            keys = np.asarray(blk[self._key])
+            uniq, inv = np.unique(keys, return_inverse=True)
+            for j, kv in enumerate(uniq):
+                sel = inv == j
+                parts = []
+                for col, pf in zip(cols, partial_fns):
+                    v = (np.asarray(blk[col])[sel] if col is not None
+                         else np.zeros(int(sel.sum())))
+                    parts.append(pf(v))
+                k = kv.item() if hasattr(kv, "item") else kv
+                if k in acc:
+                    acc[k] = [mf(a, p) for mf, a, p in
+                              zip(merge_fns, acc[k], parts)]
+                else:
+                    acc[k] = parts
+        keys_sorted = sorted(acc.keys())
+        out = {self._key: np.asarray(keys_sorted)}
+        for i, name in enumerate(out_names):
+            fin = self._finalizers[i]
+            out[name] = np.asarray([fin(acc[k][i]) for k in keys_sorted])
+        from ray_tpu.data.dataset import Dataset
+        return Dataset([out])
+
+    def aggregate(self, *aggs):
+        """aggs: results of Sum/Min/Max/Count or (AggregateFn, col)."""
+        fns, cols = zip(*aggs)
+        self._finalizers = [f.finalize for f in fns]
+        return self._group_reduce(
+            list(cols), [f.accumulate_block for f in fns],
+            [f.merge for f in fns], [f.name for f in fns])
+
+    def count(self):
+        return self.aggregate(Count())
+
+    def sum(self, col: str):
+        return self.aggregate(Sum(col))
+
+    def min(self, col: str):
+        return self.aggregate(Min(col))
+
+    def max(self, col: str):
+        return self.aggregate(Max(col))
+
+    def mean(self, col: str):
+        # sum & count partials, finalize to mean
+        ds = self.aggregate(Sum(col), Count())
+        def fin(b):
+            return {self._key: b[self._key],
+                    f"mean({col})": b[f"sum({col})"]
+                    / np.maximum(b["count()"], 1)}
+        return ds.map_batches(fin)
+
+    def std(self, col: str, ddof: int = 1):
+        # (sum, sumsq, count) partials — numerically fine for tests/
+        # moderate data; Welford per-block would be the next step
+        sq = AggregateFn(f"sumsq({col})",
+                         lambda v: float((v.astype(np.float64) ** 2).sum()),
+                         np.add)
+        ds = self.aggregate(Sum(col), (sq, col), Count())
+        def fin(b):
+            n = np.maximum(b["count()"], 1)
+            mean = b[f"sum({col})"] / n
+            var = (b[f"sumsq({col})"] / n - mean ** 2) * n / np.maximum(
+                n - ddof, 1)
+            return {self._key: b[self._key],
+                    f"std({col})": np.sqrt(np.maximum(var, 0.0))}
+        return ds.map_batches(fin)
+
+    def map_groups(self, fn: Callable[[dict], dict]):
+        """fn: group block → block (reference: map_groups).  Groups are
+        materialized per key (global)."""
+        blocks = self._ds._materialize()
+        full = B.concat([b for b in blocks if B.num_rows(b)])
+        keys = np.asarray(full[self._key])
+        uniq, inv = np.unique(keys, return_inverse=True)
+        outs = []
+        for j in np.argsort(uniq, kind="stable"):
+            sel = np.nonzero(inv == j)[0]
+            outs.append(B.normalize(fn(dict(B.take_rows(full, sel)))))
+        from ray_tpu.data.dataset import Dataset
+        return Dataset(outs or [{}])

@@ -1,0 +1,115 @@
+"""DatasetPipeline: windowed / repeated streaming over a Dataset.
+
+Reference capability: ray.data.DatasetPipeline (python/ray/data/
+dataset_pipeline.py + _internal/pipeline_executor.py) — process a
+dataset window-by-window so ingest, transform, and consumption overlap
+instead of materializing everything; ``repeat`` re-reads for multi-epoch
+training feeds.  Windows here are block sublists; per-window transforms
+reuse the Dataset stage machinery.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterator, Optional
+
+from ray_tpu.data import block as B
+
+
+class DatasetPipeline:
+    def __init__(self, windows_fn: Callable[[], Iterator], *,
+                 length: Optional[int] = None):
+        # windows_fn: () -> iterator of Dataset windows (fresh each call)
+        self._windows_fn = windows_fn
+        self._length = length
+
+    # -- construction (used by Dataset.window / Dataset.repeat) -----------
+
+    @staticmethod
+    def from_windows(datasets_fn: Callable[[], Iterator], *,
+                     length: Optional[int] = None) -> "DatasetPipeline":
+        return DatasetPipeline(datasets_fn, length=length)
+
+    def __len__(self) -> int:
+        if self._length is None:
+            raise TypeError("pipeline length unknown (infinite repeat?)")
+        return self._length
+
+    # -- per-window transforms ---------------------------------------------
+
+    def _lift(self, method: str, *a, **kw) -> "DatasetPipeline":
+        src = self._windows_fn
+        def gen():
+            for ds in src():
+                yield getattr(ds, method)(*a, **kw)
+        return DatasetPipeline(gen, length=self._length)
+
+    def map_batches(self, fn, **kw) -> "DatasetPipeline":
+        return self._lift("map_batches", fn, **kw)
+
+    def map(self, fn) -> "DatasetPipeline":
+        return self._lift("map", fn)
+
+    def filter(self, fn) -> "DatasetPipeline":
+        return self._lift("filter", fn)
+
+    def random_shuffle_each_window(self, *, seed=None) -> "DatasetPipeline":
+        return self._lift("random_shuffle", seed=seed)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        src = self._windows_fn
+        def gen():
+            n = 0
+            while times is None or n < times:
+                yield from src()
+                n += 1
+        return DatasetPipeline(
+            gen, length=None if times is None or self._length is None
+            else self._length * times)
+
+    # -- consumption -------------------------------------------------------
+
+    def iter_windows(self) -> Iterator:
+        return self._windows_fn()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False) -> Iterator[dict]:
+        carry = None
+        for ds in self._windows_fn():
+            for b in ds.iter_batches(batch_size=batch_size,
+                                     drop_last=False):
+                if carry is not None:
+                    b = B.concat([B.normalize(carry), B.normalize(b)])
+                    carry = None
+                n = B.num_rows(b)
+                s = 0
+                while n - s >= batch_size:
+                    yield dict(B.slice_block(b, s, s + batch_size))
+                    s += batch_size
+                if s < n:
+                    carry = dict(B.slice_block(b, s, n))
+        if carry is not None and not drop_last:
+            yield carry
+
+    def iter_rows(self) -> Iterator[dict]:
+        for ds in self._windows_fn():
+            yield from ds.take_all()
+
+    def count(self) -> int:
+        if self._length is None:
+            raise TypeError(
+                "count() on an endless pipeline (repeat(times=None)) "
+                "would never return; pass an explicit repeat count")
+        return sum(ds.count() for ds in self._windows_fn())
+
+    def take(self, n: int = 20) -> list[dict]:
+        out = []
+        for ds in self._windows_fn():
+            out.extend(ds.take(n - len(out)))
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def __repr__(self):
+        ln = "?" if self._length is None else self._length
+        return f"DatasetPipeline(windows={ln})"

@@ -142,3 +142,112 @@ class Chain(Preprocessor):
         self.fit(ds)
         self._fitted = True
         return self.transform(ds)
+
+
+class OneHotEncoder(Preprocessor):
+    """Categorical columns → one-hot vectors (reference:
+    python/ray/data/preprocessors/encoder.py OneHotEncoder)."""
+
+    def __init__(self, columns: list[str]):
+        self.columns = columns
+        self.stats_: dict = {}
+
+    def _fit(self, ds):
+        # ds.unique returns sorted classes — searchsorted-ready
+        self.stats_ = {c: np.asarray(ds.unique(c)) for c in self.columns}
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            classes = self.stats_[c]
+            col = np.asarray(out.pop(c))
+            j = np.searchsorted(classes, col)
+            j_clip = np.minimum(j, len(classes) - 1)
+            known = classes[j_clip] == col
+            oh = np.zeros((len(col), len(classes)), np.float32)
+            rows = np.nonzero(known)[0]
+            oh[rows, j_clip[rows]] = 1.0
+            out[c] = oh
+        return out
+
+
+class SimpleImputer(Preprocessor):
+    """Fill missing values (NaN) with mean/median/constant (reference:
+    python/ray/data/preprocessors/imputer.py)."""
+
+    def __init__(self, columns: list[str], strategy: str = "mean",
+                 fill_value=None):
+        assert strategy in ("mean", "median", "constant")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError(
+                "strategy='constant' requires an explicit fill_value")
+        self.columns = columns
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: dict = {}
+
+    def _fit(self, ds):
+        for c in self.columns:
+            v = ds._column(c).astype(np.float64)
+            if self.strategy == "mean":
+                self.stats_[c] = float(np.nanmean(v))
+            elif self.strategy == "median":
+                self.stats_[c] = float(np.nanmedian(v))
+            else:
+                self.stats_[c] = self.fill_value
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            v = np.asarray(out[c], np.float64)
+            out[c] = np.where(np.isnan(v), self.stats_[c], v)
+        return out
+
+
+class Normalizer(Preprocessor):
+    """Row-wise Lp normalization (reference:
+    python/ray/data/preprocessors/normalizer.py).  Stateless."""
+
+    def __init__(self, columns: list[str], norm: str = "l2"):
+        self.columns = columns
+        self.ord = {"l1": 1, "l2": 2, "max": np.inf}[norm]
+        self.stats_ = {}
+
+    def _fit(self, ds):
+        pass
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        stacked = np.stack([np.asarray(out[c], np.float64)
+                            for c in self.columns], axis=1)
+        norms = np.linalg.norm(stacked, ord=self.ord, axis=1)
+        norms = np.where(norms == 0, 1.0, norms)
+        for c in self.columns:
+            out[c] = np.asarray(out[c], np.float64) / norms
+        return out
+
+
+class RobustScaler(Preprocessor):
+    """Scale by median/IQR (reference:
+    python/ray/data/preprocessors/scaler.py RobustScaler)."""
+
+    def __init__(self, columns: list[str],
+                 quantile_range: tuple = (0.25, 0.75)):
+        self.columns = columns
+        self.quantile_range = quantile_range
+        self.stats_: dict = {}
+
+    def _fit(self, ds):
+        lo, hi = self.quantile_range
+        for c in self.columns:
+            v = ds._column(c).astype(np.float64)
+            med = float(np.median(v))
+            iqr = float(np.quantile(v, hi) - np.quantile(v, lo)) or 1.0
+            self.stats_[c] = (med, iqr)
+
+    def _transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            med, iqr = self.stats_[c]
+            out[c] = (np.asarray(out[c], np.float64) - med) / iqr
+        return out

@@ -110,3 +110,233 @@ def test_map_batches_as_tasks(rt_init):
     ds = rd.range(20, parallelism=4).map_batches(
         lambda b: {"id": b["id"] + 1})
     assert ds.materialize(parallelism="tasks").count() == 20
+
+
+# -- new datasources -------------------------------------------------------
+
+def test_json_roundtrip(tmp_path):
+    ds = rd.from_numpy({"a": np.arange(6), "b": np.arange(6) * 0.5})
+    paths = ds.write_json(str(tmp_path / "j"))
+    back = rd.read_json(str(tmp_path / "j"))
+    assert back.count() == 6
+    assert back.take(1)[0]["a"] == 0
+
+
+def test_csv_roundtrip(tmp_path):
+    ds = rd.from_numpy({"x": np.arange(5)})
+    ds.write_csv(str(tmp_path / "c"))
+    back = rd.read_csv(str(tmp_path / "c"))
+    assert back.count() == 5
+
+
+def test_numpy_roundtrip(tmp_path):
+    ds = rd.from_numpy(np.arange(12).reshape(6, 2))
+    ds.write_numpy(str(tmp_path / "n"))
+    back = rd.read_numpy(str(tmp_path / "n"))
+    assert back.count() == 6
+
+
+def test_read_text_and_binary(tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    ds = rd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+    b = rd.read_binary_files(str(p), include_paths=True)
+    row = b.take(1)[0]
+    assert row["bytes"] == p.read_bytes() and row["path"].endswith("f.txt")
+
+
+def test_from_to_pandas():
+    import pandas as pd
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    ds = rd.from_pandas(df)
+    assert ds.count() == 3
+    back = ds.to_pandas()
+    assert list(back["a"]) == [1, 2, 3]
+
+
+# -- transforms ------------------------------------------------------------
+
+def test_flat_map_limit_sample():
+    ds = rd.range(10, parallelism=2).flat_map(
+        lambda r: [{"v": r["id"]}, {"v": r["id"] + 100}])
+    assert ds.count() == 20
+    assert ds.limit(3).count() == 3
+    sampled = rd.range(1000).random_sample(0.1, seed=0)
+    assert 50 < sampled.count() < 200
+
+
+def test_select_drop_columns():
+    ds = rd.from_numpy({"a": np.arange(4), "b": np.arange(4),
+                        "c": np.arange(4)})
+    assert set(ds.select_columns(["a", "b"]).schema()) == {"a", "b"}
+    assert set(ds.drop_columns(["a"]).schema()) == {"b", "c"}
+
+
+def test_zip_and_split_at_indices():
+    a = rd.from_numpy({"x": np.arange(6)})
+    b = rd.from_numpy({"y": np.arange(6) * 2})
+    z = a.zip(b)
+    assert set(z.schema()) == {"x", "y"}
+    parts = z.split_at_indices([2, 4])
+    assert [p.count() for p in parts] == [2, 2, 2]
+
+
+def test_train_test_split():
+    tr, te = rd.range(100).train_test_split(test_size=0.2, shuffle=True,
+                                            seed=0)
+    assert tr.count() == 80 and te.count() == 20
+    ids = {r["id"] for r in tr.take_all()} | {r["id"] for r in te.take_all()}
+    assert len(ids) == 100
+
+
+# -- groupby / aggregates --------------------------------------------------
+
+def test_groupby_aggregates():
+    ds = rd.from_numpy({"k": np.array([0, 1, 0, 1, 0]),
+                        "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0])})
+    g = ds.groupby("k")
+    s = {r["k"]: r["sum(v)"] for r in g.sum("v").take_all()}
+    assert s == {0: 9.0, 1: 6.0}
+    c = {r["k"]: r["count()"] for r in g.count().take_all()}
+    assert c == {0: 3, 1: 2}
+    m = {r["k"]: r["mean(v)"] for r in g.mean("v").take_all()}
+    assert m == {0: 3.0, 1: 3.0}
+    mn = {r["k"]: r["min(v)"] for r in g.min("v").take_all()}
+    assert mn == {0: 1.0, 1: 2.0}
+    st = {r["k"]: r["std(v)"] for r in g.std("v").take_all()}
+    np.testing.assert_allclose(st[0], np.std([1, 3, 5], ddof=1), rtol=1e-6)
+
+
+def test_groupby_multiblock_merge():
+    # groups spanning blocks must merge partials
+    ds = rd.from_numpy({"k": np.arange(20) % 3,
+                        "v": np.ones(20)}, parallelism=5)
+    c = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert sum(c.values()) == 20 and set(c) == {0, 1, 2}
+
+
+def test_map_groups():
+    ds = rd.from_numpy({"k": np.array([0, 0, 1]),
+                        "v": np.array([1.0, 2.0, 3.0])})
+    out = ds.groupby("k").map_groups(
+        lambda g: {"k": g["k"][:1], "vmax": g["v"].max(keepdims=True)})
+    rows = {r["k"]: r["vmax"] for r in out.take_all()}
+    assert rows == {0: 2.0, 1: 3.0}
+
+
+def test_global_aggregates():
+    ds = rd.from_numpy({"v": np.arange(10, dtype=np.float64)})
+    assert ds.sum("v") == 45.0
+    assert ds.mean("v") == 4.5
+    assert ds.min("v") == 0.0 and ds.max("v") == 9.0
+    assert ds.unique("v")[:3] == [0.0, 1.0, 2.0]
+
+
+# -- pipeline --------------------------------------------------------------
+
+def test_dataset_pipeline_window_repeat():
+    ds = rd.range(16, parallelism=4)
+    pipe = ds.window(blocks_per_window=2)
+    assert len(pipe) == 2
+    assert pipe.count() == 16
+    pipe2 = ds.repeat(3)
+    assert pipe2.count() == 48
+    batches = list(ds.repeat(2).map_batches(
+        lambda b: {"id": b["id"] * 2}).iter_batches(batch_size=8))
+    assert sum(len(b["id"]) for b in batches) == 32
+    assert all((b["id"] % 2 == 0).all() for b in batches)
+
+
+def test_pipeline_shuffle_each_window():
+    ds = rd.range(8, parallelism=2)
+    pipe = ds.window(blocks_per_window=1).random_shuffle_each_window(seed=0)
+    assert pipe.count() == 8
+
+
+# -- new preprocessors -----------------------------------------------------
+
+def test_one_hot_and_imputer():
+    from ray_tpu.data import OneHotEncoder, SimpleImputer
+    ds = rd.from_numpy({"c": np.array(["a", "b", "a", "c"]),
+                        "x": np.array([1.0, np.nan, 3.0, np.nan])})
+    oh = OneHotEncoder(["c"]).fit_transform(ds)
+    row = oh.take(2)
+    np.testing.assert_array_equal(row[0]["c"], [1, 0, 0])
+    np.testing.assert_array_equal(row[1]["c"], [0, 1, 0])
+    im = SimpleImputer(["x"], strategy="mean").fit_transform(ds)
+    xs = np.array([r["x"] for r in im.take_all()])
+    np.testing.assert_allclose(xs, [1.0, 2.0, 3.0, 2.0])
+
+
+def test_normalizer_and_robust_scaler():
+    from ray_tpu.data import Normalizer, RobustScaler
+    ds = rd.from_numpy({"a": np.array([3.0, 0.0]),
+                        "b": np.array([4.0, 5.0])})
+    out = Normalizer(["a", "b"]).fit_transform(ds).take_all()
+    np.testing.assert_allclose([out[0]["a"], out[0]["b"]], [0.6, 0.8])
+    ds2 = rd.from_numpy({"v": np.arange(101, dtype=np.float64)})
+    rs = RobustScaler(["v"]).fit_transform(ds2)
+    vs = np.array([r["v"] for r in rs.take_all()])
+    np.testing.assert_allclose(np.median(vs), 0.0, atol=1e-9)
+
+
+def test_map_batches_as_actors(rt_init):
+    ds = rd.range(20, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] + 1})
+    out = ds.materialize(parallelism="actors")
+    assert out.count() == 20
+    assert sorted(r["id"] for r in out.take_all()) == list(range(1, 21))
+
+
+# -- review regression tests -----------------------------------------------
+
+def test_write_json_vector_columns(tmp_path):
+    ds = rd.from_numpy(np.arange(12).reshape(6, 2))
+    ds.write_json(str(tmp_path / "v"))
+    back = rd.read_json(str(tmp_path / "v"))
+    assert back.count() == 6
+
+
+def test_read_json_heterogeneous_rows(tmp_path):
+    p = tmp_path / "h.json"
+    p.write_text('{"a": 1}\n{"a": 2, "b": 3}\n')
+    ds = rd.read_json(str(p))
+    rows = ds.take_all()
+    assert rows[0]["b"] is None and rows[1]["b"] == 3
+
+
+def test_random_sample_decorrelated_blocks():
+    ds = rd.range(1000, parallelism=10).random_sample(0.3, seed=7)
+    ids = np.array([r["id"] for r in ds.take_all()])
+    # per-block positions must differ: the mod-100 residues should not
+    # collapse to a handful of values
+    assert len(set(ids % 100)) > 10
+
+
+def test_imputer_constant_requires_fill():
+    from ray_tpu.data import SimpleImputer
+    with pytest.raises(ValueError):
+        SimpleImputer(["x"], strategy="constant")
+    ds = rd.from_numpy({"x": np.array([1.0, np.nan])})
+    out = SimpleImputer(["x"], strategy="constant",
+                        fill_value=9.0).fit_transform(ds)
+    assert out.take_all()[1]["x"] == 9.0
+
+
+def test_infinite_pipeline_count_raises():
+    pipe = rd.range(4).repeat()
+    with pytest.raises(TypeError):
+        pipe.count()
+    assert len(pipe.take(6)) == 6  # take stays bounded
+
+
+def test_aggregate_finalize_wired():
+    from ray_tpu.data import AggregateFn
+    ds = rd.from_numpy({"k": np.array([0, 0, 1]),
+                        "v": np.array([2.0, 4.0, 8.0])})
+    halfsum = AggregateFn("halfsum(v)", lambda v: v.sum(), np.add,
+                          finalize=lambda x: x / 2)
+    out = {r["k"]: r["halfsum(v)"]
+           for r in ds.groupby("k").aggregate((halfsum, "v")).take_all()}
+    assert out == {0: 3.0, 1: 4.0}
