@@ -133,8 +133,17 @@ class Dataset:
             keys: dict = {}
             for r in rows:
                 keys.update(dict.fromkeys(r))
-            blocks.append({k: np.asarray([r.get(k) for r in rows])
-                           for k in keys})
+
+            def col(k):
+                vals = [r.get(k) for r in rows]
+                try:
+                    return np.asarray(vals)
+                except ValueError:   # ragged lists / mixed None
+                    a = np.empty(len(vals), dtype=object)
+                    a[:] = vals
+                    return a
+
+            blocks.append({k: col(k) for k in keys})
         return Dataset(blocks or [{}])
 
     @staticmethod
@@ -365,7 +374,11 @@ class Dataset:
             raise ValueError("zip requires equal row counts")
         out = dict(a)
         for k, v in b.items():
-            out[k if k not in out else f"{k}_1"] = v
+            name, i = k, 1
+            while name in out:
+                name = f"{k}_{i}"
+                i += 1
+            out[name] = v
         return Dataset([out])
 
     def split_at_indices(self, indices: list[int]) -> list["Dataset"]:

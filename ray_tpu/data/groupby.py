@@ -58,7 +58,7 @@ class GroupedData:
     # -- generic reduction over (key, column) pairs ------------------------
 
     def _group_reduce(self, cols: list[Optional[str]], partial_fns,
-                      merge_fns, out_names):
+                      merge_fns, out_names, finalizers=None):
         """Partial-aggregate each block, merge across blocks."""
         acc: dict = {}   # key value -> list of partials per aggregate
         for blk in self._ds._materialize():
@@ -81,8 +81,9 @@ class GroupedData:
                     acc[k] = parts
         keys_sorted = sorted(acc.keys())
         out = {self._key: np.asarray(keys_sorted)}
+        finalizers = finalizers or [lambda x: x] * len(out_names)
         for i, name in enumerate(out_names):
-            fin = self._finalizers[i]
+            fin = finalizers[i]
             out[name] = np.asarray([fin(acc[k][i]) for k in keys_sorted])
         from ray_tpu.data.dataset import Dataset
         return Dataset([out])
@@ -90,10 +91,10 @@ class GroupedData:
     def aggregate(self, *aggs):
         """aggs: results of Sum/Min/Max/Count or (AggregateFn, col)."""
         fns, cols = zip(*aggs)
-        self._finalizers = [f.finalize for f in fns]
         return self._group_reduce(
             list(cols), [f.accumulate_block for f in fns],
-            [f.merge for f in fns], [f.name for f in fns])
+            [f.merge for f in fns], [f.name for f in fns],
+            [f.finalize for f in fns])
 
     def count(self):
         return self.aggregate(Count())

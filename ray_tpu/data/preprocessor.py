@@ -188,13 +188,14 @@ class SimpleImputer(Preprocessor):
 
     def _fit(self, ds):
         for c in self.columns:
+            if self.strategy == "constant":
+                self.stats_[c] = self.fill_value
+                continue
             v = ds._column(c).astype(np.float64)
             if self.strategy == "mean":
                 self.stats_[c] = float(np.nanmean(v))
-            elif self.strategy == "median":
-                self.stats_[c] = float(np.nanmedian(v))
             else:
-                self.stats_[c] = self.fill_value
+                self.stats_[c] = float(np.nanmedian(v))
 
     def _transform_batch(self, batch):
         out = dict(batch)
@@ -211,10 +212,9 @@ class Normalizer(Preprocessor):
     def __init__(self, columns: list[str], norm: str = "l2"):
         self.columns = columns
         self.ord = {"l1": 1, "l2": 2, "max": np.inf}[norm]
-        self.stats_ = {}
-
-    def _fit(self, ds):
-        pass
+        # no _fit override: the base class detects stateless
+        # preprocessors by the absence of one, so transform() works
+        # without a fit() call
 
     def _transform_batch(self, batch):
         out = dict(batch)

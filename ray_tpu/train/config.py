@@ -56,6 +56,10 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    callbacks: list = field(default_factory=list)   # tune.Callback hooks
+    # stop criteria: {"metric": threshold} — a trial stops when any
+    # reported metric reaches its threshold (reference: tune.run(stop=...))
+    stop: Optional[dict] = None
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.join(

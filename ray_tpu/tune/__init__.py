@@ -4,8 +4,14 @@ python/ray/tune — SURVEY.md §2.4; build plan §7 M5)."""
 from typing import Optional
 
 from ray_tpu.tune import _report_bridge
+from ray_tpu.tune.callback import (Callback, CSVLoggerCallback,
+                                   JSONLoggerCallback,
+                                   TensorBoardLoggerCallback)
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
-                                     PopulationBasedTraining, TrialScheduler)
+                                     HyperBandScheduler,
+                                     MedianStoppingRule,
+                                     PopulationBasedTraining,
+                                     TrialScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
                                  Searcher, choice, grid_search, loguniform,
                                  randint, uniform)
@@ -35,6 +41,8 @@ __all__ = [
     "FunctionTrainable", "wrap_function", "report", "get_checkpoint",
     "choice", "uniform", "loguniform", "randint", "grid_search",
     "BasicVariantGenerator", "ConcurrencyLimiter", "Searcher",
-    "ASHAScheduler", "FIFOScheduler", "PopulationBasedTraining",
-    "TrialScheduler",
+    "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining", "TrialScheduler",
+    "Callback", "CSVLoggerCallback", "JSONLoggerCallback",
+    "TensorBoardLoggerCallback",
 ]

@@ -1,0 +1,128 @@
+"""Tune callbacks + result loggers.
+
+Reference capability: tune/callback.py Callback + tune/logger/
+(csv.py CSVLoggerCallback, json.py JSONLoggerCallback, tensorboardx.py)
+— per-trial progress files under the run directory, plus user hooks on
+trial lifecycle events.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Optional
+
+
+class Callback:
+    """(reference: tune/callback.py Callback hooks subset)"""
+
+    def setup(self, run_dir: str):
+        pass
+
+    def on_trial_start(self, trial) -> None:
+        pass
+
+    def on_trial_result(self, trial, result: dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial) -> None:
+        pass
+
+    def on_trial_error(self, trial) -> None:
+        pass
+
+    def on_experiment_end(self, trials: list) -> None:
+        pass
+
+
+def _scalars(result: dict) -> dict:
+    return {k: v for k, v in result.items()
+            if isinstance(v, (int, float, str, bool))}
+
+
+class _PerTrialFileCallback(Callback):
+    def __init__(self):
+        self._run_dir: Optional[str] = None
+
+    def setup(self, run_dir: str):
+        self._run_dir = run_dir
+
+    def _trial_dir(self, trial) -> str:
+        d = os.path.join(self._run_dir or ".", trial.trial_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+
+class JSONLoggerCallback(_PerTrialFileCallback):
+    """result.json: one JSON line per reported result (reference:
+    tune/logger/json.py)."""
+
+    def on_trial_start(self, trial):
+        with open(os.path.join(self._trial_dir(trial),
+                               "params.json"), "w") as f:
+            json.dump(_scalars(trial.config), f)
+
+    def on_trial_result(self, trial, result):
+        with open(os.path.join(self._trial_dir(trial),
+                               "result.json"), "a") as f:
+            f.write(json.dumps(_scalars(result)) + "\n")
+
+
+class CSVLoggerCallback(_PerTrialFileCallback):
+    """progress.csv (reference: tune/logger/csv.py).  Columns fixed by
+    the first result; later extra keys are dropped (same behavior as the
+    reference's CSV logger)."""
+
+    def __init__(self):
+        super().__init__()
+        self._fields: dict[str, list] = {}
+
+    def on_trial_result(self, trial, result):
+        path = os.path.join(self._trial_dir(trial), "progress.csv")
+        row = _scalars(result)
+        if trial.trial_id not in self._fields:
+            self._fields[trial.trial_id] = list(row)
+            with open(path, "w", newline="") as f:
+                w = csv.DictWriter(f, fieldnames=list(row))
+                w.writeheader()
+                w.writerow(row)
+            return
+        fields = self._fields[trial.trial_id]
+        with open(path, "a", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+            w.writerow(row)
+
+
+class TensorBoardLoggerCallback(_PerTrialFileCallback):
+    """TensorBoard event files via torch's SummaryWriter when available;
+    silently no-ops otherwise (the environment gates the dependency, as
+    with the reference's optional tensorboardX)."""
+
+    def __init__(self):
+        super().__init__()
+        self._writers: dict[str, Any] = {}
+
+    def _writer(self, trial):
+        if trial.trial_id not in self._writers:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._writers[trial.trial_id] = SummaryWriter(
+                    self._trial_dir(trial))
+            except Exception:
+                self._writers[trial.trial_id] = None
+        return self._writers[trial.trial_id]
+
+    def on_trial_result(self, trial, result):
+        w = self._writer(trial)
+        if w is None:
+            return
+        step = result.get("training_iteration", 0)
+        for k, v in result.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                w.add_scalar(k, v, global_step=step)
+
+    def on_trial_complete(self, trial):
+        w = self._writers.pop(trial.trial_id, None)
+        if w is not None:
+            w.close()

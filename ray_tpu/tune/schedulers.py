@@ -146,3 +146,70 @@ class PopulationBasedTraining(TrialScheduler):
 
     def on_complete(self, trial, result):
         self._scores.pop(trial.trial_id, None)
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop trials whose running-average metric falls below the median
+    of the running averages of all trials at the same iteration
+    (reference: tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        assert mode in ("min", "max")
+        self.metric, self.mode = metric, mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial_id -> list of metric values
+        self._results: dict[str, list[float]] = defaultdict(list)
+
+    def on_result(self, trial, result) -> str:
+        v = result.get(self.metric)
+        t = result.get("training_iteration", 0)
+        if v is None:
+            return CONTINUE
+        self._results[trial.trial_id].append(float(v))
+        if t < self.grace_period:
+            return CONTINUE
+        others = [sum(r) / len(r) for tid, r in self._results.items()
+                  if tid != trial.trial_id and r]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        import statistics
+        median = statistics.median(others)
+        mine = self._results[trial.trial_id]
+        avg = sum(mine) / len(mine)
+        worse = avg > median if self.mode == "min" else avg < median
+        return STOP if worse else CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Multi-bracket successive halving: trials round-robin over
+    num_brackets ASHA ladders with staggered grace periods, trading
+    exploration breadth against early-stopping aggressiveness
+    (reference: tune/schedulers/hyperband.py HyperBandScheduler; the
+    async multi-bracket form of async_hyperband.py brackets>1)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 81, reduction_factor: int = 3,
+                 num_brackets: int = 3):
+        self.brackets = [
+            ASHAScheduler(metric=metric, mode=mode, max_t=max_t,
+                          grace_period=max(1, reduction_factor ** s),
+                          reduction_factor=reduction_factor)
+            for s in range(num_brackets)]
+        self._assignment: dict[str, int] = {}
+        self._next = 0
+
+    def _bracket_for(self, trial) -> "ASHAScheduler":
+        b = self._assignment.get(trial.trial_id)
+        if b is None:
+            b = self._next % len(self.brackets)
+            self._assignment[trial.trial_id] = b
+            self._next += 1
+        return self.brackets[b]
+
+    def on_result(self, trial, result) -> str:
+        return self._bracket_for(trial).on_result(trial, result)
+
+    def on_complete(self, trial, result):
+        self._assignment.pop(trial.trial_id, None)

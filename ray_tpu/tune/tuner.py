@@ -132,6 +132,72 @@ class Tuner:
         else:
             raise TypeError("trainable must be a function or Trainable")
         self.param_space = param_space or {}
+        self._restored: list[Trial] = []   # from Tuner.restore
+
+    # -- experiment-state checkpointing (reference: TrialRunner
+    #    experiment checkpoint + Tuner.restore, tuner.py/trial_runner.py)
+
+    @staticmethod
+    def _experiment_state_path(run_dir: str) -> str:
+        return os.path.join(run_dir, "experiment_state.pkl")
+
+    def _save_experiment_state(self, run_dir: str, trials: list,
+                               searcher=None) -> None:
+        import cloudpickle
+        state = [{"trial_id": t.trial_id, "config": t.config,
+                  "status": t.status, "last_result": t.last_result,
+                  "history": t.history, "error": t.error,
+                  "checkpoint": t.checkpoint} for t in trials]
+        payload = {"trials": state, "param_space": self.param_space}
+        # searcher + configs ride along so restore continues the SAME
+        # experiment: remaining suggestions, metric/mode, stop criteria,
+        # schedulers, callbacks.  Unpicklable user objects degrade to
+        # defaults rather than failing the checkpoint.
+        for key, obj in (("searcher", searcher),
+                         ("tune_config", self.tune_config),
+                         ("run_config", self.run_config)):
+            try:
+                payload[key] = cloudpickle.dumps(obj)
+            except Exception:
+                payload[key] = None
+        tmp = self._experiment_state_path(run_dir) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f)
+        os.replace(tmp, self._experiment_state_path(run_dir))
+
+    @classmethod
+    def restore(cls, path: str, trainable) -> "Tuner":
+        """Resume an interrupted experiment from its run directory:
+        completed trials keep their results, unfinished ones re-run from
+        their last saved checkpoint, and the restored searcher continues
+        suggesting any configs the interrupted run never reached."""
+        import cloudpickle
+        with open(cls._experiment_state_path(path), "rb") as f:
+            state = pickle.load(f)
+
+        def load(key):
+            raw = state.get(key)
+            try:
+                return cloudpickle.loads(raw) if raw is not None else None
+            except Exception:
+                return None
+
+        tune_config = load("tune_config") or TuneConfig()
+        run_config = load("run_config") or RunConfig(
+            name=os.path.basename(path.rstrip(os.sep)),
+            storage_path=os.path.dirname(path.rstrip(os.sep)) or None)
+        searcher = load("searcher")
+        if searcher is not None:
+            tune_config.search_alg = searcher
+        tuner = cls(trainable, param_space=state["param_space"],
+                    tune_config=tune_config, run_config=run_config)
+        for ts in state["trials"]:
+            t = Trial(trial_id=ts["trial_id"], config=ts["config"],
+                      status=ts["status"], last_result=ts["last_result"],
+                      history=ts["history"], error=ts["error"],
+                      checkpoint=ts["checkpoint"])
+            tuner._restored.append(t)
+        return tuner
 
     # -- executor helpers --------------------------------------------------
 
@@ -166,11 +232,27 @@ class Tuner:
         searcher = tc.search_alg or BasicVariantGenerator(
             self.param_space, num_samples=tc.num_samples, seed=tc.seed)
         scheduler = tc.scheduler or FIFOScheduler()
+        callbacks = list(self.run_config.callbacks)
+        stop_criteria = self.run_config.stop or {}
+        for cb in callbacks:
+            cb.setup(run_dir)
 
         trials: list[Trial] = []
         live: list[Trial] = []
-        exhausted = False
-        n = 0
+        # resume: completed trials keep results, unfinished re-queue
+        requeued: list[Trial] = []
+        for t in self._restored:
+            if t.status == "TERMINATED":
+                trials.append(t)
+            else:
+                t.status = "PENDING"
+                t.error = None
+                requeued.append(t)
+        # the restored searcher (if any) continues past already-suggested
+        # configs; a restore without searcher state must not re-suggest
+        # configs that already ran
+        exhausted = bool(self._restored) and tc.search_alg is None
+        n = len(self._restored)
         max_live = tc.max_concurrent_trials or float("inf")
 
         # round-robin stepping (reference TrialRunner.step:938 analogue);
@@ -178,27 +260,34 @@ class Tuner:
         # (ConcurrencyLimiter) get asked again as slots free up
         while True:
             made_progress = False
-            while not exhausted and len(live) < max_live:
-                tid = f"trial_{n:05d}"
-                cfg = searcher.suggest(tid)
-                if cfg is None:
-                    exhausted = True
-                    break
-                if cfg == "PENDING":   # searcher at capacity; retry later
-                    break
+            while len(live) < max_live and (requeued or not exhausted):
+                if requeued:
+                    t = requeued.pop(0)
+                else:
+                    tid = f"trial_{n:05d}"
+                    cfg = searcher.suggest(tid)
+                    if cfg is None:
+                        exhausted = True
+                        break
+                    if cfg == "PENDING":  # searcher at capacity; retry later
+                        break
+                    t = Trial(trial_id=tid, config=cfg)
+                    n += 1
                 made_progress = True
-                t = Trial(trial_id=tid, config=cfg)
-                n += 1
                 trials.append(t)
                 try:
                     self._make_runner(t)
                     t.status = "RUNNING"
                     live.append(t)
+                    for cb in callbacks:
+                        cb.on_trial_start(t)
                 except Exception:
                     t.status = "ERROR"
                     t.error = traceback.format_exc()
                     scheduler.on_complete(t, None)
                     searcher.on_trial_complete(t.trial_id, None)
+                    for cb in callbacks:
+                        cb.on_trial_error(t)
             if not live:
                 if exhausted or not made_progress:
                     break   # done, or searcher wedged with nothing live
@@ -212,10 +301,23 @@ class Tuner:
                     live.remove(t)
                     scheduler.on_complete(t, None)
                     searcher.on_trial_complete(t.trial_id, None)
+                    for cb in callbacks:
+                        cb.on_trial_error(t)
+                    self._save_experiment_state(run_dir, trials, searcher)
                     continue
                 t.last_result = result
                 t.history.append(result)
+                for cb in callbacks:
+                    cb.on_trial_result(t, result)
+                freq = self.run_config.checkpoint_config.checkpoint_frequency
+                if freq and t.iterations % freq == 0:
+                    # periodic trial checkpoint → resumable experiment
+                    t.checkpoint = self._runner_call(t, "save")
+                    self._save_experiment_state(run_dir, trials, searcher)
                 done = result.get("done", False)
+                for k, v in stop_criteria.items():
+                    if k in result and result[k] >= v:
+                        done = True
                 decision = scheduler.on_result(t, result)
                 # PBT exploit: clone src weights + new config
                 exploits = getattr(scheduler, "pending_exploits", None)
@@ -231,7 +333,14 @@ class Tuner:
                 if done or decision == STOP:
                     t.status = "TERMINATED"
                     live.remove(t)
+                    t.checkpoint = self._runner_call(t, "save")
                     self._runner_call(t, "cleanup")
                     scheduler.on_complete(t, t.last_result)
                     searcher.on_trial_complete(t.trial_id, t.last_result)
+                    for cb in callbacks:
+                        cb.on_trial_complete(t)
+                    self._save_experiment_state(run_dir, trials, searcher)
+        self._save_experiment_state(run_dir, trials, searcher)
+        for cb in callbacks:
+            cb.on_experiment_end(trials)
         return ResultGrid(trials, tc.metric, tc.mode, run_dir)

@@ -340,3 +340,26 @@ def test_aggregate_finalize_wired():
     out = {r["k"]: r["halfsum(v)"]
            for r in ds.groupby("k").aggregate((halfsum, "v")).take_all()}
     assert out == {0: 3.0, 1: 4.0}
+
+
+def test_read_json_ragged_lists(tmp_path):
+    p = tmp_path / "r.json"
+    p.write_text('{"a": [1, 2]}\n{"a": [1, 2, 3]}\n')
+    rows = rd.read_json(str(p)).take_all()
+    assert rows[0]["a"] == [1, 2] and rows[1]["a"] == [1, 2, 3]
+
+
+def test_zip_suffix_probe():
+    a = rd.from_numpy({"y": np.arange(3), "y_1": np.arange(3) * 10})
+    b = rd.from_numpy({"y": np.arange(3) * 100})
+    z = a.zip(b)
+    assert set(z.schema()) == {"y", "y_1", "y_2"}
+    r = z.take(1)[0]
+    assert r["y_1"] == 0 and r["y_2"] == 0
+
+
+def test_normalizer_stateless_transform():
+    from ray_tpu.data import Normalizer
+    ds = rd.from_numpy({"a": np.array([3.0]), "b": np.array([4.0])})
+    out = Normalizer(["a", "b"]).transform(ds).take(1)[0]  # no fit()
+    np.testing.assert_allclose([out["a"], out["b"]], [0.6, 0.8])

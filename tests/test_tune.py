@@ -174,3 +174,159 @@ def test_concurrency_limiter_runs_all(tmp_path):
     grid = tuner.fit()
     assert len(grid) == 5  # all samples ran despite the cap
     assert all(t.status == "TERMINATED" for t in grid.trials)
+
+
+# -- new schedulers --------------------------------------------------------
+
+def test_median_stopping_rule():
+    from ray_tpu import tune
+    from ray_tpu.tune.schedulers import MedianStoppingRule
+
+    def train_fn(config):
+        for i in range(10):
+            # bad configs plateau high, good ones descend
+            tune.report({"loss": config["base"] - i * config["slope"]})
+
+    res = tune.Tuner(
+        train_fn,
+        param_space={"base": tune.choice([10.0]),
+                     "slope": tune.grid_search([0.0, 0.0, 0.0, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min",
+            scheduler=MedianStoppingRule(metric="loss", mode="min",
+                                         grace_period=2,
+                                         min_samples_required=2),
+            max_concurrent_trials=4),
+    ).fit()
+    best = res.get_best_result()
+    assert best.metrics["loss"] <= 1.0   # the improving trial survived
+
+
+def test_hyperband_brackets():
+    from ray_tpu import tune
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+
+    def train_fn(config):
+        for i in range(9):
+            tune.report({"loss": config["x"] / (i + 1)})
+
+    res = tune.Tuner(
+        train_fn,
+        param_space={"x": tune.grid_search([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min",
+            scheduler=HyperBandScheduler(metric="loss", mode="min",
+                                         max_t=9, reduction_factor=3,
+                                         num_brackets=2),
+            max_concurrent_trials=6),
+    ).fit()
+    assert len(res) == 6
+    assert res.get_best_result().metrics["loss"] <= 1.0
+
+
+# -- loggers / callbacks ---------------------------------------------------
+
+def test_logger_callbacks(tmp_path):
+    import json as _json
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    def train_fn(config):
+        for i in range(3):
+            tune.report({"loss": float(i), "lr": config["lr"]})
+
+    cbs = [tune.CSVLoggerCallback(), tune.JSONLoggerCallback()]
+    res = tune.Tuner(
+        train_fn, param_space={"lr": tune.grid_search([0.1, 0.2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="cbrun", storage_path=str(tmp_path),
+                             callbacks=cbs),
+    ).fit()
+    run_dir = str(tmp_path / "cbrun")
+    import os
+    tdirs = [d for d in os.listdir(run_dir) if d.startswith("trial_")]
+    assert len(tdirs) == 2
+    for td in tdirs:
+        prog = os.path.join(run_dir, td, "progress.csv")
+        with open(prog) as f:
+            lines = f.read().strip().splitlines()
+        # header + 3 reports (+ optional final done-marker result)
+        assert len(lines) in (4, 5)
+        rj = os.path.join(run_dir, td, "result.json")
+        rows = [_json.loads(l) for l in open(rj)]
+        assert rows[-1]["loss"] == 2.0
+        params = _json.load(open(os.path.join(run_dir, td, "params.json")))
+        assert "lr" in params
+
+
+def test_stop_criteria():
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    def train_fn(config):
+        for i in range(100):
+            tune.report({"score": float(i)})
+
+    res = tune.Tuner(
+        train_fn, param_space={},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="stoprun", stop={"score": 5.0}),
+    ).fit()
+    assert res.get_best_result().metrics["score"] == 5.0
+
+
+# -- experiment checkpoint / restore ---------------------------------------
+
+def test_experiment_state_and_restore(tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    def train_fn(config):
+        for i in range(4):
+            tune.report({"loss": config["x"] - i})
+
+    tuner = tune.Tuner(
+        train_fn, param_space={"x": tune.grid_search([5.0, 7.0])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(name="resumerun", storage_path=str(tmp_path)))
+    res = tuner.fit()
+    assert len(res) == 2
+    run_dir = str(tmp_path / "resumerun")
+
+    restored = tune.Tuner.restore(run_dir, train_fn)
+    res2 = restored.fit()   # everything terminated: instant, results kept
+    assert len(res2) == 2
+    assert res2.get_best_result().metrics["loss"] == 2.0
+
+
+def test_restore_continues_unsuggested_configs(tmp_path):
+    """An interrupted sweep must finish configs never suggested before
+    the interruption (the searcher state rides the experiment
+    checkpoint)."""
+    import os
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    def train_fn(config):
+        if config["x"] == 2 and not os.environ.get("TUNE_RESUMED_T"):
+            raise RuntimeError("crash")
+        tune.report({"loss": float(config["x"]), "done": True})
+
+    tuner = tune.Tuner(
+        train_fn, param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=1),
+        run_config=RunConfig(name="cont", storage_path=str(tmp_path)))
+    res = tuner.fit()   # x=2 errors; all 4 suggested (concurrency 1)
+    assert len(res) == 4
+
+    os.environ["TUNE_RESUMED_T"] = "1"
+    try:
+        restored = tune.Tuner.restore(str(tmp_path / "cont"), train_fn)
+        # restored metric/mode must survive
+        assert restored.tune_config.mode == "min"
+        res2 = restored.fit()
+        assert len(res2) == 4
+        assert all(t.status == "TERMINATED" for t in res2.trials)
+    finally:
+        del os.environ["TUNE_RESUMED_T"]
