@@ -495,8 +495,9 @@ class Dataset:
             return out
         return list(self._iter_staged_blocks())
 
-    def materialize(self, parallelism: str = "inline") -> "Dataset":
-        return Dataset(self._materialize(parallelism))
+    def materialize(self, parallelism: str = "inline",
+                    num_actors: int = 2) -> "Dataset":
+        return Dataset(self._materialize(parallelism, num_actors))
 
     # ------------------------------------------------------------ consume
 

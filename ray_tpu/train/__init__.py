@@ -5,7 +5,11 @@ from ray_tpu.train.checkpoint import (AsyncCheckpointer, Checkpoint,
                                       CheckpointManager)
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
+from ray_tpu.train.gbdt_trainer import (GBDTTrainer, LightGBMTrainer,
+                                        SklearnTrainer, XGBoostTrainer)
 from ray_tpu.train.jax_trainer import JaxTrainer
+from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
+                                     Predictor, SklearnPredictor)
 from ray_tpu.train.result import Result
 from ray_tpu.train.step import (TrainState, make_train_step, shard_batch,
                                 state_shardings)
@@ -18,5 +22,7 @@ __all__ = [
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "Result", "TrainState", "make_train_step", "shard_batch",
     "state_shardings", "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
-    "TrainingFailedError", "session",
+    "TrainingFailedError", "session", "GBDTTrainer", "SklearnTrainer",
+    "XGBoostTrainer", "LightGBMTrainer", "Predictor", "JaxPredictor",
+    "SklearnPredictor", "BatchPredictor",
 ]

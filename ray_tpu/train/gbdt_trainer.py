@@ -1,0 +1,118 @@
+"""GBDT + sklearn trainers: tabular model training on host CPUs.
+
+Reference capability: python/ray/train/gbdt_trainer.py (xgboost_ray/
+lightgbm_ray actor trees) and train/sklearn/.  Trees are host-CPU work
+in the two-tier model — no TPU involvement; the value here is the same
+Trainer surface (fit → Result with metrics + checkpoint) over Datasets.
+xgboost/lightgbm are not in the environment, so the default GBDT
+implementation is sklearn's HistGradientBoosting (same algorithm family:
+histogram gradient-boosted trees); pass ``use_xgboost=True`` to opt into
+xgboost where it is installed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+from ray_tpu.train.trainer import BaseTrainer
+
+
+def _to_xy(dataset, label_column: str, feature_columns=None):
+    from ray_tpu.data import block as B
+    full = B.concat(dataset._materialize())
+    y = np.asarray(full[label_column])
+    cols = feature_columns or [c for c in full if c != label_column]
+    X = np.column_stack([np.asarray(full[c]) for c in cols])
+    return X, y, cols
+
+
+class SklearnTrainer(BaseTrainer):
+    """(reference: train/sklearn/sklearn_trainer.py SklearnTrainer)"""
+
+    def __init__(self, *, estimator, datasets: dict,
+                 label_column: str,
+                 feature_columns: Optional[list] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config)
+        self.estimator = estimator
+        self.datasets = datasets
+        self.label_column = label_column
+        self.feature_columns = feature_columns
+
+    def fit(self) -> Result:
+        import os
+        t0 = time.perf_counter()
+        X, y, cols = _to_xy(self.datasets["train"], self.label_column,
+                            self.feature_columns)
+        self.estimator.fit(X, y)
+        metrics = {"fit_time_s": time.perf_counter() - t0,
+                   "num_rows": len(y)}
+        if "valid" in self.datasets:
+            Xv, yv, _ = _to_xy(self.datasets["valid"], self.label_column,
+                               cols)
+            metrics["valid_score"] = float(self.estimator.score(Xv, yv))
+        # checkpoint lands under the run directory like every trainer
+        run_dir = self.run_config.resolved_storage_path()
+        ck_dir = os.path.join(run_dir, "checkpoints", "final")
+        os.makedirs(ck_dir, exist_ok=True)
+        ck = Checkpoint.from_dict({"estimator": self.estimator,
+                                   "feature_columns": cols},
+                                  path=ck_dir)
+        return Result(metrics=metrics, checkpoint=ck, path=run_dir)
+
+
+class GBDTTrainer(SklearnTrainer):
+    """Gradient-boosted decision trees (reference: gbdt_trainer.py —
+    the XGBoostTrainer/LightGBMTrainer base).  Uses xgboost when
+    importable, else sklearn HistGradientBoosting."""
+
+    def __init__(self, *, datasets: dict, label_column: str,
+                 objective: str = "classification",
+                 params: Optional[dict] = None,
+                 use_xgboost: bool = False,
+                 feature_columns: Optional[list] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        params = dict(params or {})
+        est = self._make_estimator(objective, params, use_xgboost)
+        super().__init__(estimator=est, datasets=datasets,
+                         label_column=label_column,
+                         feature_columns=feature_columns,
+                         scaling_config=scaling_config,
+                         run_config=run_config)
+
+    @staticmethod
+    def _make_estimator(objective: str, params: dict, use_xgboost: bool):
+        # xgboost is explicit opt-in, not import-sniffed: the two
+        # libraries interpret params differently (max_iter vs
+        # n_estimators), and a silent swap would train a different model
+        # depending on what happens to be installed
+        if use_xgboost:  # pragma: no cover - xgboost absent here
+            import xgboost
+            cls = (xgboost.XGBClassifier if objective == "classification"
+                   else xgboost.XGBRegressor)
+            if "max_iter" in params:
+                params["n_estimators"] = params.pop("max_iter")
+            return cls(**params)
+        from sklearn.ensemble import (HistGradientBoostingClassifier,
+                                      HistGradientBoostingRegressor)
+        cls = (HistGradientBoostingClassifier
+               if objective == "classification"
+               else HistGradientBoostingRegressor)
+        return cls(**params)
+
+
+class XGBoostTrainer(GBDTTrainer):
+    """Name-compatible alias (reference: train/xgboost/xgboost_trainer.py)."""
+
+
+class LightGBMTrainer(GBDTTrainer):
+    """Name-compatible alias (reference: train/lightgbm/lightgbm_trainer.py)."""
