@@ -1,30 +1,59 @@
-"""Headline benchmark: GPT-2 124M training throughput (tokens/sec).
+"""Headline benchmark: GPT-2 124M training throughput (tokens/sec) + MFU.
 
 North-star config #2 (BASELINE.json): GPT-2 124M data-parallel training.
 Baseline = 180k tokens/s, a published-class A100 bf16 number for GPT-2
 124M with flash attention (nanoGPT-era single-A100 throughput); the
-north-star target is ≥90% of the A100 equivalent (BASELINE.md), so
-vs_baseline ≥ 0.9 meets target on a v5e-class chip.
+north-star target is >=90% of the A100 equivalent (BASELINE.md), so
+vs_baseline >= 0.9 meets target on a v5e-class chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Honest-timing design (round 2): execution is forced by fetching the
+CONCRETE loss value to host each timed step — a host fetch of real bytes
+cannot be deferred by any backend, unlike block_until_ready which some
+experimental platforms treat as a no-op. MFU is computed from the actual
+parameter count and a per-device-kind peak-FLOPs table; if MFU lands
+outside (0, 1] or vs_baseline is implausible (>2 on one chip), the bench
+reports status "implausible" instead of publishing the number.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
-import sys
 import time
+
+# bf16 peak FLOP/s per chip, by substring of jax Device.device_kind.
+_PEAK_FLOPS = [
+    ("v5 lite", 197e12),   # TPU v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v6", 918e12),        # Trillium
+    ("v3", 123e12),
+    ("v2", 46e12),
+    ("A100", 312e12),
+    ("H100", 989e12),
+]
+
+
+def _peak_for(device_kind: str):
+    for key, peak in _PEAK_FLOPS:
+        if key.lower() in device_kind.lower():
+            return peak
+    return None
 
 
 def main():
     import jax
     import jax.numpy as jnp
+    import numpy as np
     import optax
 
     from ray_tpu.models import gpt
     from ray_tpu.train.step import make_train_step
 
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform, kind = dev.platform, dev.device_kind
     on_tpu = platform == "tpu"
 
     if on_tpu:
@@ -37,6 +66,7 @@ def main():
         batch, seq, steps, warmup = 8, 256, 5, 1
 
     params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = int(sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params)))
 
     def loss(p, b):
         return gpt.loss_fn(p, b, cfg)
@@ -50,24 +80,72 @@ def main():
         dtype=jnp.int32)
     b = {"tokens": tokens}
 
-    for _ in range(warmup):
-        state, metrics = step_fn(state, b)
-    jax.block_until_ready(metrics["loss"])
+    def run(n, per_step_sync):
+        """Run n steps; returns (dt_seconds, last_loss). Forces real
+        execution with concrete host fetches, not block_until_ready."""
+        nonlocal state
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(n):
+            state, metrics = step_fn(state, b)
+            if per_step_sync:
+                last = float(np.asarray(metrics["loss"]))
+        if not per_step_sync:
+            # final fetch forces the whole dependency chain of n steps
+            last = float(np.asarray(metrics["loss"]))
+        return time.perf_counter() - t0, last
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, b)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    run(warmup, per_step_sync=True)  # warmup: compile + settle
 
-    toks_per_sec = batch * seq * steps / dt
+    # training flops/token: 6N matmul + attention quadratic term (fwd+bwd)
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    peak = _peak_for(kind)
     baseline = 180_000.0  # A100-class GPT-2 124M tokens/s (see docstring)
+
+    def metrics_for(dt):
+        tps = batch * seq * steps / dt
+        mfu = (flops_per_token * tps / peak) if peak else None
+        return tps, mfu
+
+    # pass 1: end-only sync (max dispatch overlap, best-case throughput)
+    dt, final_loss = run(steps, per_step_sync=False)
+    toks_per_sec, mfu = metrics_for(dt)
+    timing_mode = "chain_sync"
+
+    def implausible(tps, mfu):
+        if mfu is not None:
+            return mfu > 1.0  # chip-normalized: >100% of peak is impossible
+        # unknown chip: fall back to a raw multiple of the A100 baseline
+        return on_tpu and tps / baseline > 2.0
+
+    if implausible(toks_per_sec, mfu):
+        # pass 2: strict per-step host fetch — cannot be deferred
+        dt, final_loss = run(steps, per_step_sync=True)
+        toks_per_sec, mfu = metrics_for(dt)
+        timing_mode = "per_step_sync"
+
+    status = "ok"
+    if implausible(toks_per_sec, mfu):
+        # even strict timing looks impossible: platform timing is broken;
+        # refuse to publish the number as a throughput claim
+        status = "implausible"
+
+    ok = status == "ok"
     out = {
         "metric": "gpt2_124m_train_throughput" if on_tpu
                   else "gpt2_cpu_smoke_train_throughput",
-        "value": round(toks_per_sec, 1),
+        # refuse to publish an impossible number as a throughput claim
+        "value": round(toks_per_sec, 1) if ok else 0.0,
         "unit": "tokens/s",
-        "vs_baseline": round(toks_per_sec / baseline, 4),
+        "vs_baseline": round(toks_per_sec / baseline, 4) if ok else 0.0,
+        "status": status,
+        "mfu": round(mfu, 4) if (mfu is not None and ok) else None,
+        "platform": platform,
+        "device_kind": kind,
+        "n_devices": len(jax.devices()),
+        "n_params": n_params,
+        "timing": timing_mode,
+        "final_loss": round(final_loss, 4),
     }
     print(json.dumps(out))
 
