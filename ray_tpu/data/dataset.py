@@ -488,11 +488,15 @@ class Dataset:
             actors = [Worker.remote(stages)
                       for _ in range(min(num_actors, len(blocks)) or 1)]
             pool = ActorPool(actors)
-            out = list(pool.map(lambda a, bi: a.run.remote(bi[1], bi[0]),
-                                list(enumerate(blocks))))
-            for a in actors:
-                ray_tpu.kill(a)
-            return out
+            try:
+                return list(
+                    pool.map(lambda a, bi: a.run.remote(bi[1], bi[0]),
+                             list(enumerate(blocks))))
+            finally:
+                # kill even when a stage raises inside a worker, or the
+                # pool actors leak until process exit
+                for a in actors:
+                    ray_tpu.kill(a)
         return list(self._iter_staged_blocks())
 
     def materialize(self, parallelism: str = "inline",

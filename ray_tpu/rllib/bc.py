@@ -131,11 +131,15 @@ class MARWIL(Algorithm):
 
     def save_checkpoint(self) -> dict:
         return {"params": jax.tree.map(np.asarray, self.params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "ma_adv_norm": float(self._ma_adv_norm),
                 "timesteps": self._timesteps}
 
     def load_checkpoint(self, ck):
         self.params = jax.tree.map(jnp.asarray, ck["params"])
-        self.opt_state = self.tx.init(self.params)
+        self.opt_state = (jax.tree.map(jnp.asarray, ck["opt_state"])
+                          if "opt_state" in ck else self.tx.init(self.params))
+        self._ma_adv_norm = ck.get("ma_adv_norm", self._ma_adv_norm)
         self._timesteps = ck.get("timesteps", 0)
 
 

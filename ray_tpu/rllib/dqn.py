@@ -257,11 +257,17 @@ class DQN(Algorithm):
         return float(loss)
 
     def save_checkpoint(self) -> dict:
+        # optimizer moments + target net included so resume is seamless
+        # (reference: Policy.get_state saves optimizer variables too)
         return {"params": jax.tree.map(np.asarray, self.params),
+                "target_params": jax.tree.map(np.asarray, self.target_params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
                 "timesteps": self._timesteps}
 
     def load_checkpoint(self, ck):
         self.params = jax.tree.map(jnp.asarray, ck["params"])
-        self.target_params = self.params
-        self.opt_state = self.tx.init(self.params)
+        self.target_params = (jax.tree.map(jnp.asarray, ck["target_params"])
+                              if "target_params" in ck else self.params)
+        self.opt_state = (jax.tree.map(jnp.asarray, ck["opt_state"])
+                          if "opt_state" in ck else self.tx.init(self.params))
         self._timesteps = ck.get("timesteps", 0)

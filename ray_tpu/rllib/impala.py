@@ -179,11 +179,13 @@ class Impala(Algorithm):
 
     def save_checkpoint(self) -> dict:
         return {"params": jax.tree.map(np.asarray, self.params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
                 "timesteps": self._timesteps}
 
     def load_checkpoint(self, ck):
         self.params = jax.tree.map(jnp.asarray, ck["params"])
-        self.opt_state = self.tx.init(self.params)
+        self.opt_state = (jax.tree.map(jnp.asarray, ck["opt_state"])
+                          if "opt_state" in ck else self.tx.init(self.params))
         self._timesteps = ck.get("timesteps", 0)
         self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
 

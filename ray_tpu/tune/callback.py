@@ -15,9 +15,13 @@ from typing import Any, Optional
 
 
 class Callback:
-    """(reference: tune/callback.py Callback hooks subset)"""
+    """(reference: tune/callback.py Callback hooks subset)
 
-    def setup(self, run_dir: str):
+    setup receives restored=True when the experiment resumed from a
+    prior run directory, so file-writing callbacks can append instead of
+    truncating history."""
+
+    def setup(self, run_dir: str, restored: bool = False):
         pass
 
     def on_trial_start(self, trial) -> None:
@@ -44,9 +48,11 @@ def _scalars(result: dict) -> dict:
 class _PerTrialFileCallback(Callback):
     def __init__(self):
         self._run_dir: Optional[str] = None
+        self._restored = False
 
-    def setup(self, run_dir: str):
+    def setup(self, run_dir: str, restored: bool = False):
         self._run_dir = run_dir
+        self._restored = restored
 
     def _trial_dir(self, trial) -> str:
         d = os.path.join(self._run_dir or ".", trial.trial_id)
@@ -82,12 +88,22 @@ class CSVLoggerCallback(_PerTrialFileCallback):
         path = os.path.join(self._trial_dir(trial), "progress.csv")
         row = _scalars(result)
         if trial.trial_id not in self._fields:
-            self._fields[trial.trial_id] = list(row)
-            with open(path, "w", newline="") as f:
-                w = csv.DictWriter(f, fieldnames=list(row))
-                w.writeheader()
-                w.writerow(row)
-            return
+            if self._restored and os.path.exists(path):
+                # restored experiment: keep prior rows, adopt the existing
+                # header and append (a fresh 'w' would truncate history).
+                # Gated on restored so a NEW run reusing the directory
+                # still truncates stale logs.
+                with open(path, newline="") as f:
+                    header = next(csv.reader(f), None)
+                if header:
+                    self._fields[trial.trial_id] = header
+            if trial.trial_id not in self._fields:
+                self._fields[trial.trial_id] = list(row)
+                with open(path, "w", newline="") as f:
+                    w = csv.DictWriter(f, fieldnames=list(row))
+                    w.writeheader()
+                    w.writerow(row)
+                return
         fields = self._fields[trial.trial_id]
         with open(path, "a", newline="") as f:
             w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")

@@ -159,24 +159,32 @@ class MedianStoppingRule(TrialScheduler):
         self.metric, self.mode = metric, mode
         self.grace_period = grace_period
         self.min_samples = min_samples_required
-        # trial_id -> list of metric values
-        self._results: dict[str, list[float]] = defaultdict(list)
+        # trial_id -> list of (training_iteration, metric value)
+        self._results: dict[str, list[tuple[int, float]]] = defaultdict(list)
 
     def on_result(self, trial, result) -> str:
         v = result.get(self.metric)
         t = result.get("training_iteration", 0)
         if v is None:
             return CONTINUE
-        self._results[trial.trial_id].append(float(v))
+        self._results[trial.trial_id].append((int(t), float(v)))
         if t < self.grace_period:
             return CONTINUE
-        others = [sum(r) / len(r) for tid, r in self._results.items()
-                  if tid != trial.trial_id and r]
+        # compare against other trials' running averages truncated to the
+        # same training step, so a young trial is never penalized merely
+        # for having fewer (naturally worse) early results
+        others = []
+        for tid, rs in self._results.items():
+            if tid == trial.trial_id:
+                continue
+            vals = [val for it, val in rs if it <= t]
+            if vals:
+                others.append(sum(vals) / len(vals))
         if len(others) < self.min_samples:
             return CONTINUE
         import statistics
         median = statistics.median(others)
-        mine = self._results[trial.trial_id]
+        mine = [val for _, val in self._results[trial.trial_id]]
         avg = sum(mine) / len(mine)
         worse = avg > median if self.mode == "min" else avg < median
         return STOP if worse else CONTINUE

@@ -235,7 +235,10 @@ class Tuner:
         callbacks = list(self.run_config.callbacks)
         stop_criteria = self.run_config.stop or {}
         for cb in callbacks:
-            cb.setup(run_dir)
+            try:
+                cb.setup(run_dir, restored=bool(self._restored))
+            except TypeError:  # user callback with the pre-r2 signature
+                cb.setup(run_dir)
 
         trials: list[Trial] = []
         live: list[Trial] = []
@@ -249,9 +252,17 @@ class Tuner:
                 t.error = None
                 requeued.append(t)
         # the restored searcher (if any) continues past already-suggested
-        # configs; a restore without searcher state must not re-suggest
-        # configs that already ran
-        exhausted = bool(self._restored) and tc.search_alg is None
+        # configs; a restore without searcher state falls back to the
+        # fresh variant generator and skips its first len(restored)
+        # suggestions — NOT declaring the search exhausted (which would
+        # silently drop the remaining num_samples trials).  Count-based
+        # skipping equals config-equality skipping for deterministic
+        # suggestion sequences (grid, seeded random) and is the correct
+        # semantics for seedless random search, where draws are
+        # exchangeable and re-matching exact configs is impossible.
+        skip_count = (len(self._restored)
+                      if self._restored and tc.search_alg is None else 0)
+        exhausted = False
         n = len(self._restored)
         max_live = tc.max_concurrent_trials or float("inf")
 
@@ -271,6 +282,11 @@ class Tuner:
                         break
                     if cfg == "PENDING":  # searcher at capacity; retry later
                         break
+                    if skip_count > 0:
+                        # this suggestion slot already ran before the
+                        # interruption
+                        skip_count -= 1
+                        continue
                     t = Trial(trial_id=tid, config=cfg)
                     n += 1
                 made_progress = True

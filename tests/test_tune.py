@@ -330,3 +330,140 @@ def test_restore_continues_unsuggested_configs(tmp_path):
         assert all(t.status == "TERMINATED" for t in res2.trials)
     finally:
         del os.environ["TUNE_RESUMED_T"]
+
+
+def test_median_stopping_aligns_iterations():
+    """A young trial must be compared against other trials' averages
+    truncated to the SAME training step, not their full histories
+    (advisor finding r1: younger trials were stopped merely for being
+    younger)."""
+    from ray_tpu.tune.schedulers import MedianStoppingRule, CONTINUE
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    rule = MedianStoppingRule(metric="loss", mode="min", grace_period=1,
+                              min_samples_required=2)
+    # two veterans descend from 10.0 to 1.0 over 10 iterations
+    for tid in ("a", "b"):
+        for i in range(10):
+            rule.on_result(T(tid), {"loss": 10.0 - i, "training_iteration": i})
+    # a young trial at iteration 1 with the SAME trajectory must survive:
+    # at iteration<=1 the veterans averaged (10+9)/2 = 9.5, and the young
+    # trial's own average is 9.5 — not worse than the median
+    decision = rule.on_result(T("young"), {"loss": 10.0,
+                                           "training_iteration": 0})
+    assert decision == CONTINUE
+    decision = rule.on_result(T("young"), {"loss": 9.0,
+                                           "training_iteration": 1})
+    assert decision == CONTINUE
+
+
+def test_csv_logger_appends_after_restore(tmp_path):
+    """CSVLoggerCallback must append to an existing progress.csv (restored
+    experiment) instead of truncating logged history (advisor finding r1)."""
+    import csv as _csv
+    from ray_tpu.tune.callback import CSVLoggerCallback
+
+    class T:
+        trial_id = "t1"
+        config = {"x": 1}
+
+    cb = CSVLoggerCallback()
+    cb.setup(str(tmp_path))
+    cb.on_trial_result(T(), {"loss": 1.0, "training_iteration": 1})
+    cb.on_trial_result(T(), {"loss": 0.5, "training_iteration": 2})
+
+    cb2 = CSVLoggerCallback()   # fresh process after restore
+    cb2.setup(str(tmp_path), restored=True)
+    cb2.on_trial_result(T(), {"loss": 0.25, "training_iteration": 3})
+
+    with open(tmp_path / "t1" / "progress.csv", newline="") as f:
+        rows = list(_csv.DictReader(f))
+    assert len(rows) == 3, rows
+    assert [float(r["loss"]) for r in rows] == [1.0, 0.5, 0.25]
+
+
+def test_restore_without_searcher_state_runs_remaining(tmp_path):
+    """If the pickled searcher failed to round-trip, restore must still
+    run the not-yet-run configs instead of reporting success with a
+    truncated sweep (advisor finding r1)."""
+    import pickle
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    def train_fn(config):
+        tune.report({"loss": float(config["x"]), "done": True})
+
+    tuner = tune.Tuner(
+        train_fn, param_space={"x": tune.grid_search([1, 2, 3, 4])},
+        run_config=RunConfig(name="nosrch", storage_path=str(tmp_path)))
+    assert len(tuner.fit()) == 4
+
+    run_dir = str(tmp_path / "nosrch")
+    sp = tuner._experiment_state_path(run_dir)
+    with open(sp, "rb") as f:
+        payload = pickle.load(f)
+    payload["trials"] = payload["trials"][:2]   # interrupted after 2
+    payload["searcher"] = None                  # searcher didn't pickle
+    with open(sp, "wb") as f:
+        pickle.dump(payload, f)
+
+    res = tune.Tuner.restore(run_dir, train_fn).fit()
+    assert len(res) == 4
+    xs = sorted(t.config["x"] for t in res.trials)
+    assert xs == [1, 2, 3, 4]
+
+
+def test_fresh_rerun_truncates_stale_csv(tmp_path):
+    """A brand-new (non-restored) run into a reused directory must
+    truncate the previous run's progress.csv, not interleave with it."""
+    import csv as _csv
+    from ray_tpu.tune.callback import CSVLoggerCallback
+
+    class T:
+        trial_id = "t1"
+        config = {"x": 1}
+
+    cb = CSVLoggerCallback()
+    cb.setup(str(tmp_path))
+    cb.on_trial_result(T(), {"loss": 1.0, "training_iteration": 1})
+
+    cb2 = CSVLoggerCallback()
+    cb2.setup(str(tmp_path))   # restored NOT set: fresh run, same dir
+    cb2.on_trial_result(T(), {"loss": 9.0, "training_iteration": 1})
+
+    with open(tmp_path / "t1" / "progress.csv", newline="") as f:
+        rows = list(_csv.DictReader(f))
+    assert len(rows) == 1 and float(rows[0]["loss"]) == 9.0
+
+
+def test_restore_without_searcher_random_search(tmp_path):
+    """Count-based skip: even with seedless random search (configs can't
+    be re-matched by equality), a restore without searcher state runs
+    exactly the REMAINING sample budget, not restored+num_samples."""
+    import pickle
+    from ray_tpu import tune
+    from ray_tpu.train.config import RunConfig
+
+    def train_fn(config):
+        tune.report({"loss": float(config["x"]), "done": True})
+
+    tuner = tune.Tuner(
+        train_fn, param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(num_samples=4),
+        run_config=RunConfig(name="rnd", storage_path=str(tmp_path)))
+    assert len(tuner.fit()) == 4
+
+    run_dir = str(tmp_path / "rnd")
+    sp = tuner._experiment_state_path(run_dir)
+    with open(sp, "rb") as f:
+        payload = pickle.load(f)
+    payload["trials"] = payload["trials"][:2]
+    payload["searcher"] = None
+    with open(sp, "wb") as f:
+        pickle.dump(payload, f)
+
+    res = tune.Tuner.restore(run_dir, train_fn).fit()
+    assert len(res) == 4, len(res)
