@@ -75,6 +75,20 @@ _define("health_check_period_ms", int, 1000,
 _define("health_check_failure_threshold", int, 5,
         "Missed health checks before a node is declared dead.")
 
+# --- cluster plane --------------------------------------------------------
+_define("heartbeat_period_ms", int, 250,
+        "Node -> head resource heartbeat cadence (reference: "
+        "ray_syncer.h:30 RAY_CONFIG raylet_report_resources_period_ms).")
+_define("node_death_timeout_ms", int, 3000,
+        "Missed-heartbeat window after which the head declares a node "
+        "dead (reference: gcs_health_check_manager.cc timeout).")
+_define("object_transfer_chunk_size", int, 4 * 1024 * 1024,
+        "Chunk size for node-to-node object transfer (reference: "
+        "object_manager.h:117 chunked Push, default 5MiB chunks).")
+_define("object_transfer_window", int, 8,
+        "Max un-acked chunks in flight per transfer (sender-side "
+        "backpressure so huge objects don't balloon the write buffer).")
+
 # --- TPU / gang -----------------------------------------------------------
 _define("tpu_gang_in_process", bool, True,
         "Single-host fast path: run the TPU gang inline in the driver "

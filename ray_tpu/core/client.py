@@ -47,6 +47,11 @@ class ActorDiedError(RuntimeError):
     pass
 
 
+class ObjectLostError(RuntimeError):
+    """All copies of an object died with their node(s) and it could not
+    be reconstructed (reference: ray.exceptions.ObjectLostError)."""
+
+
 class NodeClient:
     def __init__(self, address: str, kind: str, tpu: bool = False,
                  push_handler: Optional[Callable[[dict], None]] = None):

@@ -1,0 +1,674 @@
+"""Head service: the cluster control plane (GCS analogue).
+
+Owns cluster-scope state the reference keeps in the GCS server
+(reference: src/ray/gcs/gcs_server/gcs_server.h:77):
+
+  * node membership + health (heartbeats, death detection)
+    (reference: gcs_node_manager.cc, gcs_health_check_manager.cc)
+  * cluster task routing / spillover scheduling
+    (reference: gcs_actor_scheduler.cc, cluster_task_manager.h:33 —
+    here routing is head-side because nodes forward what they can't place)
+  * actor directory: placement, named actors, state fan-out, node-death
+    re-placement (reference: gcs_actor_manager.cc:249,1247)
+  * object location directory with watchers (reference: the ownership-era
+    object directory, object_directory.h — centralized here, v1)
+  * KV store, pubsub, function store (reference: gcs_kv_manager.cc,
+    gcs_pubsub, function_manager.py)
+  * placement groups with cross-node 2PC bundle reservation
+    (reference: gcs_placement_group_scheduler.h:104-169)
+  * resource view broadcast to nodes (reference: ray_syncer.h:30-47)
+
+Only NODE services connect here; drivers and workers always talk to their
+local node, which proxies cluster-scope requests (the reference's raylet
+does the same for GCS-bound client calls).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu._config import RayTpuConfig
+from ray_tpu.core.service import ClientRec, EventLoopService
+
+
+@dataclass
+class NodeRec:
+    node_hex: str
+    address: str
+    conn_id: int
+    total: dict
+    available: dict
+    last_beat: float = field(default_factory=time.monotonic)
+    alive: bool = True
+
+
+@dataclass
+class ActorDir:
+    actor_id: bytes
+    node_hex: str
+    state: str                    # pending | alive | restarting | dead
+    spec: dict
+    name: str = ""
+    namespace: str = ""
+    death_cause: str = ""
+    restarts_left: int = 0        # head-side budget for node-death re-place
+    watchers: set = field(default_factory=set)   # node_hex wanting actor_at
+
+
+@dataclass
+class PGDir:
+    pg_id: bytes
+    bundles: list
+    strategy: str
+    assignment: list              # bundle_idx -> node_hex
+    state: str = "created"
+
+
+class HeadService(EventLoopService):
+    name = "head"
+
+    def __init__(self, config: RayTpuConfig, session: str,
+                 listen_host: str = "127.0.0.1", port: int = 0):
+        super().__init__(listen_host, port)
+        self.config = config
+        self.session = session
+        self.tick_interval = 0.1
+
+        self.nodes: dict[str, NodeRec] = {}
+        self._node_by_conn: dict[int, str] = {}
+        self.actors: dict[bytes, ActorDir] = {}
+        self.named_actors: dict[tuple[str, str], bytes] = {}
+        self.kv: dict[tuple[str, bytes], bytes] = {}
+        self.functions: dict[str, bytes] = {}
+        self._fn_waiters: dict[str, list] = {}   # fid -> [(conn_id, reqid)]
+        self.pubsub: dict[str, set[int]] = {}
+        self.object_locs: dict[bytes, set[str]] = {}
+        self.obj_watchers: dict[bytes, set[str]] = {}
+        self.pgs: dict[bytes, PGDir] = {}
+
+    # ------------------------------------------------------------- helpers
+
+    def _node_conn(self, node_hex: str) -> Optional[ClientRec]:
+        n = self.nodes.get(node_hex)
+        if n is None or not n.alive:
+            return None
+        return self.clients.get(n.conn_id)
+
+    def _view(self) -> dict:
+        return {h: {"address": n.address, "total": n.total,
+                    "available": n.available, "alive": n.alive}
+                for h, n in self.nodes.items() if n.alive}
+
+    def _choose_node(self, demand: dict,
+                     prefer: Optional[str] = None) -> Optional[str]:
+        """Pick a node whose TOTAL covers the demand; rank: available
+        covers now > preferred > most spare capacity (a compact version of
+        the reference hybrid policy, hybrid_scheduling_policy.h)."""
+        best, best_key = None, None
+        for h, n in self.nodes.items():
+            if not n.alive:
+                continue
+            if not all(n.total.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items()):
+                continue
+            fits_now = all(n.available.get(k, 0.0) + 1e-9 >= v
+                           for k, v in demand.items())
+            spare = sum(n.available.get(k, 0.0) for k in ("CPU", "TPU"))
+            key = (fits_now, h == prefer, spare)
+            if best_key is None or key > best_key:
+                best, best_key = h, key
+        return best
+
+    @staticmethod
+    def _demand(spec: dict) -> dict:
+        d = dict(spec.get("resources") or {})
+        d.setdefault("CPU",
+                     0.0 if spec.get("kind") == "actor_create" else 1.0)
+        if spec.get("num_tpus"):
+            d["TPU"] = float(spec["num_tpus"])
+        return d
+
+    # ----------------------------------------------------------- membership
+
+    def _h_register_node(self, rec: ClientRec, m: dict) -> None:
+        rec.kind = "node"
+        rec.node_hex = m["node_id"]
+        self.nodes[m["node_id"]] = NodeRec(
+            node_hex=m["node_id"], address=m["address"],
+            conn_id=rec.conn_id, total=dict(m["resources"]),
+            available=dict(m["available"]))
+        self._node_by_conn[rec.conn_id] = m["node_id"]
+        self._reply(rec, m["reqid"], session=self.session,
+                    view=self._view())
+        self._broadcast_view()
+
+    def _broadcast_view(self) -> None:
+        """Push the membership view to every node immediately on change;
+        heartbeat replies keep it fresh in between (reference:
+        ray_syncer.h broadcast on NodeAdded/NodeRemoved)."""
+        view = self._view()
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            c = self.clients.get(n.conn_id)
+            if c is not None:
+                self._push(c, {"t": "view_update", "view": view})
+
+    def _h_heartbeat(self, rec: ClientRec, m: dict) -> None:
+        n = self.nodes.get(rec.node_hex)
+        if n is not None:
+            n.last_beat = time.monotonic()
+            n.available = dict(m["available"])
+            n.total = dict(m["total"])
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], view=self._view())
+
+    def on_tick(self) -> None:
+        timeout = self.config.node_death_timeout_ms / 1000.0
+        cutoff = time.monotonic() - timeout
+        for h, n in list(self.nodes.items()):
+            if n.alive and n.last_beat < cutoff:
+                self._node_dead(h, "heartbeat timeout")
+
+    def on_client_drop(self, rec: ClientRec) -> None:
+        h = self._node_by_conn.pop(rec.conn_id, None)
+        if h is not None and self.nodes.get(h) is not None \
+                and self.nodes[h].alive:
+            self._node_dead(h, "connection closed")
+
+    def _node_dead(self, node_hex: str, cause: str) -> None:
+        n = self.nodes.get(node_hex)
+        if n is None or not n.alive:
+            return
+        n.alive = False
+        # tell everyone first so source nodes can start recovery
+        for other in self.nodes.values():
+            if other.alive:
+                c = self.clients.get(other.conn_id)
+                if c is not None:
+                    self._push(c, {"t": "node_dead", "node": node_hex,
+                                   "cause": cause})
+        # object locations: objects only there are lost (unless a source
+        # node resubmits the producing task — it decides, we just notify)
+        for oid, locs in list(self.object_locs.items()):
+            locs.discard(node_hex)
+            if not locs:
+                del self.object_locs[oid]
+                for w in self.obj_watchers.pop(oid, ()):
+                    c = self._node_conn(w)
+                    if c is not None:
+                        self._push(c, {"t": "object_lost", "object_id": oid,
+                                       "cause": f"node {node_hex[:8]} died"})
+        # actors hosted there: re-place if the restart budget allows
+        # (reference: gcs_actor_manager.cc OnNodeDead -> reschedule)
+        for ad in list(self.actors.values()):
+            if ad.node_hex != node_hex or ad.state == "dead":
+                continue
+            if ad.restarts_left != 0:
+                if ad.restarts_left > 0:
+                    ad.restarts_left -= 1
+                self._replace_actor(ad, cause)
+            else:
+                self._actor_dead(ad, f"node died: {cause}")
+        self._publish("node_state", {"node_id": node_hex, "state": "dead",
+                                     "cause": cause})
+        self._broadcast_view()
+
+    def _replace_actor(self, ad: ActorDir, cause: str) -> None:
+        target = self._choose_node(self._demand(ad.spec))
+        if target is None:
+            self._actor_dead(ad, f"node died ({cause}); no feasible "
+                                 "node to restart on")
+            return
+        ad.state = "restarting"
+        ad.node_hex = target
+        self._publish("actor_state", {"actor_id": ad.actor_id.hex(),
+                                      "state": "restarting"})
+        c = self._node_conn(target)
+        if c is not None:
+            self._push(c, {"t": "place_actor", "spec": ad.spec})
+
+    def _actor_dead(self, ad: ActorDir, cause: str) -> None:
+        ad.state = "dead"
+        ad.death_cause = cause
+        self._publish("actor_state", {"actor_id": ad.actor_id.hex(),
+                                      "state": "dead"})
+        for w in ad.watchers:
+            c = self._node_conn(w)
+            if c is not None:
+                self._push(c, {"t": "actor_at", "actor_id": ad.actor_id,
+                               "state": "dead", "death_cause": cause})
+        ad.watchers.clear()
+
+    # ------------------------------------------------------------ routing
+
+    def _h_cluster_submit(self, rec: ClientRec, m: dict) -> None:
+        spec = m["spec"]
+        # the forwarding node's projection is fresher than its last
+        # heartbeat — fold it in before choosing
+        src = self.nodes.get(rec.node_hex)
+        if src is not None and "src_available" in m:
+            src.available = dict(m["src_available"])
+        pg = spec.get("placement_group")
+        if pg is not None:
+            pgd = self.pgs.get(pg[0])
+            if pgd is None or pgd.state != "created":
+                self._reply(rec, m["reqid"],
+                            error=f"placement group unknown or damaged")
+                return
+            target = pgd.assignment[pg[1]]
+        else:
+            target = self._choose_node(self._demand(spec),
+                                       prefer=rec.node_hex)
+        if target is None:
+            self._reply(rec, m["reqid"],
+                        error="Infeasible resource demand "
+                              f"{self._demand(spec)} on every node: "
+                              f"{[n.total for n in self.nodes.values() if n.alive]}")
+            return
+        # optimistic accounting: debit the choice so back-to-back submits
+        # don't all land on the same node; heartbeats re-sync the truth
+        tn = self.nodes.get(target)
+        if tn is not None:
+            for k, v in self._demand(spec).items():
+                tn.available[k] = max(0.0, tn.available.get(k, 0.0) - v)
+        if target == rec.node_hex:
+            self._reply(rec, m["reqid"], local=True, node=target)
+            return
+        c = self._node_conn(target)
+        if c is None:
+            self._reply(rec, m["reqid"], error="chosen node vanished")
+            return
+        spec = dict(spec)
+        spec["_routed"] = True
+        self._push(c, {"t": "remote_submit", "spec": spec})
+        self._reply(rec, m["reqid"], node=target)
+
+    # -------------------------------------------------------------- actors
+
+    def _h_cluster_create_actor(self, rec: ClientRec, m: dict) -> None:
+        spec = m["spec"]
+        aid = spec["actor_id"]
+        name = spec.get("name") or ""
+        ns = spec.get("namespace") or "default"
+        if name:
+            key = (ns, name)
+            prev = self.named_actors.get(key)
+            if prev is not None and self.actors[prev].state != "dead":
+                if spec.get("get_if_exists"):
+                    self._reply(rec, m["reqid"], actor_id=prev,
+                                existing=True)
+                    return
+                self._reply(rec, m["reqid"],
+                            error=f"Actor name '{name}' already taken in "
+                                  f"namespace '{ns}'")
+                return
+            self.named_actors[key] = aid
+        target = self._choose_node(self._demand(spec), prefer=rec.node_hex)
+        if target is None:
+            if name:
+                self.named_actors.pop((ns, name), None)
+            self._reply(rec, m["reqid"],
+                        error=f"Infeasible actor resource demand "
+                              f"{self._demand(spec)} on every node")
+            return
+        ad = ActorDir(actor_id=aid, node_hex=target, state="pending",
+                      spec=spec, name=name, namespace=ns,
+                      restarts_left=spec.get("max_restarts", 0))
+        self.actors[aid] = ad
+        c = self._node_conn(target)
+        spec = dict(spec)
+        spec["_routed"] = True
+        self._push(c, {"t": "place_actor", "spec": spec})
+        self._reply(rec, m["reqid"], actor_id=aid, node=target)
+
+    def _h_actor_state_report(self, rec: ClientRec, m: dict) -> None:
+        ad = self.actors.get(m["actor_id"])
+        if ad is None:
+            return
+        state = m["state"]
+        # a report from a node the actor no longer lives on (e.g. the old
+        # host finally noticing a worker death after a re-place) is stale
+        if rec.node_hex != ad.node_hex:
+            return
+        ad.state = state
+        if state == "dead":
+            ad.death_cause = m.get("death_cause", "")
+        self._publish("actor_state", {"actor_id": ad.actor_id.hex(),
+                                      "state": state})
+        if state in ("alive", "dead"):
+            n = self.nodes.get(ad.node_hex)
+            for w in ad.watchers:
+                c = self._node_conn(w)
+                if c is not None:
+                    self._push(c, {
+                        "t": "actor_at", "actor_id": ad.actor_id,
+                        "state": state,
+                        "node": ad.node_hex,
+                        "address": n.address if n else "",
+                        "death_cause": ad.death_cause})
+            ad.watchers.clear()
+
+    def _h_locate_actor(self, rec: ClientRec, m: dict) -> None:
+        ad = self.actors.get(m["actor_id"])
+        if ad is None:
+            self._reply(rec, m["reqid"], state="unknown")
+            return
+        if ad.state == "alive":
+            n = self.nodes.get(ad.node_hex)
+            self._reply(rec, m["reqid"], state="alive", node=ad.node_hex,
+                        address=n.address if n else "")
+        elif ad.state == "dead":
+            self._reply(rec, m["reqid"], state="dead",
+                        death_cause=ad.death_cause)
+        else:
+            ad.watchers.add(rec.node_hex)
+            self._reply(rec, m["reqid"], state=ad.state)
+
+    def _h_kill_actor(self, rec: ClientRec, m: dict) -> None:
+        ad = self.actors.get(m["actor_id"])
+        if ad is None or ad.state == "dead":
+            if "reqid" in m:
+                self._reply(rec, m["reqid"], ok=False)
+            return
+        if m.get("no_restart", True):
+            ad.restarts_left = 0
+        c = self._node_conn(ad.node_hex)
+        if c is not None:
+            self._push(c, {"t": "kill_local_actor",
+                           "actor_id": m["actor_id"],
+                           "no_restart": m.get("no_restart", True)})
+        else:
+            self._actor_dead(ad, "killed (host node gone)")
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_get_named_actor(self, rec: ClientRec, m: dict) -> None:
+        key = (m.get("namespace") or "default", m["name"])
+        aid = self.named_actors.get(key)
+        ad = self.actors.get(aid) if aid is not None else None
+        if ad is None or ad.state == "dead":
+            self._reply(rec, m["reqid"], error="not found")
+            return
+        self._reply(rec, m["reqid"], actor_id=aid, spec_meta={
+            "methods": ad.spec.get("methods", []),
+            "class_name": ad.spec.get("class_name", "")})
+
+    def _h_list_named_actors(self, rec: ClientRec, m: dict) -> None:
+        out = [{"namespace": ns, "name": n}
+               for (ns, n), aid in self.named_actors.items()
+               if self.actors[aid].state != "dead"
+               and (m.get("all_namespaces")
+                    or ns == (m.get("namespace") or "default"))]
+        self._reply(rec, m["reqid"], actors=out)
+
+    # ------------------------------------------------------ object locations
+
+    def _h_report_locations(self, rec: ClientRec, m: dict) -> None:
+        n = self.nodes.get(rec.node_hex)
+        for oid in m.get("adds", ()):
+            self.object_locs.setdefault(oid, set()).add(rec.node_hex)
+            watchers = self.obj_watchers.pop(oid, None)
+            if watchers:
+                for w in watchers:
+                    if w == rec.node_hex:
+                        continue
+                    c = self._node_conn(w)
+                    if c is not None:
+                        self._push(c, {"t": "object_at", "object_id": oid,
+                                       "node": rec.node_hex,
+                                       "address": n.address if n else ""})
+        for oid in m.get("removes", ()):
+            locs = self.object_locs.get(oid)
+            if locs is not None:
+                locs.discard(rec.node_hex)
+                if not locs:
+                    del self.object_locs[oid]
+
+    def _h_locate_object(self, rec: ClientRec, m: dict) -> None:
+        locs_out = {}
+        for oid in m["object_ids"]:
+            locs = [h for h in self.object_locs.get(oid, ())
+                    if h != rec.node_hex and self.nodes.get(h)
+                    and self.nodes[h].alive]
+            if locs:
+                h = locs[0]
+                locs_out[oid] = (h, self.nodes[h].address)
+            else:
+                self.obj_watchers.setdefault(oid, set()).add(rec.node_hex)
+        self._reply(rec, m["reqid"], locs=locs_out)
+
+    def _h_free_objects(self, rec: ClientRec, m: dict) -> None:
+        for oid in m["object_ids"]:
+            for h in self.object_locs.pop(oid, ()):
+                if h == rec.node_hex:
+                    continue   # the requesting node deletes locally itself
+                c = self._node_conn(h)
+                if c is not None:
+                    self._push(c, {"t": "delete_object", "object_id": oid})
+            self.obj_watchers.pop(oid, None)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    # ----------------------------------------------------------- kv / pubsub
+
+    def _h_kv_put(self, rec: ClientRec, m: dict) -> None:
+        key = (m.get("namespace") or "default", m["key"])
+        if m.get("overwrite", True) or key not in self.kv:
+            self.kv[key] = m["value"]
+            added = True
+        else:
+            added = False
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], added=added)
+
+    def _h_kv_get(self, rec: ClientRec, m: dict) -> None:
+        self._reply(rec, m["reqid"],
+                    value=self.kv.get((m.get("namespace") or "default",
+                                       m["key"])))
+
+    def _h_kv_del(self, rec: ClientRec, m: dict) -> None:
+        existed = self.kv.pop((m.get("namespace") or "default", m["key"]),
+                              None) is not None
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], deleted=existed)
+
+    def _h_kv_keys(self, rec: ClientRec, m: dict) -> None:
+        ns = m.get("namespace") or "default"
+        prefix = m.get("prefix", b"")
+        self._reply(rec, m["reqid"],
+                    keys=[k for (n, k) in self.kv
+                          if n == ns and k.startswith(prefix)])
+
+    def _h_subscribe(self, rec: ClientRec, m: dict) -> None:
+        self.pubsub.setdefault(m["channel"], set()).add(rec.conn_id)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_publish(self, rec: ClientRec, m: dict) -> None:
+        self._publish(m["channel"], m["data"])
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _publish(self, channel: str, data) -> None:
+        for conn_id in list(self.pubsub.get(channel, ())):
+            c = self.clients.get(conn_id)
+            if c is not None:
+                self._push(c, {"t": "pub", "channel": channel, "data": data})
+
+    # ------------------------------------------------------------ functions
+
+    def _h_register_function(self, rec: ClientRec, m: dict) -> None:
+        self.functions[m["function_id"]] = m["pickled"]
+        for conn_id, reqid in self._fn_waiters.pop(m["function_id"], []):
+            c = self.clients.get(conn_id)
+            if c is not None:
+                self._reply(c, reqid, pickled=m["pickled"])
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_fetch_function(self, rec: ClientRec, m: dict) -> None:
+        fid = m["function_id"]
+        if fid in self.functions:
+            self._reply(rec, m["reqid"], pickled=self.functions[fid])
+        else:
+            self._fn_waiters.setdefault(fid, []).append(
+                (rec.conn_id, m["reqid"]))
+
+    # ------------------------------------------------------ placement groups
+
+    def _h_create_pg(self, rec: ClientRec, m: dict) -> None:
+        pg_id: bytes = m["pg_id"]
+        bundles: list = m["bundles"]
+        strategy = m.get("strategy", "PACK")
+        assignment = self._plan_pg(bundles, strategy)
+        if assignment is None:
+            self._reply(rec, m["reqid"],
+                        error=f"Cannot place bundles {bundles} with "
+                              f"strategy {strategy} on "
+                              f"{[(n.node_hex[:8], n.available) for n in self.nodes.values() if n.alive]}")
+            return
+        # 2PC (reference: gcs_placement_group_scheduler.h:104 prepare all,
+        # then commit all; rollback prepared on any failure)
+        state = {"pending": len(bundles), "failed": False}
+
+        def prepared(i: int, reply: dict) -> None:
+            state["pending"] -= 1
+            if reply.get("error") or not reply.get("ok"):
+                state["failed"] = True
+            if state["pending"] > 0:
+                return
+            if state["failed"]:
+                for j, h in enumerate(assignment):
+                    c = self._node_conn(h)
+                    if c is not None:
+                        self._push(c, {"t": "pg_rollback", "pg_id": pg_id,
+                                       "bundle_idx": j})
+                self._reply(rec, m["reqid"],
+                            error="placement group reservation failed "
+                                  "(node raced out of resources)")
+                return
+            for j, h in enumerate(assignment):
+                c = self._node_conn(h)
+                if c is not None:
+                    self._push(c, {"t": "pg_commit", "pg_id": pg_id,
+                                   "bundle_idx": j})
+            self.pgs[pg_id] = PGDir(pg_id=pg_id, bundles=bundles,
+                                    strategy=strategy,
+                                    assignment=assignment)
+            self._reply(rec, m["reqid"], ok=True, assignment=assignment)
+
+        for i, (b, h) in enumerate(zip(bundles, assignment)):
+            c = self._node_conn(h)
+            if c is None:
+                self.post(lambda i=i: prepared(i, {"error": "node gone"}))
+                continue
+            self._rpc(c, {"t": "pg_prepare", "pg_id": pg_id,
+                          "bundle_idx": i, "bundle": b},
+                      lambda reply, i=i: prepared(i, reply))
+
+    def _plan_pg(self, bundles: list, strategy: str) -> Optional[list]:
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        if strategy in ("PACK", "STRICT_PACK"):
+            total: dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    total[k] = total.get(k, 0.0) + v
+            for n in sorted(alive, key=lambda n: -sum(n.available.values())):
+                if all(n.available.get(k, 0.0) + 1e-9 >= v
+                       for k, v in total.items()):
+                    return [n.node_hex] * len(bundles)
+            if strategy == "STRICT_PACK":
+                return None
+            strategy = "SPREAD"   # PACK falls back to spreading
+        # SPREAD / STRICT_SPREAD: round-robin with per-node running totals
+        budget = {n.node_hex: dict(n.available) for n in alive}
+        order = sorted(alive, key=lambda n: -sum(n.available.values()))
+        assignment: list[Optional[str]] = []
+        used_nodes: set[str] = set()
+        for b in bundles:
+            placed = None
+            for n in order:
+                if strategy == "STRICT_SPREAD" and n.node_hex in used_nodes:
+                    continue
+                bud = budget[n.node_hex]
+                if all(bud.get(k, 0.0) + 1e-9 >= v for k, v in b.items()):
+                    for k, v in b.items():
+                        bud[k] = bud.get(k, 0.0) - v
+                    placed = n.node_hex
+                    used_nodes.add(n.node_hex)
+                    break
+            if placed is None:
+                return None
+            assignment.append(placed)
+            # rotate so SPREAD actually spreads
+            order = order[1:] + order[:1]
+        return assignment
+
+    def _h_remove_pg(self, rec: ClientRec, m: dict) -> None:
+        pgd = self.pgs.pop(m["pg_id"], None)
+        if pgd is not None:
+            for i, h in enumerate(pgd.assignment):
+                c = self._node_conn(h)
+                if c is not None:
+                    self._push(c, {"t": "pg_remove_local",
+                                   "pg_id": m["pg_id"], "bundle_idx": i})
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    # --------------------------------------------------------------- state
+
+    def _h_state(self, rec: ClientRec, m: dict) -> None:
+        what = m["what"]
+        if what == "nodes":
+            out = [{"node_id": h, "address": n.address,
+                    "resources": n.total, "available": n.available,
+                    "alive": n.alive}
+                   for h, n in self.nodes.items()]
+        elif what == "actors":
+            out = [{"actor_id": ad.actor_id.hex(), "state": ad.state,
+                    "name": ad.name, "namespace": ad.namespace,
+                    "node_id": ad.node_hex,
+                    "class_name": ad.spec.get("class_name", "")}
+                   for ad in self.actors.values()]
+        elif what == "resources":
+            total: dict[str, float] = {}
+            avail: dict[str, float] = {}
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.total.items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in n.available.items():
+                    avail[k] = avail.get(k, 0.0) + v
+            out = {"total": total, "available": avail}
+        else:
+            out = []
+        self._reply(rec, m["reqid"], data=out)
+
+    def _h_ping(self, rec: ClientRec, m: dict) -> None:
+        self._reply(rec, m["reqid"], ok=True, time=time.time())
+
+
+def main() -> None:
+    import argparse
+    import uuid
+    parser = argparse.ArgumentParser(description="ray_tpu head service")
+    parser.add_argument("--port", type=int, default=6380)
+    parser.add_argument("--session", default=None)
+    args = parser.parse_args()
+    svc = HeadService(RayTpuConfig(), args.session or uuid.uuid4().hex,
+                      port=args.port)
+    print(f"ray_tpu head service listening on {svc.address}", flush=True)
+    try:
+        svc.run()
+    except KeyboardInterrupt:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    main()

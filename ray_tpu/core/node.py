@@ -1,34 +1,41 @@
-"""Node service: the single-process control plane for one node.
+"""Node service: the per-node daemon (raylet analogue).
 
-Combines, in one event loop, the capabilities the reference splits between
-the GCS server and the raylet:
+Local half (reference: src/ray/raylet/node_manager.cc
+HandleRequestWorkerLease:1822, worker_pool.h, local_task_manager.h):
 
-  * task scheduling + worker pool        (reference: src/ray/raylet/
-    node_manager.cc HandleRequestWorkerLease:1822, worker_pool.h,
-    local_task_manager.h dispatch loop)
+  * task scheduling + worker pool
   * object directory + inline store + shm bookkeeping + spilling
     (reference: core_worker memory_store.h, plasma store.h,
     local_object_manager.h)
-  * actor directory, creation, restart   (reference: gcs_actor_manager.cc
-    HandleRegisterActor:249, SchedulePendingActors:1247)
-  * named actors, KV store, pubsub, function store, job table
-    (reference: gcs_kv_manager.cc, pubsub/, function_manager.py)
-  * placement groups (resource reservation; 2PC collapses to one phase on a
-    single node — reference: gcs_placement_group_scheduler.h:104 2PC)
-  * task state events for the state API  (reference: gcs_task_manager.cc)
+  * actor execution management, per-actor queues, local restart
+  * placement-group bundle reservation (2PC participant)
 
-Runs either as a thread inside the driver (default, `ray_tpu.init()`) or as
-a standalone head process (`python -m ray_tpu.core.node`).  The scheduler is
-two-level-ready: `_schedule()` is the local half; a cluster half can route
-specs between multiple NodeService instances (multi-host, later milestone).
+Cluster half (active when ``head_address`` is set; reference splits this
+between the raylet, the object manager, and the GCS client):
+
+  * head channel: register, heartbeat, resource view sync
+    (reference: ray_syncer.h:30)
+  * task spillover / routing through the head when local resources
+    can't satisfy demand (reference: cluster_task_manager.h:33)
+  * chunked node-to-node object transfer over lazy peer connections
+    (reference: object_manager.h:117 Push/Pull, object_manager.proto:61)
+  * actor-task forwarding to the owning node, with head-side location
+    lookup + caching (reference: direct_actor_task_submitter.h)
+  * proxying of cluster-scope client requests (KV, pubsub, named actors,
+    placement groups, functions) so drivers/workers only ever talk to
+    their local node
+  * node-death recovery: resubmit forwarded tasks whose returns were
+    lost, fail in-flight calls to actors on dead nodes
+
+Without a head this service runs standalone exactly as in round 1: the
+single-node control plane fused into one loop.  Runs as a thread inside
+the driver (default, ``ray_tpu.init()``) or standalone
+(``python -m ray_tpu.core.node``).
 """
 
 from __future__ import annotations
 
 import os
-import selectors
-import socket
-import struct
 import subprocess
 import sys
 import threading
@@ -40,32 +47,14 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ray_tpu._config import RayTpuConfig
+from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
 from ray_tpu.core.object_store import (NativeObjectStoreCore,
                                        make_object_store_core)
-from ray_tpu.core.protocol import dumps_frame
-
-_HDR = struct.Struct("<Q")
+from ray_tpu.core.service import ClientRec, EventLoopService
 
 # ---------------------------------------------------------------------------
 # records
-
-
-@dataclass
-class ClientRec:
-    conn_id: int
-    sock: socket.socket
-    kind: str = ""               # driver | worker | tpu_executor | observer
-    worker_id: str = ""
-    pid: int = 0
-    tpu: bool = False            # may execute TPU tasks
-    state: str = "idle"          # idle | busy | blocked
-    current_task: Optional[bytes] = None
-    dedicated_actor: Optional[ActorID] = None
-    rbuf: bytearray = field(default_factory=bytearray)
-    wbuf: bytearray = field(default_factory=bytearray)
-    held_pins: list = field(default_factory=list)
-    closed: bool = False
 
 
 @dataclass
@@ -76,13 +65,14 @@ class ObjInfo:
     size: int = 0
     owner: str = ""
     is_error: bool = False
+    loc_reported: bool = False   # location pushed to the head
     wait_waiters: list = field(default_factory=list)
 
 
 @dataclass
 class TaskRec:
     spec: dict
-    state: str = "pending"       # pending | running | finished | failed
+    state: str = "pending"       # pending | running | forwarded | finished | failed
     worker: Optional[int] = None
     retries_left: int = 0
     submitted_at: float = field(default_factory=time.time)
@@ -119,16 +109,28 @@ class PGRec:
     state: str = "created"       # single-node: reserve succeeds or raises
 
 
-class NodeService:
+def _wire_spec(spec: dict) -> dict:
+    """Spec copy safe to ship to another service (drop node-local keys)."""
+    return {k: v for k, v in spec.items()
+            if not k.startswith("_") and k != "submitter"}
+
+
+class NodeService(EventLoopService):
+    name = "node"
+
     def __init__(self, config: RayTpuConfig, session: str,
                  session_dir: str, listen_host: str = "127.0.0.1",
                  port: int = 0, num_cpus: Optional[float] = None,
                  num_tpus: Optional[float] = None,
-                 resources: Optional[dict] = None):
+                 resources: Optional[dict] = None,
+                 head_address: Optional[str] = None,
+                 stop_on_driver_exit: bool = True):
+        super().__init__(listen_host, port)
         self.config = config
         self.session = session
         self.session_dir = session_dir
         self.node_id = NodeID.from_random()
+        self.stop_on_driver_exit = stop_on_driver_exit
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
 
         ncpu = num_cpus if num_cpus is not None else float(os.cpu_count() or 1)
@@ -144,17 +146,6 @@ class NodeService:
                                             config.object_store_memory,
                                             spill_dir)
 
-        self.sel = selectors.DefaultSelector()
-        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.listener.bind((listen_host, port))
-        self.listener.listen(512)
-        self.listener.setblocking(False)
-        self.address = "%s:%d" % self.listener.getsockname()
-        self.sel.register(self.listener, selectors.EVENT_READ, None)
-
-        self._next_conn = 0
-        self.clients: dict[int, ClientRec] = {}
         self.objects: dict[ObjectID, ObjInfo] = {}
         self.tasks: dict[bytes, TaskRec] = {}
         # Two-queue dispatch (reference: local_task_manager.h waiting →
@@ -173,85 +164,49 @@ class NodeService:
         self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
         self._spawning = 0
         self._worker_procs: list[subprocess.Popen] = []
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._fn_waiters: dict[str, list] = {}
-        # Callbacks posted from timers/other threads; drained by the event
-        # loop so ALL state mutation happens on the loop thread.
-        self._posted: deque = deque()
-        self._posted_lock = threading.Lock()
         # Batched-get bookkeeping: (conn_id, reqid) -> {ids, remaining}.
         self._multigets: dict[tuple, dict] = {}
         self._mg_by_oid: dict[ObjectID, set] = {}
-        self._last_tick = 0.0
 
-    def post(self, fn) -> None:
-        with self._posted_lock:
-            self._posted.append(fn)
+        # ---- cluster plane state (dormant when head_address is None) ----
+        self.head_address = head_address
+        self.head_conn: Optional[protocol.Connection] = None
+        self.cluster_view: dict[str, dict] = {}
+        self._head_seq = 0
+        self._head_pending: dict[int, Any] = {}
+        self._head_subs: set[str] = set()
+        self._hb_inflight = False
+        self._peer_conns: dict[str, protocol.Connection] = {}
+        self._peer_connecting: dict[str, list] = {}   # node_hex -> [cb]
+        # actor_id(bytes) -> ("alive", node_hex, address)
+        self.actor_cache: dict[bytes, tuple] = {}
+        self._awaiting_actor: dict[bytes, list] = {}   # aid -> queued specs
+        self._pulls: dict[bytes, dict] = {}            # oid bytes -> state
+        self._pull_attempts: dict[bytes, int] = {}
+        self._out_transfers: dict[tuple, dict] = {}    # (conn_id, oid) -> st
+        self._watched: set[bytes] = set()              # locate sent for oid
+        self._fwd_tasks: dict[bytes, dict] = {}        # task_id -> fwd info
+        self._fwd_by_oid: dict[bytes, bytes] = {}      # return oid -> task_id
+        self._pg_prepared: dict[tuple, dict] = {}      # (pg,idx) -> bundle
+        self._pg_bundles: dict[tuple, dict] = {}       # committed originals
 
-    def post_later(self, delay: float, fn) -> None:
-        t = threading.Timer(delay, lambda: self.post(fn))
-        t.daemon = True
-        t.start()
+        self._last_hb = 0.0
+        self._hb_period = config.heartbeat_period_ms / 1000.0
+        # ticks must run at least as often as heartbeats are due
+        self.tick_interval = min(self.tick_interval, self._hb_period)
+
+        if head_address:
+            self._connect_head()
 
     # ------------------------------------------------------------------ run
 
-    def start_thread(self) -> None:
-        self._thread = threading.Thread(target=self.run, name="raytpu-node",
-                                        daemon=True)
-        self._thread.start()
-
-    def run(self) -> None:
-        while not self._stop.is_set():
-            while True:
-                with self._posted_lock:
-                    if not self._posted:
-                        break
-                    fn = self._posted.popleft()
-                try:
-                    fn()
-                except Exception:
-                    sys.stderr.write("[node] posted callback failed:\n"
-                                     + traceback.format_exc())
-            now = time.monotonic()
-            if now - self._last_tick > 0.25:
-                self._last_tick = now
-                # periodic re-dispatch: recovers from missed wakeups and
-                # re-evaluates worker-pool health (dead spawns etc.)
-                try:
-                    self._schedule()
-                    self._expire_stale_pins()
-                except Exception:
-                    sys.stderr.write("[node] periodic schedule error:\n"
-                                     + traceback.format_exc())
-            try:
-                events = self.sel.select(timeout=0.05)
-            except OSError:
-                continue
-            for key, mask in events:
-                if key.data is None:
-                    self._accept()
-                else:
-                    rec: ClientRec = key.data
-                    try:
-                        if mask & selectors.EVENT_READ:
-                            self._on_readable(rec)
-                        if mask & selectors.EVENT_WRITE:
-                            self._on_writable(rec)
-                    except Exception:
-                        sys.stderr.write("[node] connection handler error:\n"
-                                         + traceback.format_exc())
-                        try:
-                            self._drop_client(rec)
-                        except Exception:
-                            sys.stderr.write("[node] drop_client error:\n"
-                                             + traceback.format_exc())
-        self._cleanup()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None and self._thread is not threading.current_thread():
-            self._thread.join(timeout=5)
+    def on_tick(self) -> None:
+        # periodic re-dispatch: recovers from missed wakeups and
+        # re-evaluates worker-pool health (dead spawns etc.)
+        self._schedule()
+        self._expire_stale_pins()
+        self._heartbeat()
 
     def _cleanup(self) -> None:
         for rec in list(self.clients.values()):
@@ -273,117 +228,139 @@ class NodeService:
                 pass
         self.listener.close()
         self.sel.close()
+        for conn in self._peer_conns.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        if self.head_conn is not None:
+            try:
+                self.head_conn.close()
+            except Exception:
+                pass
         self.store.shutdown()
 
-    # ----------------------------------------------------------------- io
+    # ------------------------------------------------------- head channel
 
-    def _accept(self) -> None:
-        try:
-            sock, _ = self.listener.accept()
-        except OSError:
-            return
-        sock.setblocking(False)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._next_conn += 1
-        rec = ClientRec(conn_id=self._next_conn, sock=sock)
-        self.clients[rec.conn_id] = rec
-        self.sel.register(sock, selectors.EVENT_READ, rec)
+    def _connect_head(self) -> None:
+        conn = protocol.connect(self.head_address)
+        conn.send({"t": "register_node", "reqid": 0,
+                   "node_id": self.node_id.hex(), "address": self.address,
+                   "resources": self.total_resources,
+                   "available": dict(self.available)})
+        reply = conn.recv(timeout=30.0)
+        if reply.get("error"):
+            raise RuntimeError(f"head registration failed: {reply['error']}")
+        self.cluster_view = reply.get("view", {})
+        self.head_conn = conn
+        t = threading.Thread(target=self._head_recv_loop, daemon=True,
+                             name="raytpu-node-head")
+        t.start()
 
-    def _on_readable(self, rec: ClientRec) -> None:
-        try:
-            data = rec.sock.recv(1 << 20)
-        except (BlockingIOError, InterruptedError):
-            return
-        except OSError:
-            self._drop_client(rec)
-            return
-        if not data:
-            self._drop_client(rec)
-            return
-        rec.rbuf += data
-        while True:
-            if len(rec.rbuf) < _HDR.size:
-                break
-            (n,) = _HDR.unpack_from(rec.rbuf)
-            if len(rec.rbuf) < _HDR.size + n:
-                break
-            frame = bytes(rec.rbuf[_HDR.size:_HDR.size + n])
-            del rec.rbuf[:_HDR.size + n]
-            msg = pickle.loads(frame)
-            self._dispatch(rec, msg)
-
-    def _on_writable(self, rec: ClientRec) -> None:
-        if rec.wbuf:
+    def _head_recv_loop(self) -> None:
+        while not self._stop.is_set():
             try:
-                sent = rec.sock.send(rec.wbuf)
-                del rec.wbuf[:sent]
-            except (BlockingIOError, InterruptedError):
+                msg = self.head_conn.recv()
+            except protocol.ConnectionClosed:
+                self.post(self._head_lost)
                 return
-            except OSError:
-                self._drop_client(rec)
-                return
-        if not rec.wbuf:
-            self.sel.modify(rec.sock, selectors.EVENT_READ, rec)
+            except Exception:
+                continue
+            self.post(lambda m=msg: self._on_head_msg(m))
 
-    def _push(self, rec: ClientRec, msg: dict) -> None:
-        if rec.closed:
+    def _head_lost(self) -> None:
+        # Head death orphans the cluster plane; keep serving local work
+        # (reference: raylets survive transient GCS outages), but fail
+        # everything mid-flight through the head so callers see errors
+        # instead of hanging forever.
+        if self.head_conn is None:
             return
-        frame = dumps_frame(msg)
-        if rec.wbuf:
-            rec.wbuf += frame
+        sys.stderr.write("[node] lost connection to head service\n")
+        self.head_conn = None
+        self._hb_inflight = False
+        pending = list(self._head_pending.values())
+        self._head_pending.clear()
+        for cb in pending:
+            try:
+                cb({"error": "head connection lost"})
+            except Exception:
+                sys.stderr.write("[node] head-lost callback failed:\n"
+                                 + traceback.format_exc())
+        for ab, specs in list(self._awaiting_actor.items()):
+            self._awaiting_actor.pop(ab, None)
+            for spec in specs:
+                self._fail_task(spec, "Actor location unknown: head "
+                                      "connection lost")
+
+    def _head_rpc(self, msg: dict, cb=None) -> None:
+        if self.head_conn is None:
+            if cb is not None:
+                cb({"error": "no head connection"})
+            return
+        if cb is not None:
+            self._head_seq += 1
+            msg["reqid"] = self._head_seq
+            self._head_pending[self._head_seq] = cb
+        try:
+            self.head_conn.send(msg)
+        except protocol.ConnectionClosed:
+            self._head_pending.pop(msg.get("reqid", -1), None)
+            self._head_lost()
+            if cb is not None:
+                cb({"error": "no head connection"})
+
+    def _on_head_msg(self, m: dict) -> None:
+        if m.get("t") == "reply":
+            cb = self._head_pending.pop(m.get("reqid"), None)
+            if cb is not None:
+                try:
+                    cb(m)
+                except Exception:
+                    sys.stderr.write("[node] head rpc callback failed:\n"
+                                     + traceback.format_exc())
+            return
+        handler = getattr(self, "_hh_" + m["t"], None)
+        if handler is None:
             return
         try:
-            sent = rec.sock.send(frame)
-        except (BlockingIOError, InterruptedError):
-            sent = 0
-        except OSError:
-            self._drop_client(rec)
-            return
-        if sent < len(frame):
-            rec.wbuf += frame[sent:]
-            try:
-                self.sel.modify(rec.sock,
-                                selectors.EVENT_READ | selectors.EVENT_WRITE, rec)
-            except KeyError:
-                pass
+            handler(m)
+        except Exception:
+            sys.stderr.write(f"[node] head push {m['t']} failed:\n"
+                             + traceback.format_exc())
 
-    def _flush(self, rec: ClientRec) -> None:
-        rec.sock.setblocking(True)
-        if rec.wbuf:
-            try:
-                rec.sock.sendall(bytes(rec.wbuf))
-            except OSError:
-                pass
-            rec.wbuf.clear()
-
-    def _reply(self, rec: ClientRec, reqid: int, **kw) -> None:
+    def _head_reply(self, reqid: int, **kw) -> None:
         kw["t"] = "reply"
         kw["reqid"] = reqid
-        self._push(rec, kw)
-
-    # ------------------------------------------------------------- dispatch
-
-    def _dispatch(self, rec: ClientRec, msg: dict) -> None:
-        handler = getattr(self, "_h_" + msg["t"], None)
-        if handler is None:
-            if "reqid" in msg:
-                self._reply(rec, msg["reqid"], error=f"unknown message {msg['t']}")
-            return
         try:
-            handler(rec, msg)
-        except Exception:
-            tb = traceback.format_exc()
-            sys.stderr.write(f"[node] handler {msg['t']} failed:\n{tb}")
-            if "reqid" in msg:
-                self._reply(rec, msg["reqid"], error=tb)
+            self.head_conn.send(kw)
+        except (protocol.ConnectionClosed, AttributeError):
+            pass
 
-    # -- registration
+    def _heartbeat(self) -> None:
+        if self.head_conn is None or self._hb_inflight:
+            return
+        now = time.monotonic()
+        if now - self._last_hb < self._hb_period:
+            return
+        self._last_hb = now
+        self._hb_inflight = True
+
+        def cb(reply):
+            self._hb_inflight = False
+            if not reply.get("error"):
+                self.cluster_view = reply.get("view", self.cluster_view)
+        self._head_rpc({"t": "heartbeat",
+                        "available": self._projected_available(),
+                        "total": self.total_resources}, cb)
+
+    # -------------------------------------------------------- registration
 
     def _h_register(self, rec, m):
         rec.kind = m["kind"]
         rec.worker_id = m.get("worker_id", "")
         rec.pid = m.get("pid", 0)
         rec.tpu = bool(m.get("tpu", False))
+        rec.node_hex = m.get("node_hex", "")
         if rec.kind in ("worker", "tpu_executor"):
             self._spawning = max(0, self._spawning - 1)
         self._reply(rec, m["reqid"], session=self.session,
@@ -432,6 +409,7 @@ class NodeService:
         self._multigets[key] = {"ids": ids, "remaining": set(pending)}
         for o in pending:
             self._mg_by_oid.setdefault(o, set()).add(key)
+        self._ensure_remote_watch(pending)
         if rec.state == "busy":
             rec.state = "blocked"
             self._release_task_cpu(rec)
@@ -491,7 +469,30 @@ class NodeService:
                     kept.append((oid, ts))
             rec.held_pins[:] = kept
 
+    def _object_ready_hook(self, oid: ObjectID, info: ObjInfo) -> None:
+        """Cluster bookkeeping when an object becomes ready/error here."""
+        ob = oid.binary()
+        self._watched.discard(ob)
+        self._pull_attempts.pop(ob, None)
+        if self.head_conn is not None and not info.loc_reported:
+            info.loc_reported = True
+            try:
+                self.head_conn.send({"t": "report_locations", "adds": [ob]})
+            except protocol.ConnectionClosed:
+                self._head_lost()
+        tid = self._fwd_by_oid.pop(ob, None)
+        if tid is not None:
+            fw = self._fwd_tasks.get(tid)
+            if fw is not None and not any(
+                    b in self._fwd_by_oid for b in fw["spec"]["return_ids"]):
+                self._fwd_tasks.pop(tid, None)
+                tr = self.tasks.get(tid)
+                if tr is not None and tr.state == "forwarded":
+                    tr.state = "failed" if info.is_error else "finished"
+                    tr.finished_at = time.time()
+
     def _resolve_waiters(self, oid: ObjectID, info: ObjInfo) -> None:
+        self._object_ready_hook(oid, info)
         for key in self._mg_by_oid.pop(oid, ()):
             mg = self._multigets.get(key)
             if mg is None:
@@ -516,6 +517,9 @@ class NodeService:
 
     def _h_wait(self, rec, m):
         ids = [ObjectID(b) for b in m["object_ids"]]
+        self._ensure_remote_watch(
+            [o for o in ids
+             if self.objects.setdefault(o, ObjInfo()).state == "pending"])
         self._try_finish_wait(rec.conn_id, m["reqid"], ids, m["num_returns"],
                               time.time() + m["timeout"] if m.get("timeout")
                               is not None else None, first=True)
@@ -545,25 +549,38 @@ class NodeService:
                                 lambda: self._try_finish_wait(
                                     conn_id, reqid, ids, num_returns, deadline))
 
+    def _seal_error_object(self, oid: ObjectID, exc: BaseException) -> None:
+        """Make `oid` resolve to an error value and wake its waiters —
+        the single encoder of error objects on this node."""
+        from ray_tpu.core.serialization import SerializedObject
+        info = self.objects.setdefault(oid, ObjInfo())
+        info.state = "error"
+        info.loc = "inline"
+        info.data = SerializedObject(inband=pickle.dumps(exc)).to_bytes()
+        info.is_error = True
+        self._resolve_waiters(oid, info)
+
+    def _delete_local_object(self, oid: ObjectID) -> None:
+        info = self.objects.get(oid)
+        if info is not None and (info.state == "pending"
+                                 or oid in self._mg_by_oid
+                                 or info.wait_waiters
+                                 or oid in self.dep_waiting):
+            # fail anyone blocked on it before it vanishes
+            self._seal_error_object(
+                oid, RuntimeError(f"Object {oid.hex()[:16]} was freed"))
+        self.objects.pop(oid, None)
+        self.store.delete(oid)
+
     def _h_free_objects(self, rec, m):
         for b in m["object_ids"]:
-            oid = ObjectID(b)
-            info = self.objects.get(oid)
-            if info is not None and (info.state == "pending"
-                                     or oid in self._mg_by_oid
-                                     or info.wait_waiters
-                                     or oid in self.dep_waiting):
-                # fail anyone blocked on it before it vanishes
-                err = pickle.dumps(RuntimeError(
-                    f"Object {oid.hex()[:16]} was freed"))
-                from ray_tpu.core.serialization import SerializedObject
-                info.state = "error"
-                info.loc = "inline"
-                info.data = SerializedObject(inband=err).to_bytes()
-                info.is_error = True
-                self._resolve_waiters(oid, info)
-            self.objects.pop(oid, None)
-            self.store.delete(oid)
+            self._delete_local_object(ObjectID(b))
+        if self.head_conn is not None:
+            try:
+                self.head_conn.send({"t": "free_objects",
+                                     "object_ids": list(m["object_ids"])})
+            except protocol.ConnectionClosed:
+                self._head_lost()
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
 
@@ -574,39 +591,125 @@ class NodeService:
     # -- functions
 
     def _h_register_function(self, rec, m):
-        self.functions[m["function_id"]] = m["pickled"]
-        for conn_id, reqid in self._fn_waiters.pop(m["function_id"], []):
-            w = self.clients.get(conn_id)
-            if w is not None:
-                self._reply(w, reqid, pickled=m["pickled"])
+        self._store_function(m["function_id"], m["pickled"])
+        if self.head_conn is not None:
+            try:
+                self.head_conn.send({"t": "register_function",
+                                     "function_id": m["function_id"],
+                                     "pickled": m["pickled"]})
+            except protocol.ConnectionClosed:
+                self._head_lost()
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
+
+    def _store_function(self, fid: str, pickled: bytes) -> None:
+        self.functions[fid] = pickled
+        for conn_id, reqid in self._fn_waiters.pop(fid, []):
+            w = self.clients.get(conn_id)
+            if w is not None:
+                self._reply(w, reqid, pickled=pickled)
 
     def _h_fetch_function(self, rec, m):
         fid = m["function_id"]
         if fid in self.functions:
             self._reply(rec, m["reqid"], pickled=self.functions[fid])
-        else:
-            self._fn_waiters.setdefault(fid, []).append((rec.conn_id, m["reqid"]))
+            return
+        first = fid not in self._fn_waiters
+        self._fn_waiters.setdefault(fid, []).append((rec.conn_id, m["reqid"]))
+        if first and self.head_conn is not None:
+            # the head parks the fetch until some node registers the
+            # function (functions are exported once, cluster-wide)
+            self._head_rpc(
+                {"t": "fetch_function", "function_id": fid},
+                lambda reply: (reply.get("pickled")
+                               and self._store_function(fid,
+                                                        reply["pickled"])))
 
     # -- tasks
 
     def _h_submit_task(self, rec, m):
         spec = m["spec"]
         spec["submitter"] = rec.conn_id
+        self._admit_task(spec)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _admit_task(self, spec: dict) -> None:
         tr = TaskRec(spec=spec, retries_left=spec.get("max_retries", 0))
         self.tasks[spec["task_id"]] = tr
         for b in spec["return_ids"]:
             self.objects.setdefault(ObjectID(b), ObjInfo())
         self._record_event(spec, "PENDING")
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
         self._enqueue_task(spec)
 
+    def _projected_available(self) -> dict:
+        """Availability net of demand already sitting in the runnable
+        queues: resources are only acquired at dispatch, so raw
+        `available` over-promises (the reference's hybrid policy counts
+        committed resources the same way,
+        hybrid_scheduling_policy.h)."""
+        proj = dict(self.available)
+        for q in (self.runnable_cpu, self.runnable_tpu):
+            for s in q:
+                if s.get("placement_group"):
+                    continue   # draws on its bundle, not the node pool
+                for k, v in self._demand(s).items():
+                    proj[k] = proj.get(k, 0.0) - v
+        return {k: max(0.0, v) for k, v in proj.items()}
+
+    def _available_covers(self, spec: dict) -> bool:
+        proj = self._projected_available()
+        return all(proj.get(k, 0.0) + 1e-9 >= v
+                   for k, v in self._demand(spec).items())
+
+    def _cluster_has_capacity(self, spec: dict) -> bool:
+        demand = self._demand(spec)
+        me = self.node_id.hex()
+        for h, n in self.cluster_view.items():
+            if h == me or not n.get("alive"):
+                continue
+            if all(n["available"].get(k, 0.0) + 1e-9 >= v
+                   for k, v in demand.items()):
+                return True
+        return False
+
     def _enqueue_task(self, spec: dict) -> None:
-        if not self._feasible(spec):
+        routed = spec.get("_routed")
+        pg = spec.get("placement_group")
+        clustered = self.head_conn is not None and not routed
+        if pg is not None:
+            if (pg[0], pg[1]) not in self.pg_available:
+                if clustered:
+                    # bundle lives on another node: the head routes it there
+                    self._forward_task(spec)
+                    return
+                if routed:
+                    # routed here for a bundle that was removed in the
+                    # meantime: fail fast — queueing would head-of-line
+                    # block every later task behind an unacquirable spec
+                    self._fail_task(
+                        spec, "Placement group bundle no longer exists "
+                              "on this node (group removed?)")
+                    return
+        elif not self._feasible(spec):
+            if clustered:
+                self._forward_task(spec)
+                return
             self._fail_task(spec, "Infeasible resource demand: "
                             f"{self._demand(spec)} on {self.total_resources}")
+            return
+        elif (clustered and not self._available_covers(spec)
+              and self._cluster_has_capacity(spec)):
+            # spillover: another node can run it NOW, we can't
+            # (reference: hybrid scheduling policy spills when the local
+            # node is saturated, hybrid_scheduling_policy.h)
+            self._forward_task(spec)
+            return
+        if spec.get("_routed") and not self._feasible(spec):
+            # routing race: the head's view was stale
+            self._fail_task(spec, "Infeasible resource demand after "
+                            f"routing: {self._demand(spec)} on "
+                            f"{self.total_resources}")
             return
         ndeps = 0
         for b in spec.get("arg_ids", []):
@@ -615,10 +718,40 @@ class NodeService:
             if info.state == "pending":
                 ndeps += 1
                 self.dep_waiting.setdefault(oid, []).append(spec)
+                self._ensure_remote_watch([oid])
         spec["_ndeps"] = ndeps
         if ndeps == 0:
             self._make_runnable(spec)
             self._schedule()
+
+    def _forward_task(self, spec: dict) -> None:
+        tid = spec["task_id"]
+
+        def cb(reply):
+            if reply.get("error"):
+                self._fail_task(spec, reply["error"])
+                return
+            if reply.get("local"):
+                spec["_routed"] = True
+                self._enqueue_task(spec)
+                return
+            dst = reply["node"]
+            tr = self.tasks.get(tid)
+            if tr is not None:
+                tr.state = "forwarded"
+            self._fwd_tasks[tid] = {"spec": spec, "dst": dst,
+                                    "retries": spec.get("max_retries", 0)}
+            for b in spec["return_ids"]:
+                self._fwd_by_oid[b] = tid
+            self._ensure_remote_watch(
+                [ObjectID(b) for b in spec["return_ids"]])
+        self._head_rpc({"t": "cluster_submit", "spec": _wire_spec(spec),
+                        "src_available": self._projected_available()}, cb)
+
+    def _hh_remote_submit(self, m: dict) -> None:
+        spec = m["spec"]
+        spec["_routed"] = True
+        self._admit_task(spec)
 
     def _make_runnable(self, spec: dict) -> None:
         if spec.get("num_tpus"):
@@ -759,17 +892,8 @@ class NodeService:
         if tr is not None:
             tr.state = "failed"
             tr.error = error
-        err = pickle.dumps(RuntimeError(error))
-        from ray_tpu.core.serialization import SerializedObject
-        data = SerializedObject(inband=err).to_bytes()
         for b in spec["return_ids"]:
-            oid = ObjectID(b)
-            info = self.objects.setdefault(oid, ObjInfo())
-            info.state = "error"
-            info.loc = "inline"
-            info.data = data
-            info.is_error = True
-            self._resolve_waiters(oid, info)
+            self._seal_error_object(ObjectID(b), RuntimeError(error))
 
     def _maybe_spawn_worker(self, tpu: bool = False) -> None:
         if tpu:
@@ -833,6 +957,22 @@ class NodeService:
 
     def _h_create_actor(self, rec, m):
         spec = m["spec"]
+        if self.head_conn is not None:
+            # head owns names, placement, and the cluster directory
+            reqid = m["reqid"]
+
+            def cb(reply):
+                w = self.clients.get(rec.conn_id)
+                if w is None:
+                    return
+                if reply.get("error"):
+                    self._reply(w, reqid, error=reply["error"])
+                else:
+                    self._reply(w, reqid, actor_id=reply["actor_id"],
+                                existing=reply.get("existing", False))
+            self._head_rpc({"t": "cluster_create_actor",
+                            "spec": _wire_spec(spec)}, cb)
+            return
         actor_id = ActorID(spec["actor_id"])
         name = spec.get("name") or ""
         ns = spec.get("namespace") or "default"
@@ -856,12 +996,29 @@ class NodeService:
                         error=f"Infeasible actor resource demand: "
                               f"{self._demand(spec)} on {self.total_resources}")
             return
-        ar = ActorRec(actor_id=actor_id, spec=spec, name=name, namespace=ns,
+        self._reply(rec, m["reqid"], actor_id=actor_id.binary())
+        self._admit_actor(spec)
+
+    def _admit_actor(self, spec: dict) -> ActorRec:
+        actor_id = ActorID(spec["actor_id"])
+        ar = ActorRec(actor_id=actor_id, spec=spec,
+                      name=spec.get("name") or "",
+                      namespace=spec.get("namespace") or "default",
                       restarts_left=spec.get("max_restarts", 0),
                       max_concurrency=spec.get("max_concurrency", 1))
         self.actors[actor_id] = ar
-        self._reply(rec, m["reqid"], actor_id=actor_id.binary())
         self._place_actor(ar)
+        return ar
+
+    def _hh_place_actor(self, m: dict) -> None:
+        """Head chose this node to host the actor (fresh or node-death
+        re-place: the constructor re-runs; reference:
+        gcs_actor_manager.cc RestartActor)."""
+        spec = m["spec"]
+        old = self.actors.get(ActorID(spec["actor_id"]))
+        if old is not None and old.state not in ("dead",):
+            return  # duplicate placement push
+        self._admit_actor(spec)
 
     def _place_actor(self, ar: ActorRec) -> None:
         needs_tpu = bool(ar.spec.get("num_tpus"))
@@ -886,6 +1043,22 @@ class NodeService:
         if ar.state in ("pending", "restarting") and ar.conn_id is None:
             self._place_actor(ar)
 
+    def _report_actor_state(self, ar: ActorRec) -> None:
+        """State fan-out: via the head in cluster mode (it publishes and
+        resolves watchers), locally otherwise."""
+        if self.head_conn is not None:
+            try:
+                self.head_conn.send({"t": "actor_state_report",
+                                     "actor_id": ar.actor_id.binary(),
+                                     "state": ar.state,
+                                     "death_cause": ar.death_cause})
+            except protocol.ConnectionClosed:
+                self._head_lost()
+        else:
+            self._publish_local("actor_state",
+                                {"actor_id": ar.actor_id.hex(),
+                                 "state": ar.state})
+
     def _h_actor_created(self, rec, m):
         ar = self.actors.get(ActorID(m["actor_id"]))
         if ar is None:
@@ -899,10 +1072,10 @@ class NodeService:
                 rec.state = "idle"
             ar.conn_id = None
             self._return_resources(ar.spec)
+            self._report_actor_state(ar)
         else:
             ar.state = "alive"
-            self._publish("actor_state",
-                          {"actor_id": ar.actor_id.hex(), "state": "alive"})
+            self._report_actor_state(ar)
             self._dispatch_actor_queue(ar)
 
     def _h_submit_actor_task(self, rec, m):
@@ -913,8 +1086,101 @@ class NodeService:
             self.objects.setdefault(ObjectID(b), ObjInfo())
         self.tasks[spec["task_id"]] = TaskRec(spec=spec)
         self._record_event(spec, "PENDING")
+        if ar is not None:
+            if ar.state == "dead":
+                self._fail_task(spec, f"Actor is dead: {ar.death_cause}")
+                return
+            ar.queue.append(spec)
+            self._dispatch_actor_queue(ar)
+            return
+        if self.head_conn is None:
+            self._fail_task(spec, "Actor is dead: actor not found")
+            return
+        self._route_actor_task(spec)
+
+    # ---- cluster actor-task routing
+
+    def _route_actor_task(self, spec: dict) -> None:
+        ab = spec["actor_id"]
+        cached = self.actor_cache.get(ab)
+        if cached is not None:
+            # on forward failure: invalidate the cache and re-route via a
+            # fresh head lookup (the actor may have moved)
+            self._forward_actor_task(
+                spec, cached[0], cached[1],
+                on_fail=lambda: (self.actor_cache.pop(ab, None),
+                                 self._queue_actor_locate(spec)))
+            return
+        self._queue_actor_locate(spec)
+
+    def _queue_actor_locate(self, spec: dict) -> None:
+        ab = spec["actor_id"]
+        waiting = self._awaiting_actor.setdefault(ab, [])
+        waiting.append(spec)
+        if len(waiting) == 1:
+            self._head_rpc({"t": "locate_actor", "actor_id": ab},
+                           lambda reply: self._on_actor_located(ab, reply))
+
+    def _on_actor_located(self, ab: bytes, reply: dict) -> None:
+        state = reply.get("state")
+        if reply.get("error") or state in ("dead", "unknown"):
+            cause = reply.get("death_cause") or reply.get("error") \
+                or "actor not found"
+            for spec in self._awaiting_actor.pop(ab, []):
+                self._fail_task(spec, f"Actor is dead: {cause}")
+            return
+        if state == "alive":
+            self.actor_cache[ab] = (reply["node"], reply["address"])
+            for spec in self._awaiting_actor.pop(ab, []):
+                self._forward_actor_task(
+                    spec, reply["node"], reply["address"],
+                    on_fail=lambda s=spec: self._fail_task(
+                        s, "Actor's node is unreachable"))
+            return
+        # pending/restarting: the head registered us as a watcher and will
+        # push actor_at when it settles — keep the specs queued
+
+    def _hh_actor_at(self, m: dict) -> None:
+        self._on_actor_located(m["actor_id"], m)
+
+    def _forward_actor_task(self, spec: dict, node_hex: str,
+                            address: str, on_fail) -> None:
+        def go(conn):
+            if conn is None:
+                on_fail()
+                return
+            wire = _wire_spec(spec)
+            wire["_routed"] = True
+            try:
+                conn.send({"t": "remote_actor_task", "spec": wire})
+            except protocol.ConnectionClosed:
+                self._drop_peer(node_hex)
+                on_fail()
+                return
+            tid = spec["task_id"]
+            tr = self.tasks.get(tid)
+            if tr is not None:
+                tr.state = "forwarded"
+            self._fwd_tasks[tid] = {"spec": spec, "dst": node_hex,
+                                    "retries": 0, "actor": True}
+            for b in spec["return_ids"]:
+                self._fwd_by_oid[b] = tid
+            self._ensure_remote_watch(
+                [ObjectID(b) for b in spec["return_ids"]])
+        self._peer_conn_async(node_hex, address, go)
+
+    def _h_remote_actor_task(self, rec, m):
+        """A peer node forwarded a method call for an actor hosted here."""
+        spec = m["spec"]
+        spec["_routed"] = True
+        actor_id = ActorID(spec["actor_id"])
+        for b in spec["return_ids"]:
+            self.objects.setdefault(ObjectID(b), ObjInfo())
+        self.tasks[spec["task_id"]] = TaskRec(spec=spec)
+        self._record_event(spec, "PENDING")
+        ar = self.actors.get(actor_id)
         if ar is None or ar.state == "dead":
-            cause = ar.death_cause if ar else "actor not found"
+            cause = ar.death_cause if ar else "actor not on this node"
             self._fail_task(spec, f"Actor is dead: {cause}")
             return
         ar.queue.append(spec)
@@ -931,6 +1197,10 @@ class NodeService:
             if not self._args_ready(spec):
                 # actors preserve submission order: put back and stop
                 ar.queue.appendleft(spec)
+                self._ensure_remote_watch(
+                    [ObjectID(b) for b in spec.get("arg_ids", [])
+                     if self.objects.setdefault(ObjectID(b),
+                                                ObjInfo()).state == "pending"])
                 self._wait_args_then(spec, lambda: self._dispatch_actor_queue(ar))
                 return
             ar.running[spec["task_id"]] = spec
@@ -961,11 +1231,26 @@ class NodeService:
     def _h_kill_actor(self, rec, m):
         actor_id = ActorID(m["actor_id"])
         ar = self.actors.get(actor_id)
+        if ar is None and self.head_conn is not None:
+            # actor lives elsewhere: the head routes the kill
+            reqid = m.get("reqid")
+
+            def cb(reply):
+                w = self.clients.get(rec.conn_id)
+                if reqid is not None and w is not None:
+                    self._reply(w, reqid, ok=bool(reply.get("ok")))
+            self._head_rpc({"t": "kill_actor", "actor_id": m["actor_id"],
+                            "no_restart": m.get("no_restart", True)}, cb)
+            return
         if ar is None:
             if "reqid" in m:
                 self._reply(rec, m["reqid"], ok=False)
             return
-        no_restart = m.get("no_restart", True)
+        self._kill_local_actor(ar, m.get("no_restart", True))
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _kill_local_actor(self, ar: ActorRec, no_restart: bool) -> None:
         if no_restart:
             ar.restarts_left = 0
         w = self.clients.get(ar.conn_id) if ar.conn_id is not None else None
@@ -975,12 +1260,15 @@ class NodeService:
             # shared in-process TPU executor: destroy only this actor's
             # instance, keep the executor alive for other work
             self._push(w, {"t": "destroy_actor",
-                           "actor_id": actor_id.binary()})
+                           "actor_id": ar.actor_id.binary()})
             self._mark_actor_dead(ar, "killed")
         else:
             self._mark_actor_dead(ar, "killed")
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
+
+    def _hh_kill_local_actor(self, m: dict) -> None:
+        ar = self.actors.get(ActorID(m["actor_id"]))
+        if ar is not None:
+            self._kill_local_actor(ar, m.get("no_restart", True))
 
     def _mark_actor_dead(self, ar: ActorRec, cause: str) -> None:
         if ar.state == "dead":
@@ -993,10 +1281,12 @@ class NodeService:
         ar.running.clear()
         self._fail_actor_queue(ar, cause)
         self._return_resources(ar.spec)
-        self._publish("actor_state", {"actor_id": ar.actor_id.hex(),
-                                      "state": "dead"})
+        self._report_actor_state(ar)
 
     def _h_get_named_actor(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)
+            return
         key = (m.get("namespace") or "default", m["name"])
         aid = self.named_actors.get(key)
         if aid is None or self.actors[aid].state == "dead":
@@ -1008,6 +1298,9 @@ class NodeService:
                 "class_name": ar.spec.get("class_name", "")})
 
     def _h_list_named_actors(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)
+            return
         out = [{"namespace": ns, "name": n}
                for (ns, n), aid in self.named_actors.items()
                if self.actors[aid].state != "dead"
@@ -1015,9 +1308,34 @@ class NodeService:
                                                       or "default"))]
         self._reply(rec, m["reqid"], actors=out)
 
-    # -- placement groups (single node: reservation only)
+    # -- head proxying ------------------------------------------------------
+
+    def _proxy_to_head(self, rec: ClientRec, m: dict) -> None:
+        """Forward a cluster-scope client request to the head verbatim and
+        relay the reply (errors included)."""
+        reqid = m.get("reqid")
+        fwd = {k: v for k, v in m.items() if k != "reqid"}
+        if reqid is None:
+            try:
+                self.head_conn.send(fwd)
+            except protocol.ConnectionClosed:
+                self._head_lost()
+            return
+
+        def cb(reply):
+            w = self.clients.get(rec.conn_id)
+            if w is None:
+                return
+            out = {k: v for k, v in reply.items() if k not in ("t", "reqid")}
+            self._reply(w, reqid, **out)
+        self._head_rpc(fwd, cb)
+
+    # -- placement groups
 
     def _h_create_pg(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)   # head runs the cross-node 2PC
+            return
         pg_id = PlacementGroupID(m["pg_id"])
         bundles = m["bundles"]
         # single-node prepare+commit in one step
@@ -1040,6 +1358,9 @@ class NodeService:
         self._reply(rec, m["reqid"], ok=True)
 
     def _h_remove_pg(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)
+            return
         pg_id = PlacementGroupID(m["pg_id"])
         pg = self.pgs.pop(pg_id, None)
         if pg is not None:
@@ -1050,9 +1371,50 @@ class NodeService:
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
 
+    # 2PC participant handlers (pushed by the head over the head channel;
+    # reference: gcs_placement_group_scheduler.h Prepare/Commit on raylets)
+
+    def _hh_pg_prepare(self, m: dict) -> None:
+        bundle = m["bundle"]
+        ok = all(self.available.get(k, 0.0) + 1e-9 >= v
+                 for k, v in bundle.items())
+        if ok:
+            for k, v in bundle.items():
+                self.available[k] -= v
+            self._pg_prepared[(m["pg_id"], m["bundle_idx"])] = dict(bundle)
+        self._head_reply(m["reqid"], ok=ok)
+
+    def _hh_pg_commit(self, m: dict) -> None:
+        key = (m["pg_id"], m["bundle_idx"])
+        bundle = self._pg_prepared.pop(key, None)
+        if bundle is not None:
+            self.pg_available[key] = dict(bundle)
+            self._pg_bundles[key] = dict(bundle)   # original reservation
+
+    def _hh_pg_rollback(self, m: dict) -> None:
+        bundle = self._pg_prepared.pop((m["pg_id"], m["bundle_idx"]), None)
+        if bundle is not None:
+            for k, v in bundle.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+
+    def _hh_pg_remove_local(self, m: dict) -> None:
+        key = (m["pg_id"], m["bundle_idx"])
+        free = self.pg_available.pop(key, None)
+        # hand the ORIGINAL bundle reservation back to the node; tasks
+        # still drawing on the bundle release into the void afterwards,
+        # same as the reference's bundle-return semantics
+        orig = self._pg_bundles.pop(key, None)
+        if orig is None and free is None:
+            return
+        for k, v in (orig or free).items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
     # -- kv / pubsub
 
     def _h_kv_put(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)
+            return
         key = (m.get("namespace") or "default", m["key"])
         if m.get("overwrite", True) or key not in self.kv:
             self.kv[key] = m["value"]
@@ -1063,17 +1425,26 @@ class NodeService:
             self._reply(rec, m["reqid"], added=added)
 
     def _h_kv_get(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)
+            return
         self._reply(rec, m["reqid"],
                     value=self.kv.get((m.get("namespace") or "default",
                                        m["key"])))
 
     def _h_kv_del(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)
+            return
         existed = self.kv.pop((m.get("namespace") or "default", m["key"]),
                               None) is not None
         if "reqid" in m:
             self._reply(rec, m["reqid"], deleted=existed)
 
     def _h_kv_keys(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)
+            return
         ns = m.get("namespace") or "default"
         prefix = m.get("prefix", b"")
         self._reply(rec, m["reqid"],
@@ -1081,7 +1452,17 @@ class NodeService:
                           and k.startswith(prefix)])
 
     def _h_subscribe(self, rec, m):
-        self.pubsub.setdefault(m["channel"], set()).add(rec.conn_id)
+        ch = m["channel"]
+        self.pubsub.setdefault(ch, set()).add(rec.conn_id)
+        if self.head_conn is not None and ch not in self._head_subs:
+            # subscribe this NODE at the head once per channel; local
+            # clients fan out from the node (reference: pubsub long-poll
+            # through the raylet)
+            self._head_subs.add(ch)
+            try:
+                self.head_conn.send({"t": "subscribe", "channel": ch})
+            except protocol.ConnectionClosed:
+                self._head_lost()
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
 
@@ -1091,10 +1472,360 @@ class NodeService:
             self._reply(rec, m["reqid"], ok=True)
 
     def _publish(self, channel: str, data: Any) -> None:
+        if self.head_conn is not None:
+            # cluster-wide: the head fans out to subscribed nodes
+            # (including this one), which deliver locally on _hh_pub
+            try:
+                self.head_conn.send({"t": "publish", "channel": channel,
+                                     "data": data})
+                return
+            except protocol.ConnectionClosed:
+                self._head_lost()
+        self._publish_local(channel, data)
+
+    def _publish_local(self, channel: str, data: Any) -> None:
         for conn_id in list(self.pubsub.get(channel, ())):
             w = self.clients.get(conn_id)
             if w is not None:
                 self._push(w, {"t": "pub", "channel": channel, "data": data})
+
+    def _hh_pub(self, m: dict) -> None:
+        self._publish_local(m["channel"], m["data"])
+
+    def _hh_view_update(self, m: dict) -> None:
+        self.cluster_view = m["view"]
+
+    # -- node-to-node object transfer ---------------------------------------
+
+    def _peer_conn_async(self, node_hex: str, address: str, cb) -> None:
+        """Hand `cb` a Connection to the peer (or None).  The TCP connect
+        runs on a helper thread — a blackholed peer must never stall the
+        event loop (heartbeats ride it, and a stalled loop gets this
+        healthy node declared dead)."""
+        conn = self._peer_conns.get(node_hex)
+        if conn is not None:
+            cb(conn)
+            return
+        waiters = self._peer_connecting.setdefault(node_hex, [])
+        waiters.append(cb)
+        if len(waiters) > 1:
+            return   # a connect is already in flight
+
+        def work():
+            c = None
+            try:
+                c = protocol.connect(address, timeout=5.0)
+                c.send({"t": "register", "kind": "peer", "reqid": 0,
+                        "node_hex": self.node_id.hex(),
+                        "worker_id": f"peer-{self.node_id.hex()[:12]}"})
+            except (OSError, protocol.ConnectionClosed):
+                if c is not None:
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
+                c = None
+            self.post(lambda: self._peer_connected(node_hex, c))
+        threading.Thread(target=work, daemon=True,
+                         name=f"raytpu-connect-{node_hex[:8]}").start()
+
+    def _peer_connected(self, node_hex: str,
+                        conn: Optional[protocol.Connection]) -> None:
+        cbs = self._peer_connecting.pop(node_hex, [])
+        if conn is not None:
+            self._peer_conns[node_hex] = conn
+            t = threading.Thread(target=self._peer_recv_loop,
+                                 args=(node_hex, conn), daemon=True,
+                                 name=f"raytpu-peer-{node_hex[:8]}")
+            t.start()
+        for cb in cbs:
+            try:
+                cb(conn)
+            except Exception:
+                sys.stderr.write("[node] peer-connect callback failed:\n"
+                                 + traceback.format_exc())
+
+    def _peer_recv_loop(self, node_hex: str,
+                        conn: protocol.Connection) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = conn.recv()
+            except protocol.ConnectionClosed:
+                self.post(lambda: self._drop_peer(node_hex))
+                return
+            except Exception:
+                continue
+            self.post(lambda m=msg: self._on_peer_msg(node_hex, m))
+
+    def _drop_peer(self, node_hex: str) -> None:
+        conn = self._peer_conns.pop(node_hex, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        # pulls in flight from that peer: retry through the head (it may
+        # know another location, or the producer will resubmit)
+        for ob, st in list(self._pulls.items()):
+            if st["src"] == node_hex:
+                self._pulls.pop(ob, None)
+                self._watched.discard(ob)
+                self.post_later(
+                    0.1, lambda o=ObjectID(ob): self._ensure_remote_watch([o]))
+
+    def _ensure_remote_watch(self, oids: list) -> None:
+        """Ask the head where pending objects live; pull when told.  Safe
+        to call repeatedly — each object is watched at most once."""
+        if self.head_conn is None:
+            return
+        want = []
+        for o in oids:
+            ob = o.binary()
+            if ob in self._watched or ob in self._pulls:
+                continue
+            info = self.objects.get(o)
+            if info is not None and info.state != "pending":
+                continue
+            self._watched.add(ob)
+            want.append(ob)
+        if not want:
+            return
+
+        def cb(reply):
+            if reply.get("error"):
+                return
+            for ob, (node_hex, addr) in reply.get("locs", {}).items():
+                self._request_pull(ObjectID(ob), node_hex, addr)
+        self._head_rpc({"t": "locate_object", "object_ids": want}, cb)
+
+    def _hh_object_at(self, m: dict) -> None:
+        oid = ObjectID(m["object_id"])
+        info = self.objects.get(oid)
+        if info is not None and info.state == "pending":
+            self._request_pull(oid, m["node"], m["address"])
+
+    def _hh_object_lost(self, m: dict) -> None:
+        ob = m["object_id"]
+        if ob in self._fwd_by_oid:
+            return  # our own forwarded task will be resubmitted on node_dead
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        from ray_tpu.core.client import ObjectLostError
+        self._seal_error_object(oid, ObjectLostError(
+            f"Object {oid.hex()[:16]} was lost: "
+            f"{m.get('cause', 'node died')}"))
+
+    def _request_pull(self, oid: ObjectID, node_hex: str,
+                      address: str) -> None:
+        ob = oid.binary()
+        if ob in self._pulls:
+            return
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        # reserve the pull slot BEFORE the async connect so concurrent
+        # object_at notifications don't start duplicate transfers
+        self._pulls[ob] = {"src": node_hex, "view": None, "size": None,
+                           "received": 0, "is_error": False}
+
+        def go(conn):
+            st = self._pulls.get(ob)
+            if st is None or st["src"] != node_hex:
+                return   # resolved or re-routed while connecting
+            if conn is None:
+                self._pulls.pop(ob, None)
+                self._watched.discard(ob)
+                self.post_later(0.2,
+                                lambda: self._ensure_remote_watch([oid]))
+                return
+            try:
+                conn.send({"t": "pull_object", "object_id": ob})
+            except protocol.ConnectionClosed:
+                self._pulls.pop(ob, None)
+                self._drop_peer(node_hex)
+        self._peer_conn_async(node_hex, address, go)
+
+    # sender side -----------------------------------------------------------
+
+    def _h_pull_object(self, rec, m):
+        """A peer wants an object stored here: inline goes in one frame,
+        shm goes in windowed chunks (reference: object_manager.proto:61
+        Push with chunked ObjectChunk stream)."""
+        ob = m["object_id"]
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is None or info.state == "pending":
+            self._push(rec, {"t": "pull_failed", "object_id": ob,
+                             "error": "object not found on this node"})
+            return
+        if info.loc == "inline":
+            self._push(rec, {"t": "obj_inline", "object_id": ob,
+                             "data": info.data, "is_error": info.is_error})
+            return
+        if self.store.is_spilled(oid):
+            self.store.restore(oid)
+        self.store.touch(oid)
+        self.store.pin(oid)
+        try:
+            view = self.store._shm.map(oid)
+        except Exception:
+            self.store.unpin(oid)
+            self._push(rec, {"t": "pull_failed", "object_id": ob,
+                             "error": "object vanished mid-pull"})
+            return
+        st = {"oid": oid, "view": view, "size": info.size, "next_off": 0}
+        self._out_transfers[(rec.conn_id, ob)] = st
+        for _ in range(self.config.object_transfer_window):
+            if not self._send_next_chunk(rec, st):
+                break
+
+    def _send_next_chunk(self, rec: ClientRec, st: dict) -> bool:
+        off = st["next_off"]
+        if off >= st["size"]:
+            return False
+        n = min(self.config.object_transfer_chunk_size, st["size"] - off)
+        chunk = bytes(st["view"][off:off + n])
+        st["next_off"] = off + n
+        self._push(rec, {"t": "obj_chunk", "object_id": st["oid"].binary(),
+                         "offset": off, "total_size": st["size"],
+                         "data": chunk})
+        if st["next_off"] >= st["size"]:
+            # final chunk queued: release our references now; remaining
+            # acks for this transfer are ignored
+            st["view"] = None
+            self.store.unpin(st["oid"])
+            self._out_transfers.pop((rec.conn_id, st["oid"].binary()), None)
+        return True
+
+    def _h_obj_chunk_ack(self, rec, m):
+        st = self._out_transfers.get((rec.conn_id, m["object_id"]))
+        if st is not None:
+            self._send_next_chunk(rec, st)
+
+    # receiver side ----------------------------------------------------------
+
+    def _on_peer_msg(self, node_hex: str, m: dict) -> None:
+        t = m.get("t")
+        try:
+            if t == "obj_chunk":
+                self._on_obj_chunk(node_hex, m)
+            elif t == "obj_inline":
+                self._on_obj_inline(m)
+            elif t == "pull_failed":
+                self._on_pull_failed(m)
+            elif t == "shutdown":
+                self._drop_peer(node_hex)
+            # replies (e.g. to our peer register) are ignored
+        except Exception:
+            sys.stderr.write(f"[node] peer message {t} failed:\n"
+                             + traceback.format_exc())
+
+    def _on_obj_chunk(self, node_hex: str, m: dict) -> None:
+        ob = m["object_id"]
+        st = self._pulls.get(ob)
+        if st is None:
+            return  # stale transfer (object resolved another way)
+        oid = ObjectID(ob)
+        if st["view"] is None:
+            st["size"] = m["total_size"]
+            try:
+                st["view"] = self.store._shm.create(oid, st["size"])
+            except Exception as e:
+                # arena full beyond eviction (or segment clash): fail pull
+                self._pulls.pop(ob, None)
+                self._fail_pull(oid, f"store create failed during "
+                                     f"transfer: {type(e).__name__}: {e}")
+                return
+        data = m["data"]
+        off = m["offset"]
+        st["view"][off:off + len(data)] = data
+        st["received"] += len(data)
+        conn = self._peer_conns.get(node_hex)
+        if conn is not None:
+            try:
+                conn.send({"t": "obj_chunk_ack", "object_id": ob})
+            except protocol.ConnectionClosed:
+                pass
+        if st["received"] >= st["size"]:
+            st["view"] = None   # release buffer before seal/register
+            self.store._shm.seal(oid)
+            self._pulls.pop(ob, None)
+            self.store.register(oid, st["size"])
+            info = self.objects.setdefault(oid, ObjInfo())
+            info.state = "ready"
+            info.loc = "shm"
+            info.size = st["size"]
+            self._resolve_waiters(oid, info)
+
+    def _on_obj_inline(self, m: dict) -> None:
+        ob = m["object_id"]
+        self._pulls.pop(ob, None)
+        oid = ObjectID(ob)
+        info = self.objects.setdefault(oid, ObjInfo())
+        if info.state != "pending":
+            return
+        info.state = "error" if m.get("is_error") else "ready"
+        info.loc = "inline"
+        info.data = m["data"]
+        info.size = len(m["data"])
+        info.is_error = bool(m.get("is_error"))
+        self._resolve_waiters(oid, info)
+
+    def _on_pull_failed(self, m: dict) -> None:
+        ob = m["object_id"]
+        self._pulls.pop(ob, None)
+        self._watched.discard(ob)
+        oid = ObjectID(ob)
+        attempts = self._pull_attempts.get(ob, 0) + 1
+        self._pull_attempts[ob] = attempts
+        if attempts <= 5:
+            # the location may be stale (freed/evicted+deleted); re-locate
+            self.post_later(0.2, lambda: self._ensure_remote_watch([oid]))
+        else:
+            self._fail_pull(oid, m.get("error", "pull failed"))
+
+    def _fail_pull(self, oid: ObjectID, cause: str) -> None:
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        from ray_tpu.core.client import ObjectLostError
+        self._seal_error_object(oid, ObjectLostError(
+            f"Object {oid.hex()[:16]} could not be fetched: {cause}"))
+
+    def _hh_delete_object(self, m: dict) -> None:
+        self._delete_local_object(ObjectID(m["object_id"]))
+
+    # -- node death recovery -------------------------------------------------
+
+    def _hh_node_dead(self, m: dict) -> None:
+        node_hex = m["node"]
+        self._drop_peer(node_hex)
+        self.actor_cache = {k: v for k, v in self.actor_cache.items()
+                            if v[0] != node_hex}
+        for tid, fw in list(self._fwd_tasks.items()):
+            if fw["dst"] != node_hex:
+                continue
+            self._fwd_tasks.pop(tid, None)
+            spec = fw["spec"]
+            for b in spec["return_ids"]:
+                self._fwd_by_oid.pop(b, None)
+            if fw.get("actor"):
+                # the actor may restart elsewhere, but this call's
+                # execution state died with the node
+                self._fail_task(spec, f"Actor's node {node_hex[:8]} died "
+                                      "while the method was in flight")
+            elif fw["retries"] > 0:
+                # lineage-lite: deterministic return ids mean a re-run
+                # re-creates exactly the lost objects (reference:
+                # object_recovery_manager.h reconstruction)
+                spec = dict(spec)
+                spec["max_retries"] = fw["retries"] - 1
+                self._forward_task(spec)
+            else:
+                self._fail_task(spec, f"Node {node_hex[:8]} died while "
+                                      "running forwarded task")
 
     # -- state API
 
@@ -1111,6 +1842,14 @@ class NodeService:
 
     def _h_state(self, rec, m):
         what = m["what"]
+        if what in ("nodes", "resources", "cluster_actors") \
+                and self.head_conn is not None:
+            # cluster-scope views come from the head (ray.nodes() /
+            # ray.cluster_resources() are cluster-wide in the reference)
+            fwd = dict(m)
+            fwd["what"] = {"cluster_actors": "actors"}.get(what, what)
+            self._proxy_to_head(rec, fwd)
+            return
         if what == "tasks":
             out = [{"task_id": tid.hex(), "name": tr.spec.get("name", ""),
                     "state": tr.state, "error": tr.error,
@@ -1150,22 +1889,16 @@ class NodeService:
 
     # -- disconnect handling
 
-    def _drop_client(self, rec: ClientRec) -> None:
-        if rec.closed:
-            return
-        rec.closed = True
-        try:
-            self.sel.unregister(rec.sock)
-        except (KeyError, ValueError):
-            pass
-        try:
-            rec.sock.close()
-        except OSError:
-            pass
-        self.clients.pop(rec.conn_id, None)
+    def on_client_drop(self, rec: ClientRec) -> None:
         for oid, _ts in rec.held_pins:
             self.store.unpin(oid)
         rec.held_pins.clear()
+        # drop any outbound transfers to this peer
+        for key in [k for k in self._out_transfers if k[0] == rec.conn_id]:
+            st = self._out_transfers.pop(key)
+            if st.get("view") is not None:
+                st["view"] = None
+                self.store.unpin(st["oid"])
         # fail or retry the running task (reference: worker death →
         # owner retries, task_manager.h:406)
         if rec.current_task is not None:
@@ -1201,16 +1934,14 @@ class NodeService:
                     if ar.restarts_left > 0:
                         ar.restarts_left -= 1
                     ar.state = "restarting"
-                    self._publish("actor_state", {"actor_id": ar.actor_id.hex(),
-                                                  "state": "restarting"})
+                    self._report_actor_state(ar)
                     self._place_actor(ar)
                 else:
                     ar.state = "dead"
                     ar.death_cause = f"worker process died (pid={rec.pid})"
-                    self._publish("actor_state", {"actor_id": ar.actor_id.hex(),
-                                                  "state": "dead"})
+                    self._report_actor_state(ar)
                     self._fail_actor_queue(ar, ar.death_cause)
-        if rec.kind == "driver":
+        if rec.kind == "driver" and self.stop_on_driver_exit:
             # single-driver node: driver gone → shut down
             self._stop.set()
         self._schedule()
@@ -1218,19 +1949,23 @@ class NodeService:
 
 def main() -> None:
     import argparse
-    parser = argparse.ArgumentParser(description="ray_tpu head node service")
+    parser = argparse.ArgumentParser(description="ray_tpu node service")
     parser.add_argument("--port", type=int, default=6379)
     parser.add_argument("--session", default=None)
     parser.add_argument("--session-dir", default=None)
     parser.add_argument("--num-cpus", type=float, default=None)
     parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--head-address", default=None,
+                        help="head service address; omit for standalone")
     args = parser.parse_args()
     import uuid
     session = args.session or uuid.uuid4().hex
     session_dir = args.session_dir or os.path.join(
         "/tmp/ray_tpu", f"session_{session[:8]}")
     svc = NodeService(RayTpuConfig(), session, session_dir, port=args.port,
-                      num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+                      num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                      head_address=args.head_address,
+                      stop_on_driver_exit=args.head_address is None)
     print(f"ray_tpu node service listening on {svc.address} "
           f"(session {session})", flush=True)
     try:

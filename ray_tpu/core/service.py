@@ -1,0 +1,309 @@
+"""Shared event-loop service base for the head and node services.
+
+Single-threaded selector loop, framed-pickle connections, posted-callback
+injection from other threads, and reqid-correlated RPC in BOTH directions:
+incoming requests dispatch to ``_h_<type>`` handlers; incoming
+``{"t": "reply"}`` frames resolve callbacks registered with ``_rpc``.
+All state mutation happens on the loop thread.
+
+The reference splits this substrate across its gRPC services
+(src/ray/rpc/grpc_server.h, client_call.h); here one loop per service is
+enough because bulk data rides the shared-memory plane, not this one.
+"""
+
+from __future__ import annotations
+
+import pickle
+import selectors
+import socket
+import struct
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ray_tpu.core.ids import ActorID
+from ray_tpu.core.protocol import dumps_frame
+
+_HDR = struct.Struct("<Q")
+
+
+@dataclass
+class ClientRec:
+    conn_id: int
+    sock: socket.socket
+    kind: str = ""               # driver | worker | tpu_executor | node | peer
+    worker_id: str = ""
+    pid: int = 0
+    tpu: bool = False            # may execute TPU tasks
+    state: str = "idle"          # idle | busy | blocked
+    current_task: Optional[bytes] = None
+    dedicated_actor: Optional[ActorID] = None
+    rbuf: bytearray = field(default_factory=bytearray)
+    wbuf: bytearray = field(default_factory=bytearray)
+    held_pins: list = field(default_factory=list)
+    closed: bool = False
+    node_hex: str = ""           # for kind in (node, peer): peer node id
+
+
+class EventLoopService:
+    """Base: listener + selector loop + push/reply plumbing."""
+
+    name = "service"
+
+    def __init__(self, listen_host: str = "127.0.0.1", port: int = 0):
+        self.sel = selectors.DefaultSelector()
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind((listen_host, port))
+        self.listener.listen(512)
+        self.listener.setblocking(False)
+        self.address = "%s:%d" % self.listener.getsockname()
+        self.sel.register(self.listener, selectors.EVENT_READ, None)
+
+        self._next_conn = 0
+        self.clients: dict[int, ClientRec] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._posted: deque = deque()
+        self._posted_lock = threading.Lock()
+        self._last_tick = 0.0
+        self.tick_interval = 0.25
+        # outbound RPC correlation: reqid -> callback(reply_msg)
+        self._rpc_seq = 0
+        self._rpc_pending: dict[int, Callable[[dict], None]] = {}
+
+    # ------------------------------------------------------------ threading
+
+    def post(self, fn) -> None:
+        with self._posted_lock:
+            self._posted.append(fn)
+
+    def post_later(self, delay: float, fn) -> None:
+        t = threading.Timer(delay, lambda: self.post(fn))
+        t.daemon = True
+        t.start()
+
+    def start_thread(self) -> None:
+        self._thread = threading.Thread(target=self.run,
+                                        name=f"raytpu-{self.name}",
+                                        daemon=True)
+        self._thread.start()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            while True:
+                with self._posted_lock:
+                    if not self._posted:
+                        break
+                    fn = self._posted.popleft()
+                try:
+                    fn()
+                except Exception:
+                    sys.stderr.write(f"[{self.name}] posted callback "
+                                     "failed:\n" + traceback.format_exc())
+            now = time.monotonic()
+            if now - self._last_tick > self.tick_interval:
+                self._last_tick = now
+                try:
+                    self.on_tick()
+                except Exception:
+                    sys.stderr.write(f"[{self.name}] tick error:\n"
+                                     + traceback.format_exc())
+            try:
+                events = self.sel.select(timeout=0.05)
+            except OSError:
+                continue
+            for key, mask in events:
+                if key.data is None:
+                    self._accept()
+                else:
+                    rec: ClientRec = key.data
+                    try:
+                        if mask & selectors.EVENT_READ:
+                            self._on_readable(rec)
+                        if mask & selectors.EVENT_WRITE:
+                            self._on_writable(rec)
+                    except Exception:
+                        sys.stderr.write(f"[{self.name}] connection handler "
+                                         "error:\n" + traceback.format_exc())
+                        try:
+                            self._drop_client(rec)
+                        except Exception:
+                            sys.stderr.write(f"[{self.name}] drop_client "
+                                             "error:\n"
+                                             + traceback.format_exc())
+        self._cleanup()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if (self._thread is not None
+                and self._thread is not threading.current_thread()):
+            self._thread.join(timeout=5)
+
+    # hooks -----------------------------------------------------------------
+
+    def on_tick(self) -> None:
+        pass
+
+    def on_client_drop(self, rec: ClientRec) -> None:
+        pass
+
+    def _cleanup(self) -> None:
+        for rec in list(self.clients.values()):
+            try:
+                self._push(rec, {"t": "shutdown"})
+                self._flush(rec)
+            except Exception:
+                pass
+        for rec in list(self.clients.values()):
+            try:
+                rec.sock.close()
+            except OSError:
+                pass
+        self.listener.close()
+        self.sel.close()
+
+    # ----------------------------------------------------------------- io
+
+    def _accept(self) -> None:
+        try:
+            sock, _ = self.listener.accept()
+        except OSError:
+            return
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._next_conn += 1
+        rec = ClientRec(conn_id=self._next_conn, sock=sock)
+        self.clients[rec.conn_id] = rec
+        self.sel.register(sock, selectors.EVENT_READ, rec)
+
+    def _on_readable(self, rec: ClientRec) -> None:
+        try:
+            data = rec.sock.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop_client(rec)
+            return
+        if not data:
+            self._drop_client(rec)
+            return
+        rec.rbuf += data
+        while True:
+            if len(rec.rbuf) < _HDR.size:
+                break
+            (n,) = _HDR.unpack_from(rec.rbuf)
+            if len(rec.rbuf) < _HDR.size + n:
+                break
+            frame = bytes(rec.rbuf[_HDR.size:_HDR.size + n])
+            del rec.rbuf[:_HDR.size + n]
+            msg = pickle.loads(frame)
+            self._dispatch(rec, msg)
+
+    def _on_writable(self, rec: ClientRec) -> None:
+        if rec.wbuf:
+            try:
+                sent = rec.sock.send(rec.wbuf)
+                del rec.wbuf[:sent]
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._drop_client(rec)
+                return
+        if not rec.wbuf:
+            self.sel.modify(rec.sock, selectors.EVENT_READ, rec)
+
+    def _push(self, rec: ClientRec, msg: dict) -> None:
+        if rec.closed:
+            return
+        frame = dumps_frame(msg)
+        if rec.wbuf:
+            rec.wbuf += frame
+            return
+        try:
+            sent = rec.sock.send(frame)
+        except (BlockingIOError, InterruptedError):
+            sent = 0
+        except OSError:
+            self._drop_client(rec)
+            return
+        if sent < len(frame):
+            rec.wbuf += frame[sent:]
+            try:
+                self.sel.modify(rec.sock,
+                                selectors.EVENT_READ | selectors.EVENT_WRITE,
+                                rec)
+            except KeyError:
+                pass
+
+    def _flush(self, rec: ClientRec) -> None:
+        rec.sock.setblocking(True)
+        if rec.wbuf:
+            try:
+                rec.sock.sendall(bytes(rec.wbuf))
+            except OSError:
+                pass
+            rec.wbuf.clear()
+
+    def _reply(self, rec: ClientRec, reqid: int, **kw) -> None:
+        kw["t"] = "reply"
+        kw["reqid"] = reqid
+        self._push(rec, kw)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch(self, rec: ClientRec, msg: dict) -> None:
+        if msg.get("t") == "reply":
+            cb = self._rpc_pending.pop(msg.get("reqid"), None)
+            if cb is not None:
+                try:
+                    cb(msg)
+                except Exception:
+                    sys.stderr.write(f"[{self.name}] rpc callback failed:\n"
+                                     + traceback.format_exc())
+            return
+        handler = getattr(self, "_h_" + msg["t"], None)
+        if handler is None:
+            if "reqid" in msg:
+                self._reply(rec, msg["reqid"],
+                            error=f"unknown message {msg['t']}")
+            return
+        try:
+            handler(rec, msg)
+        except Exception:
+            tb = traceback.format_exc()
+            sys.stderr.write(f"[{self.name}] handler {msg['t']} "
+                             f"failed:\n{tb}")
+            if "reqid" in msg:
+                self._reply(rec, msg["reqid"], error=tb)
+
+    def _rpc(self, rec: ClientRec, msg: dict,
+             cb: Optional[Callable[[dict], None]] = None) -> None:
+        """Push a request to a connected peer; `cb(reply)` runs on the
+        loop thread when the peer answers with {"t": "reply"}."""
+        if cb is not None:
+            self._rpc_seq += 1
+            msg["reqid"] = self._rpc_seq
+            self._rpc_pending[self._rpc_seq] = cb
+        self._push(rec, msg)
+
+    # -------------------------------------------------------- disconnect
+
+    def _drop_client(self, rec: ClientRec) -> None:
+        if rec.closed:
+            return
+        rec.closed = True
+        try:
+            self.sel.unregister(rec.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            rec.sock.close()
+        except OSError:
+            pass
+        self.clients.pop(rec.conn_id, None)
+        self.on_client_drop(rec)

@@ -235,9 +235,17 @@ class Tuner:
         callbacks = list(self.run_config.callbacks)
         stop_criteria = self.run_config.stop or {}
         for cb in callbacks:
+            import inspect
             try:
+                params = inspect.signature(cb.setup).parameters
+                takes_restored = ("restored" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()))
+            except (TypeError, ValueError):
+                takes_restored = False
+            if takes_restored:
                 cb.setup(run_dir, restored=bool(self._restored))
-            except TypeError:  # user callback with the pre-r2 signature
+            else:  # user callback with the pre-r2 signature
                 cb.setup(run_dir)
 
         trials: list[Trial] = []
