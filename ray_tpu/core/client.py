@@ -159,13 +159,18 @@ class NodeClient:
                        is_error: bool = False) -> int:
         size = so.total_bytes()
         inline_limit = self.config_dict["max_direct_call_object_size"]
+        # nested refs: the node must keep the inner objects alive while
+        # the outer object exists (reference: reference_count.h borrower
+        # tracking, scoped to container-holds-ref here)
+        nested = [r.binary() for r in so.nested_refs]
         # Fire-and-forget: same-socket ordering guarantees the node sees the
         # put before any later get/submit from this process (reference: Put
         # is async in CoreWorker too, core_worker.h:500).
         if size <= inline_limit or is_error:
             self.send({"t": "put_inline", "object_id": object_id.binary(),
                        "data": so.to_bytes(), "is_error": is_error,
-                       "owner": owner or self.worker_id})
+                       "owner": owner or self.worker_id,
+                       "nested_refs": nested})
         else:
             try:
                 buf = self.shm.create(object_id, size)
@@ -176,7 +181,8 @@ class NodeClient:
                 pass  # identical value already stored (retried put)
             self.send({"t": "register_object",
                        "object_id": object_id.binary(), "size": size,
-                       "owner": owner or self.worker_id})
+                       "owner": owner or self.worker_id,
+                       "nested_refs": nested})
         return size
 
     def get_objects(self, object_ids: list[ObjectID],

@@ -117,15 +117,18 @@ class Executor:
     def _store_returns(self, spec: dict, result: Any) -> None:
         return_ids = [ObjectID(b) for b in spec["return_ids"]]
         num_returns = spec.get("num_returns", 1)
+        # returns are OWNED by the submitter (spec["owner"]), not this
+        # executor — its release_refs must be able to reclaim them
+        owner = spec.get("owner") or self.client.worker_id
         if num_returns == "dynamic":
             refs = []
             task_id = TaskID(spec["task_id"])
             for i, item in enumerate(result):
                 oid = ObjectID.for_task_return(task_id, i + 2)
-                self.client.put_object(oid, item, owner=self.client.worker_id)
-                refs.append(ObjectRef(oid, owner=self.client.worker_id))
+                self.client.put_object(oid, item, owner=owner)
+                refs.append(ObjectRef(oid, owner=owner))
             self.client.put_object(return_ids[0], ObjectRefGenerator(refs),
-                                   owner=self.client.worker_id)
+                                   owner=owner)
             return
         if num_returns == 0:
             return
@@ -138,7 +141,7 @@ class Executor:
                     f"Task declared num_returns={num_returns} but returned "
                     f"{len(outs)} values")
         for oid, val in zip(return_ids, outs):
-            self.client.put_object(oid, val, owner=self.client.worker_id)
+            self.client.put_object(oid, val, owner=owner)
 
     def _store_error(self, spec: dict, exc: BaseException, tb: str) -> None:
         err = TaskError(exc, tb) if not isinstance(exc, TaskError) else exc

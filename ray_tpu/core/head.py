@@ -30,7 +30,8 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._config import RayTpuConfig
-from ray_tpu.core.service import ClientRec, EventLoopService
+from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
+                                  EventLoopService)
 
 
 @dataclass
@@ -66,7 +67,7 @@ class PGDir:
     state: str = "created"
 
 
-class HeadService(EventLoopService):
+class HeadService(ClusterStoreMixin, EventLoopService):
     name = "head"
 
     def __init__(self, config: RayTpuConfig, session: str,
@@ -80,10 +81,7 @@ class HeadService(EventLoopService):
         self._node_by_conn: dict[int, str] = {}
         self.actors: dict[bytes, ActorDir] = {}
         self.named_actors: dict[tuple[str, str], bytes] = {}
-        self.kv: dict[tuple[str, bytes], bytes] = {}
-        self.functions: dict[str, bytes] = {}
-        self._fn_waiters: dict[str, list] = {}   # fid -> [(conn_id, reqid)]
-        self.pubsub: dict[str, set[int]] = {}
+        self._init_stores()   # kv / pubsub / function store (mixin)
         self.object_locs: dict[bytes, set[str]] = {}
         self.obj_watchers: dict[bytes, set[str]] = {}
         self.pgs: dict[bytes, PGDir] = {}
@@ -452,70 +450,7 @@ class HeadService(EventLoopService):
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
 
-    # ----------------------------------------------------------- kv / pubsub
-
-    def _h_kv_put(self, rec: ClientRec, m: dict) -> None:
-        key = (m.get("namespace") or "default", m["key"])
-        if m.get("overwrite", True) or key not in self.kv:
-            self.kv[key] = m["value"]
-            added = True
-        else:
-            added = False
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], added=added)
-
-    def _h_kv_get(self, rec: ClientRec, m: dict) -> None:
-        self._reply(rec, m["reqid"],
-                    value=self.kv.get((m.get("namespace") or "default",
-                                       m["key"])))
-
-    def _h_kv_del(self, rec: ClientRec, m: dict) -> None:
-        existed = self.kv.pop((m.get("namespace") or "default", m["key"]),
-                              None) is not None
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], deleted=existed)
-
-    def _h_kv_keys(self, rec: ClientRec, m: dict) -> None:
-        ns = m.get("namespace") or "default"
-        prefix = m.get("prefix", b"")
-        self._reply(rec, m["reqid"],
-                    keys=[k for (n, k) in self.kv
-                          if n == ns and k.startswith(prefix)])
-
-    def _h_subscribe(self, rec: ClientRec, m: dict) -> None:
-        self.pubsub.setdefault(m["channel"], set()).add(rec.conn_id)
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _h_publish(self, rec: ClientRec, m: dict) -> None:
-        self._publish(m["channel"], m["data"])
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _publish(self, channel: str, data) -> None:
-        for conn_id in list(self.pubsub.get(channel, ())):
-            c = self.clients.get(conn_id)
-            if c is not None:
-                self._push(c, {"t": "pub", "channel": channel, "data": data})
-
-    # ------------------------------------------------------------ functions
-
-    def _h_register_function(self, rec: ClientRec, m: dict) -> None:
-        self.functions[m["function_id"]] = m["pickled"]
-        for conn_id, reqid in self._fn_waiters.pop(m["function_id"], []):
-            c = self.clients.get(conn_id)
-            if c is not None:
-                self._reply(c, reqid, pickled=m["pickled"])
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _h_fetch_function(self, rec: ClientRec, m: dict) -> None:
-        fid = m["function_id"]
-        if fid in self.functions:
-            self._reply(rec, m["reqid"], pickled=self.functions[fid])
-        else:
-            self._fn_waiters.setdefault(fid, []).append(
-                (rec.conn_id, m["reqid"]))
+    # kv / pubsub / function store: inherited from ClusterStoreMixin
 
     # ------------------------------------------------------ placement groups
 

@@ -51,7 +51,8 @@ from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
 from ray_tpu.core.object_store import (NativeObjectStoreCore,
                                        make_object_store_core)
-from ray_tpu.core.service import ClientRec, EventLoopService
+from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
+                                  EventLoopService)
 
 # ---------------------------------------------------------------------------
 # records
@@ -66,6 +67,7 @@ class ObjInfo:
     owner: str = ""
     is_error: bool = False
     loc_reported: bool = False   # location pushed to the head
+    nested: tuple = ()           # ids this object's value embeds refs to
     wait_waiters: list = field(default_factory=list)
 
 
@@ -115,7 +117,7 @@ def _wire_spec(spec: dict) -> dict:
             if not k.startswith("_") and k != "submitter"}
 
 
-class NodeService(EventLoopService):
+class NodeService(ClusterStoreMixin, EventLoopService):
     name = "node"
 
     def __init__(self, config: RayTpuConfig, session: str,
@@ -156,15 +158,12 @@ class NodeService(EventLoopService):
         self.dep_waiting: dict[ObjectID, list] = {}  # oid -> waiting specs
         self.actors: dict[ActorID, ActorRec] = {}
         self.named_actors: dict[tuple[str, str], ActorID] = {}
-        self.kv: dict[tuple[str, bytes], bytes] = {}
-        self.functions: dict[str, bytes] = {}
-        self.pubsub: dict[str, set[int]] = {}
+        self._init_stores()   # kv / pubsub / function store (mixin)
         self.pgs: dict[PlacementGroupID, PGRec] = {}
         self.pg_available: dict[tuple[bytes, int], dict] = {}  # (pg,bundle)->free
         self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
         self._spawning = 0
         self._worker_procs: list[subprocess.Popen] = []
-        self._fn_waiters: dict[str, list] = {}
         # Batched-get bookkeeping: (conn_id, reqid) -> {ids, remaining}.
         self._multigets: dict[tuple, dict] = {}
         self._mg_by_oid: dict[ObjectID, set] = {}
@@ -190,6 +189,8 @@ class NodeService(EventLoopService):
         self._fwd_by_oid: dict[bytes, bytes] = {}      # return oid -> task_id
         self._pg_prepared: dict[tuple, dict] = {}      # (pg,idx) -> bundle
         self._pg_bundles: dict[tuple, dict] = {}       # committed originals
+        self._released_wait: set[ObjectID] = set()     # owner-released oids
+        self._nested_count: dict[bytes, int] = {}      # id -> container holds
 
         self._last_hb = 0.0
         self._hb_period = config.heartbeat_period_ms / 1000.0
@@ -206,6 +207,7 @@ class NodeService(EventLoopService):
         # re-evaluates worker-pool health (dead spawns etc.)
         self._schedule()
         self._expire_stale_pins()
+        self._sweep_released()
         self._heartbeat()
 
     def _cleanup(self) -> None:
@@ -379,8 +381,11 @@ class NodeService(EventLoopService):
         info.loc = "inline"
         info.data = m["data"]
         info.size = len(m["data"])
-        info.owner = m.get("owner", rec.worker_id)
+        # ownership set at submit time wins (the submitter owns task
+        # returns, even when an executor stores them)
+        info.owner = info.owner or m.get("owner", rec.worker_id)
         info.is_error = bool(m.get("is_error"))
+        self._track_nested(info, m.get("nested_refs"))
         self._resolve_waiters(oid, info)
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
@@ -391,7 +396,8 @@ class NodeService(EventLoopService):
         info.state = "ready"
         info.loc = "shm"
         info.size = m["size"]
-        info.owner = m.get("owner", rec.worker_id)
+        info.owner = info.owner or m.get("owner", rec.worker_id)
+        self._track_nested(info, m.get("nested_refs"))
         self.store.register(oid, m["size"])
         self._resolve_waiters(oid, info)
         if "reqid" in m:
@@ -560,6 +566,28 @@ class NodeService(EventLoopService):
         info.is_error = True
         self._resolve_waiters(oid, info)
 
+    def _track_nested(self, info: ObjInfo, nested) -> None:
+        """Record ids embedded in this object's value so their storage
+        outlives the owner's release while the container exists."""
+        if not nested or info.nested:
+            return   # guard against double-count on a retried put
+        info.nested = tuple(nested)
+        for nb in info.nested:
+            self._nested_count[nb] = self._nested_count.get(nb, 0) + 1
+
+    def _forget_object(self, oid: ObjectID) -> None:
+        """Single removal point: drop the entry, its storage, and its
+        holds on nested ids."""
+        info = self.objects.pop(oid, None)
+        self.store.delete(oid)
+        if info is not None and info.nested:
+            for nb in info.nested:
+                c = self._nested_count.get(nb, 0) - 1
+                if c > 0:
+                    self._nested_count[nb] = c
+                else:
+                    self._nested_count.pop(nb, None)
+
     def _delete_local_object(self, oid: ObjectID) -> None:
         info = self.objects.get(oid)
         if info is not None and (info.state == "pending"
@@ -569,8 +597,7 @@ class NodeService(EventLoopService):
             # fail anyone blocked on it before it vanishes
             self._seal_error_object(
                 oid, RuntimeError(f"Object {oid.hex()[:16]} was freed"))
-        self.objects.pop(oid, None)
-        self.store.delete(oid)
+        self._forget_object(oid)
 
     def _h_free_objects(self, rec, m):
         for b in m["object_ids"]:
@@ -588,11 +615,91 @@ class NodeService(EventLoopService):
         self._reply(rec, m["reqid"], stats=self.store.stats(),
                     num_objects=len(self.objects))
 
+    # -- automatic object lifetime (owner-based release) --------------------
+
+    def _h_release_refs(self, rec, m):
+        """The owning process dropped its last local ref to these objects
+        — reclaim their storage once nothing on this node still needs
+        them (reference: reference_count.h owner-count-zero → delete;
+        borrower chains are out of scope, so non-owner releases are
+        ignored rather than trusted)."""
+        for b in m["object_ids"]:
+            oid = ObjectID(b)
+            info = self.objects.get(oid)
+            if info is None:
+                continue
+            if info.owner and info.owner != rec.worker_id:
+                continue
+            self._released_wait.add(oid)
+        self._sweep_released()
+
+    def _args_in_flight(self) -> set:
+        """Object ids still referenced as args by queued or running work
+        on this node — storage for these must survive the owner's
+        release until the work completes."""
+        s: set = set()
+        for q in (self.runnable_cpu, self.runnable_tpu):
+            for spec in q:
+                s.update(spec.get("arg_ids", ()))
+        for specs in self.dep_waiting.values():
+            for spec in specs:
+                s.update(spec.get("arg_ids", ()))
+        for ar in self.actors.values():
+            for spec in ar.queue:
+                s.update(spec.get("arg_ids", ()))
+            for spec in ar.running.values():
+                s.update(spec.get("arg_ids", ()))
+        for tr in self.tasks.values():
+            if tr.state == "running":
+                s.update(tr.spec.get("arg_ids", ()))
+        # forwarded work: the destination node still has to PULL these
+        # args from us — our copy must outlive the forward
+        for fw in self._fwd_tasks.values():
+            s.update(fw["spec"].get("arg_ids", ()))
+        for specs in self._awaiting_actor.values():
+            for spec in specs:
+                s.update(spec.get("arg_ids", ()))
+        return s
+
+    def _sweep_released(self) -> None:
+        if not self._released_wait:
+            return
+        in_flight = self._args_in_flight()
+        freed: list[bytes] = []
+        for oid in list(self._released_wait):
+            info = self.objects.get(oid)
+            if info is None:
+                self._released_wait.discard(oid)
+                continue
+            if info.state == "pending":
+                continue   # producing task still running; re-checked later
+            if oid.binary() in in_flight:
+                continue
+            if oid in self._mg_by_oid or info.wait_waiters:
+                continue
+            if self._nested_count.get(oid.binary(), 0) > 0:
+                continue   # a stored container still embeds this ref
+            if info.loc == "shm":
+                e = self.store.entries.get(oid)
+                if e is not None and e.pin_count > 0:
+                    continue   # a get/transfer is mapping it right now
+            self._released_wait.discard(oid)
+            self._forget_object(oid)
+            freed.append(oid.binary())
+        if freed and self.head_conn is not None:
+            # replicas pulled to other nodes die with the owner's copy
+            try:
+                self.head_conn.send({"t": "free_objects",
+                                     "object_ids": freed})
+            except protocol.ConnectionClosed:
+                self._head_lost()
+
     # -- functions
 
     def _h_register_function(self, rec, m):
         self._store_function(m["function_id"], m["pickled"])
         if self.head_conn is not None:
+            # cluster-wide export so any node's workers can fetch it
             try:
                 self.head_conn.send({"t": "register_function",
                                      "function_id": m["function_id"],
@@ -601,13 +708,6 @@ class NodeService(EventLoopService):
                 self._head_lost()
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
-
-    def _store_function(self, fid: str, pickled: bytes) -> None:
-        self.functions[fid] = pickled
-        for conn_id, reqid in self._fn_waiters.pop(fid, []):
-            w = self.clients.get(conn_id)
-            if w is not None:
-                self._reply(w, reqid, pickled=pickled)
 
     def _h_fetch_function(self, rec, m):
         fid = m["function_id"]
@@ -619,11 +719,18 @@ class NodeService(EventLoopService):
         if first and self.head_conn is not None:
             # the head parks the fetch until some node registers the
             # function (functions are exported once, cluster-wide)
-            self._head_rpc(
-                {"t": "fetch_function", "function_id": fid},
-                lambda reply: (reply.get("pickled")
-                               and self._store_function(fid,
-                                                        reply["pickled"])))
+            def cb(reply):
+                if reply.get("pickled"):
+                    self._store_function(fid, reply["pickled"])
+                elif reply.get("error"):
+                    # head gone: fail waiters instead of hanging workers
+                    for conn_id, reqid in self._fn_waiters.pop(fid, []):
+                        w = self.clients.get(conn_id)
+                        if w is not None:
+                            self._reply(w, reqid,
+                                        error="function fetch failed: "
+                                              f"{reply['error']}")
+            self._head_rpc({"t": "fetch_function", "function_id": fid}, cb)
 
     # -- tasks
 
@@ -638,7 +745,8 @@ class NodeService(EventLoopService):
         tr = TaskRec(spec=spec, retries_left=spec.get("max_retries", 0))
         self.tasks[spec["task_id"]] = tr
         for b in spec["return_ids"]:
-            self.objects.setdefault(ObjectID(b), ObjInfo())
+            info = self.objects.setdefault(ObjectID(b), ObjInfo())
+            info.owner = info.owner or spec.get("owner", "")
         self._record_event(spec, "PENDING")
         self._enqueue_task(spec)
 
@@ -1083,7 +1191,8 @@ class NodeService(EventLoopService):
         actor_id = ActorID(spec["actor_id"])
         ar = self.actors.get(actor_id)
         for b in spec["return_ids"]:
-            self.objects.setdefault(ObjectID(b), ObjInfo())
+            info = self.objects.setdefault(ObjectID(b), ObjInfo())
+            info.owner = info.owner or spec.get("owner", "")
         self.tasks[spec["task_id"]] = TaskRec(spec=spec)
         self._record_event(spec, "PENDING")
         if ar is not None:
@@ -1175,7 +1284,8 @@ class NodeService(EventLoopService):
         spec["_routed"] = True
         actor_id = ActorID(spec["actor_id"])
         for b in spec["return_ids"]:
-            self.objects.setdefault(ObjectID(b), ObjInfo())
+            info = self.objects.setdefault(ObjectID(b), ObjInfo())
+            info.owner = info.owner or spec.get("owner", "")
         self.tasks[spec["task_id"]] = TaskRec(spec=spec)
         self._record_event(spec, "PENDING")
         ar = self.actors.get(actor_id)
@@ -1415,45 +1525,28 @@ class NodeService(EventLoopService):
         if self.head_conn is not None:
             self._proxy_to_head(rec, m)
             return
-        key = (m.get("namespace") or "default", m["key"])
-        if m.get("overwrite", True) or key not in self.kv:
-            self.kv[key] = m["value"]
-            added = True
-        else:
-            added = False
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], added=added)
+        super()._h_kv_put(rec, m)
 
     def _h_kv_get(self, rec, m):
         if self.head_conn is not None:
             self._proxy_to_head(rec, m)
             return
-        self._reply(rec, m["reqid"],
-                    value=self.kv.get((m.get("namespace") or "default",
-                                       m["key"])))
+        super()._h_kv_get(rec, m)
 
     def _h_kv_del(self, rec, m):
         if self.head_conn is not None:
             self._proxy_to_head(rec, m)
             return
-        existed = self.kv.pop((m.get("namespace") or "default", m["key"]),
-                              None) is not None
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], deleted=existed)
+        super()._h_kv_del(rec, m)
 
     def _h_kv_keys(self, rec, m):
         if self.head_conn is not None:
             self._proxy_to_head(rec, m)
             return
-        ns = m.get("namespace") or "default"
-        prefix = m.get("prefix", b"")
-        self._reply(rec, m["reqid"],
-                    keys=[k for (n, k) in self.kv if n == ns
-                          and k.startswith(prefix)])
+        super()._h_kv_keys(rec, m)
 
     def _h_subscribe(self, rec, m):
         ch = m["channel"]
-        self.pubsub.setdefault(ch, set()).add(rec.conn_id)
         if self.head_conn is not None and ch not in self._head_subs:
             # subscribe this NODE at the head once per channel; local
             # clients fan out from the node (reference: pubsub long-poll
@@ -1463,13 +1556,7 @@ class NodeService(EventLoopService):
                 self.head_conn.send({"t": "subscribe", "channel": ch})
             except protocol.ConnectionClosed:
                 self._head_lost()
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _h_publish(self, rec, m):
-        self._publish(m["channel"], m["data"])
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
+        super()._h_subscribe(rec, m)
 
     def _publish(self, channel: str, data: Any) -> None:
         if self.head_conn is not None:
@@ -1482,12 +1569,6 @@ class NodeService(EventLoopService):
             except protocol.ConnectionClosed:
                 self._head_lost()
         self._publish_local(channel, data)
-
-    def _publish_local(self, channel: str, data: Any) -> None:
-        for conn_id in list(self.pubsub.get(channel, ())):
-            w = self.clients.get(conn_id)
-            if w is not None:
-                self._push(w, {"t": "pub", "channel": channel, "data": data})
 
     def _hh_pub(self, m: dict) -> None:
         self._publish_local(m["channel"], m["data"])
@@ -1644,7 +1725,10 @@ class NodeService(EventLoopService):
                 conn.send({"t": "pull_object", "object_id": ob})
             except protocol.ConnectionClosed:
                 self._pulls.pop(ob, None)
+                self._watched.discard(ob)
                 self._drop_peer(node_hex)
+                self.post_later(0.2,
+                                lambda: self._ensure_remote_watch([oid]))
         self._peer_conn_async(node_hex, address, go)
 
     # sender side -----------------------------------------------------------

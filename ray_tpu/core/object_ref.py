@@ -10,9 +10,84 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
-from typing import Any, Optional
+import threading
+from typing import Any, Callable, Optional
 
 from ray_tpu.core.ids import ObjectID
+
+
+class _RefTracker:
+    """Process-local half of distributed refcounting (scoped-down
+    reference: core_worker/reference_count.h:61 — local counts here;
+    the node releases storage when the OWNER's count drains; borrower
+    chains and lineage are out of scope for v1).
+
+    Counts ObjectRef constructions/destructions per object id and, when
+    an id's count hits zero, batches a ``release_refs`` notification to
+    the node through the sink installed by the runtime."""
+
+    _FLUSH_BATCH = 64
+    _FLUSH_DELAY = 0.5
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[bytes, int] = {}
+        self._pending: list[bytes] = []
+        self._sink: Optional[Callable[[list], None]] = None
+        self._timer: Optional[threading.Timer] = None
+
+    def set_sink(self, sink: Optional[Callable[[list], None]]) -> None:
+        with self._lock:
+            self._sink = sink
+
+    def incref(self, ob: bytes) -> None:
+        with self._lock:
+            self._counts[ob] = self._counts.get(ob, 0) + 1
+
+    def decref(self, ob: bytes) -> None:
+        flush = False
+        with self._lock:
+            c = self._counts.get(ob)
+            if c is None:
+                return
+            if c <= 1:
+                del self._counts[ob]
+                if self._sink is not None:
+                    self._pending.append(ob)
+                    flush = len(self._pending) >= self._FLUSH_BATCH
+                    if not flush and self._timer is None:
+                        self._timer = threading.Timer(self._FLUSH_DELAY,
+                                                      self.flush)
+                        self._timer.daemon = True
+                        self._timer.start()
+            else:
+                self._counts[ob] = c - 1
+        if flush:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            batch, self._pending = self._pending, []
+            sink = self._sink
+        if sink is not None and batch:
+            try:
+                sink(batch)
+            except Exception:
+                pass   # connection racing shutdown: storage dies with it
+
+    def held_count(self, ob: bytes) -> int:
+        with self._lock:
+            return self._counts.get(ob, 0)
+
+
+_tracker = _RefTracker()
+
+
+def get_tracker() -> _RefTracker:
+    return _tracker
 
 
 class ObjectRef:
@@ -21,6 +96,13 @@ class ObjectRef:
     def __init__(self, object_id: ObjectID, owner: Optional[str] = None):
         self._id = object_id
         self._owner = owner  # worker id string of the owner process
+        _tracker.incref(object_id.binary())
+
+    def __del__(self):
+        try:
+            _tracker.decref(self._id.binary())
+        except Exception:
+            pass   # interpreter teardown
 
     @property
     def id(self) -> ObjectID:

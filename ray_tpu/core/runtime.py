@@ -82,6 +82,11 @@ class Runtime:
         self._serde = get_context()
         self._futures_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="raytpu-future")
+        # distributed refcount: when this process's last ref to an object
+        # dies, tell the node so the owner's storage can be reclaimed
+        # (reference: reference_count.h local-count half)
+        from ray_tpu.core.object_ref import get_tracker
+        get_tracker().set_sink(self._release_refs)
         # driver-owned helpers (populated by init())
         self.node_service = None
         self.tpu_executor_client: Optional[NodeClient] = None
@@ -160,6 +165,9 @@ class Runtime:
             "num_tpus": num_tpus,
             "max_retries": max_retries,
             "placement_group": placement_group,
+            # the SUBMITTER owns the returns (reference: ownership model,
+            # core_worker.h — the caller, not the executor, owns results)
+            "owner": self.client.worker_id,
         }
         self._prepare_args(args, kwargs, spec)
         self.client.send({"t": "submit_task", "spec": spec})
@@ -220,6 +228,7 @@ class Runtime:
             "seq": seq,
             "num_returns": num_returns,
             "return_ids": [o.binary() for o in return_ids],
+            "owner": self.client.worker_id,
         }
         self._prepare_args(args, kwargs, spec)
         self.client.send({"t": "submit_actor_task", "spec": spec})
@@ -261,9 +270,20 @@ class Runtime:
         return self._futures_pool.submit(
             lambda: self.client.get_objects([ref.id])[0])
 
+    def _release_refs(self, object_ids: list) -> None:
+        if not self.client.closed:
+            self.client.send({"t": "release_refs",
+                              "object_ids": object_ids})
+
     # ----------------------------------------------------------- shutdown
 
     def shutdown(self) -> None:
+        from ray_tpu.core.object_ref import get_tracker
+        try:
+            get_tracker().flush()
+        except Exception:
+            pass
+        get_tracker().set_sink(None)
         try:
             self._futures_pool.shutdown(wait=False)
         except Exception:

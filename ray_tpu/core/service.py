@@ -307,3 +307,94 @@ class EventLoopService:
             pass
         self.clients.pop(rec.conn_id, None)
         self.on_client_drop(rec)
+
+
+class ClusterStoreMixin:
+    """KV store, pubsub fan-out, and function store — identical local
+    semantics on the head (cluster scope) and on a standalone node
+    (single-node scope), so both inherit one implementation
+    (reference: gcs_kv_manager.cc, gcs pubsub, function_manager.py).
+
+    The node overrides these handlers to proxy to the head in cluster
+    mode; `_publish` is defined per-class (the node routes cluster-wide
+    publishes through the head)."""
+
+    def _init_stores(self) -> None:
+        self.kv: dict[tuple[str, bytes], bytes] = {}
+        self.pubsub: dict[str, set[int]] = {}
+        self.functions: dict[str, bytes] = {}
+        self._fn_waiters: dict[str, list] = {}
+
+    # -- kv
+
+    def _h_kv_put(self, rec: ClientRec, m: dict) -> None:
+        key = (m.get("namespace") or "default", m["key"])
+        if m.get("overwrite", True) or key not in self.kv:
+            self.kv[key] = m["value"]
+            added = True
+        else:
+            added = False
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], added=added)
+
+    def _h_kv_get(self, rec: ClientRec, m: dict) -> None:
+        self._reply(rec, m["reqid"],
+                    value=self.kv.get((m.get("namespace") or "default",
+                                       m["key"])))
+
+    def _h_kv_del(self, rec: ClientRec, m: dict) -> None:
+        existed = self.kv.pop((m.get("namespace") or "default", m["key"]),
+                              None) is not None
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], deleted=existed)
+
+    def _h_kv_keys(self, rec: ClientRec, m: dict) -> None:
+        ns = m.get("namespace") or "default"
+        prefix = m.get("prefix", b"")
+        self._reply(rec, m["reqid"],
+                    keys=[k for (n, k) in self.kv
+                          if n == ns and k.startswith(prefix)])
+
+    # -- pubsub
+
+    def _h_subscribe(self, rec: ClientRec, m: dict) -> None:
+        self.pubsub.setdefault(m["channel"], set()).add(rec.conn_id)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_publish(self, rec: ClientRec, m: dict) -> None:
+        self._publish(m["channel"], m["data"])
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _publish_local(self, channel: str, data: Any) -> None:
+        for conn_id in list(self.pubsub.get(channel, ())):
+            c = self.clients.get(conn_id)
+            if c is not None:
+                self._push(c, {"t": "pub", "channel": channel,
+                               "data": data})
+
+    def _publish(self, channel: str, data: Any) -> None:
+        self._publish_local(channel, data)
+
+    # -- functions
+
+    def _store_function(self, fid: str, pickled: bytes) -> None:
+        self.functions[fid] = pickled
+        for conn_id, reqid in self._fn_waiters.pop(fid, []):
+            c = self.clients.get(conn_id)
+            if c is not None:
+                self._reply(c, reqid, pickled=pickled)
+
+    def _h_register_function(self, rec: ClientRec, m: dict) -> None:
+        self._store_function(m["function_id"], m["pickled"])
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_fetch_function(self, rec: ClientRec, m: dict) -> None:
+        fid = m["function_id"]
+        if fid in self.functions:
+            self._reply(rec, m["reqid"], pickled=self.functions[fid])
+        else:
+            self._fn_waiters.setdefault(fid, []).append(
+                (rec.conn_id, m["reqid"]))
