@@ -1,0 +1,136 @@
+"""Core microbenchmark harness.
+
+The analogue of the reference's microbenchmark suite
+(reference: python/ray/_private/ray_perf.py:93-183, run per release by
+release/microbenchmark/run_microbenchmark.py): tasks/s, actor calls/s,
+put/get throughput, measured against THIS machine and printed as JSON so
+rounds can be compared.
+
+Run:  python -m ray_tpu.perf [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import time
+
+import numpy as np
+
+
+def timeit(name: str, fn, multiplier: int = 1, unit: str = "ops/s",
+           min_time: float = 1.0, quick: bool = False) -> dict:
+    """Run fn repeatedly for ~min_time and report rate (reference:
+    ray_perf.py timeit)."""
+    if quick:
+        min_time = 0.2
+    fn()  # warmup
+    count = 0
+    t0 = time.perf_counter()
+    while True:
+        fn()
+        count += 1
+        dt = time.perf_counter() - t0
+        if dt > min_time:
+            break
+    rate = count * multiplier / dt
+    out = {"name": name, "value": round(rate, 2), "unit": unit}
+    print(json.dumps(out), flush=True)
+    gc.collect()
+    return out
+
+
+def main(quick: bool = False) -> list[dict]:
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        raise RuntimeError(
+            "ray_tpu.perf needs to own its runtime (it calls shutdown); "
+            "run it in a process without an active ray_tpu.init()")
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        return _run(quick)
+    finally:
+        ray_tpu.shutdown()
+
+
+def _run(quick: bool) -> list[dict]:
+    import ray_tpu
+
+    results = []
+    B = 10 if quick else 100
+
+    @ray_tpu.remote
+    def noop():
+        pass
+
+    @ray_tpu.remote
+    class Actor:
+        def noop(self):
+            pass
+
+    # warm the worker pool so spawning isn't measured
+    ray_tpu.get([noop.remote() for _ in range(8)], timeout=120)
+
+    results.append(timeit(
+        "tasks_sync", lambda: ray_tpu.get(noop.remote(), timeout=60),
+        unit="tasks/s", quick=quick))
+
+    results.append(timeit(
+        "tasks_batch",
+        lambda: ray_tpu.get([noop.remote() for _ in range(B)], timeout=120),
+        multiplier=B, unit="tasks/s", quick=quick))
+
+    a = Actor.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)
+    results.append(timeit(
+        "actor_calls_sync", lambda: ray_tpu.get(a.noop.remote(), timeout=60),
+        unit="calls/s", quick=quick))
+
+    results.append(timeit(
+        "actor_calls_batch",
+        lambda: ray_tpu.get([a.noop.remote() for _ in range(B)], timeout=120),
+        multiplier=B, unit="calls/s", quick=quick))
+
+    small = {"k": 1}
+    results.append(timeit(
+        "put_small", lambda: ray_tpu.put(small), unit="puts/s", quick=quick))
+
+    kb = np.zeros(128, dtype=np.float64)   # 1 KiB
+    results.append(timeit(
+        "put_get_1kb", lambda: ray_tpu.get(ray_tpu.put(kb), timeout=60),
+        unit="roundtrips/s", quick=quick))
+
+    mb = np.zeros(131072, dtype=np.float64)   # 1 MiB
+    results.append(timeit(
+        "put_get_1mb", lambda: ray_tpu.get(ray_tpu.put(mb), timeout=60),
+        multiplier=1, unit="roundtrips/s", quick=quick))
+
+    big = np.zeros(13107200, dtype=np.float64)   # 100 MiB
+
+    def put_get_big():
+        r = ray_tpu.put(big)
+        out = ray_tpu.get(r, timeout=120)
+        assert out.nbytes == big.nbytes
+        del out
+        ray_tpu.free([r])
+
+    n_big = 0
+    t0 = time.perf_counter()
+    for _ in range(2 if quick else 5):
+        put_get_big()
+        n_big += 1
+    dt = time.perf_counter() - t0
+    gbps = n_big * big.nbytes * 2 / dt / 1e9   # write + read
+    out = {"name": "put_get_100mb", "value": round(gbps, 3), "unit": "GB/s"}
+    print(json.dumps(out), flush=True)
+    results.append(out)
+    return results
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    args = p.parse_args()
+    main(quick=args.quick)

@@ -156,3 +156,29 @@ def test_many_small_tasks(rt):
 def test_cluster_resources(rt):
     total = rt.cluster_resources()
     assert total["CPU"] == 2.0
+
+
+def test_perf_harness_smoke():
+    """The microbenchmark harness runs end-to-end and yields sane rates
+    (reference: ray_perf.py smoke coverage).  Runs in a subprocess: the
+    harness owns (and shuts down) its runtime, which must not collide
+    with this module's shared fixture."""
+    import json
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.perf", "--quick"],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    results = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            r = json.loads(line)
+            results[r["name"]] = r["value"]
+    assert results["tasks_sync"] > 10, results
+    assert results["actor_calls_sync"] > 10, results
+    assert results["put_get_1mb"] > 5, results
+    assert results["put_get_100mb"] > 0.05, results
