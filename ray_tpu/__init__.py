@@ -59,6 +59,18 @@ def object_store_stats():
     return rt.client.request({"t": "object_stats"})["stats"]
 
 
+def nodes():
+    """Cluster membership view (reference: ray.nodes())."""
+    rt = get_runtime()
+    return rt.client.request({"t": "state", "what": "nodes"})["data"]
+
+
+def timeline(filename=None):
+    """Chrome-trace task timeline (reference: ray.timeline)."""
+    from ray_tpu.util.state import timeline as _timeline
+    return _timeline(filename)
+
+
 def available_resources():
     rt = get_runtime()
     return rt.client.request({"t": "state", "what": "resources"})["data"]["available"]
@@ -75,5 +87,5 @@ __all__ = [
     "ObjectRefGenerator", "TaskError", "GetTimeoutError", "ActorDiedError",
     "placement_group", "remove_placement_group", "PlacementGroup",
     "PlacementGroupSchedulingStrategy", "available_resources",
-    "cluster_resources",
+    "cluster_resources", "nodes", "timeline",
 ]

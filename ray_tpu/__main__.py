@@ -1,0 +1,8 @@
+"""``python -m ray_tpu`` — the CLI entry point (reference: the `ray`
+console script, python/ray/scripts/scripts.py)."""
+
+import sys
+
+from ray_tpu.scripts import main
+
+sys.exit(main())

@@ -98,6 +98,9 @@ _define("mesh_dcn_axis", str, "dcn",
 
 # --- observability --------------------------------------------------------
 _define("metrics_report_interval_ms", int, 2000, "Metrics export cadence.")
+_define("metrics_export_port", int, 0,
+        "Port for the node's Prometheus /metrics endpoint; 0 disables "
+        "(reference: metrics_agent.py prometheus export).")
 _define("task_events_buffer_size", int, 100_000,
         "Max buffered task state events for the state API (reference: "
         "core_worker/task_event_buffer.cc).")

@@ -197,6 +197,13 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # ticks must run at least as often as heartbeats are due
         self.tick_interval = min(self.tick_interval, self._hb_period)
 
+        self.metrics_exporter = None
+        if config.metrics_export_port:
+            from ray_tpu.metrics import MetricsExporter, node_metrics_snapshot
+            self.metrics_exporter = MetricsExporter(
+                lambda: node_metrics_snapshot(self),
+                port=config.metrics_export_port)
+
         if head_address:
             self._connect_head()
 
@@ -240,6 +247,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 self.head_conn.close()
             except Exception:
                 pass
+        if self.metrics_exporter is not None:
+            self.metrics_exporter.stop()
         self.store.shutdown()
 
     # ------------------------------------------------------- head channel
@@ -992,7 +1001,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         w.current_task = spec["task_id"]
         for b in spec.get("arg_ids", []):
             self.store.pin(ObjectID(b))
-        self._record_event(spec, "RUNNING")
+        self._record_event(spec, "RUNNING", worker=w.conn_id)
         self._push(w, {"t": "execute", "spec": spec})
 
     def _fail_task(self, spec: dict, error: str) -> None:
@@ -1000,6 +1009,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         if tr is not None:
             tr.state = "failed"
             tr.error = error
+            tr.finished_at = time.time()
+        self._record_event(spec, "FAILED")
         for b in spec["return_ids"]:
             self._seal_error_object(ObjectID(b), RuntimeError(error))
 
@@ -1321,7 +1332,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 tr.state = "running"
                 tr.started_at = time.time()
                 tr.worker = w.conn_id
-            self._record_event(spec, "RUNNING")
+            self._record_event(spec, "RUNNING", worker=w.conn_id)
             self._push(w, {"t": "execute_actor", "spec": spec})
 
     def _wait_args_then(self, spec, cb) -> None:
@@ -1913,7 +1924,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     # -- state API
 
-    def _record_event(self, spec: dict, state: str) -> None:
+    def _record_event(self, spec: dict, state: str,
+                      worker: Optional[int] = None) -> None:
         self.task_events.append({
             "task_id": spec["task_id"].hex() if isinstance(spec["task_id"], bytes)
             else spec["task_id"],
@@ -1921,6 +1933,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             "state": state,
             "actor_id": spec.get("actor_id", b"").hex()
             if spec.get("actor_id") else None,
+            "worker": worker,
             "time": time.time(),
         })
 

@@ -1,0 +1,137 @@
+"""Prometheus-format metrics export.
+
+The analogue of the reference's metrics pipeline (reference:
+python/ray/_private/metrics_agent.py:375 + src/ray/stats/metric_defs.cc)
+scoped to a single dependency-free exporter: the node service registers a
+snapshot callable, and a tiny HTTP thread serves it at ``/metrics`` in
+the Prometheus text exposition format.  Enable with the
+``metrics_export_port`` config flag (0 = disabled, the default).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+def _escape_label(v) -> str:
+    """Prometheus text-exposition label escaping."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_prometheus(metrics: list[tuple]) -> str:
+    """metrics: [(name, kind, help, value_or_labeled_values)] where the
+    last element is a float OR a dict {labels_dict_as_tuple: float}."""
+    lines: list[str] = []
+    for name, kind, help_text, value in metrics:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        if isinstance(value, dict):
+            for labels, v in sorted(value.items()):
+                lab = ",".join(f'{k}="{_escape_label(val)}"'
+                               for k, val in labels)
+                lines.append(f"{name}{{{lab}}} {float(v)}")
+        else:
+            lines.append(f"{name} {float(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def node_metrics_snapshot(svc) -> list[tuple]:
+    """Gauge/counter snapshot of a NodeService.  Runs on the HTTP thread
+    while the event loop mutates the tables, so every iteration retries
+    over a list() copy (exactness is not required for monitoring)."""
+    for attempt in range(4):
+        try:
+            return _snapshot_once(svc)
+        except RuntimeError:   # dict changed size during iteration
+            if attempt == 3:
+                raise
+
+
+def _snapshot_once(svc) -> list[tuple]:
+    tasks_by_state: dict[tuple, int] = {}
+    for tr in list(svc.tasks.values()):
+        key = (("state", tr.state),)
+        tasks_by_state[key] = tasks_by_state.get(key, 0) + 1
+    actors_by_state: dict[tuple, int] = {}
+    for ar in list(svc.actors.values()):
+        key = (("state", ar.state),)
+        actors_by_state[key] = actors_by_state.get(key, 0) + 1
+    resources: dict[tuple, float] = {}
+    for k, v in list(svc.total_resources.items()):
+        resources[(("kind", "total"), ("resource", k))] = v
+    for k, v in list(svc.available.items()):
+        resources[(("kind", "available"), ("resource", k))] = v
+    store = svc.store.stats()
+    workers = sum(1 for c in list(svc.clients.values())
+                  if c.kind in ("worker", "tpu_executor"))
+    return [
+        ("ray_tpu_tasks", "gauge", "Tasks by state on this node",
+         tasks_by_state or {(("state", "none"),): 0}),
+        ("ray_tpu_actors", "gauge", "Actors by state on this node",
+         actors_by_state or {(("state", "none"),): 0}),
+        ("ray_tpu_resources", "gauge", "Node resources",
+         resources),
+        ("ray_tpu_objects", "gauge", "Objects in the node table",
+         float(len(svc.objects))),
+        ("ray_tpu_object_store_used_bytes", "gauge",
+         "Shared-memory store usage", float(store["used_bytes"])),
+        ("ray_tpu_object_store_capacity_bytes", "gauge",
+         "Shared-memory store capacity", float(store["capacity_bytes"])),
+        ("ray_tpu_objects_spilled_total", "counter",
+         "Objects spilled to disk", float(store["num_spilled"])),
+        ("ray_tpu_objects_restored_total", "counter",
+         "Objects restored from disk", float(store["num_restored"])),
+        ("ray_tpu_workers", "gauge", "Connected worker processes",
+         float(workers)),
+        ("ray_tpu_runnable_tasks", "gauge", "Queued runnable tasks",
+         float(len(svc.runnable_cpu) + len(svc.runnable_tpu))),
+    ]
+
+
+class MetricsExporter:
+    """Serve /metrics over HTTP from a snapshot callable."""
+
+    def __init__(self, snapshot: Callable[[], list], port: int = 0,
+                 host: str = "127.0.0.1"):
+        self._snapshot = snapshot
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    body = render_prometheus(exporter._snapshot()).encode()
+                except Exception as e:   # snapshot raced a shutdown
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # quiet
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="raytpu-metrics")
+        self._thread.start()
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass

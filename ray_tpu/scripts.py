@@ -1,0 +1,238 @@
+"""ray_tpu command line: start/stop/status/list/summary/timeline/memory.
+
+The analogue of the reference CLI (reference: python/ray/scripts/
+scripts.py:529 `ray start`, :1809 `ray status`, plus `ray list/summary/
+timeline/memory` from python/ray/experimental/state/state_cli.py).
+No pip entry point in this environment, so it runs as
+``python -m ray_tpu <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import uuid
+
+
+def _observer(address: str):
+    """Minimal request channel to a node service (register as observer,
+    then blocking request/reply — no runtime, no shm mapping)."""
+    from ray_tpu.core import protocol
+
+    conn = protocol.connect(address, timeout=10.0)
+    conn.send({"t": "register", "kind": "observer", "reqid": 0,
+               "worker_id": f"cli-{uuid.uuid4().hex[:8]}", "pid": os.getpid()})
+    reply = conn.recv(timeout=10.0)
+    if reply.get("error"):
+        raise RuntimeError(reply["error"])
+
+    def request(msg: dict) -> dict:
+        msg = dict(msg)
+        msg["reqid"] = 1
+        conn.send(msg)
+        while True:
+            r = conn.recv(timeout=30.0)
+            if r.get("t") == "reply" and r.get("reqid") == 1:
+                if r.get("error"):
+                    raise RuntimeError(r["error"])
+                return r
+    return conn, request
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._config import RayTpuConfig
+    from ray_tpu.core.node import NodeService
+
+    overrides = {}
+    if args.metrics_port:
+        overrides["metrics_export_port"] = args.metrics_port
+    config = RayTpuConfig(overrides)
+    session = uuid.uuid4().hex
+    session_dir = os.path.join("/tmp/ray_tpu", f"session_{session[:8]}")
+
+    head = None
+    head_address = args.address
+    if args.head:
+        from ray_tpu.core.head import HeadService
+        head = HeadService(config, session, port=args.port or 0)
+        head.start_thread()
+        head_address = head.address
+        print(f"head service listening on {head.address}")
+    elif not head_address:
+        print("either --head or --address=<head> is required",
+              file=sys.stderr)
+        return 2
+
+    node = NodeService(config, session, session_dir,
+                       num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                       head_address=head_address,
+                       stop_on_driver_exit=False)
+    print(f"node service listening on {node.address} "
+          f"(session {session[:8]})")
+    if node.metrics_exporter is not None:
+        print(f"metrics at http://127.0.0.1:"
+              f"{node.metrics_exporter.port}/metrics")
+    print("connect with: ray_tpu.init(address="
+          f"{node.address!r})", flush=True)
+    try:
+        node.run()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        # every exit path must reap workers/shm/metrics threads
+        node.stop()
+        if head is not None:
+            head.stop()
+    return 0
+
+
+def cmd_stop(args) -> int:
+    import signal
+    import subprocess
+
+    # match the module paths exactly (a looser pattern would match the
+    # invoking shell; see repo verify notes)
+    n = 0
+    for pat in ("ray_tpu.core.worker", "ray_tpu.core.node",
+                "ray_tpu.core.head", "ray_tpu start"):
+        r = subprocess.run(["pkill", "-f", pat],
+                           capture_output=True)
+        n += 1 if r.returncode == 0 else 0
+    print(f"stopped ({n} process groups signalled)")
+    del signal
+    return 0
+
+
+def cmd_status(args) -> int:
+    conn, request = _observer(args.address)
+    try:
+        nodes = request({"t": "state", "what": "nodes"})["data"]
+        res = request({"t": "state", "what": "resources"})["data"]
+        stats = request({"t": "object_stats"})["stats"]
+    finally:
+        conn.close()
+    print("======== cluster status ========")
+    print(f"nodes: {len(nodes)} "
+          f"({sum(1 for n in nodes if n.get('alive'))} alive)")
+    for n in nodes:
+        mark = "+" if n.get("alive") else "-"
+        print(f"  {mark} {n['node_id'][:12]} {n['address']} "
+              f"avail={n['available']} total={n['resources']}")
+    print(f"resources: available={res['available']} total={res['total']}")
+    print(f"object store: {stats['num_objects']} objects, "
+          f"{stats['used_bytes'] / 1e6:.1f}/"
+          f"{stats['capacity_bytes'] / 1e6:.1f} MB used"
+          + (", spilled=%d" % stats["num_spilled"]
+             if stats.get("num_spilled") else ""))
+    return 0
+
+
+def cmd_list(args) -> int:
+    conn, request = _observer(args.address)
+    try:
+        what = {"nodes": "nodes", "tasks": "tasks", "actors": "actors",
+                "objects": "objects", "workers": "workers"}[args.what]
+        data = request({"t": "state", "what": what})["data"]
+    finally:
+        conn.close()
+    print(json.dumps(data, indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    conn, request = _observer(args.address)
+    try:
+        data = request({"t": "state", "what": args.what})["data"]
+    finally:
+        conn.close()
+    from ray_tpu.util.state import group_counts
+    key = {"tasks": "name", "actors": "class_name",
+           "objects": "loc"}[args.what]
+    summ = group_counts(data, key)
+    for name, states in summ["cluster"].items():
+        print(f"{name}: {states}")
+    print(f"total: {summ['total']}")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    conn, request = _observer(args.address)
+    try:
+        events = request({"t": "state", "what": "task_events"})["data"]
+    finally:
+        conn.close()
+    from ray_tpu.util.state import events_to_trace
+    trace = events_to_trace(events)
+    out = args.output or f"timeline-{int(time.time())}.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {out} "
+          "(open in chrome://tracing or perfetto)")
+    return 0
+
+
+def cmd_memory(args) -> int:
+    conn, request = _observer(args.address)
+    try:
+        stats = request({"t": "object_stats"})
+        objects = request({"t": "state", "what": "objects"})["data"]
+    finally:
+        conn.close()
+    print(json.dumps(stats["stats"], indent=2))
+    biggest = sorted(objects, key=lambda o: -(o.get("size") or 0))[:20]
+    for o in biggest:
+        print(f"  {o['object_id'][:16]} {o['state']:8} "
+              f"{o.get('loc') or '-':7} {(o.get('size') or 0) / 1e6:.2f} MB")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu",
+        description="ray_tpu cluster CLI (reference: `ray` CLI surface)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head and/or node service")
+    p.add_argument("--head", action="store_true",
+                   help="start a head service (plus a node joined to it)")
+    p.add_argument("--address", default=None,
+                   help="existing head address to join")
+    p.add_argument("--port", type=int, default=0, help="head listen port")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--metrics-port", type=int, default=0)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="kill local ray_tpu processes")
+    p.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("memory", cmd_memory)):
+        p = sub.add_parser(name)
+        p.add_argument("--address", required=True)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("list", help="list tasks/actors/objects/workers/nodes")
+    p.add_argument("what", choices=["tasks", "actors", "objects",
+                                    "workers", "nodes"])
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary")
+    p.add_argument("what", choices=["tasks", "actors", "objects"])
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline")
+    p.add_argument("--address", required=True)
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""State API: list and summarize live cluster entities.
+
+The analogue of the reference's state observability API
+(reference: python/ray/experimental/state/api.py:736,959 — list_tasks /
+list_actors / list_objects / list_nodes / list_workers + summarize_*),
+served from the node service's state tables (and, in cluster mode, the
+head's cluster-scope tables for nodes/actors).
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Optional
+
+
+def _query(what: str) -> list | dict:
+    from ray_tpu.core.runtime import get_runtime
+    return get_runtime().client.request({"t": "state", "what": what})["data"]
+
+
+def list_tasks(filters: Optional[list] = None) -> list[dict]:
+    """Tasks submitted through this node: id, name, state, error,
+    timing.  filters: [(key, "=", value), ...] subset."""
+    return _apply_filters(_query("tasks"), filters)
+
+
+def list_actors(filters: Optional[list] = None) -> list[dict]:
+    """Actors known cluster-wide (head) or on this node (standalone)."""
+    data = _query("cluster_actors")
+    if not data:   # standalone node: local table
+        data = _query("actors")
+    return _apply_filters(data, filters)
+
+
+def list_objects(filters: Optional[list] = None) -> list[dict]:
+    """Objects resident on this node: id, state, location, size."""
+    return _apply_filters(_query("objects"), filters)
+
+
+def list_workers(filters: Optional[list] = None) -> list[dict]:
+    return _apply_filters(_query("workers"), filters)
+
+
+def list_nodes(filters: Optional[list] = None) -> list[dict]:
+    return _apply_filters(_query("nodes"), filters)
+
+
+def list_task_events() -> list[dict]:
+    """Raw task state-transition events (the timeline's source)."""
+    return _query("task_events")
+
+
+def _apply_filters(data: list, filters: Optional[list]) -> list:
+    if not filters:
+        return data
+    out = []
+    for row in data:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op in ("=", "=="):
+                ok = have == value
+            elif op == "!=":
+                ok = have != value
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out
+
+
+def group_counts(rows: list[dict], key: str) -> dict:
+    """Group rows by `key`, counting states — the shared shape of every
+    summarize_* view (and of the CLI summary command)."""
+    groups: dict[str, Counter] = defaultdict(Counter)
+    for row in rows:
+        groups[row.get(key) or "<anonymous>"][row.get("state", "?")] += 1
+    return {"cluster": {name: dict(states)
+                        for name, states in sorted(groups.items())},
+            "total": sum(sum(c.values()) for c in groups.values())}
+
+
+def summarize_tasks() -> dict:
+    """Per-function-name counts by state (reference:
+    state/api.py summarize_tasks)."""
+    return group_counts(list_tasks(), "name")
+
+
+def summarize_actors() -> dict:
+    return group_counts(list_actors(), "class_name")
+
+
+def summarize_objects() -> dict:
+    by_loc: Counter = Counter()
+    total_bytes = 0
+    for o in list_objects():
+        by_loc[o.get("loc") or o["state"]] += 1
+        total_bytes += o.get("size") or 0
+    return {"by_location": dict(by_loc), "total_bytes": total_bytes,
+            "total": sum(by_loc.values())}
+
+
+def events_to_trace(events: list[dict]) -> list[dict]:
+    """Pair RUNNING -> FINISHED/FAILED task events into chrome-trace 'X'
+    complete events (reference: _private/profiling.py chrome format)."""
+    start: dict[str, dict] = {}
+    trace: list[dict] = []
+    for ev in events:
+        tid = ev["task_id"]
+        if ev["state"] == "RUNNING":
+            start[tid] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and tid in start:
+            s = start.pop(tid)
+            trace.append({
+                "name": ev.get("name") or tid[:8],
+                "cat": "task",
+                "ph": "X",
+                "ts": s["time"] * 1e6,
+                "dur": max(0.0, (ev["time"] - s["time"]) * 1e6),
+                "pid": "ray_tpu",
+                "tid": s.get("worker") or 0,
+                "args": {"task_id": tid,
+                         "state": ev["state"]},
+            })
+    return trace
+
+
+def timeline(filename: Optional[str] = None) -> list[dict]:
+    """Chrome-trace-format task timeline (reference: ray.timeline,
+    state/api.py timeline).  Returns the trace; writes JSON if
+    filename."""
+    import json
+
+    trace = events_to_trace(list_task_events())
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

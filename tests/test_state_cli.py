@@ -1,0 +1,163 @@
+"""State API, CLI, and metrics-export tests (reference analogue:
+python/ray/tests/test_state_api.py, test_cli.py, test_metrics_agent.py)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+
+    @ray_tpu.remote
+    def named_task(x):
+        return x * 2
+
+    @ray_tpu.remote
+    class Worker:
+        def ping(self):
+            return "pong"
+
+    a = Worker.remote()
+    refs = [named_task.remote(i) for i in range(4)]
+    assert ray_tpu.get(refs, timeout=120) == [0, 2, 4, 6]
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_list_and_summarize(rt):
+    from ray_tpu.util import state
+
+    tasks = state.list_tasks()
+    # task names are qualnames; the fixture closure prefixes them
+    assert any(t["name"].endswith("named_task") and t["state"] == "finished"
+               for t in tasks)
+    finished = state.list_tasks(filters=[("state", "=", "finished")])
+    assert finished and all(t["state"] == "finished" for t in finished)
+
+    actors = state.list_actors()
+    assert any(a["class_name"] == "Worker" and a["state"] == "alive"
+               for a in actors)
+
+    objs = state.list_objects()
+    assert isinstance(objs, list)
+
+    workers = state.list_workers()
+    assert len(workers) >= 1
+
+    summ = state.summarize_tasks()
+    key = next(k for k in summ["cluster"] if k.endswith("named_task"))
+    assert summ["cluster"][key]["finished"] == 4
+    asumm = state.summarize_actors()
+    assert asumm["cluster"]["Worker"]["alive"] == 1
+
+
+def test_timeline_chrome_trace(rt, tmp_path):
+    out = tmp_path / "trace.json"
+    trace = ray_tpu.timeline(str(out))
+    assert out.exists()
+    loaded = json.loads(out.read_text())
+    assert loaded == trace
+    named = [e for e in trace if e["name"].endswith("named_task")]
+    assert len(named) >= 4
+    for e in named:
+        assert e["ph"] == "X" and e["dur"] >= 0 and e["ts"] > 0
+
+
+def test_nodes_api(rt):
+    ns = ray_tpu.nodes()
+    assert len(ns) == 1 and ns[0]["alive"]
+
+
+def test_metrics_exporter(rt):
+    from ray_tpu.metrics import MetricsExporter, node_metrics_snapshot
+    from ray_tpu.core.runtime import get_runtime
+
+    svc = get_runtime().node_service
+    exporter = MetricsExporter(lambda: node_metrics_snapshot(svc), port=0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics",
+            timeout=10).read().decode()
+    finally:
+        exporter.stop()
+    assert "# TYPE ray_tpu_tasks gauge" in body
+    assert 'ray_tpu_tasks{state="finished"}' in body
+    assert "ray_tpu_object_store_capacity_bytes" in body
+    assert 'ray_tpu_resources{kind="total",resource="CPU"} 2.0' in body
+
+
+def _cli(*args, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_against_live_node(rt, tmp_path):
+    from ray_tpu.core.runtime import get_runtime
+    addr = get_runtime().node_service.address
+
+    r = _cli("status", "--address", addr)
+    assert r.returncode == 0, r.stderr
+    assert "nodes: 1 (1 alive)" in r.stdout
+    assert "object store:" in r.stdout
+
+    r = _cli("list", "nodes", "--address", addr)
+    assert r.returncode == 0
+    assert json.loads(r.stdout)[0]["alive"] is True
+
+    r = _cli("summary", "tasks", "--address", addr)
+    assert r.returncode == 0
+    assert "named_task" in r.stdout
+
+    out = tmp_path / "t.json"
+    r = _cli("timeline", "--address", addr, "-o", str(out))
+    assert r.returncode == 0
+    assert json.loads(out.read_text())
+
+    r = _cli("memory", "--address", addr)
+    assert r.returncode == 0
+    assert "num_objects" in r.stdout
+
+
+def test_cli_start_standalone_head():
+    """`python -m ray_tpu start --head` brings up head+node processes a
+    driver can join (reference: `ray start --head` + ray.init(address))."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    node_addr = None
+    seen = []
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line == "" and proc.poll() is not None:
+                break   # child died before printing
+            seen.append(line)
+            if "node service listening on" in line:
+                node_addr = line.split("listening on")[1].split()[0]
+            if "connect with" in line:
+                break
+        assert node_addr, f"node address never printed; output: {seen}"
+
+        r = _cli("status", "--address", node_addr)
+        assert r.returncode == 0, r.stderr
+        assert "alive" in r.stdout
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
