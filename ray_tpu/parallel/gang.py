@@ -95,31 +95,137 @@ def _free_port() -> int:
     return port
 
 
+def _routable_ip() -> str:
+    """This host's address as seen by peers (UDP-connect trick; falls
+    back to loopback on isolated machines)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
 class GangMember:
     """Actor body for one host's member process (multi-host path).
 
     Placed with ``num_tpus=<chips per host>`` so the scheduler reserves a
     whole host's chips; rank 0's address is the jax.distributed
     coordinator (the analogue of the reference's TCP-store rendezvous on
-    the rank-0 train worker, train/torch/config.py:69).
+    the rank-0 train worker, train/torch/config.py:69).  With
+    ``cpu_backend`` the member pins jax to N virtual CPU devices before
+    backend init — the multi-host test shape (collectives ride Gloo).
     """
 
-    def __init__(self, rank: int, world: int, coordinator: str):
+    def __init__(self, rank: int, world: int,
+                 cpu_backend: bool = False, local_device_count: int = 0):
         self.rank = rank
         self.world = world
-        self.coordinator = coordinator
+        self.cpu_backend = cpu_backend
+        self.local_device_count = local_device_count
         self._initialized = False
 
-    def setup(self) -> str:
+    def choose_coordinator(self) -> str:
+        """Rank 0 picks the rendezvous address ON ITS OWN HOST (the
+        driver's loopback would be unreachable from other nodes)."""
+        ip = _routable_ip()
+        return f"{ip}:{_free_port()}"
+
+    def setup(self, coordinator: str) -> dict:
         import jax as _jax
+        if self.cpu_backend:
+            # must land before first backend touch in this fresh process
+            _jax.config.update("jax_platforms", "cpu")
+            if self.local_device_count:
+                _jax.config.update("jax_num_cpu_devices",
+                                   self.local_device_count)
         if self.world > 1 and not self._initialized:
             _jax.distributed.initialize(
-                coordinator_address=self.coordinator,
+                coordinator_address=coordinator,
                 num_processes=self.world, process_id=self.rank)
             self._initialized = True
-        return f"rank{self.rank}: {len(_jax.devices())} global devices"
+        return {"rank": self.rank,
+                "global_devices": len(_jax.devices()),
+                "local_devices": len(_jax.local_devices()),
+                "pid": __import__("os").getpid()}
 
     def run(self, pickled_fn: bytes, *args):
         import cloudpickle
         fn = cloudpickle.loads(pickled_fn)
         return fn(self.rank, *args)
+
+    def pid(self) -> int:
+        import os
+        return os.getpid()
+
+
+class MultiHostGang:
+    """A formed multi-host gang: one GangMember actor per host, jointly
+    initialized through jax.distributed (SPMD across processes).
+
+    The reference analogue is the worker-group half of BackendExecutor
+    (reference: train/_internal/backend_executor.py:94 start +
+    worker_group.py:92); formation here is one collective
+    jax.distributed.initialize instead of a framework process-group
+    bootstrap.  A member death breaks the gang; recovery is re-forming a
+    NEW gang (fresh coordinator, fresh processes) and restoring state
+    from a checkpoint (reference: backend_executor.py:571 restart).
+    """
+
+    def __init__(self, num_members: int, *, num_tpus_per_member: float = 0,
+                 cpu_backend: bool = False, devices_per_member: int = 0,
+                 resources_per_member: Optional[dict] = None,
+                 setup_timeout: float = 120.0):
+        import ray_tpu
+
+        self.num_members = num_members
+        opts: dict = {}
+        if num_tpus_per_member:
+            opts["num_tpus"] = num_tpus_per_member
+        if resources_per_member:
+            opts["resources"] = resources_per_member
+        member_cls = ray_tpu.remote(GangMember)
+        if opts:
+            member_cls = member_cls.options(**opts)
+        self.members = [
+            member_cls.remote(rank=i, world=num_members,
+                              cpu_backend=cpu_backend,
+                              local_device_count=devices_per_member)
+            for i in range(num_members)]
+        # rank 0 picks the rendezvous address on ITS host (it may be
+        # scheduled on any node), then setup is a collective barrier:
+        # all members must be in flight together
+        self.coordinator = ray_tpu.get(
+            self.members[0].choose_coordinator.remote(),
+            timeout=setup_timeout)
+        self.infos = ray_tpu.get(
+            [m.setup.remote(self.coordinator) for m in self.members],
+            timeout=setup_timeout)
+        self.global_devices = self.infos[0]["global_devices"]
+
+    def run(self, fn: Callable, *args,
+            timeout: Optional[float] = None) -> list:
+        """Run ``fn(rank, *args)`` on every member; returns per-rank
+        results (SPMD: all ranks execute the same program).  No default
+        timeout: a member-side attempt may legitimately run for hours —
+        member death still fails the get with an actor-death error."""
+        import cloudpickle
+        import ray_tpu
+        payload = cloudpickle.dumps(fn)
+        refs = [m.run.remote(payload, *args) for m in self.members]
+        return ray_tpu.get(refs, timeout=timeout)
+
+    def member_pids(self) -> list[int]:
+        import ray_tpu
+        return ray_tpu.get([m.pid.remote() for m in self.members],
+                           timeout=60)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        for m in self.members:
+            try:
+                ray_tpu.kill(m)
+            except Exception:
+                pass

@@ -174,6 +174,21 @@ class CheckpointManager:
 
     def latest(self) -> Optional[Checkpoint]:
         self.flush()
+        # re-scan the directory: in multi-host runs, rank-0 members write
+        # checkpoints here from ANOTHER process (reference: workers
+        # persist to storage_path; the driver discovers them on restore)
+        on_disk = sorted(
+            os.path.join(self.root, d) for d in os.listdir(self.root)
+            if d.startswith("checkpoint_")) if os.path.isdir(self.root) \
+            else []
+        for path in on_disk:
+            if path not in self._kept:
+                self._kept.append(path)
+        self._kept.sort()
+        if self._kept:
+            last = self._kept[-1]
+            self._seq = max(self._seq,
+                            int(os.path.basename(last).split("_")[1]) + 1)
         for path in reversed(self._kept):
             if os.path.exists(os.path.join(path, Checkpoint.PAYLOAD)):
                 return Checkpoint(path)

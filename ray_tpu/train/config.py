@@ -23,6 +23,12 @@ class ScalingConfig:
     mesh: dict[str, int] = field(default_factory=lambda: {"dp": -1})
     num_hosts: int = 1
     use_cpu_devices: bool = False       # tests: virtual CPU device mesh
+    # multi-host CPU test shape: virtual devices per member process
+    # (0 = all local devices; real TPU hosts always use all chips)
+    devices_per_host: int = 0
+    # extra custom resources each gang member reserves (placement)
+    resources_per_host: Optional[dict] = None
+    num_tpus_per_host: float = 0
     # reference-compat aliases: ScalingConfig(num_workers=8) on a CPU mesh
     num_workers: Optional[int] = None
 

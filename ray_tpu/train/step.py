@@ -92,6 +92,18 @@ def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation, *,
             st_sh = state_shardings(mesh, params_logical, rules, params, tx)
             params = jax.tree.map(
                 lambda x, s: jax.device_put(x, s), params, st_sh.params)
+        elif mesh is not None:
+            # no logical rules: pure data parallelism — replicate the
+            # whole state over the mesh.  Mandatory in multi-process
+            # (every array must span the global mesh), and the correct
+            # DP placement in-process too.
+            rep = replicated(mesh)
+            opt_shape = jax.eval_shape(tx.init, params)
+            st_sh = TrainState(
+                step=rep,
+                params=jax.tree.map(lambda _: rep, params),
+                opt_state=jax.tree.map(lambda _: rep, opt_shape))
+            params = jax.tree.map(lambda x: jax.device_put(x, rep), params)
         else:
             # defensive copy: the step donates its state, and donating
             # buffers the CALLER still holds would delete them under it
@@ -134,6 +146,33 @@ def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation, *,
 
 
 def shard_batch(batch, mesh: Mesh):
-    """Host batch → device batch sharded over the data axes."""
+    """Host batch → device batch sharded over the data axes.
+
+    Multi-process (one jax process per TPU host): every process holds
+    the SAME global host batch (deterministic iterators), carves out the
+    rows its local devices own, and assembles the global array with
+    ``jax.make_array_from_process_local_data`` — the SPMD data-feed
+    pattern the scaling playbook prescribes; no host ever materializes
+    another host's shard on device."""
     sh = batch_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+    import numpy as np
+
+    def shard_one(x):
+        if not hasattr(x, "shape") or x.ndim == 0:
+            return jax.device_put(x, sh)
+        global_shape = x.shape
+        # rows owned by this process under the data-axis sharding;
+        # ownership may be non-contiguous on interleaved device meshes,
+        # so concatenate the owned ranges in index order
+        lo = global_shape[0]
+        idx = sh.addressable_devices_indices_map(global_shape)
+        rows = sorted({(s[0].start or 0, s[0].stop if s[0].stop is not None
+                        else lo) for s in idx.values()})
+        parts = [x[a:b] for a, b in rows]
+        local = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        return jax.make_array_from_process_local_data(
+            sh, local, global_shape)
+    return jax.tree.map(shard_one, batch)

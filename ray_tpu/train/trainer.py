@@ -19,7 +19,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
-from ray_tpu.parallel.gang import GangConfig, TpuGang
+from ray_tpu.parallel.gang import GangConfig, MultiHostGang, TpuGang
 from ray_tpu.train import session as _session
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
@@ -125,16 +125,29 @@ class DataParallelTrainer(BaseTrainer):
         self._gang: Optional[TpuGang] = None
 
     @property
-    def gang(self) -> TpuGang:
+    def gang(self):
         if self._gang is None:
             sc = self.scaling_config
-            self._gang = TpuGang(GangConfig(
-                mesh_axes=dict(sc.mesh), num_hosts=sc.num_hosts,
-                use_cpu_devices=sc.use_cpu_devices))
+            if sc.num_hosts > 1:
+                # one member process per host, co-initialized through
+                # jax.distributed (reference: backend_executor.py:94)
+                self._gang = MultiHostGang(
+                    sc.num_hosts,
+                    cpu_backend=sc.use_cpu_devices,
+                    devices_per_member=sc.devices_per_host,
+                    num_tpus_per_member=sc.num_tpus_per_host,
+                    resources_per_member=sc.resources_per_host)
+            else:
+                self._gang = TpuGang(GangConfig(
+                    mesh_axes=dict(sc.mesh), num_hosts=sc.num_hosts,
+                    use_cpu_devices=sc.use_cpu_devices))
         return self._gang
 
     def _attempt(self) -> None:
         gang = self.gang
+        if isinstance(gang, MultiHostGang):
+            self._attempt_multihost(gang)
+            return
         st = _session._state()
         st.world_size = gang.num_hosts
         cfg = dict(self._loop_config)
@@ -148,3 +161,93 @@ class DataParallelTrainer(BaseTrainer):
                 self._loop()
             else:
                 self._loop(cfg)
+
+    def _attempt_multihost(self, gang: MultiHostGang) -> None:
+        """One SPMD attempt across gang members.
+
+        Every member runs the SAME train loop over the global mesh; rank
+        0 persists checkpoints straight into the run dir's checkpoint
+        root (shared storage — the reference's workers likewise upload
+        to storage_path), so the driver's CheckpointManager discovers
+        them for restart-based FT.  A member death fails the attempt;
+        fit() re-forms a fresh gang and restores
+        (reference: backend_executor.py:571)."""
+        if self._datasets:
+            raise NotImplementedError(
+                "datasets= with num_hosts>1: iterate data inside the "
+                "train loop (each member sees the same iterator and "
+                "feeds its own shard via shard_batch)")
+        st = _session._state()
+        st.world_size = gang.num_members
+        run_dir = self.run_config.resolved_storage_path()
+        ckpt_dir = os.path.join(run_dir, "checkpoints")
+        ckpt_cfg = self.run_config.checkpoint_config
+        restore = st.latest_checkpoint
+        # ship the checkpoint PATH, not the payload: members read it off
+        # shared storage themselves (a multi-GB state dict must not ride
+        # the driver's closure to every member)
+        restore_path = restore.path if restore is not None else None
+        mesh_axes = dict(self.scaling_config.mesh)
+        world = gang.num_members
+        loop_cfg = dict(self._loop_config)
+        trainer = self
+        self._gang = None   # actor handles must not ride the closure
+
+        def member_attempt(rank):
+            import jax as _jax
+            from ray_tpu.parallel.gang import GangConfig as _GC
+            from ray_tpu.parallel.gang import TpuGang as _TG
+            from ray_tpu.train import session as _s
+            from ray_tpu.train.checkpoint import (Checkpoint as _Ck,
+                                                  CheckpointManager as _CM)
+            mgr = (_CM(ckpt_dir, num_to_keep=ckpt_cfg.num_to_keep,
+                       async_write=False) if rank == 0 else None)
+
+            def ckpt_cb(data):
+                # SPMD lockstep: every rank reports the same checkpoint,
+                # so this gather is a collective — rule-sharded arrays
+                # that no single process fully addresses are assembled
+                # on every host, then rank 0 alone persists
+                from jax.experimental import multihost_utils as _mh
+
+                def gather(x):
+                    if isinstance(x, _jax.Array) \
+                            and not x.is_fully_addressable:
+                        # tiled: reassemble the GLOBAL value from shards
+                        return _mh.process_allgather(x, tiled=True)
+                    return x
+                host = _jax.tree.map(gather, data)
+                if mgr is not None:
+                    mgr.save(host)
+
+            latest = _Ck(restore_path) if restore_path else None
+            mst = _s._start(world_rank=rank, world_size=world,
+                            checkpoint_cb=ckpt_cb,
+                            latest_checkpoint=latest)
+            stopped = False
+            try:
+                # the member-local gang spans the GLOBAL device set
+                # (jax.distributed was initialized at member setup)
+                trainer._gang = _TG(_GC(mesh_axes=mesh_axes,
+                                        num_hosts=world))
+                with trainer._gang.mesh:
+                    if trainer._loop.__code__.co_argcount == 0:
+                        trainer._loop()
+                    else:
+                        trainer._loop(dict(loop_cfg))
+            except StopIteration:
+                stopped = True   # clean stop must not count as a failure
+            finally:
+                _s._end()
+            return {"rank": rank, "results": mst.results,
+                    "stopped": stopped}
+
+        try:
+            outs = gang.run(member_attempt)
+        except Exception:
+            # broken gang: tear it down so the retry forms a fresh one
+            gang.shutdown()
+            self._gang = None
+            raise
+        self._gang = gang
+        st.results.extend(outs[0]["results"])

@@ -1,0 +1,146 @@
+"""Multi-host gang tests: real jax.distributed across >=2 member
+processes launched through the actor API, SPMD training over the global
+mesh, and kill-one-member restart-from-checkpoint recovery.
+
+Reference analogue: python/ray/train/tests/test_backend.py +
+backend_executor.py:94 (start), :571 (restart), with jax.distributed
+replacing the torch process-group rendezvous (train/torch/config.py:69).
+Runs on the CPU backend (collectives ride Gloo), the multi-host test
+shape for machines without multiple TPU hosts.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=6, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_gang_formation_and_spmd_collective(rt):
+    from ray_tpu.parallel.gang import MultiHostGang
+
+    gang = MultiHostGang(2, cpu_backend=True, devices_per_member=2)
+    try:
+        assert [i["rank"] for i in gang.infos] == [0, 1]
+        assert all(i["global_devices"] == 4 for i in gang.infos)
+        assert all(i["local_devices"] == 2 for i in gang.infos)
+        assert len(set(i["pid"] for i in gang.infos)) == 2  # real processes
+
+        def spmd_sum(rank):
+            import jax
+            import jax.numpy as jnp
+            import numpy as _np
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as P)
+            devs = jax.devices()
+            mesh = Mesh(_np.array(devs).reshape(len(devs)), ("dp",))
+            sh = NamedSharding(mesh, P("dp"))
+            local = _np.full((2, 4), float(rank + 1))
+            garr = jax.make_array_from_process_local_data(sh, local, (4, 4))
+            # cross-process all-reduce: every rank must see the global sum
+            return float(jax.jit(jnp.sum)(garr))
+
+        out = gang.run(spmd_sum)
+        assert out == [24.0, 24.0], out   # (1+2)*2rows*4cols
+    finally:
+        gang.shutdown()
+
+
+def test_jax_trainer_multihost_kill_and_restore(rt, tmp_path):
+    """The headline FT path: JaxTrainer SPMD over a 2-process gang;
+    SIGKILL one member mid-run; the trainer re-forms a fresh gang and
+    resumes from the last rank-0 checkpoint."""
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.train.config import (FailureConfig, RunConfig,
+                                      ScalingConfig)
+
+    class SlowBatches:
+        """Deterministic, picklable, rate-limited batch stream (every
+        member sees the same sequence; shard_batch carves per-process
+        rows)."""
+
+        def __init__(self, n):
+            self.n = n
+
+        def __iter__(self):
+            rng = np.random.RandomState(0)
+            for _ in range(self.n):
+                time.sleep(0.12)
+                yield {"x": rng.rand(8, 4).astype(np.float32)}
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - 1.0) ** 2)
+
+    def init_params(key):
+        import jax
+        return {"w": jax.random.normal(key, (4, 1)) * 0.1}
+
+    num_steps = 30
+    trainer = JaxTrainer(
+        loss_fn=loss_fn, init_params=init_params,
+        optimizer=optax.adam(0.1),
+        train_data=SlowBatches(num_steps + 5),
+        num_steps=num_steps,
+        params_logical=None, rules=(),
+        report_every=5, checkpoint_every=5,
+        scaling_config=ScalingConfig(mesh={"dp": -1}, num_hosts=2,
+                                     use_cpu_devices=True,
+                                     devices_per_host=2),
+        run_config=RunConfig(name="mh", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)))
+
+    gang = trainer.gang   # pre-form so the test can see member pids
+    pids = gang.member_pids()
+    assert len(set(pids)) == 2
+
+    holder: dict = {}
+
+    def run_fit():
+        try:
+            holder["result"] = trainer.fit()
+        except Exception as e:   # surfaced to the main thread below
+            holder["error"] = e
+
+    t = threading.Thread(target=run_fit)
+    t.start()
+
+    # wait for the first rank-0 checkpoint to land, then kill member 1
+    ckpt_root = os.path.join(str(tmp_path), "mh", "checkpoints")
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if os.path.isdir(ckpt_root) and any(
+                d.startswith("checkpoint_") for d in os.listdir(ckpt_root)):
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("no checkpoint appeared before the kill")
+    os.kill(pids[1], signal.SIGKILL)
+
+    t.join(timeout=300)
+    assert not t.is_alive(), "fit() hung after member death"
+    assert "error" not in holder, holder.get("error")
+    result = holder["result"]
+    assert result.error is None
+    assert result.metrics["step"] == num_steps
+    # training actually recovered: the restored run continued past the
+    # kill point and the loss kept improving
+    steps_seen = [m["step"] for m in result.metrics_history]
+    assert steps_seen[-1] == num_steps
+    assert result.metrics["loss"] < result.metrics_history[0]["loss"]
