@@ -15,6 +15,7 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
 from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
                                  Searcher, choice, grid_search, loguniform,
                                  randint, uniform)
+from ray_tpu.tune.suggest import GPSearcher, TPESearcher, TuneBOHB
 from ray_tpu.tune.trainable import Trainable, FunctionTrainable, wrap_function
 from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 

@@ -95,8 +95,17 @@ class Searcher:
     def suggest(self, trial_id: str) -> Optional[dict]:
         raise NotImplementedError
 
+    def on_trial_result(self, trial_id: str, result: dict):
+        """Intermediate result hook (budget-aware searchers)."""
+
     def on_trial_complete(self, trial_id: str, result: Optional[dict]):
         pass
+
+    def set_search_properties(self, metric: Optional[str],
+                              mode: Optional[str]) -> None:
+        """Adopt the TuneConfig's metric/mode unless the searcher was
+        constructed with explicit ones (reference:
+        searcher.py set_search_properties)."""
 
 
 class BasicVariantGenerator(Searcher):
@@ -180,9 +189,15 @@ class ConcurrencyLimiter(Searcher):
             self._live.add(trial_id)
         return cfg
 
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
     def on_trial_complete(self, trial_id, result):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result)
+
+    def set_search_properties(self, metric, mode):
+        self.searcher.set_search_properties(metric, mode)
 
 
 def resolve_config(space_or_cfg: dict, rng: Optional[random.Random] = None):

@@ -1,0 +1,349 @@
+"""Model-based searchers: TPE, GP-EI, and budget-aware BOHB.
+
+Native re-derivations of the reference's external-library searcher
+families (reference: python/ray/tune/search/hyperopt/ wraps TPE,
+search/bayesopt/ wraps GP-EI, search/bohb/ wraps BOHB) — implemented
+directly on numpy so the framework carries no optional dependencies.
+
+All operate on the sample-space primitives in ``ray_tpu.tune.search``:
+Uniform / LogUniform / RandInt are continuous (log-transformed where
+appropriate), Choice is categorical.  Nested dicts flatten to
+path-tuples.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.tune.search import (Choice, Domain, GridSearch, LogUniform,
+                                 RandInt, Searcher, Uniform)
+
+# -- space flattening -------------------------------------------------------
+
+
+def _flatten_space(space: dict, prefix=()) -> dict[tuple, Any]:
+    out: dict[tuple, Any] = {}
+    for k, v in space.items():
+        key = (*prefix, k)
+        if isinstance(v, dict):
+            out.update(_flatten_space(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten(flat: dict[tuple, Any]) -> dict:
+    out: dict = {}
+    for path, v in flat.items():
+        d = out
+        for k in path[:-1]:
+            d = d.setdefault(k, {})
+        d[path[-1]] = v
+    return out
+
+
+class _Dim:
+    """One search dimension in a normalized [0,1] (continuous) or
+    index (categorical) representation."""
+
+    def __init__(self, domain):
+        self.domain = domain
+        self.categorical = isinstance(domain, Choice)
+        if self.categorical:
+            self.values = list(domain.values)
+        elif isinstance(domain, LogUniform):
+            self.lo, self.hi = math.log(domain.low), math.log(domain.high)
+        elif isinstance(domain, (Uniform, RandInt)):
+            self.lo, self.hi = float(domain.low), float(domain.high)
+        else:
+            raise TypeError(f"unsupported domain {domain!r}")
+
+    def from_unit(self, u: float):
+        if self.categorical:
+            return self.values[int(u)]
+        x = self.lo + min(max(u, 0.0), 1.0) * (self.hi - self.lo)
+        if isinstance(self.domain, LogUniform):
+            return math.exp(x)
+        if isinstance(self.domain, RandInt):
+            # floor, not truncation: int() would skew negative domains
+            # toward zero relative to Domain.sample's randrange
+            return min(math.floor(x), int(self.hi) - 1)
+        return x
+
+    def sample_unit(self, rng: np.random.RandomState) -> float:
+        if self.categorical:
+            return rng.randint(len(self.values))
+        return rng.rand()
+
+
+class _ModelSearcher(Searcher):
+    """Shared bookkeeping: dims, observations, num_samples budget,
+    random startup phase, mode normalization (scores are minimized
+    internally)."""
+
+    def __init__(self, param_space: dict, metric: Optional[str] = None,
+                 mode: Optional[str] = None, num_samples: int = 64,
+                 n_startup: int = 10, seed: Optional[int] = None):
+        assert mode in (None, "min", "max")
+        flat = _flatten_space(param_space)
+        for k, v in flat.items():
+            if isinstance(v, GridSearch):
+                # grid semantics (try EVERY value) cannot be honored by a
+                # sampling model — reject loudly, like the reference's
+                # hyperopt/bayesopt searchers do
+                raise ValueError(
+                    f"grid_search (at {'.'.join(map(str, k))}) is not "
+                    "supported by model-based searchers; use tune.choice "
+                    "or BasicVariantGenerator")
+        self.fixed = {k: v for k, v in flat.items()
+                      if not isinstance(v, Domain)}
+        self.dims = {k: _Dim(v) for k, v in flat.items()
+                     if isinstance(v, Domain)}
+        self._metric_explicit = metric is not None
+        self._mode_explicit = mode is not None
+        self.metric = metric or "loss"
+        self.mode = mode or "min"
+        self.num_samples = num_samples
+        self.n_startup = n_startup
+        self.rng = np.random.RandomState(seed)
+        self._suggested = 0
+        self._configs: dict[str, dict[tuple, float]] = {}  # unit space
+        self._obs: list[tuple[dict[tuple, float], float]] = []
+
+    def _record(self, trial_id: str, result: Optional[dict]) -> None:
+        units = self._configs.pop(trial_id, None)
+        if units is None or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "max":
+            score = -score
+        self._obs.append((units, score))
+
+    def on_trial_complete(self, trial_id, result):
+        self._record(trial_id, result)
+
+    def set_search_properties(self, metric, mode):
+        if metric and not self._metric_explicit:
+            self.metric = metric
+        if mode and not self._mode_explicit:
+            self.mode = mode
+
+    def _emit(self, trial_id: str, units: dict[tuple, float]) -> dict:
+        self._configs[trial_id] = units
+        self._suggested += 1
+        flat = {}
+        for k, v in self.fixed.items():
+            # sample_from-style callables re-evaluate per trial, matching
+            # BasicVariantGenerator (search.py _materialize)
+            flat[k] = v() if callable(v) and not isinstance(v, type) else v
+        for k, dim in self.dims.items():
+            flat[k] = dim.from_unit(units[k])
+        return _unflatten(flat)
+
+    def _random_units(self) -> dict[tuple, float]:
+        return {k: d.sample_unit(self.rng) for k, d in self.dims.items()}
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._suggested >= self.num_samples:
+            return None
+        if len(self._obs) < self.n_startup:
+            return self._emit(trial_id, self._random_units())
+        return self._emit(trial_id, self._model_units())
+
+    # subclass hook
+    def _model_units(self) -> dict[tuple, float]:
+        raise NotImplementedError
+
+
+class TPESearcher(_ModelSearcher):
+    """Tree-structured Parzen Estimator (Bergstra et al. 2011,
+    'Algorithms for Hyper-Parameter Optimization') — the algorithm the
+    reference wraps via hyperopt (reference: tune/search/hyperopt/
+    hyperopt_search.py).  Observations split into good (top gamma
+    quantile) and bad; candidates sampled from the good kernel density
+    are ranked by the density ratio l(x)/g(x), independently per
+    dimension."""
+
+    def __init__(self, param_space: dict, metric: str = "loss",
+                 mode: str = "min", num_samples: int = 64,
+                 n_startup: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, prior_weight: float = 0.25,
+                 seed: Optional[int] = None):
+        super().__init__(param_space, metric, mode, num_samples,
+                         n_startup, seed)
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        # fraction of candidates drawn from the uniform prior: keeps
+        # exploration alive once the good set collapses onto one region
+        # (hyperopt mixes the prior into the KDE the same way)
+        self.prior_weight = prior_weight
+
+    @staticmethod
+    def _kde_logpdf(x: np.ndarray, centers: np.ndarray, bw: float):
+        d = (x[:, None] - centers[None, :]) / bw
+        log_k = -0.5 * d * d - math.log(bw * math.sqrt(2 * math.pi))
+        m = log_k.max(axis=1, keepdims=True)
+        return (m[:, 0] + np.log(np.exp(log_k - m).sum(axis=1))
+                - math.log(len(centers)))
+
+    def _model_units(self) -> dict[tuple, float]:
+        scores = np.array([s for _, s in self._obs])
+        n_good = max(1, int(math.ceil(self.gamma * len(scores))))
+        order = np.argsort(scores)
+        good_idx = set(order[:n_good].tolist())
+        units = {}
+        for k, dim in self.dims.items():
+            vals = np.array([u[k] for u, _ in self._obs])
+            good = vals[list(good_idx)]
+            bad = np.array([v for i, v in enumerate(vals)
+                            if i not in good_idx]) if len(vals) > n_good \
+                else vals
+            if dim.categorical:
+                ncat = len(dim.values)
+                pg = (np.bincount(good.astype(int), minlength=ncat) + 1.0)
+                pb = (np.bincount(bad.astype(int), minlength=ncat) + 1.0)
+                ratio = (pg / pg.sum()) / (pb / pb.sum())
+                # candidates from the good distribution MIXED with the
+                # uniform prior, ranked by the density ratio
+                p = ((1 - self.prior_weight) * pg / pg.sum()
+                     + self.prior_weight / ncat)
+                cand = self.rng.choice(ncat, size=self.n_candidates,
+                                       p=p / p.sum())
+                units[k] = int(cand[np.argmax(ratio[cand])])
+                continue
+            # Scott-ish bandwidth floored so early clusters still explore
+            bw = max(good.std() * len(good) ** -0.2, 0.08)
+            cand = good[self.rng.randint(len(good), size=self.n_candidates)]
+            cand = np.clip(cand + self.rng.randn(self.n_candidates) * bw,
+                           0.0, 1.0)
+            n_prior = max(1, int(self.prior_weight * self.n_candidates))
+            cand[:n_prior] = self.rng.rand(n_prior)   # prior draws
+            lg = self._kde_logpdf(cand, good, bw)
+            lb = self._kde_logpdf(cand, bad if len(bad) else good,
+                                  max(bad.std() * max(len(bad), 1) ** -0.2,
+                                      0.08) if len(bad) else bw)
+            units[k] = float(cand[np.argmax(lg - lb)])
+        return units
+
+
+class GPSearcher(_ModelSearcher):
+    """Gaussian-process expected improvement over the unit cube
+    (reference wraps the same method via bayes_opt:
+    tune/search/bayesopt/bayesopt_search.py).  RBF kernel, categorical
+    dims one-hot encoded, EI maximized over a random candidate pool."""
+
+    def __init__(self, param_space: dict, metric: str = "loss",
+                 mode: str = "min", num_samples: int = 64,
+                 n_startup: int = 8, n_candidates: int = 256,
+                 length_scale: float = 0.25, noise: float = 1e-4,
+                 seed: Optional[int] = None):
+        super().__init__(param_space, metric, mode, num_samples,
+                         n_startup, seed)
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+
+    def _vec(self, units: dict[tuple, float]) -> np.ndarray:
+        parts = []
+        for k, dim in self.dims.items():
+            if dim.categorical:
+                one = np.zeros(len(dim.values))
+                one[int(units[k])] = 1.0
+                parts.append(one)
+            else:
+                parts.append(np.array([units[k]]))
+        return np.concatenate(parts)
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def _model_units(self) -> dict[tuple, float]:
+        X = np.stack([self._vec(u) for u, _ in self._obs])
+        y = np.array([s for _, s in self._obs])
+        mu0, sd = y.mean(), max(y.std(), 1e-9)
+        yn = (y - mu0) / sd
+        K = self._kernel(X, X) + self.noise * np.eye(len(X))
+        Kinv_y = np.linalg.solve(K, yn)
+        Kinv = np.linalg.inv(K)
+
+        cands = [self._random_units() for _ in range(self.n_candidates)]
+        Xc = np.stack([self._vec(u) for u in cands])
+        Kc = self._kernel(Xc, X)
+        mu = Kc @ Kinv_y
+        var = np.maximum(1.0 - np.einsum("ij,jk,ik->i", Kc, Kinv, Kc), 1e-12)
+        sigma = np.sqrt(var)
+        best = yn.min()
+        z = (best - mu) / sigma
+        phi = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+        Phi = 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = sigma * (z * Phi + phi)
+        return cands[int(np.argmax(ei))]
+
+
+class TuneBOHB(TPESearcher):
+    """BOHB's model half (Falkner et al. 2018): TPE conditioned on the
+    highest training budget that has enough observations, designed to
+    pair with HyperBandScheduler (reference: tune/search/bohb/ +
+    schedulers/hb_bohb.py).  Intermediate results feed the model via
+    on_trial_result so early-stopped trials still contribute at their
+    budget."""
+
+    def __init__(self, *args, min_points_in_model: Optional[int] = None,
+                 **kw):
+        super().__init__(*args, **kw)
+        self.min_points = min_points_in_model or self.n_startup
+        # budget (training_iteration) -> [(units, score)]
+        self._by_budget: dict[int, list] = {}
+        self._recorded: set[tuple[str, int]] = set()
+
+    def _record_at_budget(self, trial_id: str, result: dict) -> None:
+        units = self._configs.get(trial_id)
+        if units is None or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "max":
+            score = -score
+        budget = int(result.get("training_iteration", 0))
+        if (trial_id, budget) in self._recorded:
+            return   # the final result arrives twice (result + complete)
+        self._recorded.add((trial_id, budget))
+        self._by_budget.setdefault(budget, []).append((units, score))
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        # BOHB's defining trait: every rung evaluation is an observation
+        # at its budget, so early-stopped trials still inform the model
+        self._record_at_budget(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result):
+        # result=None means the trial ERRORED — its pre-crash metrics
+        # must not feed the model
+        if result is not None:
+            self._record_at_budget(trial_id, result)
+        self._configs.pop(trial_id, None)
+        self._recorded = {(t, b) for t, b in self._recorded
+                          if t != trial_id}
+
+    def _model_units(self) -> dict[tuple, float]:
+        # model the largest budget with enough observations (BOHB rule)
+        for budget in sorted(self._by_budget, reverse=True):
+            obs = self._by_budget[budget]
+            if len(obs) >= self.min_points:
+                self._obs = obs
+                return super()._model_units()
+        # not enough anywhere: pool all budgets
+        self._obs = [o for obs in self._by_budget.values() for o in obs]
+        if len(self._obs) >= self.min_points:
+            return super()._model_units()
+        return self._random_units()
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._suggested >= self.num_samples:
+            return None
+        total = sum(len(v) for v in self._by_budget.values())
+        if total < self.n_startup:
+            return self._emit(trial_id, self._random_units())
+        return self._emit(trial_id, self._model_units())

@@ -231,6 +231,11 @@ class Tuner:
 
         searcher = tc.search_alg or BasicVariantGenerator(
             self.param_space, num_samples=tc.num_samples, seed=tc.seed)
+        # sync optimization target into the searcher (reference:
+        # set_search_properties) — a silent metric mismatch would leave a
+        # model-based searcher blind or optimizing the wrong direction
+        if hasattr(searcher, "set_search_properties"):
+            searcher.set_search_properties(tc.metric, tc.mode)
         scheduler = tc.scheduler or FIFOScheduler()
         callbacks = list(self.run_config.callbacks)
         stop_criteria = self.run_config.stop or {}
@@ -331,6 +336,9 @@ class Tuner:
                     continue
                 t.last_result = result
                 t.history.append(result)
+                # budget-aware searchers (BOHB) learn from intermediate
+                # results at their training budget
+                searcher.on_trial_result(t.trial_id, result)
                 for cb in callbacks:
                     cb.on_trial_result(t, result)
                 freq = self.run_config.checkpoint_config.checkpoint_frequency
