@@ -1,11 +1,17 @@
 """Blocks: the unit of distributed data.
 
 Reference capability: ray.data blocks (python/ray/data/_internal/
-arrow_block.py, pandas_block.py — Arrow/pandas/list formats).  Here a
-block is a **column dict of numpy arrays** — the layout `device_put`
-wants, so the path from disk to HBM is: block → slice → jax.Array with
-zero format conversions at feed time.  List-of-rows blocks are accepted
-at the edges and normalized.
+arrow_block.py, pandas_block.py — Arrow/pandas/list formats).  Two block
+layouts are first-class:
+
+  * **column dict of numpy arrays** (default) — the layout `device_put`
+    wants, so the path from disk to HBM is: block → slice → jax.Array
+    with zero format conversions at feed time.
+  * **pyarrow.Table** — zero-copy columnar interchange with parquet /
+    pandas / the Arrow ecosystem (reference: arrow_block.py); accessors
+    below dispatch on the block type so stages can mix formats.
+
+List-of-rows blocks are accepted at the edges and normalized.
 """
 
 from __future__ import annotations
@@ -14,11 +20,23 @@ from typing import Any, Iterable, Optional, Union
 
 import numpy as np
 
-Block = dict  # str -> np.ndarray, all columns equal length
+try:
+    import pyarrow as pa
+except Exception:   # pragma: no cover - environment gates the dependency
+    pa = None
+
+Block = Any  # dict[str -> np.ndarray] (equal length) | pyarrow.Table
+
+
+def is_arrow(block) -> bool:
+    return pa is not None and isinstance(block, pa.Table)
 
 
 def normalize(data) -> Block:
-    """rows (list of dicts / scalars) or columns (dict of arrays) → Block."""
+    """rows (list of dicts / scalars), columns (dict of arrays), or an
+    Arrow table → Block."""
+    if is_arrow(data):
+        return data
     if isinstance(data, dict):
         return {k: np.asarray(v) for k, v in data.items()}
     if isinstance(data, np.ndarray):
@@ -32,17 +50,40 @@ def normalize(data) -> Block:
     return {"data": np.asarray(rows)}
 
 
+def to_columns(block: Block) -> dict:
+    """Any block → column dict of numpy arrays (the device-feed layout)."""
+    if is_arrow(block):
+        return {c: block[c].to_numpy(zero_copy_only=False)
+                for c in block.column_names}
+    return block
+
+
+def to_arrow(block: Block):
+    """Any block → pyarrow.Table."""
+    if pa is None:
+        raise ImportError("pyarrow is not available")
+    if is_arrow(block):
+        return block
+    return pa.table({k: np.asarray(v) for k, v in block.items()})
+
+
 def num_rows(block: Block) -> int:
+    if is_arrow(block):
+        return block.num_rows
     for v in block.values():
         return len(v)
     return 0
 
 
 def size_bytes(block: Block) -> int:
+    if is_arrow(block):
+        return block.nbytes
     return sum(v.nbytes for v in block.values())
 
 
 def slice_block(block: Block, start: int, end: int) -> Block:
+    if is_arrow(block):
+        return block.slice(start, end - start)
     return {k: v[start:end] for k, v in block.items()}
 
 
@@ -50,19 +91,52 @@ def concat(blocks: list[Block]) -> Block:
     blocks = [b for b in blocks if num_rows(b)]
     if not blocks:
         return {}
+    if any(is_arrow(b) for b in blocks):
+        return pa.concat_tables([to_arrow(b) for b in blocks])
     keys = blocks[0].keys()
     return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
 
 
 def to_rows(block: Block) -> list[dict]:
+    if is_arrow(block):
+        return block.to_pylist()
     n = num_rows(block)
     keys = list(block.keys())
     return [{k: block[k][i] for k in keys} for i in range(n)]
 
 
 def take_rows(block: Block, idx: np.ndarray) -> Block:
+    if is_arrow(block):
+        return block.take(pa.array(np.asarray(idx)))
     return {k: v[idx] for k, v in block.items()}
 
 
+def column(block: Block, name: str) -> np.ndarray:
+    if is_arrow(block):
+        return block[name].to_numpy(zero_copy_only=False)
+    return np.asarray(block[name])
+
+
+def column_names(block: Block) -> list[str]:
+    if is_arrow(block):
+        return list(block.column_names)
+    return list(block.keys())
+
+
+def drop(block: Block, cols: list[str]) -> Block:
+    if is_arrow(block):
+        return block.drop_columns([c for c in cols
+                                   if c in block.column_names])
+    return {k: v for k, v in block.items() if k not in cols}
+
+
+def select(block: Block, cols: list[str]) -> Block:
+    if is_arrow(block):
+        return block.select(cols)
+    return {k: block[k] for k in cols}
+
+
 def schema(block: Block) -> dict:
+    if is_arrow(block):
+        return {f.name: (f.type, ()) for f in block.schema}
     return {k: (v.dtype, v.shape[1:]) for k, v in block.items()}

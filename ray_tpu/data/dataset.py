@@ -88,7 +88,7 @@ class Dataset:
 
     def to_pandas(self):
         import pandas as pd
-        full = B.concat(self._materialize())
+        full = B.to_columns(B.concat(self._materialize()))
         return pd.DataFrame({k: list(v) if v.ndim > 1 else v
                              for k, v in full.items()})
 
@@ -178,15 +178,37 @@ class Dataset:
         return Dataset(blocks or [{}])
 
     @staticmethod
-    def read_parquet(paths: Union[str, list[str]]) -> "Dataset":
+    def read_parquet(paths: Union[str, list[str]], *,
+                     block_format: str = "arrow") -> "Dataset":
+        """Parquet files → one block per file (reference:
+        datasource/parquet_datasource.py).  block_format="arrow" keeps
+        the zero-copy Tables; "numpy" converts eagerly."""
         import pyarrow.parquet as pq
-        paths = [paths] if isinstance(paths, str) else list(paths)
+        paths = Dataset._expand_paths(paths)
         out = []
         for p in paths:
             t = pq.read_table(p)
-            out.append({c: t[c].to_numpy(zero_copy_only=False)
-                        for c in t.column_names})
+            out.append(t if block_format == "arrow"
+                       else {c: t[c].to_numpy(zero_copy_only=False)
+                             for c in t.column_names})
         return Dataset(out)
+
+    @staticmethod
+    def from_arrow(tables) -> "Dataset":
+        """pyarrow.Table(s) → Dataset with Arrow blocks (reference:
+        from_arrow, python/ray/data/read_api.py)."""
+        if not isinstance(tables, (list, tuple)):
+            tables = [tables]
+        return Dataset([B.to_arrow(t) for t in tables])
+
+    def to_arrow(self):
+        """Materialize to a single pyarrow.Table."""
+        import pyarrow as pa
+        blocks = [B.to_arrow(b) for b in self._materialize()
+                  if B.num_rows(b)]
+        if not blocks:
+            return pa.table({})
+        return pa.concat_tables(blocks)
 
     def write_parquet(self, dir_path: str) -> list[str]:
         import os
@@ -196,7 +218,7 @@ class Dataset:
         paths = []
         for i, blk in enumerate(self._materialize()):
             p = f"{dir_path}/part-{i:05d}.parquet"
-            pq.write_table(pa.table({k: v for k, v in blk.items()}), p)
+            pq.write_table(B.to_arrow(blk), p)
             paths.append(p)
         return paths
 
@@ -207,7 +229,7 @@ class Dataset:
         paths = []
         for i, blk in enumerate(self._materialize()):
             p = f"{dir_path}/part-{i:05d}.csv"
-            pd.DataFrame(dict(blk)).to_csv(p, index=False)
+            pd.DataFrame(dict(B.to_columns(blk))).to_csv(p, index=False)
             paths.append(p)
         return paths
 
@@ -233,7 +255,7 @@ class Dataset:
         paths = []
         for i, blk in enumerate(self._materialize()):
             p = f"{dir_path}/part-{i:05d}.npy"
-            np.save(p, np.asarray(blk[column]), allow_pickle=False)
+            np.save(p, B.column(blk, column), allow_pickle=False)
             paths.append(p)
         return paths
 
@@ -244,15 +266,22 @@ class Dataset:
 
     def map_batches(self, fn: Callable[[dict], dict], *,
                     batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
                     **_compat) -> "Dataset":
-        """fn: column-dict -> column-dict (reference: dataset.py:364)."""
+        """fn over batches (reference: dataset.py:364).  batch_format:
+        "numpy" hands fn a column dict; "arrow" hands it a
+        pyarrow.Table (reference arrow batch format)."""
+        def convert(blk):
+            return (B.to_arrow(blk) if batch_format == "arrow"
+                    else dict(B.to_columns(blk)))
+
         def stage(blk: B.Block) -> B.Block:
             if batch_size is None or B.num_rows(blk) <= batch_size:
-                return B.normalize(fn(dict(blk)))
+                return B.normalize(fn(convert(blk)))
             outs = []
             for s in range(0, B.num_rows(blk), batch_size):
                 outs.append(B.normalize(fn(
-                    dict(B.slice_block(blk, s, s + batch_size)))))
+                    convert(B.slice_block(blk, s, s + batch_size)))))
             return B.concat(outs)
         return self._with_stage(stage)
 
@@ -269,8 +298,8 @@ class Dataset:
 
     def add_column(self, name: str, fn: Callable[[dict], np.ndarray]):
         def stage(blk):
-            out = dict(blk)
-            out[name] = np.asarray(fn(dict(blk)))
+            out = dict(B.to_columns(blk))
+            out[name] = np.asarray(fn(dict(out)))
             return out
         return self._with_stage(stage)
 
@@ -285,12 +314,12 @@ class Dataset:
 
     def drop_columns(self, cols: list[str]) -> "Dataset":
         def stage(blk):
-            return {k: v for k, v in blk.items() if k not in cols}
+            return B.drop(blk, cols)
         return self._with_stage(stage)
 
     def select_columns(self, cols: list[str]) -> "Dataset":
         def stage(blk):
-            return {k: blk[k] for k in cols}
+            return B.select(blk, cols)
         return self._with_stage(stage)
 
     def random_sample(self, fraction: float, *,
@@ -313,7 +342,7 @@ class Dataset:
             rows = B.num_rows(blk)
             take = min(rows, n - have)
             if take > 0:
-                out.append(dict(B.slice_block(blk, 0, take)))
+                out.append(B.slice_block(blk, 0, take))
                 have += take
             if have >= n:
                 break
@@ -345,7 +374,7 @@ class Dataset:
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         full = B.concat(self._materialize())
-        order = np.argsort(full[key], kind="stable")
+        order = np.argsort(B.column(full, key), kind="stable")
         if descending:
             order = order[::-1]
         return Dataset([B.take_rows(full, order)])
@@ -368,8 +397,8 @@ class Dataset:
     def zip(self, other: "Dataset") -> "Dataset":
         """Column-wise zip of equal-length datasets (reference:
         dataset.zip; clashing names get a _1 suffix)."""
-        a = B.concat(self._materialize())
-        b = B.concat(other._materialize())
+        a = B.to_columns(B.concat(self._materialize()))
+        b = B.to_columns(B.concat(other._materialize()))
         if B.num_rows(a) != B.num_rows(b):
             raise ValueError("zip requires equal row counts")
         out = dict(a)
@@ -406,7 +435,7 @@ class Dataset:
     # -- global aggregates -------------------------------------------------
 
     def _column(self, col: str) -> np.ndarray:
-        parts = [np.asarray(b[col]) for b in self._materialize()
+        parts = [B.column(b, col) for b in self._materialize()
                  if B.num_rows(b)]
         return (np.concatenate(parts) if parts
                 else np.empty(0))
@@ -461,8 +490,18 @@ class Dataset:
                 out.append(b)
         return out
 
-    def _iter_staged_blocks(self) -> Iterator:
-        """Blocks with stages applied, one at a time (streaming shape)."""
+    def _iter_staged_blocks(self, parallelism: str = "inline",
+                            max_in_flight: int = 4) -> Iterator:
+        """Blocks with stages applied, one at a time (streaming shape).
+        parallelism="streaming" runs stages as remote tasks with at most
+        max_in_flight blocks submitted — op-level backpressure
+        (reference: streaming_executor.py:31)."""
+        if parallelism == "streaming" and self._stages:
+            from ray_tpu.data.streaming import StreamingExecutor
+            yield from StreamingExecutor(
+                self._stages,
+                max_in_flight=max_in_flight).execute(self._resolve_blocks())
+            return
         for i, blk in enumerate(self._resolve_blocks()):
             yield _apply_stages(blk, self._stages, i)
 
@@ -476,6 +515,9 @@ class Dataset:
             return blocks
 
         stages = self._stages
+        if parallelism == "streaming":
+            return list(self._iter_staged_blocks("streaming",
+                                                 max_in_flight=num_actors))
         if parallelism == "tasks":
             import ray_tpu
             task = ray_tpu.remote(_apply_stages)
@@ -533,27 +575,41 @@ class Dataset:
 
     def iter_batches(self, *, batch_size: int = 256,
                      drop_last: bool = False,
-                     shuffle_seed: Optional[int] = None) -> Iterator[dict]:
+                     shuffle_seed: Optional[int] = None,
+                     parallelism: str = "inline",
+                     max_in_flight: int = 4) -> Iterator[dict]:
         """Stream column-dict batches; stages run block-by-block
-        (streaming-executor shape: no global materialization)."""
+        (streaming-executor shape: no global materialization).
+        parallelism="streaming" pushes stage work to remote tasks with a
+        bounded in-flight window — the consumer's pace throttles
+        submission."""
         carry: Optional[dict] = None
         blocks = self._resolve_blocks()
         order = list(range(len(blocks)))
         if shuffle_seed is not None:
             np.random.default_rng(shuffle_seed).shuffle(order)
 
-        for bi in order:
-            blk = _apply_stages(blocks[bi], self._stages, bi)
+        if parallelism == "streaming" and self._stages:
+            from ray_tpu.data.streaming import StreamingExecutor
+            staged_iter = StreamingExecutor(
+                self._stages, max_in_flight=max_in_flight).execute(
+                    (blocks[bi] for bi in order), indices=order)
+        else:
+            staged_iter = (_apply_stages(blocks[bi], self._stages, bi)
+                           for bi in order)
+
+        for blk in staged_iter:
             if carry is not None:
                 blk = B.concat([carry, blk])
                 carry = None
             n = B.num_rows(blk)
             s = 0
             while n - s >= batch_size:
-                yield dict(B.slice_block(blk, s, s + batch_size))
+                yield dict(B.to_columns(B.slice_block(blk, s,
+                                                      s + batch_size)))
                 s += batch_size
             if s < n:
-                carry = dict(B.slice_block(blk, s, n))
+                carry = dict(B.to_columns(B.slice_block(blk, s, n)))
         if carry is not None and not drop_last:
             yield carry
 

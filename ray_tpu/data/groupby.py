@@ -137,7 +137,7 @@ class GroupedData:
         """fn: group block → block (reference: map_groups).  Groups are
         materialized per key (global)."""
         blocks = self._ds._materialize()
-        full = B.concat([b for b in blocks if B.num_rows(b)])
+        full = B.to_columns(B.concat([b for b in blocks if B.num_rows(b)]))
         keys = np.asarray(full[self._key])
         uniq, inv = np.unique(keys, return_inverse=True)
         outs = []

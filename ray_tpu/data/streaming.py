@@ -1,0 +1,58 @@
+"""Streaming executor: bounded-in-flight block pipeline.
+
+The capability analogue of the reference's streaming executor
+(reference: python/ray/data/_internal/execution/streaming_executor.py:31
+— pull-based operator execution with resource-based backpressure).
+Scoped here to the shape that matters: at most ``max_in_flight`` blocks
+are ever submitted as remote tasks; output is consumed in order, and the
+consumer's pace throttles submission (op-level backpressure), so a slow
+sink never piles unbounded blocks into the object store.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterable, Iterator, Optional
+
+
+class StreamingExecutor:
+    def __init__(self, stages: list, max_in_flight: int = 4,
+                 get_timeout: Optional[float] = 600.0):
+        self.stages = stages
+        self.max_in_flight = max(1, max_in_flight)
+        self.get_timeout = get_timeout
+        self.stats = {"blocks": 0, "max_in_flight_observed": 0}
+
+    def execute(self, blocks: Iterable,
+                indices: Optional[Iterable[int]] = None) -> Iterator:
+        """Stream staged blocks, in input order.  Submission is strictly
+        bounded: a new block is sent only after the oldest result has
+        been yielded AND consumed downstream.  ``indices`` carries the
+        ORIGINAL block indices when the stream is reordered (index-aware
+        stages like random_sample seed per original block, so all
+        execution modes must agree on the index)."""
+        import ray_tpu
+        from ray_tpu.data.dataset import _apply_stages
+
+        task = ray_tpu.remote(_apply_stages)
+        pending: deque = deque()
+        it = (zip(indices, blocks) if indices is not None
+              else enumerate(blocks))
+
+        def submit(i, blk):
+            pending.append(task.remote(blk, self.stages, i))
+            self.stats["max_in_flight_observed"] = max(
+                self.stats["max_in_flight_observed"], len(pending))
+
+        for i, blk in it:
+            submit(i, blk)
+            if len(pending) < self.max_in_flight:
+                continue
+            out = ray_tpu.get(pending.popleft(),
+                              timeout=self.get_timeout)
+            self.stats["blocks"] += 1
+            yield out
+        while pending:
+            out = ray_tpu.get(pending.popleft(), timeout=self.get_timeout)
+            self.stats["blocks"] += 1
+            yield out
