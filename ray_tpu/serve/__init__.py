@@ -5,11 +5,13 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
+from ray_tpu.serve.asgi import ingress
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.controller import ServeController
 from ray_tpu.serve.deployment import (AutoscalingConfig, Deployment,
                                       DeploymentOptions, deployment)
-from ray_tpu.serve.handle import DeploymentHandle, ServeResponse
+from ray_tpu.serve.handle import (DeploymentHandle, RemoteDeploymentHandle,
+                                  ServeResponse)
 from ray_tpu.serve.http_proxy import HttpProxy
 
 _controller: Optional[ServeController] = None
@@ -23,16 +25,47 @@ def _get_controller() -> ServeController:
     return _controller
 
 
+def _deploy_tree(dep: Deployment, ctrl: ServeController,
+                 use_actors: Optional[bool],
+                 seen: dict) -> DeploymentHandle:
+    """Deployment graph: Deployment-valued init args are child nodes —
+    deploy children first, inject handles in their place (reference:
+    serve deployment graphs on the DAG layer, serve/dag/; handles cross
+    process boundaries as RemoteDeploymentHandle via pickling)."""
+    if dep.name in seen:
+        return seen[dep.name]
+
+    def resolve(v):
+        return (_deploy_tree(v, ctrl, use_actors, seen)
+                if isinstance(v, Deployment) else v)
+
+    resolved = dep.bind(*(resolve(a) for a in dep.init_args),
+                        **{k: resolve(v)
+                           for k, v in dep.init_kwargs.items()})
+    state = ctrl.deploy(resolved, use_actors=use_actors)
+    handle = DeploymentHandle(state)
+    seen[dep.name] = handle
+    return handle
+
+
 def run(dep: Deployment, *, use_actors: Optional[bool] = None,
-        http: bool = False, port: int = 0) -> DeploymentHandle:
-    """Deploy and return a handle (reference: serve.run api.py:455)."""
+        http: bool = False, port: int = 0,
+        proxy: str = "asyncio") -> DeploymentHandle:
+    """Deploy (a graph of) deployment(s) and return the root handle
+    (reference: serve.run api.py:455).  proxy: "asyncio" (concurrent,
+    streaming + ASGI capable) or "threaded" (the round-1 stdlib
+    server)."""
     global _proxy
     ctrl = _get_controller()
-    state = ctrl.deploy(dep, use_actors=use_actors)
+    handle = _deploy_tree(dep, ctrl, use_actors, {})
     if http and _proxy is None:
-        _proxy = HttpProxy(ctrl, port=port)
+        if proxy == "asyncio":
+            from ray_tpu.serve.asgi import AsyncHttpProxy
+            _proxy = AsyncHttpProxy(ctrl, port=port)
+        else:
+            _proxy = HttpProxy(ctrl, port=port)
         _proxy.start()
-    return DeploymentHandle(state)
+    return handle
 
 
 def get_handle(name: str) -> DeploymentHandle:
@@ -66,7 +99,7 @@ def shutdown() -> None:
 
 __all__ = [
     "deployment", "Deployment", "DeploymentOptions", "AutoscalingConfig",
-    "DeploymentHandle", "ServeResponse", "ServeController", "HttpProxy",
-    "batch", "run", "get_handle", "delete", "shutdown", "status",
-    "proxy_address",
+    "DeploymentHandle", "RemoteDeploymentHandle", "ServeResponse",
+    "ServeController", "HttpProxy", "ingress", "batch", "run",
+    "get_handle", "delete", "shutdown", "status", "proxy_address",
 ]

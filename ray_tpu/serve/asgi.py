@@ -1,0 +1,307 @@
+"""Asyncio ingress: concurrent HTTP proxy with streaming + ASGI support.
+
+Reference capability: the uvicorn/starlette proxy
+(python/ray/serve/_private/http_proxy.py:230,399 — an asyncio event
+loop multiplexes thousands of in-flight requests; responses may stream;
+user apps may be ASGI applications via @serve.ingress).  Dependency-free
+here: a hand-rolled HTTP/1.1 server on asyncio.start_server, chunked
+transfer-encoding for iterator results, and a minimal ASGI 3.0 driver
+for ingress apps.
+
+Routes stay in a local table refreshed by the controller's long-poll
+host — the proxy never reaches into controller state per request
+(reference: proxy route table via LongPollClient).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Optional
+from urllib.parse import unquote, urlparse
+
+from ray_tpu.serve.deployment import Deployment, DeploymentOptions
+from ray_tpu.serve.http_proxy import _jsonable
+from ray_tpu.serve.long_poll import LongPollClient
+
+
+class _ASGIReplica:
+    """Replica body driving a user ASGI app: one request-response cycle
+    per call, messages collected and returned as a plain dict so the
+    result crosses process boundaries."""
+
+    def __init__(self, app):
+        self._app = app
+
+    def handle_asgi(self, scope: dict, body: bytes) -> dict:
+        async def drive():
+            sent_body = False
+            messages: list = []
+
+            async def receive():
+                nonlocal sent_body
+                if sent_body:
+                    return {"type": "http.disconnect"}
+                sent_body = True
+                return {"type": "http.request", "body": body,
+                        "more_body": False}
+
+            async def send(msg):
+                messages.append(msg)
+
+            full_scope = dict(scope)
+            full_scope.setdefault("type", "http")
+            full_scope.setdefault("asgi", {"version": "3.0"})
+            await self._app(full_scope, receive, send)
+            return messages
+
+        messages = asyncio.run(drive())
+        status, headers, chunks = 200, [], []
+        for m in messages:
+            if m["type"] == "http.response.start":
+                status = m["status"]
+                headers = [(bytes(k).decode("latin1"),
+                            bytes(v).decode("latin1"))
+                           for k, v in m.get("headers", [])]
+            elif m["type"] == "http.response.body":
+                chunks.append(bytes(m.get("body", b"")))
+        return {"status": status, "headers": headers,
+                "body": b"".join(chunks)}
+
+
+def ingress(asgi_app, *, name: Optional[str] = None,
+            num_replicas: int = 1,
+            max_concurrent_queries: int = 32) -> Deployment:
+    """Wrap an ASGI application as a deployment (reference:
+    @serve.ingress(fastapi_app), serve/api.py ingress)."""
+    dep = Deployment(_ASGIReplica, DeploymentOptions(
+        name=name or getattr(asgi_app, "__name__", "asgi_app"),
+        num_replicas=num_replicas,
+        max_concurrent_queries=max_concurrent_queries),
+        init_args=(asgi_app,))
+    dep.is_asgi = True
+    return dep
+
+
+class AsyncHttpProxy:
+    """Concurrent HTTP/1.1 ingress on an asyncio loop thread.
+
+    Each connection is an asyncio task; replica calls run on the default
+    executor so slow handlers never stall the accept loop.  Iterator /
+    generator results stream as chunked transfer-encoding."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+        self.controller = controller
+        self._host_arg, self._port_arg = host, port
+        self.host: str = host
+        self.port: int = 0
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server = None
+        self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # long-polled route table: never touch controller state per
+        # request (reference: proxy LongPollClient on route updates)
+        self._routes: set[str] = set(controller.deployments.keys())
+        self._lp = LongPollClient(
+            controller.long_poll, ["routes"],
+            lambda key, snapshot: self._set_routes(snapshot))
+
+    def _set_routes(self, snapshot) -> None:
+        self._routes = set(snapshot or ())
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="raytpu-serve-asgi")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("asyncio proxy failed to start")
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host_arg, self._port_arg)
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+        self._loop.run_until_complete(boot())
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    def stop(self) -> None:
+        self._lp.stop()
+        if self._loop is None:
+            return
+
+        def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+        self._loop.call_soon_threadsafe(_shutdown)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- serving
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                keep_alive = await self._dispatch(writer, *req)
+                if not keep_alive:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, target, _version = line.decode("latin1").split()
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode("latin1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length") or 0)
+        body = await reader.readexactly(n) if n else b""
+        return method, target, headers, body
+
+    async def _dispatch(self, writer, method, target, headers,
+                        body) -> bool:
+        parsed = urlparse(target)
+        path = unquote(parsed.path)
+        stripped = path.strip("/")
+        if stripped == "-/healthz":
+            await self._respond_json(writer, 200, {"status": "ok"})
+            return True
+        if stripped == "-/routes":
+            await self._respond_json(writer, 200, sorted(self._routes))
+            return True
+        name = stripped.split("/")[0]
+        if name not in self._routes:
+            await self._respond_json(writer, 404,
+                                     {"error": f"no route /{name}"})
+            return True
+        try:
+            state = self.controller.get(name)
+        except KeyError:
+            await self._respond_json(writer, 404,
+                                     {"error": f"no route /{name}"})
+            return True
+
+        loop = asyncio.get_running_loop()
+        if getattr(state.deployment, "is_asgi", False):
+            scope = {
+                "type": "http", "method": method, "path": path,
+                "raw_path": path.encode(), "root_path": "",
+                "query_string": parsed.query.encode(),
+                "headers": [(k.encode("latin1"), v.encode("latin1"))
+                            for k, v in headers.items()],
+            }
+            from ray_tpu.serve.handle import DeploymentHandle
+            handle = DeploymentHandle(state, "handle_asgi")
+            try:
+                out = await loop.run_in_executor(
+                    None,
+                    lambda: handle.remote(scope, body).result(timeout=120))
+            except Exception as e:
+                # same contract as the JSON path: app errors become 500s,
+                # never dropped connections
+                await self._respond_json(writer, 500, {"error": str(e)})
+                return True
+            await self._respond_raw(writer, out["status"], out["headers"],
+                                    out["body"])
+            return True
+
+        try:
+            arg = json.loads(body) if body else None
+        except json.JSONDecodeError:
+            arg = body.decode("utf-8", "replace")
+        from ray_tpu.serve.handle import DeploymentHandle
+        handle = DeploymentHandle(state)
+        try:
+            out = await loop.run_in_executor(
+                None, lambda: handle.remote(arg).result(timeout=120))
+        except Exception as e:
+            await self._respond_json(writer, 500, {"error": str(e)})
+            return True
+        if hasattr(out, "__next__") or hasattr(out, "__anext__"):
+            await self._respond_stream(writer, out, loop)
+            return False   # chunked stream ends the connection
+        await self._respond_json(writer, 200, {"result": _jsonable(out)})
+        return True
+
+    # ------------------------------------------------------------ responses
+
+    async def _respond_json(self, writer, status: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        await self._respond_raw(
+            writer, status, [("Content-Type", "application/json")], body)
+
+    async def _respond_raw(self, writer, status: int, headers, body: bytes):
+        lines = [f"HTTP/1.1 {status} X".encode()]
+        seen = {k.lower() for k, _ in headers}
+        hdrs = list(headers)
+        if "content-length" not in seen:
+            hdrs.append(("Content-Length", str(len(body))))
+        for k, v in hdrs:
+            lines.append(f"{k}: {v}".encode("latin1"))
+        writer.write(b"\r\n".join(lines) + b"\r\n\r\n" + body)
+        await writer.drain()
+
+    async def _respond_stream(self, writer, it, loop) -> None:
+        """Chunked transfer-encoding over a (sync) iterator result —
+        each chunk flushes as the replica produces it (reference:
+        StreamingResponse through the proxy)."""
+        writer.write(b"HTTP/1.1 200 X\r\n"
+                     b"Content-Type: application/octet-stream\r\n"
+                     b"Transfer-Encoding: chunked\r\n"
+                     b"Connection: close\r\n\r\n")
+        await writer.drain()
+
+        async def write_chunk(chunk):
+            data = (chunk if isinstance(chunk, bytes)
+                    else json.dumps(_jsonable(chunk)).encode())
+            writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            await writer.drain()
+
+        if hasattr(it, "__anext__"):
+            # async generator results drive directly on this loop
+            async for chunk in it:
+                await write_chunk(chunk)
+        else:
+            _SENTINEL = object()
+
+            def next_chunk():
+                try:
+                    return next(it)
+                except StopIteration:
+                    return _SENTINEL
+
+            while True:
+                chunk = await loop.run_in_executor(None, next_chunk)
+                if chunk is _SENTINEL:
+                    break
+                await write_chunk(chunk)
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()

@@ -72,13 +72,22 @@ class DeploymentState:
     deployment_state.py DeploymentState; states collapsed to
     RUNNING/dead)."""
 
-    def __init__(self, deployment: Deployment, use_actors: bool):
+    def __init__(self, deployment: Deployment, use_actors: bool,
+                 on_membership_change=None):
         self.deployment = deployment
         self.use_actors = use_actors
         self.replicas: list[ReplicaHandle] = []
         self._rr = itertools.count()
         self._lock = threading.Lock()
+        self._on_membership_change = on_membership_change
         self.scale_to(deployment.options.num_replicas)
+
+    def _membership_changed(self) -> None:
+        if self._on_membership_change is not None:
+            try:
+                self._on_membership_change(self)
+            except Exception:
+                traceback.print_exc()
 
     # -- replica lifecycle -------------------------------------------------
 
@@ -93,17 +102,22 @@ class DeploymentState:
 
     def scale_to(self, n: int) -> None:
         n = max(0, n)
+        changed = False
         with self._lock:
             while len(self.replicas) < n:
                 self.replicas.append(self._start_replica())
+                changed = True
             while len(self.replicas) > n:
                 r = self.replicas.pop()
+                changed = True
                 if r.is_actor:
                     import ray_tpu
                     try:
                         ray_tpu.kill(r.impl)
                     except Exception:
                         pass
+        if changed:
+            self._membership_changed()
 
     def restart_dead(self) -> int:
         """Health-check replicas; replace dead ones (reference:
@@ -121,15 +135,19 @@ class DeploymentState:
                 if not ok:
                     self.replicas[i] = self._start_replica()
                     replaced += 1
+        if replaced:
+            self._membership_changed()
         return replaced
 
     # -- routing -----------------------------------------------------------
 
-    def assign_replica(self) -> ReplicaHandle:
+    def assign_replica(self, timeout: float = 60.0) -> ReplicaHandle:
         """Round-robin among replicas with free slots; block if all are
         at max_concurrent_queries (reference: router.py:221
-        assign_replica backpressure)."""
+        assign_replica backpressure).  A deployment stuck at zero
+        replicas past the timeout raises instead of spinning forever."""
         maxq = self.deployment.options.max_concurrent_queries
+        deadline = time.monotonic() + timeout
         while True:
             with self._lock:
                 if self.replicas:
@@ -139,6 +157,14 @@ class DeploymentState:
                         if r.ongoing < maxq:
                             r.ongoing += 1
                             return r
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"deployment {self.deployment.name!r}: no replica "
+                    f"available within {timeout}s "
+                    f"({len(self.replicas)} replicas, all saturated)"
+                    if self.replicas else
+                    f"deployment {self.deployment.name!r} has no "
+                    "replicas (deleted or scaled to zero?)")
             time.sleep(0.001)
 
     def release(self, r: ReplicaHandle):
@@ -171,9 +197,47 @@ class ServeController:
     reconciliation; here driver-side, exposed via ray_tpu.serve.api)"""
 
     def __init__(self):
+        from ray_tpu.serve.long_poll import LongPollHost
         self.deployments: dict[str, DeploymentState] = {}
+        self.long_poll = LongPollHost()
         self._autoscale_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._publish_lock = threading.Lock()
+
+    def _publish_membership(self, st: DeploymentState) -> None:
+        """Config-push choke point: version the replica membership for
+        in-process long-pollers AND mirror it into the core KV store so
+        cross-process handles can refresh without a controller hop
+        (reference: long_poll.py LongPollNamespace.REPLICA_HANDLES).
+
+        Snapshot + publish run under one lock so concurrent scale
+        operations (autoscaler vs driver) can neither tear the replica
+        list nor publish out of order; an empty membership DELETES the
+        KV mirror so remote handles fail fast instead of routing to
+        killed actors."""
+        name = st.deployment.name
+        import ray_tpu
+        with self._publish_lock:
+            with st._lock:
+                snapshot = {
+                    "replicas": [r.impl for r in st.replicas if r.is_actor],
+                    "max_concurrent_queries":
+                        st.deployment.options.max_concurrent_queries,
+                }
+            self.long_poll.notify(f"replicas:{name}", snapshot)
+            self.long_poll.notify("routes",
+                                  sorted(self.deployments.keys()))
+            if ray_tpu.is_initialized():
+                import cloudpickle
+                key = f"serve:replicas:{name}".encode()
+                try:
+                    if snapshot["replicas"]:
+                        ray_tpu.get_runtime().client.kv_put(
+                            key, cloudpickle.dumps(snapshot))
+                    else:
+                        ray_tpu.get_runtime().client.kv_del(key)
+                except Exception:
+                    traceback.print_exc()
 
     def deploy(self, deployment: Deployment,
                use_actors: Optional[bool] = None) -> DeploymentState:
@@ -185,15 +249,20 @@ class ServeController:
         existing = self.deployments.get(deployment.name)
         if existing is not None:
             existing.scale_to(0)
-        st = DeploymentState(deployment, use_actors)
+        st = DeploymentState(deployment, use_actors,
+                             on_membership_change=self._publish_membership)
         self.deployments[deployment.name] = st
+        self._publish_membership(st)
         self._ensure_autoscaler()
         return st
 
     def delete(self, name: str) -> None:
         st = self.deployments.pop(name, None)
         if st is not None:
-            st.scale_to(0)
+            st.scale_to(0)   # publishes empty membership -> kv_del
+            self.long_poll.drop(f"replicas:{name}")
+            self.long_poll.notify("routes",
+                                  sorted(self.deployments.keys()))
 
     def get(self, name: str) -> DeploymentState:
         if name not in self.deployments:

@@ -79,8 +79,23 @@ class DeploymentHandle:
                 cls._pool = ThreadPoolExecutor(max_workers=32)
         return cls._pool
 
+    def _current_state(self) -> DeploymentState:
+        """Re-resolve by name: a redeploy replaces the DeploymentState,
+        and a handle bound to the dead one would spin on zero replicas
+        forever."""
+        try:
+            from ray_tpu import serve as _serve
+            ctrl = _serve._controller
+            if ctrl is not None:
+                st = ctrl.deployments.get(self._state.deployment.name)
+                if st is not None and st is not self._state:
+                    self._state = st
+        except Exception:
+            pass
+        return self._state
+
     def remote(self, *args, **kwargs) -> ServeResponse:
-        state, method = self._state, self._method
+        state, method = self._current_state(), self._method
         replica = state.assign_replica()
         if replica.is_actor:
             ref = replica.impl.handle_request.remote(method, args, kwargs)
@@ -98,3 +113,110 @@ class DeploymentHandle:
                 return fut.result(timeout)
 
         return ServeResponse(resolve, lambda: state.release(replica))
+
+    def __reduce__(self):
+        # a handle crossing a process boundary (deployment-graph child
+        # injected into a replica's constructor) becomes a
+        # RemoteDeploymentHandle that routes via the KV-mirrored replica
+        # membership — the controller object cannot travel
+        return (RemoteDeploymentHandle,
+                (self.deployment_name, self._method))
+
+
+class RemoteDeploymentHandle:
+    """Process-portable deployment handle (the router half the reference
+    ships inside every replica: _private/router.py + long-poll replica
+    membership).  Replica actor handles come from the KV mirror the
+    controller maintains; the snapshot refreshes on a short TTL or on
+    call failure, so scaling/restarts propagate without a central hop
+    per request."""
+
+    REFRESH_S = 1.0
+
+    def __init__(self, name: str, method: str = "__call__"):
+        self._name = name
+        self._method = method
+        self._replicas: list = []
+        self._maxq = 8
+        self._fetched_at = 0.0
+        self._rr = 0
+        self._ongoing: dict[int, int] = {}   # replica index -> in-flight
+        self._lock = threading.Lock()
+
+    def options(self, *, method_name: str) -> "RemoteDeploymentHandle":
+        return RemoteDeploymentHandle(self._name, method_name)
+
+    def __getattr__(self, name: str) -> "RemoteDeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return RemoteDeploymentHandle(self._name, name)
+
+    def __reduce__(self):
+        return (RemoteDeploymentHandle, (self._name, self._method))
+
+    def _refresh(self, force: bool = False) -> None:
+        import time as _time
+        now = _time.monotonic()
+        with self._lock:
+            if (not force and self._replicas
+                    and now - self._fetched_at < self.REFRESH_S):
+                return
+        import cloudpickle
+        import ray_tpu
+        raw = ray_tpu.get_runtime().client.kv_get(
+            f"serve:replicas:{self._name}".encode())
+        if raw is None:
+            raise RuntimeError(
+                f"no replica membership for deployment {self._name!r} "
+                "(not deployed with actor replicas?)")
+        snap = cloudpickle.loads(raw)
+        with self._lock:
+            if snap["replicas"] is not self._replicas:
+                self._ongoing = {}   # membership changed: counts reset
+            self._replicas = snap["replicas"]
+            self._maxq = snap["max_concurrent_queries"]
+            self._fetched_at = now
+
+    def _assign(self, timeout: float = 60.0):
+        """Round-robin with per-handle max_concurrent_queries
+        backpressure — the remote path must honor the same concurrency
+        bound the local router enforces (router.py:221)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while True:
+            self._refresh()
+            with self._lock:
+                n = len(self._replicas)
+                if n == 0:
+                    raise RuntimeError(f"deployment {self._name!r} has "
+                                       "no actor replicas")
+                for _ in range(n):
+                    self._rr += 1
+                    i = self._rr % n
+                    if self._ongoing.get(i, 0) < self._maxq:
+                        self._ongoing[i] = self._ongoing.get(i, 0) + 1
+                        return i, self._replicas[i]
+            if _time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"deployment {self._name!r}: all replicas saturated "
+                    f"for {timeout}s")
+            _time.sleep(0.001)
+
+    def _release(self, i: int) -> None:
+        with self._lock:
+            if self._ongoing.get(i, 0) > 0:
+                self._ongoing[i] -= 1
+
+    def remote(self, *args, **kwargs) -> ServeResponse:
+        i, replica = self._assign()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+
+        def resolve(timeout):
+            import ray_tpu
+            try:
+                return ray_tpu.get(ref, timeout=timeout)
+            except Exception:
+                # stale membership (replica died): refresh for next call
+                self._refresh(force=True)
+                raise
+        return ServeResponse(resolve, lambda: self._release(i))

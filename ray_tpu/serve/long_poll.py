@@ -1,0 +1,85 @@
+"""Long-poll config propagation.
+
+Reference capability: serve's LongPollHost/LongPollClient
+(python/ray/serve/_private/long_poll.py:185 — listeners block on a set
+of keys until any snapshot's version advances, then receive the changed
+snapshots).  The host lives in the controller; in-process listeners
+(the HTTP proxy's route table) block on a Condition, and snapshots are
+mirrored into the core KV store so cross-process handles can refresh
+replica membership without a controller hop.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+
+class LongPollHost:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._versions: dict[str, int] = {}
+        self._snapshots: dict[str, Any] = {}
+
+    def notify(self, key: str, snapshot: Any) -> None:
+        with self._lock:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._snapshots[key] = snapshot
+            self._lock.notify_all()
+
+    def drop(self, key: str) -> None:
+        with self._lock:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._snapshots.pop(key, None)
+            self._lock.notify_all()
+
+    def get(self, key: str):
+        with self._lock:
+            return self._versions.get(key, 0), self._snapshots.get(key)
+
+    def listen(self, known: dict[str, int],
+               timeout: Optional[float] = 30.0) -> dict[str, tuple]:
+        """Block until any key in `known` has a version newer than the
+        caller's, then return {key: (version, snapshot)} for the changed
+        keys.  Empty dict on timeout (the client just re-polls —
+        long-poll semantics, reference long_poll.py listen_for_change)."""
+        with self._lock:
+            def changed():
+                return {k: (self._versions.get(k, 0), self._snapshots.get(k))
+                        for k, v in known.items()
+                        if self._versions.get(k, 0) > v}
+            out = changed()
+            if out:
+                return out
+            self._lock.wait(timeout)
+            return changed()
+
+
+class LongPollClient:
+    """Background listener: invokes ``callback(key, snapshot)`` whenever
+    a watched key changes (reference: LongPollClient callbacks)."""
+
+    def __init__(self, host: LongPollHost, keys: list[str],
+                 callback: Callable[[str, Any], None]):
+        self._host = host
+        self._keys = {k: 0 for k in keys}
+        self._callback = callback
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="raytpu-serve-longpoll")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            updates = self._host.listen(dict(self._keys), timeout=1.0)
+            for key, (version, snapshot) in updates.items():
+                self._keys[key] = version
+                try:
+                    self._callback(key, snapshot)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

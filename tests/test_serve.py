@@ -167,3 +167,148 @@ def test_batching_per_instance_isolation():
     rb = b.handle(2)
     assert a.seen == [1] and b.seen == [2]  # no cross-instance leakage
     assert ra[1] != rb[1] or ra[0] != rb[0]
+
+
+# -- asyncio proxy / streaming / ASGI / graphs / long-poll ------------------
+
+def test_async_proxy_json_roundtrip():
+    @serve.deployment
+    class Echo:
+        def __call__(self, req):
+            return {"echo": req}
+
+    serve.run(Echo, use_actors=False, http=True, proxy="asyncio")
+    addr = serve.proxy_address()
+    with urllib.request.urlopen(f"{addr}/-/healthz", timeout=10) as r:
+        assert json.load(r)["status"] == "ok"
+    req = urllib.request.Request(
+        f"{addr}/Echo", data=json.dumps({"x": 3}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.load(r)["result"] == {"echo": {"x": 3}}
+    with urllib.request.urlopen(f"{addr}/-/routes", timeout=10) as r:
+        assert json.load(r) == ["Echo"]
+
+
+def test_async_proxy_streaming_response():
+    @serve.deployment
+    class Streamer:
+        def __call__(self, req):
+            def gen():
+                for i in range((req or {}).get("n", 3)):
+                    yield {"i": i}
+            return gen()
+
+    serve.run(Streamer, use_actors=False, http=True, proxy="asyncio")
+    addr = serve.proxy_address()
+    req = urllib.request.Request(
+        f"{addr}/Streamer", data=json.dumps({"n": 4}).encode())
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.headers.get("Transfer-Encoding") == "chunked"
+        body = r.read()   # urllib de-chunks transparently
+    payloads = [json.loads(x) for x in
+                body.replace(b"}{", b"}\x00{").split(b"\x00")]
+    assert payloads == [{"i": i} for i in range(4)]
+
+
+def test_asgi_ingress():
+    async def app(scope, receive, send):
+        msg = await receive()
+        body = msg.get("body", b"")
+        await send({"type": "http.response.start", "status": 201,
+                    "headers": [(b"content-type", b"text/plain"),
+                                (b"x-path", scope["path"].encode())]})
+        await send({"type": "http.response.body",
+                    "body": b"got:" + body})
+
+    dep = serve.ingress(app, name="api")
+    serve.run(dep, use_actors=False, http=True, proxy="asyncio")
+    addr = serve.proxy_address()
+    req = urllib.request.Request(f"{addr}/api/items", data=b"payload")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 201
+        assert r.headers["x-path"] == "/api/items"
+        assert r.read() == b"got:payload"
+
+
+def test_deployment_graph_inproc():
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            return self.pre.remote(x).result() + 1
+
+    graph = Model.bind(Preprocess)
+    h = serve.run(graph, use_actors=False)
+    assert h.remote(10).result() == 21
+    # both nodes deployed
+    assert set(serve.status().keys()) == {"Model", "Preprocess"}
+
+
+def test_deployment_graph_actors(rt_init):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Chain:
+        def __init__(self, inner):
+            self.inner = inner   # unpickles as RemoteDeploymentHandle
+
+        def __call__(self, x):
+            return self.inner.remote(x).result() + 5
+
+    h = serve.run(Chain.bind(Doubler), use_actors=True)
+    assert h.remote(7).result(timeout=120) == 19
+
+
+def test_long_poll_host_and_route_push():
+    from ray_tpu.serve.long_poll import LongPollHost
+
+    host = LongPollHost()
+    assert host.listen({"k": 0}, timeout=0.05) == {}
+    host.notify("k", ["a"])
+    out = host.listen({"k": 0}, timeout=5)
+    assert out["k"][0] == 1 and out["k"][1] == ["a"]
+    # blocked listener wakes on notify
+    got = {}
+
+    def wait():
+        got.update(host.listen({"k": 1}, timeout=10))
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.1)
+    host.notify("k", ["a", "b"])
+    t.join(timeout=5)
+    assert got["k"][1] == ["a", "b"]
+
+    # end-to-end: the asyncio proxy's route table follows deploys
+    @serve.deployment
+    class A:
+        def __call__(self, _):
+            return 1
+
+    @serve.deployment
+    class B:
+        def __call__(self, _):
+            return 2
+
+    serve.run(A, use_actors=False, http=True, proxy="asyncio")
+    addr = serve.proxy_address()
+    serve.run(B, use_actors=False)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        with urllib.request.urlopen(f"{addr}/-/routes", timeout=10) as r:
+            routes = json.load(r)
+        if routes == ["A", "B"]:
+            break
+        time.sleep(0.1)
+    assert routes == ["A", "B"]
