@@ -59,6 +59,7 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16        # activation dtype (MXU-native)
     param_dtype: Any = jnp.float32
     remat: bool = True
+    remat_policy: Optional[str] = None   # None=full recompute, "dots"
     tie_embeddings: bool = True
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
 
@@ -222,7 +223,19 @@ def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
         x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
         return x, None
 
-    body = jax.checkpoint(layer) if cfg.remat else layer
+    if cfg.remat:
+        # "dots" keeps matmul outputs and recomputes only the cheap
+        # elementwise/norm work in the backward pass — a fraction of
+        # full-remat's extra FLOPs for modest activation memory
+        # (the policy knob the scaling playbook recommends)
+        if cfg.remat_policy not in (None, "dots"):
+            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
+                             "expected None (full recompute) or 'dots'")
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if cfg.remat_policy == "dots" else None)
+        body = jax.checkpoint(layer, policy=policy)
+    else:
+        body = layer
     x, _ = lax.scan(body, x, params["layers"])
 
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])

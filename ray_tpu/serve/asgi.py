@@ -100,6 +100,12 @@ class AsyncHttpProxy:
         self._server = None
         self._started = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # dedicated, sized pool for blocking replica calls: the loop's
+        # default executor is shared and small, which would head-of-line
+        # block unrelated requests behind slow handlers
+        from concurrent.futures import ThreadPoolExecutor
+        self._executor = ThreadPoolExecutor(
+            max_workers=128, thread_name_prefix="raytpu-serve-call")
         # long-polled route table: never touch controller state per
         # request (reference: proxy LongPollClient on route updates)
         self._routes: set[str] = set(controller.deployments.keys())
@@ -136,6 +142,7 @@ class AsyncHttpProxy:
 
     def stop(self) -> None:
         self._lp.stop()
+        self._executor.shutdown(wait=False)
         if self._loop is None:
             return
 
@@ -153,10 +160,29 @@ class AsyncHttpProxy:
                            writer: asyncio.StreamWriter) -> None:
         try:
             while True:
-                req = await self._read_request(reader)
+                try:
+                    req = await self._read_request(reader)
+                except ValueError:   # malformed framing (bad length)
+                    await self._respond_json(writer, 400,
+                                             {"error": "bad request"})
+                    break
                 if req is None:
                     break
-                keep_alive = await self._dispatch(writer, *req)
+                try:
+                    keep_alive = await self._dispatch(writer, *req)
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    raise
+                except Exception as e:
+                    # last-resort 500: a dispatch bug (or a replica
+                    # iterator raising mid-stream) must never silently
+                    # drop the connection; if headers already went out
+                    # the write fails and the close signals truncation
+                    try:
+                        await self._respond_json(writer, 500,
+                                                 {"error": str(e)})
+                    except Exception:
+                        pass
+                    break
                 if not keep_alive:
                     break
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -222,7 +248,7 @@ class AsyncHttpProxy:
             handle = DeploymentHandle(state, "handle_asgi")
             try:
                 out = await loop.run_in_executor(
-                    None,
+                    self._executor,
                     lambda: handle.remote(scope, body).result(timeout=120))
             except Exception as e:
                 # same contract as the JSON path: app errors become 500s,
@@ -241,7 +267,8 @@ class AsyncHttpProxy:
         handle = DeploymentHandle(state)
         try:
             out = await loop.run_in_executor(
-                None, lambda: handle.remote(arg).result(timeout=120))
+                self._executor,
+                lambda: handle.remote(arg).result(timeout=120))
         except Exception as e:
             await self._respond_json(writer, 500, {"error": str(e)})
             return True
@@ -299,7 +326,7 @@ class AsyncHttpProxy:
                     return _SENTINEL
 
             while True:
-                chunk = await loop.run_in_executor(None, next_chunk)
+                chunk = await loop.run_in_executor(self._executor, next_chunk)
                 if chunk is _SENTINEL:
                     break
                 await write_chunk(chunk)

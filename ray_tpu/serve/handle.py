@@ -171,11 +171,22 @@ class RemoteDeploymentHandle:
                 "(not deployed with actor replicas?)")
         snap = cloudpickle.loads(raw)
         with self._lock:
-            if snap["replicas"] is not self._replicas:
-                self._ongoing = {}   # membership changed: counts reset
             self._replicas = snap["replicas"]
             self._maxq = snap["max_concurrent_queries"]
             self._fetched_at = now
+            # counts are keyed by actor id, so a refresh with unchanged
+            # membership preserves in-flight bookkeeping and a reorder
+            # can't misattribute load; drop counts for departed replicas
+            live = {self._replica_key(r) for r in self._replicas}
+            self._ongoing = {k: v for k, v in self._ongoing.items()
+                             if k in live}
+
+    @staticmethod
+    def _replica_key(replica) -> str:
+        try:
+            return replica._actor_id.hex()
+        except AttributeError:
+            return str(id(replica))
 
     def _assign(self, timeout: float = 60.0):
         """Round-robin with per-handle max_concurrent_queries
@@ -192,23 +203,24 @@ class RemoteDeploymentHandle:
                                        "no actor replicas")
                 for _ in range(n):
                     self._rr += 1
-                    i = self._rr % n
-                    if self._ongoing.get(i, 0) < self._maxq:
-                        self._ongoing[i] = self._ongoing.get(i, 0) + 1
-                        return i, self._replicas[i]
+                    r = self._replicas[self._rr % n]
+                    key = self._replica_key(r)
+                    if self._ongoing.get(key, 0) < self._maxq:
+                        self._ongoing[key] = self._ongoing.get(key, 0) + 1
+                        return key, r
             if _time.monotonic() > deadline:
                 raise RuntimeError(
                     f"deployment {self._name!r}: all replicas saturated "
                     f"for {timeout}s")
             _time.sleep(0.001)
 
-    def _release(self, i: int) -> None:
+    def _release(self, key: str) -> None:
         with self._lock:
-            if self._ongoing.get(i, 0) > 0:
-                self._ongoing[i] -= 1
+            if self._ongoing.get(key, 0) > 0:
+                self._ongoing[key] -= 1
 
     def remote(self, *args, **kwargs) -> ServeResponse:
-        i, replica = self._assign()
+        key, replica = self._assign()
         ref = replica.handle_request.remote(self._method, args, kwargs)
 
         def resolve(timeout):
@@ -216,7 +228,11 @@ class RemoteDeploymentHandle:
             try:
                 return ray_tpu.get(ref, timeout=timeout)
             except Exception:
-                # stale membership (replica died): refresh for next call
-                self._refresh(force=True)
+                # stale membership (replica died): refresh for the next
+                # call, but never let the refresh mask the real failure
+                try:
+                    self._refresh(force=True)
+                except Exception:
+                    pass
                 raise
-        return ServeResponse(resolve, lambda: self._release(i))
+        return ServeResponse(resolve, lambda: self._release(key))
