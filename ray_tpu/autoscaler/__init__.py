@@ -1,0 +1,16 @@
+"""ray_tpu.autoscaler: demand-driven cluster scaling.
+
+Reference capability: the autoscaler stack (python/ray/autoscaler/ —
+node_provider.py:13 provider interface, _private/autoscaler.py
+StandardAutoscaler control loop, _private/monitor.py the monitor
+process).  The TPU shape: nodes are whole TPU hosts/slices, so the
+provider north star is the TPU-pod provider (gcloud TPU VM surface).
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.node_provider import (LocalNodeProvider,
+                                              NodeProvider, NodeStatus)
+from ray_tpu.autoscaler.tpu_pod_provider import TpuPodNodeProvider
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "NodeProvider", "NodeStatus",
+           "LocalNodeProvider", "TpuPodNodeProvider"]

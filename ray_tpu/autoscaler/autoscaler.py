@@ -1,0 +1,147 @@
+"""The autoscaler control loop.
+
+Reference capability: StandardAutoscaler
+(reference: python/ray/autoscaler/_private/autoscaler.py — periodic
+reconciliation of demand vs supply) driven by the head's load view:
+nodes report queued (unplaceable-now) resource demand in heartbeats, and
+the head aggregates it in the state API.  Scale-up launches provider
+nodes while queued demand persists; scale-down terminates nodes that
+have been idle (nothing running, nothing queued) past the timeout —
+never below min_workers, never above max_workers.
+
+Runs as a thread against a live HeadService (in-process mode) or
+standalone against a node/head address via an observer connection
+(``python -m ray_tpu.autoscaler.monitor`` analogue:
+reference _private/monitor.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+@dataclass
+class AutoscalerConfig:
+    min_workers: int = 0
+    max_workers: int = 4
+    idle_timeout_s: float = 30.0
+    # how long queued demand must persist before launching (debounce —
+    # a burst the current nodes will drain in one tick shouldn't scale)
+    upscale_delay_s: float = 1.0
+    tick_s: float = 1.0
+    node_config: dict = field(default_factory=dict)
+
+
+class Autoscaler:
+    def __init__(self, head, provider: NodeProvider,
+                 config: Optional[AutoscalerConfig] = None,
+                 head_address: Optional[str] = None):
+        """head: a live HeadService (in-process) — its .address is the
+        join target unless head_address overrides it."""
+        self.head = head
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self.head_address = head_address or head.address
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._demand_since: Optional[float] = None
+        self._idle_since: dict[str, float] = {}   # node_hex -> ts
+        # provider ids launched but not yet seen in the membership view
+        # (nodes self-identify via the provider_node_id label, so the
+        # mapping is exact, never join-order guesswork)
+        self._launched: set[str] = set()
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # -- cluster view -------------------------------------------------------
+
+    def _nodes(self) -> list[dict]:
+        return self.head.nodes_snapshot()
+
+    # -- control loop -------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="raytpu-autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.tick_s):
+            try:
+                self.tick()
+            except Exception:
+                traceback.print_exc()
+
+    def tick(self) -> None:
+        cfg = self.config
+        nodes = [n for n in self._nodes() if n["alive"]]
+        # exact attribution: managed nodes carry their provider id as a
+        # label (providers start them with --label provider_node_id=...)
+        managed_nodes = {n["labels"]["provider_node_id"]: n
+                         for n in nodes
+                         if "provider_node_id" in n.get("labels", {})}
+        self._launched -= set(managed_nodes)   # joined
+        # reconcile against the provider: launches that died before
+        # joining must not count as capacity forever
+        provider_ids = {p.node_id
+                        for p in self.provider.non_terminated_nodes()}
+        self._launched &= provider_ids
+
+        managed = len(self._launched) + len(managed_nodes)
+        queued = sum(sum(n["queued"].values()) for n in nodes)
+
+        # ---- scale up: queued demand that persists past the debounce
+        now = time.monotonic()
+        if queued > 0:
+            if self._demand_since is None:
+                self._demand_since = now
+            if (now - self._demand_since >= cfg.upscale_delay_s
+                    and managed < cfg.max_workers):
+                self._launch()
+                self._demand_since = None   # re-debounce per launch
+        else:
+            self._demand_since = None
+
+        # floor
+        while managed < cfg.min_workers:
+            self._launch()
+            managed += 1
+
+        # ---- scale down: managed nodes idle past the timeout
+        remaining = len(managed_nodes)
+        for pid, n in managed_nodes.items():
+            h = n["node_id"]
+            busy = (sum(n["queued"].values()) > 0
+                    or any(n["available"].get(k, 0.0) + 1e-9
+                           < n["resources"].get(k, 0.0)
+                           for k in n["resources"]))
+            if busy:
+                self._idle_since.pop(h, None)
+                continue
+            first = self._idle_since.setdefault(h, now)
+            if (now - first >= cfg.idle_timeout_s
+                    and remaining > cfg.min_workers):
+                self._idle_since.pop(h, None)
+                remaining -= 1
+                self.num_terminations += 1
+                try:
+                    self.provider.terminate_node(pid)
+                except Exception:
+                    traceback.print_exc()
+
+    def _launch(self) -> None:
+        pid = self.provider.create_node(self.head_address,
+                                        dict(self.config.node_config))
+        self._launched.add(pid)
+        self.num_launches += 1

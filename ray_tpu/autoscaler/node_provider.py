@@ -1,0 +1,100 @@
+"""Node providers: how the autoscaler creates and destroys nodes.
+
+Reference capability: the NodeProvider interface
+(reference: python/ray/autoscaler/node_provider.py:13,121 —
+create_node / terminate_node / non_terminated_nodes / node lifecycle
+tags).  A node here is a whole worker HOST running one NodeService
+joined to the head (on TPU pods: one host of a slice).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class NodeStatus:
+    node_id: str
+    status: str          # pending | running | terminated
+    metadata: dict = field(default_factory=dict)
+
+
+class NodeProvider:
+    """Provider contract (reference: node_provider.py NodeProvider)."""
+
+    def create_node(self, head_address: str, node_config: dict) -> str:
+        """Launch one node joined to `head_address`; returns provider
+        node id (the node registers itself with the head
+        asynchronously)."""
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list[NodeStatus]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        for n in self.non_terminated_nodes():
+            self.terminate_node(n.node_id)
+
+
+class LocalNodeProvider(NodeProvider):
+    """Nodes as local NodeService subprocesses — the test/dev provider
+    (reference analogue: autoscaler/_private/fake_multi_node/
+    node_provider.py, the multi-node-on-one-machine provider)."""
+
+    def __init__(self, base_dir: Optional[str] = None):
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._base = base_dir or os.path.join(
+            "/tmp/ray_tpu", f"autoscale_{uuid.uuid4().hex[:8]}")
+        os.makedirs(self._base, exist_ok=True)
+        self._n = 0
+
+    def create_node(self, head_address: str, node_config: dict) -> str:
+        self._n += 1
+        node_id = f"local-{self._n:03d}"
+        # distinct session prefix => distinct shm arena (arena name is
+        # derived from session[:8])
+        session = f"a{self._n:03d}{uuid.uuid4().hex[:8]}"
+        args = [sys.executable, "-m", "ray_tpu.core.node",
+                "--head-address", head_address,
+                "--session", session,
+                "--session-dir", os.path.join(self._base, node_id),
+                "--label", f"provider_node_id={node_id}"]
+        if node_config.get("num_cpus") is not None:
+            args += ["--num-cpus", str(node_config["num_cpus"])]
+        if node_config.get("num_tpus"):
+            args += ["--num-tpus", str(node_config["num_tpus"])]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p]
+            + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+        log = open(os.path.join(self._base, f"{node_id}.log"), "ab")
+        self._procs[node_id] = subprocess.Popen(
+            args, env=env, stdout=log, stderr=log, start_new_session=True)
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        p = self._procs.pop(node_id, None)
+        if p is None:
+            return
+        p.terminate()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+    def non_terminated_nodes(self) -> list[NodeStatus]:
+        out = []
+        for nid, p in list(self._procs.items()):
+            if p.poll() is None:
+                out.append(NodeStatus(nid, "running", {"pid": p.pid}))
+            else:
+                self._procs.pop(nid, None)
+        return out

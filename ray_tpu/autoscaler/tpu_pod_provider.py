@@ -1,0 +1,99 @@
+"""TPU-pod node provider: nodes are TPU VM hosts provisioned via gcloud.
+
+The north-star provider (SURVEY.md aux goals; reference interface:
+python/ray/autoscaler/node_provider.py:13 — the reference's GCP provider
+lives in autoscaler/_private/gcp/node_provider.py).  A "node" is a TPU
+VM (single host or one slice), created with
+``gcloud compute tpus tpu-vm create`` and bootstrapped with a startup
+command that launches a NodeService joined to the head.
+
+Untestable without GCP credentials — every gcloud invocation goes
+through ``_run`` so tests can stub the CLI; ``available()`` gates use.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import uuid
+from typing import Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider, NodeStatus
+
+_BOOTSTRAP = (
+    "python -m ray_tpu.core.node --head-address {head} "
+    "--session tpu{suffix} --num-tpus {chips} "
+    "--label provider_node_id={name} "
+    ">> /tmp/ray_tpu_node.log 2>&1 &"
+)
+
+
+def available() -> bool:
+    return shutil.which("gcloud") is not None
+
+
+class TpuPodNodeProvider(NodeProvider):
+    def __init__(self, project: str, zone: str,
+                 accelerator_type: str = "v5litepod-8",
+                 runtime_version: str = "v2-alpha-tpuv5-lite",
+                 name_prefix: str = "ray-tpu",
+                 chips_per_host: int = 4):
+        if not available():
+            raise RuntimeError("gcloud CLI not found; TpuPodNodeProvider "
+                               "requires the Google Cloud SDK")
+        self.project = project
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.name_prefix = name_prefix
+        self.chips_per_host = chips_per_host
+
+    # -- gcloud plumbing ----------------------------------------------------
+
+    def _run(self, *args: str, timeout: float = 600.0) -> str:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
+               f"--project={self.project}", f"--zone={self.zone}",
+               "--format=json", "--quiet"]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(f"gcloud failed: {' '.join(cmd)}\n"
+                               f"{proc.stderr[-2000:]}")
+        return proc.stdout
+
+    # -- provider interface -------------------------------------------------
+
+    def create_node(self, head_address: str, node_config: dict) -> str:
+        suffix = uuid.uuid4().hex[:8]
+        name = f"{self.name_prefix}-{suffix}"
+        self._run("create", name,
+                  f"--accelerator-type="
+                  f"{node_config.get('accelerator_type', self.accelerator_type)}",
+                  f"--version="
+                  f"{node_config.get('runtime_version', self.runtime_version)}")
+        bootstrap = _BOOTSTRAP.format(
+            head=head_address, suffix=suffix, name=name,
+            chips=node_config.get("num_tpus", self.chips_per_host))
+        # --worker=all: every host of a multi-host slice starts a node
+        # service (one NodeService per TPU host, the gang-member shape)
+        self._run("ssh", name, "--worker=all",
+                  f"--command={bootstrap}", timeout=900.0)
+        return name
+
+    def terminate_node(self, node_id: str) -> None:
+        self._run("delete", node_id)
+
+    def non_terminated_nodes(self) -> list[NodeStatus]:
+        raw = self._run("list")
+        out = []
+        for item in json.loads(raw or "[]"):
+            name = item.get("name", "").rsplit("/", 1)[-1]
+            if not name.startswith(self.name_prefix):
+                continue
+            state = item.get("state", "")
+            status = {"READY": "running", "CREATING": "pending"}.get(
+                state, "terminated" if state in ("DELETING", "TERMINATED")
+                else "pending")
+            out.append(NodeStatus(name, status, {"state": state}))
+        return out

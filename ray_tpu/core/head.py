@@ -41,6 +41,8 @@ class NodeRec:
     conn_id: int
     total: dict
     available: dict
+    queued: dict = field(default_factory=dict)   # demand waiting locally
+    labels: dict = field(default_factory=dict)   # e.g. provider_node_id
     last_beat: float = field(default_factory=time.monotonic)
     alive: bool = True
 
@@ -136,7 +138,8 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         self.nodes[m["node_id"]] = NodeRec(
             node_hex=m["node_id"], address=m["address"],
             conn_id=rec.conn_id, total=dict(m["resources"]),
-            available=dict(m["available"]))
+            available=dict(m["available"]),
+            labels=dict(m.get("labels") or {}))
         self._node_by_conn[rec.conn_id] = m["node_id"]
         self._reply(rec, m["reqid"], session=self.session,
                     view=self._view())
@@ -160,6 +163,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             n.last_beat = time.monotonic()
             n.available = dict(m["available"])
             n.total = dict(m["total"])
+            n.queued = dict(m.get("queued") or {})
         if "reqid" in m:
             self._reply(rec, m["reqid"], view=self._view())
 
@@ -557,13 +561,26 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
     # --------------------------------------------------------------- state
 
+    def nodes_snapshot(self) -> list[dict]:
+        """Membership view safe to call from ANY thread (the autoscaler
+        polls it): retries over list copies while the loop mutates."""
+        for attempt in range(4):
+            try:
+                return [{"node_id": h, "address": n.address,
+                         "resources": dict(n.total),
+                         "available": dict(n.available),
+                         "queued": dict(n.queued),
+                         "labels": dict(n.labels), "alive": n.alive}
+                        for h, n in list(self.nodes.items())]
+            except RuntimeError:   # dict changed size during iteration
+                if attempt == 3:
+                    raise
+        return []
+
     def _h_state(self, rec: ClientRec, m: dict) -> None:
         what = m["what"]
         if what == "nodes":
-            out = [{"node_id": h, "address": n.address,
-                    "resources": n.total, "available": n.available,
-                    "alive": n.alive}
-                   for h, n in self.nodes.items()]
+            out = self.nodes_snapshot()
         elif what == "actors":
             out = [{"actor_id": ad.actor_id.hex(), "state": ad.state,
                     "name": ad.name, "namespace": ad.namespace,

@@ -126,7 +126,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                  num_tpus: Optional[float] = None,
                  resources: Optional[dict] = None,
                  head_address: Optional[str] = None,
-                 stop_on_driver_exit: bool = True):
+                 stop_on_driver_exit: bool = True,
+                 labels: Optional[dict] = None):
         super().__init__(listen_host, port)
         self.config = config
         self.session = session
@@ -170,6 +171,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
         # ---- cluster plane state (dormant when head_address is None) ----
         self.head_address = head_address
+        self.labels = dict(labels or {})
         self.head_conn: Optional[protocol.Connection] = None
         self.cluster_view: dict[str, dict] = {}
         self._head_seq = 0
@@ -213,9 +215,35 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # periodic re-dispatch: recovers from missed wakeups and
         # re-evaluates worker-pool health (dead spawns etc.)
         self._schedule()
+        self._rebalance()
         self._expire_stale_pins()
         self._sweep_released()
         self._heartbeat()
+
+    def _rebalance(self) -> None:
+        """Queued work meets new capacity: spillover decisions are made
+        at enqueue time, so when another node gains availability LATER
+        (autoscaler launch, task completion elsewhere), re-route queue
+        heads this node can't start now (reference: the cluster
+        scheduler re-evaluates pending queues on resource updates,
+        cluster_task_manager.cc ScheduleAndDispatchTasks)."""
+        if self.head_conn is None:
+            return
+        moved = 0
+        for q in (self.runnable_cpu, self.runnable_tpu):
+            while q and moved < 8:
+                spec = q[0]
+                if spec.get("_routed") or spec.get("placement_group"):
+                    break   # FIFO: don't reorder past an unmovable head
+                demand = self._demand(spec)
+                if all(self.available.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items()):
+                    break   # dispatches here as soon as a worker frees
+                if not self._cluster_has_capacity(spec):
+                    break
+                q.popleft()
+                self._forward_task(spec)
+                moved += 1
 
     def _cleanup(self) -> None:
         for rec in list(self.clients.values()):
@@ -258,7 +286,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         conn.send({"t": "register_node", "reqid": 0,
                    "node_id": self.node_id.hex(), "address": self.address,
                    "resources": self.total_resources,
-                   "available": dict(self.available)})
+                   "available": dict(self.available),
+                   "labels": self.labels})
         reply = conn.recv(timeout=30.0)
         if reply.get("error"):
             raise RuntimeError(f"head registration failed: {reply['error']}")
@@ -360,9 +389,17 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._hb_inflight = False
             if not reply.get("error"):
                 self.cluster_view = reply.get("view", self.cluster_view)
+        queued: dict[str, float] = {}
+        for q in (self.runnable_cpu, self.runnable_tpu):
+            for s in q:
+                if s.get("placement_group"):
+                    continue
+                for k, v in self._demand(s).items():
+                    queued[k] = queued.get(k, 0.0) + v
         self._head_rpc({"t": "heartbeat",
                         "available": self._projected_available(),
-                        "total": self.total_resources}, cb)
+                        "total": self.total_resources,
+                        "queued": queued}, cb)
 
     # -------------------------------------------------------- registration
 
@@ -2054,7 +2091,11 @@ def main() -> None:
     parser.add_argument("--num-tpus", type=float, default=None)
     parser.add_argument("--head-address", default=None,
                         help="head service address; omit for standalone")
+    parser.add_argument("--label", action="append", default=[],
+                        help="k=v node label (repeatable); e.g. the "
+                             "autoscaler's provider_node_id")
     args = parser.parse_args()
+    labels = dict(kv.split("=", 1) for kv in args.label)
     import uuid
     session = args.session or uuid.uuid4().hex
     session_dir = args.session_dir or os.path.join(
@@ -2062,7 +2103,8 @@ def main() -> None:
     svc = NodeService(RayTpuConfig(), session, session_dir, port=args.port,
                       num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                       head_address=args.head_address,
-                      stop_on_driver_exit=args.head_address is None)
+                      stop_on_driver_exit=args.head_address is None,
+                      labels=labels)
     print(f"ray_tpu node service listening on {svc.address} "
           f"(session {session})", flush=True)
     try:

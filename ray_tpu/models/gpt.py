@@ -63,6 +63,12 @@ class GPTConfig:
     tie_embeddings: bool = True
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
 
+    def __post_init__(self):
+        if self.remat_policy not in (None, "dots"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; expected "
+                "None (full recompute) or 'dots'")
+
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
@@ -227,10 +233,8 @@ def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
         # "dots" keeps matmul outputs and recomputes only the cheap
         # elementwise/norm work in the backward pass — a fraction of
         # full-remat's extra FLOPs for modest activation memory
-        # (the policy knob the scaling playbook recommends)
-        if cfg.remat_policy not in (None, "dots"):
-            raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
-                             "expected None (full recompute) or 'dots'")
+        # (the policy knob the scaling playbook recommends; validated
+        # at GPTConfig construction)
         policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                   if cfg.remat_policy == "dots" else None)
         body = jax.checkpoint(layer, policy=policy)

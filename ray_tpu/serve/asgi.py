@@ -273,7 +273,15 @@ class AsyncHttpProxy:
             await self._respond_json(writer, 500, {"error": str(e)})
             return True
         if hasattr(out, "__next__") or hasattr(out, "__anext__"):
-            await self._respond_stream(writer, out, loop)
+            try:
+                await self._respond_stream(writer, out, loop)
+            except (ConnectionError, asyncio.IncompleteReadError):
+                raise
+            except Exception:
+                # headers are already on the wire: injecting a 500 would
+                # corrupt the chunked framing, so close WITHOUT the
+                # terminating 0-chunk — truncation is the error signal
+                pass
             return False   # chunked stream ends the connection
         await self._respond_json(writer, 200, {"result": _jsonable(out)})
         return True
