@@ -123,7 +123,8 @@ class ActorClass:
             max_restarts=o.get("max_restarts",
                                -1 if o.get("lifetime") == "detached" else 0),
             max_concurrency=o.get("max_concurrency", 1),
-            placement_group=_pg_tuple(o))
+            placement_group=_pg_tuple(o),
+            runtime_env=o.get("runtime_env"))
         return ActorHandle(actor_id, methods, self._cls.__name__)
 
     def bind(self, *args, **kwargs):

@@ -48,14 +48,23 @@ def queue_push_handler(q: "queue.SimpleQueue"):
 
 class Executor:
     def __init__(self, client: NodeClient,
-                 msg_queue: Optional["queue.SimpleQueue"] = None):
+                 msg_queue: Optional["queue.SimpleQueue"] = None,
+                 threaded_actors: bool = False):
         self.client = client
         self._functions: dict[str, Any] = {}
         self._actors: dict[bytes, Any] = {}
+        self._actor_envs: dict[bytes, dict] = {}
         self._actor_lock = threading.Lock()
         self._serde = get_context()
         self._queue = msg_queue if msg_queue is not None else queue.SimpleQueue()
         self._shutdown = threading.Event()
+        # threaded_actors: dedicated CPU workers honor max_concurrency>1
+        # by running each dispatched actor call on its own thread.  The
+        # SHARED in-process TPU executor must stay single-threaded — all
+        # TPU actors and tasks share the driver's jax device, and
+        # concurrent dispatch from multiple threads would break the
+        # driver-owns-device invariant.
+        self._threaded_actors = threaded_actors
 
     # -- message pump ------------------------------------------------------
 
@@ -75,12 +84,27 @@ class Executor:
             if t == "execute":
                 self.execute_task(msg["spec"])
             elif t == "execute_actor":
-                self.execute_actor_task(msg["spec"])
+                # the node dispatches up to the actor's max_concurrency
+                # in-flight calls; a dedicated worker honors that with
+                # one thread per dispatched call (no pool cap: a bounded
+                # pool could deadlock waiter-pattern actors whose
+                # unblocking call queues behind blocked threads).  With
+                # max_concurrency=1 the node sends one call at a time,
+                # so ordering is preserved.  Reference: concurrency
+                # groups, core_worker task_execution_service
+                if self._threaded_actors:
+                    threading.Thread(
+                        target=self.execute_actor_task,
+                        args=(msg["spec"],), daemon=True,
+                        name="raytpu-actor-task").start()
+                else:
+                    self.execute_actor_task(msg["spec"])
             elif t == "create_actor_exec":
                 self.create_actor(msg["spec"])
             elif t == "destroy_actor":
                 with self._actor_lock:
                     self._actors.pop(msg["actor_id"], None)
+                    self._actor_envs.pop(msg["actor_id"], None)
 
     # -- function store ----------------------------------------------------
 
@@ -160,11 +184,13 @@ class Executor:
 
     def execute_task(self, spec: dict) -> None:
         from ray_tpu.core.runtime import task_context
+        from ray_tpu.runtime_env import applied_env
         error = None
         try:
             fn = self._get_function(spec["function_id"])
             args, kwargs = self._load_args(spec)
-            with task_context(TaskID(spec["task_id"])):
+            with task_context(TaskID(spec["task_id"])), \
+                    applied_env(spec.get("runtime_env"), self.client):
                 result = fn(*args, **kwargs)
             self._store_returns(spec, result)
         except BaseException as e:  # noqa: BLE001 — report all task errors
@@ -180,7 +206,20 @@ class Executor:
             cls = self._get_function(spec["function_id"])
             args, kwargs = self._load_args(spec)
             from ray_tpu.core.runtime import task_context
-            with task_context(TaskID(spec["task_id"])):
+            from ray_tpu.runtime_env import applied_env
+            env = spec.get("runtime_env")
+            if env and self._threaded_actors:
+                # dedicated worker: the env spans the actor's LIFETIME
+                # (applied once, never popped)
+                applied_env(env, self.client).__enter__()
+                env = None
+            elif env:
+                # SHARED executor (in-process TPU): the env must never
+                # leak into the driver/other actors — scope it around
+                # construction and around every method call instead
+                self._actor_envs[spec["actor_id"]] = env
+            with task_context(TaskID(spec["task_id"])), \
+                    applied_env(env, self.client):
                 instance = cls(*args, **kwargs)
             with self._actor_lock:
                 self._actors[spec["actor_id"]] = instance
@@ -191,6 +230,7 @@ class Executor:
 
     def execute_actor_task(self, spec: dict) -> None:
         from ray_tpu.core.runtime import task_context
+        from ray_tpu.runtime_env import applied_env
         error = None
         try:
             instance = self._actors.get(spec["actor_id"])
@@ -198,7 +238,9 @@ class Executor:
                 raise RuntimeError("actor instance not found in this worker")
             method = getattr(instance, spec["method"])
             args, kwargs = self._load_args(spec)
-            with task_context(TaskID(spec["task_id"])):
+            with task_context(TaskID(spec["task_id"])), \
+                    applied_env(self._actor_envs.get(spec["actor_id"]),
+                                self.client):
                 result = method(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     import asyncio

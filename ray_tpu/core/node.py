@@ -172,6 +172,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # ---- cluster plane state (dormant when head_address is None) ----
         self.head_address = head_address
         self.labels = dict(labels or {})
+        self._owner_driver: Optional[int] = None
         self.head_conn: Optional[protocol.Connection] = None
         self.cluster_view: dict[str, dict] = {}
         self._head_seq = 0
@@ -409,6 +410,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         rec.pid = m.get("pid", 0)
         rec.tpu = bool(m.get("tpu", False))
         rec.node_hex = m.get("node_hex", "")
+        if rec.kind == "driver" and self._owner_driver is None:
+            # the FIRST driver owns this node's lifetime; later drivers
+            # (job entrypoints, attached shells) come and go freely
+            self._owner_driver = rec.conn_id
         if rec.kind in ("worker", "tpu_executor"):
             self._spawning = max(0, self._spawning - 1)
         self._reply(rec, m["reqid"], session=self.session,
@@ -2075,8 +2080,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     ar.death_cause = f"worker process died (pid={rec.pid})"
                     self._report_actor_state(ar)
                     self._fail_actor_queue(ar, ar.death_cause)
-        if rec.kind == "driver" and self.stop_on_driver_exit:
-            # single-driver node: driver gone → shut down
+        if (rec.kind == "driver" and self.stop_on_driver_exit
+                and rec.conn_id == self._owner_driver):
+            # owning driver gone → shut down
             self._stop.set()
         self._schedule()
 

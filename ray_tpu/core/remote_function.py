@@ -86,7 +86,8 @@ class RemoteFunction:
             num_tpus=float(o.get("num_tpus") or 0),
             max_retries=o.get("max_retries",
                               rt.client.config_dict["task_max_retries"]),
-            placement_group=_pg_tuple(o))
+            placement_group=_pg_tuple(o),
+            runtime_env=o.get("runtime_env"))
 
     def bind(self, *args, **kwargs):
         """Lazy DAG node (reference: ray DAG .bind, dag/dag_node.py)."""

@@ -149,7 +149,10 @@ class Runtime:
                     name: str = "", num_returns=1,
                     resources: Optional[dict] = None,
                     num_tpus: float = 0, max_retries: int = 0,
-                    placement_group=None):
+                    placement_group=None, runtime_env=None):
+        if runtime_env:
+            from ray_tpu.runtime_env import validate
+            runtime_env = validate(dict(runtime_env))
         task_id = self._next_task_id()
         n_ret = 1 if num_returns == "dynamic" else max(num_returns, 0)
         return_ids = [ObjectID.for_task_return(task_id, i + 1)
@@ -165,6 +168,7 @@ class Runtime:
             "num_tpus": num_tpus,
             "max_retries": max_retries,
             "placement_group": placement_group,
+            "runtime_env": runtime_env,
             # the SUBMITTER owns the returns (reference: ownership model,
             # core_worker.h — the caller, not the executor, owns results)
             "owner": self.client.worker_id,
@@ -186,7 +190,10 @@ class Runtime:
                      get_if_exists: bool = False,
                      resources: Optional[dict] = None, num_tpus: float = 0,
                      max_restarts: int = 0, max_concurrency: int = 1,
-                     placement_group=None) -> ActorID:
+                     placement_group=None, runtime_env=None) -> ActorID:
+        if runtime_env:
+            from ray_tpu.runtime_env import validate
+            runtime_env = validate(dict(runtime_env))
         actor_id = ActorID.of(self.job_id, current_task_id(),
                               self._actor_counter.next())
         task_id = self._next_task_id()
@@ -207,6 +214,7 @@ class Runtime:
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,
             "placement_group": placement_group,
+            "runtime_env": runtime_env,
         }
         self._prepare_args(args, kwargs, spec)
         reply = self.client.request({"t": "create_actor", "spec": spec})
@@ -351,6 +359,11 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
     with _runtime_lock:
         if _runtime is not None:
             return _runtime
+
+        if address is None:
+            # job drivers join their cluster via the env the supervisor
+            # sets (reference: RAY_ADDRESS)
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
 
         cfg_overrides = dict(system_config or {})
         if object_store_memory is not None:

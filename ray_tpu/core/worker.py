@@ -76,7 +76,7 @@ def main() -> None:
     inbox = make_message_queue()
     client = NodeClient(args.address, kind="worker",
                         push_handler=queue_push_handler(inbox))
-    executor = Executor(client, msg_queue=inbox)
+    executor = Executor(client, msg_queue=inbox, threaded_actors=True)
 
     # Make the public API (ray_tpu.get/put/remote/...) work inside tasks.
     rt.attach_worker_runtime(client, executor)

@@ -1,0 +1,16 @@
+"""Job submission: run an entrypoint command ON the cluster.
+
+Reference capability: the job submission stack
+(reference: dashboard/modules/job/job_manager.py:490 JobManager +
+python/ray/dashboard/modules/job/sdk.py JobSubmissionClient + the
+`ray job` CLI).  Shape here: a job is a supervisor ACTOR that
+materializes the job's runtime env, runs the entrypoint as a
+subprocess, streams its output to a log buffer, and records status in
+the cluster KV store — so any later client (or the CLI) can query
+status/logs after the submitter disconnected.
+"""
+
+from ray_tpu.job.job_manager import (JobInfo, JobStatus,
+                                     JobSubmissionClient)
+
+__all__ = ["JobSubmissionClient", "JobStatus", "JobInfo"]

@@ -1,0 +1,275 @@
+"""Job supervisor actor + submission client.
+
+Reference: dashboard/modules/job/job_manager.py — JobSupervisor actor
+per job (:490 submit_job → supervisor actor → subprocess driver),
+status persisted to the GCS KV (job_info_storage_client).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+# terminal + live states (reference: JobStatus enum, common.py)
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+
+class JobStatus:
+    PENDING, RUNNING, SUCCEEDED, FAILED, STOPPED = (
+        PENDING, RUNNING, SUCCEEDED, FAILED, STOPPED)
+    TERMINAL = {SUCCEEDED, FAILED, STOPPED}
+
+
+@dataclass
+class JobInfo:
+    job_id: str
+    status: str
+    entrypoint: str
+    message: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    metadata: Optional[dict] = None
+
+
+def _kv_key(job_id: str) -> bytes:
+    return f"job:{job_id}".encode()
+
+
+def _logs_key(job_id: str) -> bytes:
+    return f"job:{job_id}:logs".encode()
+
+
+_MAX_LOG_BYTES = 4 * 1024 * 1024
+
+
+class _JobSupervisor:
+    """One actor per job (reference: JobSupervisor, job_manager.py:161).
+    Runs in its own worker process; the entrypoint is a subprocess so a
+    crashing job can never take the supervisor down with it."""
+
+    def __init__(self, job_id: str, entrypoint: str,
+                 runtime_env: Optional[dict], node_address: str,
+                 metadata: Optional[dict]):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.runtime_env = runtime_env or {}
+        self.node_address = node_address
+        self.metadata = metadata or {}
+        self._proc: Optional[subprocess.Popen] = None
+        self._stopped = False
+        self._log = bytearray()   # in-place append: chatty jobs must
+        #                           not pay quadratic copying
+        self._set_status(PENDING)
+
+    # -- kv state -----------------------------------------------------------
+
+    def _client(self):
+        from ray_tpu.core.runtime import get_runtime
+        return get_runtime().client
+
+    def _set_status(self, status: str, message: str = "",
+                    start: Optional[float] = None,
+                    end: Optional[float] = None) -> None:
+        cur = {}
+        raw = self._client().kv_get(_kv_key(self.job_id))
+        if raw:
+            cur = json.loads(raw)
+        cur.update({"job_id": self.job_id, "status": status,
+                    "entrypoint": self.entrypoint,
+                    "metadata": self.metadata})
+        if message:
+            cur["message"] = message
+        if start is not None:
+            cur["start_time"] = start
+        if end is not None:
+            cur["end_time"] = end
+        self._client().kv_put(_kv_key(self.job_id),
+                              json.dumps(cur).encode())
+
+    def _flush_logs(self) -> None:
+        self._client().kv_put(_logs_key(self.job_id),
+                              bytes(self._log[-_MAX_LOG_BYTES:]))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> str:
+        """Blocks until the entrypoint exits; returns terminal status."""
+        from ray_tpu.runtime_env import applied_env
+        env = dict(os.environ)
+        env.update(self.runtime_env.get("env_vars") or {})
+        # the job's own driver connects to the SAME cluster
+        env["RAY_TPU_ADDRESS"] = self.node_address
+        cwd = None
+        with applied_env({k: v for k, v in self.runtime_env.items()
+                          if k != "env_vars"}, self._client()) as ae:
+            if self.runtime_env.get("working_dir"):
+                cwd = os.getcwd()   # applied_env chdir'd into the pkg
+            if ae.paths:
+                # materialized working_dir/py_modules must be importable
+                # in the ENTRYPOINT subprocess too
+                env["PYTHONPATH"] = os.pathsep.join(
+                    ae.paths + [p for p in
+                                env.get("PYTHONPATH", "").split(os.pathsep)
+                                if p])
+            if self._stopped:   # stop() raced submission: cancel cleanly
+                self._set_status(STOPPED, message="stopped before start",
+                                 end=time.time())
+                return STOPPED
+            self._set_status(RUNNING, start=time.time())
+            self._proc = subprocess.Popen(
+                self.entrypoint, shell=True, cwd=cwd, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                start_new_session=True)
+            assert self._proc.stdout is not None
+            last_flush = time.monotonic()
+            for line in self._proc.stdout:
+                self._log += line
+                if len(self._log) > 2 * _MAX_LOG_BYTES:
+                    self._log = self._log[-_MAX_LOG_BYTES:]
+                if time.monotonic() - last_flush > 1.0:
+                    self._flush_logs()
+                    last_flush = time.monotonic()
+            rc = self._proc.wait()
+        self._flush_logs()
+        if self._stopped:
+            status = STOPPED
+        else:
+            status = SUCCEEDED if rc == 0 else FAILED
+        self._set_status(status, message=f"exit code {rc}",
+                         end=time.time())
+        return status
+
+    def stop(self) -> bool:
+        """True if the job was killed OR will be cancelled before it
+        starts; False only when it already finished."""
+        already_done = (self._proc is not None
+                        and self._proc.poll() is not None)
+        if already_done:
+            return False
+        self._stopped = True
+        if self._proc is not None:
+            import signal
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        return True
+
+    def logs_tail(self, nbytes: int = 65536) -> bytes:
+        return bytes(self._log[-nbytes:])
+
+
+
+class JobSubmissionClient:
+    """Submit/inspect jobs against a running cluster node
+    (reference: dashboard/modules/job/sdk.py JobSubmissionClient)."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            if address is None:
+                address = os.environ.get("RAY_TPU_ADDRESS")
+            ray_tpu.init(address=address)
+        self._rt = ray_tpu.get_runtime()
+        self._address = address or self._rt.client.address
+        self._supervisors: dict[str, object] = {}
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[dict] = None,
+                   job_id: Optional[str] = None,
+                   metadata: Optional[dict] = None) -> str:
+        import ray_tpu
+        from ray_tpu.runtime_env import (package_directory, upload_package,
+                                         validate)
+        job_id = job_id or f"raytpu_job_{uuid.uuid4().hex[:10]}"
+        runtime_env = validate(dict(runtime_env or {}))
+        wd = runtime_env.get("working_dir")
+        if wd and os.path.isdir(wd):
+            # content-addressed upload; workers materialize from the KV
+            runtime_env["working_dir"] = upload_package(
+                self._rt.client, package_directory(wd))
+        mods = runtime_env.get("py_modules")
+        if mods:
+            runtime_env["py_modules"] = [
+                upload_package(self._rt.client, package_directory(m))
+                if os.path.isdir(m) else m
+                for m in ([mods] if isinstance(mods, str) else mods)]
+        # the PENDING record lands BEFORE the (async) supervisor spawn so
+        # status queries never race actor creation (reference: the job
+        # manager writes JobInfo first, then starts the supervisor)
+        self._rt.client.kv_put(
+            _kv_key(job_id),
+            json.dumps({"job_id": job_id, "status": PENDING,
+                        "entrypoint": entrypoint,
+                        "metadata": metadata or {}}).encode())
+        Supervisor = ray_tpu.remote(_JobSupervisor).options(
+            name=f"_job_supervisor:{job_id}", max_concurrency=4)
+        sup = Supervisor.remote(job_id, entrypoint, runtime_env,
+                                self._address, metadata)
+        self._supervisors[job_id] = sup
+        sup.run.remote()   # fire and track via KV
+        return job_id
+
+    def _info(self, job_id: str) -> JobInfo:
+        raw = self._rt.client.kv_get(_kv_key(job_id))
+        if raw is None:
+            raise ValueError(f"no such job {job_id!r}")
+        d = json.loads(raw)
+        return JobInfo(job_id=d["job_id"], status=d["status"],
+                       entrypoint=d.get("entrypoint", ""),
+                       message=d.get("message", ""),
+                       start_time=d.get("start_time", 0.0),
+                       end_time=d.get("end_time", 0.0),
+                       metadata=d.get("metadata"))
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._info(job_id).status
+
+    def get_job_info(self, job_id: str) -> JobInfo:
+        return self._info(job_id)
+
+    def get_job_logs(self, job_id: str) -> str:
+        raw = self._rt.client.kv_get(_logs_key(job_id))
+        return (raw or b"").decode("utf-8", "replace")
+
+    def list_jobs(self) -> list[JobInfo]:
+        out = []
+        for key in self._rt.client.kv_keys(prefix=b"job:"):
+            name = key.decode()
+            if name.endswith(":logs"):
+                continue
+            out.append(self._info(name.split(":", 1)[1]))
+        return out
+
+    def stop_job(self, job_id: str) -> bool:
+        import ray_tpu
+        sup = self._supervisors.get(job_id)
+        if sup is None:
+            try:
+                sup = ray_tpu.get_actor(f"_job_supervisor:{job_id}")
+            except Exception:
+                return False
+        try:
+            return ray_tpu.get(sup.stop.remote(), timeout=30)
+        except Exception:
+            return False
+
+    def wait_until_finished(self, job_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(f"job {job_id} not finished in {timeout}s")

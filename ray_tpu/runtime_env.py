@@ -1,0 +1,166 @@
+"""Runtime environments: per-job/task execution context.
+
+Reference capability: python/ray/_private/runtime_env/ — the scoped-down
+slice that matters without package installation (this environment bakes
+dependencies): ``env_vars`` (applied around execution),
+``working_dir`` and ``py_modules`` (zipped, content-addressed in the
+cluster KV store, materialized into a worker-local cache and put on
+sys.path — reference: runtime_env/working_dir.py + packaging.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Any, Optional
+
+_MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules",
+                 ".pytest_cache", ".mypy_cache"}
+
+
+def validate(runtime_env: dict) -> dict:
+    known = {"env_vars", "working_dir", "py_modules"}
+    unknown = set(runtime_env) - known
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; supported: "
+            f"{sorted(known)} (pip/conda are out of scope: dependencies "
+            "are baked into the cluster image)")
+    ev = runtime_env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str)
+               for k, v in ev.items()):
+        raise ValueError("env_vars must be str -> str")
+    return runtime_env
+
+
+def package_directory(path: str) -> bytes:
+    """Zip a directory deterministically (reference:
+    packaging.py create_package)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir {path!r} is not a directory")
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+            for f in sorted(files):
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, path)
+                try:
+                    total += os.path.getsize(full)
+                except OSError:
+                    continue
+                if total > _MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"working_dir exceeds "
+                        f"{_MAX_PACKAGE_BYTES >> 20} MiB")
+                # from_file keeps permission bits (exec scripts survive
+                # extraction); the pinned date keeps the hash stable
+                info = zipfile.ZipInfo.from_file(full, rel)
+                info.date_time = (1980, 1, 1, 0, 0, 0)
+                with open(full, "rb") as fh:
+                    z.writestr(info, fh.read())
+    return buf.getvalue()
+
+
+def package_hash(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:32]
+
+
+def upload_package(client, data: bytes) -> str:
+    """Content-addressed upload into the cluster KV (reference:
+    packaging.py upload_package_if_needed).  Returns the package hash."""
+    h = package_hash(data)
+    key = f"runtime_env:pkg:{h}".encode()
+    if client.kv_get(key) is None:
+        client.kv_put(key, data)
+    return h
+
+
+def ensure_package(client, pkg_hash: str,
+                   cache_root: Optional[str] = None) -> str:
+    """Materialize a package into the local cache; idempotent
+    (reference: working_dir.py download_and_unpack_package)."""
+    cache_root = cache_root or os.path.join(
+        "/tmp/ray_tpu", "runtime_env_cache")
+    dest = os.path.join(cache_root, pkg_hash)
+    marker = os.path.join(dest, ".ready")
+    if os.path.exists(marker):
+        return dest
+    data = client.kv_get(f"runtime_env:pkg:{pkg_hash}".encode())
+    if data is None:
+        raise RuntimeError(f"runtime_env package {pkg_hash} not found "
+                           "in the cluster KV store")
+    tmp = dest + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        z.extractall(tmp)
+        # extractall drops permission bits — restore them so bundled
+        # scripts/binaries stay executable
+        for info in z.infolist():
+            mode = (info.external_attr >> 16) & 0o7777
+            if mode:
+                try:
+                    os.chmod(os.path.join(tmp, info.filename), mode)
+                except OSError:
+                    pass
+    try:
+        os.replace(tmp, dest)   # atomic against racing workers
+    except OSError:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    open(marker, "w").close()
+    return dest
+
+
+class applied_env:
+    """Context manager applying a runtime_env around execution
+    (env_vars set/restored; working_dir/py_modules on sys.path + cwd)."""
+
+    def __init__(self, runtime_env: Optional[dict], client=None):
+        self.env = runtime_env or {}
+        self.client = client
+        self._saved_env: dict[str, Optional[str]] = {}
+        self.paths: list[str] = []   # materialized dirs (public: callers
+        #                              propagate them, e.g. as PYTHONPATH)
+        self._saved_cwd: Optional[str] = None
+
+    def __enter__(self):
+        for k, v in (self.env.get("env_vars") or {}).items():
+            self._saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        for field, chdir in (("working_dir", True), ("py_modules", False)):
+            ref = self.env.get(field)
+            if not ref:
+                continue
+            refs = [ref] if isinstance(ref, str) else list(ref)
+            for r in refs:
+                path = (ensure_package(self.client, r)
+                        if self.client is not None and not os.path.isdir(r)
+                        else r)
+                sys.path.insert(0, path)
+                self.paths.append(path)
+                if chdir and self._saved_cwd is None:
+                    self._saved_cwd = os.getcwd()
+                    os.chdir(path)
+        return self
+
+    def __exit__(self, *exc):
+        for p in self.paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        if self._saved_cwd is not None:
+            os.chdir(self._saved_cwd)
+        for k, old in self._saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return False

@@ -189,6 +189,43 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient(address=args.address)
+    if args.job_cmd == "submit":
+        runtime_env = {}
+        if args.working_dir:
+            runtime_env["working_dir"] = args.working_dir
+        import shlex
+        job_id = client.submit_job(
+            entrypoint=shlex.join(args.entrypoint),
+            runtime_env=runtime_env or None)
+        print(f"submitted {job_id}")
+        if args.wait:
+            status = client.wait_until_finished(job_id,
+                                                timeout=args.timeout)
+            print(client.get_job_logs(job_id), end="")
+            print(f"status: {status}")
+            return 0 if status == JobStatus.SUCCEEDED else 1
+        return 0
+    if args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+        return 0
+    if args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+        return 0
+    if args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.job_id)
+              else "not running")
+        return 0
+    if args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info.job_id}  {info.status:10}  {info.entrypoint}")
+        return 0
+    return 2
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu",
@@ -229,6 +266,25 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("job", help="submit/inspect cluster jobs")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    ps = jsub.add_parser("submit")
+    ps.add_argument("--address", required=True)
+    ps.add_argument("--working-dir", default=None)
+    ps.add_argument("--wait", action="store_true")
+    ps.add_argument("--timeout", type=float, default=600.0)
+    ps.add_argument("entrypoint", nargs="+",
+                    help="command to run on the cluster (after --)")
+    ps.set_defaults(fn=cmd_job)
+    for name in ("status", "logs", "stop"):
+        pj = jsub.add_parser(name)
+        pj.add_argument("--address", required=True)
+        pj.add_argument("job_id")
+        pj.set_defaults(fn=cmd_job)
+    pl = jsub.add_parser("list")
+    pl.add_argument("--address", required=True)
+    pl.set_defaults(fn=cmd_job)
 
     args = parser.parse_args(argv)
     return args.fn(args)

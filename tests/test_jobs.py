@@ -1,0 +1,132 @@
+"""Job submission + runtime env tests (reference analogue:
+dashboard/modules/job/tests/test_job_manager.py +
+python/ray/tests/test_runtime_env*.py)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_task_runtime_env_env_vars(rt):
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("MY_FLAG"), os.environ.get("OTHER")
+
+    flagged = read_env.options(
+        runtime_env={"env_vars": {"MY_FLAG": "on"}})
+    assert ray_tpu.get(flagged.remote(), timeout=60) == ("on", None)
+    # the env does not leak into later tasks on the same worker
+    assert ray_tpu.get(read_env.remote(), timeout=60) == (None, None)
+
+
+def test_actor_runtime_env_spans_lifetime(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_MODE": "tpu"}})
+    class A:
+        def mode(self):
+            return os.environ.get("ACTOR_MODE")
+
+    a = A.remote()
+    assert ray_tpu.get(a.mode.remote(), timeout=90) == "tpu"
+    assert ray_tpu.get(a.mode.remote(), timeout=60) == "tpu"
+
+
+def test_working_dir_package_roundtrip(rt, tmp_path):
+    (tmp_path / "mod").mkdir()
+    (tmp_path / "mod" / "__init__.py").write_text("VALUE = 41\n")
+    (tmp_path / "helper.py").write_text("def answer():\n    return 42\n")
+
+    from ray_tpu.runtime_env import (ensure_package, package_directory,
+                                     upload_package)
+    pkg = package_directory(str(tmp_path))
+    h = upload_package(rt.get_runtime().client, pkg)
+    # idempotent
+    assert upload_package(rt.get_runtime().client, pkg) == h
+
+    @ray_tpu.remote(runtime_env={"working_dir": h})
+    def use_pkg():
+        import helper
+        import mod
+        return helper.answer() + mod.VALUE
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=90) == 83
+
+    dest = ensure_package(rt.get_runtime().client, h)
+    assert os.path.exists(os.path.join(dest, "helper.py"))
+
+
+def test_job_submission_lifecycle(rt, tmp_path):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    (tmp_path / "script.py").write_text(
+        "import os\n"
+        "print('job says', os.environ.get('GREETING'))\n"
+        "print('cwd has script:', os.path.exists('script.py'))\n")
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint="python script.py",
+        runtime_env={"working_dir": str(tmp_path),
+                     "env_vars": {"GREETING": "hello"}})
+    status = client.wait_until_finished(job_id, timeout=180)
+    logs = client.get_job_logs(job_id)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "job says hello" in logs
+    assert "cwd has script: True" in logs
+
+    infos = {j.job_id for j in client.list_jobs()}
+    assert job_id in infos
+
+
+def test_job_failure_and_stop(rt):
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(bad, timeout=120) == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(bad).message
+
+    slow = client.submit_job(
+        entrypoint="python -c 'import time; print(\"go\", flush=True); "
+                   "time.sleep(120)'")
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(slow) == JobStatus.RUNNING \
+                and "go" in client.get_job_logs(slow):
+            break
+        time.sleep(0.25)
+    assert client.stop_job(slow)
+    assert client.wait_until_finished(slow, timeout=120) == JobStatus.STOPPED
+
+
+def test_job_driver_joins_cluster(rt, tmp_path):
+    """A job's entrypoint is a full driver: it joins the SAME cluster
+    through RAY_TPU_ADDRESS and runs its own tasks."""
+    from ray_tpu.job import JobStatus, JobSubmissionClient
+
+    (tmp_path / "drv.py").write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"   # RAY_TPU_ADDRESS from the supervisor
+        "@ray_tpu.remote\n"
+        "def double(x):\n"
+        "    return x * 2\n"
+        "print('result', ray_tpu.get(double.remote(21), timeout=120))\n")
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint="python drv.py",
+                               runtime_env={"working_dir": str(tmp_path)})
+    status = client.wait_until_finished(job_id, timeout=240)
+    logs = client.get_job_logs(job_id)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "result 42" in logs
