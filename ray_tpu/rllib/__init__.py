@@ -7,7 +7,11 @@ from ray_tpu.rllib.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.catalog import ModelCatalog
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import CartPole, VectorEnv, make_env
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, vtrace
+from ray_tpu.rllib.multi_agent import (MultiAgentCartPole, MultiAgentEnv,
+                                       MultiAgentPPO, MultiAgentPPOConfig,
+                                       MultiAgentRolloutWorker)
 from ray_tpu.rllib.offline import (JsonReader, JsonWriter,
                                    importance_sampling_estimate)
 from ray_tpu.rllib.policy import (JaxPolicy, PolicyConfig, compute_gae,
@@ -32,4 +36,6 @@ __all__ = [
     "PPO", "PPOConfig", "ppo_loss", "MinSegmentTree",
     "PrioritizedReplayBuffer", "ReplayBuffer", "ReservoirReplayBuffer",
     "SumSegmentTree", "RolloutWorker", "SAC", "SACConfig", "SampleBatch",
+    "APPO", "APPOConfig", "MultiAgentEnv", "MultiAgentCartPole",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentRolloutWorker",
 ]

@@ -1,0 +1,114 @@
+"""APPO: asynchronous PPO — IMPALA's actor-learner architecture with the
+clipped surrogate objective over V-trace-corrected advantages.
+
+Reference capability: rllib/algorithms/appo/ (appo.py + appo_torch_policy
+loss — clip surrogate on importance ratios, V-trace targets for the
+value function, periodically refreshed target network for the ratio
+baseline).  TPU shape: inherits IMPALA's async per-worker consume loop;
+only the jitted update differs.  The target network refreshes every
+``target_update_freq`` updates (reference:
+appo.py NUM_TARGET_UPDATES / target_network_update_freq).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as SB
+from ray_tpu.rllib.impala import Impala, ImpalaConfig, vtrace
+from ray_tpu.rllib.policy import policy_forward
+
+
+@dataclass
+class APPOConfig(ImpalaConfig):
+    clip_param: float = 0.2
+    target_update_freq: int = 16     # learner updates between refreshes
+
+    def build(self, algo_cls=None) -> "APPO":
+        return APPO({"_config": self})
+
+
+def make_appo_update(cfg: APPOConfig, tx):
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        # batch tensors are time-major [T, B, ...]
+        obs = batch[SB.OBS]
+
+        def loss_fn(params):
+            logits, values = jax.vmap(
+                lambda o: policy_forward(params, o))(obs)
+            logp_all = jax.nn.log_softmax(logits)
+            tgt_logp = jnp.take_along_axis(
+                logp_all, batch[SB.ACTIONS][..., None], axis=-1)[..., 0]
+            # V-trace targets computed with the TARGET network's values:
+            # the ratio baseline stays stable between refreshes
+            t_logits, t_values = jax.vmap(
+                lambda o: policy_forward(target_params, o))(obs)
+            _, boot_v = policy_forward(target_params, batch["last_obs"])
+            t_logp_all = jax.nn.log_softmax(t_logits)
+            t_logp = jnp.take_along_axis(
+                t_logp_all, batch[SB.ACTIONS][..., None], axis=-1)[..., 0]
+            vs, pg_adv = vtrace(
+                batch[SB.LOGP], t_logp, batch[SB.REWARDS],
+                t_values, batch[SB.DONES], boot_v,
+                gamma=cfg.gamma, rho_clip=cfg.rho_clip, c_clip=cfg.c_clip)
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+            # clipped surrogate on the learner/behavior ratio (the PPO
+            # half of APPO)
+            ratio = jnp.exp(tgt_logp - batch[SB.LOGP])
+            surr = jnp.minimum(
+                ratio * pg_adv,
+                jnp.clip(ratio, 1 - cfg.clip_param,
+                         1 + cfg.clip_param) * pg_adv)
+            pg_loss = -jnp.mean(surr)
+            vf_loss = 0.5 * jnp.mean(
+                (values - jax.lax.stop_gradient(vs)) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                     - cfg.entropy_coeff * entropy)
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        (l, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {**aux, "total_loss": l}
+
+    return update
+
+
+class APPO(Impala):
+    _default_config = APPOConfig
+
+    def _build(self):
+        super()._build()
+        self.target_params = self.params
+        self._updates_since_refresh = 0
+        appo_update = make_appo_update(self.config, self.tx)
+
+        def update(params, opt_state, batch):
+            params, opt_state, m = appo_update(
+                params, self.target_params, opt_state, batch)
+            self._updates_since_refresh += 1
+            if self._updates_since_refresh >= self.config.target_update_freq:
+                self.target_params = params
+                self._updates_since_refresh = 0
+            return params, opt_state, m
+        self._update = update
+
+    def save_checkpoint(self) -> dict:
+        ck = super().save_checkpoint()
+        ck["target_params"] = jax.tree.map(np.asarray, self.target_params)
+        return ck
+
+    def load_checkpoint(self, ck):
+        super().load_checkpoint(ck)
+        self.target_params = (
+            jax.tree.map(jnp.asarray, ck["target_params"])
+            if "target_params" in ck else self.params)

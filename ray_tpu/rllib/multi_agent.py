@@ -1,0 +1,325 @@
+"""Multi-agent environments and independent-learner PPO.
+
+Reference capability: rllib/env/multi_agent_env.py MultiAgentEnv (dict
+obs/rewards/dones keyed by agent id, "__all__" episode termination) +
+the multi-agent training path (policies dict, policy_mapping_fn,
+per-policy SampleBatches — rllib/policy/sample_batch.py
+MultiAgentBatch, algorithm config .multi_agent()).
+
+Training shape here: INDEPENDENT learners — each policy owns params,
+optimizer, and a jitted PPO update (the reference's default when
+policies don't share weights); agents map onto policies via
+policy_mapping_fn, and each policy trains on the concatenation of its
+agents' trajectories.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as SB
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import CartPole
+from ray_tpu.rllib.policy import (PolicyConfig, compute_gae,
+                                  init_policy_params, policy_forward)
+from ray_tpu.rllib.ppo import PPOConfig, make_ppo_update
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class MultiAgentEnv:
+    """Interface (reference: env/multi_agent_env.py MultiAgentEnv).
+
+    reset() -> {agent_id: obs}
+    step({agent_id: action}) -> (obs_dict, reward_dict, done_dict, info)
+      where done_dict carries per-agent flags plus "__all__".
+    Only agents present in the obs dict act on the next step.
+    """
+
+    agent_ids: list[str] = []
+
+    def reset(self) -> dict:
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """N independent CartPoles, one per agent — the reference's standard
+    multi-agent smoke env (rllib/examples/envs/classes/
+    multi_agent.py MultiAgentCartPole).  The episode ends when every
+    agent's pole has fallen."""
+
+    def __init__(self, num_agents: int = 2, seed: Optional[int] = None):
+        self.agent_ids = [f"agent_{i}" for i in range(num_agents)]
+        self._envs = {aid: CartPole(seed=None if seed is None else seed + i)
+                      for i, aid in enumerate(self.agent_ids)}
+        self._done: dict[str, bool] = {}
+        self.observation_dim = 4
+        self.num_actions = 2
+
+    def reset(self) -> dict:
+        self._done = {aid: False for aid in self.agent_ids}
+        return {aid: env.reset() for aid, env in self._envs.items()}
+
+    def step(self, action_dict: dict):
+        obs, rew, done = {}, {}, {}
+        for aid, action in action_dict.items():
+            if self._done.get(aid):
+                continue
+            o, r, d, _ = self._envs[aid].step(int(action))
+            rew[aid] = r
+            done[aid] = d
+            self._done[aid] = d
+            if not d:
+                obs[aid] = o
+        done["__all__"] = all(self._done.values())
+        return obs, rew, done, {}
+
+
+class MultiAgentRolloutWorker:
+    """Sample a MultiAgentEnv into per-POLICY batches with GAE
+    (reference: the multi-agent episode collector,
+    evaluation/collectors/ + policy_mapping_fn routing)."""
+
+    def __init__(self, env_maker: Callable[[], MultiAgentEnv],
+                 policies: dict[str, PolicyConfig],
+                 policy_mapping_fn: Callable[[str], str],
+                 *, rollout_length: int = 256, gamma: float = 0.99,
+                 lam: float = 0.95, seed: int = 0):
+        self.env = env_maker()
+        self.policies = policies
+        self.map_fn = policy_mapping_fn
+        self.rollout_length = rollout_length
+        self.gamma, self.lam = gamma, lam
+        self.rng = jax.random.PRNGKey(seed)
+        self._weights: dict[str, object] = {}
+        self._obs = self.env.reset()
+        # per-agent in-flight trajectory buffers
+        self._traj: dict[str, dict[str, list]] = {}
+        self._ep_return: dict[str, float] = {}
+        self.episode_returns_buf: list[float] = []
+
+        @jax.jit
+        def _act(params, rng, obs):
+            logits, value = policy_forward(params, obs[None])
+            a = jax.random.categorical(rng, logits[0])
+            logp = jax.nn.log_softmax(logits[0])[a]
+            return a, logp, value[0]
+        self._act = _act
+
+    def set_weights(self, weights: dict) -> None:
+        self._weights = {pid: jax.tree.map(jnp.asarray, w)
+                         for pid, w in weights.items()}
+
+    def _finish_trajectory(self, aid: str, last_value: float,
+                           out: dict) -> None:
+        traj = self._traj.pop(aid, None)
+        if not traj or not traj["obs"]:
+            return
+        pid = self.map_fn(aid)
+        rewards = np.asarray(traj["rew"], np.float32)
+        values = np.asarray(traj["val"], np.float32)
+        dones = np.asarray(traj["done"], bool)
+        adv, vt = compute_gae(rewards, values, dones,
+                              np.float32(last_value),
+                              gamma=self.gamma, lam=self.lam)
+        dst = out.setdefault(pid, {k: [] for k in (
+            SB.OBS, SB.ACTIONS, SB.LOGP, SB.ADVANTAGES,
+            SB.VALUE_TARGETS, SB.VF_PREDS)})
+        dst[SB.OBS].extend(traj["obs"])
+        dst[SB.ACTIONS].extend(traj["act"])
+        dst[SB.LOGP].extend(traj["logp"])
+        dst[SB.ADVANTAGES].extend(adv.tolist())
+        dst[SB.VALUE_TARGETS].extend(vt.tolist())
+        dst[SB.VF_PREDS].extend(values.tolist())
+
+    def sample(self) -> dict[str, SampleBatch]:
+        """Collect ~rollout_length env steps; returns per-policy
+        SampleBatches."""
+        out: dict[str, dict] = {}
+        for _ in range(self.rollout_length):
+            actions = {}
+            step_meta = {}
+            for aid, obs in self._obs.items():
+                pid = self.map_fn(aid)
+                self.rng, sub = jax.random.split(self.rng)
+                a, logp, v = self._act(self._weights[pid], sub,
+                                       jnp.asarray(obs))
+                actions[aid] = int(a)
+                step_meta[aid] = (obs, int(a), float(logp), float(v))
+            nobs, rew, done, _ = self.env.step(actions)
+            for aid, (obs, a, logp, v) in step_meta.items():
+                traj = self._traj.setdefault(
+                    aid, {"obs": [], "act": [], "logp": [], "rew": [],
+                          "val": [], "done": []})
+                traj["obs"].append(obs)
+                traj["act"].append(a)
+                traj["logp"].append(logp)
+                traj["rew"].append(rew.get(aid, 0.0))
+                traj["val"].append(v)
+                traj["done"].append(bool(done.get(aid, False)))
+                self._ep_return[aid] = (self._ep_return.get(aid, 0.0)
+                                        + rew.get(aid, 0.0))
+                if done.get(aid, False):
+                    self._finish_trajectory(aid, 0.0, out)
+                    self.episode_returns_buf.append(
+                        self._ep_return.pop(aid, 0.0))
+            self._obs = nobs
+            if done.get("__all__"):
+                # envs may terminate via "__all__" alone (time limits):
+                # close every in-flight trajectory at the episode
+                # boundary or GAE would bleed across the reset
+                for aid in list(self._traj):
+                    traj = self._traj[aid]
+                    if traj["done"]:
+                        traj["done"][-1] = True
+                    self._finish_trajectory(aid, 0.0, out)
+                    if aid in self._ep_return:
+                        self.episode_returns_buf.append(
+                            self._ep_return.pop(aid))
+                self._obs = self.env.reset()
+        # truncate in-flight trajectories, bootstrapping from V(s_t)
+        for aid in list(self._traj):
+            obs = self._obs.get(aid)
+            if obs is not None:
+                pid = self.map_fn(aid)
+                self.rng, sub = jax.random.split(self.rng)
+                _, _, v = self._act(self._weights[pid], sub,
+                                    jnp.asarray(obs))
+                self._finish_trajectory(aid, float(v), out)
+            else:
+                self._finish_trajectory(aid, 0.0, out)
+        return {pid: SampleBatch({k: np.asarray(v)
+                                  for k, v in cols.items()})
+                for pid, cols in out.items()}
+
+    def episode_returns(self, clear: bool = True) -> list[float]:
+        out = list(self.episode_returns_buf)
+        if clear:
+            self.episode_returns_buf.clear()
+        return out
+
+
+@dataclass
+class MultiAgentPPOConfig(PPOConfig):
+    env_maker: Optional[Callable] = None        # () -> MultiAgentEnv
+    policies: tuple = ("shared",)               # policy ids
+    policy_mapping_fn: Optional[Callable] = None  # agent_id -> policy id
+
+    def multi_agent(self, *, policies=None,
+                    policy_mapping_fn=None) -> "MultiAgentPPOConfig":
+        out = self
+        if policies is not None:
+            out = replace(out, policies=tuple(policies))
+        if policy_mapping_fn is not None:
+            out = replace(out, policy_mapping_fn=policy_mapping_fn)
+        return out
+
+    def build(self, algo_cls=None) -> "MultiAgentPPO":
+        return MultiAgentPPO({"_config": self})
+
+
+class MultiAgentPPO(Algorithm):
+    """Independent PPO learners over a MultiAgentEnv (reference: the
+    default multi-agent Algorithm path with per-policy Learners)."""
+
+    _default_config = MultiAgentPPOConfig
+
+    def _build(self):
+        cfg = self.config
+        env_maker = cfg.env_maker or (
+            cfg.env if callable(cfg.env) else None)
+        if env_maker is None:
+            raise ValueError("MultiAgentPPO needs env_maker=callable "
+                             "returning a MultiAgentEnv")
+        probe = env_maker()
+        pcfg = PolicyConfig(obs_dim=probe.observation_dim,
+                            num_actions=probe.num_actions,
+                            hiddens=tuple(cfg.hiddens))
+        map_fn = cfg.policy_mapping_fn or (lambda aid: cfg.policies[0])
+        self.map_fn = map_fn
+        self.tx = optax.adam(cfg.lr)
+        rng = jax.random.PRNGKey(cfg.seed)
+        self.params: dict = {}
+        self.opt_state: dict = {}
+        for i, pid in enumerate(cfg.policies):
+            self.params[pid] = init_policy_params(
+                pcfg, jax.random.fold_in(rng, i))
+            self.opt_state[pid] = self.tx.init(self.params[pid])
+        # ONE jitted update shared by every policy: the program is pure
+        # in (params, opt_state, rng, batch) and identical across
+        # policies, so per-policy instances would just recompile it N×
+        self._update = make_ppo_update(cfg, self.tx)
+        self.worker = MultiAgentRolloutWorker(
+            env_maker, {pid: pcfg for pid in cfg.policies}, map_fn,
+            rollout_length=cfg.rollout_length, gamma=cfg.gamma,
+            lam=cfg.lam, seed=cfg.seed)
+        self._sync()
+        self._rng = jax.random.PRNGKey(cfg.seed + 7)
+
+    def _sync(self):
+        self.worker.set_weights(
+            {pid: jax.tree.map(np.asarray, p)
+             for pid, p in self.params.items()})
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        # accumulate per policy until every policy has a train batch
+        acc: dict[str, list[SampleBatch]] = {p: [] for p in cfg.policies}
+        counts = {p: 0 for p in cfg.policies}
+        steps = 0
+        sweeps = 0
+        while any(c < cfg.train_batch_size for c in counts.values()):
+            batches = self.worker.sample()
+            sweeps += 1
+            self._ep_returns.extend(self.worker.episode_returns())
+            for pid, b in batches.items():
+                acc[pid].append(b)
+                counts[pid] += b.count
+                steps += b.count
+            if sweeps >= 2:
+                starved = [p for p, c in counts.items() if c == 0]
+                if starved:
+                    # a policy no agent maps to would hang this loop
+                    # forever — fail loudly instead
+                    raise ValueError(
+                        f"policies {starved} received no samples: "
+                        "policy_mapping_fn maps no agent to them")
+        metrics = {}
+        for pid in cfg.policies:
+            if not acc[pid]:
+                continue
+            batch = SampleBatch.concat_samples(acc[pid])
+            n = (batch.count // cfg.minibatch_size) * cfg.minibatch_size
+            if n == 0:
+                continue
+            jb = {k: jnp.asarray(v[:n]) for k, v in batch.items()}
+            self._rng, sub = jax.random.split(self._rng)
+            self.params[pid], self.opt_state[pid], m = self._update(
+                self.params[pid], self.opt_state[pid], sub, jb)
+            metrics.update({f"{pid}/{k}": float(v) for k, v in m.items()})
+        self._sync()
+        self._timesteps += steps
+        metrics["steps_this_iter"] = steps
+        return metrics
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.opt_state = (jax.tree.map(jnp.asarray, ck["opt_state"])
+                          if "opt_state" in ck
+                          else {pid: self.tx.init(p)
+                                for pid, p in self.params.items()})
+        self._timesteps = ck.get("timesteps", 0)
+        self._sync()
