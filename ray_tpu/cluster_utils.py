@@ -26,15 +26,41 @@ from ray_tpu.core.node import NodeService
 
 
 class Cluster:
-    def __init__(self, config: Optional[RayTpuConfig] = None):
+    def __init__(self, config: Optional[RayTpuConfig] = None,
+                 head_persistence: bool = False):
         self.config = config or RayTpuConfig()
         self.session = uuid.uuid4().hex
         self.base_dir = os.path.join("/tmp/ray_tpu",
                                      f"cluster_{self.session[:8]}")
         os.makedirs(self.base_dir, exist_ok=True)
-        self.head = HeadService(self.config, self.session)
+        self.persistence_path = (os.path.join(self.base_dir, "head.state")
+                                 if head_persistence else None)
+        self.head = HeadService(self.config, self.session,
+                                persistence_path=self.persistence_path)
         self.head.start_thread()
         self.nodes: list[NodeService] = []
+
+    def restart_head(self) -> None:
+        """Kill the head and bring a new one up on the SAME address with
+        the persisted state; nodes rejoin automatically (head-FT test
+        shape — reference: GCS restart with Redis-backed storage)."""
+        assert self.persistence_path, "construct with head_persistence=True"
+        port = int(self.head.address.rsplit(":", 1)[1])
+        self.head.stop()
+        deadline = time.time() + 30
+        last_err = None
+        while time.time() < deadline:
+            try:
+                self.head = HeadService(
+                    self.config, self.session, port=port,
+                    persistence_path=self.persistence_path)
+                break
+            except OSError as e:   # port still in TIME_WAIT
+                last_err = e
+                time.sleep(0.2)
+        else:
+            raise RuntimeError(f"could not rebind head port: {last_err}")
+        self.head.start_thread()
 
     @property
     def head_address(self) -> str:

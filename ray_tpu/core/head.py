@@ -73,7 +73,8 @@ class HeadService(ClusterStoreMixin, EventLoopService):
     name = "head"
 
     def __init__(self, config: RayTpuConfig, session: str,
-                 listen_host: str = "127.0.0.1", port: int = 0):
+                 listen_host: str = "127.0.0.1", port: int = 0,
+                 persistence_path: Optional[str] = None):
         super().__init__(listen_host, port)
         self.config = config
         self.session = session
@@ -87,6 +88,115 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         self.object_locs: dict[bytes, set[str]] = {}
         self.obj_watchers: dict[bytes, set[str]] = {}
         self.pgs: dict[bytes, PGDir] = {}
+
+        # durable control-plane state (reference: gcs_server.cc:58-61 —
+        # the Redis/file-backed GCS table storage that lets the head
+        # restart without losing the cluster's KV/actor/PG directory)
+        self.persistence_path = persistence_path
+        self._dirty = False
+        self._last_snapshot = 0.0
+        self._snapshot_writing = False
+        # actors restored as pending get a rejoin grace window; if their
+        # node never comes back they re-place or die (reference: GCS
+        # reconciles actors after the reconnection grace period)
+        self._restored_pending: set = set()
+        self._restored_at = 0.0
+        if persistence_path:
+            self._restore_snapshot()
+
+    def _cleanup(self) -> None:
+        # graceful stop must not lose acknowledged mutations
+        if self.persistence_path and self._dirty:
+            try:
+                self._snapshot(sync=True)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+        super()._cleanup()
+
+    # -------------------------------------------------------- persistence
+
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    def _build_snapshot_state(self) -> dict:
+        """Cheap copies on the loop thread; the expensive pickle+write
+        happens off-thread so heartbeats never queue behind disk IO."""
+        return {
+            "kv": dict(self.kv),
+            "functions": dict(self.functions),
+            "named_actors": dict(self.named_actors),
+            "actors": [{"actor_id": ad.actor_id, "node_hex": ad.node_hex,
+                        "state": ad.state, "spec": ad.spec,
+                        "name": ad.name, "namespace": ad.namespace,
+                        "death_cause": ad.death_cause,
+                        "restarts_left": ad.restarts_left}
+                       for ad in self.actors.values()],
+            "pgs": [{"pg_id": p.pg_id, "bundles": p.bundles,
+                     "strategy": p.strategy, "assignment": p.assignment,
+                     "state": p.state} for p in self.pgs.values()],
+        }
+
+    def _write_snapshot(self, state: dict) -> None:
+        import os
+        import pickle
+        tmp = self.persistence_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self.persistence_path)
+
+    def _snapshot(self, sync: bool = False) -> None:
+        state = self._build_snapshot_state()
+        self._dirty = False
+        if sync:
+            self._write_snapshot(state)
+            return
+        if self._snapshot_writing:
+            self._dirty = True   # retry next tick
+            return
+        self._snapshot_writing = True
+
+        def work():
+            try:
+                self._write_snapshot(state)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            finally:
+                self._snapshot_writing = False
+        import threading
+        threading.Thread(target=work, daemon=True,
+                         name="raytpu-head-snapshot").start()
+
+    def _restore_snapshot(self) -> None:
+        import os
+        import pickle
+        if not os.path.exists(self.persistence_path):
+            return
+        with open(self.persistence_path, "rb") as f:
+            state = pickle.load(f)
+        self.kv = state["kv"]
+        self.functions = state["functions"]
+        self.named_actors = state["named_actors"]
+        for a in state["actors"]:
+            self.actors[a["actor_id"]] = ActorDir(
+                actor_id=a["actor_id"], node_hex=a["node_hex"],
+                # alive actors re-assert themselves when their node
+                # reconnects and re-reports; until then they are pending
+                state=("pending" if a["state"] in ("alive", "restarting",
+                                                   "pending")
+                       else a["state"]),
+                spec=a["spec"], name=a["name"], namespace=a["namespace"],
+                death_cause=a["death_cause"],
+                restarts_left=a["restarts_left"])
+            if self.actors[a["actor_id"]].state == "pending":
+                self._restored_pending.add(a["actor_id"])
+        self._restored_at = time.monotonic()
+        for p in state["pgs"]:
+            self.pgs[p["pg_id"]] = PGDir(
+                pg_id=p["pg_id"], bundles=p["bundles"],
+                strategy=p["strategy"], assignment=p["assignment"],
+                state=p["state"])
 
     # ------------------------------------------------------------- helpers
 
@@ -173,6 +283,34 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         for h, n in list(self.nodes.items()):
             if n.alive and n.last_beat < cutoff:
                 self._node_dead(h, "heartbeat timeout")
+        if (self.persistence_path and self._dirty
+                and time.monotonic() - self._last_snapshot > 0.5):
+            try:
+                self._snapshot()
+                self._last_snapshot = time.monotonic()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+        if self._restored_pending:
+            grace = 3 * timeout + 2.0
+            if time.monotonic() - self._restored_at > grace:
+                for aid in list(self._restored_pending):
+                    ad = self.actors.get(aid)
+                    if (ad is not None and ad.state == "pending"
+                            and not (self.nodes.get(ad.node_hex)
+                                     and self.nodes[ad.node_hex].alive)):
+                        # host never rejoined: re-place or declare dead
+                        if ad.restarts_left != 0:
+                            if ad.restarts_left > 0:
+                                ad.restarts_left -= 1
+                            self._replace_actor(
+                                ad, "host did not rejoin after head "
+                                    "restart")
+                        else:
+                            self._actor_dead(
+                                ad, "host node did not rejoin after "
+                                    "head restart")
+                self._restored_pending.clear()
 
     def on_client_drop(self, rec: ClientRec) -> None:
         h = self._node_by_conn.pop(rec.conn_id, None)
@@ -235,6 +373,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
     def _actor_dead(self, ad: ActorDir, cause: str) -> None:
         ad.state = "dead"
         ad.death_cause = cause
+        self.mark_dirty()
         self._publish("actor_state", {"actor_id": ad.actor_id.hex(),
                                       "state": "dead"})
         for w in ad.watchers:
@@ -320,6 +459,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                       spec=spec, name=name, namespace=ns,
                       restarts_left=spec.get("max_restarts", 0))
         self.actors[aid] = ad
+        self.mark_dirty()
         c = self._node_conn(target)
         spec = dict(spec)
         spec["_routed"] = True
@@ -331,11 +471,26 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         if ad is None:
             return
         state = m["state"]
+        if ad.state == "dead":
+            # dead is terminal: a rejoining node must not resurrect the
+            # directory entry — tell it to kill its orphan instance
+            if state != "dead":
+                self._push(rec, {"t": "kill_local_actor",
+                                 "actor_id": m["actor_id"],
+                                 "no_restart": True})
+            return
         # a report from a node the actor no longer lives on (e.g. the old
-        # host finally noticing a worker death after a re-place) is stale
+        # host finally noticing a worker death after a re-place, or a
+        # transiently-disconnected node whose actor was re-placed) is
+        # stale — the reporting node must retire its duplicate
         if rec.node_hex != ad.node_hex:
+            if state != "dead":
+                self._push(rec, {"t": "kill_local_actor",
+                                 "actor_id": m["actor_id"],
+                                 "no_restart": True})
             return
         ad.state = state
+        self.mark_dirty()
         if state == "dead":
             ad.death_cause = m.get("death_cause", "")
         self._publish("actor_state", {"actor_id": ad.actor_id.hex(),
@@ -455,6 +610,19 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             self._reply(rec, m["reqid"], ok=True)
 
     # kv / pubsub / function store: inherited from ClusterStoreMixin
+    # (mutations mark the persistence snapshot dirty)
+
+    def _h_kv_put(self, rec, m):
+        super()._h_kv_put(rec, m)
+        self.mark_dirty()
+
+    def _h_kv_del(self, rec, m):
+        super()._h_kv_del(rec, m)
+        self.mark_dirty()
+
+    def _h_register_function(self, rec, m):
+        super()._h_register_function(rec, m)
+        self.mark_dirty()
 
     # ------------------------------------------------------ placement groups
 
@@ -497,6 +665,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             self.pgs[pg_id] = PGDir(pg_id=pg_id, bundles=bundles,
                                     strategy=strategy,
                                     assignment=assignment)
+            self.mark_dirty()
             self._reply(rec, m["reqid"], ok=True, assignment=assignment)
 
         for i, (b, h) in enumerate(zip(bundles, assignment)):
@@ -550,6 +719,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
     def _h_remove_pg(self, rec: ClientRec, m: dict) -> None:
         pgd = self.pgs.pop(m["pg_id"], None)
+        self.mark_dirty()
         if pgd is not None:
             for i, h in enumerate(pgd.assignment):
                 c = self._node_conn(h)

@@ -311,9 +311,12 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _head_lost(self) -> None:
         # Head death orphans the cluster plane; keep serving local work
-        # (reference: raylets survive transient GCS outages), but fail
+        # (reference: raylets survive transient GCS outages), fail
         # everything mid-flight through the head so callers see errors
-        # instead of hanging forever.
+        # instead of hanging forever, and keep trying to REJOIN — a
+        # persistent head restarting on the same address picks the
+        # cluster back up (reference: GCS-FT reconnection,
+        # gcs_client reconnection loop).
         if self.head_conn is None:
             return
         sys.stderr.write("[node] lost connection to head service\n")
@@ -332,6 +335,63 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             for spec in specs:
                 self._fail_task(spec, "Actor location unknown: head "
                                       "connection lost")
+        self.post_later(1.0, self._try_reconnect_head)
+
+    def _try_reconnect_head(self) -> None:
+        if self.head_conn is not None or self._stop.is_set():
+            return
+
+        def work():
+            try:
+                conn = protocol.connect(self.head_address, timeout=3.0)
+                conn.send({"t": "register_node", "reqid": 0,
+                           "node_id": self.node_id.hex(),
+                           "address": self.address,
+                           "resources": self.total_resources,
+                           "available": dict(self.available),
+                           "labels": self.labels})
+                reply = conn.recv(timeout=10.0)
+                if reply.get("error"):
+                    raise RuntimeError(reply["error"])
+            except Exception:
+                self.post_later(2.0, self._try_reconnect_head)
+                return
+            self.post(lambda: self._head_rejoined(conn, reply))
+        threading.Thread(target=work, daemon=True,
+                         name="raytpu-head-reconnect").start()
+
+    def _head_rejoined(self, conn: protocol.Connection,
+                       reply: dict) -> None:
+        if self.head_conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            return
+        sys.stderr.write("[node] rejoined head service\n")
+        self.head_conn = conn
+        self.cluster_view = reply.get("view", {})
+        t = threading.Thread(target=self._head_recv_loop, daemon=True,
+                             name="raytpu-node-head")
+        t.start()
+        try:
+            # re-establish cluster-visible state: subscriptions, object
+            # locations, actor liveness (a restarted head restored its
+            # durable directory but not this live state)
+            for ch in self._head_subs:
+                conn.send({"t": "subscribe", "channel": ch})
+            adds = []
+            for oid, info in self.objects.items():
+                if info.state in ("ready", "error"):
+                    info.loc_reported = True
+                    adds.append(oid.binary())
+            if adds:
+                conn.send({"t": "report_locations", "adds": adds})
+            for ar in self.actors.values():
+                if ar.state != "dead":
+                    self._report_actor_state(ar)
+        except protocol.ConnectionClosed:
+            self._head_lost()
 
     def _head_rpc(self, msg: dict, cb=None) -> None:
         if self.head_conn is None:

@@ -155,6 +155,16 @@ class MultiAgentRolloutWorker:
                 actions[aid] = int(a)
                 step_meta[aid] = (obs, int(a), float(logp), float(v))
             nobs, rew, done, _ = self.env.step(actions)
+            # rewards may arrive for agents that did NOT act this step
+            # (turn-based envs): credit them to the agent's latest
+            # recorded transition so nothing is dropped
+            for aid, r in rew.items():
+                if aid in step_meta:
+                    continue
+                traj = self._traj.get(aid)
+                if traj and traj["rew"]:
+                    traj["rew"][-1] += r
+                self._ep_return[aid] = self._ep_return.get(aid, 0.0) + r
             for aid, (obs, a, logp, v) in step_meta.items():
                 traj = self._traj.setdefault(
                     aid, {"obs": [], "act": [], "logp": [], "rew": [],
