@@ -1,0 +1,53 @@
+"""Observer protocol: read-only request channel to a node service.
+
+One shared implementation of the register + reqid-matched request/reply
+loop used by the CLI, the dashboard, and anything else that wants
+cluster state without a runtime (no shm mapping, no task submission)."""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Callable
+
+
+def observer_connect(address: str, *, timeout: float = 10.0,
+                     request_timeout: float = 30.0):
+    """Returns (conn, request): request(msg) -> reply dict, raising
+    RuntimeError on error replies.  Caller closes conn."""
+    from ray_tpu.core import protocol
+
+    conn = protocol.connect(address, timeout=timeout)
+    conn.send({"t": "register", "kind": "observer", "reqid": 0,
+               "worker_id": f"obs-{uuid.uuid4().hex[:8]}",
+               "pid": os.getpid()})
+    reply = conn.recv(timeout=timeout)
+    if reply.get("error"):
+        conn.close()
+        raise RuntimeError(reply["error"])
+
+    state = {"reqid": 0}
+
+    def request(msg: dict) -> dict:
+        state["reqid"] += 1
+        rid = state["reqid"]
+        msg = dict(msg)
+        msg["reqid"] = rid
+        conn.send(msg)
+        while True:
+            r = conn.recv(timeout=request_timeout)
+            if r.get("t") == "reply" and r.get("reqid") == rid:
+                if r.get("error"):
+                    raise RuntimeError(r["error"])
+                return r
+
+    return conn, request
+
+
+def observer_query(address: str, queries: list[dict]) -> list[dict]:
+    """One-shot batch of queries over a short-lived connection."""
+    conn, request = observer_connect(address)
+    try:
+        return [request(q) for q in queries]
+    finally:
+        conn.close()

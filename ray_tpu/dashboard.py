@@ -1,0 +1,184 @@
+"""Web dashboard: cluster state over HTTP.
+
+Reference capability: the Ray dashboard (reference: dashboard/ — node /
+actor / job / object views over the state APIs).  Dependency-free shape:
+one ThreadingHTTPServer serving a static single-page UI plus JSON
+endpoints backed by observer connections to a node service (the same
+read-only protocol the CLI uses), so it can point at ANY live cluster.
+
+Run: ``python -m ray_tpu dashboard --address <node> [--port 8265]``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body { font-family: system-ui, sans-serif; margin: 1.5rem; color: #222; }
+h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.4rem; }
+table { border-collapse: collapse; min-width: 40rem; }
+th, td { text-align: left; padding: .25rem .7rem; border-bottom:
+  1px solid #ddd; font-size: .85rem; }
+th { background: #f5f5f5; }
+.ok { color: #0a7d36; } .bad { color: #c0392b; }
+#updated { color: #888; font-size: .8rem; }
+</style></head><body>
+<h1>ray_tpu dashboard</h1><div id="updated"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Resources</h2><table id="resources"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Task summary</h2><table id="tasks"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Object store</h2><table id="objects"></table>
+<script>
+function esc(v) {
+  // cluster-supplied strings (names, entrypoints) are untrusted —
+  // escape everything; trusted markup opts in via {html: "..."}
+  if (v && typeof v === "object" && "html" in v) return v.html;
+  return String(v).replace(/&/g, "&amp;").replace(/</g, "&lt;")
+    .replace(/>/g, "&gt;").replace(/"/g, "&quot;");
+}
+function row(cells, tag) {
+  return "<tr>" + cells.map(c => `<${tag||"td"}>${esc(c)}</${tag||"td"}>`)
+    .join("") + "</tr>";
+}
+function fill(id, header, rows) {
+  document.getElementById(id).innerHTML =
+    row(header, "th") + rows.map(r => row(r)).join("");
+}
+async function refresh() {
+  try {
+    const s = await (await fetch("/api/summary")).json();
+    fill("nodes", ["node", "address", "alive", "total", "available",
+                   "queued"],
+      s.nodes.map(n => [n.node_id.slice(0, 12), n.address,
+        n.alive ? {html: '<span class="ok">alive</span>'}
+                : {html: '<span class="bad">dead</span>'},
+        JSON.stringify(n.resources), JSON.stringify(n.available),
+        JSON.stringify(n.queued || {})]));
+    fill("resources", ["resource", "available", "total"],
+      Object.keys(s.resources.total).map(k =>
+        [k, s.resources.available[k] ?? 0, s.resources.total[k]]));
+    fill("actors", ["actor", "class", "name", "state"],
+      s.actors.map(a => [a.actor_id.slice(0, 12), a.class_name,
+                         a.name || "-", a.state]));
+    fill("tasks", ["function", "states"],
+      Object.entries(s.tasks.cluster).map(([k, v]) =>
+        [k, JSON.stringify(v)]));
+    fill("jobs", ["job", "status", "entrypoint"],
+      s.jobs.map(j => [j.job_id, j.status, j.entrypoint]));
+    fill("objects", ["metric", "value"],
+      Object.entries(s.object_store).map(([k, v]) => [k, v]));
+    document.getElementById("updated").textContent =
+      "updated " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("updated").textContent = "refresh failed: " + e;
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class _StateSource:
+    """Observer-protocol reads against a node service (one short-lived
+    connection per snapshot — read-only, no runtime needed; shared wire
+    implementation with the CLI, error replies raise)."""
+
+    def __init__(self, address: str):
+        self.address = address
+
+    def _request_many(self, queries: list[dict]) -> list[dict]:
+        from ray_tpu.core.observer import observer_query
+        return observer_query(self.address, queries)
+
+    def summary(self) -> dict:
+        from ray_tpu.util.state import group_counts
+        replies = self._request_many([
+            {"t": "state", "what": "nodes"},
+            {"t": "state", "what": "resources"},
+            {"t": "state", "what": "cluster_actors"},
+            {"t": "state", "what": "actors"},
+            {"t": "state", "what": "tasks"},
+            {"t": "object_stats"},
+            {"t": "kv_keys", "prefix": b"job:"},
+        ])
+        nodes, res, cactors, lactors, tasks, ostats, jkeys = replies
+        actors = cactors["data"] or lactors["data"]
+        jobs = []
+        job_keys = [k for k in jkeys.get("keys", [])
+                    if not k.endswith(b":logs")]
+        if job_keys:
+            job_replies = self._request_many(
+                [{"t": "kv_get", "key": k} for k in job_keys])
+            for r in job_replies:
+                if r.get("value"):
+                    try:
+                        jobs.append(json.loads(r["value"]))
+                    except Exception:
+                        pass
+        return {
+            "nodes": nodes["data"],
+            "resources": res["data"],
+            "actors": actors,
+            "tasks": group_counts(tasks["data"], "name"),
+            "object_store": ostats["stats"],
+            "jobs": jobs,
+            "time": time.time(),
+        }
+
+
+class Dashboard:
+    def __init__(self, address: str, host: str = "127.0.0.1",
+                 port: int = 8265):
+        source = _StateSource(address)
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0].rstrip("/") or "/"
+                try:
+                    if path == "/":
+                        self._send(200, _PAGE.encode(),
+                                   "text/html; charset=utf-8")
+                    elif path == "/api/summary":
+                        self._send(200,
+                                   json.dumps(source.summary(),
+                                              default=str).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, b'{"error": "not found"}',
+                                   "application/json")
+                except Exception as e:
+                    self._send(502, json.dumps(
+                        {"error": str(e)}).encode(), "application/json")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="raytpu-dashboard")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -18,28 +18,8 @@ import uuid
 
 
 def _observer(address: str):
-    """Minimal request channel to a node service (register as observer,
-    then blocking request/reply — no runtime, no shm mapping)."""
-    from ray_tpu.core import protocol
-
-    conn = protocol.connect(address, timeout=10.0)
-    conn.send({"t": "register", "kind": "observer", "reqid": 0,
-               "worker_id": f"cli-{uuid.uuid4().hex[:8]}", "pid": os.getpid()})
-    reply = conn.recv(timeout=10.0)
-    if reply.get("error"):
-        raise RuntimeError(reply["error"])
-
-    def request(msg: dict) -> dict:
-        msg = dict(msg)
-        msg["reqid"] = 1
-        conn.send(msg)
-        while True:
-            r = conn.recv(timeout=30.0)
-            if r.get("t") == "reply" and r.get("reqid") == 1:
-                if r.get("error"):
-                    raise RuntimeError(r["error"])
-                return r
-    return conn, request
+    from ray_tpu.core.observer import observer_connect
+    return observer_connect(address)
 
 
 def cmd_start(args) -> int:
@@ -189,6 +169,20 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_dashboard(args) -> int:
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(args.address, port=args.port)
+    dash.start()
+    print(f"dashboard at http://{dash.host}:{dash.port}/", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.stop()
+    return 0
+
+
 def cmd_job(args) -> int:
     from ray_tpu.job import JobStatus, JobSubmissionClient
 
@@ -266,6 +260,11 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("dashboard", help="serve the web dashboard")
+    p.add_argument("--address", required=True)
+    p.add_argument("--port", type=int, default=8265)
+    p.set_defaults(fn=cmd_dashboard)
 
     p = sub.add_parser("job", help="submit/inspect cluster jobs")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

@@ -161,3 +161,27 @@ def test_cli_start_standalone_head():
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_dashboard(rt):
+    """Dashboard serves the UI page and a live cluster summary
+    (reference analogue: the dashboard's node/actor/job views)."""
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dashboard import Dashboard
+
+    addr = get_runtime().node_service.address
+    dash = Dashboard(addr, port=0)
+    dash.start()
+    try:
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{dash.port}/", timeout=15).read().decode()
+        assert "ray_tpu dashboard" in page
+        summ = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{dash.port}/api/summary", timeout=30))
+        assert summ["nodes"] and summ["nodes"][0]["alive"]
+        assert "CPU" in summ["resources"]["total"]
+        assert any(k.endswith("named_task")
+                   for k in summ["tasks"]["cluster"])
+        assert summ["object_store"]["capacity_bytes"] > 0
+    finally:
+        dash.stop()
