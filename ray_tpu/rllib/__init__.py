@@ -3,10 +3,22 @@ SURVEY.md §2.4; §7 M6: CPU rollout actors + compiled TPU learner)."""
 
 from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
+from ray_tpu.rllib.bandit import BanditConfig, LinTS, LinUCB, \
+    LinearBanditEnv
 from ray_tpu.rllib.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.catalog import ModelCatalog
+from ray_tpu.rllib.connectors import (ClipActions, ClipReward, Connector,
+                                      ConnectorPipeline, FlattenObs,
+                                      FrameStack, MeanStdFilter,
+                                      UnsquashActions)
+from ray_tpu.rllib.cql import CQL, CQLConfig
+from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.dqn import DQN, DQNConfig
-from ray_tpu.rllib.env import CartPole, VectorEnv, make_env
+from ray_tpu.rllib.env import CartPole, Pendulum, VectorEnv, make_env
+from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
+from ray_tpu.rllib.pg import PG, PGConfig
+from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, vtrace
 from ray_tpu.rllib.multi_agent import (MultiAgentCartPole, MultiAgentEnv,
@@ -38,4 +50,10 @@ __all__ = [
     "SumSegmentTree", "RolloutWorker", "SAC", "SACConfig", "SampleBatch",
     "APPO", "APPOConfig", "MultiAgentEnv", "MultiAgentCartPole",
     "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentRolloutWorker",
+    "ApexDQN", "ApexDQNConfig", "BanditConfig", "LinUCB", "LinTS",
+    "LinearBanditEnv", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "TD3",
+    "TD3Config", "ES", "ESConfig", "ARS", "ARSConfig", "PG", "PGConfig",
+    "Pendulum", "Connector", "ConnectorPipeline", "FlattenObs",
+    "MeanStdFilter", "FrameStack", "ClipReward", "ClipActions",
+    "UnsquashActions", "PolicyClient", "PolicyServerInput",
 ]

@@ -1,0 +1,259 @@
+"""APEX-DQN: distributed prioritized experience replay.
+
+Reference capability: rllib/algorithms/apex_dqn/ (apex_dqn.py) — many
+rollout workers with per-worker exploration epsilons push experience
+into sharded replay-buffer actors; the learner samples from the shards,
+trains, pushes updated priorities back, and periodically broadcasts
+weights to the workers (Horgan et al. 2018).
+
+ray_tpu redesign: replay shards and collectors are core-runtime actors;
+the learner reuses DQN's single jitted update program. When no runtime
+is up (or num_rollout_workers == 0) everything degrades to the inline
+DQN loop, keeping tests hermetic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.dqn import (DQNConfig, init_q_params, make_dqn_update,
+                               q_values)
+from ray_tpu.rllib.env import VectorEnv
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class ApexDQNConfig(DQNConfig):
+    num_rollout_workers: int = 2
+    num_replay_shards: int = 1
+    collect_steps_per_round: int = 256   # env steps per collector round
+    train_rounds_per_iter: int = 8
+    grad_steps_per_round: int = 8
+    weight_sync_freq: int = 2            # rounds between weight pushes
+    epsilon_base: float = 0.4            # per-worker eps: base^(1+i/(N-1)·7)
+    learning_starts: int = 500
+
+    def build(self, algo_cls=None) -> "ApexDQN":
+        return ApexDQN({"_config": self})
+
+
+class _ReplayShard:
+    """Replay-buffer actor (reference: apex's ReplayActor)."""
+
+    def __init__(self, capacity: int, alpha: float, seed: int):
+        self.buf = PrioritizedReplayBuffer(capacity, alpha, seed=seed)
+
+    def add(self, batch_dict: dict):
+        self.buf.add(SampleBatch(batch_dict))
+        return len(self.buf)
+
+    def sample(self, n: int, beta: float):
+        if len(self.buf) < n:
+            return None
+        return dict(self.buf.sample(n, beta=beta))
+
+    def update_priorities(self, idx, prio):
+        self.buf.update_priorities(np.asarray(idx), np.asarray(prio))
+
+    def size(self):
+        return len(self.buf)
+
+
+class _Collector:
+    """Epsilon-greedy experience collector actor (reference: apex rollout
+    worker). Runs its own VectorEnv + CPU-jitted Q net."""
+
+    def __init__(self, env, num_envs, hiddens, dueling, epsilon, seed):
+        self.vec = VectorEnv(env, num_envs, seed=seed)
+        self.epsilon = epsilon
+        self.hiddens, self.dueling = hiddens, dueling
+        self.params = init_q_params(
+            self.vec.observation_dim, self.vec.num_actions, hiddens,
+            dueling, jax.random.PRNGKey(seed))
+        self._qvals = jax.jit(q_values)
+        self._rng = np.random.default_rng(seed)
+        self._obs = self.vec.reset()
+        self._ep_rew = np.zeros(num_envs, np.float32)
+        self._completed: list = []
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+    def collect(self, n_steps: int) -> dict:
+        B = self.vec.num_envs
+        rows = {"obs": [], "actions": [], "rewards": [], "dones": [],
+                "next_obs": []}
+        for _ in range(max(1, n_steps // B)):
+            q = np.asarray(self._qvals(self.params, jnp.asarray(self._obs)))
+            greedy = q.argmax(axis=-1)
+            explore = self._rng.random(B) < self.epsilon
+            rand = self._rng.integers(0, self.vec.num_actions, B)
+            actions = np.where(explore, rand, greedy)
+            next_obs, rew, done = self.vec.step(actions)
+            rows["obs"].append(np.asarray(self._obs, np.float32))
+            rows["actions"].append(actions.astype(np.int64))
+            rows["rewards"].append(rew.astype(np.float32))
+            rows["dones"].append(done.astype(np.float32))
+            rows["next_obs"].append(np.asarray(next_obs, np.float32))
+            self._ep_rew += rew
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_rew[i]))
+                self._ep_rew[i] = 0.0
+            self._obs = next_obs
+        return {k: np.concatenate(v) for k, v in rows.items()}
+
+    def episode_returns(self):
+        out, self._completed = self._completed, []
+        return out
+
+
+class ApexDQN(Algorithm):
+    _default_config = ApexDQNConfig
+
+    def _build(self):
+        import ray_tpu
+        cfg = self.config
+        self._distributed = (cfg.num_rollout_workers > 0
+                             and ray_tpu.is_initialized())
+        probe = VectorEnv(cfg.env, 1, seed=cfg.seed)
+        self.obs_dim = probe.observation_dim
+        self.num_actions = probe.num_actions
+        self.params = init_q_params(self.obs_dim, self.num_actions,
+                                    cfg.hiddens, cfg.dueling,
+                                    jax.random.PRNGKey(cfg.seed))
+        self.target_params = self.params
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = make_dqn_update(cfg, self.tx)
+        self._round = 0
+        self._since_target_sync = 0
+
+        N = max(1, cfg.num_rollout_workers)
+        # per-worker epsilon ladder (Horgan et al. eq. 1)
+        eps = [cfg.epsilon_base ** (1 + (i / max(1, N - 1)) * 7)
+               for i in range(N)]
+        if self._distributed:
+            Shard = ray_tpu.remote(_ReplayShard)
+            Coll = ray_tpu.remote(_Collector)
+            self.shards = [
+                Shard.remote(cfg.buffer_size // cfg.num_replay_shards,
+                             cfg.prioritized_alpha, cfg.seed + 100 + i)
+                for i in range(cfg.num_replay_shards)]
+            self.collectors = [
+                Coll.remote(cfg.env, cfg.num_envs_per_worker, cfg.hiddens,
+                            cfg.dueling, eps[i], cfg.seed + 1000 * (i + 1))
+                for i in range(N)]
+        else:
+            self.shards = [_ReplayShard(cfg.buffer_size,
+                                        cfg.prioritized_alpha, cfg.seed)]
+            self.collectors = [
+                _Collector(cfg.env, cfg.num_envs_per_worker, cfg.hiddens,
+                           cfg.dueling, eps[i], cfg.seed + 1000 * (i + 1))
+                for i in range(N)]
+        self._sync_collector_weights()
+
+    # -- plumbing that is transparent to inline vs actor mode -------------
+    def _call(self, objs, method, *args):
+        if self._distributed:
+            import ray_tpu
+            return ray_tpu.get(
+                [getattr(o, method).remote(*args) for o in objs],
+                timeout=600)
+        return [getattr(o, method)(*args) for o in objs]
+
+    def _sync_collector_weights(self):
+        w = jax.tree.map(np.asarray, self.params)
+        if self._distributed:
+            import ray_tpu
+            ref = ray_tpu.put(w)
+            ray_tpu.get([c.set_weights.remote(ref)
+                         for c in self.collectors], timeout=600)
+        else:
+            for c in self.collectors:
+                c.set_weights(w)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        steps, losses = 0, []
+        for _ in range(cfg.train_rounds_per_iter):
+            self._round += 1
+            # 1. collect in parallel, scatter round-robin into shards
+            batches = self._call(self.collectors, "collect",
+                                 cfg.collect_steps_per_round)
+            for i, b in enumerate(batches):
+                n = len(b["rewards"])
+                steps += n
+                self._timesteps += n
+                self._since_target_sync += n
+                shard = self.shards[i % len(self.shards)]
+                if self._distributed:
+                    import ray_tpu
+                    ray_tpu.get(shard.add.remote(b), timeout=600)
+                else:
+                    shard.add(b)
+            for rets in self._call(self.collectors, "episode_returns"):
+                self._ep_returns.extend(rets)
+
+            # 2. learn from sampled shards
+            sizes = self._call(self.shards, "size")
+            if sum(sizes) < cfg.learning_starts:
+                continue
+            for g in range(cfg.grad_steps_per_round):
+                shard = self.shards[g % len(self.shards)]
+                got = (self._call([shard], "sample", cfg.batch_size,
+                                  cfg.prioritized_beta))[0]
+                if got is None:
+                    continue
+                jb = {k: jnp.asarray(v) for k, v in got.items()
+                      if k != "batch_indexes"}
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.target_params, self.opt_state, jb)
+                losses.append(float(loss))
+                # 3. push refreshed priorities back to the owning shard
+                if self._distributed:
+                    import ray_tpu
+                    ray_tpu.get(shard.update_priorities.remote(
+                        got["batch_indexes"], np.asarray(td)), timeout=600)
+                else:
+                    shard.update_priorities(got["batch_indexes"],
+                                            np.asarray(td))
+
+            if self._since_target_sync >= cfg.target_update_freq:
+                self.target_params = self.params
+                self._since_target_sync = 0
+            if self._round % cfg.weight_sync_freq == 0:
+                self._sync_collector_weights()
+
+        return {"steps_this_iter": steps,
+                "replay_size": int(sum(self._call(self.shards, "size"))),
+                "mean_td_loss": float(np.mean(losses)) if losses else 0.0}
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target_params": jax.tree.map(np.asarray,
+                                              self.target_params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.target_params = jax.tree.map(jnp.asarray, ck["target_params"])
+        self.opt_state = jax.tree.map(jnp.asarray, ck["opt_state"])
+        self._timesteps = ck.get("timesteps", 0)
+        self._sync_collector_weights()
+
+    def cleanup(self):
+        if self._distributed:
+            import ray_tpu
+            for o in self.collectors + self.shards:
+                try:
+                    ray_tpu.kill(o)
+                except Exception:  # noqa: BLE001
+                    pass

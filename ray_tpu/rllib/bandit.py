@@ -1,0 +1,144 @@
+"""Contextual bandits: LinUCB and Linear Thompson Sampling.
+
+Reference capability: rllib/algorithms/bandit/ (bandit.py,
+bandit_torch_model.py — DiscreteLinearModel with UCB / Thompson
+exploration over per-arm linear models).
+
+TPU redesign: all arms' ridge-regression statistics live in one stacked
+tensor (A: [K, d, d], b: [K, d]) so the posterior update and the
+arm-scoring pass are single batched jax ops (batched solve on the MXU)
+rather than per-arm Python objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class LinearBanditEnv:
+    """Built-in test env: K arms, reward = w_k·x + noise (reference
+    analogue: rllib/env/wrappers/recsim ... bandit test envs)."""
+
+    def __init__(self, num_arms: int = 5, context_dim: int = 8,
+                 noise: float = 0.1, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        self.w = rng.normal(size=(num_arms, context_dim))
+        self.w /= np.linalg.norm(self.w, axis=1, keepdims=True)
+        self.noise = noise
+        self.context_dim = context_dim
+        self.num_actions = num_arms
+        self.rng = rng
+        self._ctx = None
+
+    def reset(self) -> np.ndarray:
+        self._ctx = self.rng.normal(size=self.context_dim).astype(np.float32)
+        self._ctx /= np.linalg.norm(self._ctx)
+        return self._ctx
+
+    def step(self, arm: int):
+        rew = float(self.w[arm] @ self._ctx
+                    + self.rng.normal() * self.noise)
+        regret = float(np.max(self.w @ self._ctx) - self.w[arm] @ self._ctx)
+        ctx = self.reset()
+        return ctx, rew, False, {"regret": regret}
+
+
+@dataclass
+class BanditConfig(AlgorithmConfig):
+    env: Union[str, Callable] = LinearBanditEnv
+    exploration: str = "ucb"      # "ucb" | "ts"
+    alpha: float = 1.0            # UCB exploration coefficient
+    lambda_reg: float = 1.0       # ridge prior precision
+    steps_per_iter: int = 128
+
+    def build(self, algo_cls=None) -> "LinUCB":
+        return (LinTS if self.exploration == "ts" else LinUCB)(
+            {"_config": self})
+
+
+class LinUCB(Algorithm):
+    _default_config = BanditConfig
+    _mode = "ucb"
+
+    def _build(self):
+        cfg = self.config
+        self.env = cfg.env() if callable(cfg.env) else cfg.env
+        K, d = self.env.num_actions, self.env.context_dim
+        # stacked ridge stats: A = λI + Σ x xᵀ (per arm), b = Σ r x
+        self.A = jnp.stack([cfg.lambda_reg * jnp.eye(d)] * K)
+        self.b = jnp.zeros((K, d))
+        self._rng = jax.random.PRNGKey(cfg.seed)
+
+        @jax.jit
+        def score_ucb(A, b, x):
+            theta = jnp.linalg.solve(A, b[..., None])[..., 0]   # [K, d]
+            Ainv_x = jnp.linalg.solve(A, jnp.broadcast_to(
+                x, (K, d))[..., None])[..., 0]                  # [K, d]
+            conf = jnp.sqrt(jnp.maximum(jnp.einsum("d,kd->k", x, Ainv_x),
+                                        0.0))
+            return theta @ x + cfg.alpha * conf
+
+        @jax.jit
+        def score_ts(A, b, x, rng):
+            theta = jnp.linalg.solve(A, b[..., None])[..., 0]
+            cov = jnp.linalg.inv(A)                             # [K, d, d]
+            chol = jnp.linalg.cholesky(
+                cov + 1e-6 * jnp.eye(d)[None])
+            rng, sub = jax.random.split(rng)
+            z = jax.random.normal(sub, (K, d))
+            sample = theta + jnp.einsum("kij,kj->ki", chol, z)
+            return sample @ x, rng
+
+        @jax.jit
+        def update(A, b, arm, x, rew):
+            A = A.at[arm].add(jnp.outer(x, x))
+            b = b.at[arm].add(rew * x)
+            return A, b
+
+        self._score_ucb, self._score_ts, self._posterior = (
+            score_ucb, score_ts, update)
+
+    def _choose(self, x: jnp.ndarray) -> int:
+        if self._mode == "ts":
+            scores, self._rng = self._score_ts(self.A, self.b, x, self._rng)
+        else:
+            scores = self._score_ucb(self.A, self.b, x)
+        return int(jnp.argmax(scores))
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        ctx = self.env.reset()
+        rewards, regrets = [], []
+        for _ in range(cfg.steps_per_iter):
+            x = jnp.asarray(ctx, jnp.float32)
+            arm = self._choose(x)
+            ctx, rew, _, info = self.env.step(arm)
+            self.A, self.b = self._posterior(self.A, self.b, arm, x, rew)
+            rewards.append(rew)
+            regrets.append(info.get("regret", 0.0))
+        self._timesteps += cfg.steps_per_iter
+        self._ep_returns.append(float(np.sum(rewards)))
+        return {"steps_this_iter": cfg.steps_per_iter,
+                "mean_reward": float(np.mean(rewards)),
+                "mean_regret": float(np.mean(regrets))}
+
+    def save_checkpoint(self) -> dict:
+        return {"A": np.asarray(self.A), "b": np.asarray(self.b),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.A, self.b = jnp.asarray(ck["A"]), jnp.asarray(ck["b"])
+        self._timesteps = ck.get("timesteps", 0)
+
+
+class LinTS(LinUCB):
+    """Linear Thompson sampling (reference: bandit_torch_model.py
+    DiscreteLinearModelThompsonSampling)."""
+    _mode = "ts"

@@ -1,0 +1,140 @@
+"""CQL: Conservative Q-Learning (offline, discrete actions).
+
+Reference capability: rllib/algorithms/cql/ (cql.py,
+cql_torch_policy.py) — offline RL that augments the TD loss with a
+conservative regularizer penalizing Q-values of actions not in the
+dataset: L = TD + α_cql·E_s[logsumexp_a Q(s,a) − Q(s, a_data)].
+
+Discrete-action variant over the DQN Q-network; the dataset comes from
+offline.JsonReader with (obs, actions, rewards, dones, next_obs)
+columns.  The whole update (double-Q TD target + CQL penalty) is one
+jitted program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import init_q_params, q_values
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class CQLConfig(AlgorithmConfig):
+    input_path: str = ""             # offline data dir (JsonReader)
+    cql_alpha: float = 1.0           # conservative penalty weight
+    batch_size: int = 256
+    grad_steps_per_iter: int = 100
+    target_update_freq: int = 500    # in grad steps
+    tau: float = 1.0                 # 1.0 = hard target sync
+    gamma: float = 0.99
+    lr: float = 3e-4
+    double_q: bool = True
+
+    def build(self, algo_cls=None) -> "CQL":
+        return CQL({"_config": self})
+
+
+def make_cql_update(cfg: CQLConfig, tx):
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        obs, actions = batch["obs"], batch["actions"]
+        rewards, dones, next_obs = (batch["rewards"], batch["dones"],
+                                    batch["next_obs"])
+        q_next_t = q_values(target_params, next_obs)
+        if cfg.double_q:
+            sel = jnp.argmax(q_values(params, next_obs), axis=-1)
+        else:
+            sel = jnp.argmax(q_next_t, axis=-1)
+        boot = jnp.take_along_axis(q_next_t, sel[:, None], 1)[:, 0]
+        target = rewards + cfg.gamma * (1.0 - dones) * boot
+
+        def loss_fn(p):
+            q_all = q_values(p, obs)
+            q_data = jnp.take_along_axis(q_all, actions[:, None], 1)[:, 0]
+            td = jnp.mean((q_data - jax.lax.stop_gradient(target)) ** 2)
+            # conservative gap: push down OOD actions, up dataset actions
+            gap = jnp.mean(jax.scipy.special.logsumexp(q_all, axis=-1)
+                           - q_data)
+            return td + cfg.cql_alpha * gap, (td, gap)
+
+        (loss, (td, gap)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, td, gap
+
+    return update
+
+
+class CQL(Algorithm):
+    _default_config = CQLConfig
+
+    def _build(self):
+        cfg = self.config
+        if not cfg.input_path:
+            raise ValueError("CQL requires config.input_path offline data")
+        self.data = JsonReader(cfg.input_path).read_all()
+        self.obs_dim = int(np.asarray(self.data["obs"]).shape[1])
+        self.num_actions = int(np.asarray(self.data["actions"]).max()) + 1
+        self.params = init_q_params(self.obs_dim, self.num_actions,
+                                    cfg.hiddens, False,
+                                    jax.random.PRNGKey(cfg.seed))
+        self.target_params = self.params
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = make_cql_update(cfg, self.tx)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._grad_steps = 0
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(np.asarray(self.data["obs"]))
+        losses, tds, gaps = [], [], []
+        for _ in range(cfg.grad_steps_per_iter):
+            idx = self._rng.integers(0, n, cfg.batch_size)
+            jb = {k: jnp.asarray(np.asarray(self.data[k])[idx])
+                  for k in ("obs", "actions", "rewards", "dones",
+                            "next_obs")}
+            jb["actions"] = jb["actions"].astype(jnp.int32)
+            self.params, self.opt_state, loss, td, gap = self._update(
+                self.params, self.target_params, self.opt_state, jb)
+            losses.append(float(loss))
+            tds.append(float(td))
+            gaps.append(float(gap))
+            self._grad_steps += 1
+            if self._grad_steps % cfg.target_update_freq == 0:
+                self.target_params = jax.tree.map(
+                    lambda t, p: (1 - cfg.tau) * t + cfg.tau * p,
+                    self.target_params, self.params)
+        self._timesteps += cfg.grad_steps_per_iter
+        return {"steps_this_iter": cfg.grad_steps_per_iter,
+                "loss": float(np.mean(losses)),
+                "td_loss": float(np.mean(tds)),
+                "cql_gap": float(np.mean(gaps))}
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        q = q_values(self.params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(q[0]))
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target_params": jax.tree.map(np.asarray,
+                                              self.target_params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "timesteps": self._timesteps,
+                "grad_steps": self._grad_steps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.target_params = jax.tree.map(jnp.asarray, ck["target_params"])
+        self.opt_state = jax.tree.map(jnp.asarray, ck["opt_state"])
+        self._timesteps = ck.get("timesteps", 0)
+        self._grad_steps = ck.get("grad_steps", 0)

@@ -1,0 +1,264 @@
+"""DDPG and TD3: deterministic-policy continuous control.
+
+Reference capability: rllib/algorithms/ddpg/ (ddpg.py,
+ddpg_torch_policy.py) and rllib/algorithms/td3/ (td3.py — DDPG with
+twin critics, target-policy smoothing, and delayed actor updates).
+
+TPU redesign: actor + twin critics are flat param pytrees; the entire
+update (critic TD step, optional delayed actor step via lax.cond,
+polyak target update) is one jitted program, one host→device transfer
+per train step; replay stays host-side numpy (two-tier model shared
+with DQN/SAC).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import VectorEnv
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class DDPGConfig(AlgorithmConfig):
+    env: object = "Pendulum-v1"      # continuous-control default
+    buffer_size: int = 50_000
+    learning_starts: int = 1_000
+    batch_size: int = 128
+    train_intensity: float = 0.5     # grad steps per env step
+    tau: float = 0.005               # polyak
+    gamma: float = 0.99
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    exploration_noise: float = 0.1   # action-space Gaussian sigma (scaled)
+    # TD3 extensions (twin_q=False, policy_delay=1, noise=0 => plain DDPG)
+    twin_q: bool = False
+    policy_delay: int = 1
+    target_noise: float = 0.0
+    target_noise_clip: float = 0.5
+
+    def build(self, algo_cls=None) -> "DDPG":
+        return DDPG({"_config": self})
+
+
+@dataclass
+class TD3Config(DDPGConfig):
+    twin_q: bool = True
+    policy_delay: int = 2
+    target_noise: float = 0.2
+
+    def build(self, algo_cls=None) -> "TD3":
+        return TD3({"_config": self})
+
+
+# -- networks --------------------------------------------------------------
+
+def _mlp_init(rng, dims, out_dim, out_scale=0.01):
+    keys = jax.random.split(rng, len(dims))
+    params = {}
+    for i in range(len(dims) - 1):
+        params[f"fc{i}"] = {
+            "w": (jax.random.normal(keys[i], (dims[i], dims[i + 1]))
+                  * np.sqrt(2.0 / dims[i])).astype(jnp.float32),
+            "b": jnp.zeros((dims[i + 1],), jnp.float32)}
+    params["out"] = {
+        "w": (jax.random.normal(keys[-1], (dims[-1], out_dim))
+              * out_scale).astype(jnp.float32),
+        "b": jnp.zeros((out_dim,), jnp.float32)}
+    return params
+
+
+def _mlp(params, x):
+    i = 0
+    while f"fc{i}" in params:
+        lp = params[f"fc{i}"]
+        x = jax.nn.relu(x @ lp["w"] + lp["b"])
+        i += 1
+    return x @ params["out"]["w"] + params["out"]["b"]
+
+
+def actor_forward(params, obs, low, high):
+    """Deterministic action in [low, high] via tanh squash."""
+    raw = jnp.tanh(_mlp(params, obs))
+    return low + (raw + 1.0) * 0.5 * (high - low)
+
+
+def critic_forward(params, obs, act):
+    return _mlp(params, jnp.concatenate([obs, act], axis=-1))[:, 0]
+
+
+def make_ddpg_update(cfg: DDPGConfig, tx_pi, tx_q, low, high):
+    @jax.jit
+    def update(state, batch, step_idx):
+        (pi, pi_t, q1, q2, q1_t, q2_t, opt_pi, opt_q, rng) = state
+        obs, actions = batch["obs"], batch["actions"]
+        rewards, dones, next_obs = (batch["rewards"], batch["dones"],
+                                    batch["next_obs"])
+        rng, sub = jax.random.split(rng)
+
+        # target action with clipped smoothing noise (TD3; zero for DDPG)
+        a_next = actor_forward(pi_t, next_obs, low, high)
+        if cfg.target_noise > 0:
+            noise = jnp.clip(
+                jax.random.normal(sub, a_next.shape) * cfg.target_noise,
+                -cfg.target_noise_clip, cfg.target_noise_clip)
+            a_next = jnp.clip(a_next + noise * (high - low) * 0.5,
+                              low, high)
+        q_next = critic_forward(q1_t, next_obs, a_next)
+        if cfg.twin_q:
+            q_next = jnp.minimum(q_next,
+                                 critic_forward(q2_t, next_obs, a_next))
+        target = rewards + cfg.gamma * (1.0 - dones) * q_next
+
+        def critic_loss(q1p, q2p):
+            l1 = jnp.mean((critic_forward(q1p, obs, actions)
+                           - jax.lax.stop_gradient(target)) ** 2)
+            if cfg.twin_q:
+                l2 = jnp.mean((critic_forward(q2p, obs, actions)
+                               - jax.lax.stop_gradient(target)) ** 2)
+                return l1 + l2
+            return l1
+
+        closs, grads = jax.value_and_grad(
+            lambda qs: critic_loss(qs[0], qs[1]))((q1, q2))
+        updates, opt_q = tx_q.update(grads, opt_q, (q1, q2))
+        q1, q2 = optax.apply_updates((q1, q2), updates)
+
+        def actor_step(args):
+            pi_p, opt = args
+
+            def actor_loss(p):
+                a = actor_forward(p, obs, low, high)
+                return -jnp.mean(critic_forward(q1, obs, a))
+
+            aloss, g = jax.value_and_grad(actor_loss)(pi_p)
+            u, opt = tx_pi.update(g, opt, pi_p)
+            return optax.apply_updates(pi_p, u), opt, aloss
+
+        def actor_skip(args):
+            pi_p, opt = args
+            return pi_p, opt, jnp.float32(0.0)
+
+        pi, opt_pi, aloss = jax.lax.cond(
+            step_idx % cfg.policy_delay == 0, actor_step, actor_skip,
+            (pi, opt_pi))
+
+        polyak = lambda t, s: jax.tree.map(
+            lambda a, b: (1 - cfg.tau) * a + cfg.tau * b, t, s)
+        pi_t, q1_t, q2_t = polyak(pi_t, pi), polyak(q1_t, q1), \
+            polyak(q2_t, q2)
+        return ((pi, pi_t, q1, q2, q1_t, q2_t, opt_pi, opt_q, rng),
+                closs, aloss)
+
+    return update
+
+
+class DDPG(Algorithm):
+    _default_config = DDPGConfig
+
+    def _build(self):
+        cfg = self.config
+        self.vec = VectorEnv(cfg.env, cfg.num_envs_per_worker,
+                             seed=cfg.seed)
+        if self.vec.action_dim is None:
+            raise ValueError("DDPG/TD3 require a continuous-action env")
+        obs_dim, act_dim = self.vec.observation_dim, self.vec.action_dim
+        self.low = jnp.asarray(self.vec.action_low)
+        self.high = jnp.asarray(self.vec.action_high)
+        k = jax.random.split(jax.random.PRNGKey(cfg.seed), 3)
+        dims = (obs_dim, *cfg.hiddens)
+        qdims = (obs_dim + act_dim, *cfg.hiddens)
+        pi = _mlp_init(k[0], dims, act_dim)
+        q1 = _mlp_init(k[1], qdims, 1, out_scale=0.1)
+        q2 = _mlp_init(k[2], qdims, 1, out_scale=0.1)
+        self.tx_pi = optax.adam(cfg.actor_lr)
+        self.tx_q = optax.adam(cfg.critic_lr)
+        self.state = (pi, pi, q1, q2, q1, q2,
+                      self.tx_pi.init(pi), self.tx_q.init((q1, q2)),
+                      jax.random.PRNGKey(cfg.seed + 3))
+        self._update = make_ddpg_update(cfg, self.tx_pi, self.tx_q,
+                                        self.low, self.high)
+        self._act = jax.jit(
+            lambda p, o: actor_forward(p, o, self.low, self.high))
+        self.buffer = ReplayBuffer(cfg.buffer_size, seed=cfg.seed)
+        self._obs = self.vec.reset()
+        self._np_rng = np.random.default_rng(cfg.seed + 1)
+        self._ep_rew = np.zeros(self.vec.num_envs, np.float32)
+        self._grad_debt = 0.0
+        self._grad_steps = 0
+
+    def _explore(self, obs) -> np.ndarray:
+        a = np.asarray(self._act(self.state[0], jnp.asarray(obs)))
+        scale = (np.asarray(self.high) - np.asarray(self.low)) * 0.5
+        noise = self._np_rng.normal(
+            0.0, self.config.exploration_noise, a.shape) * scale
+        return np.clip(a + noise, np.asarray(self.low),
+                       np.asarray(self.high))
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        B = self.vec.num_envs
+        steps, closses, alosses = 0, [], []
+        for _ in range(cfg.rollout_length):
+            if self._timesteps < cfg.learning_starts:
+                actions = self._np_rng.uniform(
+                    np.asarray(self.low), np.asarray(self.high),
+                    (B, len(np.asarray(self.low)))).astype(np.float32)
+            else:
+                actions = self._explore(self._obs).astype(np.float32)
+            next_obs, rew, done = self.vec.step(actions)
+            self.buffer.add(SampleBatch({
+                "obs": np.asarray(self._obs, np.float32),
+                "actions": actions,
+                "rewards": rew.astype(np.float32),
+                "dones": done.astype(np.float32),
+                "next_obs": np.asarray(next_obs, np.float32)}))
+            self._ep_rew += rew
+            for i in np.nonzero(done)[0]:
+                self._ep_returns.append(float(self._ep_rew[i]))
+                self._ep_rew[i] = 0.0
+            self._obs = next_obs
+            steps += B
+            self._timesteps += B
+            if len(self.buffer) < cfg.learning_starts:
+                continue
+            self._grad_debt += cfg.train_intensity * B
+            while self._grad_debt >= 1.0:
+                self._grad_debt -= 1.0
+                batch = self.buffer.sample(cfg.batch_size)
+                jb = {k: jnp.asarray(v) for k, v in batch.items()
+                      if k != "batch_indexes"}
+                self.state, closs, aloss = self._update(
+                    self.state, jb, jnp.int32(self._grad_steps))
+                self._grad_steps += 1
+                closses.append(float(closs))
+                alosses.append(float(aloss))
+        return {"steps_this_iter": steps,
+                "buffer_size": len(self.buffer),
+                "critic_loss": float(np.mean(closses)) if closses else 0.0,
+                "actor_loss": float(np.mean(alosses)) if alosses else 0.0}
+
+    def compute_action(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._act(
+            self.state[0], jnp.asarray(obs, jnp.float32)[None]))[0]
+
+    def save_checkpoint(self) -> dict:
+        return {"state": jax.tree.map(np.asarray, self.state),
+                "timesteps": self._timesteps,
+                "grad_steps": self._grad_steps}
+
+    def load_checkpoint(self, ck):
+        self.state = jax.tree.map(jnp.asarray, ck["state"])
+        self._timesteps = ck.get("timesteps", 0)
+        self._grad_steps = ck.get("grad_steps", 0)
+
+
+class TD3(DDPG):
+    _default_config = TD3Config

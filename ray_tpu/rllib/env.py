@@ -48,6 +48,45 @@ class CartPole:
         return self.state.astype(np.float32), 1.0, done, {}
 
 
+class Pendulum:
+    """Classic control Pendulum-v1 dynamics (numpy, single env) —
+    continuous action in [-2, 2], the built-in test env for the
+    continuous-control algorithms (DDPG/TD3)."""
+
+    MAX_STEPS = 200
+
+    def __init__(self, seed: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+        self.observation_dim = 3
+        self.action_dim = 1
+        self.action_low = np.array([-2.0], np.float32)
+        self.action_high = np.array([2.0], np.float32)
+        self.th = self.thdot = 0.0
+        self.t = 0
+
+    def _obs(self):
+        return np.array([np.cos(self.th), np.sin(self.th), self.thdot],
+                        np.float32)
+
+    def reset(self):
+        self.th = self.rng.uniform(-np.pi, np.pi)
+        self.thdot = self.rng.uniform(-1.0, 1.0)
+        self.t = 0
+        return self._obs()
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        g, m, l, dt = 10.0, 1.0, 1.0, 0.05
+        th_norm = ((self.th + np.pi) % (2 * np.pi)) - np.pi
+        cost = th_norm ** 2 + 0.1 * self.thdot ** 2 + 0.001 * u ** 2
+        self.thdot += (3 * g / (2 * l) * np.sin(self.th)
+                       + 3.0 / (m * l ** 2) * u) * dt
+        self.thdot = float(np.clip(self.thdot, -8.0, 8.0))
+        self.th += self.thdot * dt
+        self.t += 1
+        return self._obs(), -float(cost), self.t >= self.MAX_STEPS, {}
+
+
 class GymEnvAdapter:
     """gymnasium env → the 4-tuple interface used here."""
 
@@ -74,6 +113,8 @@ def make_env(env: Union[str, Callable], seed: Optional[int] = None):
         return env()
     if env in ("CartPole-v1", "CartPole"):
         return CartPole(seed)
+    if env in ("Pendulum-v1", "Pendulum"):
+        return Pendulum(seed)
     return GymEnvAdapter(env, seed)
 
 
@@ -86,7 +127,11 @@ class VectorEnv:
         self.envs = [make_env(env, seed + i) for i in range(num_envs)]
         self.num_envs = num_envs
         self.observation_dim = self.envs[0].observation_dim
-        self.num_actions = self.envs[0].num_actions
+        # discrete envs expose num_actions; continuous expose action_dim
+        self.num_actions = getattr(self.envs[0], "num_actions", None)
+        self.action_dim = getattr(self.envs[0], "action_dim", None)
+        self.action_low = getattr(self.envs[0], "action_low", None)
+        self.action_high = getattr(self.envs[0], "action_high", None)
         self._obs = None
 
     def reset(self) -> np.ndarray:

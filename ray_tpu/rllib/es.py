@@ -1,0 +1,193 @@
+"""Evolution Strategies (ES) and Augmented Random Search (ARS).
+
+Reference capability: rllib/algorithms/es/ (es.py — OpenAI-ES with
+antithetic sampling + centered-rank fitness shaping, parallel perturbation
+evaluation over worker actors) and rllib/algorithms/ars/ (ars.py —
+top-k directions, returns-std step scaling).
+
+TPU redesign: perturbation generation and the parameter update are pure
+jax programs over the flattened parameter vector (one fused
+vectorized op instead of per-worker noise tables); episode evaluation is
+host-side and fans out over core-runtime tasks when a runtime is up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.policy import PolicyConfig, init_policy_params, \
+    policy_forward
+
+
+@dataclass
+class ESConfig(AlgorithmConfig):
+    pop_size: int = 16          # perturbation pairs per iteration
+    sigma: float = 0.05         # noise stddev
+    step_size: float = 0.02
+    episodes_per_eval: int = 1
+    max_episode_steps: int = 500
+    top_directions: int = 0     # 0 = use all (ES); >0 = ARS top-k
+    eval_parallelism: int = 0   # >0: fan evals out as remote tasks
+
+    def build(self, algo_cls=None) -> "ES":
+        return ES({"_config": self})
+
+
+@dataclass
+class ARSConfig(ESConfig):
+    top_directions: int = 8
+    sigma: float = 0.03
+    step_size: float = 0.02
+
+    def build(self, algo_cls=None) -> "ARS":
+        return ARS({"_config": self})
+
+
+def _flatten(params):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    flat = jnp.concatenate([jnp.ravel(l) for l in leaves])
+    return flat, (treedef, shapes, sizes)
+
+
+def _unflatten(flat, spec):
+    treedef, shapes, sizes = spec
+    leaves, off = [], 0
+    for shape, size in zip(shapes, sizes):
+        leaves.append(jnp.reshape(flat[off:off + size], shape))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _rollout_return(env_name, flat_theta, spec, pcfg, seed, episodes,
+                    max_steps):
+    """Deterministic (argmax) episode return of a perturbed policy.
+    Picklable top-level function so it can run as a remote task."""
+    params = _unflatten(jnp.asarray(flat_theta), spec)
+    total = 0.0
+    for ep in range(episodes):
+        env = make_env(env_name, seed=seed + ep)
+        obs = env.reset()
+        for _ in range(max_steps):
+            logits, _ = policy_forward(
+                params, jnp.asarray(obs, jnp.float32)[None, :])
+            obs, rew, done, _ = env.step(
+                int(np.argmax(np.asarray(logits)[0])))
+            total += rew
+            if done:
+                break
+    return total / episodes
+
+
+def _centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Fitness shaping: map returns to [-0.5, 0.5] by rank (reference:
+    es.py compute_centered_ranks)."""
+    ranks = np.empty(len(x), dtype=np.float32)
+    ranks[x.argsort()] = np.arange(len(x), dtype=np.float32)
+    return ranks / (len(x) - 1) - 0.5
+
+
+class ES(Algorithm):
+    _default_config = ESConfig
+
+    def _build(self):
+        cfg = self.config
+        probe = make_env(cfg.env, seed=cfg.seed)
+        probe.reset()
+        self.pcfg = PolicyConfig(obs_dim=probe.observation_dim,
+                                 num_actions=probe.num_actions,
+                                 hiddens=tuple(cfg.hiddens))
+        params = init_policy_params(self.pcfg, jax.random.PRNGKey(cfg.seed))
+        self.theta, self.spec = _flatten(params)
+        self._rng = jax.random.PRNGKey(cfg.seed + 11)
+        dim = self.theta.shape[0]
+
+        @jax.jit
+        def perturb(rng, theta):
+            """Antithetic perturbation bank: [2P, dim] candidates."""
+            rng, sub = jax.random.split(rng)
+            eps = jax.random.normal(sub, (cfg.pop_size, dim),
+                                    dtype=theta.dtype)
+            cands = jnp.concatenate([theta + cfg.sigma * eps,
+                                     theta - cfg.sigma * eps])
+            return rng, eps, cands
+
+        @jax.jit
+        def es_step(theta, eps, fitness_pairs):
+            """theta += a/(P·s) · Σ (f+ − f−)·eps, fitness pre-shaped."""
+            f_pos, f_neg = fitness_pairs[:, 0], fitness_pairs[:, 1]
+            grad = ((f_pos - f_neg) @ eps) / (eps.shape[0] * cfg.sigma)
+            return theta + cfg.step_size * grad
+
+        self._perturb, self._es_step = perturb, es_step
+
+    def _evaluate(self, candidates: np.ndarray) -> np.ndarray:
+        cfg = self.config
+        args = [(cfg.env, candidates[i], self.spec, self.pcfg,
+                 cfg.seed + 7919 * self.iteration + i,
+                 cfg.episodes_per_eval, cfg.max_episode_steps)
+                for i in range(len(candidates))]
+        if cfg.eval_parallelism > 0:
+            import ray_tpu
+            task = ray_tpu.remote(_rollout_return)
+            refs = [task.remote(*a) for a in args]
+            return np.asarray(ray_tpu.get(refs, timeout=1200), np.float32)
+        return np.asarray([_rollout_return(*a) for a in args], np.float32)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        self._rng, eps, cands = self._perturb(self._rng, self.theta)
+        returns = self._evaluate(np.asarray(cands))
+        P = cfg.pop_size
+        pos, neg = returns[:P], returns[P:]
+
+        shaped = _centered_ranks(returns)
+        pairs = np.stack([shaped[:P], shaped[P:]], axis=1)
+        eps_used, pairs = self._select_directions(eps, pairs, pos, neg)
+        self.theta = self._es_step(self.theta, eps_used,
+                                   jnp.asarray(pairs))
+
+        steps = int(2 * P * cfg.episodes_per_eval * cfg.max_episode_steps)
+        self._timesteps += steps
+        self._ep_returns.extend(returns.tolist())
+        return {"steps_this_iter": steps,
+                "pop_return_mean": float(returns.mean()),
+                "pop_return_max": float(returns.max())}
+
+    def _select_directions(self, eps, pairs, pos, neg):
+        return eps, pairs  # plain ES: all directions
+
+    def save_checkpoint(self) -> dict:
+        return {"theta": np.asarray(self.theta),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.theta = jnp.asarray(ck["theta"])
+        self._timesteps = ck.get("timesteps", 0)
+
+    def get_policy_params(self):
+        return _unflatten(self.theta, self.spec)
+
+
+class ARS(ES):
+    """ARS = ES with top-k direction selection and raw-return scaling
+    normalized by the stddev of the selected returns (reference:
+    ars.py — 'V2' without weight/obs normalization)."""
+
+    _default_config = ARSConfig
+
+    def _select_directions(self, eps, pairs, pos, neg):
+        k = min(self.config.top_directions, len(pos))
+        score = np.maximum(pos, neg)
+        idx = np.argsort(-score)[:k]
+        sel_returns = np.concatenate([pos[idx], neg[idx]])
+        std = sel_returns.std() + 1e-8
+        raw = np.stack([pos[idx], neg[idx]], axis=1) / std
+        return eps[jnp.asarray(idx)], raw

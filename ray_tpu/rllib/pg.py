@@ -1,0 +1,108 @@
+"""Vanilla policy gradient (REINFORCE with value baseline).
+
+Reference capability: rllib/algorithms/pg/ (pg.py, pg_torch_policy.py) —
+the simplest on-policy algorithm: loss = -logp(a|s)·R. Here R is the
+GAE advantage the rollout workers already compute (baseline-subtracted
+REINFORCE), plus a fitted value baseline, all in one jitted update.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as SB
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rllib.policy import PolicyConfig, init_policy_params, \
+    policy_forward
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclass
+class PGConfig(AlgorithmConfig):
+    vf_coeff: float = 0.5
+    ent_coeff: float = 0.0
+    lr: float = 4e-3
+
+    def build(self, algo_cls=None) -> "PG":
+        return PG({"_config": self})
+
+
+def pg_loss(params, batch, *, vf_coeff, ent_coeff):
+    logits, value = policy_forward(params, batch[SB.OBS])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch[SB.ACTIONS][:, None], 1)[:, 0]
+    adv = batch[SB.ADVANTAGES]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    pi_loss = -jnp.mean(logp * adv)
+    vf_loss = jnp.mean((value - batch[SB.VALUE_TARGETS]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
+class PG(Algorithm):
+    _default_config = PGConfig
+
+    def _build(self):
+        cfg = self.config
+        self.workers = WorkerSet(cfg)
+        pcfg = PolicyConfig(obs_dim=self.workers.obs_dim,
+                            num_actions=self.workers.num_actions,
+                            hiddens=tuple(cfg.hiddens))
+        self.params = init_policy_params(pcfg, jax.random.PRNGKey(cfg.seed))
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                pg_loss, has_aux=True)(
+                    params, batch, vf_coeff=cfg.vf_coeff,
+                    ent_coeff=cfg.ent_coeff)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, aux
+
+        self._update = update
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        batches, steps = [], 0
+        while steps < cfg.train_batch_size:
+            b, rets = self.workers.sample_sync()
+            self._ep_returns.extend(rets)
+            batches.append(b)
+            steps += b.count
+        train_batch = SampleBatch.concat_samples(batches)
+        self._timesteps += train_batch.count
+        jb = {k: jnp.asarray(v) for k, v in train_batch.items()
+              if k in (SB.OBS, SB.ACTIONS, SB.ADVANTAGES,
+                       SB.VALUE_TARGETS)}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, jb)
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+        out = {k: float(v) for k, v in aux.items()}
+        out["steps_this_iter"] = train_batch.count
+        return out
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.opt_state = (jax.tree.map(jnp.asarray, ck["opt_state"])
+                          if "opt_state" in ck else self.tx.init(self.params))
+        self._timesteps = ck.get("timesteps", 0)
+        self.workers.sync_weights(jax.tree.map(np.asarray, self.params))
+
+    def cleanup(self):
+        self.workers.stop()
