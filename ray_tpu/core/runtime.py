@@ -173,8 +173,14 @@ class Runtime:
             # core_worker.h — the caller, not the executor, owns results)
             "owner": self.client.worker_id,
         }
+        from ray_tpu.util.tracing import inject_context, start_span
+        tctx = inject_context()
+        if tctx is not None:
+            spec["trace_ctx"] = tctx
         self._prepare_args(args, kwargs, spec)
-        self.client.send({"t": "submit_task", "spec": spec})
+        with start_span(f"task::{name}.remote", kind="client",
+                        attributes={"task_id": task_id.hex()}):
+            self.client.send({"t": "submit_task", "spec": spec})
         refs = [ObjectRef(o, owner=self.client.worker_id) for o in return_ids]
         if num_returns == "dynamic" or num_returns == 1:
             return refs[0]
@@ -238,6 +244,10 @@ class Runtime:
             "return_ids": [o.binary() for o in return_ids],
             "owner": self.client.worker_id,
         }
+        from ray_tpu.util.tracing import inject_context
+        tctx = inject_context()
+        if tctx is not None:
+            spec["trace_ctx"] = tctx
         self._prepare_args(args, kwargs, spec)
         self.client.send({"t": "submit_actor_task", "spec": spec})
         refs = [ObjectRef(o, owner=self.client.worker_id) for o in return_ids]
@@ -364,6 +374,15 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
             # job drivers join their cluster via the env the supervisor
             # sets (reference: RAY_ADDRESS)
             address = os.environ.get("RAY_TPU_ADDRESS") or None
+
+        if address and address.startswith("ray://"):
+            # thin-client mode (reference: ray.init("ray://...") routes
+            # through util/client — python/ray/_private/worker.py:1043)
+            from ray_tpu.util.client import ClientRuntime
+            rt = ClientRuntime(address, namespace=namespace)
+            _runtime = rt
+            atexit.register(shutdown)
+            return rt
 
         cfg_overrides = dict(system_config or {})
         if object_store_memory is not None:

@@ -1,8 +1,14 @@
 """ray_tpu.util: user utilities over the core API (reference capability:
-python/ray/util — ActorPool, Queue; the collective API lives in
-ray_tpu.parallel.collectives)."""
+python/ray/util — ActorPool, Queue, multiprocessing.Pool shim, joblib
+backend, ParallelIterator, ray client, tracing; the collective API
+lives in ray_tpu.parallel.collectives)."""
 
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.queue import Empty, Full, Queue
 
 __all__ = ["ActorPool", "Queue", "Empty", "Full"]
+
+# heavier util surfaces are import-on-demand submodules, mirroring the
+# reference's layout: ray_tpu.util.multiprocessing.Pool,
+# ray_tpu.util.joblib.register_ray, ray_tpu.util.iter.from_items,
+# ray_tpu.util.client.connect, ray_tpu.util.tracing, ray_tpu.util.state

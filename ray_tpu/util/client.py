@@ -1,0 +1,372 @@
+"""Ray Client: thin ``ray://`` proxy for driving a cluster remotely.
+
+Reference capability: python/ray/util/client/ — a client that pickles
+API calls to a server-side driver (server/server.py:96 RayletServicer,
+proxier multiplexing, ray_client.proto wire surface) so
+``ray_tpu.init(address="ray://host:port")`` works from outside the
+cluster without running a local node.
+
+Re-derived design: the ClientServer process is itself a normal driver
+attached to the cluster; each client connection speaks a small op
+vocabulary (connect/export/task/create_actor/actor_task/put/get/wait/
+free/release/request) over the same length-prefixed-pickle framing as
+the rest of the control plane (core/protocol.py). The server holds one
+live server-side ObjectRef per client-held ref in a per-connection
+registry, so cluster-side refcounting sees client refs; releases (and
+disconnects) drain the registry. Client-created non-detached actors are
+killed on disconnect, matching the reference's session cleanup.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Optional, Sequence
+
+import cloudpickle
+
+from ray_tpu.core.ids import ActorID, ObjectID
+from ray_tpu.core.object_ref import ObjectRef, get_tracker
+from ray_tpu.core.protocol import Connection, ConnectionClosed
+
+PROTOCOL_VERSION = 1
+
+
+def _dumps(obj) -> bytes:
+    return cloudpickle.dumps(obj)
+
+
+def _loads(blob: bytes):
+    import pickle
+    return pickle.loads(blob)
+
+
+# ========================================================================
+# Server
+# ========================================================================
+
+class _ClientSession:
+    """Per-connection server state: refs held on behalf of the client,
+    actors created by it."""
+
+    def __init__(self):
+        self.refs: dict[bytes, ObjectRef] = {}
+        self.actors: dict[bytes, bool] = {}  # actor_id -> detached
+
+
+class ClientServer:
+    """Accepts ray:// clients and proxies them onto this process's
+    runtime (reference: util/client/server/server.py serve +
+    proxier.py)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 10001):
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            raise RuntimeError("ray_tpu.init() the cluster connection "
+                               "before starting ClientServer")
+        from ray_tpu.core.runtime import get_runtime
+        self._rt = get_runtime()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = f"ray://{host}:{self._sock.getsockname()[1]}"
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True,
+                                        name="raytpu-client-server")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one,
+                             args=(Connection(sock),), daemon=True).start()
+
+    def _serve_one(self, conn: Connection):
+        sess = _ClientSession()
+        try:
+            while True:
+                msg = conn.recv()
+                try:
+                    reply = self._dispatch(msg, sess)
+                except Exception as e:  # noqa: BLE001 - send to client
+                    reply = {"error": _dumps(e)}
+                if msg.get("no_reply"):
+                    continue  # fire-and-forget op: never reply, even on error
+                reply["rid"] = msg.get("rid")
+                conn.send(reply)
+        except ConnectionClosed:
+            pass
+        finally:
+            self._cleanup(sess)
+
+    def _cleanup(self, sess: _ClientSession):
+        sess.refs.clear()
+        import ray_tpu
+        for aid, detached in sess.actors.items():
+            if not detached:
+                try:
+                    self._rt.kill_actor(ActorID(aid))
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _register(self, sess, refs):
+        out = []
+        for r in refs:
+            sess.refs[r.binary()] = r
+            out.append(r.binary())
+        return out
+
+    def _dispatch(self, msg: dict, sess: _ClientSession) -> dict:
+        rt = self._rt
+        op = msg["op"]
+        if op == "connect":
+            if msg.get("version") != PROTOCOL_VERSION:
+                raise RuntimeError(
+                    f"client protocol {msg.get('version')} != server "
+                    f"{PROTOCOL_VERSION}")
+            return {"config_dict": dict(rt.client.config_dict),
+                    "namespace": rt.namespace,
+                    "worker_id": rt.client.worker_id}
+        if op == "export":
+            fn = _loads(msg["blob"])
+            return {"fn_id": rt.export_function(fn)}
+        if op == "task":
+            args, kwargs = _loads(msg["args_blob"])
+            res = rt.submit_task(msg["fn_id"], args, kwargs,
+                                 **msg["opts"])
+            refs = (res if isinstance(res, list)
+                    else [] if res is None else [res])
+            return {"ref_ids": self._register(sess, refs),
+                    "shape": ("list" if isinstance(res, list)
+                              else "none" if res is None else "one")}
+        if op == "create_actor":
+            args, kwargs = _loads(msg["args_blob"])
+            aid = rt.create_actor(msg["fn_id"], args, kwargs,
+                                  **msg["opts"])
+            sess.actors[aid.binary()] = bool(msg.get("detached"))
+            return {"actor_id": aid.binary()}
+        if op == "actor_task":
+            args, kwargs = _loads(msg["args_blob"])
+            res = rt.submit_actor_task(
+                ActorID(msg["actor_id"]), msg["nonce"], msg["seq"],
+                msg["method"], args, kwargs, **msg["opts"])
+            refs = (res if isinstance(res, list)
+                    else [] if res is None else [res])
+            return {"ref_ids": self._register(sess, refs),
+                    "shape": ("list" if isinstance(res, list)
+                              else "none" if res is None else "one")}
+        if op == "kill_actor":
+            rt.kill_actor(ActorID(msg["actor_id"]),
+                          no_restart=msg["no_restart"])
+            sess.actors.pop(msg["actor_id"], None)
+            return {}
+        if op == "put":
+            ref = rt.put(_loads(msg["blob"]))
+            return {"ref_id": self._register(sess, [ref])[0]}
+        if op == "get":
+            refs = [sess.refs.get(b) or ObjectRef(ObjectID(b))
+                    for b in msg["ref_ids"]]
+            vals = rt.get(refs, timeout=msg.get("timeout"))
+            return {"blob": _dumps(vals)}
+        if op == "wait":
+            id_to_ref = {b: (sess.refs.get(b) or ObjectRef(ObjectID(b)))
+                         for b in msg["ref_ids"]}
+            ready, rest = rt.wait(
+                [id_to_ref[b] for b in msg["ref_ids"]],
+                num_returns=msg["num_returns"],
+                timeout=msg.get("timeout"))
+            return {"ready": [r.binary() for r in ready],
+                    "rest": [r.binary() for r in rest]}
+        if op == "free":
+            refs = [sess.refs.get(b) or ObjectRef(ObjectID(b))
+                    for b in msg["ref_ids"]]
+            rt.free(refs)
+            return {}
+        if op == "release":
+            for b in msg["ref_ids"]:
+                sess.refs.pop(b, None)
+            return {}
+        if op == "request":  # generic state-API pass-through
+            return {"reply": rt.client.request(msg["msg"])}
+        raise ValueError(f"unknown client op {op!r}")
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ========================================================================
+# Client
+# ========================================================================
+
+class _ClientShim:
+    """Quacks like NodeClient for the bits the API layer touches
+    (config_dict, worker_id, request)."""
+
+    def __init__(self, proxy: "ClientRuntime", config_dict: dict,
+                 worker_id: str):
+        self._proxy = proxy
+        self.config_dict = config_dict
+        self.worker_id = worker_id
+
+    def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        return self._proxy._call({"op": "request", "msg": msg})["reply"]
+
+
+class ClientRuntime:
+    """Drop-in Runtime replacement speaking the client protocol
+    (reference: util/client/worker.py:81 Worker)."""
+
+    mode = "client"
+
+    def __init__(self, address: str, namespace: str = "default",
+                 timeout: float = 30.0):
+        hostport = address[len("ray://"):] if address.startswith("ray://") \
+            else address
+        host, _, port = hostport.rpartition(":")
+        sock = socket.create_connection((host or "127.0.0.1", int(port)),
+                                        timeout=timeout)
+        sock.settimeout(None)
+        self._conn = Connection(sock)
+        self._lock = threading.Lock()
+        self._rid = 0
+        hello = self._call({"op": "connect", "version": PROTOCOL_VERSION})
+        self.namespace = namespace
+        self.client = _ClientShim(self, hello["config_dict"],
+                                  "client-of-" + hello["worker_id"])
+        self._fn_ids: dict[int, str] = {}
+        self.node_service = None
+        get_tracker().set_sink(self._release_refs)
+
+    # -- plumbing ----------------------------------------------------------
+    def _call(self, msg: dict) -> dict:
+        with self._lock:
+            self._rid += 1
+            msg["rid"] = self._rid
+            self._conn.send(msg)
+            while True:
+                reply = self._conn.recv()
+                if reply.get("rid") == msg["rid"]:
+                    break
+        if "error" in reply:
+            raise _loads(reply["error"])
+        return reply
+
+    def _refs_from(self, reply) -> Any:
+        refs = [ObjectRef(ObjectID(b), owner=self.client.worker_id)
+                for b in reply["ref_ids"]]
+        shape = reply["shape"]
+        if shape == "one":
+            return refs[0]
+        if shape == "none":
+            return None
+        return refs
+
+    # -- Runtime surface ---------------------------------------------------
+    def export_function(self, fn) -> str:
+        import hashlib
+        blob = _dumps(fn)
+        # key by content hash, not id(fn): CPython reuses addresses
+        # after GC, which would silently alias two different functions
+        key = hashlib.sha1(blob).hexdigest()
+        if key not in self._fn_ids:
+            self._fn_ids[key] = self._call(
+                {"op": "export", "blob": blob})["fn_id"]
+        return self._fn_ids[key]
+
+    def submit_task(self, function_id: str, args, kwargs, **opts):
+        return self._refs_from(self._call({
+            "op": "task", "fn_id": function_id,
+            "args_blob": _dumps((args, kwargs)), "opts": opts}))
+
+    def create_actor(self, function_id: str, args, kwargs, **opts):
+        detached = opts.pop("lifetime", None) == "detached" or \
+            bool(opts.get("name"))
+        reply = self._call({
+            "op": "create_actor", "fn_id": function_id,
+            "args_blob": _dumps((args, kwargs)), "opts": opts,
+            "detached": detached})
+        return ActorID(reply["actor_id"])
+
+    def submit_actor_task(self, actor_id: ActorID, caller_nonce: bytes,
+                          seq: int, method: str, args, kwargs, **opts):
+        return self._refs_from(self._call({
+            "op": "actor_task", "actor_id": actor_id.binary(),
+            "nonce": caller_nonce, "seq": seq, "method": method,
+            "args_blob": _dumps((args, kwargs)), "opts": opts}))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._call({"op": "kill_actor", "actor_id": actor_id.binary(),
+                    "no_restart": no_restart})
+
+    def put(self, value) -> ObjectRef:
+        reply = self._call({"op": "put", "blob": _dumps(value)})
+        return ObjectRef(ObjectID(reply["ref_id"]),
+                         owner=self.client.worker_id)
+
+    def get(self, refs: Sequence[ObjectRef], timeout=None) -> list:
+        reply = self._call({"op": "get",
+                            "ref_ids": [r.binary() for r in refs],
+                            "timeout": timeout})
+        return _loads(reply["blob"])
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        by_id = {r.binary(): r for r in refs}
+        reply = self._call({"op": "wait",
+                            "ref_ids": [r.binary() for r in refs],
+                            "num_returns": num_returns,
+                            "timeout": timeout})
+        return ([by_id[b] for b in reply["ready"]],
+                [by_id[b] for b in reply["rest"]])
+
+    def free(self, refs) -> None:
+        self._call({"op": "free",
+                    "ref_ids": [r.binary() for r in refs]})
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(self.get([ref], timeout=None)[0])
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def _release_refs(self, object_ids: list) -> None:
+        # fire-and-forget: this can run from ObjectRef.__del__ during GC
+        # while this thread is inside _call holding self._lock — a
+        # request/response here would self-deadlock (Connection.send is
+        # itself thread-safe, and the server sends no reply for no_reply
+        # messages so the rid stream stays in sync)
+        try:
+            self._conn.send({"op": "release",
+                             "ref_ids": list(object_ids),
+                             "no_reply": True})
+        except Exception:  # noqa: BLE001 - racing disconnect
+            pass
+
+    def shutdown(self) -> None:
+        get_tracker().set_sink(None)
+        try:
+            self._conn.sock.close()
+        except OSError:
+            pass
+
+
+def connect(address: str, namespace: str = "default") -> ClientRuntime:
+    """Connect to a ray:// client server (reference:
+    util/client/__init__.py connect)."""
+    return ClientRuntime(address, namespace=namespace)

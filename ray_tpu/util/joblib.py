@@ -1,0 +1,66 @@
+"""joblib backend over ray_tpu (reference capability:
+python/ray/util/joblib/ — `register_ray()` + `parallel_backend("ray")`
+so sklearn grid-search etc. fan out over the cluster).
+
+Implemented as a joblib ParallelBackendBase subclass when joblib is
+importable; `register_ray()` is a no-op with a warning otherwise (no new
+dependencies may be installed in this environment).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+_registered = False
+
+
+def register_ray() -> None:
+    """Register the 'ray' joblib backend (idempotent)."""
+    global _registered
+    if _registered:
+        return
+    try:
+        from joblib.parallel import register_parallel_backend
+    except ImportError:
+        warnings.warn("joblib is not installed; register_ray() is a no-op")
+        return
+    register_parallel_backend("ray", _make_backend_class())
+    _registered = True
+
+
+def _make_backend_class():
+    from joblib._parallel_backends import MultiprocessingBackend
+
+    class RayBackend(MultiprocessingBackend):
+        """joblib backend whose pool is ray_tpu.util.multiprocessing.Pool.
+
+        joblib's MultiprocessingBackend drives an mp.Pool via apply_async;
+        our Pool implements that surface, so the integration point is just
+        configure() swapping the pool.
+        """
+
+        supports_timeout = True
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            if n_jobs == 1:
+                return 1
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 2))
+            return cpus if n_jobs in (None, -1) else n_jobs
+
+        def configure(self, n_jobs=1, parallel=None, prefer=None,
+                      require=None, **memmappingpool_args):
+            from ray_tpu.util.multiprocessing import Pool
+            n_jobs = self.effective_n_jobs(n_jobs)
+            self._pool = Pool(processes=n_jobs)
+            self.parallel = parallel
+            return n_jobs
+
+        def terminate(self):
+            if getattr(self, "_pool", None) is not None:
+                self._pool.terminate()
+                self._pool = None
+
+    return RayBackend

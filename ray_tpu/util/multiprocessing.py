@@ -1,0 +1,261 @@
+"""multiprocessing.Pool API over ray_tpu tasks.
+
+Reference capability: python/ray/util/multiprocessing/pool.py — a drop-in
+`Pool` whose workers are cluster actors, so `pool.map` fans out across
+the cluster instead of local forks. Re-derived for ray_tpu: each pool
+worker is an actor holding an optional initializer state; chunked
+submission mirrors stdlib `multiprocessing.pool.Pool` semantics
+(chunksize, ordered map vs imap_unordered, AsyncResult).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+
+class TimeoutError(Exception):
+    """Raised when AsyncResult.get times out (mirrors mp.TimeoutError)."""
+
+
+class AsyncResult:
+    """Handle to an in-flight map/apply (mirrors mp.pool.AsyncResult)."""
+
+    def __init__(self, refs: list, single: bool, pool: "Pool",
+                 callback=None, error_callback=None):
+        self._refs = refs
+        self._single = single
+        self._pool = pool
+        self._callback = callback
+        self._error_callback = error_callback
+        self._result = None
+        self._error = None
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._bg = None
+        if callback is not None or error_callback is not None:
+            # stdlib mp.Pool fires callbacks from its result-handler
+            # thread as soon as results land; consumers like joblib wait
+            # on the callback, never calling get() — so resolve eagerly.
+            self._bg = threading.Thread(target=self._resolve,
+                                        args=(None,), daemon=True)
+            self._bg.start()
+
+    def _resolve(self, timeout: Optional[float]):
+        with self._lock:
+            if self._done.is_set():
+                return
+            import ray_tpu
+            try:
+                chunks = ray_tpu.get(self._refs, timeout=timeout)
+                out = list(itertools.chain.from_iterable(chunks))
+                self._result = out[0] if self._single else out
+                if self._callback is not None:
+                    self._callback(self._result)
+            except ray_tpu.GetTimeoutError:
+                raise TimeoutError("result not ready within timeout")
+            except Exception as e:  # noqa: BLE001 - surfaced via get()
+                self._error = e
+                if self._error_callback is not None:
+                    self._error_callback(e)
+            self._done.set()
+
+    def get(self, timeout: Optional[float] = None):
+        if self._bg is not None:
+            # a background resolver owns the lock for the whole job —
+            # wait on the completion event so `timeout` is honored
+            if not self._done.wait(timeout):
+                raise TimeoutError("result not ready within timeout")
+        else:
+            self._resolve(timeout)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def wait(self, timeout: Optional[float] = None):
+        if self._bg is not None:
+            self._done.wait(timeout)
+            return
+        try:
+            self._resolve(timeout)
+        except TimeoutError:
+            pass
+
+    def ready(self) -> bool:
+        if self._done.is_set():
+            return True
+        import ray_tpu
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self._done.is_set():
+            raise ValueError("result is not ready")
+        return self._error is None
+
+
+class IMapIterator:
+    """Iterator over chunk results; ordered or completion-ordered."""
+
+    def __init__(self, refs: list, ordered: bool):
+        self._refs = list(refs)
+        self._ordered = ordered
+        self._buffer: list = []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+        if self._buffer:
+            return self._buffer.pop(0)
+        if not self._refs:
+            raise StopIteration
+        if self._ordered:
+            ref = self._refs.pop(0)
+        else:
+            ready, rest = ray_tpu.wait(self._refs, num_returns=1)
+            ref = ready[0]
+            self._refs = rest
+        self._buffer.extend(ray_tpu.get(ref))
+        return self.__next__()
+
+    next = __next__
+
+
+class Pool:
+    """Process-pool-compatible API backed by ray_tpu actors.
+
+    Reference: python/ray/util/multiprocessing/pool.py (Pool), which
+    replaces fork workers with `PoolActor`s. Initializer runs once per
+    worker actor; tasks are submitted as chunks to bound queue growth.
+    """
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: Sequence = (), maxtasksperchild=None,
+                 ray_address: Optional[str] = None):
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=ray_address)
+        self._rt = ray_tpu
+        if processes is None:
+            res = ray_tpu.cluster_resources()
+            processes = max(1, int(res.get("CPU", 2)))
+        self._processes = processes
+        self._closed = False
+
+        @ray_tpu.remote
+        class PoolActor:
+            def __init__(self, initializer=None, initargs=()):
+                if initializer is not None:
+                    initializer(*initargs)
+
+            def run(self, fn, chunk, star):
+                if star:
+                    return [fn(*item) for item in chunk]
+                return [fn(item) for item in chunk]
+
+            def ping(self):
+                return True
+
+        self._actors = [PoolActor.remote(initializer, tuple(initargs))
+                        for _ in range(processes)]
+        self._rr = 0  # round-robin cursor over pool actors
+
+    # -- submission helpers ------------------------------------------------
+    def _submit_chunks(self, fn, iterable, chunksize, star=False) -> list:
+        if self._closed:
+            raise ValueError("Pool not running")
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        refs = []
+        for i in range(0, len(items), chunksize):
+            actor = self._actors[self._rr % len(self._actors)]
+            self._rr += 1
+            refs.append(actor.run.remote(fn, items[i:i + chunksize], star))
+        return refs
+
+    # -- mp.Pool API -------------------------------------------------------
+    def apply(self, fn: Callable, args: Sequence = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: Sequence = (),
+                    kwds: dict = None, callback=None, error_callback=None):
+        kwds = kwds or {}
+        call = _KwCall(fn, kwds) if kwds else fn
+        refs = self._submit_chunks(call, [tuple(args)], 1, star=True)
+        return AsyncResult(refs, single=True, pool=self,
+                           callback=callback, error_callback=error_callback)
+
+    def map(self, fn: Callable, iterable: Iterable, chunksize=None) -> List:
+        return AsyncResult(self._submit_chunks(fn, iterable, chunksize),
+                           single=False, pool=self).get()
+
+    def map_async(self, fn, iterable, chunksize=None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        return AsyncResult(self._submit_chunks(fn, iterable, chunksize),
+                           single=False, pool=self, callback=callback,
+                           error_callback=error_callback)
+
+    def starmap(self, fn, iterable: Iterable[Sequence], chunksize=None):
+        return AsyncResult(
+            self._submit_chunks(fn, iterable, chunksize, star=True),
+            single=False, pool=self).get()
+
+    def starmap_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return AsyncResult(
+            self._submit_chunks(fn, iterable, chunksize, star=True),
+            single=False, pool=self)
+
+    def imap(self, fn, iterable, chunksize=1) -> IMapIterator:
+        return IMapIterator(self._submit_chunks(fn, iterable, chunksize),
+                            ordered=True)
+
+    def imap_unordered(self, fn, iterable, chunksize=1) -> IMapIterator:
+        return IMapIterator(self._submit_chunks(fn, iterable, chunksize),
+                            ordered=False)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for a in self._actors:
+            try:
+                self._rt.kill(a)
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self._actors = []
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+        # close() drains nothing in this model: all submitted work holds
+        # its own refs; nothing to wait on here beyond actor liveness.
+        for a in self._actors:
+            try:
+                self._rt.get(a.ping.remote(), timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _KwCall:
+    """Picklable functools.partial-alike carrying kwargs for apply()."""
+
+    def __init__(self, fn, kwds):
+        self.fn = fn
+        self.kwds = kwds
+
+    def __call__(self, *args):
+        return self.fn(*args, **self.kwds)

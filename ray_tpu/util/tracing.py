@@ -1,0 +1,150 @@
+"""Distributed tracing: spans around task/actor submission + execution.
+
+Reference capability: python/ray/util/tracing/tracing_helper.py — when
+tracing is enabled, every ``.remote()`` call opens a client span whose
+context is injected into the task spec, and the executing worker opens
+a server span as its child, so cross-process traces stitch together in
+one trace id.
+
+Dependency-light redesign (no opentelemetry wheel in this image): spans
+are plain dicts with W3C-style ids (128-bit trace id, 64-bit span id);
+context propagates in-process via a contextvar and cross-process inside
+the task spec (``trace_ctx``). Finished spans land in an in-process
+buffer and, when ``RAY_TPU_TRACE_DIR`` is set, one JSONL file per
+process — ``collect_spans()`` merges them for analysis/tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import glob
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+_current: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None)
+
+_lock = threading.Lock()
+_finished: List[dict] = []
+_MAX_BUFFER = 10_000
+_file = None
+_enabled: Optional[bool] = None
+
+
+def tracing_enabled() -> bool:
+    """Flag gate (reference: tracing enabled via ray.init tracing
+    startup hook / RAY_TRACING_ENABLED)."""
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get("RAY_TPU_TRACING", "").lower() in (
+            "1", "true", "yes") or bool(os.environ.get("RAY_TPU_TRACE_DIR"))
+    return _enabled
+
+
+def enable_tracing(trace_dir: Optional[str] = None) -> None:
+    global _enabled
+    _enabled = True
+    os.environ["RAY_TPU_TRACING"] = "1"
+    if trace_dir:
+        os.environ["RAY_TPU_TRACE_DIR"] = trace_dir
+
+
+def disable_tracing() -> None:
+    global _enabled, _file
+    _enabled = False
+    os.environ.pop("RAY_TPU_TRACING", None)
+    os.environ.pop("RAY_TPU_TRACE_DIR", None)
+    with _lock:
+        if _file is not None:
+            _file.close()
+            _file = None
+
+
+def _emit(span: dict) -> None:
+    global _file
+    with _lock:
+        _finished.append(span)
+        if len(_finished) > _MAX_BUFFER:
+            del _finished[:len(_finished) - _MAX_BUFFER]
+        d = os.environ.get("RAY_TPU_TRACE_DIR")
+        if d:
+            if _file is None:
+                os.makedirs(d, exist_ok=True)
+                _file = open(os.path.join(
+                    d, f"spans-{os.getpid()}.jsonl"), "a")
+            _file.write(json.dumps(span) + "\n")
+            _file.flush()
+
+
+@contextlib.contextmanager
+def start_span(name: str, kind: str = "internal",
+               attributes: Optional[Dict[str, Any]] = None,
+               remote_ctx: Optional[dict] = None) -> Iterator[dict]:
+    """Open a span; parent = remote_ctx (cross-process) or the current
+    in-process span. Yields the mutable span dict (add attributes)."""
+    if not tracing_enabled():
+        yield {}
+        return
+    parent = remote_ctx if remote_ctx is not None else _current.get()
+    span = {
+        "name": name,
+        "kind": kind,
+        "trace_id": (parent or {}).get("trace_id") or secrets.token_hex(16),
+        "span_id": secrets.token_hex(8),
+        "parent_id": (parent or {}).get("span_id"),
+        "start": time.time(),
+        "pid": os.getpid(),
+        "attributes": dict(attributes or {}),
+        "status": "ok",
+    }
+    token = _current.set({"trace_id": span["trace_id"],
+                          "span_id": span["span_id"]})
+    try:
+        yield span
+    except BaseException as e:
+        span["status"] = f"error: {type(e).__name__}"
+        raise
+    finally:
+        _current.reset(token)
+        span["end"] = time.time()
+        _emit(span)
+
+
+def inject_context() -> Optional[dict]:
+    """Current span context for embedding in a task spec (reference:
+    tracing_helper.py _inject_tracing_into_function)."""
+    if not tracing_enabled():
+        return None
+    return _current.get()
+
+
+def get_finished_spans(name: Optional[str] = None) -> List[dict]:
+    with _lock:
+        spans = list(_finished)
+    if name:
+        spans = [s for s in spans if s["name"] == name]
+    return spans
+
+
+def clear() -> None:
+    with _lock:
+        _finished.clear()
+
+
+def collect_spans(trace_dir: Optional[str] = None) -> List[dict]:
+    """Merge every process's span file (worker spans included)."""
+    d = trace_dir or os.environ.get("RAY_TPU_TRACE_DIR")
+    if not d:
+        return get_finished_spans()
+    out = []
+    for p in sorted(glob.glob(os.path.join(d, "spans-*.jsonl"))):
+        with open(p) as f:
+            for line in f:
+                if line.strip():
+                    out.append(json.loads(line))
+    return out

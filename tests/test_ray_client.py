@@ -1,0 +1,119 @@
+"""Ray Client (ray:// proxy) tests (reference test model:
+python/ray/tests/test_client.py — connect, tasks, actors, put/get/wait,
+disconnect cleanup)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.client import ClientServer, connect
+
+
+@pytest.fixture
+def client_server(rt_init):
+    server = ClientServer(host="127.0.0.1", port=0)
+    yield server
+    server.stop()
+
+
+def test_client_connect_and_task(client_server):
+    c = connect(client_server.address)
+    try:
+        fn_id = c.export_function(lambda x: x * 3)
+        ref = c.submit_task(fn_id, (14,), {}, name="t", num_returns=1,
+                            resources={}, num_tpus=0, max_retries=0,
+                            placement_group=None, runtime_env=None)
+        assert c.get([ref], timeout=60) == [42]
+    finally:
+        c.shutdown()
+
+
+def test_client_put_get_wait_free(client_server):
+    c = connect(client_server.address)
+    try:
+        a = c.put(np.arange(5))
+        b = c.put("hello")
+        ready, rest = c.wait([a, b], num_returns=2, timeout=30)
+        assert len(ready) == 2 and not rest
+        va, vb = c.get([a, b], timeout=30)
+        np.testing.assert_array_equal(va, np.arange(5))
+        assert vb == "hello"
+        c.free([a, b])
+    finally:
+        c.shutdown()
+
+
+def test_client_through_public_api(rt_init):
+    """init(address='ray://...') swaps in the ClientRuntime so @remote
+    works unchanged."""
+    server = ClientServer(host="127.0.0.1", port=0)
+    try:
+        import ray_tpu.core.runtime as rtmod
+        saved = rtmod._runtime
+        rtmod._runtime = None
+        try:
+            ray_tpu.init(address=server.address)
+
+            @ray_tpu.remote
+            def add(a, b):
+                return a + b
+
+            assert ray_tpu.get(add.remote(2, 3), timeout=60) == 5
+
+            @ray_tpu.remote
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+
+                def incr(self):
+                    self.n += 1
+                    return self.n
+
+            c = Counter.remote()
+            assert ray_tpu.get([c.incr.remote() for _ in range(3)],
+                               timeout=60) == [1, 2, 3]
+            ray_tpu.kill(c)
+        finally:
+            rt = rtmod._runtime
+            if rt is not None and getattr(rt, "mode", "") == "client":
+                rt.shutdown()
+            rtmod._runtime = saved
+    finally:
+        server.stop()
+
+
+def test_client_error_propagates(client_server):
+    c = connect(client_server.address)
+    try:
+        def boom():
+            raise ValueError("kaput")
+        fn_id = c.export_function(boom)
+        ref = c.submit_task(fn_id, (), {}, name="boom", num_returns=1,
+                            resources={}, num_tpus=0, max_retries=0,
+                            placement_group=None, runtime_env=None)
+        with pytest.raises(Exception, match="kaput"):
+            c.get([ref], timeout=60)
+    finally:
+        c.shutdown()
+
+
+def test_client_disconnect_kills_actors(client_server, rt_init):
+    c = connect(client_server.address)
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return "ok"
+
+    fn_id = c.export_function(Holder._cls if hasattr(Holder, "_cls")
+                              else Holder)
+    # create through the raw client op so we control options
+    aid = c.create_actor(fn_id, (), {}, class_name="Holder",
+                         methods=["ping"], name="", namespace="default",
+                         get_if_exists=False, resources={}, num_tpus=0,
+                         max_restarts=0, max_concurrency=1,
+                         placement_group=None, runtime_env=None)
+    ref = c.submit_actor_task(aid, b"nonce0", 0, "ping", (), {},
+                              num_returns=1, name="ping")
+    assert c.get([ref], timeout=60) == ["ok"]
+    c.shutdown()

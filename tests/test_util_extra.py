@@ -1,0 +1,133 @@
+"""Tests for util parity modules: multiprocessing.Pool shim, joblib
+backend, ParallelIterator (reference test models:
+python/ray/tests/test_multiprocessing.py, test_joblib.py, test_iter.py).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import iter as rt_iter
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture(autouse=True)
+def _rt(rt_init):
+    yield
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+class TestPool:
+    def test_map(self):
+        with Pool(2) as p:
+            assert p.map(_sq, range(10)) == [x * x for x in range(10)]
+
+    def test_apply_and_async(self):
+        with Pool(2) as p:
+            assert p.apply(_add, (2, 3)) == 5
+            r = p.apply_async(_add, (10, 20))
+            assert r.get(timeout=60) == 30
+            assert r.successful()
+
+    def test_starmap(self):
+        with Pool(2) as p:
+            assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+
+    def test_imap_ordered(self):
+        with Pool(2) as p:
+            assert list(p.imap(_sq, range(8), chunksize=3)) == \
+                [x * x for x in range(8)]
+
+    def test_imap_unordered(self):
+        with Pool(2) as p:
+            got = sorted(p.imap_unordered(_sq, range(8), chunksize=2))
+            assert got == sorted(x * x for x in range(8))
+
+    def test_initializer(self):
+        def init(v):
+            import os
+            os.environ["_POOL_INIT_V"] = str(v)
+
+        def read(_):
+            import os
+            return os.environ.get("_POOL_INIT_V")
+
+        with Pool(2, initializer=init, initargs=(7,)) as p:
+            assert p.map(read, range(4)) == ["7"] * 4
+
+    def test_map_error_propagates(self):
+        def boom(x):
+            raise ValueError("boom")
+        with Pool(2) as p:
+            with pytest.raises(Exception, match="boom"):
+                p.map(boom, range(4))
+
+
+class TestParallelIterator:
+    def test_from_items_gather_sync(self):
+        it = rt_iter.from_items(list(range(20)), num_shards=3)
+        assert sorted(it.gather_sync()) == list(range(20))
+
+    def test_for_each_filter_batch(self):
+        it = (rt_iter.from_range(12, num_shards=2)
+              .for_each(lambda x: x * 2)
+              .filter(lambda x: x % 3 == 0)
+              .batch(2))
+        flat = [x for b in it.gather_sync() for x in b]
+        assert sorted(flat) == sorted(
+            x * 2 for x in range(12) if (x * 2) % 3 == 0)
+
+    def test_flatten_combine(self):
+        it = rt_iter.from_items([[1, 2], [3, 4]], num_shards=2).flatten()
+        assert sorted(it.gather_sync()) == [1, 2, 3, 4]
+        it2 = rt_iter.from_range(3, num_shards=1).combine(
+            lambda x: [x, x * 10])
+        assert list(it2.gather_sync()) == [0, 0, 1, 10, 2, 20]
+
+    def test_gather_async(self):
+        it = rt_iter.from_range(30, num_shards=3).for_each(lambda x: x + 1)
+        assert sorted(it.gather_async(num_async=2)) == list(range(1, 31))
+
+    def test_local_shuffle_preserves_multiset(self):
+        it = rt_iter.from_range(50, num_shards=2).local_shuffle(
+            shuffle_buffer_size=10, seed=0)
+        assert sorted(it.gather_sync()) == list(range(50))
+
+    def test_take_and_shards(self):
+        it = rt_iter.from_range(100, num_shards=4)
+        assert len(it.take(5)) == 5
+        shards = it.for_each(lambda x: -x).shards()
+        assert len(shards) == 4
+        assert sorted(sum((list(s) for s in shards), [])) == \
+            sorted(-x for x in range(100))
+
+    def test_union_and_repartition(self):
+        a = rt_iter.from_items([1, 2], num_shards=1)
+        b = rt_iter.from_items([3, 4], num_shards=1)
+        u = a.union(b)
+        assert u.num_shards() == 2
+        assert sorted(u.gather_sync()) == [1, 2, 3, 4]
+        r = rt_iter.from_range(10, num_shards=2).repartition(5)
+        assert r.num_shards() == 5
+        assert sorted(r.gather_sync()) == list(range(10))
+
+    def test_repeat(self):
+        it = rt_iter.from_items([1, 2, 3], num_shards=1, repeat=True)
+        assert it.gather_sync().take(7) == [1, 2, 3, 1, 2, 3, 1]
+
+
+class TestJoblib:
+    def test_backend_registers_and_runs(self):
+        joblib = pytest.importorskip("joblib")
+        from ray_tpu.util.joblib import register_ray
+        register_ray()
+        with joblib.parallel_backend("ray", n_jobs=2):
+            out = joblib.Parallel()(
+                joblib.delayed(_sq)(i) for i in range(6))
+        assert out == [x * x for x in range(6)]
