@@ -187,10 +187,14 @@ class Executor:
         from ray_tpu.runtime_env import applied_env
         error = None
         try:
+            from ray_tpu.util.tracing import start_span
             fn = self._get_function(spec["function_id"])
             args, kwargs = self._load_args(spec)
             with task_context(TaskID(spec["task_id"])), \
-                    applied_env(spec.get("runtime_env"), self.client):
+                    applied_env(spec.get("runtime_env"), self.client), \
+                    start_span(f"task::{spec.get('name', '?')}.execute",
+                               kind="server",
+                               remote_ctx=spec.get("trace_ctx")):
                 result = fn(*args, **kwargs)
             self._store_returns(spec, result)
         except BaseException as e:  # noqa: BLE001 — report all task errors
@@ -236,11 +240,15 @@ class Executor:
             instance = self._actors.get(spec["actor_id"])
             if instance is None:
                 raise RuntimeError("actor instance not found in this worker")
+            from ray_tpu.util.tracing import start_span
             method = getattr(instance, spec["method"])
             args, kwargs = self._load_args(spec)
             with task_context(TaskID(spec["task_id"])), \
                     applied_env(self._actor_envs.get(spec["actor_id"]),
-                                self.client):
+                                self.client), \
+                    start_span(f"actor::{spec.get('name', '?')}.execute",
+                               kind="server",
+                               remote_ctx=spec.get("trace_ctx")):
                 result = method(*args, **kwargs)
                 if inspect.iscoroutine(result):
                     import asyncio

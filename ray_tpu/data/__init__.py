@@ -22,12 +22,15 @@ read_json = Dataset.read_json
 read_numpy = Dataset.read_numpy
 read_text = Dataset.read_text
 read_binary_files = Dataset.read_binary_files
+read_tfrecords = Dataset.read_tfrecords
+read_images = Dataset.read_images
 
 __all__ = [
     "Dataset", "DatasetPipeline", "GroupedData", "AggregateFn", "Count",
     "Sum", "Min", "Max", "block", "from_items", "range", "from_numpy",
     "from_pandas", "read_csv", "read_parquet", "read_json", "read_numpy",
-    "read_text", "read_binary_files", "Preprocessor", "BatchMapper",
+    "read_text", "read_binary_files", "read_tfrecords", "read_images",
+    "Preprocessor", "BatchMapper",
     "Chain", "StandardScaler", "MinMaxScaler", "LabelEncoder",
     "Concatenator", "Normalizer", "OneHotEncoder", "RobustScaler",
     "SimpleImputer",

@@ -178,6 +178,32 @@ class Dataset:
         return Dataset(blocks or [{}])
 
     @staticmethod
+    def read_tfrecords(paths: Union[str, list[str]]) -> "Dataset":
+        """TFRecord files of tf.train.Example records → one block/file
+        (reference: datasource/tfrecords_datasource.py; the Example
+        protobuf + crc framing are decoded natively — see
+        data/datasource.py)."""
+        from ray_tpu.data.datasource import read_tfrecords_blocks
+        return Dataset(
+            read_tfrecords_blocks(Dataset._expand_paths(paths)) or [{}])
+
+    def write_tfrecords(self, dir_path: str) -> list[str]:
+        from ray_tpu.data.datasource import write_tfrecords_blocks
+        return write_tfrecords_blocks(self._materialize(), dir_path)
+
+    @staticmethod
+    def read_images(paths: Union[str, list[str]], *, size=None,
+                    mode: str = "RGB",
+                    include_paths: bool = False) -> "Dataset":
+        """Image files → uint8 tensors (reference:
+        datasource/image_datasource.py ImageDatasource)."""
+        from ray_tpu.data.datasource import read_images_blocks
+        return Dataset(
+            read_images_blocks(Dataset._expand_paths(paths), size=size,
+                               mode=mode, include_paths=include_paths)
+            or [{}])
+
+    @staticmethod
     def read_parquet(paths: Union[str, list[str]], *,
                      block_format: str = "arrow") -> "Dataset":
         """Parquet files → one block per file (reference:
@@ -358,10 +384,15 @@ class Dataset:
                         for s in range(0, n, per)] or [{}])
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Global shuffle (reference: push_based_shuffle.py capability —
-        here: per-block permutation + round-robin redistribution, exact
-        permutation within materialized blocks)."""
+        """Global shuffle. Multi-block datasets on a live runtime go
+        through the push-based map/reduce exchange (data/shuffle.py,
+        reference: _internal/push_based_shuffle.py); otherwise an exact
+        driver-side permutation."""
         blocks = self._materialize()
+        import ray_tpu
+        if len(blocks) > 1 and ray_tpu.is_initialized():
+            from ray_tpu.data.shuffle import shuffle_blocks
+            return Dataset(shuffle_blocks(blocks, seed=seed))
         full = B.concat(blocks)
         n = B.num_rows(full)
         rng = np.random.default_rng(seed)
@@ -373,7 +404,16 @@ class Dataset:
                         for s in range(0, n, per)] or [{}])
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        full = B.concat(self._materialize())
+        """Global sort. Multi-block datasets on a live runtime use the
+        distributed sample-sort (data/shuffle.py, reference:
+        _internal/sort.py); otherwise one driver-side argsort."""
+        blocks = self._materialize()
+        import ray_tpu
+        if len(blocks) > 1 and ray_tpu.is_initialized():
+            from ray_tpu.data.shuffle import sort_blocks
+            return Dataset(sort_blocks(blocks, key,
+                                       descending=descending))
+        full = B.concat(blocks)
         order = np.argsort(B.column(full, key), kind="stable")
         if descending:
             order = order[::-1]

@@ -1,0 +1,324 @@
+"""Extra datasources: TFRecords and images.
+
+Reference capability: python/ray/data/datasource/tfrecords_datasource.py
+(read/write tf.train.Example records) and image_datasource.py
+(ImageDatasource — read image files into uint8 tensors).
+
+Dependency-light redesign: the TFRecord container format (length +
+masked-crc32c framing) and the tf.train.Example protobuf schema are
+implemented directly — ~3 fixed message types — so the reader/writer
+needs neither tensorflow nor protobuf at runtime. Images go through
+PIL when importable.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+# ========================================================================
+# crc32c (Castagnoli), table-driven — required by the TFRecord framing.
+# ========================================================================
+
+_CRC_TABLE: Optional[List[int]] = None
+
+
+def _crc_table() -> List[int]:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+try:                                    # C implementation when present
+    import google_crc32c as _gcrc
+except ImportError:                     # pragma: no cover
+    _gcrc = None
+
+
+def crc32c(data: bytes) -> int:
+    if _gcrc is not None:
+        return _gcrc.value(bytes(data))
+    # pure-python fallback — correct but slow; only hit when the
+    # accelerated wheel is absent
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# ========================================================================
+# TFRecord container framing
+# ========================================================================
+
+def write_tfrecord_file(path: str, records: Iterable[bytes]) -> int:
+    """[len u64][masked_crc(len) u32][data][masked_crc(data) u32]*"""
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            hdr = struct.pack("<Q", len(rec))
+            f.write(hdr)
+            f.write(struct.pack("<I", _masked_crc(hdr)))
+            f.write(rec)
+            f.write(struct.pack("<I", _masked_crc(rec)))
+            n += 1
+    return n
+
+
+def read_tfrecord_file(path: str) -> Iterable[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(8)
+            if not hdr:
+                return
+            if len(hdr) < 8:
+                raise ValueError(f"truncated tfrecord file {path}")
+            (length,) = struct.unpack("<Q", hdr)
+            (crc_hdr,) = struct.unpack("<I", f.read(4))
+            if _masked_crc(hdr) != crc_hdr:
+                raise ValueError(f"corrupt length crc in {path}")
+            data = f.read(length)
+            (crc_data,) = struct.unpack("<I", f.read(4))
+            if _masked_crc(data) != crc_data:
+                raise ValueError(f"corrupt record crc in {path}")
+            yield data
+
+
+# ========================================================================
+# Minimal protobuf codec for tf.train.Example
+#
+# Example       = { 1: Features }
+# Features      = { 1: map<string, Feature> }  (map entry: {1: key, 2: val})
+# Feature       = { 1: BytesList | 2: FloatList | 3: Int64List }
+# BytesList     = { 1: repeated bytes }
+# FloatList     = { 1: repeated float (packed) }
+# Int64List     = { 1: repeated int64 (packed varint) }
+# ========================================================================
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int):
+    result, shift = 0, 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _len_field(field_no: int, payload: bytes) -> bytes:
+    return _varint((field_no << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _encode_feature(values) -> bytes:
+    a = np.asarray(values)
+    if a.dtype.kind in ("S", "O", "U") or isinstance(values, (bytes, str)):
+        items = values if isinstance(values, (list, tuple, np.ndarray)) \
+            else [values]
+        body = b"".join(
+            _len_field(1, v.encode() if isinstance(v, str) else bytes(v))
+            for v in items)
+        return _len_field(1, body)                      # BytesList
+    if a.dtype.kind == "f":
+        packed = np.asarray(a, "<f4").tobytes()
+        return _len_field(2, _len_field(1, packed))     # FloatList packed
+    packed = b"".join(_varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+                      for v in a.reshape(-1))
+    return _len_field(3, _len_field(1, packed))         # Int64List packed
+
+
+def encode_example(row: Dict[str, Any]) -> bytes:
+    entries = b""
+    for key, values in row.items():
+        entry = _len_field(1, key.encode()) + _len_field(
+            2, _encode_feature(values))
+        entries += _len_field(1, entry)     # Features.feature map entry
+    return _len_field(1, entries)           # Example.features
+
+
+def _iter_fields(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field_no, wire = tag >> 3, tag & 7
+        if wire == 2:
+            n, pos = _read_varint(buf, pos)
+            yield field_no, buf[pos:pos + n]
+            pos += n
+        elif wire == 0:
+            v, pos = _read_varint(buf, pos)
+            yield field_no, v
+        elif wire == 5:
+            yield field_no, buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            yield field_no, buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def _decode_feature(buf: bytes):
+    for fno, payload in _iter_fields(buf):
+        if fno == 1:     # BytesList
+            return [p for n, p in _iter_fields(payload) if n == 1]
+        if fno == 2:     # FloatList (packed or repeated fixed32)
+            floats: list = []
+            for n, p in _iter_fields(payload):
+                if n == 1:
+                    floats.extend(np.frombuffer(p, "<f4").tolist()
+                                  if isinstance(p, bytes)
+                                  else [p])
+            return np.asarray(floats, np.float32)
+        if fno == 3:     # Int64List packed varints
+            ints: list = []
+            for n, p in _iter_fields(payload):
+                if n == 1 and isinstance(p, bytes):
+                    pos = 0
+                    while pos < len(p):
+                        v, pos = _read_varint(p, pos)
+                        if v >= 1 << 63:
+                            v -= 1 << 64
+                        ints.append(v)
+                elif n == 1:
+                    ints.append(p)
+            return np.asarray(ints, np.int64)
+    return []
+
+
+def decode_example(data: bytes) -> Dict[str, Any]:
+    row: Dict[str, Any] = {}
+    for fno, features in _iter_fields(data):
+        if fno != 1:
+            continue
+        for fno2, entry in _iter_fields(features):
+            if fno2 != 1:
+                continue
+            key, feature = None, None
+            for fno3, payload in _iter_fields(entry):
+                if fno3 == 1:
+                    key = payload.decode()
+                elif fno3 == 2:
+                    feature = payload
+            if key is not None and feature is not None:
+                row[key] = _decode_feature(feature)
+    return row
+
+
+# ========================================================================
+# Dataset-level readers/writers (wired as Dataset static/instance methods)
+# ========================================================================
+
+def read_tfrecords_blocks(paths: List[str]) -> List[dict]:
+    """One block per file; scalar features are unwrapped to 1 value/row
+    (reference: tfrecords_datasource.py unwrapping of single-element
+    lists)."""
+    blocks = []
+    for p in paths:
+        rows = [decode_example(rec) for rec in read_tfrecord_file(p)]
+        if not rows:
+            continue
+        # schema = union over all records, not just the first — records
+        # with heterogeneous feature sets must not silently lose columns
+        keys: Dict[str, None] = {}
+        for r in rows:
+            for k in r:
+                keys.setdefault(k)
+        cols: Dict[str, list] = {k: [] for k in keys}
+        for r in rows:
+            for k in cols:
+                v = r.get(k, [])
+                if isinstance(v, np.ndarray) and v.size == 1:
+                    v = v[0]
+                elif isinstance(v, list) and len(v) == 1:
+                    v = v[0]
+                cols[k].append(v)
+        block = {}
+        for k, vs in cols.items():
+            try:
+                block[k] = np.asarray(vs)
+            except Exception:  # ragged: keep as object array
+                a = np.empty(len(vs), object)
+                a[:] = vs
+                block[k] = a
+        blocks.append(block)
+    return blocks
+
+
+def write_tfrecords_blocks(blocks: Iterable[dict], dir_path: str
+                           ) -> List[str]:
+    os.makedirs(dir_path, exist_ok=True)
+    out = []
+    for i, block in enumerate(blocks):
+        from ray_tpu.data.block import to_columns
+        cols = to_columns(block)
+        keys = list(cols)
+        n = len(cols[keys[0]]) if keys else 0
+        recs = (encode_example({k: cols[k][j] for k in keys})
+                for j in range(n))
+        p = os.path.join(dir_path, f"part-{i:05d}.tfrecords")
+        write_tfrecord_file(p, recs)
+        out.append(p)
+    return out
+
+
+_IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def read_images_blocks(paths: List[str], size=None, mode: str = "RGB",
+                       include_paths: bool = False) -> List[dict]:
+    """Decode image files into uint8 arrays (reference:
+    image_datasource.py ImageDatasource; `size` resizes so rows stack
+    into one dense [N, H, W, C] column)."""
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise ImportError("read_images requires PIL") from e
+    paths = [p for p in paths if p.lower().endswith(_IMG_EXTS)]
+    imgs, kept = [], []
+    for p in paths:
+        with Image.open(p) as im:
+            im = im.convert(mode)
+            if size is not None:
+                im = im.resize(tuple(size))
+            imgs.append(np.asarray(im, np.uint8))
+            kept.append(p)
+    if not imgs:
+        return []
+    if size is not None:
+        col = np.stack(imgs)
+    else:
+        col = np.empty(len(imgs), object)
+        col[:] = imgs
+    block = {"image": col}
+    if include_paths:
+        block["path"] = np.asarray(kept)
+    return [block]

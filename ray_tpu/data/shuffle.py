@@ -1,0 +1,149 @@
+"""Push-based distributed shuffle and sort over the task/object plane.
+
+Reference capability: python/ray/data/_internal/push_based_shuffle.py +
+sort.py — two-stage map/reduce exchange: mappers partition each block
+and push the parts into the object store; reducers pull their partition
+ids and merge. The driver never materializes the dataset.
+
+ray_tpu shape: mappers are `num_returns=P` remote tasks (each return
+slot is one partition — the push), reducers are remote tasks taking one
+ref per mapper (the object plane moves only the needed parts). Sort
+uses sample-based range partitioning (reference: sort.py sample_boundaries),
+shuffle uses seeded random assignment. Falls back to inline execution
+when no runtime is up, keeping small/local datasets dependency-free.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.data import block as B
+
+
+def _split_random(blk, P: int, seed: int, block_index: int = 0):
+    cols = B.to_columns(blk)
+    n = B.num_rows(cols)
+    # distinct stream per mapper: equally-sized blocks must not get
+    # identical partition assignments
+    assign = np.random.default_rng(
+        (seed, block_index)).integers(0, P, n)
+    out = [B.take_rows(cols, np.nonzero(assign == p)[0])
+           for p in range(P)]
+    return out[0] if P == 1 else tuple(out)
+
+
+def _split_range(blk, key: str, bounds, descending: bool):
+    cols = B.to_columns(blk)
+    vals = B.column(cols, key)
+    bins = np.searchsorted(bounds, vals, side="right")
+    P = len(bounds) + 1
+    if descending:
+        bins = (P - 1) - bins
+    out = [B.take_rows(cols, np.nonzero(bins == p)[0]) for p in range(P)]
+    return out[0] if P == 1 else tuple(out)
+
+
+def _merge_shuffled(*parts, seed: int = 0):
+    full = B.concat([p for p in parts if B.num_rows(p)] or [parts[0]])
+    n = B.num_rows(full)
+    perm = np.random.default_rng(seed).permutation(n)
+    return B.take_rows(full, perm)
+
+
+def _merge_sorted(*parts, key: str, descending: bool = False):
+    full = B.concat([p for p in parts if B.num_rows(p)] or [parts[0]])
+    order = np.argsort(B.column(full, key), kind="stable")
+    if descending:
+        order = order[::-1]
+    return B.take_rows(full, order)
+
+
+def _runtime_up() -> bool:
+    import ray_tpu
+    return ray_tpu.is_initialized()
+
+
+def _exchange(blocks: List, map_fn, map_args_per_block, reduce_fn,
+              reduce_kwargs_per_part) -> List:
+    """Generic 2-stage exchange. map_fn(block, *map_args_i) -> P parts;
+    reduce_fn(*parts_p, **kwargs_p) -> merged block p."""
+    P = len(reduce_kwargs_per_part)
+    if not _runtime_up() or len(blocks) <= 1:
+        parts = [map_fn(b, *a) for b, a in zip(blocks, map_args_per_block)]
+        parts = [(p,) if P == 1 else p for p in parts]
+        return [reduce_fn(*[m[p] for m in parts],
+                          **reduce_kwargs_per_part[p]) for p in range(P)]
+    import ray_tpu
+    mapper = ray_tpu.remote(map_fn).options(num_returns=P)
+    reducer = ray_tpu.remote(reduce_fn)
+    part_refs = []  # [mapper][partition]
+    for blk, args in zip(blocks, map_args_per_block):
+        refs = mapper.remote(blk, *args)
+        part_refs.append([refs] if P == 1 else refs)
+    out_refs = [
+        reducer.remote(*[m[p] for m in part_refs],
+                       **reduce_kwargs_per_part[p])
+        for p in range(P)]
+    return ray_tpu.get(out_refs, timeout=600)
+
+
+def shuffle_blocks(blocks: List, num_partitions: Optional[int] = None,
+                   seed: Optional[int] = None) -> List:
+    """Distributed random shuffle -> num_partitions blocks."""
+    P = num_partitions or max(1, len(blocks))
+    # unseeded shuffles draw fresh entropy (matching the driver-side
+    # np.random.default_rng(None) path); seeded ones are reproducible
+    base = (int(np.random.SeedSequence().entropy) % (2 ** 31)
+            if seed is None else int(seed))
+    blocks = list(blocks)
+    if not blocks:
+        return []
+    out = []
+    mapped = _exchange(
+        blocks,
+        _split_random, [(P, base, i) for i in range(len(blocks))],
+        _merge_shuffled,
+        [{"seed": base + 1000 + p} for p in range(P)])
+    for blk in mapped:
+        if B.num_rows(blk):
+            out.append(blk)
+    return out or [blocks[0]]
+
+
+def sample_boundaries(blocks: List, key: str, P: int,
+                      sample_size: int = 256) -> np.ndarray:
+    """Range-partition boundaries from per-block samples (reference:
+    sort.py sample_boundaries)."""
+    samples = []
+    rng = np.random.default_rng(0)
+    for blk in blocks:
+        vals = B.column(B.to_columns(blk), key)
+        if len(vals) == 0:
+            continue
+        take = min(len(vals), sample_size)
+        samples.append(rng.choice(vals, size=take, replace=False))
+    if not samples:
+        return np.asarray([])
+    allv = np.sort(np.concatenate(samples))
+    qs = [(i + 1) * len(allv) // P for i in range(P - 1)]
+    return allv[[min(q, len(allv) - 1) for q in qs]]
+
+
+def sort_blocks(blocks: List, key: str, descending: bool = False,
+                num_partitions: Optional[int] = None) -> List:
+    """Distributed sample-sort -> globally ordered block list."""
+    blocks = [b for b in blocks if B.num_rows(b)]
+    if not blocks:
+        return []
+    P = num_partitions or max(1, len(blocks))
+    bounds = sample_boundaries(blocks, key, P)
+    if len(bounds) == 0:
+        P = 1
+    merged = _exchange(
+        blocks,
+        _split_range, [(key, bounds, descending)] * len(blocks),
+        _merge_sorted,
+        [{"key": key, "descending": descending} for _ in range(P)])
+    return [b for b in merged if B.num_rows(b)] or [blocks[0]]

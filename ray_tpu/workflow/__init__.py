@@ -15,6 +15,13 @@ stable across processes for identically-constructed DAGs.
 from ray_tpu.workflow.execution import (WorkflowStorage, cancel, delete,
                                         get_output, get_status, list_all,
                                         resume, run, run_async)
+from ray_tpu.workflow.extras import (Continuation, EventListener,
+                                     HTTPEventProvider, TimerListener,
+                                     continuation, virtual_actor,
+                                     wait_for_event)
 
 __all__ = ["run", "run_async", "resume", "get_status", "get_output",
-           "list_all", "cancel", "delete", "WorkflowStorage"]
+           "list_all", "cancel", "delete", "WorkflowStorage",
+           "continuation", "Continuation", "EventListener",
+           "TimerListener", "HTTPEventProvider", "wait_for_event",
+           "virtual_actor"]

@@ -106,26 +106,44 @@ class _WorkflowRun:
     def execute(self, dag: DAGNode, *input_args) -> Any:
         st = self.storage
         wf = self.workflow_id
-        task_ids = _topo_task_ids(dag)
         st.set_status(wf, "RUNNING")
-        memo: dict = {}
-
-        def run_node(node, args, kwargs):
-            tid = task_ids[node._id]
-            if st.has_task_result(wf, tid):
-                return st.get_task_result(wf, tid)
-            out = node._execute_impl(args, kwargs, input_args, {}, False)
-            st.put_task_result(wf, tid, out)
-            return out
-
         try:
-            result = dag._apply_recursive(run_node, memo)
+            result = self._execute_dag(dag, input_args, prefix="")
         except Exception:
             st.set_status(wf, "FAILED")
             raise
         st.put_task_result(wf, "__output__", result)
         st.set_status(wf, "SUCCESSFUL")
         return result
+
+    def _execute_dag(self, dag: DAGNode, input_args, prefix: str) -> Any:
+        """One DAG level; continuations recurse with a prefixed id
+        namespace so every continuation step is independently durable
+        (reference: workflow.continuation tail recursion)."""
+        st = self.storage
+        wf = self.workflow_id
+        task_ids = _topo_task_ids(dag)
+        memo: dict = {}
+
+        def run_node(node, args, kwargs):
+            tid = prefix + task_ids[node._id]
+            if st.has_task_result(wf, tid):
+                return st.get_task_result(wf, tid)
+            out = node._execute_impl(args, kwargs, input_args, {}, False)
+            out = self._resolve_continuations(out, tid)
+            st.put_task_result(wf, tid, out)
+            return out
+
+        return dag._apply_recursive(run_node, memo)
+
+    def _resolve_continuations(self, out, tid: str) -> Any:
+        from ray_tpu.workflow.extras import Continuation
+        depth = 0
+        while isinstance(out, Continuation):
+            out = self._execute_dag(out.dag, (),
+                                    prefix=f"{tid}.c{depth}.")
+            depth += 1
+        return out
 
 
 # -- module API (reference: workflow/api.py) -------------------------------

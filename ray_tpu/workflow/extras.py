@@ -1,0 +1,221 @@
+"""Workflow extensions: continuations, events, virtual actors.
+
+Reference capabilities:
+- continuations: python/ray/workflow/api.py ``workflow.continuation`` —
+  a task returns another DAG to execute in its place; the engine tail-
+  recurses durably (each continuation step checkpoints independently).
+- events: python/ray/workflow/event_listener.py (EventListener) +
+  http_event_provider.py — a workflow task that completes only when an
+  external event arrives, durable once observed.
+- virtual actors: the reference's workflow virtual-actor surface
+  (python/ray/workflow historical virtual_actor API) — an actor whose
+  state is durably persisted per actor id; each method call is a
+  load-state → run → persist-state step, so the actor survives process
+  loss between calls.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ray_tpu.workflow.execution import WorkflowStorage, _storage
+
+
+class Continuation:
+    """Marker returned by a workflow task: 'execute this DAG next, as my
+    result' (reference: workflow.continuation)."""
+
+    def __init__(self, dag):
+        from ray_tpu.dag.dag_node import DAGNode
+        if not isinstance(dag, DAGNode):
+            raise TypeError("continuation() takes a bound DAG node")
+        self.dag = dag
+
+
+def continuation(dag) -> Continuation:
+    return Continuation(dag)
+
+
+# ========================================================================
+# Events
+# ========================================================================
+
+class EventListener:
+    """Base event source (reference: event_listener.py EventListener —
+    poll_for_event is the single required method)."""
+
+    def poll_for_event(self, timeout: Optional[float] = None) -> Any:
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    """Fires at an absolute unix time (reference: workflow.sleep /
+    TimerListener)."""
+
+    def __init__(self, fire_at: float):
+        self.fire_at = fire_at
+
+    def poll_for_event(self, timeout: Optional[float] = None) -> Any:
+        delay = self.fire_at - time.time()
+        if timeout is not None and delay > timeout:
+            raise TimeoutError(f"timer fires in {delay:.1f}s > timeout")
+        if delay > 0:
+            time.sleep(delay)
+        return {"fired_at": self.fire_at}
+
+
+class HTTPEventProvider(EventListener):
+    """Receives events over HTTP POST /event {"key": ..., "payload": ...}
+    (reference: http_event_provider.py HTTPEventProvider — a Serve
+    deployment in the reference; a stdlib threaded server here).
+
+    One provider can feed many workflows: listeners poll by key.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        self._events: dict[str, Any] = {}
+        self._cv = threading.Condition()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    key = req["key"]
+                except Exception:  # noqa: BLE001
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                with outer._cv:
+                    outer._events[key] = req.get("payload")
+                    outer._cv.notify_all()
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address = f"http://{host}:{self._httpd.server_address[1]}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def event_key_listener(self, key: str) -> "EventListener":
+        outer = self
+
+        class _KeyListener(EventListener):
+            def poll_for_event(self, timeout: Optional[float] = None):
+                deadline = None if timeout is None else \
+                    time.time() + timeout
+                with outer._cv:
+                    while key not in outer._events:
+                        remaining = None if deadline is None else \
+                            deadline - time.time()
+                        if remaining is not None and remaining <= 0:
+                            raise TimeoutError(f"no event {key!r}")
+                        outer._cv.wait(timeout=remaining)
+                    return outer._events[key]
+
+        return _KeyListener()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def wait_for_event(listener_factory: Callable[[], EventListener],
+                   timeout: Optional[float] = None):
+    """Bindable DAG node that completes when the event arrives; the
+    observed payload is checkpointed like any task result, so resume
+    does NOT re-wait (reference: workflow/api.py wait_for_event)."""
+    from ray_tpu.dag.dag_node import FunctionNode
+
+    def _wait_for_event():
+        return listener_factory().poll_for_event(timeout)
+
+    return FunctionNode(_wait_for_event, (), {}, options={})
+
+
+# ========================================================================
+# Virtual actors
+# ========================================================================
+
+class VirtualActorHandle:
+    """Handle to a durable actor: state loads before and persists after
+    every call (each call is its own durable 'step')."""
+
+    def __init__(self, cls: type, actor_id: str,
+                 storage: WorkflowStorage):
+        self._cls = cls
+        self._actor_id = actor_id
+        self._storage = storage
+        self._lock = threading.Lock()
+
+    def _state_path(self) -> str:
+        d = os.path.join(self._storage.root, "virtual_actors",
+                         self._actor_id)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, "state")
+
+    def _load(self):
+        p = self._state_path()
+        inst = object.__new__(self._cls)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                inst.__dict__.update(pickle.load(f))
+            return inst, True
+        return inst, False
+
+    def _persist(self, inst) -> None:
+        p = self._state_path()
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(inst.__dict__, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, p)
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        fn = getattr(self._cls, method)
+
+        def call(*args, **kwargs):
+            with self._lock:
+                inst, existed = self._load()
+                if not existed:
+                    inst.__init__()
+                out = fn(inst, *args, **kwargs)
+                self._persist(inst)
+                return out
+
+        return call
+
+    def delete(self) -> None:
+        import shutil
+        shutil.rmtree(os.path.join(self._storage.root, "virtual_actors",
+                                   self._actor_id), ignore_errors=True)
+
+
+class VirtualActorClass:
+    def __init__(self, cls: type):
+        self._cls = cls
+
+    def get_or_create(self, actor_id: str,
+                      storage: Optional[str] = None) -> VirtualActorHandle:
+        sto = WorkflowStorage(storage) if storage else _storage
+        return VirtualActorHandle(self._cls, actor_id, sto)
+
+
+def virtual_actor(cls: type) -> VirtualActorClass:
+    """``@workflow.virtual_actor`` decorator. The class must be
+    no-arg-constructible and its state picklable."""
+    return VirtualActorClass(cls)

@@ -1,0 +1,119 @@
+"""Tests for round-2 data additions: TFRecords, images, push-based
+shuffle/sort (reference test models: python/ray/data/tests/
+test_tfrecords.py, test_image.py, test_sort.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+from ray_tpu.data import block as B
+from ray_tpu.data.datasource import (crc32c, decode_example,
+                                     encode_example, read_tfrecord_file,
+                                     write_tfrecord_file)
+
+
+class TestTFRecords:
+    def test_crc32c_known_vectors(self):
+        # published CRC-32C test vectors (rfc3720 appx / kernel tests)
+        assert crc32c(b"") == 0x0
+        assert crc32c(b"123456789") == 0xE3069283
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+    def test_example_proto_roundtrip(self):
+        row = {"label": np.int64(3),
+               "weights": np.asarray([0.5, 1.5], np.float32),
+               "name": b"abc"}
+        out = decode_example(encode_example(row))
+        assert out["label"][0] == 3
+        np.testing.assert_allclose(out["weights"], [0.5, 1.5])
+        assert out["name"] == [b"abc"]
+
+    def test_container_roundtrip(self, tmp_path):
+        p = str(tmp_path / "f.tfrecords")
+        recs = [b"alpha", b"bravo" * 100, b""]
+        write_tfrecord_file(p, recs)
+        assert list(read_tfrecord_file(p)) == recs
+
+    def test_dataset_roundtrip(self, tmp_path):
+        ds = rd.from_items([{"x": i, "y": float(i) / 2, "s": f"row{i}"}
+                            for i in range(10)])
+        paths = ds.write_tfrecords(str(tmp_path / "out"))
+        assert paths
+        back = rd.read_tfrecords(str(tmp_path / "out"))
+        cols = B.to_columns(B.concat(back._materialize()))
+        np.testing.assert_array_equal(np.sort(cols["x"]), np.arange(10))
+        np.testing.assert_allclose(np.sort(cols["y"]),
+                                   np.arange(10) / 2)
+        assert b"row3" in [bytes(v) for v in cols["s"]]
+
+    def test_tensorflow_compat(self, tmp_path):
+        """Our records must parse with real TF when it's available."""
+        tf = pytest.importorskip("tensorflow")
+        ds = rd.from_items([{"a": i} for i in range(4)])
+        paths = ds.write_tfrecords(str(tmp_path / "tf"))
+        raw = tf.data.TFRecordDataset(paths)
+        feats = {"a": tf.io.FixedLenFeature([], tf.int64)}
+        got = sorted(int(tf.io.parse_single_example(r, feats)["a"])
+                     for r in raw)
+        assert got == [0, 1, 2, 3]
+
+
+class TestImages:
+    def test_read_images(self, tmp_path):
+        PIL = pytest.importorskip("PIL")
+        from PIL import Image
+        for i in range(3):
+            Image.fromarray(
+                np.full((8 + i, 8, 3), i * 40, np.uint8)).save(
+                tmp_path / f"img{i}.png")
+        ds = rd.read_images(str(tmp_path), size=(8, 8),
+                            include_paths=True)
+        cols = B.to_columns(B.concat(ds._materialize()))
+        assert cols["image"].shape == (3, 8, 8, 3)
+        assert len(cols["path"]) == 3
+
+    def test_read_images_ragged(self, tmp_path):
+        pytest.importorskip("PIL")
+        from PIL import Image
+        Image.fromarray(np.zeros((4, 6, 3), np.uint8)).save(
+            tmp_path / "a.png")
+        Image.fromarray(np.zeros((8, 2, 3), np.uint8)).save(
+            tmp_path / "b.png")
+        ds = rd.read_images(str(tmp_path))
+        cols = B.to_columns(B.concat(ds._materialize()))
+        shapes = sorted(im.shape for im in cols["image"])
+        assert shapes == [(4, 6, 3), (8, 2, 3)]
+
+
+class TestDistributedShuffleSort:
+    def test_shuffle_blocks_inline(self):
+        from ray_tpu.data.shuffle import shuffle_blocks
+        blocks = [{"x": np.arange(i * 10, (i + 1) * 10)} for i in range(4)]
+        out = shuffle_blocks(blocks, seed=0)
+        allx = np.concatenate([B.column(b, "x") for b in out])
+        np.testing.assert_array_equal(np.sort(allx), np.arange(40))
+        assert not np.array_equal(allx, np.arange(40))  # actually shuffled
+
+    def test_sort_blocks_inline(self):
+        from ray_tpu.data.shuffle import sort_blocks
+        rng = np.random.default_rng(0)
+        blocks = [{"k": rng.permutation(100)[i * 25:(i + 1) * 25],
+                   "v": np.arange(25)} for i in range(4)]
+        out = sort_blocks(blocks, "k")
+        allk = np.concatenate([B.column(b, "k") for b in out])
+        np.testing.assert_array_equal(allk, np.sort(allk))
+
+    def test_distributed_shuffle_and_sort(self, rt_init):
+        ds = rd.from_items([{"k": (i * 37) % 100, "v": i}
+                            for i in range(100)]).repartition(4)
+        shuffled = ds.random_shuffle(seed=1)
+        kv = B.to_columns(B.concat(shuffled._materialize()))
+        np.testing.assert_array_equal(np.sort(kv["v"]), np.arange(100))
+
+        srt = ds.sort("k")
+        ks = B.column(B.concat(srt._materialize()), "k")
+        np.testing.assert_array_equal(ks, np.sort(ks))
+
+        desc = ds.sort("k", descending=True)
+        kd = B.column(B.concat(desc._materialize()), "k")
+        np.testing.assert_array_equal(kd, np.sort(kd)[::-1])

@@ -1,0 +1,67 @@
+"""Tracing tests (reference test model:
+python/ray/tests/test_tracing.py — task/actor spans, context
+propagation, trace stitching)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def traced(tmp_path):
+    tracing.enable_tracing(str(tmp_path / "traces"))
+    tracing.clear()
+    yield str(tmp_path / "traces")
+    tracing.disable_tracing()
+    tracing.clear()
+
+
+def test_span_nesting_and_ids(traced):
+    with tracing.start_span("outer") as outer:
+        with tracing.start_span("inner") as inner:
+            pass
+    spans = tracing.get_finished_spans()
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+    assert by_name["inner"]["trace_id"] == by_name["outer"]["trace_id"]
+    assert by_name["outer"]["parent_id"] is None
+    assert by_name["outer"]["end"] >= by_name["outer"]["start"]
+
+
+def test_span_error_status(traced):
+    with pytest.raises(ValueError):
+        with tracing.start_span("boom"):
+            raise ValueError("x")
+    (span,) = tracing.get_finished_spans("boom")
+    assert span["status"].startswith("error")
+
+
+def test_disabled_is_noop():
+    tracing.disable_tracing()
+    tracing.clear()
+    with tracing.start_span("nothing") as s:
+        assert s == {}
+    assert tracing.get_finished_spans() == []
+
+
+def test_task_spans_stitch_across_processes(traced, rt_init):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    with tracing.start_span("driver_root"):
+        ref = work.remote(1)
+        assert ray_tpu.get(ref, timeout=60) == 2
+
+    spans = tracing.collect_spans(traced)
+    names = {s["name"] for s in spans}
+    assert any("work.remote" in n for n in names)
+    assert any("work.execute" in n for n in names)
+    submit = next(s for s in spans if "work.remote" in s["name"])
+    execute = next(s for s in spans if "work.execute" in s["name"])
+    # one trace across submission and (worker-side) execution
+    assert execute["trace_id"] == submit["trace_id"]
+    root = next(s for s in spans if s["name"] == "driver_root")
+    assert submit["parent_id"] == root["span_id"]

@@ -1,0 +1,125 @@
+"""Workflow extensions tests: continuations, events, virtual actors
+(reference test model: python/ray/workflow/tests/)."""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu import workflow
+from ray_tpu.dag.dag_node import FunctionNode
+from ray_tpu.workflow.extras import (Continuation, HTTPEventProvider,
+                                     TimerListener, VirtualActorHandle,
+                                     continuation, virtual_actor,
+                                     wait_for_event)
+
+
+def _bind(fn, *args, **kwargs):
+    return FunctionNode(fn, args, kwargs, options={})
+
+
+class TestContinuation:
+    def test_tail_recursion(self, tmp_path):
+        def countdown(n):
+            if n <= 0:
+                return "done"
+            return continuation(_bind(countdown, n - 1))
+
+        out = workflow.run(_bind(countdown, 4),
+                           workflow_id="wf_cont",
+                           storage=str(tmp_path))
+        assert out == "done"
+        # every continuation level durably checkpointed
+        assert workflow.get_output("wf_cont",
+                                   storage=str(tmp_path)) == "done"
+
+    def test_continuation_resume_skips(self, tmp_path):
+        calls = []
+
+        def a():
+            calls.append("a")
+            return continuation(_bind(b))
+
+        def b():
+            calls.append("b")
+            return 42
+
+        assert workflow.run(_bind(a), workflow_id="wf_c2",
+                            storage=str(tmp_path)) == 42
+        n = len(calls)
+        assert workflow.resume("wf_c2", _bind(a),
+                               storage=str(tmp_path)) == 42
+        assert len(calls) == n  # all levels memoized
+
+
+class TestEvents:
+    def test_timer_listener(self):
+        t0 = time.time()
+        payload = TimerListener(time.time() + 0.2).poll_for_event()
+        assert time.time() - t0 >= 0.15
+        assert "fired_at" in payload
+
+    def test_wait_for_event_in_workflow(self, tmp_path):
+        provider = HTTPEventProvider(port=0)
+        try:
+            def post_later():
+                time.sleep(0.3)
+                import json
+                import urllib.request
+                req = urllib.request.Request(
+                    provider.address + "/event",
+                    data=json.dumps({"key": "go",
+                                     "payload": {"x": 7}}).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=10).read()
+
+            threading.Thread(target=post_later, daemon=True).start()
+            node = wait_for_event(
+                lambda: provider.event_key_listener("go"), timeout=30)
+            out = workflow.run(node, workflow_id="wf_evt",
+                               storage=str(tmp_path))
+            assert out == {"x": 7}
+            # resume does not re-wait: result is durable
+            out2 = workflow.resume("wf_evt", node,
+                                   storage=str(tmp_path))
+            assert out2 == {"x": 7}
+        finally:
+            provider.stop()
+
+
+class TestVirtualActor:
+    def test_state_survives_handles(self, tmp_path):
+        @virtual_actor
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self, k=1):
+                self.n += k
+                return self.n
+
+            def value(self):
+                return self.n
+
+        h1 = Counter.get_or_create("c1", storage=str(tmp_path))
+        assert h1.incr() == 1
+        assert h1.incr(5) == 6
+        # a brand-new handle (fresh process analogue) sees durable state
+        h2 = Counter.get_or_create("c1", storage=str(tmp_path))
+        assert h2.value() == 6
+        # distinct actor id = distinct state
+        h3 = Counter.get_or_create("c2", storage=str(tmp_path))
+        assert h3.value() == 0
+        h1.delete()
+        h4 = Counter.get_or_create("c1", storage=str(tmp_path))
+        assert h4.value() == 0
+
+    def test_virtual_actor_rejects_private(self, tmp_path):
+        @virtual_actor
+        class A:
+            def __init__(self):
+                self.x = 1
+
+        h = A.get_or_create("a1", storage=str(tmp_path))
+        with pytest.raises(AttributeError):
+            h._private()
