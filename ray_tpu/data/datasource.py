@@ -82,6 +82,13 @@ def write_tfrecord_file(path: str, records: Iterable[bytes]) -> int:
 
 
 def read_tfrecord_file(path: str) -> Iterable[bytes]:
+    def must_read(f, n: int, what: str) -> bytes:
+        buf = f.read(n)
+        if len(buf) < n:
+            raise ValueError(
+                f"truncated tfrecord file {path} (short {what})")
+        return buf
+
     with open(path, "rb") as f:
         while True:
             hdr = f.read(8)
@@ -90,11 +97,13 @@ def read_tfrecord_file(path: str) -> Iterable[bytes]:
             if len(hdr) < 8:
                 raise ValueError(f"truncated tfrecord file {path}")
             (length,) = struct.unpack("<Q", hdr)
-            (crc_hdr,) = struct.unpack("<I", f.read(4))
+            (crc_hdr,) = struct.unpack(
+                "<I", must_read(f, 4, "length crc"))
             if _masked_crc(hdr) != crc_hdr:
                 raise ValueError(f"corrupt length crc in {path}")
-            data = f.read(length)
-            (crc_data,) = struct.unpack("<I", f.read(4))
+            data = must_read(f, length, "record body")
+            (crc_data,) = struct.unpack(
+                "<I", must_read(f, 4, "record crc"))
             if _masked_crc(data) != crc_data:
                 raise ValueError(f"corrupt record crc in {path}")
             yield data
@@ -308,7 +317,10 @@ def read_images_blocks(paths: List[str], size=None, mode: str = "RGB",
         with Image.open(p) as im:
             im = im.convert(mode)
             if size is not None:
-                im = im.resize(tuple(size))
+                # size is (height, width), the [N, H, W, C] convention
+                # (reference: ImageDatasource size); PIL takes (w, h)
+                h, w = size
+                im = im.resize((w, h))
             imgs.append(np.asarray(im, np.uint8))
             kept.append(p)
     if not imgs:

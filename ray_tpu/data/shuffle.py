@@ -66,7 +66,8 @@ def _runtime_up() -> bool:
 
 
 def _exchange(blocks: List, map_fn, map_args_per_block, reduce_fn,
-              reduce_kwargs_per_part) -> List:
+              reduce_kwargs_per_part, timeout: Optional[float] = None
+              ) -> List:
     """Generic 2-stage exchange. map_fn(block, *map_args_i) -> P parts;
     reduce_fn(*parts_p, **kwargs_p) -> merged block p."""
     P = len(reduce_kwargs_per_part)
@@ -86,11 +87,14 @@ def _exchange(blocks: List, map_fn, map_args_per_block, reduce_fn,
         reducer.remote(*[m[p] for m in part_refs],
                        **reduce_kwargs_per_part[p])
         for p in range(P)]
-    return ray_tpu.get(out_refs, timeout=600)
+    # timeout=None blocks until the exchange completes — a large shuffle
+    # legitimately runs as long as it runs
+    return ray_tpu.get(out_refs, timeout=timeout)
 
 
 def shuffle_blocks(blocks: List, num_partitions: Optional[int] = None,
-                   seed: Optional[int] = None) -> List:
+                   seed: Optional[int] = None,
+                   timeout: Optional[float] = None) -> List:
     """Distributed random shuffle -> num_partitions blocks."""
     P = num_partitions or max(1, len(blocks))
     # unseeded shuffles draw fresh entropy (matching the driver-side
@@ -105,7 +109,8 @@ def shuffle_blocks(blocks: List, num_partitions: Optional[int] = None,
         blocks,
         _split_random, [(P, base, i) for i in range(len(blocks))],
         _merge_shuffled,
-        [{"seed": base + 1000 + p} for p in range(P)])
+        [{"seed": base + 1000 + p} for p in range(P)],
+        timeout=timeout)
     for blk in mapped:
         if B.num_rows(blk):
             out.append(blk)
@@ -132,7 +137,8 @@ def sample_boundaries(blocks: List, key: str, P: int,
 
 
 def sort_blocks(blocks: List, key: str, descending: bool = False,
-                num_partitions: Optional[int] = None) -> List:
+                num_partitions: Optional[int] = None,
+                timeout: Optional[float] = None) -> List:
     """Distributed sample-sort -> globally ordered block list."""
     blocks = [b for b in blocks if B.num_rows(b)]
     if not blocks:
@@ -145,5 +151,6 @@ def sort_blocks(blocks: List, key: str, descending: bool = False,
         blocks,
         _split_range, [(key, bounds, descending)] * len(blocks),
         _merge_sorted,
-        [{"key": key, "descending": descending} for _ in range(P)])
+        [{"key": key, "descending": descending} for _ in range(P)],
+        timeout=timeout)
     return [b for b in merged if B.num_rows(b)] or [blocks[0]]

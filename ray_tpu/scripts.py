@@ -220,6 +220,78 @@ def cmd_job(args) -> int:
     return 2
 
 
+def cmd_serve(args) -> int:
+    """`serve run/deploy/status/shutdown` (reference:
+    python/ray/serve/scripts.py serve CLI)."""
+    import json as _json
+
+    if args.serve_cmd == "run":
+        import ray_tpu
+        from ray_tpu.serve.rest import ServeRestServer, apply_config
+        ray_tpu.init(address=args.address)
+        apply_config({"applications": [
+            {"name": args.name or args.import_path,
+             "import_path": args.import_path}]},
+            http=True, port=args.port)
+        from ray_tpu import serve as _serve
+        rest = ServeRestServer(port=args.rest_port)
+        print(f"serving {args.import_path}  "
+              f"ingress={_serve.proxy_address()}  rest={rest.address}")
+        # always block: the proxy/REST servers are daemon threads of
+        # THIS process — returning would tear the service down
+        import time as _time
+        try:
+            while True:
+                _time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    if args.serve_cmd == "deploy":
+        import urllib.request
+        with open(args.config_file) as f:
+            cfg = (_json.load(f) if args.config_file.endswith(".json")
+                   else _load_yaml_or_json(f.read()))
+        req = urllib.request.Request(
+            args.address.rstrip("/") + "/api/serve/applications/",
+            data=_json.dumps(cfg).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            print(resp.read().decode())
+        return 0
+
+    if args.serve_cmd == "status":
+        import urllib.request
+        with urllib.request.urlopen(
+                args.address.rstrip("/") + "/api/serve/applications/",
+                timeout=30) as resp:
+            print(_json.dumps(_json.loads(resp.read()), indent=2))
+        return 0
+
+    if args.serve_cmd == "shutdown":
+        import urllib.request
+        req = urllib.request.Request(
+            args.address.rstrip("/") + "/api/serve/applications/",
+            method="DELETE")
+        with urllib.request.urlopen(req, timeout=60):
+            print("shut down")
+        return 0
+    return 2
+
+
+def _load_yaml_or_json(text: str) -> dict:
+    import json as _json
+    try:
+        return _json.loads(text)
+    except ValueError:
+        try:
+            import yaml
+            return yaml.safe_load(text)
+        except ImportError as e:
+            raise SystemExit(
+                "config is not JSON and pyyaml is unavailable") from e
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ray_tpu",
@@ -284,6 +356,26 @@ def main(argv=None) -> int:
     pl = jsub.add_parser("list")
     pl.add_argument("--address", required=True)
     pl.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("serve", help="model-serving CLI")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    pr = ssub.add_parser("run", help="deploy module:app and serve HTTP")
+    pr.add_argument("import_path")
+    pr.add_argument("--name", default=None)
+    pr.add_argument("--address", default=None,
+                    help="cluster address (default: local node)")
+    pr.add_argument("--port", type=int, default=8000)
+    pr.add_argument("--rest-port", type=int, default=8001)
+    pr.set_defaults(fn=cmd_serve)
+    pd = ssub.add_parser("deploy", help="PUT a config to a serve REST API")
+    pd.add_argument("config_file")
+    pd.add_argument("--address", required=True,
+                    help="serve REST address, e.g. http://host:8001")
+    pd.set_defaults(fn=cmd_serve)
+    for name in ("status", "shutdown"):
+        psx = ssub.add_parser(name)
+        psx.add_argument("--address", required=True)
+        psx.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
     return args.fn(args)

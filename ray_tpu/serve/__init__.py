@@ -83,8 +83,38 @@ def proxy_address() -> Optional[str]:
 def status() -> dict:
     ctrl = _get_controller()
     return {name: {"replicas": len(st.replicas),
-                   "ongoing_per_replica": st.ongoing_per_replica()}
+                   "ongoing_per_replica": st.ongoing_per_replica(),
+                   **st.request_metrics}
             for name, st in ctrl.deployments.items()}
+
+
+def metrics_snapshot() -> list:
+    """Per-deployment request metrics in the exporter's tuple format
+    (reference: serve's Prometheus metrics via the metrics agent)."""
+    ctrl = _get_controller()
+    reqs, errs, lat = {}, {}, {}
+    for name, st in ctrl.deployments.items():
+        key = (("deployment", name),)
+        m = st.request_metrics
+        reqs[key] = m["requests"]
+        errs[key] = m["errors"]
+        lat[key] = m["latency_sum_s"]
+    return [
+        ("serve_requests_total", "counter",
+         "Requests completed per deployment", reqs),
+        ("serve_request_errors_total", "counter",
+         "Requests errored per deployment", errs),
+        ("serve_request_latency_seconds_sum", "counter",
+         "Summed request latency per deployment", lat),
+    ]
+
+
+def start_metrics_exporter(port: int = 0):
+    """Expose serve metrics at /metrics (reference: per-node metrics
+    agent endpoint)."""
+    from ray_tpu.metrics import MetricsExporter
+    exporter = MetricsExporter(metrics_snapshot, port=port)
+    return exporter
 
 
 def shutdown() -> None:

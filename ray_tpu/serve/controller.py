@@ -80,7 +80,18 @@ class DeploymentState:
         self._rr = itertools.count()
         self._lock = threading.Lock()
         self._on_membership_change = on_membership_change
+        # request counters for /metrics + status (reference: serve's
+        # per-deployment autoscaling/QPS metrics, autoscaling_metrics.py)
+        self.request_metrics = {"requests": 0, "errors": 0,
+                                "latency_sum_s": 0.0}
         self.scale_to(deployment.options.num_replicas)
+
+    def record_request(self, latency_s: float, error: bool) -> None:
+        with self._lock:
+            self.request_metrics["requests"] += 1
+            if error:
+                self.request_metrics["errors"] += 1
+            self.request_metrics["latency_sum_s"] += latency_s
 
     def _membership_changed(self) -> None:
         if self._on_membership_change is not None:

@@ -95,12 +95,14 @@ class DeploymentHandle:
         return self._state
 
     def remote(self, *args, **kwargs) -> ServeResponse:
+        import time as _time
         state, method = self._current_state(), self._method
         replica = state.assign_replica()
+        t0 = _time.perf_counter()
         if replica.is_actor:
             ref = replica.impl.handle_request.remote(method, args, kwargs)
 
-            def resolve(timeout):
+            def resolve_inner(timeout):
                 import ray_tpu
                 # timeout=None means block until done (matches the
                 # in-process Future path) — do not invent a deadline
@@ -109,8 +111,18 @@ class DeploymentHandle:
             fut: Future = self._ensure_pool().submit(
                 replica.impl.handle_request, method, args, kwargs)
 
-            def resolve(timeout):
+            def resolve_inner(timeout):
                 return fut.result(timeout)
+
+        def resolve(timeout):
+            try:
+                out = resolve_inner(timeout)
+            except BaseException as e:
+                if not _is_timeout(e):   # timeouts retry; don't count
+                    state.record_request(_time.perf_counter() - t0, True)
+                raise
+            state.record_request(_time.perf_counter() - t0, False)
+            return out
 
         return ServeResponse(resolve, lambda: state.release(replica))
 

@@ -1,0 +1,177 @@
+"""Serve REST config API + declarative config deploys.
+
+Reference capability: the Serve REST surface served by the dashboard
+(python/ray/serve/schema.py ServeDeploySchema /
+ServeApplicationSchema; dashboard/modules/serve/serve_rest_api.py):
+``PUT /api/serve/applications/`` deploys a declarative config of
+applications (import_path + deployment overrides), ``GET`` returns
+cluster serve status, ``DELETE`` tears everything down. The same
+config shape drives ``serve deploy <config>`` / ``serve run`` in the
+CLI.
+
+Config shape (the subset of the reference schema implemented here):
+
+    {"applications": [
+        {"name": "app1",
+         "import_path": "my.module:entrypoint",   # a Deployment (bound)
+         "args": {...},                            # optional bind kwargs
+         "deployments": [                          # per-deployment overrides
+            {"name": "Model", "num_replicas": 2,
+             "max_concurrent_queries": 8}]}]}
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+_applications: Dict[str, dict] = {}   # name -> {"import_path", "deployments"}
+_lock = threading.Lock()
+
+
+def _import_target(import_path: str):
+    """'pkg.module:attr' → the attribute (a Deployment or bound graph)."""
+    if ":" not in import_path:
+        raise ValueError(
+            f"import_path must look like 'module:attr', got "
+            f"{import_path!r}")
+    mod_name, _, attr = import_path.partition(":")
+    mod = importlib.import_module(mod_name)
+    target = mod
+    for part in attr.split("."):
+        target = getattr(target, part)
+    return target
+
+
+def apply_config(config: dict, *, use_actors: Optional[bool] = None,
+                 http: bool = False, port: int = 0) -> List[str]:
+    """Deploy a declarative config (reference: ServeDeploySchema apply —
+    serve_rest_api.py put handler). Returns deployed app names."""
+    from ray_tpu import serve
+    from ray_tpu.serve.deployment import Deployment
+
+    apps = config.get("applications", [])
+    deployed = []
+    for app in apps:
+        name = app.get("name") or app["import_path"]
+        target = _import_target(app["import_path"])
+        if callable(target) and not isinstance(target, Deployment):
+            target = target(**app.get("args", {}))
+        if not isinstance(target, Deployment):
+            raise TypeError(
+                f"{app['import_path']} resolved to {type(target).__name__},"
+                " expected a Deployment")
+        overrides = {d["name"]: {k: v for k, v in d.items() if k != "name"}
+                     for d in app.get("deployments", [])}
+        if target.name in overrides:
+            target = target.set_options(**overrides[target.name])
+        serve.run(target, use_actors=use_actors, http=http, port=port)
+        # apply overrides to already-deployed graph children too: every
+        # option a root gets via set_options, not just num_replicas
+        ctrl = serve._get_controller()
+        for dep_name, opts in overrides.items():
+            if dep_name != target.name and dep_name in ctrl.deployments:
+                st = ctrl.deployments[dep_name]
+                for key, val in opts.items():
+                    if key == "num_replicas":
+                        st.scale_to(int(val))
+                    elif hasattr(st.deployment.options, key):
+                        setattr(st.deployment.options, key, val)
+                    else:
+                        raise ValueError(
+                            f"unknown deployment override {key!r} for "
+                            f"{dep_name!r}")
+        with _lock:
+            _applications[name] = {
+                "import_path": app["import_path"],
+                "route_prefix": app.get("route_prefix", f"/{target.name}"),
+                "deployments": sorted(
+                    {target.name, *overrides}),
+            }
+        deployed.append(name)
+    return deployed
+
+
+def describe() -> dict:
+    """Serve status document (reference: GET /api/serve/applications/
+    → ServeInstanceDetails)."""
+    from ray_tpu import serve
+    status = serve.status()
+    with _lock:
+        apps = {name: dict(info) for name, info in _applications.items()}
+    for info in apps.values():
+        info["status"] = "RUNNING" if all(
+            status.get(d, {}).get("replicas", 0) > 0
+            for d in info["deployments"]) else "DEPLOYING"
+        info["deployments"] = {
+            d: status.get(d, {}) for d in info["deployments"]}
+    return {"applications": apps,
+            "proxy_location": serve.proxy_address(),
+            "deployments": status}
+
+
+def shutdown_all() -> None:
+    from ray_tpu import serve
+    serve.shutdown()
+    with _lock:
+        _applications.clear()
+
+
+class ServeRestServer:
+    """Standalone REST endpoint (the dashboard mounts the same handlers)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        outer_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, payload: Any):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/api/serve/applications":
+                    self._reply(200, describe())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_PUT(self):
+                if self.path.rstrip("/") != "/api/serve/applications":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    cfg = json.loads(self.rfile.read(n) or b"{}")
+                    deployed = apply_config(cfg)
+                    self._reply(200, {"deployed": deployed})
+                except Exception as e:  # noqa: BLE001 - wire to client
+                    self._reply(400, {"error": str(e)})
+
+            def do_DELETE(self):
+                if self.path.rstrip("/") == "/api/serve/applications":
+                    shutdown_all()
+                    self._reply(200, {})
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.address = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="raytpu-serve-rest")
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -13,6 +13,8 @@ from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
 from ray_tpu.train.result import Result
 from ray_tpu.train.step import (TrainState, make_train_step, shard_batch,
                                 state_shardings)
+from ray_tpu.train.torch_trainer import (TorchConfig, TorchTrainer,
+                                         prepare_model)
 from ray_tpu.train.trainer import (BaseTrainer, DataParallelTrainer,
                                    TrainingFailedError)
 from ray_tpu.train import session
@@ -24,5 +26,6 @@ __all__ = [
     "state_shardings", "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
     "TrainingFailedError", "session", "GBDTTrainer", "SklearnTrainer",
     "XGBoostTrainer", "LightGBMTrainer", "Predictor", "JaxPredictor",
-    "SklearnPredictor", "BatchPredictor",
+    "SklearnPredictor", "BatchPredictor", "TorchTrainer", "TorchConfig",
+    "prepare_model",
 ]

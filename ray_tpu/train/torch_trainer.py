@@ -1,0 +1,197 @@
+"""TorchTrainer: data-parallel torch training over actor workers.
+
+Reference capability: python/ray/train/torch/ — TorchTrainer
+(torch/torch_trainer.py), TorchConfig/_TorchBackend
+(torch/config.py:29,129: `_setup_torch_process_group` →
+`dist.init_process_group(backend=...)` with a TCP rendezvous on the
+rank-0 worker), plus `train.torch.prepare_model` (DDP wrap).
+
+ray_tpu shape: torch here is a *host-side* framework (CPU build in this
+image; the TPU compute path is jax) — so unlike JaxTrainer's
+in-process SPMD, TorchTrainer runs the reference architecture for
+real: N worker ACTORS, a gloo process group rendezvoused over TCP,
+per-worker session reporting gathered by the driver, rank-0
+checkpoints through the shared run dir. This is the migration surface
+for users arriving with torch training loops.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+from ray_tpu.train.trainer import BaseTrainer, TrainingFailedError
+
+
+@dataclass
+class TorchConfig:
+    """Process-group knobs (reference: torch/config.py:29 TorchConfig)."""
+    backend: str = "gloo"          # CPU image: gloo; nccl has no GPUs here
+    init_timeout_s: float = 120.0
+
+
+class _TorchWorker:
+    """One training worker actor (reference: the WorkerGroup actor in
+    train/_internal/worker_group.py:92 + _TorchBackend.on_start).
+
+    Two-phase startup like the reference: rank 0 reports its own
+    address + a probed port (`master_address`, torch/config.py:69
+    `_setup_torch_process_group` rendezvous on the rank-0 WORKER, not
+    the driver — workers may land on other nodes), then every rank's
+    `setup_pg` joins the group."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self._ckpt_payload = None
+
+    def master_address(self) -> tuple:
+        """Rank-0's reachable host + a free port (bind-probe; the small
+        release-to-bind race matches the reference's get_address)."""
+        host = socket.gethostbyname(socket.gethostname())
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return host, port
+
+    def setup_pg(self, master_addr: str, master_port: int, backend: str,
+                 timeout_s: float) -> bool:
+        os.environ["MASTER_ADDR"] = master_addr
+        os.environ["MASTER_PORT"] = str(master_port)
+        os.environ["RANK"] = str(self.rank)
+        os.environ["WORLD_SIZE"] = str(self.world_size)
+        import datetime
+
+        import torch.distributed as dist
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        dist.init_process_group(
+            backend=backend, rank=self.rank,
+            world_size=self.world_size,
+            init_method=f"tcp://{master_addr}:{master_port}",
+            timeout=datetime.timedelta(seconds=timeout_s))
+        return True
+
+    def run(self, loop: Callable, config: dict,
+            restore_payload) -> dict:
+        """Execute the user loop inside a session; returns
+        {reports, checkpoint} for the driver to merge."""
+        from ray_tpu.train import session as _s
+        worker = self
+
+        def ckpt_cb(data):
+            worker._ckpt_payload = data   # kept worker-side; rank 0's
+            return None                   # payload rides the return value
+
+        latest = (Checkpoint.from_dict(restore_payload)
+                  if restore_payload is not None else None)
+        st = _s._start(world_rank=self.rank, world_size=self.world_size,
+                       checkpoint_cb=ckpt_cb, latest_checkpoint=latest)
+        try:
+            if loop.__code__.co_argcount == 0:
+                loop()
+            else:
+                loop(dict(config))
+        except StopIteration:
+            pass
+        finally:
+            _s._end()
+        reports = [{k: v for k, v in r.items()
+                    if k != "_checkpoint_path"} for r in st.results]
+        return {"reports": reports,
+                "checkpoint": self._ckpt_payload if self.rank == 0
+                else None}
+
+    def shutdown(self):
+        import torch.distributed as dist
+        if dist.is_initialized():
+            dist.destroy_process_group()
+        return True
+
+
+def prepare_model(model):
+    """Wrap in DDP when a process group is active (reference:
+    train/torch/train_loop_utils.py prepare_model)."""
+    import torch.distributed as dist
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+        return DistributedDataParallel(model)
+    return model
+
+
+class TorchTrainer(BaseTrainer):
+    """(reference: train/torch/torch_trainer.py TorchTrainer)"""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[dict] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self._loop = train_loop_per_worker
+        self._loop_config = train_loop_config or {}
+        self._torch_config = torch_config or TorchConfig()
+
+    @property
+    def _num_workers(self) -> int:
+        sc = self.scaling_config
+        if sc.num_workers is not None:
+            return sc.num_workers
+        dp = sc.mesh.get("dp", 1)
+        return dp if dp > 0 else 1
+
+    def _attempt(self) -> None:
+        import ray_tpu
+        from ray_tpu.train import session as _session
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self._torch_config
+        world = self._num_workers
+        Worker = ray_tpu.remote(_TorchWorker)
+        workers = [Worker.remote(r, world) for r in range(world)]
+        st = _session._state()
+        st.world_size = world
+        restore = st.latest_checkpoint
+        restore_payload = restore.to_dict() if restore is not None else None
+        try:
+            # rendezvous on the rank-0 WORKER's address (it may be on a
+            # different node than the driver); then all ranks join
+            addr, port = ray_tpu.get(workers[0].master_address.remote(),
+                                     timeout=tc.init_timeout_s)
+            ray_tpu.get([w.setup_pg.remote(addr, port, tc.backend,
+                                           tc.init_timeout_s)
+                         for w in workers],
+                        timeout=tc.init_timeout_s + 60)
+            refs = [w.run.remote(self._loop, self._loop_config,
+                                 restore_payload) for w in workers]
+            # training runs as long as it runs — no duration cap; worker
+            # death surfaces as a task error and triggers fit()'s retry
+            outs = ray_tpu.get(refs, timeout=None)
+            # merge: stream rank-0 reports through the driver session so
+            # fit()'s manager sees metrics/checkpoints in order
+            rank0 = outs[0]
+            n = len(rank0["reports"])
+            for i, metrics in enumerate(rank0["reports"]):
+                is_last = i == n - 1
+                ck = rank0["checkpoint"] if is_last else None
+                _session.report(metrics, checkpoint=ck)
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.get(w.shutdown.remote(), timeout=30)
+                except Exception:  # noqa: BLE001
+                    pass
+                try:
+                    ray_tpu.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass

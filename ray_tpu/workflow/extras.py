@@ -150,6 +150,22 @@ def wait_for_event(listener_factory: Callable[[], EventListener],
 # Virtual actors
 # ========================================================================
 
+_va_locks: dict = {}
+_va_locks_guard = threading.Lock()
+
+
+def _va_lock(root: str, actor_id: str) -> threading.Lock:
+    """Per-(storage, actor) lock shared by ALL handles in this process —
+    a per-handle lock would let two handles to the same actor race the
+    load-mutate-persist cycle and lose updates."""
+    key = (root, actor_id)
+    with _va_locks_guard:
+        lock = _va_locks.get(key)
+        if lock is None:
+            lock = _va_locks[key] = threading.Lock()
+        return lock
+
+
 class VirtualActorHandle:
     """Handle to a durable actor: state loads before and persists after
     every call (each call is its own durable 'step')."""
@@ -159,7 +175,7 @@ class VirtualActorHandle:
         self._cls = cls
         self._actor_id = actor_id
         self._storage = storage
-        self._lock = threading.Lock()
+        self._lock = _va_lock(storage.root, actor_id)
 
     def _state_path(self) -> str:
         d = os.path.join(self._storage.root, "virtual_actors",

@@ -66,10 +66,11 @@ class TestImages:
             Image.fromarray(
                 np.full((8 + i, 8, 3), i * 40, np.uint8)).save(
                 tmp_path / f"img{i}.png")
-        ds = rd.read_images(str(tmp_path), size=(8, 8),
+        # size is (height, width) per the [N, H, W, C] convention
+        ds = rd.read_images(str(tmp_path), size=(8, 6),
                             include_paths=True)
         cols = B.to_columns(B.concat(ds._materialize()))
-        assert cols["image"].shape == (3, 8, 8, 3)
+        assert cols["image"].shape == (3, 8, 6, 3)
         assert len(cols["path"]) == 3
 
     def test_read_images_ragged(self, tmp_path):
