@@ -1,0 +1,252 @@
+/* ray_api — native C++ worker API (capability analogue of the
+ * reference's C++ frontend: cpp/include/ray/api.h — ray::Init,
+ * ray::Put/Get, ray::Task(F).Remote(args...), actor handles — backed
+ * by a runtime the way cpp/src/ray/runtime/local_mode_ray_runtime.cc
+ * backs the reference's local mode: tasks execute on an in-process
+ * executor pool and objects live in the REAL node shm store
+ * (rt_store), so C++ tasks and Python workers share one object plane.
+ *
+ * Cross-process C++ workers (the reference's NativeRayRuntime) would
+ * reuse this surface with a socket transport; the local-mode runtime
+ * here is the first-class testable slice, as it is in the reference.
+ *
+ * Serialization: trivially-copyable types and std::string /
+ * std::vector<trivially-copyable> round-trip through the object store;
+ * anything else needs a Serializer<T> specialization. */
+#ifndef RAY_API_H
+#define RAY_API_H
+
+#include <array>
+#include <condition_variable>
+#include <cstring>
+#include <functional>
+#include <future>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <type_traits>
+#include <vector>
+
+#include "rt_store.h"
+
+namespace ray {
+
+using ObjectID = std::array<uint8_t, RT_ID_SIZE>;
+
+/* ---------------- serialization ---------------- */
+
+template <typename T, typename Enable = void>
+struct Serializer;  // specialize for custom types
+
+template <typename T>
+struct Serializer<T,
+    typename std::enable_if<std::is_trivially_copyable<T>::value>::type> {
+  static std::vector<uint8_t> Dump(const T &v) {
+    std::vector<uint8_t> out(sizeof(T));
+    std::memcpy(out.data(), &v, sizeof(T));
+    return out;
+  }
+  static T Load(const uint8_t *data, size_t n) {
+    if (n != sizeof(T)) throw std::runtime_error("ray: size mismatch");
+    T v;
+    std::memcpy(&v, data, sizeof(T));
+    return v;
+  }
+};
+
+template <>
+struct Serializer<std::string, void> {
+  static std::vector<uint8_t> Dump(const std::string &v) {
+    return std::vector<uint8_t>(v.begin(), v.end());
+  }
+  static std::string Load(const uint8_t *data, size_t n) {
+    return std::string(reinterpret_cast<const char *>(data), n);
+  }
+};
+
+template <typename E>
+struct Serializer<std::vector<E>,
+    typename std::enable_if<std::is_trivially_copyable<E>::value>::type> {
+  static std::vector<uint8_t> Dump(const std::vector<E> &v) {
+    std::vector<uint8_t> out(v.size() * sizeof(E));
+    if (!v.empty()) std::memcpy(out.data(), v.data(), out.size());
+    return out;
+  }
+  static std::vector<E> Load(const uint8_t *data, size_t n) {
+    std::vector<E> v(n / sizeof(E));
+    if (n) std::memcpy(v.data(), data, n);
+    return v;
+  }
+};
+
+/* ---------------- runtime ---------------- */
+
+class Runtime {
+ public:
+  static Runtime &Instance();
+  void Init(const std::string &store_name = "", uint64_t capacity = 0);
+  void Shutdown();
+  bool Initialized() const { return store_ != nullptr; }
+
+  ObjectID PutBytes(const std::vector<uint8_t> &data);
+  std::vector<uint8_t> GetBytes(const ObjectID &id, double timeout_s);
+
+  /* submit: runs fn on the executor pool; the result bytes are sealed
+   * into the store under the returned id when the task finishes. */
+  ObjectID Submit(std::function<std::vector<uint8_t>()> fn);
+
+  rt_store *store() { return store_; }
+
+ private:
+  Runtime() = default;
+  void Worker();
+  ObjectID NextId();
+  void StoreResult(const ObjectID &id, const std::vector<uint8_t> &data);
+
+  rt_store *store_ = nullptr;
+  std::string store_name_;
+  bool owns_store_ = false;
+  uint8_t *base_ = nullptr;   /* mmap of the shm data plane */
+  uint64_t map_bytes_ = 0;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::queue<std::function<void()>> queue_;
+  std::vector<std::thread> workers_;
+  bool stopping_ = false;
+  uint64_t counter_ = 0;
+  /* ids whose task errored: Get throws instead of blocking forever */
+  std::mutex err_mu_;
+  std::vector<std::pair<ObjectID, std::string>> errors_;
+ public:
+  void RecordError(const ObjectID &id, const std::string &what);
+  bool FindError(const ObjectID &id, std::string *out);
+};
+
+inline void Init() { Runtime::Instance().Init(); }
+inline void Init(const std::string &store_name, uint64_t capacity) {
+  Runtime::Instance().Init(store_name, capacity);
+}
+inline void Shutdown() { Runtime::Instance().Shutdown(); }
+inline bool IsInitialized() { return Runtime::Instance().Initialized(); }
+
+/* ---------------- ObjectRef / Put / Get ---------------- */
+
+template <typename T>
+class ObjectRef {
+ public:
+  ObjectRef() = default;
+  explicit ObjectRef(const ObjectID &id) : id_(id) {}
+  const ObjectID &ID() const { return id_; }
+  T Get(double timeout_s = 60.0) const {
+    auto bytes = Runtime::Instance().GetBytes(id_, timeout_s);
+    return Serializer<T>::Load(bytes.data(), bytes.size());
+  }
+
+ private:
+  ObjectID id_{};
+};
+
+template <typename T>
+ObjectRef<T> Put(const T &value) {
+  auto id = Runtime::Instance().PutBytes(Serializer<T>::Dump(value));
+  return ObjectRef<T>(id);
+}
+
+template <typename T>
+T Get(const ObjectRef<T> &ref, double timeout_s = 60.0) {
+  return ref.Get(timeout_s);
+}
+
+/* ---------------- Task(...).Remote(...) ---------------- */
+
+template <typename F, typename... Args>
+class TaskCaller {
+ public:
+  TaskCaller(F fn, std::tuple<Args...> args)
+      : fn_(fn), args_(std::move(args)) {}
+
+  using R = decltype(std::apply(std::declval<F>(),
+                                std::declval<std::tuple<Args...>>()));
+
+  ObjectRef<R> Remote() {
+    F fn = fn_;
+    auto args = args_;
+    auto id = Runtime::Instance().Submit(
+        [fn, args]() -> std::vector<uint8_t> {
+          R result = std::apply(fn, args);
+          return Serializer<R>::Dump(result);
+        });
+    return ObjectRef<R>(id);
+  }
+
+ private:
+  F fn_;
+  std::tuple<Args...> args_;
+};
+
+/* ray::Task(f, a, b).Remote() — args bound at Task() like the
+ * reference's ray::Task(f).Remote(a, b); both spellings supported. */
+template <typename F, typename... Args>
+TaskCaller<F, Args...> Task(F fn, Args... args) {
+  return TaskCaller<F, Args...>(fn, std::make_tuple(args...));
+}
+
+/* ---------------- actors ---------------- */
+
+template <typename C>
+class ActorHandle {
+ public:
+  explicit ActorHandle(std::shared_ptr<C> inst,
+                       std::shared_ptr<std::mutex> mu)
+      : inst_(std::move(inst)), mu_(std::move(mu)) {}
+
+  /* handle.Task(&C::Method, args...).Remote() */
+  template <typename R, typename... MArgs, typename... CallArgs>
+  ObjectRef<R> Call(R (C::*method)(MArgs...), CallArgs... args) {
+    auto inst = inst_;
+    auto mu = mu_;
+    auto tup = std::make_tuple(args...);
+    auto id = Runtime::Instance().Submit(
+        [inst, mu, method, tup]() -> std::vector<uint8_t> {
+          /* per-actor mutex: method calls serialize, matching actor
+           * semantics (one logical thread per actor) */
+          std::lock_guard<std::mutex> lk(*mu);
+          R result = std::apply(
+              [&](auto... a) { return ((*inst).*method)(a...); }, tup);
+          return Serializer<R>::Dump(result);
+        });
+    return ObjectRef<R>(id);
+  }
+
+ private:
+  std::shared_ptr<C> inst_;
+  std::shared_ptr<std::mutex> mu_;
+};
+
+template <typename C, typename... Args>
+class ActorCreator {
+ public:
+  explicit ActorCreator(std::tuple<Args...> args)
+      : args_(std::move(args)) {}
+  ActorHandle<C> Remote() {
+    auto inst = std::apply(
+        [](auto... a) { return std::make_shared<C>(a...); }, args_);
+    return ActorHandle<C>(inst, std::make_shared<std::mutex>());
+  }
+
+ private:
+  std::tuple<Args...> args_;
+};
+
+template <typename C, typename... Args>
+ActorCreator<C, Args...> Actor(Args... args) {
+  return ActorCreator<C, Args...>(std::make_tuple(args...));
+}
+
+}  // namespace ray
+
+#endif  /* RAY_API_H */

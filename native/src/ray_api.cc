@@ -1,0 +1,188 @@
+/* ray_api runtime implementation (reference analogue:
+ * cpp/src/ray/runtime/local_mode_ray_runtime.cc +
+ * object/local_mode_object_store.cc — task execution on an in-process
+ * pool, objects in the node shm store via rt_store). */
+
+#include "ray_api.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+
+namespace ray {
+
+Runtime &Runtime::Instance() {
+  static Runtime rt;
+  return rt;
+}
+
+void Runtime::Init(const std::string &store_name, uint64_t capacity) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (store_ != nullptr) return;
+  if (store_name.empty()) {
+    store_name_ = "/ray_api_" + std::to_string(getpid());
+    owns_store_ = true;
+    store_ = rt_store_create(store_name_.c_str(),
+                             capacity ? capacity : (64u << 20), 4096);
+  } else {
+    /* attach to an existing node store: C++ tasks share the Python
+     * workers' object plane */
+    store_name_ = store_name;
+    owns_store_ = false;
+    store_ = rt_store_attach(store_name_.c_str());
+  }
+  if (store_ == nullptr) throw std::runtime_error("ray: store init failed");
+
+  /* map the data plane (clients resolve offsets against their own map,
+   * see rt_store.h header comment) */
+  map_bytes_ = rt_store_map_bytes(store_);
+  std::string shm_path = "/dev/shm" + store_name_;
+  int fd = open(shm_path.c_str(), O_RDWR);
+  if (fd < 0) throw std::runtime_error("ray: shm open failed");
+  base_ = static_cast<uint8_t *>(mmap(nullptr, map_bytes_,
+                                      PROT_READ | PROT_WRITE,
+                                      MAP_SHARED, fd, 0));
+  close(fd);
+  if (base_ == MAP_FAILED) throw std::runtime_error("ray: mmap failed");
+
+  stopping_ = false;
+  unsigned n = std::thread::hardware_concurrency();
+  if (n < 2) n = 2;
+  if (n > 8) n = 8;
+  for (unsigned i = 0; i < n; i++) {
+    workers_.emplace_back([this] { Worker(); });
+  }
+}
+
+void Runtime::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (store_ == nullptr) return;
+    stopping_ = true;
+  }
+  cv_.notify_all();
+  for (auto &t : workers_) t.join();
+  workers_.clear();
+  munmap(base_, map_bytes_);
+  base_ = nullptr;
+  rt_store_detach(store_);
+  if (owns_store_) rt_store_destroy(store_name_.c_str());
+  store_ = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    errors_.clear();
+  }
+}
+
+void Runtime::Worker() {
+  for (;;) {
+    std::function<void()> job;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] { return stopping_ || !queue_.empty(); });
+      if (stopping_ && queue_.empty()) return;
+      job = std::move(queue_.front());
+      queue_.pop();
+    }
+    job();
+  }
+}
+
+ObjectID Runtime::NextId() {
+  ObjectID id{};
+  uint64_t c;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    c = ++counter_;
+  }
+  uint64_t pid = static_cast<uint64_t>(getpid());
+  std::memcpy(id.data(), &c, sizeof(c));
+  std::memcpy(id.data() + sizeof(c), &pid, sizeof(pid));
+  id[RT_ID_SIZE - 1] = 0xC2;  /* marks C++-api-owned ids */
+  return id;
+}
+
+void Runtime::StoreResult(const ObjectID &id,
+                          const std::vector<uint8_t> &data) {
+  /* layout: [u64 payload size][payload] — the header makes empty
+   * payloads representable (the store itself has a min object size) */
+  uint64_t n = data.size();
+  int64_t off = rt_obj_create(store_, id.data(), sizeof(n) + n);
+  if (off < 0) throw std::runtime_error("ray: object create failed");
+  std::memcpy(base_ + off, &n, sizeof(n));
+  if (n) std::memcpy(base_ + off + sizeof(n), data.data(), n);
+  if (rt_obj_seal(store_, id.data()) != RT_OK)
+    throw std::runtime_error("ray: seal failed");
+}
+
+ObjectID Runtime::PutBytes(const std::vector<uint8_t> &data) {
+  if (store_ == nullptr) throw std::runtime_error("ray: not initialized");
+  ObjectID id = NextId();
+  StoreResult(id, data);
+  return id;
+}
+
+std::vector<uint8_t> Runtime::GetBytes(const ObjectID &id,
+                                       double timeout_s) {
+  if (store_ == nullptr) throw std::runtime_error("ray: not initialized");
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  for (;;) {
+    uint64_t size = 0;
+    int64_t off = rt_obj_get(store_, id.data(), &size);
+    if (off >= 0) {
+      uint64_t n = 0;
+      std::memcpy(&n, base_ + off, sizeof(n));
+      std::vector<uint8_t> out(base_ + off + sizeof(n),
+                               base_ + off + sizeof(n) + n);
+      rt_obj_release(store_, id.data());
+      return out;
+    }
+    std::string err;
+    if (FindError(id, &err))
+      throw std::runtime_error("ray: task failed: " + err);
+    if (std::chrono::steady_clock::now() > deadline)
+      throw std::runtime_error("ray: Get timed out");
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+}
+
+void Runtime::RecordError(const ObjectID &id, const std::string &what) {
+  std::lock_guard<std::mutex> lk(err_mu_);
+  errors_.emplace_back(id, what);
+}
+
+bool Runtime::FindError(const ObjectID &id, std::string *out) {
+  std::lock_guard<std::mutex> lk(err_mu_);
+  for (auto &e : errors_) {
+    if (e.first == id) {
+      *out = e.second;
+      return true;
+    }
+  }
+  return false;
+}
+
+ObjectID Runtime::Submit(std::function<std::vector<uint8_t>()> fn) {
+  if (store_ == nullptr) throw std::runtime_error("ray: not initialized");
+  ObjectID id = NextId();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push([this, id, fn] {
+      try {
+        StoreResult(id, fn());
+      } catch (const std::exception &e) {
+        RecordError(id, e.what());
+      } catch (...) {
+        RecordError(id, "unknown error");
+      }
+    });
+  }
+  cv_.notify_one();
+  return id;
+}
+
+}  // namespace ray

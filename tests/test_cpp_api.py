@@ -1,0 +1,26 @@
+"""C++ worker API build + run (reference analogue: cpp/ api tests run
+in CI; here the Makefile target builds against the same shm store the
+Python runtime uses)."""
+
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE = __file__.rsplit("/", 2)[0] + "/native"
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_cpp_api_build_and_run():
+    out = subprocess.run(["make", "-C", NATIVE, "api_test"],
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "api_test ok" in out.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_cpp_race_test():
+    out = subprocess.run(["make", "-C", NATIVE, "race"],
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "race_test ok" in out.stdout
