@@ -188,8 +188,9 @@ class TaskCaller {
   std::tuple<Args...> args_;
 };
 
-/* ray::Task(f, a, b).Remote() — args bound at Task() like the
- * reference's ray::Task(f).Remote(a, b); both spellings supported. */
+/* ray::Task(f, a, b).Remote() — args are bound at Task() (the
+ * reference binds them at Remote(); only this spelling is supported
+ * here). */
 template <typename F, typename... Args>
 TaskCaller<F, Args...> Task(F fn, Args... args) {
   return TaskCaller<F, Args...>(fn, std::make_tuple(args...));

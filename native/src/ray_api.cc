@@ -37,16 +37,29 @@ void Runtime::Init(const std::string &store_name, uint64_t capacity) {
   if (store_ == nullptr) throw std::runtime_error("ray: store init failed");
 
   /* map the data plane (clients resolve offsets against their own map,
-   * see rt_store.h header comment) */
+   * see rt_store.h header comment).  shm names may or may not carry a
+   * leading slash (the Python side's arena names have none) — the
+   * filesystem path wants exactly one separator. */
   map_bytes_ = rt_store_map_bytes(store_);
-  std::string shm_path = "/dev/shm" + store_name_;
+  std::string bare = store_name_;
+  while (!bare.empty() && bare.front() == '/') bare.erase(0, 1);
+  std::string shm_path = "/dev/shm/" + bare;
   int fd = open(shm_path.c_str(), O_RDWR);
-  if (fd < 0) throw std::runtime_error("ray: shm open failed");
+  if (fd < 0) {
+    rt_store_detach(store_);   /* roll back: never leave store_ set on a
+                                  half-initialized runtime */
+    store_ = nullptr;
+    throw std::runtime_error("ray: shm open failed: " + shm_path);
+  }
   base_ = static_cast<uint8_t *>(mmap(nullptr, map_bytes_,
                                       PROT_READ | PROT_WRITE,
                                       MAP_SHARED, fd, 0));
   close(fd);
-  if (base_ == MAP_FAILED) throw std::runtime_error("ray: mmap failed");
+  if (base_ == MAP_FAILED) {
+    rt_store_detach(store_);
+    store_ = nullptr;
+    throw std::runtime_error("ray: mmap failed");
+  }
 
   stopping_ = false;
   unsigned n = std::thread::hardware_concurrency();

@@ -14,7 +14,7 @@ from ray_tpu.rllib.connectors import (ClipActions, ClipReward, Connector,
                                       UnsquashActions)
 from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.ddpg import DDPG, DDPGConfig, TD3, TD3Config
-from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig, SimpleQ, SimpleQConfig
 from ray_tpu.rllib.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.pg import PG, PGConfig
@@ -56,4 +56,5 @@ __all__ = [
     "Pendulum", "Connector", "ConnectorPipeline", "FlattenObs",
     "MeanStdFilter", "FrameStack", "ClipReward", "ClipActions",
     "UnsquashActions", "PolicyClient", "PolicyServerInput",
+    "SimpleQ", "SimpleQConfig",
 ]

@@ -140,6 +140,19 @@ class _NStepWindow:
         return out
 
 
+@dataclass
+class SimpleQConfig(DQNConfig):
+    """Vanilla Q-learning: DQN minus double/dueling/prioritized/n-step
+    (reference: rllib/algorithms/simple_q/)."""
+    double_q: bool = False
+    dueling: bool = False
+    prioritized_replay: bool = False
+    n_step: int = 1
+
+    def build(self, algo_cls=None) -> "SimpleQ":
+        return SimpleQ({"_config": self})
+
+
 class DQN(Algorithm):
     _default_config = DQNConfig
 
@@ -271,3 +284,7 @@ class DQN(Algorithm):
         self.opt_state = (jax.tree.map(jnp.asarray, ck["opt_state"])
                           if "opt_state" in ck else self.tx.init(self.params))
         self._timesteps = ck.get("timesteps", 0)
+
+
+class SimpleQ(DQN):
+    _default_config = SimpleQConfig

@@ -13,6 +13,7 @@ from ray_tpu.train.predictor import (BatchPredictor, JaxPredictor,
 from ray_tpu.train.result import Result
 from ray_tpu.train.step import (TrainState, make_train_step, shard_batch,
                                 state_shardings)
+from ray_tpu.train.huggingface import TransformersTrainer
 from ray_tpu.train.torch_trainer import (TorchConfig, TorchTrainer,
                                          prepare_model)
 from ray_tpu.train.trainer import (BaseTrainer, DataParallelTrainer,
@@ -27,5 +28,5 @@ __all__ = [
     "TrainingFailedError", "session", "GBDTTrainer", "SklearnTrainer",
     "XGBoostTrainer", "LightGBMTrainer", "Predictor", "JaxPredictor",
     "SklearnPredictor", "BatchPredictor", "TorchTrainer", "TorchConfig",
-    "prepare_model",
+    "prepare_model", "TransformersTrainer",
 ]

@@ -1,0 +1,84 @@
+"""TransformersTrainer: Hugging Face Trainer loops on the cluster.
+
+Reference capability: python/ray/train/huggingface/ —
+TransformersTrainer (huggingface_trainer.py): each worker constructs a
+``transformers.Trainer`` via ``trainer_init_per_worker`` and runs it
+under torch.distributed so HF's own DDP integration shards the batch;
+HF log events flow back as session reports.
+
+ray_tpu shape: a thin specialization of TorchTrainer — the worker loop
+builds the HF trainer inside the initialized gloo process group
+(transformers reads RANK/WORLD_SIZE/MASTER_* from the env our
+_TorchWorker.setup_pg exports), bridges ``on_log`` to
+``session.report``, and ships rank-0's final model state dict as the
+checkpoint payload.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.torch_trainer import TorchConfig, TorchTrainer
+
+
+def _make_loop(trainer_init_per_worker: Callable):
+    def loop(config):
+        import transformers
+
+        from ray_tpu.train import session
+
+        hf_trainer = trainer_init_per_worker(config)
+        if not isinstance(hf_trainer, transformers.Trainer):
+            raise TypeError(
+                "trainer_init_per_worker must return a "
+                f"transformers.Trainer, got {type(hf_trainer).__name__}")
+
+        class _ReportCallback(transformers.TrainerCallback):
+            """HF log events → session.report (reference:
+            huggingface/_huggingface_utils.py TrainReportCallback)."""
+
+            def on_log(self, args, state, control, logs=None, **kw):
+                if logs:
+                    session.report(
+                        {k: v for k, v in logs.items()
+                         if isinstance(v, (int, float))})
+
+        hf_trainer.add_callback(_ReportCallback())
+        result = hf_trainer.train()
+
+        final = {"training_loss": float(result.training_loss),
+                 "global_step": int(result.global_step)}
+        ckpt = None
+        if session.get_world_rank() == 0:
+            import numpy as np
+            model = hf_trainer.model
+            # unwrap DDP if HF wrapped it
+            model = getattr(model, "module", model)
+            ckpt = {"state_dict": {
+                k: np.asarray(v.detach().cpu())
+                for k, v in model.state_dict().items()},
+                **final}
+        session.report(final, checkpoint=ckpt)
+
+    return loop
+
+
+class TransformersTrainer(TorchTrainer):
+    """(reference: train/huggingface/huggingface_trainer.py
+    TransformersTrainer / HuggingFaceTrainer)"""
+
+    def __init__(self, trainer_init_per_worker: Callable, *,
+                 trainer_init_config: Optional[dict] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(
+            _make_loop(trainer_init_per_worker),
+            train_loop_config=trainer_init_config or {},
+            torch_config=torch_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint)

@@ -1,0 +1,124 @@
+"""Experiment-tracking integrations: W&B and MLflow logger callbacks.
+
+Reference capability: python/ray/air/integrations/wandb.py
+(WandbLoggerCallback) and mlflow.py (MLflowLoggerCallback) — per-trial
+runs in the tracking backend, metrics streamed on every result, final
+status on completion.
+
+Both import their client lazily so the framework carries no hard
+dependency; constructing a callback without the library raises an
+actionable ImportError (matching the reference's behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu.tune.callback import Callback
+
+
+class WandbLoggerCallback(Callback):
+    """(reference: air/integrations/wandb.py WandbLoggerCallback —
+    one wandb run per trial, config logged once, metrics per result)."""
+
+    def __init__(self, project: str, group: Optional[str] = None,
+                 api_key: Optional[str] = None, **init_kwargs):
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbLoggerCallback requires the `wandb` package; it is "
+                "not installed in this environment") from e
+        self._wandb = wandb
+        self.project = project
+        self.group = group
+        self.init_kwargs = init_kwargs
+        self._runs: Dict[str, object] = {}
+        if api_key:
+            self._wandb.login(key=api_key)
+
+    def on_trial_start(self, trial) -> None:
+        # reinit="create_new": concurrent trials each keep a live run —
+        # plain reinit=True finishes the previous trial's run and drops
+        # its remaining metric stream
+        self._runs[trial.trial_id] = self._wandb.init(
+            project=self.project, group=self.group,
+            name=trial.trial_id, config=dict(trial.config),
+            reinit="create_new", **self.init_kwargs)
+
+    def on_trial_result(self, trial, result: dict) -> None:
+        run = self._runs.get(trial.trial_id)
+        if run is not None:
+            run.log({k: v for k, v in result.items()
+                     if isinstance(v, (int, float))})
+
+    def on_trial_complete(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish()
+
+    def on_trial_error(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish(exit_code=1)
+
+    def on_experiment_end(self, trials: list) -> None:
+        for run in self._runs.values():
+            run.finish()
+        self._runs.clear()
+
+
+class MLflowLoggerCallback(Callback):
+    """(reference: air/integrations/mlflow.py MLflowLoggerCallback —
+    one mlflow run per trial under a shared experiment).
+
+    Uses MlflowClient with explicit run ids — the fluent
+    ``mlflow.log_metric`` API targets the global *active* run, which
+    misroutes metrics as soon as two trials overlap."""
+
+    def __init__(self, experiment_name: str = "ray_tpu",
+                 tracking_uri: Optional[str] = None,
+                 tags: Optional[dict] = None):
+        try:
+            from mlflow.tracking import MlflowClient
+        except ImportError as e:
+            raise ImportError(
+                "MLflowLoggerCallback requires the `mlflow` package; it "
+                "is not installed in this environment") from e
+        self._client = MlflowClient(tracking_uri=tracking_uri)
+        exp = self._client.get_experiment_by_name(experiment_name)
+        self._experiment_id = (exp.experiment_id if exp is not None
+                               else self._client.create_experiment(
+                                   experiment_name))
+        self.tags = tags or {}
+        self._runs: Dict[str, str] = {}   # trial_id -> mlflow run_id
+
+    def on_trial_start(self, trial) -> None:
+        run = self._client.create_run(
+            self._experiment_id,
+            tags={**self.tags, "mlflow.runName": trial.trial_id})
+        self._runs[trial.trial_id] = run.info.run_id
+        for k, v in trial.config.items():
+            try:
+                self._client.log_param(run.info.run_id, k, v)
+            except Exception:  # noqa: BLE001 - unloggable param type
+                pass
+
+    def on_trial_result(self, trial, result: dict) -> None:
+        run_id = self._runs.get(trial.trial_id)
+        if run_id is None:
+            return
+        step = int(result.get("training_iteration", 0))
+        for k, v in result.items():
+            if isinstance(v, (int, float)):
+                self._client.log_metric(run_id, k, float(v), step=step)
+
+    def on_trial_complete(self, trial) -> None:
+        run_id = self._runs.pop(trial.trial_id, None)
+        if run_id is not None:
+            self._client.set_terminated(run_id, status="FINISHED")
+
+    def on_trial_error(self, trial) -> None:
+        run_id = self._runs.pop(trial.trial_id, None)
+        if run_id is not None:
+            self._client.set_terminated(run_id, status="FAILED")

@@ -1,0 +1,73 @@
+"""Usage stats: opt-out local usage records (no network egress).
+
+Reference capability: python/ray/_private/usage/usage_lib.py — an
+opt-out telemetry ping summarizing cluster/library usage. Re-derived
+WITHOUT phoning home: records are written to a local JSONL file under
+the session dir so operators can aggregate them themselves; nothing
+leaves the machine. Disable with RAY_TPU_USAGE_STATS_ENABLED=0
+(mirrors RAY_USAGE_STATS_ENABLED).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_tags: Dict[str, str] = {}
+_library_usages: set = set()
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1").lower() \
+        not in ("0", "false", "no")
+
+
+def record_library_usage(library: str) -> None:
+    """Called by library entry points (train/tune/data/serve/rllib)
+    (reference: usage_lib.record_library_usage)."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _library_usages.add(library)
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _tags[str(key)] = str(value)
+
+
+def _snapshot() -> dict:
+    import ray_tpu
+    with _lock:
+        snap = {
+            "ts": time.time(),
+            "version": ray_tpu.__version__,
+            "libraries": sorted(_library_usages),
+            "tags": dict(_tags),
+        }
+    try:
+        import jax
+        snap["device_kind"] = jax.devices()[0].device_kind
+        snap["n_devices"] = len(jax.devices())
+    except Exception:  # noqa: BLE001 - no backend is fine
+        pass
+    return snap
+
+
+def write_usage_record(session_dir: Optional[str] = None) -> Optional[str]:
+    """Append one usage record locally (the analogue of the reference's
+    report, minus the network)."""
+    if not usage_stats_enabled():
+        return None
+    d = session_dir or os.path.join("/tmp/ray_tpu", "usage")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "usage_stats.jsonl")
+    with open(path, "a") as f:
+        f.write(json.dumps(_snapshot()) + "\n")
+    return path

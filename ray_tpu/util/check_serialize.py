@@ -1,0 +1,92 @@
+"""inspect_serializability: explain WHY an object fails to pickle.
+
+Reference capability: python/ray/util/check_serialize.py —
+``inspect_serializability(obj)`` walks closures/attributes of an
+unpicklable object and prints a tree of the offending members, so
+users can fix `@remote` capture errors without bisecting by hand.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Set, Tuple
+
+import cloudpickle
+
+
+class FailureTuple:
+    """One identified unserializable member."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple({self.name}, parent={self.parent!r})"
+
+    def __eq__(self, other):
+        return (isinstance(other, FailureTuple)
+                and (self.name, self.parent) == (other.name, other.parent))
+
+    def __hash__(self):
+        return hash((self.name, self.parent))
+
+
+def _try_pickle(obj) -> Tuple[bool, Optional[Exception]]:
+    try:
+        cloudpickle.dumps(obj)
+        return True, None
+    except Exception as e:  # noqa: BLE001 - the point is diagnosing these
+        return False, e
+
+
+def inspect_serializability(obj: Any, name: Optional[str] = None,
+                            depth: int = 3, _failures=None,
+                            _seen: Optional[Set[int]] = None,
+                            _print=print
+                            ) -> Tuple[bool, Set[FailureTuple]]:
+    """Returns (serializable, failures). Walks closure cells, attributes,
+    and function globals of unpicklable objects up to `depth`."""
+    failures = set() if _failures is None else _failures
+    seen = set() if _seen is None else _seen
+    name = name or getattr(obj, "__name__", repr(obj)[:60])
+
+    ok, err = _try_pickle(obj)
+    if ok:
+        return True, failures
+    if id(obj) in seen or depth < 0:
+        return False, failures
+    seen.add(id(obj))
+    _print(f"  serialization FAILED for {name!r}: "
+           f"{type(err).__name__}: {err}")
+
+    children = []
+    if inspect.isfunction(obj) or inspect.ismethod(obj):
+        fn = obj.__func__ if inspect.ismethod(obj) else obj
+        if fn.__closure__:
+            for var, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                try:
+                    children.append((var, cell.cell_contents))
+                except ValueError:
+                    pass
+        for g in fn.__code__.co_names:
+            if g in (fn.__globals__ or {}):
+                children.append((f"global:{g}", fn.__globals__[g]))
+    else:
+        for attr, val in sorted(vars(obj).items()) \
+                if hasattr(obj, "__dict__") else []:
+            children.append((attr, val))
+
+    found_child = False
+    for child_name, child in children:
+        c_ok, _ = _try_pickle(child)
+        if not c_ok:
+            found_child = True
+            failures.add(FailureTuple(child, child_name, name))
+            inspect_serializability(child, name=child_name,
+                                    depth=depth - 1, _failures=failures,
+                                    _seen=seen, _print=_print)
+    if not found_child:
+        failures.add(FailureTuple(obj, name, None))
+    return False, failures
