@@ -48,6 +48,7 @@ void Runtime::Init(const std::string &store_name, uint64_t capacity) {
   if (fd < 0) {
     rt_store_detach(store_);   /* roll back: never leave store_ set on a
                                   half-initialized runtime */
+    if (owns_store_) rt_store_destroy(store_name_.c_str());
     store_ = nullptr;
     throw std::runtime_error("ray: shm open failed: " + shm_path);
   }
@@ -57,6 +58,7 @@ void Runtime::Init(const std::string &store_name, uint64_t capacity) {
   close(fd);
   if (base_ == MAP_FAILED) {
     rt_store_detach(store_);
+    if (owns_store_) rt_store_destroy(store_name_.c_str());
     store_ = nullptr;
     throw std::runtime_error("ray: mmap failed");
   }

@@ -21,6 +21,40 @@ from typing import Any, Optional
 
 _HDR = struct.Struct("<Q")
 
+# frame payload = 1 tag byte + body; self-describing so mixed encodings
+# coexist on one socket (the reply always matches the request's encoding)
+_TAG_PICKLE = b"\x00"
+_TAG_PROTO = b"\x01"
+
+
+def encode_payload(msg: dict, encoding: str = "pickle") -> bytes:
+    """dict → tagged frame payload. encoding="proto" uses the typed
+    wire contract (core/schema.py over native/protos/ray_tpu.proto)."""
+    if encoding == "proto":
+        from ray_tpu.core import schema
+        return _TAG_PROTO + schema.encode(msg)
+    return _TAG_PICKLE + pickle.dumps(msg, protocol=5)
+
+
+def decode_payload(data: bytes) -> dict:
+    tag, body = data[:1], data[1:]
+    if tag == _TAG_PROTO:
+        from ray_tpu.core import schema
+        return schema.decode(body)
+    return pickle.loads(body)
+
+
+def payload_encoding(data: bytes) -> str:
+    return "proto" if data[:1] == _TAG_PROTO else "pickle"
+
+
+def default_encoding() -> str:
+    """Process-wide wire encoding (RAY_TPU_WIRE_ENCODING=proto opts in
+    to the protobuf contract; pickle framing is the default)."""
+    import os
+    return ("proto" if os.environ.get("RAY_TPU_WIRE_ENCODING", "")
+            .lower() == "proto" else "pickle")
+
 
 class ConnectionClosed(Exception):
     pass
@@ -29,15 +63,16 @@ class ConnectionClosed(Exception):
 class Connection:
     """Framed, thread-safe-send connection over a stream socket."""
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, encoding: Optional[str] = None):
         self.sock = sock
+        self.encoding = encoding or default_encoding()
         self._send_lock = threading.Lock()
         self._recv_buf = b""
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
             if sock.family != socket.AF_UNIX else None
 
     def send(self, msg: dict) -> None:
-        data = pickle.dumps(msg, protocol=5)
+        data = encode_payload(msg, self.encoding)
         with self._send_lock:
             try:
                 self.sock.sendall(_HDR.pack(len(data)) + data)
@@ -56,7 +91,7 @@ class Connection:
             raise ConnectionClosed(str(e)) from e
         finally:
             self.sock.settimeout(None)
-        return pickle.loads(data)
+        return decode_payload(data)
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -89,6 +124,6 @@ def connect(address: str, timeout: float = 30.0) -> Connection:
     return Connection(sock)
 
 
-def dumps_frame(msg: dict) -> bytes:
-    data = pickle.dumps(msg, protocol=5)
+def dumps_frame(msg: dict, encoding: str = "pickle") -> bytes:
+    data = encode_payload(msg, encoding)
     return _HDR.pack(len(data)) + data

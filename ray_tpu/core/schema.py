@@ -1,0 +1,273 @@
+"""Typed wire schema: dict messages ⇄ protobuf.
+
+Reference capability: src/ray/protobuf/ (N20 — 22 .proto files typing
+every RPC). The contract lives in native/protos/ray_tpu.proto
+(compiled into ray_tpu/core/generated/); this module converts the
+live control-plane dict messages to and from those protos.
+
+The transport (core/protocol.py) still frames pickled dicts — the
+conversion layer is exercised in CI on real traffic shapes so the
+encoding can flip to protobuf (or the surface be served over gRPC)
+without touching callers. Messages without a dedicated proto ride the
+`Raw` envelope (typed tag + pickled body), the same pattern the
+reference uses for pickled task payloads inside typed protos.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+from typing import Any, Dict, Optional
+
+_GEN = os.path.join(os.path.dirname(__file__), "generated")
+if _GEN not in sys.path:
+    sys.path.insert(0, _GEN)
+
+import ray_tpu_pb2 as pb  # noqa: E402
+
+
+def _dumps(v) -> bytes:
+    import cloudpickle
+    return cloudpickle.dumps(v)
+
+
+def _loads(b: bytes):
+    return pickle.loads(b)
+
+
+# -- TaskSpec ------------------------------------------------------------
+
+def spec_to_proto(spec: Dict[str, Any]) -> "pb.TaskSpec":
+    p = pb.TaskSpec()
+    p.task_id = spec.get("task_id", b"")
+    p.kind = spec.get("kind", "task")
+    p.name = spec.get("name", "")
+    p.function_id = spec.get("function_id", "") or ""
+    nr = spec.get("num_returns", 1)
+    if nr == "dynamic":
+        p.dynamic_returns = True
+        p.num_returns = 1
+    else:
+        p.num_returns = int(nr)
+    p.return_ids.extend(spec.get("return_ids", []))
+    for k, v in (spec.get("resources") or {}).items():
+        p.resources[k] = float(v)
+    p.num_tpus = float(spec.get("num_tpus", 0))
+    p.max_retries = int(spec.get("max_retries", 0))
+    p.owner = spec.get("owner", "") or ""
+    p.args_data = spec.get("args", b"") or b""
+    p.arg_ids.extend(spec.get("arg_ids", []))
+    if spec.get("arg_blob"):
+        p.arg_blob = spec["arg_blob"]
+    pg = spec.get("placement_group")
+    if pg:
+        p.placement_group_id = pg[0]
+        p.placement_group_bundle = int(pg[1])
+    if spec.get("runtime_env"):
+        p.runtime_env_payload = _dumps(spec["runtime_env"])
+    p.actor_id = spec.get("actor_id", b"")
+    p.class_name = spec.get("class_name", "") or ""
+    p.methods.extend(spec.get("methods", []))
+    p.method = spec.get("method", "") or ""
+    p.seq = int(spec.get("seq", 0))
+    p.max_restarts = int(spec.get("max_restarts", 0))
+    p.max_concurrency = int(spec.get("max_concurrency", 1))
+    p.namespace = spec.get("namespace", "") or ""
+    p.get_if_exists = bool(spec.get("get_if_exists", False))
+    tctx = spec.get("trace_ctx") or {}
+    p.trace_id = tctx.get("trace_id", "")
+    p.span_id = tctx.get("span_id", "")
+    return p
+
+
+def spec_from_proto(p: "pb.TaskSpec") -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "task_id": p.task_id,
+        "kind": p.kind,
+        "name": p.name,
+        "function_id": p.function_id,
+        "num_returns": "dynamic" if p.dynamic_returns else p.num_returns,
+        "return_ids": list(p.return_ids),
+        "resources": dict(p.resources),
+        "num_tpus": p.num_tpus,
+        "max_retries": p.max_retries,
+        "owner": p.owner,
+        "args": p.args_data,
+        "arg_ids": list(p.arg_ids),
+    }
+    if p.arg_blob:
+        spec["arg_blob"] = p.arg_blob
+    if p.placement_group_id:
+        spec["placement_group"] = (p.placement_group_id,
+                                   p.placement_group_bundle)
+    if p.runtime_env_payload:
+        spec["runtime_env"] = _loads(p.runtime_env_payload)
+    if p.kind in ("actor_create", "actor_task"):
+        spec["actor_id"] = p.actor_id
+    if p.kind == "actor_create":
+        spec.update(class_name=p.class_name, methods=list(p.methods),
+                    max_restarts=p.max_restarts,
+                    max_concurrency=p.max_concurrency,
+                    namespace=p.namespace, get_if_exists=p.get_if_exists)
+    if p.kind == "actor_task":
+        spec.update(method=p.method, seq=p.seq)
+    if p.trace_id:
+        spec["trace_ctx"] = {"trace_id": p.trace_id,
+                             "span_id": p.span_id}
+    return spec
+
+
+# -- message envelope ----------------------------------------------------
+
+# dict "t" tag → (oneof field name, to_proto, from_proto)
+def _simple(field: str, keys: Dict[str, str], bin_lists=(), payloads=()):
+    """Builder for flat messages: keys maps dict key → proto field."""
+
+    def to_proto(m: dict, env: "pb.Message"):
+        sub = getattr(env, field)
+        for dk, pk in keys.items():
+            if dk in m and m[dk] is not None:
+                setattr(sub, pk, m[dk])
+        for dk in bin_lists:
+            getattr(sub, dk).extend(m.get(dk, []))
+        for dk in payloads:
+            if m.get(dk) is not None:
+                setattr(sub, dk + "_payload", _dumps(m[dk]))
+
+    def from_proto(env: "pb.Message") -> dict:
+        sub = getattr(env, field)
+        out = {}
+        for dk, pk in keys.items():
+            out[dk] = getattr(sub, pk)
+        for dk in bin_lists:
+            out[dk] = list(getattr(sub, dk))
+        for dk in payloads:
+            blob = getattr(sub, dk + "_payload")
+            out[dk] = _loads(blob) if blob else None
+        return out
+
+    return field, to_proto, from_proto
+
+
+_TABLE: Dict[str, tuple] = {
+    "register": _simple("register", {"kind": "kind",
+                                     "worker_id": "worker_id",
+                                     "pid": "pid", "tpu": "tpu",
+                                     "node_hex": "node_hex"}),
+    "put_inline": _simple("put_inline", {"object_id": "object_id",
+                                         "data": "data",
+                                         "is_error": "is_error",
+                                         "owner": "owner"},
+                          bin_lists=("nested_refs",)),
+    "get_objects": _simple("get_objects", {}, bin_lists=("object_ids",)),
+    "free_objects": _simple("free_objects", {},
+                            bin_lists=("object_ids",)),
+    "release_pins": _simple("release_pins", {},
+                            bin_lists=("object_ids",)),
+    "release_refs": _simple("release_refs", {},
+                            bin_lists=("object_ids",)),
+    "task_done": _simple("task_done", {"task_id": "task_id",
+                                       "error": "error"}),
+    "kill_actor": _simple("kill_actor", {"actor_id": "actor_id",
+                                         "no_restart": "no_restart"}),
+    "kv_put": _simple("kv_put", {"key": "key", "value": "value",
+                                 "overwrite": "overwrite",
+                                 "namespace": "namespace"}),
+    "kv_get": _simple("kv_get", {"key": "key", "namespace": "namespace"}),
+    "kv_del": _simple("kv_del", {"key": "key", "namespace": "namespace"}),
+    "subscribe": _simple("subscribe", {"channel": "channel"}),
+}
+
+
+def message_to_proto(m: Dict[str, Any]) -> "pb.Message":
+    """One live control-plane dict → typed envelope."""
+    env = pb.Message()
+    if "reqid" in m:
+        env.reqid = int(m["reqid"])
+        env.has_reqid = True
+    t = m.get("t", "")
+    if t in ("submit_task", "submit_actor_task", "create_actor"):
+        env.submit_task.spec.CopyFrom(spec_to_proto(m["spec"]))
+        return env
+    if t == "wait":
+        env.wait.object_ids.extend(m.get("object_ids", []))
+        env.wait.num_returns = int(m.get("num_returns", 1))
+        if m.get("timeout") is not None:
+            env.wait.timeout = float(m["timeout"])
+            env.wait.has_timeout = True
+        return env
+    if t == "publish":
+        env.publish.channel = m.get("channel", "")
+        env.publish.payload = _dumps(m.get("data"))
+        return env
+    if t == "heartbeat":
+        env.heartbeat.node_id = m.get("node_id", "")
+        for field_name in ("available", "total", "queued"):
+            dst = getattr(env.heartbeat, field_name)
+            for k, v in (m.get(field_name) or {}).items():
+                dst[k] = float(v)
+        env.heartbeat.seq = int(m.get("seq", 0))
+        return env
+    if t in _TABLE:
+        field, to_proto, _ = _TABLE[t]
+        getattr(env, field).SetInParent()   # select the oneof arm even
+        to_proto(m, env)                    # when every field is empty
+        return env
+    # long tail: typed tag + pickled body
+    env.raw.type = t
+    env.raw.payload = _dumps({k: v for k, v in m.items()
+                              if k not in ("t", "reqid")})
+    return env
+
+
+def message_from_proto(env: "pb.Message") -> Dict[str, Any]:
+    body = env.WhichOneof("body")
+    # fire-and-forget messages carry no reqid; materializing one would
+    # flip the service's `"reqid" in m` reply gate for every such
+    # message (and reqid=0 IS a valid first request id, hence the
+    # explicit presence flag)
+    out: Dict[str, Any] = {}
+    if env.has_reqid:
+        out["reqid"] = env.reqid
+    if body == "submit_task":
+        spec = spec_from_proto(env.submit_task.spec)
+        t = {"task": "submit_task", "actor_create": "create_actor",
+             "actor_task": "submit_actor_task"}[spec["kind"]]
+        out.update(t=t, spec=spec)
+        return out
+    if body == "wait":
+        out.update(t="wait", object_ids=list(env.wait.object_ids),
+                   num_returns=env.wait.num_returns,
+                   timeout=(env.wait.timeout if env.wait.has_timeout
+                            else None))
+        return out
+    if body == "publish":
+        out.update(t="publish", channel=env.publish.channel,
+                   data=_loads(env.publish.payload))
+        return out
+    if body == "heartbeat":
+        out.update(t="heartbeat", node_id=env.heartbeat.node_id,
+                   available=dict(env.heartbeat.available),
+                   total=dict(env.heartbeat.total),
+                   queued=dict(env.heartbeat.queued),
+                   seq=env.heartbeat.seq)
+        return out
+    if body == "raw":
+        out.update(t=env.raw.type, **_loads(env.raw.payload))
+        return out
+    for t, (field, _, from_proto) in _TABLE.items():
+        if body == field:
+            out.update(t=t, **from_proto(env))
+            return out
+    raise ValueError(f"unmapped proto body {body!r}")
+
+
+def encode(m: Dict[str, Any]) -> bytes:
+    return message_to_proto(m).SerializeToString()
+
+
+def decode(data: bytes) -> Dict[str, Any]:
+    env = pb.Message()
+    env.ParseFromString(data)
+    return message_from_proto(env)

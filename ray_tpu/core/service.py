@@ -25,6 +25,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID
 from ray_tpu.core.protocol import dumps_frame
 
@@ -47,6 +48,7 @@ class ClientRec:
     held_pins: list = field(default_factory=list)
     closed: bool = False
     node_hex: str = ""           # for kind in (node, peer): peer node id
+    encoding: str = "pickle"     # wire encoding this client speaks
 
 
 class EventLoopService:
@@ -201,7 +203,10 @@ class EventLoopService:
                 break
             frame = bytes(rec.rbuf[_HDR.size:_HDR.size + n])
             del rec.rbuf[:_HDR.size + n]
-            msg = pickle.loads(frame)
+            # frames are self-describing; replies/pushes follow the
+            # client's encoding
+            rec.encoding = protocol.payload_encoding(frame)
+            msg = protocol.decode_payload(frame)
             self._dispatch(rec, msg)
 
     def _on_writable(self, rec: ClientRec) -> None:
@@ -220,7 +225,7 @@ class EventLoopService:
     def _push(self, rec: ClientRec, msg: dict) -> None:
         if rec.closed:
             return
-        frame = dumps_frame(msg)
+        frame = dumps_frame(msg, rec.encoding)
         if rec.wbuf:
             rec.wbuf += frame
             return

@@ -35,3 +35,6 @@ __all__ = [
     "Concatenator", "Normalizer", "OneHotEncoder", "RobustScaler",
     "SimpleImputer",
 ]
+
+from ray_tpu import usage_stats as _usage_stats
+_usage_stats.record_library_usage("data")

@@ -19,6 +19,8 @@ from ray_tpu.rllib.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput
+from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TeamSwitch
+from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, vtrace
 from ray_tpu.rllib.multi_agent import (MultiAgentCartPole, MultiAgentEnv,
@@ -56,5 +58,9 @@ __all__ = [
     "Pendulum", "Connector", "ConnectorPipeline", "FlattenObs",
     "MeanStdFilter", "FrameStack", "ClipReward", "ClipActions",
     "UnsquashActions", "PolicyClient", "PolicyServerInput",
-    "SimpleQ", "SimpleQConfig",
+    "SimpleQ", "SimpleQConfig", "R2D2", "R2D2Config", "QMIX",
+    "QMIXConfig", "TeamSwitch",
 ]
+
+from ray_tpu import usage_stats as _usage_stats
+_usage_stats.record_library_usage("rllib")

@@ -1,0 +1,290 @@
+"""R2D2: recurrent replay distributed DQN.
+
+Reference capability: rllib/algorithms/r2d2/ (r2d2.py,
+r2d2_torch_policy.py — Kapturowski et al. 2019): an LSTM Q-network
+trained on stored SEQUENCES with burn-in (the first B steps of each
+replayed sequence only refresh the recurrent state, no gradient),
+stored-state initialization, double-Q targets, and h-function value
+rescaling.
+
+TPU redesign: the whole sequence update — burn-in scan, unrolled
+double-Q targets, masked sequence loss, value rescaling — is one jitted
+program (lax.scan over time inside jax.checkpoint-free small nets);
+the sequence replay buffer stays host-side numpy, matching the
+two-tier replay model used by DQN/SAC/APEX here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models.zoo import (LSTMNetConfig, lstm_forward, lstm_init,
+                                lstm_initial_state)
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import _NStepWindow  # noqa: F401 (parity import)
+from ray_tpu.rllib.env import VectorEnv
+
+
+@dataclass
+class R2D2Config(AlgorithmConfig):
+    buffer_size: int = 2_000          # stored sequences
+    learning_starts: int = 32         # sequences before training
+    batch_size: int = 16              # sequences per update
+    seq_len: int = 16                 # replayed sequence length
+    burn_in: int = 4                  # no-gradient prefix
+    cell_size: int = 64
+    target_update_freq: int = 400     # env steps
+    train_intensity: float = 0.125    # grad steps per env step
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 10_000
+    use_h_function: bool = True       # value rescaling h(x)
+    gamma: float = 0.997
+    lr: float = 1e-3
+
+    def build(self, algo_cls=None) -> "R2D2":
+        return R2D2({"_config": self})
+
+
+# value rescaling (Pohlen et al.): h(x) = sign(x)(sqrt(|x|+1)-1) + eps·x
+_H_EPS = 1e-3
+
+
+def _h(x):
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + _H_EPS * x
+
+
+def _h_inv(x):
+    # closed-form inverse of h
+    a = jnp.sqrt(1.0 + 4.0 * _H_EPS * (jnp.abs(x) + 1.0 + _H_EPS))
+    return jnp.sign(x) * ((((a - 1.0) / (2.0 * _H_EPS)) ** 2) - 1.0)
+
+
+def init_r2d2_params(obs_dim, num_actions, cell_size, rng):
+    from ray_tpu.models.zoo import _dense_init
+    k1, k2 = jax.random.split(rng)
+    cfg = LSTMNetConfig(obs_dim, cell_size)
+    return {"lstm": lstm_init(cfg, k1),
+            "q": _dense_init(k2, cell_size, num_actions, scale=0.01)}, cfg
+
+
+def q_seq(params, lcfg, obs_seq, carry):
+    """obs [B, T, D], carry → (q [B, T, A], carry)."""
+    from ray_tpu.models.zoo import _dense
+    ys, carry = lstm_forward(params["lstm"], obs_seq, carry, lcfg)
+    return _dense(params["q"], ys), carry
+
+
+class _SeqBuffer:
+    """Uniform replay of fixed-length sequences with stored initial
+    recurrent state (reference: r2d2's sequence replay)."""
+
+    def __init__(self, capacity: int, seed: int):
+        self.capacity = capacity
+        self.rows: list = []
+        self.pos = 0
+        self.rng = np.random.default_rng(seed)
+
+    def add(self, row: dict):
+        if len(self.rows) < self.capacity:
+            self.rows.append(row)
+        else:
+            self.rows[self.pos] = row
+            self.pos = (self.pos + 1) % self.capacity
+
+    def __len__(self):
+        return len(self.rows)
+
+    def sample(self, n: int) -> dict:
+        idx = self.rng.integers(0, len(self.rows), n)
+        cols = {}
+        for k in self.rows[0]:
+            cols[k] = np.stack([self.rows[i][k] for i in idx])
+        return cols
+
+
+def make_r2d2_update(cfg: R2D2Config, lcfg, tx):
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        obs = batch["obs"]                  # [B, T+1, D]
+        actions = batch["actions"]          # [B, T]
+        rewards = batch["rewards"]          # [B, T]
+        dones = batch["dones"]              # [B, T]
+        h0 = (batch["h0"], batch["c0"])     # stored initial state
+        B = obs.shape[0]
+        burn, T = cfg.burn_in, actions.shape[1]
+
+        def full_q(p, carry):
+            # burn-in: advance the recurrent state without gradient
+            if burn > 0:
+                _, carry = q_seq(p, lcfg, obs[:, :burn], carry)
+                carry = jax.tree.map(jax.lax.stop_gradient, carry)
+            q, _ = q_seq(p, lcfg, obs[:, burn:], carry)
+            return q                       # [B, T+1-burn, A]
+
+        q_t = full_q(target_params, h0)
+        tb = slice(burn, T)                # training region (post burn-in)
+
+        def loss_fn(p):
+            q = full_q(p, h0)              # [B, T+1-burn, A]
+            q_taken = jnp.take_along_axis(
+                q[:, :-1], actions[:, tb][..., None], 2)[..., 0]
+            # double-Q: online selects, target evaluates, at t+1
+            sel = jnp.argmax(q[:, 1:], axis=-1)
+            q_next = jnp.take_along_axis(q_t[:, 1:], sel[..., None],
+                                         2)[..., 0]
+            q_next = jax.lax.stop_gradient(q_next)
+            if cfg.use_h_function:
+                target = _h(rewards[:, tb] + cfg.gamma
+                            * (1.0 - dones[:, tb]) * _h_inv(q_next))
+            else:
+                target = rewards[:, tb] + cfg.gamma \
+                    * (1.0 - dones[:, tb]) * q_next
+            td = q_taken - jax.lax.stop_gradient(target)
+            # mask steps after an episode end inside the sequence
+            alive = jnp.concatenate(
+                [jnp.ones((B, 1)),
+                 jnp.cumprod(1.0 - dones[:, tb], axis=1)[:, :-1]], axis=1)
+            return jnp.sum(alive * td ** 2) / jnp.maximum(
+                jnp.sum(alive), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return update
+
+
+class R2D2(Algorithm):
+    _default_config = R2D2Config
+
+    def _build(self):
+        cfg = self.config
+        self.vec = VectorEnv(cfg.env, cfg.num_envs_per_worker,
+                             seed=cfg.seed)
+        self.obs_dim = self.vec.observation_dim
+        self.num_actions = self.vec.num_actions
+        self.params, self.lcfg = init_r2d2_params(
+            self.obs_dim, self.num_actions, cfg.cell_size,
+            jax.random.PRNGKey(cfg.seed))
+        self.target_params = self.params
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._update = make_r2d2_update(cfg, self.lcfg, self.tx)
+        self._qstep = jax.jit(
+            lambda p, o, c: q_seq(p, self.lcfg, o[:, None, :], c))
+        self.buffer = _SeqBuffer(cfg.buffer_size, cfg.seed)
+        self._obs = self.vec.reset()
+        self._carry = lstm_initial_state(self.lcfg, self.vec.num_envs)
+        self._rng = np.random.default_rng(cfg.seed + 1)
+        self._ep_rew = np.zeros(self.vec.num_envs, np.float32)
+        self._since_target_sync = 0
+        self._grad_debt = 0.0
+        # rolling per-env sequence accumulators (obs includes s_{t+T})
+        B = self.vec.num_envs
+        self._acc = [{"obs": [], "actions": [], "rewards": [],
+                      "dones": [],
+                      "h0": np.zeros(cfg.cell_size, np.float32),
+                      "c0": np.zeros(cfg.cell_size, np.float32)}
+                     for _ in range(B)]
+
+    @property
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._timesteps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end
+                                           - cfg.epsilon_start)
+
+    def _flush_seq(self, e: int, next_obs_e) -> None:
+        cfg = self.config
+        acc = self._acc[e]
+        if len(acc["actions"]) < cfg.seq_len:
+            return
+        row = {"obs": np.stack(acc["obs"] + [next_obs_e]),
+               "actions": np.asarray(acc["actions"], np.int32),
+               "rewards": np.asarray(acc["rewards"], np.float32),
+               "dones": np.asarray(acc["dones"], np.float32),
+               "h0": acc["h0"], "c0": acc["c0"]}
+        self.buffer.add(row)
+        # next sequence starts from the CURRENT recurrent state
+        h, c = self._carry
+        self._acc[e] = {"obs": [], "actions": [], "rewards": [],
+                        "dones": [],
+                        "h0": np.asarray(h[e]), "c0": np.asarray(c[e])}
+
+    def _reset_env_state(self, e: int) -> None:
+        h, c = self._carry
+        self._carry = (h.at[e].set(0.0), c.at[e].set(0.0))
+        self._acc[e] = {"obs": [], "actions": [], "rewards": [],
+                        "dones": [],
+                        "h0": np.zeros(self.config.cell_size, np.float32),
+                        "c0": np.zeros(self.config.cell_size, np.float32)}
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        B = self.vec.num_envs
+        steps, losses = 0, []
+        for _ in range(cfg.rollout_length):
+            q, self._carry = self._qstep(
+                self.params, jnp.asarray(self._obs, jnp.float32),
+                self._carry)
+            greedy = np.asarray(q[:, 0]).argmax(axis=-1)
+            explore = self._rng.random(B) < self.epsilon
+            rand = self._rng.integers(0, self.num_actions, B)
+            actions = np.where(explore, rand, greedy)
+            next_obs, rew, done = self.vec.step(actions)
+            for e in range(B):
+                acc = self._acc[e]
+                acc["obs"].append(np.asarray(self._obs[e], np.float32))
+                acc["actions"].append(int(actions[e]))
+                acc["rewards"].append(float(rew[e]))
+                acc["dones"].append(float(done[e]))
+                self._flush_seq(e, np.asarray(next_obs[e], np.float32))
+                if done[e]:
+                    self._reset_env_state(e)
+            self._ep_rew += rew
+            for i in np.nonzero(done)[0]:
+                self._ep_returns.append(float(self._ep_rew[i]))
+                self._ep_rew[i] = 0.0
+            self._obs = next_obs
+            steps += B
+            self._timesteps += B
+            self._since_target_sync += B
+
+            if len(self.buffer) < cfg.learning_starts:
+                continue
+            self._grad_debt += cfg.train_intensity * B
+            while self._grad_debt >= 1.0:
+                self._grad_debt -= 1.0
+                batch = self.buffer.sample(cfg.batch_size)
+                jb = {k: jnp.asarray(v) for k, v in batch.items()}
+                self.params, self.opt_state, loss = self._update(
+                    self.params, self.target_params, self.opt_state, jb)
+                losses.append(float(loss))
+            if self._since_target_sync >= cfg.target_update_freq:
+                self.target_params = self.params
+                self._since_target_sync = 0
+
+        return {"steps_this_iter": steps,
+                "epsilon": self.epsilon,
+                "buffer_sequences": len(self.buffer),
+                "mean_td_loss": float(np.mean(losses)) if losses else 0.0}
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "target_params": jax.tree.map(np.asarray,
+                                              self.target_params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.target_params = jax.tree.map(jnp.asarray, ck["target_params"])
+        self.opt_state = jax.tree.map(jnp.asarray, ck["opt_state"])
+        self._timesteps = ck.get("timesteps", 0)

@@ -133,3 +133,6 @@ __all__ = [
     "ServeController", "HttpProxy", "ingress", "batch", "run",
     "get_handle", "delete", "shutdown", "status", "proxy_address",
 ]
+
+from ray_tpu import usage_stats as _usage_stats
+_usage_stats.record_library_usage("serve")

@@ -30,3 +30,6 @@ __all__ = [
     "SklearnPredictor", "BatchPredictor", "TorchTrainer", "TorchConfig",
     "prepare_model", "TransformersTrainer",
 ]
+
+from ray_tpu import usage_stats as _usage_stats
+_usage_stats.record_library_usage("train")

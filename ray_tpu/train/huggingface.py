@@ -46,6 +46,21 @@ def _make_loop(trainer_init_per_worker: Callable):
                          if isinstance(v, (int, float))})
 
         hf_trainer.add_callback(_ReportCallback())
+
+        # restore: the trainer's resume checkpoint (or a restart-FT
+        # retry's last good state) carries rank-0's state_dict — load it
+        # before training so resume actually resumes
+        ck = session.get_checkpoint()
+        if ck is not None:
+            import torch
+            payload = ck.to_dict()
+            sd = payload.get("state_dict")
+            if sd:
+                model = getattr(hf_trainer.model, "module",
+                                hf_trainer.model)
+                model.load_state_dict(
+                    {k: torch.as_tensor(v) for k, v in sd.items()})
+
         result = hf_trainer.train()
 
         final = {"training_loss": float(result.training_loss),

@@ -47,3 +47,6 @@ __all__ = [
     "Callback", "CSVLoggerCallback", "JSONLoggerCallback",
     "TensorBoardLoggerCallback",
 ]
+
+from ray_tpu import usage_stats as _usage_stats
+_usage_stats.record_library_usage("tune")

@@ -25,3 +25,6 @@ __all__ = ["run", "run_async", "resume", "get_status", "get_output",
            "continuation", "Continuation", "EventListener",
            "TimerListener", "HTTPEventProvider", "wait_for_event",
            "virtual_actor"]
+
+from ray_tpu import usage_stats as _usage_stats
+_usage_stats.record_library_usage("workflow")

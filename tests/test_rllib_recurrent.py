@@ -1,0 +1,87 @@
+"""R2D2 + QMIX tests (reference test models:
+rllib/algorithms/r2d2/tests/, rllib/algorithms/qmix/tests/)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.qmix import QMIXConfig, TeamSwitch
+from ray_tpu.rllib.r2d2 import R2D2Config, _h, _h_inv
+
+
+class TestR2D2:
+    def test_value_rescaling_inverse(self):
+        import jax.numpy as jnp
+        x = jnp.asarray([-10.0, -1.0, 0.0, 0.5, 7.0, 100.0])
+        np.testing.assert_allclose(np.asarray(_h_inv(_h(x))),
+                                   np.asarray(x), rtol=1e-4, atol=1e-4)
+
+    def test_trains_and_loss_drops(self):
+        algo = R2D2Config(env="CartPole-v1", num_envs_per_worker=2,
+                          rollout_length=64, learning_starts=8,
+                          batch_size=8, seq_len=8, burn_in=2,
+                          seed=0).build()
+        losses = [algo.train()["mean_td_loss"] for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+    def test_sequences_carry_stored_state(self):
+        algo = R2D2Config(env="CartPole-v1", num_envs_per_worker=1,
+                          rollout_length=40, learning_starts=10_000,
+                          seq_len=8, burn_in=2, seed=0).build()
+        algo.train()
+        assert len(algo.buffer) >= 4
+        row = algo.buffer.rows[-1]
+        # obs includes the bootstrap successor; h0/c0 stored per sequence
+        assert row["obs"].shape[0] == 8 + 1
+        assert row["h0"].shape == (algo.config.cell_size,)
+
+    def test_checkpoint_roundtrip(self):
+        import jax
+        algo = R2D2Config(env="CartPole-v1", num_envs_per_worker=1,
+                          rollout_length=16, learning_starts=4,
+                          batch_size=4, seq_len=4, burn_in=1,
+                          seed=0).build()
+        algo.train()
+        ck = algo.save_checkpoint()
+        before = jax.tree.map(np.asarray, algo.params)
+        algo.train()
+        algo.load_checkpoint(ck)
+        after = jax.tree.map(np.asarray, algo.params)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_allclose(a, b)
+
+
+class TestQMIX:
+    def test_team_switch_env_contract(self):
+        env = TeamSwitch(num_agents=3, seed=0)
+        obs = env.reset()
+        assert set(obs) == {"agent_0", "agent_1", "agent_2"}
+        assert env.state().shape == (4,)
+        o, r, d, _ = env.step({a: 0 for a in env.agent_ids})
+        assert set(r.values()) <= {0.0, 1.0}
+        assert "__all__" in d
+
+    def test_mixer_monotonic_in_agent_q(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.qmix import init_qmix_params, mix
+        params = init_qmix_params(2, 2, 2, (32, 32), 3, 16,
+                                  jax.random.PRNGKey(0))
+        state = jnp.ones((1, 3))
+        q1 = float(mix(params, jnp.asarray([[0.0, 0.0]]), state)[0])
+        q2 = float(mix(params, jnp.asarray([[1.0, 0.0]]), state)[0])
+        q3 = float(mix(params, jnp.asarray([[1.0, 1.0]]), state)[0])
+        # |W| hypernetworks guarantee dQtot/dQa >= 0
+        assert q2 >= q1 and q3 >= q2
+
+    @pytest.mark.slow
+    def test_qmix_learns_team_switch(self):
+        algo = QMIXConfig(num_agents=2, rollout_length=256,
+                          learning_starts=100, batch_size=32,
+                          epsilon_decay_steps=2000, seed=0).build()
+        for _ in range(10):
+            algo.train()
+        # random play scores ~2/8; the observability ceiling is 4.0
+        recent = float(np.mean(algo._ep_returns[-50:]))
+        assert recent > 3.3, f"QMIX stuck at {recent}"

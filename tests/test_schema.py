@@ -1,0 +1,125 @@
+"""Wire-schema conformance: real control-plane traffic shapes must
+round-trip through the protobuf contract (reference analogue: the
+.proto files under src/ray/protobuf/ ARE the contract; here CI proves
+dict ⇄ proto fidelity so the encoding can flip without caller churn)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.core import schema
+
+
+def roundtrip(m):
+    return schema.decode(schema.encode(m))
+
+
+class TestTaskSpec:
+    def test_plain_task_spec(self):
+        spec = {
+            "task_id": b"T" * 24, "kind": "task", "name": "f",
+            "function_id": "abc123", "num_returns": 2,
+            "return_ids": [b"R1" + b"\0" * 26, b"R2" + b"\0" * 26],
+            "resources": {"CPU": 1.0}, "num_tpus": 0.0,
+            "max_retries": 3, "owner": "driver-1",
+            "args": b"SERIALIZED-TUPLE",
+            "arg_ids": [b"O" * 28],
+            "placement_group": (b"P" * 16, 1),
+        }
+        out = roundtrip({"t": "submit_task", "spec": spec, "reqid": 7})
+        assert out["t"] == "submit_task" and out["reqid"] == 7
+        s = out["spec"]
+        assert s["task_id"] == spec["task_id"]
+        assert s["num_returns"] == 2
+        assert s["resources"] == {"CPU": 1.0}
+        assert s["args"] == b"SERIALIZED-TUPLE"
+        assert s["arg_ids"] == [b"O" * 28]
+        assert s["placement_group"] == (b"P" * 16, 1)
+
+    def test_arg_blob_spill(self):
+        spec = {"task_id": b"T" * 24, "kind": "task", "name": "f",
+                "function_id": "x", "num_returns": 1,
+                "return_ids": [b"R" * 28], "owner": "d",
+                "args": b"", "arg_blob": b"B" * 28,
+                "arg_ids": [b"B" * 28]}
+        s = roundtrip({"t": "submit_task", "spec": spec})["spec"]
+        assert s["arg_blob"] == b"B" * 28 and s["args"] == b""
+
+    def test_dynamic_returns_and_trace(self):
+        spec = {"task_id": b"T" * 24, "kind": "task", "name": "g",
+                "function_id": "f1", "num_returns": "dynamic",
+                "return_ids": [b"R" * 28], "owner": "d",
+                "args": b"",
+                "trace_ctx": {"trace_id": "t" * 32, "span_id": "s" * 16}}
+        s = roundtrip({"t": "submit_task", "spec": spec})["spec"]
+        assert s["num_returns"] == "dynamic"
+        assert s["trace_ctx"]["trace_id"] == "t" * 32
+
+    def test_actor_create_and_task(self):
+        create = {"task_id": b"T" * 24, "kind": "actor_create",
+                  "actor_id": b"A" * 16, "class_name": "Counter",
+                  "methods": ["incr", "get"], "function_id": "cls1",
+                  "num_returns": 0, "return_ids": [], "args": b"",
+                  "max_restarts": 2, "max_concurrency": 4,
+                  "namespace": "ns", "get_if_exists": True}
+        out = roundtrip({"t": "create_actor", "spec": create})
+        assert out["t"] == "create_actor"
+        assert out["spec"]["methods"] == ["incr", "get"]
+        assert out["spec"]["max_concurrency"] == 4
+
+        call = {"task_id": b"T" * 24, "kind": "actor_task",
+                "actor_id": b"A" * 16, "method": "incr", "seq": 9,
+                "num_returns": 1, "return_ids": [b"R" * 28],
+                "owner": "d", "args": b"x"}
+        out = roundtrip({"t": "submit_actor_task", "spec": call})
+        assert out["t"] == "submit_actor_task"
+        assert out["spec"]["method"] == "incr"
+        assert out["spec"]["seq"] == 9
+
+
+class TestMessages:
+    def test_objects_plane(self):
+        m = roundtrip({"t": "put_inline", "object_id": b"O" * 28,
+                       "data": b"\x80\x05bytes", "is_error": False,
+                       "owner": "d", "nested_refs": [b"N" * 28]})
+        assert m["t"] == "put_inline" and m["nested_refs"] == [b"N" * 28]
+
+        m = roundtrip({"t": "get_objects",
+                       "object_ids": [b"A" * 28, b"B" * 28]})
+        assert m["object_ids"] == [b"A" * 28, b"B" * 28]
+
+        m = roundtrip({"t": "wait", "object_ids": [b"A" * 28],
+                       "num_returns": 1, "timeout": None})
+        assert m["timeout"] is None
+        m = roundtrip({"t": "wait", "object_ids": [b"A" * 28],
+                       "num_returns": 1, "timeout": 2.5})
+        assert m["timeout"] == 2.5
+
+    def test_kv_and_pubsub(self):
+        m = roundtrip({"t": "kv_put", "key": b"k", "value": b"v",
+                       "overwrite": True, "namespace": "default"})
+        assert m["key"] == b"k" and m["overwrite"] is True
+        m = roundtrip({"t": "publish", "channel": "logs",
+                       "data": {"line": "hello", "n": np.int64(3)}})
+        assert m["data"]["line"] == "hello"
+
+    def test_heartbeat(self):
+        m = roundtrip({"t": "heartbeat", "node_id": "n1",
+                       "available": {"CPU": 3.5}, "seq": 42})
+        assert m["available"] == {"CPU": 3.5} and m["seq"] == 42
+
+    def test_raw_fallback_long_tail(self):
+        m = roundtrip({"t": "need_space", "nbytes": 1 << 20,
+                       "reqid": 3})
+        assert m["t"] == "need_space" and m["nbytes"] == 1 << 20
+
+    def test_empty_oneof_arm_selected(self):
+        # an all-defaults message must still carry its type
+        m = roundtrip({"t": "get_objects", "object_ids": []})
+        assert m["t"] == "get_objects" and m["object_ids"] == []
+
+
+def test_encoding_is_compact_vs_pickle():
+    import pickle
+    m = {"t": "get_objects", "reqid": 5,
+         "object_ids": [bytes([i] * 28) for i in range(20)]}
+    assert len(schema.encode(m)) < len(pickle.dumps(m, protocol=5))
