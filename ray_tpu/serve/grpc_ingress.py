@@ -1,0 +1,142 @@
+"""gRPC ingress for Serve.
+
+Reference capability: the serve gRPC proxy
+(python/ray/serve/_private/grpc_util.py + proxy gRPC mode,
+src/ray/protobuf/serve.proto): a second ingress protocol next to HTTP,
+for clients that want typed, multiplexed, low-overhead calls.
+
+Implementation note: this image has protoc (message codegen) but not
+the grpc_tools stub generator, so the service is wired with
+grpc.method_handlers_generic_handler over the protoc-generated message
+classes — functionally identical to generated stubs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ray_tpu.serve.http_proxy import _jsonable
+
+_SERVICE = "ray_tpu.serve.RayTpuServe"
+
+
+def _pb():
+    # core.schema already puts ray_tpu/core/generated on sys.path
+    import ray_tpu.core.schema  # noqa: F401 - path bootstrap
+    import serve_pb2
+    return serve_pb2
+
+
+class GrpcIngress:
+    """Serves Predict/Healthz/Routes for a controller's deployments."""
+
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 0, max_workers: int = 16):
+        try:
+            import grpc
+        except ImportError as e:
+            raise ImportError("gRPC ingress requires grpcio") from e
+        from concurrent import futures
+        pb = _pb()
+        self.controller = controller
+        ingress = self
+
+        def predict(request, context):
+            from ray_tpu.serve.handle import DeploymentHandle
+            reply = pb.ServeReply()
+            try:
+                state = ingress.controller.get(request.deployment)
+                handle = DeploymentHandle(state,
+                                          request.method or "__call__")
+                arg = (json.loads(request.payload)
+                       if request.payload else None)
+                # honor the CLIENT's deadline: holding a worker thread
+                # past it just pins the pool for a caller that's gone
+                remaining = context.time_remaining()
+                timeout = (min(remaining, 300.0)
+                           if remaining is not None else 300.0)
+                result = handle.remote(arg).result(timeout=timeout)
+                reply.payload = json.dumps(_jsonable(result)).encode()
+            except Exception as e:  # noqa: BLE001 - wire to client
+                reply.error = f"{type(e).__name__}: {e}"
+            return reply
+
+        def healthz(request, context):
+            return pb.HealthzReply(status="ok")
+
+        def routes(request, context):
+            return pb.RoutesReply(
+                deployments=sorted(ingress.controller.deployments))
+
+        rpcs = {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                predict,
+                request_deserializer=pb.ServeRequest.FromString,
+                response_serializer=pb.ServeReply.SerializeToString),
+            "Healthz": grpc.unary_unary_rpc_method_handler(
+                healthz,
+                request_deserializer=pb.HealthzRequest.FromString,
+                response_serializer=pb.HealthzReply.SerializeToString),
+            "Routes": grpc.unary_unary_rpc_method_handler(
+                routes,
+                request_deserializer=pb.RoutesRequest.FromString,
+                response_serializer=pb.RoutesReply.SerializeToString),
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, rpcs),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+        self.address = f"{host}:{self.port}"
+        self._server.start()
+
+    def stop(self, grace: Optional[float] = 1.0):
+        self._server.stop(grace)
+
+
+class GrpcServeClient:
+    """Typed client (the stub the reference generates; hand-wired here
+    for the same reason as the server)."""
+
+    def __init__(self, address: str):
+        import grpc
+        pb = _pb()
+        self._pb = pb
+        self._channel = grpc.insecure_channel(address)
+        base = f"/{_SERVICE}/"
+        self._predict = self._channel.unary_unary(
+            base + "Predict",
+            request_serializer=pb.ServeRequest.SerializeToString,
+            response_deserializer=pb.ServeReply.FromString)
+        self._healthz = self._channel.unary_unary(
+            base + "Healthz",
+            request_serializer=pb.HealthzRequest.SerializeToString,
+            response_deserializer=pb.HealthzReply.FromString)
+        self._routes = self._channel.unary_unary(
+            base + "Routes",
+            request_serializer=pb.RoutesRequest.SerializeToString,
+            response_deserializer=pb.RoutesReply.FromString)
+
+    def predict(self, deployment: str, data=None, method: str = "",
+                timeout: float = 300.0):
+        req = self._pb.ServeRequest(
+            deployment=deployment, method=method,
+            payload=json.dumps(data).encode() if data is not None
+            else b"")
+        reply = self._predict(req, timeout=timeout)
+        if reply.error:
+            raise RuntimeError(reply.error)
+        return json.loads(reply.payload) if reply.payload else None
+
+    def healthz(self, timeout: float = 10.0) -> str:
+        return self._healthz(self._pb.HealthzRequest(),
+                             timeout=timeout).status
+
+    def routes(self, timeout: float = 10.0) -> list:
+        return list(self._routes(self._pb.RoutesRequest(),
+                                 timeout=timeout).deployments)
+
+    def close(self):
+        self._channel.close()

@@ -9,7 +9,7 @@ from ray_tpu.tune.callback import (Callback, CSVLoggerCallback,
                                    TensorBoardLoggerCallback)
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      HyperBandScheduler,
-                                     MedianStoppingRule,
+                                     MedianStoppingRule, PB2,
                                      PopulationBasedTraining,
                                      TrialScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
@@ -43,7 +43,7 @@ __all__ = [
     "choice", "uniform", "loguniform", "randint", "grid_search",
     "BasicVariantGenerator", "ConcurrencyLimiter", "Searcher",
     "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining", "TrialScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining", "PB2", "TrialScheduler",
     "Callback", "CSVLoggerCallback", "JSONLoggerCallback",
     "TensorBoardLoggerCallback",
 ]

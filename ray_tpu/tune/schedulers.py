@@ -148,6 +148,97 @@ class PopulationBasedTraining(TrialScheduler):
         self._scores.pop(trial.trial_id, None)
 
 
+class PB2(PopulationBasedTraining):
+    """PB2: population-based bandits (reference: tune/schedulers/pb2.py,
+    Parker-Holder et al. 2020). PBT's exploit step, but explore selects
+    new hyperparameters by a GP-UCB bandit fit on observed
+    (hyperparams → reward change) data instead of random perturbation —
+    sample-efficient for small populations.
+
+    ``hyperparam_bounds`` maps key → (low, high) continuous bounds; the
+    GP runs on unit-normalized inputs with an RBF kernel (the same
+    dependency-free GP machinery as tune/suggest.py GPSearcher).
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[dict] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.0,
+                 n_candidates: int = 128,
+                 length_scale: float = 0.2,
+                 seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        # GP data: rows of (normalized config vector, reward delta)
+        self._X: list = []
+        self._y: list = []
+        self._prev_score: dict[str, float] = {}
+
+    # -- data collection ---------------------------------------------------
+    def _norm(self, config: dict) -> list:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / max(hi - lo, 1e-12))
+        return out
+
+    def on_result(self, trial, result) -> str:
+        v = result.get(self.metric)
+        if v is not None:
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None:
+                delta = float(v) - prev
+                if self.mode == "min":
+                    delta = -delta          # larger = better, always
+                self._X.append(self._norm(trial.config))
+                self._y.append(delta)
+                if len(self._X) > 512:      # _explore reads the tail only
+                    del self._X[:-512]
+                    del self._y[:-512]
+            self._prev_score[trial.trial_id] = float(v)
+        decision = super().on_result(trial, result)
+        if trial.trial_id in self.pending_exploits:
+            # the next delta would include the exploit's checkpoint jump
+            # — attributing it to the new config would poison the GP
+            self._prev_score.pop(trial.trial_id, None)
+        return decision
+
+    def on_complete(self, trial, result):
+        self._prev_score.pop(trial.trial_id, None)
+        super().on_complete(trial, result)
+
+    # -- GP-UCB explore -----------------------------------------------------
+    def _explore(self, config: dict) -> dict:
+        import numpy as np
+
+        from ray_tpu.tune.suggest import gp_posterior
+        out = dict(config)
+        if len(self._y) < 4:
+            for k, (lo, hi) in self.bounds.items():
+                out[k] = lo + self.rng.random() * (hi - lo)
+            return out
+        X = np.asarray(self._X[-256:])
+        y = np.asarray(self._y[-256:])
+        cands = np.asarray(
+            [[self.rng.random() for _ in self.bounds]
+             for _ in range(self.n_candidates)])
+        mu, sigma, _ = gp_posterior(X, y, cands, self.length_scale)
+        best = cands[int(np.argmax(mu + self.kappa * sigma))]
+        for i, (k, (lo, hi)) in enumerate(self.bounds.items()):
+            out[k] = lo + float(best[i]) * (hi - lo)
+        return out
+
+
 class MedianStoppingRule(TrialScheduler):
     """Stop trials whose running-average metric falls below the median
     of the running averages of all trials at the same iteration

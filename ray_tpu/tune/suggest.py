@@ -21,6 +21,32 @@ import numpy as np
 from ray_tpu.tune.search import (Choice, Domain, GridSearch, LogUniform,
                                  RandInt, Searcher, Uniform)
 
+# -- shared GP machinery ----------------------------------------------------
+
+
+def gp_posterior(X: np.ndarray, y: np.ndarray, cands: np.ndarray,
+                 length_scale: float, noise: float = 1e-4):
+    """RBF-kernel GP posterior at candidate points.
+
+    Returns (mu, sigma) of the normalized-target posterior plus the
+    normalization (mean, sd) so callers can invert it. Shared by
+    GPSearcher (EI) and the PB2 scheduler (UCB) — one copy of the
+    kernel/solve math."""
+    mu0, sd = float(y.mean()), max(float(y.std()), 1e-9)
+    yn = (y - mu0) / sd
+
+    def kernel(a, b):
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / length_scale ** 2)
+
+    K = kernel(X, X) + noise * np.eye(len(X))
+    Kinv = np.linalg.inv(K)
+    Kc = kernel(cands, X)
+    mu = Kc @ (Kinv @ yn)
+    var = np.maximum(1.0 - np.einsum("ij,jk,ik->i", Kc, Kinv, Kc), 1e-12)
+    return mu, np.sqrt(var), (mu0, sd)
+
+
 # -- space flattening -------------------------------------------------------
 
 
@@ -257,26 +283,14 @@ class GPSearcher(_ModelSearcher):
                 parts.append(np.array([units[k]]))
         return np.concatenate(parts)
 
-    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
-        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
-        return np.exp(-0.5 * d2 / self.length_scale ** 2)
-
     def _model_units(self) -> dict[tuple, float]:
         X = np.stack([self._vec(u) for u, _ in self._obs])
         y = np.array([s for _, s in self._obs])
-        mu0, sd = y.mean(), max(y.std(), 1e-9)
-        yn = (y - mu0) / sd
-        K = self._kernel(X, X) + self.noise * np.eye(len(X))
-        Kinv_y = np.linalg.solve(K, yn)
-        Kinv = np.linalg.inv(K)
-
         cands = [self._random_units() for _ in range(self.n_candidates)]
         Xc = np.stack([self._vec(u) for u in cands])
-        Kc = self._kernel(Xc, X)
-        mu = Kc @ Kinv_y
-        var = np.maximum(1.0 - np.einsum("ij,jk,ik->i", Kc, Kinv, Kc), 1e-12)
-        sigma = np.sqrt(var)
-        best = yn.min()
+        mu, sigma, (mu0, sd) = gp_posterior(X, y, Xc,
+                                            self.length_scale, self.noise)
+        best = (y.min() - mu0) / sd
         z = (best - mu) / sigma
         phi = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
         Phi = 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))

@@ -1,0 +1,55 @@
+"""Serve gRPC ingress tests (reference test model:
+python/ray/serve/tests/test_grpc.py)."""
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from ray_tpu import serve  # noqa: E402
+from ray_tpu.serve.grpc_ingress import GrpcIngress, GrpcServeClient  # noqa: E402
+
+
+@serve.deployment
+class Adder:
+    def __call__(self, x):
+        return {"sum": x["a"] + x["b"]}
+
+    def scale(self, x):
+        return [v * 10 for v in x]
+
+
+@pytest.fixture
+def grpc_serve():
+    serve.run(Adder.bind())
+    ingress = GrpcIngress(serve._get_controller(), port=0)
+    client = GrpcServeClient(ingress.address)
+    yield client
+    client.close()
+    ingress.stop()
+    serve.shutdown()
+
+
+def test_predict_roundtrip(grpc_serve):
+    out = grpc_serve.predict("Adder", {"a": 2, "b": 40})
+    assert out == {"sum": 42}
+
+
+def test_method_dispatch(grpc_serve):
+    assert grpc_serve.predict("Adder", [1, 2, 3],
+                              method="scale") == [10, 20, 30]
+
+
+def test_healthz_and_routes(grpc_serve):
+    assert grpc_serve.healthz() == "ok"
+    assert grpc_serve.routes() == ["Adder"]
+
+
+def test_error_surface(grpc_serve):
+    with pytest.raises(RuntimeError, match="KeyError|no deployment|Error"):
+        grpc_serve.predict("NoSuchDeployment", {})
+
+
+def test_request_metrics_count_grpc(grpc_serve):
+    for i in range(3):
+        grpc_serve.predict("Adder", {"a": i, "b": i})
+    assert serve.status()["Adder"]["requests"] >= 3
