@@ -19,8 +19,12 @@ from ray_tpu.rllib.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput
+from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, SpreadLine
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TeamSwitch
 from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
+from ray_tpu.rllib.rl_module import (DiscretePGModule, Learner,
+                                     LearnerGroup, MultiRLModule,
+                                     RLModule)
 from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, vtrace
 from ray_tpu.rllib.multi_agent import (MultiAgentCartPole, MultiAgentEnv,
@@ -59,7 +63,9 @@ __all__ = [
     "MeanStdFilter", "FrameStack", "ClipReward", "ClipActions",
     "UnsquashActions", "PolicyClient", "PolicyServerInput",
     "SimpleQ", "SimpleQConfig", "R2D2", "R2D2Config", "QMIX",
-    "QMIXConfig", "TeamSwitch",
+    "QMIXConfig", "TeamSwitch", "MADDPG", "MADDPGConfig", "SpreadLine",
+    "RLModule", "MultiRLModule", "DiscretePGModule", "Learner",
+    "LearnerGroup",
 ]
 
 from ray_tpu import usage_stats as _usage_stats

@@ -1,0 +1,247 @@
+"""Next-gen API stack: RLModule + Learner + LearnerGroup.
+
+Reference capability: rllib/core/ (rl_module/rl_module.py RLModule,
+marl_module.py MultiRLModule, rl_trainer/rl_trainer.py:76 the Learner,
+rl_trainer/trainer_runner.py:38 the LearnerGroup) — the reference's
+"new API stack": the neural-net piece (RLModule) is separated from the
+update loop (Learner), which is separated from distribution
+(LearnerGroup), so algorithms compose instead of subclassing Policy.
+
+TPU shape: an RLModule is a pure pytree + jitted forward functions
+(the natural jax decomposition — no torch Module statefulness); the
+Learner owns one jitted update program; the LearnerGroup fans
+minibatches over core-runtime actors with parameter averaging (DP over
+learners), or runs inline when no runtime is up.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class RLModule:
+    """The neural-network piece (reference: rl_module.py RLModule —
+    forward_inference/_exploration/_train over batch dicts)."""
+
+    def init_params(self, rng) -> Any:
+        raise NotImplementedError
+
+    def forward_inference(self, params, batch: Dict) -> Dict:
+        """Greedy/deterministic outputs for serving."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, batch: Dict) -> Dict:
+        """Sampling outputs for rollouts (default: same as inference)."""
+        return self.forward_inference(params, batch)
+
+    def forward_train(self, params, batch: Dict) -> Dict:
+        """Outputs the loss needs (logits, values, ...)."""
+        raise NotImplementedError
+
+    def loss(self, params, batch: Dict) -> jnp.ndarray:
+        """Scalar loss (the Learner differentiates this)."""
+        raise NotImplementedError
+
+
+class DiscretePGModule(RLModule):
+    """Actor-critic module over the shared policy nets (the analogue of
+    the reference's default PPO RLModule)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens=(64, 64), vf_coeff: float = 0.5,
+                 ent_coeff: float = 0.01):
+        from ray_tpu.rllib.policy import PolicyConfig
+        self.cfg = PolicyConfig(obs_dim=obs_dim, num_actions=num_actions,
+                                hiddens=tuple(hiddens))
+        self.vf_coeff = vf_coeff
+        self.ent_coeff = ent_coeff
+
+    def init_params(self, rng):
+        from ray_tpu.rllib.policy import init_policy_params
+        return init_policy_params(self.cfg, rng)
+
+    def forward_inference(self, params, batch):
+        from ray_tpu.rllib.policy import policy_forward
+        logits, value = policy_forward(params, batch["obs"])
+        return {"actions": jnp.argmax(logits, axis=-1),
+                "logits": logits, "vf": value}
+
+    def forward_exploration(self, params, batch):
+        from ray_tpu.rllib.policy import policy_forward
+        logits, value = policy_forward(params, batch["obs"])
+        actions = jax.random.categorical(batch["rng"], logits, axis=-1)
+        logp = jnp.take_along_axis(jax.nn.log_softmax(logits),
+                                   actions[:, None], 1)[:, 0]
+        return {"actions": actions, "logp": logp, "vf": value}
+
+    def forward_train(self, params, batch):
+        from ray_tpu.rllib.policy import policy_forward
+        logits, value = policy_forward(params, batch["obs"])
+        return {"logits": logits, "vf": value}
+
+    def loss(self, params, batch):
+        out = self.forward_train(params, batch)
+        logp_all = jax.nn.log_softmax(out["logits"])
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], 1)[:, 0]
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pi_loss = -jnp.mean(logp * adv)
+        vf_loss = jnp.mean((out["vf"] - batch["value_targets"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        return pi_loss + self.vf_coeff * vf_loss \
+            - self.ent_coeff * entropy
+
+
+class MultiRLModule(RLModule):
+    """Policy-id → RLModule container (reference: marl_module.py)."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        self.modules = dict(modules)
+
+    def init_params(self, rng):
+        keys = jax.random.split(rng, len(self.modules))
+        return {pid: m.init_params(k)
+                for (pid, m), k in zip(sorted(self.modules.items()),
+                                       keys)}
+
+    def forward_inference(self, params, batch):
+        return {pid: self.modules[pid].forward_inference(
+                    params[pid], batch[pid])
+                for pid in batch}
+
+    def forward_exploration(self, params, batch):
+        # delegate per sub-module: the base-class fallback would turn
+        # exploration into greedy inference and drop sampled logp
+        return {pid: self.modules[pid].forward_exploration(
+                    params[pid], batch[pid])
+                for pid in batch}
+
+    def forward_train(self, params, batch):
+        return {pid: self.modules[pid].forward_train(
+                    params[pid], batch[pid])
+                for pid in batch}
+
+    def loss(self, params, batch):
+        losses = [self.modules[pid].loss(params[pid], batch[pid])
+                  for pid in batch]
+        return jnp.mean(jnp.stack(losses))
+
+
+class Learner:
+    """Owns one module's params + optimizer + jitted update
+    (reference: rl_trainer.py:76)."""
+
+    def __init__(self, module: RLModule, *, lr: float = 3e-4,
+                 optimizer: Optional[Any] = None, seed: int = 0):
+        self.module = module
+        self.tx = optimizer if optimizer is not None else optax.adam(lr)
+        self.params = module.init_params(jax.random.PRNGKey(seed))
+        self.opt_state = self.tx.init(self.params)
+
+        @jax.jit
+        def _update(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(module.loss)(params, batch)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = _update
+
+    def update(self, batch: Dict) -> Dict:
+        # tree-map: multi-module batches nest dicts per policy id
+        jb = jax.tree.map(jnp.asarray, batch)
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, jb)
+        return {"loss": float(loss)}
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class LearnerGroup:
+    """Fan updates over N learners (reference: trainer_runner.py:38).
+    Distributed mode shards each batch across learner ACTORS and
+    averages the resulting parameters (synchronous DP); inline mode is
+    one local learner."""
+
+    def __init__(self, module_factory: Callable[[], RLModule],
+                 num_learners: int = 0, *, lr: float = 3e-4,
+                 seed: int = 0):
+        import ray_tpu
+        self._distributed = (num_learners > 0
+                             and ray_tpu.is_initialized())
+        if not self._distributed:
+            self._local = Learner(module_factory(), lr=lr, seed=seed)
+            self.num_learners = 1
+        else:
+            Actor = ray_tpu.remote(Learner)
+            # same seed: all learners start from identical params, and
+            # parameter averaging keeps them in lockstep thereafter
+            self._learners = [
+                Actor.remote(module_factory(), lr=lr, seed=seed)
+                for _ in range(num_learners)]
+            self.num_learners = num_learners
+
+    @staticmethod
+    def _rows(batch: Dict) -> int:
+        leaves = jax.tree.leaves(batch)
+        return min(len(v) for v in leaves) if leaves else 0
+
+    @staticmethod
+    def _slice(batch: Dict, lo: int, hi: int) -> Dict:
+        # tree-map so MultiRLModule's nested per-policy dicts shard too
+        return jax.tree.map(lambda v: v[lo:hi], batch)
+
+    def update(self, batch: Dict) -> Dict:
+        if not self._distributed:
+            return self._local.update(batch)
+        import ray_tpu
+        n = self.num_learners
+        rows = self._rows(batch)
+        refs = []
+        if rows < n:
+            # too few rows to shard: every learner sees the full batch
+            # (an empty shard would mean NaN losses that the parameter
+            # averaging below would spread to the whole group)
+            refs = [l.update.remote(batch) for l in self._learners]
+        else:
+            bounds = np.linspace(0, rows, n + 1, dtype=int)
+            for i in range(n):
+                refs.append(self._learners[i].update.remote(
+                    self._slice(batch, int(bounds[i]),
+                                int(bounds[i + 1]))))
+        results = ray_tpu.get(refs, timeout=600)
+        # parameter averaging (sync DP)
+        weights = ray_tpu.get(
+            [l.get_weights.remote() for l in self._learners],
+            timeout=600)
+        avg = jax.tree.map(
+            lambda *ws: np.mean(np.stack(ws), axis=0), *weights)
+        ray_tpu.get([l.set_weights.remote(avg)
+                     for l in self._learners], timeout=600)
+        return {"loss": float(np.mean([r["loss"] for r in results]))}
+
+    def get_weights(self):
+        if not self._distributed:
+            return self._local.get_weights()
+        import ray_tpu
+        return ray_tpu.get(self._learners[0].get_weights.remote(),
+                           timeout=600)
+
+    def stop(self):
+        if self._distributed:
+            import ray_tpu
+            for l in self._learners:
+                try:
+                    ray_tpu.kill(l)
+                except Exception:  # noqa: BLE001
+                    pass

@@ -1,0 +1,49 @@
+"""MADDPG tests (reference test model:
+rllib/algorithms/maddpg/tests/test_maddpg.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, SpreadLine
+
+
+def test_spread_line_env_contract():
+    env = SpreadLine(num_agents=3, seed=0)
+    obs = env.reset()
+    assert len(obs) == 3 and obs["agent_0"].shape == (4,)
+    o, r, d, _ = env.step({a: np.asarray([0.5]) for a in env.agent_ids})
+    # shared (cooperative) reward
+    assert len(set(r.values())) == 1
+    assert "__all__" in d
+
+
+def test_maddpg_step_and_checkpoint():
+    algo = MADDPGConfig(num_agents=2, rollout_length=64,
+                        learning_starts=32, batch_size=16,
+                        seed=0).build()
+    r = algo.train()
+    assert r["steps_this_iter"] == 64 and r["buffer_size"] == 64
+    assert np.isfinite(r["critic_loss"])
+    import jax
+    ck = algo.save_checkpoint()
+    before = jax.tree.map(np.asarray, algo.state)
+    algo.train()
+    algo.load_checkpoint(ck)
+    after = jax.tree.map(np.asarray, algo.state)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_allclose(a, b)
+
+
+@pytest.mark.slow
+def test_maddpg_improves_coverage():
+    algo = MADDPGConfig(num_agents=2, rollout_length=200,
+                        learning_starts=200, batch_size=64,
+                        seed=0).build()
+    returns = []
+    for _ in range(8):
+        algo.train()
+        if algo._ep_returns:
+            returns.append(float(np.mean(algo._ep_returns[-20:])))
+    # centralized critics should beat the random-walk baseline clearly
+    assert returns[-1] > returns[0] + 3.0, \
+        f"MADDPG no improvement: {returns[0]} -> {returns[-1]}"
