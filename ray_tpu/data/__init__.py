@@ -24,12 +24,15 @@ read_text = Dataset.read_text
 read_binary_files = Dataset.read_binary_files
 read_tfrecords = Dataset.read_tfrecords
 read_images = Dataset.read_images
+read_webdataset = Dataset.read_webdataset
+read_mongo = Dataset.read_mongo
 
 __all__ = [
     "Dataset", "DatasetPipeline", "GroupedData", "AggregateFn", "Count",
     "Sum", "Min", "Max", "block", "from_items", "range", "from_numpy",
     "from_pandas", "read_csv", "read_parquet", "read_json", "read_numpy",
     "read_text", "read_binary_files", "read_tfrecords", "read_images",
+    "read_webdataset", "read_mongo",
     "Preprocessor", "BatchMapper",
     "Chain", "StandardScaler", "MinMaxScaler", "LabelEncoder",
     "Concatenator", "Normalizer", "OneHotEncoder", "RobustScaler",

@@ -204,6 +204,29 @@ class Dataset:
             or [{}])
 
     @staticmethod
+    def read_webdataset(paths: Union[str, list[str]], *,
+                        decode_images: bool = True) -> "Dataset":
+        """WebDataset tar shards → Dataset (reference:
+        datasource/webdataset_datasource.py)."""
+        from ray_tpu.data.datasource import read_webdataset_blocks
+        return Dataset(
+            read_webdataset_blocks(Dataset._expand_paths(paths),
+                                   decode_images=decode_images) or [{}])
+
+    def write_webdataset(self, dir_path: str) -> list[str]:
+        from ray_tpu.data.datasource import write_webdataset_blocks
+        return write_webdataset_blocks(self._materialize(), dir_path)
+
+    @staticmethod
+    def read_mongo(uri: str, database: str, collection: str, *,
+                   query: Optional[dict] = None) -> "Dataset":
+        """MongoDB → Dataset (reference:
+        datasource/mongo_datasource.py; gated on pymongo)."""
+        from ray_tpu.data.datasource import read_mongo_blocks
+        return Dataset(read_mongo_blocks(uri, database, collection,
+                                         query=query) or [{}])
+
+    @staticmethod
     def read_parquet(paths: Union[str, list[str]], *,
                      block_format: str = "arrow") -> "Dataset":
         """Parquet files → one block per file (reference:

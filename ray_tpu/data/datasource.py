@@ -299,6 +299,192 @@ def write_tfrecords_blocks(blocks: Iterable[dict], dir_path: str
     return out
 
 
+# ========================================================================
+# WebDataset-style tar shards + mongo (gated)
+# ========================================================================
+
+def read_webdataset_blocks(paths: List[str],
+                           decode_images: bool = True) -> List[dict]:
+    """WebDataset tar shards → one block per shard (reference:
+    datasource/webdataset_datasource.py). Samples are groups of tar
+    members sharing a basename; the extension names the column
+    (.jpg/.png decode to uint8 tensors when PIL is present, .cls/.txt
+    to scalars/strings, .json to dicts, anything else stays bytes)."""
+    import io
+    import json as _json
+    import tarfile
+
+    try:
+        from PIL import Image
+    except ImportError:
+        Image = None
+
+    blocks = []
+    for p in paths:
+        samples: Dict[str, dict] = {}
+        order: List[str] = []
+        with tarfile.open(p) as tf:
+            for member in tf.getmembers():
+                if not member.isfile():
+                    continue
+                name = member.name
+                while name.startswith("./"):   # `tar -cf x.tar .` names
+                    name = name[2:]
+                base, _, suffix = name.partition(".")
+                if not suffix:
+                    continue
+                raw = tf.extractfile(member).read()
+                if base not in samples:
+                    samples[base] = {"__key__": base}
+                    order.append(base)
+                # column = suffix minus the trailing type extension
+                # ("caption.txt" -> column "caption" typed txt; a plain
+                # "jpg" suffix is both column and type, wds-style)
+                parts = suffix.lower().split(".")
+                type_ext = parts[-1]
+                column = ".".join(parts[:-1]) or type_ext
+                if type_ext in ("jpg", "jpeg", "png") and decode_images \
+                        and Image is not None:
+                    with Image.open(io.BytesIO(raw)) as im:
+                        val = np.asarray(im.convert("RGB"), np.uint8)
+                elif type_ext in ("cls", "id"):
+                    val = int(raw)
+                elif type_ext in ("txt",):
+                    val = raw.decode()
+                elif type_ext == "json":
+                    val = _json.loads(raw)
+                else:
+                    val = raw
+                samples[base][column] = val
+        if not order:
+            continue
+        keys: Dict[str, None] = {}
+        for b in order:
+            for k in samples[b]:
+                keys.setdefault(k)
+        cols: Dict[str, list] = {k: [samples[b].get(k) for b in order]
+                                 for k in keys}
+        block = {}
+        for k, vs in cols.items():
+            try:
+                block[k] = np.asarray(vs)
+            except Exception:  # ragged
+                a = np.empty(len(vs), object)
+                a[:] = vs
+                block[k] = a
+        blocks.append(block)
+    return blocks
+
+
+def write_webdataset_blocks(blocks: Iterable[dict], dir_path: str,
+                            samples_per_shard: int = 10_000
+                            ) -> List[str]:
+    """Column dicts → WebDataset tar shards (inverse of the reader:
+    ndarray image columns → .png, ints → .cls, strings → .txt,
+    dicts → .json, bytes → .bin)."""
+    import io
+    import json as _json
+    import tarfile
+
+    from ray_tpu.data.block import to_columns
+    os.makedirs(dir_path, exist_ok=True)
+    out = []
+    idx = 0
+    shard_i = 0
+    for blk in blocks:
+        cols = to_columns(blk)
+        names = [k for k in cols if k != "__key__"]
+        n = len(next(iter(cols.values()))) if cols else 0
+        for lo in range(0, max(n, 1), samples_per_shard):
+            hi = min(n, lo + samples_per_shard)
+            path = os.path.join(dir_path, f"shard-{shard_i:05d}.tar")
+            shard_i += 1
+            with tarfile.open(path, "w") as tf:
+                for j in range(lo, hi):
+                    key = (str(cols["__key__"][j]) if "__key__" in cols
+                           else f"{idx:08d}")
+                    idx += 1
+                    for k in names:
+                        v = cols[k][j]
+                        if isinstance(v, np.ndarray) \
+                                and v.dtype == np.uint8 and v.ndim == 3:
+                            try:
+                                from PIL import Image
+                                buf = io.BytesIO()
+                                Image.fromarray(v).save(buf,
+                                                        format="PNG")
+                                raw, ext = buf.getvalue(), "png"
+                            except ImportError:
+                                raw, ext = v.tobytes(), "bin"
+                        elif isinstance(v, (bool, np.bool_)):
+                            raw, ext = str(int(v)).encode(), "cls"
+                        elif isinstance(v, (int, np.integer)):
+                            raw, ext = str(int(v)).encode(), "cls"
+                        elif isinstance(v, str):
+                            raw, ext = v.encode(), "txt"
+                        elif isinstance(v, dict):
+                            raw, ext = _json.dumps(v).encode(), "json"
+                        elif isinstance(v, bytes):
+                            raw, ext = v, "bin"
+                        else:
+                            raw, ext = _json.dumps(
+                                np.asarray(v).tolist()).encode(), "json"
+                        # member = key.<column>.<type-ext>; when the
+                        # column IS the type ext (wds convention), keep
+                        # the short key.<ext> form so plain wds shards
+                        # round-trip unchanged
+                        member_name = (f"{key}.{ext}" if k == ext
+                                       else f"{key}.{k}.{ext}")
+                        info = tarfile.TarInfo(member_name)
+                        info.size = len(raw)
+                        tf.addfile(info, io.BytesIO(raw))
+            out.append(path)
+    return out
+
+
+def read_mongo_blocks(uri: str, database: str, collection: str,
+                      query: Optional[dict] = None,
+                      block_rows: int = 10_000) -> List[dict]:
+    """MongoDB collection → blocks (reference:
+    datasource/mongo_datasource.py). Gated on pymongo."""
+    try:
+        import pymongo
+    except ImportError as e:
+        raise ImportError(
+            "read_mongo requires the `pymongo` package; it is not "
+            "installed in this environment") from e
+    client = pymongo.MongoClient(uri)
+    cursor = client[database][collection].find(
+        query or {}, batch_size=block_rows)
+
+    def chunk_to_block(chunk):
+        keys: Dict[str, None] = {}
+        for r in chunk:
+            for k in r:
+                keys.setdefault(k)
+        block = {}
+        for k in keys:
+            vs = [r.get(k) for r in chunk]
+            try:
+                block[k] = np.asarray(vs)
+            except Exception:
+                a = np.empty(len(vs), object)
+                a[:] = vs
+                block[k] = a
+        return block
+
+    # stream the cursor: peak memory is one block, not the collection
+    blocks, chunk = [], []
+    for row in cursor:
+        chunk.append(row)
+        if len(chunk) >= block_rows:
+            blocks.append(chunk_to_block(chunk))
+            chunk = []
+    if chunk:
+        blocks.append(chunk_to_block(chunk))
+    return blocks
+
+
 _IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
 
 

@@ -19,6 +19,7 @@ from ray_tpu.rllib.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput
+from ray_tpu.rllib.dt import DT, DTConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, SpreadLine
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TeamSwitch
 from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
@@ -65,7 +66,7 @@ __all__ = [
     "SimpleQ", "SimpleQConfig", "R2D2", "R2D2Config", "QMIX",
     "QMIXConfig", "TeamSwitch", "MADDPG", "MADDPGConfig", "SpreadLine",
     "RLModule", "MultiRLModule", "DiscretePGModule", "Learner",
-    "LearnerGroup",
+    "LearnerGroup", "DT", "DTConfig",
 ]
 
 from ray_tpu import usage_stats as _usage_stats

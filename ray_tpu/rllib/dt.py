@@ -1,0 +1,292 @@
+"""DT: Decision Transformer — offline RL as sequence modeling.
+
+Reference capability: rllib/algorithms/dt/ (dt.py,
+dt_torch_model.py — Chen et al. 2021): trajectories become sequences
+of (return-to-go, state, action) tokens; a causal transformer is
+trained supervised to predict the action at each state token;
+evaluation conditions on a target return and unrolls autoregressively.
+
+TPU redesign: the full model — modality embeddings, interleaving to a
+3K token stream, causal multi-head attention, action head — is one
+jitted program of static shapes (context length K fixed); offline
+trajectory segmentation/return-to-go computation is host-side numpy
+over the same offline JSON format the BC/MARWIL/CQL family reads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.offline import JsonReader
+
+
+@dataclass
+class DTConfig(AlgorithmConfig):
+    input_path: str = ""
+    context_len: int = 20           # K state tokens (3K transformer slots)
+    d_model: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    target_return: float = 400.0    # eval conditioning
+    batch_size: int = 64
+    grad_steps_per_iter: int = 100
+    lr: float = 1e-3
+    weight_decay: float = 1e-4
+    max_episode_steps: int = 500
+
+    def build(self, algo_cls=None) -> "DT":
+        return DT({"_config": self})
+
+
+# -- trajectory prep -------------------------------------------------------
+
+def segment_episodes(data: dict) -> List[dict]:
+    """Flat (obs, actions, rewards, dones) columns → per-episode dicts
+    with returns-to-go."""
+    obs = np.asarray(data["obs"], np.float32)
+    acts = np.asarray(data["actions"], np.int64)
+    rews = np.asarray(data["rewards"], np.float32)
+    dones = np.asarray(data["dones"], np.float32)
+    episodes, start = [], 0
+    for i in range(len(rews)):
+        if dones[i] > 0.5 or i == len(rews) - 1:
+            r = rews[start:i + 1]
+            rtg = np.cumsum(r[::-1])[::-1].astype(np.float32)
+            episodes.append({"obs": obs[start:i + 1],
+                             "actions": acts[start:i + 1],
+                             "rtg": rtg,
+                             "timesteps": np.arange(i + 1 - start)})
+            start = i + 1
+    return episodes
+
+
+# -- model -----------------------------------------------------------------
+
+def init_dt_params(cfg: DTConfig, obs_dim: int, num_actions: int, rng,
+                   max_timestep: int = 4096):
+    d = cfg.d_model
+    ks = iter(jax.random.split(rng, 8 + 4 * cfg.n_layers))
+
+    def dense(k, i, o, scale=None):
+        s = scale if scale is not None else np.sqrt(2.0 / i)
+        return {"w": (jax.random.normal(k, (i, o)) * s
+                      ).astype(jnp.float32),
+                "b": jnp.zeros((o,), jnp.float32)}
+
+    params = {
+        "emb_rtg": dense(next(ks), 1, d),
+        "emb_obs": dense(next(ks), obs_dim, d),
+        "emb_act": (jax.random.normal(next(ks), (num_actions + 1, d))
+                    * 0.02).astype(jnp.float32),   # +1 = padding token
+        "emb_t": (jax.random.normal(next(ks), (max_timestep, d))
+                  * 0.02).astype(jnp.float32),
+        "head": dense(next(ks), d, num_actions, scale=0.01),
+        "ln_f": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "ln1": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "qkv": dense(next(ks), d, 3 * d),
+            "proj": dense(next(ks), d, d, scale=0.01),
+            "ln2": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "up": dense(next(ks), d, 4 * d),
+            "down": dense(next(ks), 4 * d, d, scale=0.01),
+        })
+    return params
+
+
+def _ln(x, p):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * p["scale"] + p["bias"]
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def dt_forward(params, cfg: DTConfig, rtg, obs, actions, timesteps):
+    """rtg [B,K], obs [B,K,O], actions [B,K] (shifted: a_{t-1} feeds
+    slot t; index num_actions = pad), timesteps [B,K] → action logits
+    at each state token [B,K,A]."""
+    B, K = rtg.shape
+    d, h = cfg.d_model, cfg.n_heads
+    hd = d // h
+    t_emb = params["emb_t"][timesteps]                       # [B,K,d]
+    e_rtg = _dense(params["emb_rtg"], rtg[..., None]) + t_emb
+    e_obs = _dense(params["emb_obs"], obs) + t_emb
+    e_act = params["emb_act"][actions] + t_emb
+    # interleave (rtg_t, obs_t, act_t) → [B, 3K, d]
+    x = jnp.stack([e_rtg, e_obs, e_act], axis=2).reshape(B, 3 * K, d)
+    T = 3 * K
+    mask = jnp.tril(jnp.ones((T, T), bool))
+
+    for lp in params["layers"]:
+        y = _ln(x, lp["ln1"])
+        qkv = _dense(lp["qkv"], y).reshape(B, T, 3, h, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(hd)
+        att = jnp.where(mask[None, None], att, -1e9)
+        att = jax.nn.softmax(att, axis=-1)
+        o = jnp.einsum("bhts,bshd->bthd", att, v).reshape(B, T, d)
+        x = x + _dense(lp["proj"], o)
+        y = _ln(x, lp["ln2"])
+        x = x + _dense(lp["down"], jax.nn.gelu(_dense(lp["up"], y)))
+
+    x = _ln(x, params["ln_f"])
+    state_tokens = x.reshape(B, K, 3, d)[:, :, 1]            # obs slots
+    return _dense(params["head"], state_tokens)              # [B,K,A]
+
+
+class DT(Algorithm):
+    _default_config = DTConfig
+
+    def _build(self):
+        cfg = self.config
+        if not cfg.input_path:
+            raise ValueError("DT requires config.input_path offline data")
+        data = JsonReader(cfg.input_path).read_all()
+        self.episodes = segment_episodes(data)
+        if not self.episodes:
+            raise ValueError("no episodes in offline data")
+        self.obs_dim = self.episodes[0]["obs"].shape[1]
+        self.num_actions = int(max(e["actions"].max()
+                                   for e in self.episodes)) + 1
+        # size the timestep table to the data + eval horizon: jax
+        # clamps out-of-bounds gathers silently, which would alias all
+        # late positions onto one embedding
+        max_t = max(max(len(e["actions"]) for e in self.episodes),
+                    cfg.max_episode_steps) + 1
+        self.params = init_dt_params(cfg, self.obs_dim, self.num_actions,
+                                     jax.random.PRNGKey(cfg.seed),
+                                     max_timestep=max(4096, max_t))
+        self.tx = optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(cfg.seed)
+        # sample episodes length-weighted (reference: dt.py traj sampling)
+        lens = np.asarray([len(e["actions"]) for e in self.episodes],
+                          np.float64)
+        self._ep_p = lens / lens.sum()
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            def loss_fn(p):
+                logits = dt_forward(p, cfg, batch["rtg"], batch["obs"],
+                                    batch["prev_actions"],
+                                    batch["timesteps"])
+                logp = jax.nn.log_softmax(logits)
+                gold = jnp.take_along_axis(
+                    logp, batch["actions"][..., None], 2)[..., 0]
+                return -jnp.sum(gold * batch["mask"]) \
+                    / jnp.maximum(batch["mask"].sum(), 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = update
+        self._forward = jax.jit(
+            lambda p, rtg, obs, acts, ts: dt_forward(
+                p, cfg, rtg, obs, acts, ts))
+
+    def _sample_batch(self) -> dict:
+        cfg = self.config
+        K, B = cfg.context_len, cfg.batch_size
+        rtg = np.zeros((B, K), np.float32)
+        obs = np.zeros((B, K, self.obs_dim), np.float32)
+        acts = np.zeros((B, K), np.int64)
+        prev = np.full((B, K), self.num_actions, np.int64)  # pad token
+        ts = np.zeros((B, K), np.int64)
+        mask = np.zeros((B, K), np.float32)
+        idx = self._rng.choice(len(self.episodes), B, p=self._ep_p)
+        for b, ei in enumerate(idx):
+            ep = self.episodes[ei]
+            L = len(ep["actions"])
+            s = int(self._rng.integers(0, max(1, L - 1)))
+            e = min(L, s + K)
+            n = e - s
+            rtg[b, :n] = ep["rtg"][s:e]
+            obs[b, :n] = ep["obs"][s:e]
+            acts[b, :n] = ep["actions"][s:e]
+            if s > 0:
+                prev[b, 0] = ep["actions"][s - 1]
+            prev[b, 1:n] = ep["actions"][s:e - 1]
+            ts[b, :n] = ep["timesteps"][s:e]
+            mask[b, :n] = 1.0
+        return {"rtg": rtg, "obs": obs, "actions": acts,
+                "prev_actions": prev, "timesteps": ts, "mask": mask}
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        losses = []
+        for _ in range(cfg.grad_steps_per_iter):
+            jb = {k: jnp.asarray(v)
+                  for k, v in self._sample_batch().items()}
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, jb)
+            losses.append(float(loss))
+        self._timesteps += cfg.grad_steps_per_iter
+        return {"steps_this_iter": cfg.grad_steps_per_iter,
+                "loss": float(np.mean(losses))}
+
+    def evaluate(self, env_name: Optional[str] = None,
+                 num_episodes: int = 5,
+                 target_return: Optional[float] = None) -> float:
+        """Autoregressive rollout conditioned on target return
+        (reference: dt.py evaluation loop)."""
+        from ray_tpu.rllib.env import make_env
+        cfg = self.config
+        K = cfg.context_len
+        tgt = target_return if target_return is not None \
+            else cfg.target_return
+        total = 0.0
+        for ep_i in range(num_episodes):
+            env = make_env(env_name or cfg.env, seed=cfg.seed + ep_i)
+            o = env.reset()
+            rtg_hist = [tgt]
+            obs_hist = [np.asarray(o, np.float32)]
+            act_hist: List[int] = []
+            ret = 0.0
+            for t in range(cfg.max_episode_steps):
+                n = min(len(obs_hist), K)
+                rtg = np.zeros((1, K), np.float32)
+                obs = np.zeros((1, K, self.obs_dim), np.float32)
+                prev = np.full((1, K), self.num_actions, np.int64)
+                ts = np.zeros((1, K), np.int64)
+                rtg[0, :n] = rtg_hist[-n:]
+                obs[0, :n] = np.stack(obs_hist[-n:])
+                pa = ([self.num_actions] + act_hist)[-n:]
+                prev[0, :n] = pa
+                ts[0, :n] = np.arange(max(0, t - n + 1), t + 1)[:n]
+                logits = self._forward(self.params, jnp.asarray(rtg),
+                                       jnp.asarray(obs),
+                                       jnp.asarray(prev),
+                                       jnp.asarray(ts))
+                a = int(np.argmax(np.asarray(logits)[0, n - 1]))
+                o, r, done, _ = env.step(a)
+                ret += r
+                act_hist.append(a)
+                obs_hist.append(np.asarray(o, np.float32))
+                rtg_hist.append(rtg_hist[-1] - r)
+                if done:
+                    break
+            total += ret
+        return total / num_episodes
+
+    def save_checkpoint(self) -> dict:
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        self.params = jax.tree.map(jnp.asarray, ck["params"])
+        self.opt_state = jax.tree.map(jnp.asarray, ck["opt_state"])
+        self._timesteps = ck.get("timesteps", 0)

@@ -198,10 +198,8 @@ class QMIX(Algorithm):
         if env_maker is None:
             raise ValueError("QMIX needs a cooperative MultiAgentEnv "
                              "factory as config.env")
-        try:
-            self.env = env_maker(num_agents=cfg.num_agents, seed=cfg.seed)
-        except TypeError:
-            self.env = env_maker()
+        from ray_tpu.rllib.maddpg import _call_env_maker
+        self.env = _call_env_maker(env_maker, cfg)
         self._obs = self.env.reset()   # state() is defined post-reset
         self.agent_ids = list(self.env.agent_ids)
         N = len(self.agent_ids)
