@@ -1,0 +1,24 @@
+"""Train PPO on CartPole with actor rollout workers.
+
+    python examples/rllib_train_ppo.py
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    algo = (PPOConfig(env="CartPole-v1")
+            .rollouts(num_rollout_workers=2, num_envs_per_worker=4,
+                      rollout_length=64)
+            .training(train_batch_size=2048, lr=3e-4)
+            .build())
+    for i in range(10):
+        result = algo.train()
+        print(f"iter {i}: reward_mean="
+              f"{result.get('episode_reward_mean', 0):.1f}")
+    algo.cleanup()
+    ray_tpu.shutdown()

@@ -1,0 +1,41 @@
+"""Serve a jax model over HTTP + gRPC with autoscaling replicas.
+
+    python examples/serve_model.py
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import numpy as np
+
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=2, max_concurrent_queries=8)
+class Classifier:
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+        k = jax.random.PRNGKey(0)
+        self.w = jax.random.normal(k, (4, 3))
+        self._predict = jax.jit(
+            lambda w, x: jnp.argmax(x @ w, axis=-1))
+
+    def __call__(self, features):
+        import jax.numpy as jnp
+        x = jnp.asarray(features, jnp.float32).reshape(-1, 4)
+        return {"classes": np.asarray(self._predict(self.w, x)).tolist()}
+
+
+if __name__ == "__main__":
+    handle = serve.run(Classifier.bind(), http=True, port=8000)
+    print("HTTP ingress:", serve.proxy_address())
+    out = handle.remote([[0.1, 0.2, 0.3, 0.4]]).result(timeout=30)
+    print("direct handle call:", out)
+
+    from ray_tpu.serve.grpc_ingress import GrpcIngress, GrpcServeClient
+    ing = GrpcIngress(serve._get_controller(), port=0)
+    cli = GrpcServeClient(ing.address)
+    print("gRPC call:", cli.predict("Classifier",
+                                    [[1.0, 0.0, 0.0, 0.0]]))
+    cli.close(); ing.stop(); serve.shutdown()

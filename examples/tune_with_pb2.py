@@ -1,0 +1,46 @@
+"""Hyperparameter search with the PB2 population-based bandit.
+
+    python examples/tune_with_pb2.py
+"""
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+from ray_tpu import tune
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune import PB2, TuneConfig, Tuner
+
+
+class Quadratic(tune.Trainable):
+    def setup(self, config):
+        self.lr = config["lr"]
+        self.score = 0.0
+
+    def step(self):
+        self.score += 1.0 - (self.lr - 0.7) ** 2
+        return {"score": self.score, "done": self._iteration >= 9}
+
+    def save_checkpoint(self):
+        return {"score": self.score}
+
+    def load_checkpoint(self, ck):
+        self.score = ck["score"]
+
+    def reset_config(self, cfg):
+        self.lr = cfg["lr"]
+        return True
+
+
+if __name__ == "__main__":
+    sched = PB2(metric="score", mode="max", perturbation_interval=2,
+                hyperparam_bounds={"lr": (0.0, 1.0)}, seed=0)
+    grid = Tuner(
+        Quadratic,
+        param_space={"lr": tune.grid_search([0.1, 0.5, 0.9])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=sched),
+        run_config=RunConfig(name="pb2_demo",
+                             storage_path="/tmp/rt_pb2")).fit()
+    for t in grid.trials:
+        print(t.trial_id, "lr=%.3f" % t.config["lr"],
+              "score=%.2f" % t.last_result["score"])

@@ -403,6 +403,12 @@ def write_webdataset_blocks(blocks: Iterable[dict], dir_path: str,
                 for j in range(lo, hi):
                     key = (str(cols["__key__"][j]) if "__key__" in cols
                            else f"{idx:08d}")
+                    if "." in key:
+                        raise ValueError(
+                            f"__key__ {key!r} contains '.', which the "
+                            "WebDataset member naming uses as the "
+                            "key/column separator — keys would merge "
+                            "on read-back")
                     idx += 1
                     for k in names:
                         v = cols[k][j]
@@ -454,8 +460,6 @@ def read_mongo_blocks(uri: str, database: str, collection: str,
             "read_mongo requires the `pymongo` package; it is not "
             "installed in this environment") from e
     client = pymongo.MongoClient(uri)
-    cursor = client[database][collection].find(
-        query or {}, batch_size=block_rows)
 
     def chunk_to_block(chunk):
         keys: Dict[str, None] = {}
@@ -475,13 +479,18 @@ def read_mongo_blocks(uri: str, database: str, collection: str,
 
     # stream the cursor: peak memory is one block, not the collection
     blocks, chunk = [], []
-    for row in cursor:
-        chunk.append(row)
-        if len(chunk) >= block_rows:
+    try:
+        cursor = client[database][collection].find(
+            query or {}, batch_size=block_rows)
+        for row in cursor:
+            chunk.append(row)
+            if len(chunk) >= block_rows:
+                blocks.append(chunk_to_block(chunk))
+                chunk = []
+        if chunk:
             blocks.append(chunk_to_block(chunk))
-            chunk = []
-    if chunk:
-        blocks.append(chunk_to_block(chunk))
+    finally:
+        client.close()
     return blocks
 
 

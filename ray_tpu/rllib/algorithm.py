@@ -61,6 +61,27 @@ class AlgorithmConfig:
         return cls({"_config": self})
 
 
+def call_env_maker(env_maker: Callable, cfg) -> Any:
+    """Build a multi-agent env, passing num_agents/seed only when the
+    factory's signature takes them (directly or via **kwargs) — a
+    blanket try/except TypeError would mask factory-internal errors
+    and silently drop cfg.num_agents."""
+    import inspect
+    try:
+        sig = inspect.signature(env_maker)
+        params = sig.parameters
+        var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                     for p in params.values())
+        kwargs = {}
+        if var_kw or "num_agents" in params:
+            kwargs["num_agents"] = cfg.num_agents
+        if var_kw or "seed" in params:
+            kwargs["seed"] = cfg.seed
+        return env_maker(**kwargs)
+    except ValueError:        # uninspectable callable (C builtin etc.)
+        return env_maker(num_agents=cfg.num_agents, seed=cfg.seed)
+
+
 class WorkerSet:
     """Driver-side handle to N rollout workers (reference:
     rllib/evaluation/worker_set.py:78).  Inline mode keeps one local

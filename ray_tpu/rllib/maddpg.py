@@ -76,23 +76,6 @@ class SpreadLine:
         return self._obs(), rew, dones, {}
 
 
-def _call_env_maker(env_maker, cfg):
-    """Pass num_agents/seed only when the factory's signature takes
-    them — a blanket try/except TypeError would mask factory-internal
-    errors and silently drop cfg.num_agents."""
-    import inspect
-    try:
-        sig = inspect.signature(env_maker)
-        kwargs = {}
-        if "num_agents" in sig.parameters:
-            kwargs["num_agents"] = cfg.num_agents
-        if "seed" in sig.parameters:
-            kwargs["seed"] = cfg.seed
-        return env_maker(**kwargs)
-    except ValueError:        # uninspectable callable (C builtin etc.)
-        return env_maker(num_agents=cfg.num_agents, seed=cfg.seed)
-
-
 @dataclass
 class MADDPGConfig(AlgorithmConfig):
     env: object = SpreadLine
@@ -188,7 +171,8 @@ class MADDPG(Algorithm):
         env_maker = cfg.env if callable(cfg.env) else None
         if env_maker is None:
             raise ValueError("MADDPG needs a MultiAgentEnv factory")
-        self.env = _call_env_maker(env_maker, cfg)
+        from ray_tpu.rllib.algorithm import call_env_maker
+        self.env = call_env_maker(env_maker, cfg)
         self._obs = self.env.reset()
         self.agent_ids = list(self.env.agent_ids)
         N = len(self.agent_ids)

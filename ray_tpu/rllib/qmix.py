@@ -198,8 +198,8 @@ class QMIX(Algorithm):
         if env_maker is None:
             raise ValueError("QMIX needs a cooperative MultiAgentEnv "
                              "factory as config.env")
-        from ray_tpu.rllib.maddpg import _call_env_maker
-        self.env = _call_env_maker(env_maker, cfg)
+        from ray_tpu.rllib.algorithm import call_env_maker
+        self.env = call_env_maker(env_maker, cfg)
         self._obs = self.env.reset()   # state() is defined post-reset
         self.agent_ids = list(self.env.agent_ids)
         N = len(self.agent_ids)
