@@ -395,6 +395,16 @@ def write_webdataset_blocks(blocks: Iterable[dict], dir_path: str,
         cols = to_columns(blk)
         names = [k for k in cols if k != "__key__"]
         n = len(next(iter(cols.values()))) if cols else 0
+        if "__key__" in cols:
+            # validate BEFORE any tar is opened: raising mid-write
+            # would leave truncated shards behind
+            bad = [str(k) for k in cols["__key__"] if "." in str(k)]
+            if bad:
+                raise ValueError(
+                    f"__key__ values contain '.' ({bad[:3]}...), which "
+                    "the WebDataset member naming uses as the "
+                    "key/column separator — keys would merge on "
+                    "read-back")
         for lo in range(0, max(n, 1), samples_per_shard):
             hi = min(n, lo + samples_per_shard)
             path = os.path.join(dir_path, f"shard-{shard_i:05d}.tar")
@@ -403,12 +413,6 @@ def write_webdataset_blocks(blocks: Iterable[dict], dir_path: str,
                 for j in range(lo, hi):
                     key = (str(cols["__key__"][j]) if "__key__" in cols
                            else f"{idx:08d}")
-                    if "." in key:
-                        raise ValueError(
-                            f"__key__ {key!r} contains '.', which the "
-                            "WebDataset member naming uses as the "
-                            "key/column separator — keys would merge "
-                            "on read-back")
                     idx += 1
                     for k in names:
                         v = cols[k][j]

@@ -77,9 +77,18 @@ def call_env_maker(env_maker: Callable, cfg) -> Any:
             kwargs["num_agents"] = cfg.num_agents
         if var_kw or "seed" in params:
             kwargs["seed"] = cfg.seed
-        return env_maker(**kwargs)
     except ValueError:        # uninspectable callable (C builtin etc.)
-        return env_maker(num_agents=cfg.num_agents, seed=cfg.seed)
+        kwargs = {"num_agents": cfg.num_agents, "seed": cfg.seed}
+        var_kw = False
+    try:
+        return env_maker(**kwargs)
+    except TypeError as e:
+        # a **kwargs factory forwarding into a constructor that takes
+        # neither knob: retry bare, but ONLY when the error is about
+        # these exact kwargs — anything else is a real factory bug
+        if kwargs and ("num_agents" in str(e) or "seed" in str(e)):
+            return env_maker()
+        raise
 
 
 class WorkerSet:

@@ -34,6 +34,7 @@ class ESConfig(AlgorithmConfig):
     max_episode_steps: int = 500
     top_directions: int = 0     # 0 = use all (ES); >0 = ARS top-k
     eval_parallelism: int = 0   # >0: fan evals out as remote tasks
+    observation_filter: str = "NoFilter"   # "MeanStdFilter" = ARS V2
 
     def build(self, algo_cls=None) -> "ES":
         return ES({"_config": self})
@@ -44,6 +45,7 @@ class ARSConfig(ESConfig):
     top_directions: int = 8
     sigma: float = 0.03
     step_size: float = 0.02
+    observation_filter: str = "MeanStdFilter"   # ARS V2 default
 
     def build(self, algo_cls=None) -> "ARS":
         return ARS({"_config": self})
@@ -67,23 +69,40 @@ def _unflatten(flat, spec):
 
 
 def _rollout_return(env_name, flat_theta, spec, pcfg, seed, episodes,
-                    max_steps):
+                    max_steps, obs_stats=None, track_obs=False):
     """Deterministic (argmax) episode return of a perturbed policy.
-    Picklable top-level function so it can run as a remote task."""
+    Picklable top-level function so it can run as a remote task.
+
+    obs_stats=(mean, std) applies ARS-style observation normalization;
+    with track_obs the return includes the visited-observation moments
+    so the driver folds them into the shared running filter (reference:
+    ars.py MeanStdFilter synced across workers). Plain ES (NoFilter)
+    skips the per-step accumulation entirely."""
     params = _unflatten(jnp.asarray(flat_theta), spec)
     total = 0.0
+    s = np.zeros(pcfg.obs_dim)
+    s2 = np.zeros(pcfg.obs_dim)
+    n = 0
     for ep in range(episodes):
         env = make_env(env_name, seed=seed + ep)
         obs = env.reset()
         for _ in range(max_steps):
+            o = np.asarray(obs, np.float64)
+            if track_obs:
+                s += o
+                s2 += o * o
+                n += 1
+            if obs_stats is not None:
+                mean, std = obs_stats
+                o = (o - mean) / std
             logits, _ = policy_forward(
-                params, jnp.asarray(obs, jnp.float32)[None, :])
+                params, jnp.asarray(o, jnp.float32)[None, :])
             obs, rew, done, _ = env.step(
                 int(np.argmax(np.asarray(logits)[0])))
             total += rew
             if done:
                 break
-    return total / episodes
+    return total / episodes, s, s2, n
 
 
 def _centered_ranks(x: np.ndarray) -> np.ndarray:
@@ -107,6 +126,10 @@ class ES(Algorithm):
         params = init_policy_params(self.pcfg, jax.random.PRNGKey(cfg.seed))
         self.theta, self.spec = _flatten(params)
         self._rng = jax.random.PRNGKey(cfg.seed + 11)
+        # shared observation filter moments (ARS V2 MeanStdFilter)
+        self._obs_sum = np.zeros(self.pcfg.obs_dim)
+        self._obs_sq = np.zeros(self.pcfg.obs_dim)
+        self._obs_n = 0
         dim = self.theta.shape[0]
 
         @jax.jit
@@ -128,18 +151,38 @@ class ES(Algorithm):
 
         self._perturb, self._es_step = perturb, es_step
 
+    def _obs_stats(self):
+        if self.config.observation_filter != "MeanStdFilter" \
+                or self._obs_n < 2:
+            return None
+        mean = self._obs_sum / self._obs_n
+        var = np.maximum(self._obs_sq / self._obs_n - mean * mean, 0.0)
+        return mean, np.sqrt(var) + 1e-8
+
     def _evaluate(self, candidates: np.ndarray) -> np.ndarray:
         cfg = self.config
+        track = cfg.observation_filter == "MeanStdFilter"
+        stats = self._obs_stats()
         args = [(cfg.env, candidates[i], self.spec, self.pcfg,
                  cfg.seed + 7919 * self.iteration + i,
-                 cfg.episodes_per_eval, cfg.max_episode_steps)
+                 cfg.episodes_per_eval, cfg.max_episode_steps, stats,
+                 track)
                 for i in range(len(candidates))]
         if cfg.eval_parallelism > 0:
             import ray_tpu
             task = ray_tpu.remote(_rollout_return)
             refs = [task.remote(*a) for a in args]
-            return np.asarray(ray_tpu.get(refs, timeout=1200), np.float32)
-        return np.asarray([_rollout_return(*a) for a in args], np.float32)
+            outs = ray_tpu.get(refs, timeout=1200)
+        else:
+            outs = [_rollout_return(*a) for a in args]
+        if track:
+            # fold every worker's observation moments into the shared
+            # filter (reference: ars.py syncs MeanStdFilter per iter)
+            for _, s, s2, n in outs:
+                self._obs_sum += s
+                self._obs_sq += s2
+                self._obs_n += n
+        return np.asarray([r for r, _, _, _ in outs], np.float32)
 
     def training_step(self) -> dict:
         cfg = self.config
@@ -165,12 +208,23 @@ class ES(Algorithm):
         return eps, pairs  # plain ES: all directions
 
     def save_checkpoint(self) -> dict:
+        # copies: _evaluate mutates the live arrays in place with +=,
+        # which would silently change an already-saved in-memory
+        # checkpoint (Tune holds checkpoints as raw dicts inline)
         return {"theta": np.asarray(self.theta),
-                "timesteps": self._timesteps}
+                "timesteps": self._timesteps,
+                "obs_sum": np.copy(self._obs_sum),
+                "obs_sq": np.copy(self._obs_sq),
+                "obs_n": self._obs_n}
 
     def load_checkpoint(self, ck):
         self.theta = jnp.asarray(ck["theta"])
         self._timesteps = ck.get("timesteps", 0)
+        self._obs_sum = np.copy(ck.get("obs_sum",
+                                       np.zeros(self.pcfg.obs_dim)))
+        self._obs_sq = np.copy(ck.get("obs_sq",
+                                      np.zeros(self.pcfg.obs_dim)))
+        self._obs_n = ck.get("obs_n", 0)
 
     def get_policy_params(self):
         return _unflatten(self.theta, self.spec)

@@ -255,3 +255,15 @@ def test_policy_server_roundtrip():
         assert server.episode_returns() == [5.0]
     finally:
         server.stop()
+
+
+def test_ars_obs_filter_accumulates():
+    algo = ARSConfig(env="CartPole-v1", pop_size=4, top_directions=2,
+                     max_episode_steps=50, seed=0).build()
+    assert algo.config.observation_filter == "MeanStdFilter"
+    algo.train()
+    assert algo._obs_n > 0                      # moments collected
+    mean, std = algo._obs_stats()
+    assert mean.shape == (4,) and (std > 0).all()
+    ck = algo.save_checkpoint()
+    assert ck["obs_n"] == algo._obs_n           # filter rides checkpoints
