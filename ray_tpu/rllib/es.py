@@ -83,6 +83,7 @@ def _rollout_return(env_name, flat_theta, spec, pcfg, seed, episodes,
     s = np.zeros(pcfg.obs_dim)
     s2 = np.zeros(pcfg.obs_dim)
     n = 0
+    env_steps = 0
     for ep in range(episodes):
         env = make_env(env_name, seed=seed + ep)
         obs = env.reset()
@@ -100,9 +101,10 @@ def _rollout_return(env_name, flat_theta, spec, pcfg, seed, episodes,
             obs, rew, done, _ = env.step(
                 int(np.argmax(np.asarray(logits)[0])))
             total += rew
+            env_steps += 1
             if done:
                 break
-    return total / episodes, s, s2, n
+    return total / episodes, s, s2, n, env_steps
 
 
 def _centered_ranks(x: np.ndarray) -> np.ndarray:
@@ -178,11 +180,12 @@ class ES(Algorithm):
         if track:
             # fold every worker's observation moments into the shared
             # filter (reference: ars.py syncs MeanStdFilter per iter)
-            for _, s, s2, n in outs:
+            for _, s, s2, n, _ in outs:
                 self._obs_sum += s
                 self._obs_sq += s2
                 self._obs_n += n
-        return np.asarray([r for r, _, _, _ in outs], np.float32)
+        self._env_steps_last_eval = sum(es for *_, es in outs)
+        return np.asarray([r for r, *_ in outs], np.float32)
 
     def training_step(self) -> dict:
         cfg = self.config
@@ -197,7 +200,9 @@ class ES(Algorithm):
         self.theta = self._es_step(self.theta, eps_used,
                                    jnp.asarray(pairs))
 
-        steps = int(2 * P * cfg.episodes_per_eval * cfg.max_episode_steps)
+        # actual env steps taken (early-terminating episodes count what
+        # they ran, not max_episode_steps)
+        steps = int(self._env_steps_last_eval)
         self._timesteps += steps
         self._ep_returns.extend(returns.tolist())
         return {"steps_this_iter": steps,

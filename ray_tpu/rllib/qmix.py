@@ -12,9 +12,9 @@ vmap over the agent axis (one fused program instead of per-agent
 modules), and the whole update — per-agent double-Q selection, mixing
 of chosen/target utilities, TD loss — is a single jitted program.
 
-Includes SwitchRiddle-style built-in coop env (`TeamSwitch`): agents
-must choose complementary actions to score, forcing credit assignment
-through the mixer.
+Includes SwitchRiddle-style built-in coop env (`TeamSwitch`): the team
+is rewarded all-or-nothing (every agent must play its own observed
+bit), forcing per-agent credit assignment through the mixer.
 """
 
 from __future__ import annotations
@@ -34,9 +34,12 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 
 class TeamSwitch:
     """Cooperative matrix-ish env: each agent sees a private bit; the
-    team earns +1 when the joint action equals the XOR pattern of the
-    bits, else 0. Optimal play requires coordination through the shared
-    reward — independent learners plateau, QMIX's mixer solves it."""
+    team earns +1 only when EVERY agent plays its own bit, else 0. The
+    optimum is derivable from each agent's own observation, but the
+    reward is shared and all-or-nothing, so per-agent credit assignment
+    is the hard part — QMIX's monotonic mixer decomposes the team
+    return where plain shared-reward independent learners are slowed by
+    teammate exploration noise."""
 
     def __init__(self, num_agents: int = 2, episode_len: int = 8,
                  seed: Optional[int] = None):
@@ -66,10 +69,9 @@ class TeamSwitch:
 
     def step(self, action_dict):
         acts = np.asarray([int(action_dict[a]) for a in self.agent_ids])
-        # team scores when each agent plays its own bit XOR the first
-        # agent's bit (needs everyone to coordinate on agent_0's private
-        # info only through reward)
-        want = self._bits ^ self._bits[0]
+        # team scores when each agent plays its own (observed) bit —
+        # individually derivable, jointly rewarded
+        want = self._bits
         team_r = 1.0 if np.array_equal(acts, want) else 0.0
         self._t += 1
         self._bits = self.rng.integers(0, 2, self.n)

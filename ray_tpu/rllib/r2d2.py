@@ -146,10 +146,14 @@ def make_r2d2_update(cfg: R2D2Config, lcfg, tx):
                 target = rewards[:, tb] + cfg.gamma \
                     * (1.0 - dones[:, tb]) * q_next
             td = q_taken - jax.lax.stop_gradient(target)
-            # mask steps after an episode end inside the sequence
-            alive = jnp.concatenate(
+            # mask steps after an episode end ANYWHERE in the sequence
+            # (padded partial rows can terminate inside the burn-in
+            # prefix, so the mask must be computed over the full T and
+            # sliced — the first post-burn-in step is not always real)
+            alive_full = jnp.concatenate(
                 [jnp.ones((B, 1)),
-                 jnp.cumprod(1.0 - dones[:, tb], axis=1)[:, :-1]], axis=1)
+                 jnp.cumprod(1.0 - dones, axis=1)[:, :-1]], axis=1)
+            alive = alive_full[:, tb]
             return jnp.sum(alive * td ** 2) / jnp.maximum(
                 jnp.sum(alive), 1.0)
 
@@ -218,6 +222,29 @@ class R2D2(Algorithm):
                         "dones": [],
                         "h0": np.asarray(h[e]), "c0": np.asarray(c[e])}
 
+    def _flush_partial(self, e: int, next_obs_e) -> None:
+        """Zero-pad a partial sequence to seq_len and store it on episode
+        end (reference pads likewise: policy/rnn_sequencing.py
+        pad_batch_to_sequences_of_same_size).  Padded steps carry done=1
+        so the loss's `alive` cumprod mask zeroes them; the terminal
+        transition itself still trains."""
+        cfg = self.config
+        acc = self._acc[e]
+        n = len(acc["actions"])
+        # n <= burn_in would be fully masked by the alive cumprod (zero
+        # gradient) — don't waste buffer capacity on it
+        if n <= cfg.burn_in or n >= cfg.seq_len:
+            return
+        pad = cfg.seq_len - n
+        row = {"obs": np.stack(acc["obs"] + [next_obs_e] * (pad + 1)),
+               "actions": np.asarray(acc["actions"] + [0] * pad, np.int32),
+               "rewards": np.asarray(acc["rewards"] + [0.0] * pad,
+                                     np.float32),
+               "dones": np.asarray(acc["dones"] + [1.0] * pad,
+                                   np.float32),
+               "h0": acc["h0"], "c0": acc["c0"]}
+        self.buffer.add(row)
+
     def _reset_env_state(self, e: int) -> None:
         h, c = self._carry
         self._carry = (h.at[e].set(0.0), c.at[e].set(0.0))
@@ -247,6 +274,8 @@ class R2D2(Algorithm):
                 acc["dones"].append(float(done[e]))
                 self._flush_seq(e, np.asarray(next_obs[e], np.float32))
                 if done[e]:
+                    self._flush_partial(
+                        e, np.asarray(next_obs[e], np.float32))
                     self._reset_env_state(e)
             self._ep_rew += rew
             for i in np.nonzero(done)[0]:

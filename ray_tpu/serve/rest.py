@@ -29,7 +29,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 _applications: Dict[str, dict] = {}   # name -> {"import_path", "deployments"}
-_lock = threading.Lock()
+_lock = threading.Lock()              # guards _applications
+_apply_lock = threading.Lock()        # serializes declarative PUT/DELETE
 
 
 def _import_target(import_path: str):
@@ -49,50 +50,105 @@ def _import_target(import_path: str):
 def apply_config(config: dict, *, use_actors: Optional[bool] = None,
                  http: bool = False, port: int = 0) -> List[str]:
     """Deploy a declarative config (reference: ServeDeploySchema apply —
-    serve_rest_api.py put handler). Returns deployed app names."""
+    serve_rest_api.py put handler). Returns deployed app names.
+
+    Fully declarative like the reference: apps previously deployed via
+    this API but absent from the new config are torn down. Concurrent
+    PUT/DELETEs are serialized (the reference controller applies configs
+    from a single control loop)."""
     from ray_tpu import serve
     from ray_tpu.serve.deployment import Deployment
 
-    apps = config.get("applications", [])
-    deployed = []
-    for app in apps:
-        name = app.get("name") or app["import_path"]
-        target = _import_target(app["import_path"])
-        if callable(target) and not isinstance(target, Deployment):
-            target = target(**app.get("args", {}))
-        if not isinstance(target, Deployment):
-            raise TypeError(
-                f"{app['import_path']} resolved to {type(target).__name__},"
-                " expected a Deployment")
-        overrides = {d["name"]: {k: v for k, v in d.items() if k != "name"}
-                     for d in app.get("deployments", [])}
-        if target.name in overrides:
-            target = target.set_options(**overrides[target.name])
-        serve.run(target, use_actors=use_actors, http=http, port=port)
-        # apply overrides to already-deployed graph children too: every
-        # option a root gets via set_options, not just num_replicas
-        ctrl = serve._get_controller()
-        for dep_name, opts in overrides.items():
-            if dep_name != target.name and dep_name in ctrl.deployments:
-                st = ctrl.deployments[dep_name]
-                for key, val in opts.items():
-                    if key == "num_replicas":
-                        st.scale_to(int(val))
-                    elif hasattr(st.deployment.options, key):
-                        setattr(st.deployment.options, key, val)
-                    else:
+    with _apply_lock:
+        apps = config.get("applications", [])
+
+        # pass 1 — resolve and validate EVERYTHING before touching any
+        # running state, so a bad config rejects without side effects
+        def tree_names(dep) -> set:
+            names = {dep.name}
+            for v in (*dep.init_args, *dep.init_kwargs.values()):
+                if isinstance(v, Deployment):
+                    names |= tree_names(v)
+            return names
+
+        plans = []   # (name, app-dict, target, overrides, deployment set)
+        for app in apps:
+            name = app.get("name") or app["import_path"]
+            target = _import_target(app["import_path"])
+            if callable(target) and not isinstance(target, Deployment):
+                target = target(**app.get("args", {}))
+            if not isinstance(target, Deployment):
+                raise TypeError(
+                    f"{app['import_path']} resolved to "
+                    f"{type(target).__name__}, expected a Deployment")
+            overrides = {d["name"]: {k: v for k, v in d.items()
+                                     if k != "name"}
+                         for d in app.get("deployments", [])}
+            # validate every override key here so a typo can't reject
+            # the config AFTER pass 2 has torn running apps down
+            from ray_tpu.serve.deployment import DeploymentOptions
+            for dep_name, opts in overrides.items():
+                for key in opts:
+                    if key != "num_replicas" \
+                            and not hasattr(DeploymentOptions, key) \
+                            and key not in DeploymentOptions.__dataclass_fields__:
                         raise ValueError(
                             f"unknown deployment override {key!r} for "
                             f"{dep_name!r}")
+            if target.name in overrides:
+                target = target.set_options(**overrides[target.name])
+            # the static graph walk (not a controller diff) gives the
+            # exact deployment set even when apps share children
+            plans.append((name, app, target, overrides,
+                          tree_names(target) | set(overrides)))
+
+        # pass 2 — tear down deployments the new config no longer needs:
+        # whole stale apps, plus obsolete deployments of re-configured
+        # apps (import_path change)
+        needed = set().union(*(p[4] for p in plans)) if plans else set()
+        new_names = {p[0] for p in plans}
         with _lock:
-            _applications[name] = {
-                "import_path": app["import_path"],
-                "route_prefix": app.get("route_prefix", f"/{target.name}"),
-                "deployments": sorted(
-                    {target.name, *overrides}),
-            }
-        deployed.append(name)
-    return deployed
+            obsolete = set()
+            for name in list(_applications):
+                obsolete |= set(_applications[name]["deployments"])
+                if name not in new_names:
+                    _applications.pop(name)
+        obsolete -= needed
+        if obsolete:
+            ctrl = serve._get_controller()
+            for dep in sorted(obsolete):
+                if dep in ctrl.deployments:
+                    serve.delete(dep)
+
+        # pass 3 — deploy
+        deployed = []
+        for name, app, target, overrides, dep_names in plans:
+            serve.run(target, use_actors=use_actors, http=http, port=port)
+            # apply overrides to already-deployed graph children too:
+            # every option a root gets via set_options, not just
+            # num_replicas
+            ctrl = serve._get_controller()
+            for dep_name, opts in overrides.items():
+                if dep_name != target.name and dep_name in ctrl.deployments:
+                    st = ctrl.deployments[dep_name]
+                    for key, val in opts.items():
+                        if key == "num_replicas":
+                            st.scale_to(int(val))
+                        elif hasattr(st.deployment.options, key):
+                            setattr(st.deployment.options, key, val)
+                        else:
+                            raise ValueError(
+                                f"unknown deployment override {key!r} "
+                                f"for {dep_name!r}")
+            with _lock:
+                _applications[name] = {
+                    "import_path": app["import_path"],
+                    "route_prefix": app.get("route_prefix",
+                                            f"/{target.name}"),
+                    "deployments": sorted(dep_names),
+                }
+            deployed.append(name)
+        return deployed
 
 
 def describe() -> dict:
@@ -115,9 +171,10 @@ def describe() -> dict:
 
 def shutdown_all() -> None:
     from ray_tpu import serve
-    serve.shutdown()
-    with _lock:
-        _applications.clear()
+    with _apply_lock:
+        serve.shutdown()
+        with _lock:
+            _applications.clear()
 
 
 class ServeRestServer:

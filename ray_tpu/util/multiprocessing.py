@@ -52,14 +52,27 @@ class AsyncResult:
                 chunks = ray_tpu.get(self._refs, timeout=timeout)
                 out = list(itertools.chain.from_iterable(chunks))
                 self._result = out[0] if self._single else out
-                if self._callback is not None:
-                    self._callback(self._result)
             except ray_tpu.GetTimeoutError:
                 raise TimeoutError("result not ready within timeout")
             except Exception as e:  # noqa: BLE001 - surfaced via get()
                 self._error = e
                 if self._error_callback is not None:
-                    self._error_callback(e)
+                    try:
+                        self._error_callback(e)
+                    except Exception:  # noqa: BLE001 - must reach done.set
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "AsyncResult error_callback raised")
+            else:
+                # stdlib mp.Pool never converts a user-callback failure
+                # into a job failure — run it outside the job try/except
+                if self._callback is not None:
+                    try:
+                        self._callback(self._result)
+                    except Exception:  # noqa: BLE001 - log, don't fail job
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "AsyncResult callback raised")
             self._done.set()
 
     def get(self, timeout: Optional[float] = None):

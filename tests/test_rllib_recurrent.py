@@ -82,6 +82,7 @@ class TestQMIX:
                           epsilon_decay_steps=2000, seed=0).build()
         for _ in range(10):
             algo.train()
-        # random play scores ~2/8; the observability ceiling is 4.0
+        # random play scores ~2/8; optimum is 8.0 (each agent plays its
+        # own observed bit every step)
         recent = float(np.mean(algo._ep_returns[-50:]))
-        assert recent > 3.3, f"QMIX stuck at {recent}"
+        assert recent > 6.0, f"QMIX stuck at {recent}"
