@@ -88,6 +88,23 @@ _define("object_transfer_chunk_size", int, 4 * 1024 * 1024,
 _define("object_transfer_window", int, 8,
         "Max un-acked chunks in flight per transfer (sender-side "
         "backpressure so huge objects don't balloon the write buffer).")
+_define("max_lineage_bytes", int, 64 * 1024 * 1024,
+        "Per-node budget for retained producer task specs used to "
+        "reconstruct lost objects; oldest lineage is evicted beyond it "
+        "(reference: ray_config_def.h max_lineage_bytes / "
+        "task_manager.h:97 lineage pinning).")
+_define("memory_monitor_refresh_ms", int, 250,
+        "How often the node memory monitor samples usage; 0 disables "
+        "OOM protection (reference: ray_config_def.h "
+        "memory_monitor_refresh_ms = 250).")
+_define("memory_usage_threshold", float, 0.95,
+        "Fraction of node memory beyond which the monitor kills a "
+        "worker to protect the node (reference: ray_config_def.h "
+        "memory_usage_threshold = 0.95).")
+_define("max_object_reconstructions", int, 3,
+        "How many times a lost object's producer may be re-executed "
+        "before the loss becomes an ObjectLostError (reference: "
+        "object_recovery_manager.h bounded reconstruction).")
 
 # --- TPU / gang -----------------------------------------------------------
 _define("tpu_gang_in_process", bool, True,

@@ -52,6 +52,12 @@ class ObjectLostError(RuntimeError):
     be reconstructed (reference: ray.exceptions.ObjectLostError)."""
 
 
+class OutOfMemoryError(RuntimeError):
+    """The node's memory monitor killed this task's worker to protect
+    the node, and its retry budget is exhausted (reference:
+    ray.exceptions.OutOfMemoryError / memory_monitor.h:52)."""
+
+
 class NodeClient:
     def __init__(self, address: str, kind: str, tpu: bool = False,
                  push_handler: Optional[Callable[[dict], None]] = None):

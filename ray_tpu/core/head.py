@@ -87,6 +87,10 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         self._init_stores()   # kv / pubsub / function store (mixin)
         self.object_locs: dict[bytes, set[str]] = {}
         self.obj_watchers: dict[bytes, set[str]] = {}
+        # diagnostic: how many locate_object lookups reached the head —
+        # with the ownership directory live, owned-object traffic should
+        # bypass the head entirely
+        self.locate_requests = 0
         self.pgs: dict[bytes, PGDir] = {}
 
         # durable control-plane state (reference: gcs_server.cc:58-61 —
@@ -585,6 +589,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                     del self.object_locs[oid]
 
     def _h_locate_object(self, rec: ClientRec, m: dict) -> None:
+        self.locate_requests += len(m["object_ids"])
         locs_out = {}
         for oid in m["object_ids"]:
             locs = [h for h in self.object_locs.get(oid, ())

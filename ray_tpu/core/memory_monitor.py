@@ -1,0 +1,139 @@
+"""Node memory monitor: watch usage, pick OOM-kill victims.
+
+Reference capability: src/ray/common/memory_monitor.h:52 (periodic
+usage refresh against a kill threshold, cgroup-aware) and
+src/ray/raylet/worker_killing_policy_group_by_owner.h:85 (victim
+selection: group running tasks by their submitter, shrink the largest
+group, newest task first, preferring retriable tasks).
+
+TPU redesign delta: the monitor lives inside the fused node-service
+event loop (one `maybe_check` per tick) instead of a dedicated thread,
+and the in-process TPU executor is never a candidate — killing it would
+kill the driver that owns the accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional, Tuple
+
+_CGROUP_V2 = "/sys/fs/cgroup"
+_CGROUP_V1_MEM = "/sys/fs/cgroup/memory"
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path, "rb") as f:
+            raw = f.read().strip()
+        if raw in (b"max", b""):
+            return None
+        return int(raw)
+    except (OSError, ValueError):
+        return None
+
+
+def _cgroup_inactive_file(stat_path: str) -> int:
+    """Reclaimable page cache charged to the cgroup — must not count
+    toward kill pressure (reference: memory_monitor.cc subtracts
+    inactive_file from the cgroup's used bytes)."""
+    try:
+        with open(stat_path) as f:
+            for line in f:
+                if line.startswith("inactive_file "):
+                    return int(line.split()[1])
+                if line.startswith("total_inactive_file "):   # v1
+                    return int(line.split()[1])
+    except (OSError, ValueError):
+        pass
+    return 0
+
+
+def system_usage() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) — cgroup v2, then v1, then /proc/meminfo
+    (reference: memory_monitor.cc GetMemoryBytes cgroup-first order)."""
+    cur = _read_int(os.path.join(_CGROUP_V2, "memory.current"))
+    lim = _read_int(os.path.join(_CGROUP_V2, "memory.max"))
+    if cur is not None and lim is not None:
+        cache = _cgroup_inactive_file(os.path.join(_CGROUP_V2,
+                                                   "memory.stat"))
+        return max(cur - cache, 0), lim
+    cur = _read_int(os.path.join(_CGROUP_V1_MEM, "memory.usage_in_bytes"))
+    lim = _read_int(os.path.join(_CGROUP_V1_MEM, "memory.limit_in_bytes"))
+    # v1 reports an absurd limit when unconstrained
+    if cur is not None and lim is not None and lim < (1 << 60):
+        cache = _cgroup_inactive_file(os.path.join(_CGROUP_V1_MEM,
+                                                   "memory.stat"))
+        return max(cur - cache, 0), lim
+    total = avail = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+                if total is not None and avail is not None:
+                    break
+    except OSError:
+        pass
+    if total is None or avail is None:
+        return 0, 0
+    return total - avail, total
+
+
+def process_rss(pid: int) -> int:
+    """Resident set size of one process in bytes (/proc/<pid>/statm)."""
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+class MemoryMonitor:
+    """Threshold watcher with an injectable usage source (tests swap
+    `get_usage` to simulate pressure without allocating)."""
+
+    def __init__(self, threshold: float, refresh_ms: int,
+                 get_usage: Optional[Callable[[], Tuple[int, int]]] = None):
+        self.threshold = threshold
+        self.refresh_s = max(refresh_ms, 1) / 1000.0
+        self.get_usage = get_usage or system_usage
+        self._last_check = 0.0
+
+    def due(self) -> bool:
+        now = time.monotonic()
+        if now - self._last_check < self.refresh_s:
+            return False
+        self._last_check = now
+        return True
+
+    def over_threshold(self) -> Optional[Tuple[int, int]]:
+        """(used, total) when usage exceeds the kill threshold, else
+        None."""
+        used, total = self.get_usage()
+        if total > 0 and used / total >= self.threshold:
+            return used, total
+        return None
+
+
+def pick_victim(candidates: list) -> Optional[tuple]:
+    """Group-by-owner policy (reference:
+    worker_killing_policy_group_by_owner.h:85): shrink the LARGEST
+    owner's group, newest task first, retriable tasks before
+    non-retriable.  `candidates` is a list of (rec, task_rec) with
+    task_rec.spec/.started_at/.retries_left; returns one of them."""
+    if not candidates:
+        return None
+    groups: dict = {}
+    for item in candidates:
+        owner = item[1].spec.get("owner", "")
+        groups.setdefault(owner, []).append(item)
+    grp = max(groups.values(), key=len)
+    # newest first; retriable preferred so work is lost, not failed
+    grp.sort(key=lambda it: it[1].started_at, reverse=True)
+    for item in grp:
+        if item[1].retries_left > 0:
+            return item
+    return grp[0]

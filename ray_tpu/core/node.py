@@ -36,6 +36,7 @@ the driver (default, ``ray_tpu.init()``) or standalone
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -69,6 +70,21 @@ class ObjInfo:
     loc_reported: bool = False   # location pushed to the head
     nested: tuple = ()           # ids this object's value embeds refs to
     wait_waiters: list = field(default_factory=list)
+    # (node_hex, address) of the node that OWNS this object — the
+    # submitter's node is the location authority and lineage holder
+    # (reference: ownership model, core_worker.h / the owner_address
+    # every ObjectReference carries)
+    owner_node: tuple = ()
+
+
+@dataclass
+class OwnedRec:
+    """Owner-side directory entry for one owned object (reference:
+    ownership_based_object_directory.cc — the owner, not the GCS, is
+    authoritative for locations of objects it owns)."""
+    task_id: bytes = b""                       # producer (b"" for puts)
+    locations: dict = field(default_factory=dict)   # node_hex -> address
+    watchers: set = field(default_factory=set)      # (node_hex, address)
 
 
 @dataclass
@@ -205,6 +221,24 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._pg_bundles: dict[tuple, dict] = {}       # committed originals
         self._released_wait: set[ObjectID] = set()     # owner-released oids
         self._nested_count: dict[bytes, int] = {}      # id -> container holds
+        # ---- ownership + lineage (reference: reference_count.h /
+        # object_recovery_manager.h / ownership_based_object_directory.cc)
+        self.owned: dict[bytes, OwnedRec] = {}         # oid -> directory rec
+        self.lineage: dict[bytes, dict] = {}           # tid -> {spec,cost,live,recons}
+        self._lineage_bytes = 0
+        self._lineage_order: deque[bytes] = deque()
+        self._owner_watch: dict[bytes, str] = {}       # oid -> owner hex asked
+
+        # OOM protection (reference: memory_monitor.h + worker killing
+        # policy; N15 MemoryMonitor slice)
+        self.memory_monitor = None
+        if config.memory_monitor_refresh_ms > 0:
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+            self.memory_monitor = MemoryMonitor(
+                config.memory_usage_threshold,
+                config.memory_monitor_refresh_ms)
+        self._oom_kills: dict[bytes, str] = {}     # task_id -> detail
+        self.oom_kill_count = 0
 
         self._last_hb = 0.0
         self._hb_period = config.heartbeat_period_ms / 1000.0
@@ -230,7 +264,49 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._rebalance()
         self._expire_stale_pins()
         self._sweep_released()
+        self._memory_check()
         self._heartbeat()
+
+    def _memory_check(self) -> None:
+        """OOM protection: when node memory crosses the threshold, kill
+        one running worker chosen by the group-by-owner policy; the task
+        retries or fails with OutOfMemoryError (reference:
+        memory_monitor.h:52, worker_killing_policy_group_by_owner.h:85)."""
+        mm = self.memory_monitor
+        if mm is None or not mm.due():
+            return
+        over = mm.over_threshold()
+        if over is None:
+            return
+        used, total = over
+        from ray_tpu.core.memory_monitor import pick_victim
+        cands = []
+        for rec in self.clients.values():
+            if (rec.kind != "worker" or rec.dedicated_actor is not None
+                    or rec.state != "busy" or rec.current_task is None
+                    or not rec.pid):
+                continue
+            tr = self.tasks.get(rec.current_task)
+            if tr is not None and tr.state == "running":
+                cands.append((rec, tr))
+        victim = pick_victim(cands)
+        if victim is None:
+            return
+        rec, tr = victim
+        detail = (f"task used node memory past the threshold "
+                  f"({used / (1 << 20):.0f}MiB / {total / (1 << 20):.0f}"
+                  f"MiB >= {mm.threshold:.2f}); worker pid={rec.pid} "
+                  f"killed to protect the node")
+        self._oom_kills[rec.current_task] = detail
+        self.oom_kill_count += 1
+        self._record_event(tr.spec, "OOM_KILLED", worker=rec.conn_id)
+        sys.stderr.write(f"[node] OOM: killing worker pid={rec.pid} "
+                         f"(task {rec.current_task.hex()[:12]}, "
+                         f"{used}/{total} bytes)\n")
+        try:
+            os.kill(rec.pid, signal.SIGKILL)
+        except OSError:
+            self._oom_kills.pop(rec.current_task, None)
 
     def _rebalance(self) -> None:
         """Queued work meets new capacity: spillover decisions are made
@@ -507,6 +583,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # returns, even when an executor stores them)
         info.owner = info.owner or m.get("owner", rec.worker_id)
         info.is_error = bool(m.get("is_error"))
+        if self.head_conn is not None and not info.owner_node:
+            # first stored here with no prior claim: this node owns it
+            # (ray.put objects — the putter's node is the authority)
+            info.owner_node = (self.node_id.hex(), self.address)
         self._track_nested(info, m.get("nested_refs"))
         self._resolve_waiters(oid, info)
         if "reqid" in m:
@@ -519,6 +599,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         info.loc = "shm"
         info.size = m["size"]
         info.owner = info.owner or m.get("owner", rec.worker_id)
+        if self.head_conn is not None and not info.owner_node:
+            info.owner_node = (self.node_id.hex(), self.address)
         self._track_nested(info, m.get("nested_refs"))
         self.store.register(oid, m["size"])
         self._resolve_waiters(oid, info)
@@ -602,12 +684,24 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         ob = oid.binary()
         self._watched.discard(ob)
         self._pull_attempts.pop(ob, None)
+        self._owner_watch.pop(ob, None)
         if self.head_conn is not None and not info.loc_reported:
             info.loc_reported = True
             try:
                 self.head_conn.send({"t": "report_locations", "adds": [ob]})
             except protocol.ConnectionClosed:
                 self._head_lost()
+        if self.head_conn is not None and info.owner_node:
+            # tell the object's OWNER a copy lives here — the owner, not
+            # the head, serves location queries for owned objects
+            if info.owner_node[0] == self.node_id.hex():
+                self._owner_add_location(ob, self.node_id.hex(),
+                                         self.address)
+            else:
+                self._owner_push(
+                    info.owner_node[0], info.owner_node[1],
+                    {"t": "owner_object_at", "object_id": ob,
+                     "node": self.node_id.hex(), "address": self.address})
         tid = self._fwd_by_oid.pop(ob, None)
         if tid is not None:
             fw = self._fwd_tasks.get(tid)
@@ -697,11 +791,40 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         for nb in info.nested:
             self._nested_count[nb] = self._nested_count.get(nb, 0) + 1
 
+    def _release_owned(self, ob: bytes) -> None:
+        """Drop the ownership record and dereference its lineage entry
+        (freed objects need no reconstruction path)."""
+        orec = self.owned.pop(ob, None)
+        if orec is None or not orec.task_id:
+            return
+        lin = self.lineage.get(orec.task_id)
+        if lin is None:
+            return
+        lin["live"].discard(ob)
+        if not lin["live"]:
+            if lin["spec"] is not None:
+                self._lineage_bytes -= lin["cost"]
+            del self.lineage[orec.task_id]
+            # compact the eviction queue occasionally: entries for
+            # deleted lineage would otherwise accumulate forever
+            if len(self._lineage_order) > 256 \
+                    and len(self._lineage_order) > 4 * len(self.lineage):
+                self._lineage_order = deque(
+                    t for t in self._lineage_order if t in self.lineage)
+
     def _forget_object(self, oid: ObjectID) -> None:
         """Single removal point: drop the entry, its storage, and its
         holds on nested ids."""
         info = self.objects.pop(oid, None)
         self.store.delete(oid)
+        ob = oid.binary()
+        if info is not None and info.owner_node \
+                and info.owner_node[0] == self.node_id.hex():
+            self._release_owned(ob)
+        else:
+            orec = self.owned.get(ob)
+            if orec is not None:
+                orec.locations.pop(self.node_id.hex(), None)
         if info is not None and info.nested:
             for nb in info.nested:
                 c = self._nested_count.get(nb, 0) - 1
@@ -866,11 +989,78 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _admit_task(self, spec: dict) -> None:
         tr = TaskRec(spec=spec, retries_left=spec.get("max_retries", 0))
         self.tasks[spec["task_id"]] = tr
+        if self.head_conn is not None and not spec.get("owner_node"):
+            # first admission on the submitter's node: WE own the returns
+            spec["owner_node"] = (self.node_id.hex(), self.address)
+            if spec.get("max_retries", 0) != 0:
+                # retry-disabled tasks are not reconstructable, matching
+                # the reference (max_retries=0 -> ObjectLostError)
+                self._record_lineage(spec)
+        self._absorb_arg_owners(spec)
+        onode = tuple(spec.get("owner_node") or ())
         for b in spec["return_ids"]:
             info = self.objects.setdefault(ObjectID(b), ObjInfo())
             info.owner = info.owner or spec.get("owner", "")
+            if onode and not info.owner_node:
+                info.owner_node = onode
         self._record_event(spec, "PENDING")
         self._enqueue_task(spec)
+
+    # -- ownership + lineage --------------------------------------------------
+
+    def _record_lineage(self, spec: dict) -> None:
+        """Retain the producer spec so lost returns can be re-executed
+        (reference: task_manager.h lineage pinning bounded by
+        max_lineage_bytes)."""
+        tid = spec["task_id"]
+        live = set(spec["return_ids"])
+        for b in live:
+            rec = self.owned.get(b)
+            if rec is None:
+                self.owned[b] = OwnedRec(task_id=tid)
+            else:
+                rec.task_id = rec.task_id or tid
+        if tid in self.lineage or not live:
+            return
+        wire = _wire_spec(spec)
+        # cheap size estimate: serialized args dominate a spec
+        cost = len(wire.get("args") or b"") + 256 * (1 + len(live))
+        self.lineage[tid] = {"spec": wire, "cost": cost, "live": live,
+                             "recons": 0}
+        self._lineage_order.append(tid)
+        self._lineage_bytes += cost
+        cap = self.config.max_lineage_bytes
+        while self._lineage_bytes > cap and self._lineage_order:
+            old = self._lineage_order.popleft()
+            lin = self.lineage.get(old)
+            if lin is not None and lin["spec"] is not None:
+                lin["spec"] = None
+                self._lineage_bytes -= lin["cost"]
+
+    def _absorb_arg_owners(self, spec: dict) -> None:
+        """Adopt the forwarding node's owner hints for arg objects so
+        location queries go to owners, not the head."""
+        for b, onode in (spec.get("arg_owners") or {}).items():
+            info = self.objects.setdefault(ObjectID(b), ObjInfo())
+            if not info.owner_node:
+                info.owner_node = tuple(onode)
+
+    def _attach_arg_owners(self, wire: dict, spec: dict) -> None:
+        """Stamp owner addresses onto a spec leaving this node (the
+        reference ships owner_address inside every ObjectReference)."""
+        owners = {}
+        ids = list(spec.get("arg_ids", ()))
+        for b in ids:
+            info = self.objects.get(ObjectID(b))
+            if info is None:
+                continue
+            if info.owner_node:
+                owners[b] = tuple(info.owner_node)
+            elif info.state != "pending":
+                # no owner recorded but we hold a copy: we can serve it
+                owners[b] = (self.node_id.hex(), self.address)
+        if owners:
+            wire["arg_owners"] = owners
 
     def _projected_available(self) -> dict:
         """Availability net of demand already sitting in the runnable
@@ -975,7 +1165,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 self._fwd_by_oid[b] = tid
             self._ensure_remote_watch(
                 [ObjectID(b) for b in spec["return_ids"]])
-        self._head_rpc({"t": "cluster_submit", "spec": _wire_spec(spec),
+        wire = _wire_spec(spec)
+        self._attach_arg_owners(wire, spec)
+        self._head_rpc({"t": "cluster_submit", "spec": wire,
                         "src_available": self._projected_available()}, cb)
 
     def _hh_remote_submit(self, m: dict) -> None:
@@ -991,6 +1183,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _h_task_done(self, rec, m):
         tid = m["task_id"]
+        # the task outran its SIGKILL: it is not an OOM casualty (and a
+        # stale entry must not mislabel a later failure of this task id)
+        self._oom_kills.pop(tid, None)
         tr = self.tasks.get(tid)
         if tr is not None:
             tr.state = "failed" if m.get("error") else "finished"
@@ -1314,9 +1509,17 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         spec = m["spec"]
         actor_id = ActorID(spec["actor_id"])
         ar = self.actors.get(actor_id)
+        if self.head_conn is not None and not spec.get("owner_node"):
+            # actor-task returns get the ownership directory but NOT
+            # lineage: re-running actor methods is not loss-transparent
+            # (reference: actor results -> ObjectLostError by default)
+            spec["owner_node"] = (self.node_id.hex(), self.address)
+        onode = tuple(spec.get("owner_node") or ())
         for b in spec["return_ids"]:
             info = self.objects.setdefault(ObjectID(b), ObjInfo())
             info.owner = info.owner or spec.get("owner", "")
+            if onode and not info.owner_node:
+                info.owner_node = onode
         self.tasks[spec["task_id"]] = TaskRec(spec=spec)
         self._record_event(spec, "PENDING")
         if ar is not None:
@@ -1384,6 +1587,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 return
             wire = _wire_spec(spec)
             wire["_routed"] = True
+            self._attach_arg_owners(wire, spec)
             try:
                 conn.send({"t": "remote_actor_task", "spec": wire})
             except protocol.ConnectionClosed:
@@ -1407,9 +1611,13 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         spec = m["spec"]
         spec["_routed"] = True
         actor_id = ActorID(spec["actor_id"])
+        self._absorb_arg_owners(spec)
+        onode = tuple(spec.get("owner_node") or ())
         for b in spec["return_ids"]:
             info = self.objects.setdefault(ObjectID(b), ObjInfo())
             info.owner = info.owner or spec.get("owner", "")
+            if onode and not info.owner_node:
+                info.owner_node = onode
         self.tasks[spec["task_id"]] = TaskRec(spec=spec)
         self._record_event(spec, "PENDING")
         ar = self.actors.get(actor_id)
@@ -1779,11 +1987,15 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     0.1, lambda o=ObjectID(ob): self._ensure_remote_watch([o]))
 
     def _ensure_remote_watch(self, oids: list) -> None:
-        """Ask the head where pending objects live; pull when told.  Safe
+        """Route pending objects to their location authority: the OWNER
+        node when known (reference: ownership_based_object_directory.cc),
+        the head only as fallback for objects with no owner hint.  Safe
         to call repeatedly — each object is watched at most once."""
         if self.head_conn is None:
             return
-        want = []
+        me = self.node_id.hex()
+        head_want = []
+        by_owner: dict[tuple, list] = {}
         for o in oids:
             ob = o.binary()
             if ob in self._watched or ob in self._pulls:
@@ -1791,17 +2003,249 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             info = self.objects.get(o)
             if info is not None and info.state != "pending":
                 continue
-            self._watched.add(ob)
-            want.append(ob)
-        if not want:
-            return
+            onode = tuple(info.owner_node) if info is not None \
+                and info.owner_node else ()
+            if onode and onode[0] == me:
+                # owner-side resolution is idempotent and cheap — don't
+                # latch _watched, so demand arriving later re-resolves
+                self._owner_self_resolve(ob)
+            elif onode:
+                self._watched.add(ob)
+                by_owner.setdefault(onode, []).append(ob)
+            else:
+                self._watched.add(ob)
+                head_want.append(ob)
+        for onode, obs in by_owner.items():
+            self._owner_locate_send(onode, obs)
+        if head_want:
+            self._head_locate(head_want)
+
+    def _head_locate(self, obs: list, fatal_missing: bool = False) -> None:
+        """Fallback directory lookup through the head."""
 
         def cb(reply):
             if reply.get("error"):
                 return
-            for ob, (node_hex, addr) in reply.get("locs", {}).items():
+            locs = reply.get("locs", {})
+            for ob, (node_hex, addr) in locs.items():
                 self._request_pull(ObjectID(ob), node_hex, addr)
-        self._head_rpc({"t": "locate_object", "object_ids": want}, cb)
+            if fatal_missing:
+                from ray_tpu.core.client import ObjectLostError
+                for ob in obs:
+                    if ob in locs:
+                        continue
+                    oid = ObjectID(ob)
+                    info = self.objects.get(oid)
+                    if info is not None and info.state == "pending":
+                        self._seal_error_object(oid, ObjectLostError(
+                            f"Object {oid.hex()[:16]} was lost: its "
+                            "owner node died and no copy is known"))
+        self._head_rpc({"t": "locate_object", "object_ids": list(obs)}, cb)
+
+    # -- ownership directory protocol ----------------------------------------
+
+    def _owner_locate_send(self, onode: tuple, obs: list) -> None:
+        """Ask the owner node where these objects live; it replies with
+        object_at pushes (or owner_object_lost) and registers us as a
+        watcher until then."""
+        hexn, addr = onode
+
+        def go(conn):
+            if conn is None:
+                self._owner_unreachable(hexn, obs)
+                return
+            try:
+                conn.send({"t": "owner_locate", "object_ids": list(obs),
+                           "from_hex": self.node_id.hex(),
+                           "from_addr": self.address})
+                for ob in obs:
+                    self._owner_watch[ob] = hexn
+            except protocol.ConnectionClosed:
+                self._drop_peer(hexn)
+                self._owner_unreachable(hexn, obs)
+        self._peer_conn_async(hexn, addr, go)
+
+    def _owner_unreachable(self, owner_hex: str, obs: list) -> None:
+        """Owner node gone: fall back to the head directory; if it knows
+        no copy either, the object is lost for good."""
+        retry = []
+        for ob in obs:
+            self._owner_watch.pop(ob, None)
+            info = self.objects.get(ObjectID(ob))
+            if info is not None and info.state == "pending":
+                info.owner_node = ()
+                retry.append(ob)
+        if retry:
+            self._head_locate(retry, fatal_missing=True)
+
+    def _owner_push(self, node_hex: str, address: str, msg: dict) -> None:
+        def go(conn):
+            if conn is None:
+                return
+            try:
+                conn.send(msg)
+            except protocol.ConnectionClosed:
+                self._drop_peer(node_hex)
+        self._peer_conn_async(node_hex, address, go)
+
+    def _owner_add_location(self, ob: bytes, node_hex: str,
+                            address: str) -> None:
+        """Owner-side: record that a copy of an owned object exists on
+        `node_hex`, notify watchers, feed our own pending consumers."""
+        orec = self.owned.get(ob)
+        if orec is None:
+            orec = self.owned[ob] = OwnedRec()
+        orec.locations[node_hex] = address
+        # a remote location report IS the completion signal for a task we
+        # forwarded — settle its record so node-death recovery treats the
+        # object as lost-but-reconstructable, not in-flight
+        tid = self._fwd_by_oid.pop(ob, None)
+        if tid is not None:
+            fw = self._fwd_tasks.get(tid)
+            if fw is not None and not any(b in self._fwd_by_oid
+                                          for b in fw["spec"]["return_ids"]):
+                self._fwd_tasks.pop(tid, None)
+                tr = self.tasks.get(tid)
+                if tr is not None and tr.state == "forwarded":
+                    tr.state = "finished"
+                    tr.finished_at = time.time()
+        if orec.watchers:
+            watchers, orec.watchers = orec.watchers, set()
+            for whex, waddr in watchers:
+                if whex == node_hex:
+                    continue
+                self._owner_push(whex, waddr,
+                                 {"t": "object_at", "object_id": ob,
+                                  "node": node_hex, "address": address})
+        # demand-driven: pull our own copy only if something local waits
+        # on it (a get, a wait, or a queued task's dependency)
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is not None and info.state == "pending" \
+                and node_hex != self.node_id.hex() \
+                and (oid in self._mg_by_oid or oid in self.dep_waiting
+                     or info.wait_waiters):
+            self._request_pull(oid, node_hex, address)
+
+    def _h_owner_object_at(self, rec, m):
+        """A node stored a copy of an object WE own."""
+        self._owner_add_location(m["object_id"], m["node"], m["address"])
+
+    def _h_owner_locate(self, rec, m):
+        """A consumer asks us (the owner) where our objects live."""
+        me = self.node_id.hex()
+        watcher = (m.get("from_hex", ""), m.get("from_addr", ""))
+        for ob in m["object_ids"]:
+            oid = ObjectID(ob)
+            info = self.objects.get(oid)
+            if info is not None and info.state != "pending":
+                self._push(rec, {"t": "object_at", "object_id": ob,
+                                 "node": me, "address": self.address})
+                continue
+            orec = self.owned.get(ob)
+            if orec is not None:
+                self._prune_dead_locations(orec)
+                loc = next(((h, a) for h, a in orec.locations.items()
+                            if h != me), None)
+                if loc is not None:
+                    self._push(rec, {"t": "object_at", "object_id": ob,
+                                     "node": loc[0], "address": loc[1]})
+                    continue
+            tid = (orec.task_id if orec is not None and orec.task_id
+                   else oid.task_id().binary())
+            if self._producer_in_flight(tid) or self._reconstruct(tid):
+                # result will arrive: register the asker for the
+                # object_at push that follows
+                if watcher[0]:
+                    orec = self.owned.get(ob)
+                    if orec is None:
+                        orec = self.owned[ob] = OwnedRec(task_id=tid)
+                    orec.watchers.add(watcher)
+                continue
+            self._push(rec, {"t": "owner_object_lost", "object_id": ob,
+                             "cause": "owner holds no copy and no lineage"})
+
+    def _h_object_at(self, rec, m):
+        """Location push from an owner node (same shape as the head's)."""
+        self._on_owner_object_at_push(m)
+
+    def _on_owner_object_at_push(self, m: dict) -> None:
+        self._owner_watch.pop(m["object_id"], None)
+        self._hh_object_at(m)
+
+    def _h_owner_object_lost(self, rec, m):
+        self._on_owner_object_lost_push(m)
+
+    def _on_owner_object_lost_push(self, m: dict) -> None:
+        ob = m["object_id"]
+        self._owner_watch.pop(ob, None)
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        from ray_tpu.core.client import ObjectLostError
+        self._seal_error_object(oid, ObjectLostError(
+            f"Object {oid.hex()[:16]} was lost: {m.get('cause', '')}"))
+
+    def _prune_dead_locations(self, orec: OwnedRec) -> None:
+        me = self.node_id.hex()
+        for h in list(orec.locations):
+            if h != me and h not in self.cluster_view:
+                orec.locations.pop(h)
+
+    def _producer_in_flight(self, tid: bytes) -> bool:
+        if tid in self._fwd_tasks:
+            return True
+        tr = self.tasks.get(tid)
+        return tr is not None and tr.state in ("pending", "running",
+                                               "forwarded")
+
+    def _owner_self_resolve(self, ob: bytes) -> None:
+        """We own this pending object: pull a known copy, wait on the
+        in-flight producer, or re-execute it from lineage (reference:
+        object_recovery_manager.h:41)."""
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        me = self.node_id.hex()
+        orec = self.owned.get(ob)
+        if orec is not None:
+            self._prune_dead_locations(orec)
+            loc = next(((h, a) for h, a in orec.locations.items()
+                        if h != me), None)
+            if loc is not None:
+                self._request_pull(oid, loc[0], loc[1])
+                return
+        # no live copy: wait on an in-flight producer (the owned rec may
+        # not exist yet — lineage-less tasks only get one when a
+        # location is first reported), reconstruct, or declare the loss
+        tid = (orec.task_id if orec is not None and orec.task_id
+               else oid.task_id().binary())
+        if self._producer_in_flight(tid):
+            return
+        if self._reconstruct(tid):
+            return
+        from ray_tpu.core.client import ObjectLostError
+        self._seal_error_object(oid, ObjectLostError(
+            f"Object {oid.hex()[:16]} was lost and cannot be "
+            "reconstructed (no live copy, no retained lineage)"))
+
+    def _reconstruct(self, tid: bytes) -> bool:
+        """Re-execute the producer of lost owned objects.  Deterministic
+        return ids mean the re-run recreates exactly the lost objects
+        (reference: object_recovery_manager.h ReconstructObject)."""
+        lin = self.lineage.get(tid)
+        if lin is None or lin.get("spec") is None:
+            return False
+        if lin["recons"] >= self.config.max_object_reconstructions:
+            return False
+        lin["recons"] += 1
+        spec = dict(lin["spec"])
+        sys.stderr.write(f"[node] reconstructing task "
+                         f"{tid.hex()[:12]} (attempt {lin['recons']})\n")
+        self._admit_task(spec)
+        return True
 
     def _hh_object_at(self, m: dict) -> None:
         oid = ObjectID(m["object_id"])
@@ -1816,6 +2260,14 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         oid = ObjectID(ob)
         info = self.objects.get(oid)
         if info is None or info.state != "pending":
+            return
+        if info.owner_node:
+            # the owner, not the head, decides whether this is fatal —
+            # it may hold another copy or reconstruct from lineage
+            if info.owner_node[0] == self.node_id.hex():
+                self._owner_self_resolve(ob)
+            elif ob not in self._owner_watch:
+                self._owner_locate_send(tuple(info.owner_node), [ob])
             return
         from ray_tpu.core.client import ObjectLostError
         self._seal_error_object(oid, ObjectLostError(
@@ -1923,6 +2375,15 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 self._on_obj_inline(m)
             elif t == "pull_failed":
                 self._on_pull_failed(m)
+            elif t == "object_at":
+                # owner's reply to our owner_locate rides this conn
+                self._on_owner_object_at_push(m)
+            elif t == "owner_object_lost":
+                self._on_owner_object_lost_push(m)
+            elif t == "owner_object_at":
+                # a holder may report on a conn WE opened to it earlier
+                self._owner_add_location(m["object_id"], m["node"],
+                                         m["address"])
             elif t == "shutdown":
                 self._drop_peer(node_hex)
             # replies (e.g. to our peer register) are ignored
@@ -1983,20 +2444,36 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _on_pull_failed(self, m: dict) -> None:
         ob = m["object_id"]
-        self._pulls.pop(ob, None)
+        st = self._pulls.pop(ob, None)
+        src = st["src"] if st else None
         self._watched.discard(ob)
         oid = ObjectID(ob)
+        # a failed source is no longer a valid location for objects we own
+        orec = self.owned.get(ob)
+        if orec is not None and src:
+            orec.locations.pop(src, None)
         attempts = self._pull_attempts.get(ob, 0) + 1
         self._pull_attempts[ob] = attempts
         if attempts <= 5:
             # the location may be stale (freed/evicted+deleted); re-locate
             self.post_later(0.2, lambda: self._ensure_remote_watch([oid]))
         else:
-            self._fail_pull(oid, m.get("error", "pull failed"))
+            self._fail_pull(oid, m.get("error", "pull failed"), src=src)
 
-    def _fail_pull(self, oid: ObjectID, cause: str) -> None:
+    def _fail_pull(self, oid: ObjectID, cause: str,
+                   src: Optional[str] = None) -> None:
         info = self.objects.get(oid)
         if info is None or info.state != "pending":
+            return
+        ob = oid.binary()
+        if info.owner_node and info.owner_node[0] == self.node_id.hex():
+            orec = self.owned.get(ob)
+            if orec is not None and src:
+                orec.locations.pop(src, None)
+            self._pull_attempts.pop(ob, None)
+            # may pull another copy, wait on the producer, reconstruct,
+            # or seal the loss itself
+            self._owner_self_resolve(ob)
             return
         from ray_tpu.core.client import ObjectLostError
         self._seal_error_object(oid, ObjectLostError(
@@ -2012,6 +2489,32 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._drop_peer(node_hex)
         self.actor_cache = {k: v for k, v in self.actor_cache.items()
                             if v[0] != node_hex}
+        # owned objects whose only copies died: re-resolve (pull another
+        # copy / reconstruct) for any object someone is waiting on
+        me = self.node_id.hex()
+        for ob, orec in list(self.owned.items()):
+            if orec.locations.pop(node_hex, None) is None:
+                continue
+            if orec.locations and any(h == me or h in self.cluster_view
+                                      for h in orec.locations):
+                continue
+            oid = ObjectID(ob)
+            info = self.objects.get(oid)
+            needed = (orec.watchers
+                      or oid in self._mg_by_oid
+                      or oid in self.dep_waiting
+                      or (info is not None and info.wait_waiters))
+            if needed and info is not None and info.state == "pending":
+                self._watched.discard(ob)
+                self._owner_self_resolve(ob)
+        # consumers whose owner-directory authority died: fall back to
+        # the head for anything we were watching through that owner
+        stale = [ob for ob, h in self._owner_watch.items()
+                 if h == node_hex]
+        if stale:
+            self._owner_unreachable(node_hex, stale)
+            for ob in stale:
+                self._watched.discard(ob)
         for tid, fw in list(self._fwd_tasks.items()):
             if fw["dst"] != node_hex:
                 continue
@@ -2113,6 +2616,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # owner retries, task_manager.h:406)
         if rec.current_task is not None:
             tr = self.tasks.get(rec.current_task)
+            oom_detail = self._oom_kills.pop(rec.current_task, None)
             if tr is not None and tr.state == "running":
                 if not tr.spec.get("_cpu_released"):
                     self._return_resources(tr.spec)
@@ -2121,6 +2625,15 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     tr.retries_left -= 1
                     tr.state = "pending"
                     self._make_runnable(tr.spec)
+                elif oom_detail is not None:
+                    from ray_tpu.core.client import OutOfMemoryError
+                    tr.state = "failed"
+                    tr.error = oom_detail
+                    tr.finished_at = time.time()
+                    self._record_event(tr.spec, "FAILED")
+                    for b in tr.spec["return_ids"]:
+                        self._seal_error_object(
+                            ObjectID(b), OutOfMemoryError(oom_detail))
                 else:
                     self._fail_task(tr.spec,
                                     f"Worker died while running task "

@@ -78,6 +78,11 @@ def spec_to_proto(spec: Dict[str, Any]) -> "pb.TaskSpec":
     tctx = spec.get("trace_ctx") or {}
     p.trace_id = tctx.get("trace_id", "")
     p.span_id = tctx.get("span_id", "")
+    if spec.get("owner_node"):
+        p.owner_node.extend(spec["owner_node"])
+    for b, onode in (spec.get("arg_owners") or {}).items():
+        p.arg_owner_ids.append(b)
+        p.arg_owner_locs.extend([onode[0], onode[1]])
     return p
 
 
@@ -115,6 +120,12 @@ def spec_from_proto(p: "pb.TaskSpec") -> Dict[str, Any]:
     if p.trace_id:
         spec["trace_ctx"] = {"trace_id": p.trace_id,
                              "span_id": p.span_id}
+    if p.owner_node:
+        spec["owner_node"] = tuple(p.owner_node)
+    if p.arg_owner_ids:
+        spec["arg_owners"] = {
+            b: (p.arg_owner_locs[2 * i], p.arg_owner_locs[2 * i + 1])
+            for i, b in enumerate(p.arg_owner_ids)}
     return spec
 
 

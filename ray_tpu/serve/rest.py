@@ -102,25 +102,16 @@ def apply_config(config: dict, *, use_actors: Optional[bool] = None,
             plans.append((name, app, target, overrides,
                           tree_names(target) | set(overrides)))
 
-        # pass 2 — tear down deployments the new config no longer needs:
-        # whole stale apps, plus obsolete deployments of re-configured
-        # apps (import_path change)
+        # snapshot pre-PUT state; teardown happens LAST so a failed
+        # deploy never destroys the previously-running apps
         needed = set().union(*(p[4] for p in plans)) if plans else set()
         new_names = {p[0] for p in plans}
         with _lock:
-            obsolete = set()
-            for name in list(_applications):
-                obsolete |= set(_applications[name]["deployments"])
-                if name not in new_names:
-                    _applications.pop(name)
-        obsolete -= needed
-        if obsolete:
-            ctrl = serve._get_controller()
-            for dep in sorted(obsolete):
-                if dep in ctrl.deployments:
-                    serve.delete(dep)
+            prev_deployments = set()
+            for info in _applications.values():
+                prev_deployments |= set(info["deployments"])
 
-        # pass 3 — deploy
+        # pass 2 — deploy the new config
         deployed = []
         for name, app, target, overrides, dep_names in plans:
             serve.run(target, use_actors=use_actors, http=http, port=port)
@@ -148,6 +139,19 @@ def apply_config(config: dict, *, use_actors: Optional[bool] = None,
                     "deployments": sorted(dep_names),
                 }
             deployed.append(name)
+
+        # pass 3 — the new config is fully live: tear down whole stale
+        # apps and obsolete deployments of re-configured apps
+        with _lock:
+            for name in list(_applications):
+                if name not in new_names:
+                    _applications.pop(name)
+        obsolete = prev_deployments - needed
+        if obsolete:
+            ctrl = serve._get_controller()
+            for dep in sorted(obsolete):
+                if dep in ctrl.deployments:
+                    serve.delete(dep)
         return deployed
 
 
