@@ -1,0 +1,165 @@
+"""Scale envelope: nodes / actors / queued tasks / broadcast / chaos.
+
+The full-size counterpart of tests/test_scale.py, mirroring the
+reference's release scheduling benchmarks
+(release/benchmarks/README.md:5-31: many nodes, many actors, 1M queued
+tasks) at the scale one 1-core box can honestly host.  Writes a JSON
+evidence file (SCALE_r03.json at the repo root by default).
+
+Run:  python benchmarks/scale_envelope.py --out SCALE_r03.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import ray_tpu                                              # noqa: E402
+from ray_tpu.cluster_utils import Cluster                   # noqa: E402
+from ray_tpu.util.chaos import NodeKiller                   # noqa: E402
+
+
+def bench_tasks(n_tasks: int) -> dict:
+    @ray_tpu.remote
+    def tick(i):
+        return i
+
+    t0 = time.time()
+    refs = [tick.remote(i) for i in range(n_tasks)]
+    t_submit = time.time() - t0
+    out = ray_tpu.get(refs, timeout=3600)
+    t_drain = time.time() - t0
+    assert out == list(range(n_tasks))
+    return {"queued_tasks": n_tasks,
+            "submit_rate_per_s": round(n_tasks / t_submit, 1),
+            "drain_seconds": round(t_drain, 1),
+            "drain_rate_per_s": round(n_tasks / t_drain, 1)}
+
+
+def bench_actors(n_actors: int, wave: int) -> dict:
+    @ray_tpu.remote
+    class Cell:
+        def __init__(self, i):
+            self.i = i
+
+        def ping(self):
+            return self.i
+
+    t0 = time.time()
+    actors, acked = [], 0
+    while len(actors) < n_actors:
+        batch = [Cell.remote(len(actors) + j)
+                 for j in range(min(wave, n_actors - len(actors)))]
+        got = ray_tpu.get([a.ping.remote() for a in batch], timeout=3600)
+        acked += len(got)
+        actors.extend(batch)
+        el = time.time() - t0
+        print(f"  actors alive: {len(actors)}/{n_actors} "
+              f"({len(actors) / el:.1f}/s)", flush=True)
+    dt = time.time() - t0
+    # every actor still answers after the full wave
+    sample = actors[:: max(1, len(actors) // 50)]
+    assert ray_tpu.get([a.ping.remote() for a in sample], timeout=600)
+    return {"actors": len(actors), "ack_total": acked,
+            "create_seconds": round(dt, 1),
+            "create_rate_per_s": round(len(actors) / dt, 2)}
+
+
+def bench_broadcast(mb: int, n_nodes: int) -> dict:
+    blob = ray_tpu.put(np.ones(mb * 1024 * 128, dtype=np.float64))
+
+    def make(i):
+        @ray_tpu.remote(resources={f"n{i}": 1})
+        def consume(x):
+            return float(x[0] + x[-1])
+        return consume
+
+    t0 = time.time()
+    outs = ray_tpu.get([make(i).remote(blob) for i in range(n_nodes)],
+                       timeout=3600)
+    dt = time.time() - t0
+    assert all(o == 2.0 for o in outs)
+    return {"broadcast_mib": mb, "fanout_nodes": n_nodes,
+            "seconds": round(dt, 1),
+            "aggregate_mib_per_s": round(n_nodes * mb / dt, 1)}
+
+
+def bench_chaos(cluster, spare) -> dict:
+    @ray_tpu.remote(max_retries=5)
+    def work(i):
+        time.sleep(0.01)
+        return i
+
+    killer = NodeKiller(cluster, interval=3.0, max_kills=2,
+                        exclude=(spare,), seed=3,
+                        replace=lambda: cluster.add_node(num_cpus=1)).start()
+    n = 1500
+    t0 = time.time()
+    try:
+        out = ray_tpu.get([work.remote(i) for i in range(n)], timeout=3600)
+    finally:
+        killer.stop()
+    dt = time.time() - t0
+    assert out == list(range(n))
+    return {"chaos_tasks": n, "nodes_killed": len(killer.killed),
+            "completed_all": True, "seconds": round(dt, 1)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--tasks", type=int, default=10_000)
+    ap.add_argument("--actors", type=int, default=1000)
+    ap.add_argument("--actor-wave", type=int, default=50)
+    ap.add_argument("--broadcast-mb", type=int, default=1024)
+    ap.add_argument("--out", default="SCALE_r03.json")
+    args = ap.parse_args()
+
+    result = {"round": 3, "env": {
+        "physical_cores": os.cpu_count(),
+        "note": "virtual multi-node cluster on one machine "
+                "(cluster_utils), every node a full NodeService with "
+                "its own shm arena and worker pool"}}
+
+    c = Cluster()
+    t0 = time.time()
+    nodes = [c.add_node(num_cpus=2, resources={f"n{i}": 1})
+             for i in range(args.nodes)]
+    c.wait_for_nodes(timeout=120)
+    result["nodes"] = {"count": args.nodes,
+                       "bringup_seconds": round(time.time() - t0, 1)}
+    ray_tpu.init(address=nodes[0].address)
+    try:
+        print("== queued tasks ==", flush=True)
+        result["tasks"] = bench_tasks(args.tasks)
+        print(result["tasks"], flush=True)
+        print("== broadcast ==", flush=True)
+        result["broadcast"] = bench_broadcast(args.broadcast_mb,
+                                              args.nodes)
+        print(result["broadcast"], flush=True)
+        print("== chaos ==", flush=True)
+        result["chaos"] = bench_chaos(c, nodes[0])
+        print(result["chaos"], flush=True)
+        print("== actors ==", flush=True)
+        result["actors"] = bench_actors(args.actors, args.actor_wave)
+        print(result["actors"], flush=True)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -192,6 +192,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
         self._spawning = 0
         self._worker_procs: list[subprocess.Popen] = []
+        self._worker_log_by_pid: dict[int, tuple] = {}  # pid -> (out, err)
         # Batched-get bookkeeping: (conn_id, reqid) -> {ids, remaining}.
         self._multigets: dict[tuple, dict] = {}
         self._mg_by_oid: dict[ObjectID, set] = {}
@@ -297,16 +298,16 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                   f"({used / (1 << 20):.0f}MiB / {total / (1 << 20):.0f}"
                   f"MiB >= {mm.threshold:.2f}); worker pid={rec.pid} "
                   f"killed to protect the node")
+        try:
+            os.kill(rec.pid, signal.SIGKILL)
+        except OSError:
+            return   # already gone: no kill happened, record nothing
         self._oom_kills[rec.current_task] = detail
         self.oom_kill_count += 1
         self._record_event(tr.spec, "OOM_KILLED", worker=rec.conn_id)
         sys.stderr.write(f"[node] OOM: killing worker pid={rec.pid} "
                          f"(task {rec.current_task.hex()[:12]}, "
                          f"{used}/{total} bytes)\n")
-        try:
-            os.kill(rec.pid, signal.SIGKILL)
-        except OSError:
-            self._oom_kills.pop(rec.current_task, None)
 
     def _rebalance(self) -> None:
         """Queued work meets new capacity: spillover decisions are made
@@ -1283,7 +1284,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         for q, tpu in ((self.runnable_cpu, False), (self.runnable_tpu, True)):
             while q:
                 spec = q[0]
-                w = self._find_idle_worker(tpu=tpu)
+                w = self._find_idle_worker(tpu=tpu,
+                                           env_hash=spec.get("env_hash"))
                 if w is None:
                     if not tpu:
                         self._maybe_spawn_worker()
@@ -1293,12 +1295,22 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 q.popleft()
                 self._dispatch_task(w, spec)
 
-    def _find_idle_worker(self, tpu: bool) -> Optional[ClientRec]:
+    def _find_idle_worker(self, tpu: bool,
+                          env_hash: Optional[str] = None
+                          ) -> Optional[ClientRec]:
+        best = None
         for rec in self.clients.values():
             if (rec.kind in ("worker", "tpu_executor") and rec.state == "idle"
                     and rec.dedicated_actor is None and rec.tpu == tpu):
-                return rec
-        return None
+                if not env_hash:
+                    return rec
+                # prefer a worker that already materialized this env
+                # (reference: worker_pool.h:192 runtime-env-hash cache)
+                if env_hash in rec.seen_envs:
+                    return rec
+                if best is None:
+                    best = rec
+        return best
 
     def _dispatch_task(self, w: ClientRec, spec: dict) -> None:
         tr = self.tasks[spec["task_id"]]
@@ -1307,6 +1319,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         tr.started_at = time.time()
         w.state = "busy"
         w.current_task = spec["task_id"]
+        if spec.get("env_hash"):
+            w.seen_envs.add(spec["env_hash"])
         for b in spec.get("arg_ids", []):
             self.store.pin(ObjectID(b))
         self._record_event(spec, "RUNNING", worker=w.conn_id)
@@ -1379,6 +1393,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
              "--address", self.address, "--session", self.session],
             env=env, stdout=out, stderr=err, start_new_session=True)
         self._worker_procs.append(proc)
+        # stack dumps / the dashboard log view need pid -> log mapping
+        self._worker_log_by_pid[proc.pid] = (
+            os.path.join(logdir, f"worker-{idx}.out"),
+            os.path.join(logdir, f"worker-{idx}.err"))
 
     # -- actors
 
@@ -2582,7 +2600,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                    for oid, info in self.objects.items()]
         elif what == "workers":
             out = [{"worker_id": c.worker_id, "kind": c.kind, "pid": c.pid,
-                    "state": c.state, "tpu": c.tpu}
+                    "state": c.state, "tpu": c.tpu,
+                    "log": os.path.basename(
+                        self._worker_log_by_pid.get(c.pid, ("", ""))[0])
+                    or None}
                    for c in self.clients.values()
                    if c.kind in ("worker", "tpu_executor")]
         elif what == "nodes":
@@ -2597,8 +2618,100 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             out = []
         self._reply(rec, m["reqid"], data=out)
 
+    def _h_worker_logs(self, rec, m):
+        """List this node's worker log files, or tail one (reference:
+        the dashboard's per-worker log viewer, dashboard/modules/log/)."""
+        logdir = os.path.join(self.session_dir, "logs")
+        name = m.get("name")
+        if not name:
+            files = []
+            try:
+                for f in sorted(os.listdir(logdir)):
+                    full = os.path.join(logdir, f)
+                    files.append({"name": f,
+                                  "size": os.path.getsize(full)})
+            except OSError:
+                pass
+            self._reply(rec, m["reqid"], files=files)
+            return
+        # basename only — no path escape out of the log dir
+        path = os.path.join(logdir, os.path.basename(str(name)))
+        nbytes = int(m.get("nbytes", 64 * 1024))
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - nbytes))
+                data = f.read()
+            self._reply(rec, m["reqid"],
+                        data=data.decode("utf-8", "replace"), size=size)
+        except OSError as e:
+            self._reply(rec, m["reqid"], error=str(e))
+
+    def _h_stack_dump(self, rec, m):
+        """Dump a live worker's thread stacks (reference: `ray stack`,
+        scripts.py:1767 / profile_manager.py): SIGUSR1 triggers the
+        worker's faulthandler into its .err log; reply with the fresh
+        tail."""
+        pid = int(m["pid"])
+        target = next((c for c in self.clients.values()
+                       if c.kind == "worker" and c.pid == pid), None)
+        logs = self._worker_log_by_pid.get(pid)
+        if target is None or logs is None:
+            self._reply(rec, m["reqid"],
+                        error=f"no live spawned worker with pid {pid}")
+            return
+        err_path = logs[1]
+        try:
+            start = os.path.getsize(err_path)
+        except OSError:
+            start = 0
+        try:
+            os.kill(pid, signal.SIGUSR1)
+        except OSError as e:
+            self._reply(rec, m["reqid"], error=str(e))
+            return
+
+        def collect(attempt: int = 0):
+            # the dump is async — poll THIS worker's own .err for growth
+            # (other workers' stderr chatter must not be misattributed)
+            try:
+                size = os.path.getsize(err_path)
+            except OSError:
+                size = start
+            if size <= start and attempt < 20:
+                self.post_later(0.05, lambda: collect(attempt + 1))
+                return
+            if size <= start:
+                self._reply(rec, m["reqid"],
+                            error="worker produced no stack dump "
+                                  "(faulthandler unavailable?)")
+                return
+            with open(err_path, "rb") as f:
+                f.seek(start)
+                data = f.read()
+            self._reply(rec, m["reqid"], pid=pid,
+                        data=data.decode("utf-8", "replace"),
+                        log=os.path.basename(err_path))
+        collect()
+
     def _h_ping(self, rec, m):
         self._reply(rec, m["reqid"], ok=True, time=time.time())
+
+    def _h_stop_node(self, rec, m):
+        """Hard-stop this node on request — the chaos-testing kill switch
+        (reference: the NodeKiller in _private/test_utils.py:1337 and
+        `ray kill-random-node`).  Workers die with the node; the head
+        notices through the dropped connection / missed heartbeats."""
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+        for p in self._worker_procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        self._stop.set()
 
     # -- disconnect handling
 

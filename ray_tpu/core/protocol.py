@@ -49,11 +49,13 @@ def payload_encoding(data: bytes) -> str:
 
 
 def default_encoding() -> str:
-    """Process-wide wire encoding (RAY_TPU_WIRE_ENCODING=proto opts in
-    to the protobuf contract; pickle framing is the default)."""
+    """Process-wide wire encoding.  The typed protobuf contract is the
+    DEFAULT (reference: every control-plane RPC is a typed proto,
+    src/ray/protobuf/); RAY_TPU_WIRE_ENCODING=pickle opts back into
+    plain pickle framing (debugging / maximum-compat escape hatch)."""
     import os
-    return ("proto" if os.environ.get("RAY_TPU_WIRE_ENCODING", "")
-            .lower() == "proto" else "pickle")
+    return ("pickle" if os.environ.get("RAY_TPU_WIRE_ENCODING", "")
+            .lower() == "pickle" else "proto")
 
 
 class ConnectionClosed(Exception):

@@ -80,6 +80,11 @@ class Runtime:
         self._export_lock = threading.Lock()
         self._actor_counter = _Counter()
         self._serde = get_context()
+        # prepared runtime envs memoized per canonical input: re-zipping
+        # / re-checking the KV on EVERY submission would dominate the
+        # task hot path for working_dir users
+        self._env_cache: dict[str, tuple] = {}
+        self._env_cache_lock = threading.Lock()
         self._futures_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="raytpu-future")
         # distributed refcount: when this process's last ref to an object
@@ -135,6 +140,26 @@ class Runtime:
             spec["args"] = data
         spec["arg_ids"] = ref_ids
 
+    def _prepare_env(self, runtime_env: dict) -> tuple:
+        """validate + prepare + hash, memoized on the raw input (same
+        env dict on every .remote() must not re-zip working_dir)."""
+        import json as _json
+
+        from ray_tpu.runtime_env import env_hash, prepare, validate
+        try:
+            key = _json.dumps(runtime_env, sort_keys=True, default=str)
+        except TypeError:
+            key = repr(sorted(runtime_env.items()))
+        with self._env_cache_lock:
+            hit = self._env_cache.get(key)
+        if hit is not None:
+            return hit
+        prepared = prepare(validate(dict(runtime_env)), self.client)
+        out = (prepared, env_hash(prepared))
+        with self._env_cache_lock:
+            self._env_cache[key] = out
+        return out
+
     def _next_put_index(self) -> int:
         _ctx.put_counter += 1
         return _ctx.put_counter
@@ -150,9 +175,9 @@ class Runtime:
                     resources: Optional[dict] = None,
                     num_tpus: float = 0, max_retries: int = 0,
                     placement_group=None, runtime_env=None):
+        env_h = ""
         if runtime_env:
-            from ray_tpu.runtime_env import validate
-            runtime_env = validate(dict(runtime_env))
+            runtime_env, env_h = self._prepare_env(runtime_env)
         task_id = self._next_task_id()
         n_ret = 1 if num_returns == "dynamic" else max(num_returns, 0)
         return_ids = [ObjectID.for_task_return(task_id, i + 1)
@@ -169,6 +194,7 @@ class Runtime:
             "max_retries": max_retries,
             "placement_group": placement_group,
             "runtime_env": runtime_env,
+            "env_hash": env_h,
             # the SUBMITTER owns the returns (reference: ownership model,
             # core_worker.h — the caller, not the executor, owns results)
             "owner": self.client.worker_id,
@@ -198,8 +224,7 @@ class Runtime:
                      max_restarts: int = 0, max_concurrency: int = 1,
                      placement_group=None, runtime_env=None) -> ActorID:
         if runtime_env:
-            from ray_tpu.runtime_env import validate
-            runtime_env = validate(dict(runtime_env))
+            runtime_env, _ = self._prepare_env(runtime_env)
         actor_id = ActorID.of(self.job_id, current_task_id(),
                               self._actor_counter.next())
         task_id = self._next_task_id()

@@ -80,6 +80,7 @@ def spec_to_proto(spec: Dict[str, Any]) -> "pb.TaskSpec":
     p.span_id = tctx.get("span_id", "")
     if spec.get("owner_node"):
         p.owner_node.extend(spec["owner_node"])
+    p.env_hash = spec.get("env_hash", "") or ""
     for b, onode in (spec.get("arg_owners") or {}).items():
         p.arg_owner_ids.append(b)
         p.arg_owner_locs.extend([onode[0], onode[1]])
@@ -122,6 +123,8 @@ def spec_from_proto(p: "pb.TaskSpec") -> Dict[str, Any]:
                              "span_id": p.span_id}
     if p.owner_node:
         spec["owner_node"] = tuple(p.owner_node)
+    if p.env_hash:
+        spec["env_hash"] = p.env_hash
     if p.arg_owner_ids:
         spec["arg_owners"] = {
             b: (p.arg_owner_locs[2 * i], p.arg_owner_locs[2 * i + 1])

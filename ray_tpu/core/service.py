@@ -49,6 +49,7 @@ class ClientRec:
     closed: bool = False
     node_hex: str = ""           # for kind in (node, peer): peer node id
     encoding: str = "pickle"     # wire encoding this client speaks
+    seen_envs: set = field(default_factory=set)  # runtime-env hashes run
 
 
 class EventLoopService:

@@ -63,6 +63,17 @@ def main() -> None:
     else:
         _install_jax_cpu_pin()
 
+    # on-demand stack dumps (reference: `ray stack` /
+    # dashboard/modules/reporter/profile_manager.py): SIGUSR1 makes the
+    # worker write every thread's stack to its .err log, even mid-task
+    import faulthandler
+    import signal
+    try:
+        faulthandler.register(signal.SIGUSR1, file=sys.stderr,
+                              all_threads=True)
+    except (AttributeError, ValueError):
+        pass   # non-POSIX or non-main-thread: dumps unavailable
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--address", required=True)
     parser.add_argument("--session", required=True)

@@ -34,6 +34,12 @@ th { background: #f5f5f5; }
 <h2>Resources</h2><table id="resources"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Task summary</h2><table id="tasks"></table>
+<h2>Recent tasks</h2><table id="taskdetail"></table>
+<div id="taskevents" style="display:none"><h2>Task events:
+<span id="taskid"></span></h2><table id="events"></table></div>
+<h2>Workers</h2><table id="workers"></table>
+<pre id="text" style="background:#f8f8f8;border:1px solid #ddd;
+padding:.6rem;max-height:24rem;overflow:auto;display:none"></pre>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Object store</h2><table id="objects"></table>
 <script>
@@ -75,11 +81,43 @@ async function refresh() {
       s.jobs.map(j => [j.job_id, j.status, j.entrypoint]));
     fill("objects", ["metric", "value"],
       Object.entries(s.object_store).map(([k, v]) => [k, v]));
+    fill("taskdetail", ["task", "name", "state", "duration", ""],
+      s.recent_tasks.map(t => [t.task_id.slice(0, 12), t.name, t.state,
+        t.duration == null ? "-" : t.duration.toFixed(3) + "s",
+        {html: `<a href="#" onclick="events('${esc(t.task_id)}');` +
+               `return false">events</a>`}]));
+    fill("workers", ["worker", "kind", "pid", "state", "", ""],
+      s.workers.map(w => [w.worker_id.slice(0, 18), w.kind, w.pid,
+        w.state,
+        {html: `<a href="#" onclick="stack(${w.pid});return false">` +
+               `stack</a>`},
+        {html: w.log
+          ? `<a href="#" onclick="logs('${esc(w.log)}');return false">` +
+            `logs</a>` : "-"}]));
     document.getElementById("updated").textContent =
       "updated " + new Date().toLocaleTimeString();
   } catch (e) {
     document.getElementById("updated").textContent = "refresh failed: " + e;
   }
+}
+async function events(tid) {
+  const ev = await (await fetch("/api/tasks/" + tid)).json();
+  document.getElementById("taskevents").style.display = "";
+  document.getElementById("taskid").textContent = tid.slice(0, 16);
+  fill("events", ["state", "worker", "time"],
+    ev.events.map(e => [e.state, e.worker ?? "-",
+      new Date(e.time * 1000).toLocaleTimeString()]));
+}
+async function showText(url) {
+  const r = await (await fetch(url)).json();
+  const el = document.getElementById("text");
+  el.style.display = "";
+  el.textContent = r.error ? ("error: " + r.error)
+    : (r.data ?? JSON.stringify(r, null, 1));
+}
+function stack(pid) { showText("/api/stack?pid=" + pid); }
+function logs(name) {
+  showText("/api/logs" + (name ? "?name=" + encodeURIComponent(name) : ""));
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
@@ -108,8 +146,10 @@ class _StateSource:
             {"t": "state", "what": "tasks"},
             {"t": "object_stats"},
             {"t": "kv_keys", "prefix": b"job:"},
+            {"t": "state", "what": "workers"},
         ])
-        nodes, res, cactors, lactors, tasks, ostats, jkeys = replies
+        (nodes, res, cactors, lactors, tasks, ostats, jkeys,
+         workers) = replies
         actors = cactors["data"] or lactors["data"]
         jobs = []
         job_keys = [k for k in jkeys.get("keys", [])
@@ -123,15 +163,53 @@ class _StateSource:
                         jobs.append(json.loads(r["value"]))
                     except Exception:
                         pass
+        recent = sorted(tasks["data"],
+                        key=lambda t: t.get("submitted_at") or 0,
+                        reverse=True)[:50]
         return {
             "nodes": nodes["data"],
             "resources": res["data"],
             "actors": actors,
             "tasks": group_counts(tasks["data"], "name"),
+            "recent_tasks": recent,
+            "workers": workers["data"],
             "object_store": ostats["stats"],
             "jobs": jobs,
             "time": time.time(),
         }
+
+    def task_events(self, task_id_hex: str) -> dict:
+        """Drill-down: the per-task state timeline (reference: the
+        dashboard's task detail view over task events)."""
+        (reply,) = self._request_many(
+            [{"t": "state", "what": "task_events"}])
+        events = [e for e in reply["data"]
+                  if e.get("task_id") == task_id_hex]
+        return {"task_id": task_id_hex, "events": events}
+
+    def worker_logs(self, name: Optional[str] = None) -> dict:
+        q = {"t": "worker_logs"}
+        if name:
+            q["name"] = name
+        try:
+            (reply,) = self._request_many([q])
+        except RuntimeError as e:      # error replies raise in observer
+            return {"error": str(e)}
+        if name:
+            return {"name": name, "data": reply.get("data")}
+        files = reply.get("files", [])
+        return {"files": files,
+                "data": "\n".join(f"{f['name']}\t{f['size']}B"
+                                  for f in files)}
+
+    def stack_dump(self, pid: int) -> dict:
+        try:
+            (reply,) = self._request_many(
+                [{"t": "stack_dump", "pid": pid}])
+        except RuntimeError as e:
+            return {"pid": pid, "error": str(e)}
+        return {"pid": pid, "data": reply.get("data"),
+                "log": reply.get("log")}
 
 
 class Dashboard:
@@ -151,7 +229,10 @@ class Dashboard:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
-                path = self.path.split("?")[0].rstrip("/") or "/"
+                from urllib.parse import parse_qs, urlparse
+                parsed = urlparse(self.path)
+                path = parsed.path.rstrip("/") or "/"
+                qs = parse_qs(parsed.query)
                 try:
                     if path == "/":
                         self._send(200, _PAGE.encode(),
@@ -161,6 +242,21 @@ class Dashboard:
                                    json.dumps(source.summary(),
                                               default=str).encode(),
                                    "application/json")
+                    elif path.startswith("/api/tasks/"):
+                        tid = path.rsplit("/", 1)[1]
+                        self._send(200, json.dumps(
+                            source.task_events(tid),
+                            default=str).encode(), "application/json")
+                    elif path == "/api/logs":
+                        name = (qs.get("name") or [None])[0]
+                        self._send(200, json.dumps(
+                            source.worker_logs(name),
+                            default=str).encode(), "application/json")
+                    elif path == "/api/stack":
+                        pid = int((qs.get("pid") or ["0"])[0])
+                        self._send(200, json.dumps(
+                            source.stack_dump(pid),
+                            default=str).encode(), "application/json")
                     else:
                         self._send(404, b'{"error": "not found"}',
                                    "application/json")

@@ -3,6 +3,10 @@ SURVEY.md §2.4; §7 M6: CPU rollout actors + compiled TPU learner)."""
 
 from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, WorkerSet
+from ray_tpu.rllib.alpha_zero import (AlphaZero, AlphaZeroConfig,
+                                      GridGoal, MCTS,
+                                      RankedRewardsBuffer)
+from ray_tpu.rllib.slateq import InterestEvolution, SlateQ, SlateQConfig
 from ray_tpu.rllib.apex import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.bandit import BanditConfig, LinTS, LinUCB, \
     LinearBanditEnv
@@ -47,6 +51,8 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 
 __all__ = [
     "A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "WorkerSet",
+    "AlphaZero", "AlphaZeroConfig", "GridGoal", "MCTS",
+    "RankedRewardsBuffer", "SlateQ", "SlateQConfig", "InterestEvolution",
     "BC", "BCConfig", "MARWIL", "MARWILConfig", "ModelCatalog",
     "DQN", "DQNConfig", "CartPole", "VectorEnv", "make_env",
     "Impala", "ImpalaConfig", "vtrace", "JsonReader", "JsonWriter",

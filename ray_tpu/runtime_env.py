@@ -1,18 +1,30 @@
 """Runtime environments: per-job/task execution context.
 
-Reference capability: python/ray/_private/runtime_env/ — the scoped-down
-slice that matters without package installation (this environment bakes
-dependencies): ``env_vars`` (applied around execution),
-``working_dir`` and ``py_modules`` (zipped, content-addressed in the
-cluster KV store, materialized into a worker-local cache and put on
-sys.path — reference: runtime_env/working_dir.py + packaging.py).
+Reference capability: python/ray/_private/runtime_env/ —
+``env_vars`` (applied around execution), ``working_dir`` and
+``py_modules`` (zipped, content-addressed in the cluster KV store,
+materialized into a worker-local cache and put on sys.path —
+reference: runtime_env/working_dir.py + packaging.py + py_modules.py),
+and ``pip`` (reference: runtime_env/pip.py): requirements installed
+into a per-env-hash target directory that workers share and reuse.
+Local wheel files (in ``pip`` or ``py_modules``) are content-addressed
+through the cluster KV like directories, so the install path is fully
+offline-capable; named requirement strings shell out to pip and need
+an index (or a pre-populated cache) to resolve.
+
+Worker reuse: envs are cached on disk by content hash, and the node
+scheduler prefers dispatching a task to a worker that has already
+materialized the same env hash (reference: worker_pool.h:192 caching
+of workers per runtime-env hash).
 """
 
 from __future__ import annotations
 
 import hashlib
 import io
+import json
 import os
+import subprocess
 import sys
 import zipfile
 from typing import Any, Optional
@@ -23,18 +35,207 @@ _EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules",
 
 
 def validate(runtime_env: dict) -> dict:
-    known = {"env_vars", "working_dir", "py_modules"}
+    known = {"env_vars", "working_dir", "py_modules", "pip"}
     unknown = set(runtime_env) - known
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; supported: "
-            f"{sorted(known)} (pip/conda are out of scope: dependencies "
-            "are baked into the cluster image)")
+            f"{sorted(known)} (conda/container are out of scope: the "
+            "cluster image is the base environment)")
     ev = runtime_env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in ev.items()):
         raise ValueError("env_vars must be str -> str")
+    pip = runtime_env.get("pip")
+    if pip is not None:
+        # accept the reference's shapes: list[str] or {"packages": [...]}
+        if isinstance(pip, dict):
+            pip = list(pip.get("packages") or [])
+        elif isinstance(pip, str):
+            pip = [pip]
+        else:
+            pip = list(pip)
+        if not all(isinstance(p, str) for p in pip):
+            raise ValueError("pip must be a list of requirement strings "
+                             "or local wheel paths")
+        runtime_env["pip"] = pip
     return runtime_env
+
+
+def env_hash(runtime_env: Optional[dict]) -> str:
+    """Stable content hash of a PREPARED runtime env (local artifacts
+    already content-addressed) — the worker-caching key (reference:
+    worker_pool.h runtime_env_hash)."""
+    if not runtime_env:
+        return ""
+    canon = json.dumps(
+        {k: runtime_env[k] for k in sorted(runtime_env)
+         if runtime_env[k] is not None},
+        sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def _upload_wheel(client, path: str) -> str:
+    """Content-address a local wheel file; returns a 'whl:' ref that
+    workers can materialize anywhere in the cluster."""
+    with open(path, "rb") as f:
+        data = f.read()
+    h = package_hash(data)
+    key = f"runtime_env:pkg:{h}".encode()
+    if _kv_missing(client, key):
+        client.kv_put(key, data)
+    return f"whl:{h}:{os.path.basename(path)}"
+
+
+def prepare(runtime_env: dict, client) -> dict:
+    """Submission-side step: upload every LOCAL artifact (directories,
+    wheel files) into the cluster KV so any node can materialize the
+    env (reference: packaging.py upload_package_if_needed called from
+    the runtime-env agent)."""
+    env = dict(runtime_env)
+    wd = env.get("working_dir")
+    if wd and os.path.isdir(wd):
+        env["working_dir"] = upload_package(client, package_directory(wd))
+    mods = env.get("py_modules")
+    if mods:
+        out = []
+        for m in ([mods] if isinstance(mods, str) else list(mods)):
+            if os.path.isdir(m):
+                out.append(upload_package(client, package_directory(m)))
+            elif m.endswith(".whl") and os.path.isfile(m):
+                out.append(_upload_wheel(client, m))
+            else:
+                out.append(m)
+        env["py_modules"] = out
+    pip = env.get("pip")
+    if pip:
+        env["pip"] = [
+            _upload_wheel(client, p)
+            if p.endswith(".whl") and os.path.isfile(p) else p
+            for p in pip]
+    return env
+
+
+def _materialize_wheel(client, ref: str, cache_root: str) -> str:
+    """'whl:<hash>:<basename>' → local wheel file path."""
+    _, h, basename = ref.split(":", 2)
+    dest_dir = os.path.join(cache_root, "wheels", h)
+    dest = os.path.join(dest_dir, basename)
+    if os.path.exists(dest):
+        return dest
+    data = client.kv_get(f"runtime_env:pkg:{h}".encode())
+    if data is None:
+        raise RuntimeError(f"runtime_env wheel {h} not found in the "
+                           "cluster KV store")
+    os.makedirs(dest_dir, exist_ok=True)
+    tmp = dest + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, dest)
+    return dest
+
+
+def _extract_wheel(whl_path: str, cache_root: str) -> str:
+    """Extract a wheel into the cache, keyed by content hash; returns
+    the importable directory."""
+    with open(whl_path, "rb") as f:
+        h = package_hash(f.read())
+    path = os.path.join(cache_root, "whl_x", h)
+    if os.path.isdir(path):
+        return path
+    tmp = path + f".tmp{os.getpid()}"
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with zipfile.ZipFile(whl_path) as z:
+        z.extractall(tmp)
+    try:
+        os.replace(tmp, path)
+    except OSError:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return path
+
+
+def ensure_pip_env(client, pip: list, cache_root: Optional[str] = None,
+                   ) -> str:
+    """Install a pip requirement list into a per-hash target directory,
+    once per cluster host (reference: pip.py PipProcessor; --target
+    keeps the base environment untouched).  Local-wheel refs install
+    with --no-index, so the path is offline-capable."""
+    cache_root = cache_root or os.path.join("/tmp/ray_tpu",
+                                            "runtime_env_cache")
+    h = hashlib.sha256(json.dumps(sorted(pip)).encode()).hexdigest()[:16]
+    target = os.path.join(cache_root, "pip", h)
+    marker = os.path.join(target, ".ready")
+    if os.path.exists(marker):
+        return target
+    os.makedirs(target, exist_ok=True)
+    # cross-process guard: first creator installs, racers wait on the
+    # marker.  The lock records the installer's pid so a SIGKILLed
+    # installer (e.g. the OOM monitor) can't deadlock the env forever —
+    # waiters steal a lock whose owner is dead.
+    lock = os.path.join(target, ".lock")
+
+    def acquire() -> bool:
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            return False
+
+    if not acquire():
+        import time
+        deadline = time.time() + 300
+        while True:
+            if os.path.exists(marker):
+                return target
+            try:
+                with open(lock) as f:
+                    owner = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                owner = 0
+            alive = False
+            if owner:
+                try:
+                    os.kill(owner, 0)
+                    alive = True
+                except OSError:
+                    alive = False
+            if not alive:
+                # stale lock: remove and try to take over the install
+                try:
+                    os.remove(lock)
+                except OSError:
+                    pass
+                if acquire():
+                    break
+            if time.time() > deadline:
+                raise RuntimeError("timed out waiting for a concurrent "
+                                   f"pip install of {pip}")
+            time.sleep(0.2)
+    try:
+        wheels = [_materialize_wheel(client, p, cache_root)
+                  for p in pip if p.startswith("whl:")]
+        named = [p for p in pip if not p.startswith("whl:")]
+        base = [sys.executable, "-m", "pip", "install", "--quiet",
+                "--no-warn-script-location", "--target", target]
+        if wheels:
+            subprocess.run(base + ["--no-index", "--no-deps"] + wheels,
+                           check=True, capture_output=True, text=True)
+        if named:
+            subprocess.run(base + named, check=True,
+                           capture_output=True, text=True)
+        open(marker, "w").close()
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            f"pip install failed for {pip}: {e.stderr}") from e
+    finally:
+        try:
+            os.remove(lock)
+        except OSError:
+            pass
+    return target
 
 
 def package_directory(path: str) -> bytes:
@@ -72,12 +273,20 @@ def package_hash(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()[:32]
 
 
+def _kv_missing(client, key: bytes) -> bool:
+    """Existence check WITHOUT transferring the payload back."""
+    try:
+        return not client.kv_keys(prefix=key)
+    except Exception:
+        return client.kv_get(key) is None
+
+
 def upload_package(client, data: bytes) -> str:
     """Content-addressed upload into the cluster KV (reference:
     packaging.py upload_package_if_needed).  Returns the package hash."""
     h = package_hash(data)
     key = f"runtime_env:pkg:{h}".encode()
-    if client.kv_get(key) is None:
+    if _kv_missing(client, key):
         client.kv_put(key, data)
     return h
 
@@ -134,15 +343,32 @@ class applied_env:
         for k, v in (self.env.get("env_vars") or {}).items():
             self._saved_env[k] = os.environ.get(k)
             os.environ[k] = v
+        cache_root = os.path.join("/tmp/ray_tpu", "runtime_env_cache")
+        pip = self.env.get("pip")
+        if pip:
+            target = ensure_pip_env(self.client, list(pip))
+            sys.path.insert(0, target)
+            self.paths.append(target)
         for field, chdir in (("working_dir", True), ("py_modules", False)):
             ref = self.env.get(field)
             if not ref:
                 continue
             refs = [ref] if isinstance(ref, str) else list(ref)
             for r in refs:
-                path = (ensure_package(self.client, r)
-                        if self.client is not None and not os.path.isdir(r)
-                        else r)
+                if isinstance(r, str) and r.startswith("whl:"):
+                    # a wheel on py_modules: extract it straight onto
+                    # sys.path (a wheel is an importable zip layout —
+                    # reference: py_modules.py wheel support)
+                    whl = _materialize_wheel(self.client, r, cache_root)
+                    path = _extract_wheel(whl, cache_root)
+                elif (isinstance(r, str) and r.endswith(".whl")
+                        and os.path.isfile(r)):
+                    # local wheel path (single-machine / unprepared env)
+                    path = _extract_wheel(r, cache_root)
+                else:
+                    path = (ensure_package(self.client, r)
+                            if self.client is not None
+                            and not os.path.isdir(r) else r)
                 sys.path.insert(0, path)
                 self.paths.append(path)
                 if chdir and self._saved_cwd is None:
@@ -151,6 +377,16 @@ class applied_env:
         return self
 
     def __exit__(self, *exc):
+        if self.paths:
+            # a reused worker must not leak env-provided modules into
+            # later tasks that did NOT request this env (the reference
+            # avoids this by binding workers to one env hash; here the
+            # env's imports are evicted instead so workers stay shared)
+            roots = tuple(os.path.abspath(p) + os.sep for p in self.paths)
+            for name, mod in list(sys.modules.items()):
+                origin = getattr(mod, "__file__", None)
+                if origin and os.path.abspath(origin).startswith(roots):
+                    del sys.modules[name]
         for p in self.paths:
             try:
                 sys.path.remove(p)

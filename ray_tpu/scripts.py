@@ -169,6 +169,39 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """Dump the thread stacks of every live worker on a node
+    (reference: `ray stack`, scripts.py:1767)."""
+    conn, request = _observer(args.address)
+    try:
+        workers = request({"t": "state", "what": "workers"})["data"]
+        workers = [w for w in workers if w["kind"] == "worker"]
+        if not workers:
+            print("no live workers on this node")
+            return 0
+        for w in workers:
+            print(f"===== worker pid={w['pid']} state={w['state']} =====")
+            try:
+                r = request({"t": "stack_dump", "pid": w["pid"]})
+                print(r.get("data", ""))
+            except RuntimeError as e:
+                print(f"  <{e}>")
+        return 0
+    finally:
+        conn.close()
+
+
+def cmd_kill_random_node(args) -> int:
+    from ray_tpu.util.chaos import kill_random_node
+    victim = kill_random_node(args.address,
+                              exclude_addresses=tuple(args.spare))
+    if victim is None:
+        print("no killable node found")
+        return 1
+    print(f"killed node at {victim}")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from ray_tpu.dashboard import Dashboard
 
@@ -332,6 +365,21 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("stack", help="dump live worker thread stacks "
+                                     "(reference: `ray stack`)")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("kill-random-node",
+                       help="chaos: hard-stop a random alive node "
+                            "(reference: chaos release tests / "
+                            "test_utils NodeKiller)")
+    p.add_argument("--address", required=True,
+                   help="any cluster node's address")
+    p.add_argument("--spare", action="append", default=[],
+                   help="node address to never kill (repeatable)")
+    p.set_defaults(fn=cmd_kill_random_node)
 
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--address", required=True)

@@ -1,0 +1,113 @@
+"""Chaos-testing utilities: kill random nodes while a workload runs.
+
+Reference capability: the reusable NodeKiller resource actor
+(python/ray/_private/test_utils.py:1337) and the release-test pattern
+of killing nodes on an interval to prove recovery paths; surfaced on
+the CLI as ``ray_tpu kill-random-node`` (the reference exposes the
+same through chaos release tests).
+
+TPU redesign delta: nodes here are event-loop services, so the killer
+is a plain thread that either stops in-process ``NodeService`` objects
+(virtual clusters) or sends the ``stop_node`` control message to a
+remote node's listener.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from ray_tpu.core import protocol
+
+
+def list_cluster_nodes(address: str) -> list[dict]:
+    """[{node_id, address, alive}] from any node's state endpoint."""
+    from ray_tpu.core.observer import observer_query
+    return observer_query(address,
+                          [{"t": "state", "what": "nodes"}])[0]["data"]
+
+
+def kill_node_at(address: str) -> bool:
+    """Send the stop_node kill switch to one node's listener."""
+    from ray_tpu.core.observer import observer_connect
+    try:
+        conn, request = observer_connect(address, timeout=5.0)
+    except (OSError, RuntimeError):
+        return False
+    try:
+        request({"t": "stop_node"})
+        return True
+    except (protocol.ConnectionClosed, RuntimeError, TimeoutError):
+        return True   # the node may die before flushing the ack
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+def kill_random_node(address: str,
+                     exclude_addresses: tuple = ()) -> Optional[str]:
+    """Pick a random alive node (optionally sparing some, e.g. the
+    driver's) and kill it.  Returns the victim's address or None."""
+    nodes = [n for n in list_cluster_nodes(address)
+             if n.get("alive") and n.get("address")
+             and n["address"] not in exclude_addresses]
+    if not nodes:
+        return None
+    victim = random.choice(nodes)
+    return victim["address"] if kill_node_at(victim["address"]) else None
+
+
+class NodeKiller:
+    """Background chaos loop for virtual clusters (cluster_utils.Cluster):
+    every `interval` seconds stop a random live node, optionally asking
+    `replace` to add a fresh one so the cluster churns instead of
+    draining to nothing."""
+
+    def __init__(self, cluster, interval: float = 2.0,
+                 max_kills: int = 1, exclude: tuple = (),
+                 replace: Optional[Callable[[], None]] = None,
+                 seed: Optional[int] = None):
+        self.cluster = cluster
+        self.interval = interval
+        self.max_kills = max_kills
+        self.exclude = set(id(n) for n in exclude)
+        self.replace = replace
+        self.rng = random.Random(seed)
+        self.killed: list[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _candidates(self):
+        return [n for n in self.cluster.nodes
+                if id(n) not in self.exclude and not n._stop.is_set()]
+
+    def _run(self):
+        while not self._stop.is_set() and len(self.killed) < self.max_kills:
+            if self._stop.wait(self.interval):
+                break
+            cands = self._candidates()
+            if not cands:
+                continue
+            victim = self.rng.choice(cands)
+            self.killed.append(victim.node_id.hex())
+            self.cluster.kill_node(victim)
+            if self.replace is not None:
+                try:
+                    self.replace()
+                except Exception:
+                    pass
+
+    def start(self) -> "NodeKiller":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="raytpu-node-killer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

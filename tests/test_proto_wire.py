@@ -89,10 +89,20 @@ def test_mixed_encodings_one_node(proto_rt):
     proto frames — frames are self-describing per connection."""
     from ray_tpu.core.observer import observer_query
     rt = ray_tpu.get_runtime()
-    os.environ.pop("RAY_TPU_WIRE_ENCODING", None)  # observer → pickle
+    os.environ["RAY_TPU_WIRE_ENCODING"] = "pickle"  # observer → pickle
     try:
         replies = observer_query(rt.node_service.address,
                                  [{"t": "object_stats"}])
         assert "stats" in replies[0]
     finally:
         os.environ["RAY_TPU_WIRE_ENCODING"] = "proto"
+
+
+def test_proto_is_the_default_encoding(monkeypatch):
+    """The typed contract is the default wire; pickle is the opt-out
+    (reference: typed protos ARE the reference's control plane)."""
+    from ray_tpu.core import protocol
+    monkeypatch.delenv("RAY_TPU_WIRE_ENCODING", raising=False)
+    assert protocol.default_encoding() == "proto"
+    monkeypatch.setenv("RAY_TPU_WIRE_ENCODING", "pickle")
+    assert protocol.default_encoding() == "pickle"

@@ -1,0 +1,124 @@
+"""AlphaZero + SlateQ (reference: rllib/algorithms/{alpha_zero,slateq}).
+
+Convergence thresholds follow the repo's test strategy: each algorithm
+must clearly beat its random baseline on its built-in env.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.alpha_zero import (AlphaZero, AlphaZeroConfig,
+                                      GridGoal, RankedRewardsBuffer)
+from ray_tpu.rllib.slateq import (InterestEvolution, SlateQConfig,
+                                  enumerate_slates)
+
+
+class TestAlphaZero:
+    def test_grid_goal_env_contract(self):
+        env = GridGoal(seed=0)
+        obs = env.reset()
+        assert set(obs) == {"obs", "action_mask"}
+        assert obs["obs"].shape == (env.observation_dim,)
+        s = env.get_state()
+        env.step(1)
+        env.set_state(s)
+        assert env.get_state() == s
+        # deterministic: same action sequence, same outcome
+        env.reset()
+        for a in [1, 1, 1, 2, 2, 2]:
+            obs, rew, done, _ = env.step(a)
+        assert not done
+        obs, rew, done, _ = env.step(1)
+        obs, rew, done, _ = env.step(2)
+        assert done and rew == 1.0        # reached (3,3) in 8 steps
+
+    def test_ranked_rewards_binary_scores(self):
+        r2 = RankedRewardsBuffer(10, 60.0)
+        assert r2.normalize(1.0) == 1.0 and r2.normalize(0.0) == -1.0
+        for _ in range(10):
+            r2.add(0.0)
+        assert r2.normalize(0.0) == -1.0 and r2.normalize(1.0) == 1.0
+        for _ in range(10):
+            r2.add(1.0)
+        assert r2.normalize(1.0) == 1.0 and r2.normalize(0.0) == -1.0
+
+    def test_mcts_search_restores_env_and_sums_to_one(self):
+        algo = AlphaZeroConfig(num_sims=16, seed=0).build()
+        env = algo.env
+        obs = env.reset()
+        before = env.get_state()
+        pi = algo.mcts.search(env, obs)
+        assert env.get_state() == before, "search must restore the env"
+        assert pi.shape == (env.num_actions,)
+        assert abs(float(pi.sum()) - 1.0) < 1e-5
+
+    @pytest.mark.slow
+    def test_alpha_zero_solves_grid_goal(self):
+        algo = AlphaZeroConfig(num_sims=48, episodes_per_iter=8,
+                               batch_size=64, seed=0).build()
+        for _ in range(12):
+            r = algo.train()
+        # random play on GridGoal succeeds <5% of the time; planning
+        # with learned value/priors should make it routine
+        recent = float(np.mean(algo._ep_returns[-24:]))
+        assert recent > 0.6, f"AlphaZero stuck at {recent}"
+        # checkpoint round-trips
+        ck = algo.save_checkpoint()
+        algo2 = AlphaZeroConfig(num_sims=48, seed=1).build()
+        algo2.load_checkpoint(ck)
+        assert algo2._timesteps == algo._timesteps
+
+
+class TestSlateQ:
+    def test_enumerate_slates(self):
+        sl = enumerate_slates(4, 2)
+        assert sl.shape == (12, 2)           # 4P2 ordered slates
+        assert len({tuple(r) for r in sl.tolist()}) == 12
+
+    def test_env_contract(self):
+        env = InterestEvolution(num_candidates=5, slate_size=2, seed=0)
+        obs = env.reset()
+        assert obs["user"].shape == (4,) and obs["doc"].shape == (5, 4)
+        obs, rew, done, info = env.step([0, 1])
+        assert info["click"] in (0, 1, 2)    # slate pos or no-click
+        assert rew >= 0.0
+
+    def test_training_step_and_shapes(self):
+        algo = SlateQConfig(num_candidates=6, slate_size=2,
+                            rollout_length=64, learning_starts=32,
+                            batch_size=16, seed=0).build()
+        r = algo.train()
+        assert r["steps_this_iter"] == 64
+        assert r["replay_size"] == 64
+        r = algo.train()
+        assert r["mean_q_loss"] >= 0.0 and r["mean_choice_loss"] > 0.0
+
+    @pytest.mark.slow
+    def test_slateq_beats_random_slates(self):
+        cfg = SlateQConfig(num_candidates=8, slate_size=2,
+                           rollout_length=256, learning_starts=400,
+                           batch_size=64, epsilon_decay_steps=2500,
+                           seed=0)
+        algo = cfg.build()
+        for _ in range(16):
+            algo.train()
+        learned = float(np.mean(algo._ep_returns[-30:]))
+
+        # random-slate baseline on an identical env stream
+        env = InterestEvolution(num_candidates=8, slate_size=2, seed=99)
+        rng = np.random.default_rng(1)
+        rand_returns, ep = [], 0.0
+        env.reset()
+        for _ in range(algo.config.episode_len * 30):
+            slate = rng.choice(env.C, env.S, replace=False)
+            _, rew, done, _ = env.step(slate)
+            ep += rew
+            if done:
+                rand_returns.append(ep)
+                ep = 0.0
+                env.reset()
+        baseline = float(np.mean(rand_returns))
+        assert learned > baseline * 1.15, (
+            f"SlateQ {learned:.2f} vs random {baseline:.2f}")

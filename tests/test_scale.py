@@ -1,0 +1,152 @@
+"""Scale + chaos: many virtual nodes, queue depth, broadcast, NodeKiller.
+
+Reference capability: release/benchmarks/README.md:5-31 (scheduling
+envelope: many nodes / actors / queued tasks), the NodeKiller chaos
+utility (_private/test_utils.py:1337), and chaos release tests where
+training survives node churn.  CI runs moderate sizes on this 1-core
+box; `benchmarks/scale_envelope.py` runs the full envelope and records
+SCALE_r03.json.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.chaos import NodeKiller, kill_node_at, list_cluster_nodes
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_eight_nodes_deep_task_queue(cluster):
+    """8 virtual nodes; a queue of 2,000 no-op tasks drains completely
+    (queue depth >> worker count exercises admission + spillover)."""
+    nodes = [cluster.add_node(num_cpus=1) for _ in range(8)]
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=nodes[0].address)
+
+    @ray_tpu.remote
+    def tick(i):
+        return i
+
+    n = 2000
+    t0 = time.time()
+    refs = [tick.remote(i) for i in range(n)]
+    submitted = time.time() - t0
+    out = ray_tpu.get(refs, timeout=600)
+    drained = time.time() - t0
+    assert out == list(range(n))
+    assert submitted < 60 and drained < 600
+    print(f"submit {n / submitted:.0f}/s drain {n / drained:.0f}/s")
+
+
+def test_many_actors_across_nodes(cluster):
+    """A wave of actors lands across 8 nodes and all respond (envelope
+    slice of the reference's many-actor benchmark)."""
+    nodes = [cluster.add_node(num_cpus=4) for _ in range(8)]
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=nodes[0].address)
+
+    @ray_tpu.remote
+    class Echo:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            import os
+            return (self.i, os.getpid())
+
+    n = 24
+    actors = [Echo.remote(i) for i in range(n)]
+    out = ray_tpu.get([a.who.remote() for a in actors], timeout=600)
+    assert sorted(i for i, _ in out) == list(range(n))
+    assert len({pid for _, pid in out}) == n   # one process each
+
+
+def test_broadcast_to_all_nodes(cluster):
+    """One shm object is pulled by a consumer on EVERY node (the 1-GiB
+    broadcast shape at CI size)."""
+    nodes = [cluster.add_node(num_cpus=1, resources={f"n{i}": 1})
+             for i in range(8)]
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=nodes[0].address)
+
+    mb = 64
+    blob = ray_tpu.put(np.ones(mb * 1024 * 128, dtype=np.float64))  # 64MiB
+
+    def make(i):
+        @ray_tpu.remote(resources={f"n{i}": 1})
+        def consume(x):
+            return float(x[::4096].sum())
+        return consume
+
+    t0 = time.time()
+    outs = ray_tpu.get([make(i).remote(blob) for i in range(8)],
+                       timeout=600)
+    dt = time.time() - t0
+    assert all(o == outs[0] for o in outs)
+    print(f"broadcast {mb}MiB x8 in {dt:.1f}s "
+          f"({8 * mb / max(dt, 1e-9):.0f} MiB/s aggregate)")
+
+
+def test_kill_random_node_cli_helper(cluster):
+    nodes = [cluster.add_node(num_cpus=1) for _ in range(3)]
+    cluster.wait_for_nodes()
+    listed = list_cluster_nodes(nodes[0].address)
+    assert len([n for n in listed if n["alive"]]) == 3
+    assert kill_node_at(nodes[2].address)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        alive = [n for n in list_cluster_nodes(nodes[0].address)
+                 if n["alive"]]
+        if len(alive) == 2:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("killed node never left the membership view")
+
+
+def test_training_survives_random_node_kill(cluster):
+    """Chaos: an ES run with remote rollout evaluation keeps training
+    while a NodeKiller stops a random compute node (task retries +
+    churn), and its checkpoint restores into a fresh algorithm."""
+    n0 = cluster.add_node(num_cpus=1)
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=n0.address)
+
+    from ray_tpu.rllib.es import ESConfig
+    algo = ESConfig(env="CartPole-v1", pop_size=4, episodes_per_eval=1,
+                    max_episode_steps=50, eval_parallelism=4,
+                    seed=0).build()
+
+    killer = NodeKiller(
+        cluster, interval=1.5, max_kills=2, exclude=(n0,),
+        replace=lambda: cluster.add_node(num_cpus=2), seed=7).start()
+    try:
+        for _ in range(4):
+            r = algo.train()
+            assert r["steps_this_iter"] > 0
+    finally:
+        killer.stop()
+    assert len(killer.killed) >= 1, "chaos never actually fired"
+
+    ck = algo.save_checkpoint()
+    algo2 = ESConfig(env="CartPole-v1", pop_size=4, episodes_per_eval=1,
+                     max_episode_steps=50, eval_parallelism=4,
+                     seed=0).build()
+    algo2.load_checkpoint(ck)
+    assert algo2._timesteps == algo._timesteps > 0
+    r = algo2.train()
+    assert r["steps_this_iter"] > 0
