@@ -183,6 +183,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # per executor class.
         self.runnable_cpu: deque[dict] = deque()
         self.runnable_tpu: deque[dict] = deque()
+        # incremental aggregates over the runnable queues: admission and
+        # spawn decisions run PER EVENT, so recomputing by iterating a
+        # deep queue would be O(backlog) per task -> O(n^2) per burst
+        self._queued_demand: dict[str, float] = {}
+        self._queued_pg = 0
         self.dep_waiting: dict[ObjectID, list] = {}  # oid -> waiting specs
         self.actors: dict[ActorID, ActorRec] = {}
         self.named_actors: dict[tuple[str, str], ActorID] = {}
@@ -330,7 +335,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     break   # dispatches here as soon as a worker frees
                 if not self._cluster_has_capacity(spec):
                     break
-                q.popleft()
+                self._queue_pop(q)
                 self._forward_task(spec)
                 moved += 1
 
@@ -371,7 +376,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     # ------------------------------------------------------- head channel
 
     def _connect_head(self) -> None:
-        conn = protocol.connect(self.head_address)
+        conn = protocol.connect(self.head_address, remote=True)
         conn.send({"t": "register_node", "reqid": 0,
                    "node_id": self.node_id.hex(), "address": self.address,
                    "resources": self.total_resources,
@@ -431,7 +436,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
         def work():
             try:
-                conn = protocol.connect(self.head_address, timeout=3.0)
+                conn = protocol.connect(self.head_address, timeout=3.0, remote=True)
                 conn.send({"t": "register_node", "reqid": 0,
                            "node_id": self.node_id.hex(),
                            "address": self.address,
@@ -538,13 +543,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._hb_inflight = False
             if not reply.get("error"):
                 self.cluster_view = reply.get("view", self.cluster_view)
-        queued: dict[str, float] = {}
-        for q in (self.runnable_cpu, self.runnable_tpu):
-            for s in q:
-                if s.get("placement_group"):
-                    continue
-                for k, v in self._demand(s).items():
-                    queued[k] = queued.get(k, 0.0) + v
+        queued = {k: v for k, v in self._queued_demand.items()
+                  if v > 1e-9}
         self._head_rpc({"t": "heartbeat",
                         "available": self._projected_available(),
                         "total": self.total_resources,
@@ -1070,12 +1070,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         committed resources the same way,
         hybrid_scheduling_policy.h)."""
         proj = dict(self.available)
-        for q in (self.runnable_cpu, self.runnable_tpu):
-            for s in q:
-                if s.get("placement_group"):
-                    continue   # draws on its bundle, not the node pool
-                for k, v in self._demand(s).items():
-                    proj[k] = proj.get(k, 0.0) - v
+        for k, v in self._queued_demand.items():
+            proj[k] = proj.get(k, 0.0) - v
         return {k: max(0.0, v) for k, v in proj.items()}
 
     def _available_covers(self, spec: dict) -> bool:
@@ -1181,6 +1177,24 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self.runnable_tpu.append(spec)
         else:
             self.runnable_cpu.append(spec)
+        if spec.get("placement_group"):
+            self._queued_pg += 1
+        else:
+            for k, v in self._demand(spec).items():
+                self._queued_demand[k] = self._queued_demand.get(k, 0.0) + v
+
+    def _queue_pop(self, q: deque) -> dict:
+        spec = q.popleft()
+        if spec.get("placement_group"):
+            self._queued_pg = max(0, self._queued_pg - 1)
+        else:
+            for k, v in self._demand(spec).items():
+                self._queued_demand[k] = self._queued_demand.get(k, 0.0) - v
+        if not self.runnable_cpu and not self.runnable_tpu:
+            # drain point: clear float drift
+            self._queued_demand.clear()
+            self._queued_pg = 0
+        return spec
 
     def _h_task_done(self, rec, m):
         tid = m["task_id"]
@@ -1292,7 +1306,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     break
                 if not self._try_acquire(spec):
                     break
-                q.popleft()
+                self._queue_pop(q)
                 self._dispatch_task(w, spec)
 
     def _find_idle_worker(self, tpu: bool,
@@ -1358,7 +1372,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # bundle reservation instead, and actors hold no CPU — both always
         # need a process.  Concurrent startups are capped (reference:
         # worker_pool.h maximum_startup_concurrency :192,717).
-        n_pg = sum(1 for s in self.runnable_cpu if s.get("placement_group"))
+        n_pg = min(self._queued_pg, len(self.runnable_cpu))
         cpu_demand = min(len(self.runnable_cpu) - n_pg,
                          max(0, int(self.available.get("CPU", 0.0))))
         demand = cpu_demand + n_pg + n_actors_waiting
@@ -1945,7 +1959,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         def work():
             c = None
             try:
-                c = protocol.connect(address, timeout=5.0)
+                c = protocol.connect(address, timeout=5.0, remote=True)
                 c.send({"t": "register", "kind": "peer", "reqid": 0,
                         "node_hex": self.node_id.hex(),
                         "worker_id": f"peer-{self.node_id.hex()[:12]}"})

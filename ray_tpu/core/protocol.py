@@ -48,14 +48,22 @@ def payload_encoding(data: bytes) -> str:
     return "proto" if data[:1] == _TAG_PROTO else "pickle"
 
 
-def default_encoding() -> str:
-    """Process-wide wire encoding.  The typed protobuf contract is the
-    DEFAULT (reference: every control-plane RPC is a typed proto,
-    src/ray/protobuf/); RAY_TPU_WIRE_ENCODING=pickle opts back into
-    plain pickle framing (debugging / maximum-compat escape hatch)."""
+def default_encoding(remote: bool = False) -> str:
+    """Wire encoding defaults, overridable by RAY_TPU_WIRE_ENCODING.
+
+    The typed protobuf contract is the DEFAULT on REMOTE links — the
+    node↔node and node↔head channels that actually cross machines and
+    need a language-neutral, evolvable schema (reference: every
+    control-plane RPC is a typed proto, src/ray/protobuf/).  Local
+    loopback links (a driver or worker talking to its own node) default
+    to pickle: same process image on both ends, and python-side proto
+    encode costs ~3-6x per message, which is pure overhead on-host.
+    Frames are self-describing, so mixed encodings interoperate."""
     import os
-    return ("pickle" if os.environ.get("RAY_TPU_WIRE_ENCODING", "")
-            .lower() == "pickle" else "proto")
+    forced = os.environ.get("RAY_TPU_WIRE_ENCODING", "").lower()
+    if forced in ("pickle", "proto"):
+        return forced
+    return "proto" if remote else "pickle"
 
 
 class ConnectionClosed(Exception):
@@ -114,7 +122,8 @@ class Connection:
         self.sock.close()
 
 
-def connect(address: str, timeout: float = 30.0) -> Connection:
+def connect(address: str, timeout: float = 30.0,
+            remote: bool = False) -> Connection:
     if address.startswith("unix://"):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(timeout)
@@ -123,7 +132,7 @@ def connect(address: str, timeout: float = 30.0) -> Connection:
         host, port = address.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=timeout)
     sock.settimeout(None)
-    return Connection(sock)
+    return Connection(sock, encoding=default_encoding(remote))
 
 
 def dumps_frame(msg: dict, encoding: str = "pickle") -> bytes:

@@ -52,6 +52,9 @@ class ClientRec:
     seen_envs: set = field(default_factory=set)  # runtime-env hashes run
 
 
+_WAKER = object()   # selector sentinel for the self-pipe
+
+
 class EventLoopService:
     """Base: listener + selector loop + push/reply plumbing."""
 
@@ -75,6 +78,15 @@ class EventLoopService:
         self._posted_lock = threading.Lock()
         self._last_tick = 0.0
         self.tick_interval = 0.25
+        # self-pipe waker: post() from another thread (peer receivers,
+        # the head channel, timers) must interrupt select() NOW — waiting
+        # out the poll timeout adds up to 50 ms to every cross-thread
+        # event (object chunks, forwarded tasks, ...)
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
+        self._waker_w.setblocking(False)
+        self._wake_armed = False
+        self.sel.register(self._waker_r, selectors.EVENT_READ, _WAKER)
         # outbound RPC correlation: reqid -> callback(reply_msg)
         self._rpc_seq = 0
         self._rpc_pending: dict[int, Callable[[dict], None]] = {}
@@ -84,6 +96,12 @@ class EventLoopService:
     def post(self, fn) -> None:
         with self._posted_lock:
             self._posted.append(fn)
+            if not self._wake_armed:
+                self._wake_armed = True
+                try:
+                    self._waker_w.send(b"x")
+                except (BlockingIOError, OSError):
+                    pass   # already saturated: the loop will wake anyway
 
     def post_later(self, delay: float, fn) -> None:
         t = threading.Timer(delay, lambda: self.post(fn))
@@ -98,6 +116,8 @@ class EventLoopService:
 
     def run(self) -> None:
         while not self._stop.is_set():
+            with self._posted_lock:
+                self._wake_armed = False
             while True:
                 with self._posted_lock:
                     if not self._posted:
@@ -121,7 +141,13 @@ class EventLoopService:
             except OSError:
                 continue
             for key, mask in events:
-                if key.data is None:
+                if key.data is _WAKER:
+                    try:
+                        while self._waker_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                elif key.data is None:
                     self._accept()
                 else:
                     rec: ClientRec = key.data
@@ -168,6 +194,11 @@ class EventLoopService:
             except OSError:
                 pass
         self.listener.close()
+        for s in (self._waker_r, self._waker_w):
+            try:
+                s.close()
+            except OSError:
+                pass
         self.sel.close()
 
     # ----------------------------------------------------------------- io

@@ -77,4 +77,13 @@ def attention(q, k, v, *, causal: bool = True,
                                block_q=block_q, block_k=block_k)
     if impl == "reference":
         return mha_reference(q, k, v, causal=causal, scale=scale, mask=mask)
+    if impl == "xla_fused":
+        # XLA's own fused attention path (jax.nn.dot_product_attention,
+        # [b, s, h, d] layout)
+        if mask is not None:
+            raise ValueError("xla_fused impl has no custom-mask support")
+        out = jax.nn.dot_product_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), scale=scale, is_causal=causal)
+        return out.transpose(0, 2, 1, 3)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -151,13 +151,18 @@ def _bwd_rule(scale, causal, block_q, block_k, res, do):
         # ragged kv — fall back to one full-matrix block
         bk, nk = kv_len, 1
 
-    qf = q.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # [b,h,sq]
+    # Matmul INPUTS stay in the model dtype (bf16 rides the MXU at full
+    # rate; f32 inputs run at a fraction of it and quadruple the HBM
+    # traffic of the big [sq, bk] intermediates).  Accumulation is f32
+    # via preferred_element_type; softmax math is f32 throughout.
+    qf = q
+    dof = do
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # [b,h,sq] f32
     row = jnp.arange(sq)[:, None] + (kv_len - sq)
 
-    kb = k.reshape(b, h, nk, bk, d).astype(jnp.float32)
-    vb = v.reshape(b, h, nk, bk, d).astype(jnp.float32)
+    kb = k.reshape(b, h, nk, bk, d)
+    vb = v.reshape(b, h, nk, bk, d)
 
     # recompute logsumexp block-wise (the flash trade: FLOPs for memory)
     def lse_step(carry, j):
@@ -187,12 +192,17 @@ def _bwd_rule(scale, causal, block_q, block_k, res, do):
         if causal:
             col = j * bk + jnp.arange(bk)[None, :]
             logits = jnp.where(row >= col, logits, NEG_INF)
-        p = jnp.exp(logits - lse[..., None])  # [b,h,sq,bk]
-        dvj = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vj)
-        ds = p * (dp - delta[..., None])  # [b,h,sq,bk]
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kj) * s
-        dkj = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * s
+        p = jnp.exp(logits - lse[..., None])  # [b,h,sq,bk] f32
+        pb = p.astype(q.dtype)                # matmul operand in bf16
+        dvj = jnp.einsum("bhqk,bhqd->bhkd", pb, dof,
+                         preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vj,
+                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None])).astype(q.dtype)  # [b,h,sq,bk]
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kj,
+                             preferred_element_type=jnp.float32) * s
+        dkj = jnp.einsum("bhqk,bhqd->bhkd", ds, qf,
+                         preferred_element_type=jnp.float32) * s
         return dq, (dkj, dvj)
 
     dq0 = jnp.zeros((b, h, sq, d), jnp.float32)

@@ -98,11 +98,15 @@ def test_mixed_encodings_one_node(proto_rt):
         os.environ["RAY_TPU_WIRE_ENCODING"] = "proto"
 
 
-def test_proto_is_the_default_encoding(monkeypatch):
-    """The typed contract is the default wire; pickle is the opt-out
-    (reference: typed protos ARE the reference's control plane)."""
+def test_proto_is_the_default_remote_encoding(monkeypatch):
+    """The typed contract is the default on REMOTE links (node↔node,
+    node↔head — the cross-machine wire); local loopback stays pickle
+    for speed.  The env var forces either everywhere."""
     from ray_tpu.core import protocol
     monkeypatch.delenv("RAY_TPU_WIRE_ENCODING", raising=False)
-    assert protocol.default_encoding() == "proto"
+    assert protocol.default_encoding(remote=True) == "proto"
+    assert protocol.default_encoding(remote=False) == "pickle"
     monkeypatch.setenv("RAY_TPU_WIRE_ENCODING", "pickle")
-    assert protocol.default_encoding() == "pickle"
+    assert protocol.default_encoding(remote=True) == "pickle"
+    monkeypatch.setenv("RAY_TPU_WIRE_ENCODING", "proto")
+    assert protocol.default_encoding(remote=False) == "proto"
