@@ -8,7 +8,10 @@ prunes the kv loop to the lower triangle.
 
 Backward is a custom VJP that recomputes probabilities block-by-block from
 the saved logsumexp (the standard flash trade: extra FLOPs for O(s·block)
-memory), written in plain jax so XLA fuses it; it runs anywhere.
+memory).  On block-aligned shapes it runs as two fused pallas kernels —
+one grid pass over kv blocks producing dk/dv, one over q blocks producing
+dq — with bf16 matmul operands and f32 accumulation; ragged shapes fall
+back to a plain-jax scan that XLA fuses.
 
 Reference capability context: the reference framework has no fused
 attention of its own (it rides torch/CUDA kernels); this is the TPU-native
@@ -26,9 +29,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+# lse/delta ride VMEM broadcast across one full lane register, the same
+# convention as jax's reference TPU flash kernel (MIN_BLOCK_SIZE lanes):
+# scalar-per-row vectors are awkward on the VPU, a [rows, 128] tile is not.
+LANES = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse,
                 scale: float, causal: bool, block_k: int, kv_len: int,
                 q_len: int):
     qi = pl.program_id(1)
@@ -39,6 +46,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *,
     # (decode with kv cache) puts q at the TAIL of the kv sequence, same
     # convention as mha_reference's (k_len - q_len) offset
     q_offset = qi * block_q + (kv_len - q_len)
+    # k_ref/v_ref are zero-padded to a block multiple by the caller; the
+    # padded columns are masked below (col >= kv_len)
     ragged = kv_len % block_k != 0
 
     num_kv_blocks = pl.cdiv(kv_len, block_k)
@@ -59,14 +68,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *,
         col = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         if ragged:
-            # the last block's ds() clamps its start, re-reading earlier
-            # keys — mask out columns past kv_len (clamped ds shifts the
-            # window back by (block_k - rem), so recompute real positions)
-            start = jnp.minimum(j * block_k, kv_len - block_k)
-            col = start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = col >= j * block_k
-            s = jnp.where(valid, s, NEG_INF)
+            s = jnp.where(col < kv_len, s, NEG_INF)
         if causal:
             row = q_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -86,9 +88,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *,
 
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (acc / l).astype(o_ref.dtype)
+    if maybe_lse:
+        # training path only: inference skips the extra HBM write (the
+        # pallas body is opaque to XLA, so an unused output would not be
+        # dead-code-eliminated)
+        l_ref, = maybe_lse
+        lse = m + jnp.log(l)  # [bq, 1]
+        l_ref[...] = jax.lax.broadcast_in_dim(
+            lse[:, 0], l_ref.shape, (0,))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, need_lse=False):
     b, h, sq, d = q.shape
     kv_len = k.shape[2]
     block_q = min(block_q, sq)
@@ -96,23 +106,35 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, kv_len, d)
     vf = v.reshape(b * h, kv_len, d)
+    kv_pad = (-kv_len) % block_k
+    if kv_pad:
+        # zero-pad ragged kv to a block multiple; kernel masks col>=kv_len
+        # (in-kernel ds clamping is not portable: interpret mode returns
+        # zeros for out-of-bounds rows instead of clamping the start)
+        kf = jnp.pad(kf, ((0, 0), (0, kv_pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, kv_pad), (0, 0)))
 
     grid = (b * h, pl.cdiv(sq, block_q))
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, kv_len=kv_len, q_len=sq)
-    out = pl.pallas_call(
+    o_spec = pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0))
+    o_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)
+    lse_spec = pl.BlockSpec((None, block_q, LANES), lambda bh, qi: (bh, qi, 0))
+    lse_shape = jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32)
+    res = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, kv_len, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, kv_len, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, kv_len + kv_pad, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, kv_len + kv_pad, d), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[o_spec, lse_spec] if need_lse else [o_spec],
+        out_shape=[o_shape, lse_shape] if need_lse else [o_shape],
         interpret=_interpret_mode(),
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d)
+    out = res[0].reshape(b, h, sq, d)
+    return (out, res[1]) if need_lse else (out, None)
 
 
 def _interpret_mode() -> bool:
@@ -124,7 +146,7 @@ def _interpret_mode() -> bool:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, scale, causal, block_q, block_k):
     s = (q.shape[-1] ** -0.5) if scale is None else scale
-    return _flash_fwd(q, k, v, s, causal, block_q, block_k)
+    return _flash_fwd(q, k, v, s, causal, block_q, block_k)[0]
 
 
 def flash_attention(q, k, v, *, scale: Optional[float] = None,
@@ -136,19 +158,199 @@ def flash_attention(q, k, v, *, scale: Optional[float] = None,
 
 def _fwd_rule(q, k, v, scale, causal, block_q, block_k):
     s = (q.shape[-1] ** -0.5) if scale is None else scale
-    out = _flash_fwd(q, k, v, s, causal, block_q, block_k)
-    return out, (q, k, v, out)
+    out, lse = _flash_fwd(q, k, v, s, causal, block_q, block_k,
+                          need_lse=True)
+    return out, (q, k, v, out, lse)
+
+
+def _recompute_p_ds(qj, doj, k, v, lse, delta, row0, col0, scale, causal):
+    """Shared backward recompute: probabilities p from the saved lse and
+    the softmax-jacobian product ds, for one (q block, kv block) pair.
+    row0/col0 are the blocks' global offsets (row0 includes the causal
+    diagonal offset).  Returns (p f32, ds in model dtype, both [bq, bk])."""
+    block_q, block_k = qj.shape[0], k.shape[0]
+    lanes_rep = block_k // LANES
+    s = jax.lax.dot_general(
+        qj, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # [bq, bk]
+    if causal:
+        row = row0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        col = col0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(row >= col, s, NEG_INF)
+    p = jnp.exp(s - jnp.tile(lse, (1, lanes_rep)))       # [bq, bk] f32
+    # dp = do @ vᵀ
+    dp = jax.lax.dot_general(
+        doj, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [bq, bk]
+    ds = (p * (dp - jnp.tile(delta, (1, lanes_rep)))
+          * scale).astype(qj.dtype)
+    return p, ds
+
+
+def _bwd_kv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc, *,
+                   scale: float, causal: bool, nq: int,
+                   q_len: int, kv_len: int):
+    """Grid (bh, kv-block, q-block): the innermost q dimension streams one
+    [block_q, d] slice of q/do/lse/delta per step (VMEM stays O(block),
+    independent of sequence length), accumulating dk/dv for the resident
+    kv block in f32 VMEM scratch, flushed on the last q step."""
+    ki = pl.program_id(1)
+    j = pl.program_id(2)
+    block_k = k_ref.shape[0]
+    block_q = q_ref.shape[0]
+    off = kv_len - q_len
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # causal: skip q blocks fully above the diagonal for this kv block
+    live = (j * block_q + off + block_q - 1 >= ki * block_k) \
+        if causal else (j >= 0)
+
+    @pl.when(live)
+    def _accumulate():
+        qj = q_ref[...]       # [bq, d] model dtype
+        doj = do_ref[...]
+        p, ds = _recompute_p_ds(
+            qj, doj, k_ref[...], v_ref[...], lse_ref[...], delta_ref[...],
+            row0=j * block_q + off, col0=ki * block_k,
+            scale=scale, causal=causal)
+        # dv += pᵀ @ do
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(qj.dtype), doj, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+        # dk += dsᵀ @ q
+        dk_acc[...] += jax.lax.dot_general(
+            ds, qj, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+
+    @pl.when(j == nq - 1)
+    def _flush():
+        dk_ref[...] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                   dq_ref, dq_acc, *,
+                   scale: float, causal: bool, nk: int,
+                   q_len: int, kv_len: int):
+    """Grid (bh, q-block, kv-block): streams one kv block per innermost
+    step, accumulating dq for the resident q block in f32 scratch."""
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    off = kv_len - q_len
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    # causal: kv blocks fully above the diagonal contribute nothing
+    live = (qi * block_q + off + block_q - 1 >= j * block_k) \
+        if causal else (j >= 0)
+
+    @pl.when(live)
+    def _accumulate():
+        kj = k_ref[...]         # [bk, d]
+        _, ds = _recompute_p_ds(
+            q_ref[...], do_ref[...], kj, v_ref[...], lse_ref[...],
+            delta_ref[...],
+            row0=qi * block_q + off, col0=j * block_k,
+            scale=scale, causal=causal)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, d]
+
+    @pl.when(j == nk - 1)
+    def _flush():
+        dq_ref[...] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_pallas(scale, causal, bq, bk, res, do):
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    kv_len = k.shape[2]
+    bh = b * h
+    nq = sq // bq
+    nk = kv_len // bk
+
+    qf = q.reshape(bh, sq, d)
+    kf = k.reshape(bh, kv_len, d)
+    vf = v.reshape(bh, kv_len, d)
+    dof = do.reshape(bh, sq, d)
+    # delta_i = Σ_d do·o — cheap rowwise reduce, XLA fuses it; broadcast
+    # across lanes to match the lse layout.
+    delta = jnp.sum(dof.astype(jnp.float32)
+                    * out.reshape(bh, sq, d).astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, LANES))
+
+    interpret = _interpret_mode()
+    # the innermost grid dim revisits the same output block (accumulation)
+    params = {} if interpret else dict(compiler_params=pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")))
+
+    # grid (bh, ki, j): q/do/lse/delta stream along j, k/v pinned by ki
+    q_j = pl.BlockSpec((None, bq, d), lambda g, ki, j: (g, j, 0))
+    lane_j = pl.BlockSpec((None, bq, LANES), lambda g, ki, j: (g, j, 0))
+    kv_ki = pl.BlockSpec((None, bk, d), lambda g, ki, j: (g, ki, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kv_kernel, scale=scale, causal=causal,
+                          nq=nq, q_len=sq, kv_len=kv_len),
+        grid=(bh, nk, nq),
+        in_specs=[q_j, q_j, lane_j, lane_j, kv_ki, kv_ki],
+        out_specs=[kv_ki, kv_ki],
+        out_shape=[jax.ShapeDtypeStruct((bh, kv_len, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, kv_len, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(qf, dof, lse, delta, kf, vf)
+
+    # grid (bh, qi, j): k/v stream along j, q/do/lse/delta pinned by qi
+    q_qi = pl.BlockSpec((None, bq, d), lambda g, qi, j: (g, qi, 0))
+    lane_qi = pl.BlockSpec((None, bq, LANES), lambda g, qi, j: (g, qi, 0))
+    kv_j = pl.BlockSpec((None, bk, d), lambda g, qi, j: (g, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          nk=nk, q_len=sq, kv_len=kv_len),
+        grid=(bh, nq, nk),
+        in_specs=[q_qi, q_qi, lane_qi, lane_qi, kv_j, kv_j],
+        out_specs=q_qi,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(qf, dof, lse, delta, kf, vf)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, kv_len, d),
+            dv.reshape(b, h, kv_len, d))
 
 
 def _bwd_rule(scale, causal, block_q, block_k, res, do):
-    q, k, v, out = res
+    q, k, v, out, lse_lanes = res
     s = (q.shape[-1] ** -0.5) if scale is None else scale
     b, h, sq, d = q.shape
     kv_len = k.shape[2]
+    bq = min(block_q, sq)
     bk = min(block_k, kv_len)
+    if sq % bq == 0 and kv_len % bk == 0 and bk % LANES == 0:
+        return _bwd_pallas(s, causal, bq, bk, res, do)
+
+    # ragged fallback: plain jax, one full-matrix kv block if ragged
     nk = kv_len // bk if kv_len % bk == 0 else None
     if nk is None:
-        # ragged kv — fall back to one full-matrix block
         bk, nk = kv_len, 1
 
     # Matmul INPUTS stay in the model dtype (bf16 rides the MXU at full
@@ -164,25 +366,7 @@ def _bwd_rule(scale, causal, block_q, block_k, res, do):
     kb = k.reshape(b, h, nk, bk, d)
     vb = v.reshape(b, h, nk, bk, d)
 
-    # recompute logsumexp block-wise (the flash trade: FLOPs for memory)
-    def lse_step(carry, j):
-        m_prev, l_prev = carry
-        kj = kb[:, :, j]
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kj,
-                            preferred_element_type=jnp.float32) * s
-        if causal:
-            col = j * bk + jnp.arange(bk)[None, :]
-            logits = jnp.where(row >= col, logits, NEG_INF)
-        m_cur = jnp.max(logits, axis=-1)
-        m_next = jnp.maximum(m_prev, m_cur)
-        l_next = (l_prev * jnp.exp(m_prev - m_next)
-                  + jnp.sum(jnp.exp(logits - m_next[..., None]), axis=-1))
-        return (m_next, l_next), None
-
-    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
-    (m, l), _ = jax.lax.scan(lse_step, (m0, l0), jnp.arange(nk))
-    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    lse = lse_lanes[..., 0].reshape(b, h, sq)
 
     def kv_step(dq, j):
         kj = kb[:, :, j]  # [b,h,bk,d]
