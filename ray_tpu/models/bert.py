@@ -40,6 +40,7 @@ class BERTConfig:
     remat: bool = True
     ignore_index: int = -100         # label value meaning "not an MLM target"
     attn_impl: Optional[str] = None  # None=auto (flash on TPU), "reference"
+    pp_microbatches: Optional[int] = None  # None = 2*pp stages (GPipe)
 
     @property
     def head_dim(self) -> int:
@@ -156,19 +157,20 @@ def encode(params, tokens, cfg: BERTConfig, *,
         attn_mask = attention_mask[:, None, None, :].astype(bool)
 
     def layer(x, lp):
+        bx, sx = x.shape[0], x.shape[1]  # microbatched under pp
         qkv = jnp.einsum("bsd,de->bse", x, lp["wqkv"].astype(cfg.dtype))
         qkv = _constrain(qkv, ("batch", "seq", "qkv"), mesh, rules)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
-            return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+            return t.reshape(bx, sx, h, hd).transpose(0, 2, 1, 3)
 
         # auto-dispatch (pallas flash on TPU) when there is no padding
         # mask; the masked path needs the reference impl
         impl = "reference" if attn_mask is not None else cfg.attn_impl
         o = attention(heads(q), heads(k), heads(v), causal=False,
                       mask=attn_mask, impl=impl)
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        o = o.transpose(0, 2, 1, 3).reshape(bx, sx, cfg.d_model)
         o = jnp.einsum("bsd,de->bse", o, lp["wo"].astype(cfg.dtype)) \
             + lp["bo"].astype(cfg.dtype)
         x = _layer_norm(x + o, lp["ln1_scale"], lp["ln1_bias"])  # post-LN
@@ -185,6 +187,32 @@ def encode(params, tokens, cfg: BERTConfig, *,
         return x, None
 
     body = jax.checkpoint(layer) if cfg.remat else layer
+
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        # GPipe microbatch pipeline over pp (parallel.pipeline); the
+        # encoder stack is residual-stream shaped so the generic stage
+        # runner applies directly
+        from ray_tpu.parallel.pipeline import pipeline_apply
+        if attn_mask is not None:
+            raise NotImplementedError(
+                "attention_mask + pp pipeline is not supported yet; "
+                "pad-free batches only on pp meshes")
+        S = mesh.shape["pp"]
+        if cfg.n_layers % S != 0:
+            raise ValueError(
+                f"n_layers {cfg.n_layers} not divisible by pp={S}")
+        M = cfg.pp_microbatches or 2 * S
+        if b % M != 0:
+            raise ValueError(f"batch {b} not divisible by microbatches {M}")
+        x_mb = x.reshape(M, b // M, s, cfg.d_model)
+
+        def stage_fn(local_layers, xm):
+            xm, _ = lax.scan(body, xm, local_layers)
+            return xm
+
+        outs = pipeline_apply(stage_fn, x_mb, params["layers"], mesh=mesh)
+        return outs.reshape(b, s, cfg.d_model)
+
     x, _ = lax.scan(body, x, params["layers"])
     return x
 

@@ -62,6 +62,7 @@ class GPTConfig:
     remat_policy: Optional[str] = None   # None=full recompute, "dots"
     tie_embeddings: bool = True
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
+    pp_microbatches: Optional[int] = None  # None = 2*pp stages (GPipe)
 
     def __post_init__(self):
         if self.remat_policy not in (None, "dots"):
@@ -89,8 +90,9 @@ class GPTConfig:
 
 # -- params ----------------------------------------------------------------
 
-# logical axes per leaf; "layers" is the scan dim and never mesh-mapped
-# (rules map it to None; pp would shard it — see DEFAULT_LLM_RULES).
+# logical axes per leaf; "layers" is the scan dim, sharded over pp when
+# the mesh has one (DEFAULT_LLM_RULES maps layers->pp; pruned to None on
+# meshes without a pp axis).
 PARAM_AXES = {
     "wte": ("vocab", "embed"),
     "wpe": (None, "embed"),
@@ -187,47 +189,44 @@ def _attend(q, k, v, cfg: GPTConfig, mesh: Optional[Mesh], rules: Rules):
     return attention(q, k, v, causal=True, impl=cfg.attn_impl)
 
 
-def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
-            rules: Rules = DEFAULT_LLM_RULES):
-    """tokens [b, s] int32 → logits [b, s, vocab] (f32).
-
-    With a mesh, activations carry sharding constraints so pjit lays out
-    batch over dp/fsdp, heads/mlp over tp, seq over sp; without one it is
-    an ordinary single-device jax function.
-    """
-    b, s = tokens.shape
+def _transformer_layer(x, lp, cfg: GPTConfig, mesh: Optional[Mesh],
+                       rules: Rules):
+    """One pre-LN transformer block; x [b, s, d], lp = one layer's params
+    (no leading layers dim)."""
+    b, s, _ = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
 
-    x = params["wte"][tokens] + params["wpe"][:s][None, :, :]
-    x = x.astype(cfg.dtype)
+    y = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+    qkv = jnp.einsum("bsd,de->bse", y, lp["wqkv"].astype(cfg.dtype))
+    qkv = _constrain(qkv, ("batch", "seq", "qkv"), mesh, rules)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):  # [b, s, d] -> [b, h, s, hd]
+        return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+
+    o = _attend(heads(q), heads(k), heads(v), cfg, mesh, rules)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+    o = jnp.einsum("bsd,de->bse", o, lp["wo"].astype(cfg.dtype)) \
+        + lp["bo"].astype(cfg.dtype)
+    x = x + o
     x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
 
+    y = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+    u = jnp.einsum("bsd,df->bsf", y, lp["w_up"].astype(cfg.dtype)) \
+        + lp["b_up"].astype(cfg.dtype)
+    u = _constrain(u, ("batch", "seq", "mlp"), mesh, rules)
+    u = jax.nn.gelu(u)
+    dn = jnp.einsum("bsf,fd->bsd", u, lp["w_down"].astype(cfg.dtype)) \
+        + lp["b_down"].astype(cfg.dtype)
+    x = x + dn
+    x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
+    return x
+
+
+def _layer_scan_body(cfg: GPTConfig, mesh, rules):
+    """Scan body over a stacked layer dim, rematerialized per cfg."""
     def layer(x, lp):
-        y = _layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
-        qkv = jnp.einsum("bsd,de->bse", y, lp["wqkv"].astype(cfg.dtype))
-        qkv = _constrain(qkv, ("batch", "seq", "qkv"), mesh, rules)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):  # [b, s, d] -> [b, h, s, hd]
-            return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
-
-        o = _attend(heads(q), heads(k), heads(v), cfg, mesh, rules)
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-        o = jnp.einsum("bsd,de->bse", o, lp["wo"].astype(cfg.dtype)) \
-            + lp["bo"].astype(cfg.dtype)
-        x = x + o
-        x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
-
-        y = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
-        u = jnp.einsum("bsd,df->bsf", y, lp["w_up"].astype(cfg.dtype)) \
-            + lp["b_up"].astype(cfg.dtype)
-        u = _constrain(u, ("batch", "seq", "mlp"), mesh, rules)
-        u = jax.nn.gelu(u)
-        dn = jnp.einsum("bsf,fd->bsd", u, lp["w_down"].astype(cfg.dtype)) \
-            + lp["b_down"].astype(cfg.dtype)
-        x = x + dn
-        x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
-        return x, None
+        return _transformer_layer(x, lp, cfg, mesh, rules), None
 
     if cfg.remat:
         # "dots" keeps matmul outputs and recomputes only the cheap
@@ -237,16 +236,78 @@ def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
         # at GPTConfig construction)
         policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                   if cfg.remat_policy == "dots" else None)
-        body = jax.checkpoint(layer, policy=policy)
-    else:
-        body = layer
-    x, _ = lax.scan(body, x, params["layers"])
+        return jax.checkpoint(layer, policy=policy)
+    return layer
 
+
+def _embed(params, tokens, cfg: GPTConfig, mesh, rules):
+    s = tokens.shape[1]
+    x = params["wte"][tokens] + params["wpe"][:s][None, :, :]
+    x = x.astype(cfg.dtype)
+    return _constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+
+def _head(params, x, cfg: GPTConfig, mesh, rules):
     x = _layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
     w_out = (params["wte"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x, w_out.astype(cfg.dtype))
     logits = _constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
     return logits.astype(jnp.float32)
+
+
+def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
+            rules: Rules = DEFAULT_LLM_RULES):
+    """tokens [b, s] int32 → logits [b, s, vocab] (f32).
+
+    With a mesh, activations carry sharding constraints so pjit lays out
+    batch over dp/fsdp, heads/mlp over tp, seq over sp; without one it is
+    an ordinary single-device jax function.  A mesh with pp > 1 runs the
+    layer stack as a GPipe microbatch pipeline (parallel.pipeline).
+    """
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        return _forward_pipelined(params, tokens, cfg, mesh, rules)
+
+    x = _embed(params, tokens, cfg, mesh, rules)
+    x, _ = lax.scan(_layer_scan_body(cfg, mesh, rules), x, params["layers"])
+    return _head(params, x, cfg, mesh, rules)
+
+
+def _forward_pipelined(params, tokens, cfg: GPTConfig, mesh: Mesh,
+                       rules: Rules):
+    """Pipeline-parallel forward: embedding and head run under GSPMD auto
+    sharding (once, sharded over dp/tp); only the layer stack rides the
+    pp pipeline (parallel.pipeline.pipeline_apply, single-hop ppermute
+    hand-offs).  Composes with dp/fsdp/tp; sp+pp is not supported (ring
+    attention would nest shard_maps)."""
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    if mesh.shape.get("sp", 1) > 1:
+        raise NotImplementedError(
+            "sp and pp on the same mesh are not supported; shard long "
+            "sequences with sp, deep stacks with pp")
+    S = mesh.shape["pp"]
+    if cfg.n_layers % S != 0:
+        raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp={S}")
+    M = cfg.pp_microbatches or 2 * S
+    b, s = tokens.shape
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by microbatches {M}")
+
+    x = _embed(params, tokens, cfg, mesh, rules)
+    x_mb = x.reshape(M, b // M, s, cfg.d_model)
+
+    # dp/fsdp/tp are auto axes inside the pipeline's shard_map, so the
+    # stage body keeps its usual logical-axis constraints (their specs
+    # never mention pp)
+    body = _layer_scan_body(cfg, mesh, rules)
+
+    def stage_fn(local_layers, x):
+        x, _ = lax.scan(body, x, local_layers)
+        return x
+
+    outs = pipeline_apply(stage_fn, x_mb, params["layers"], mesh=mesh)
+    x = outs.reshape(b, s, cfg.d_model)
+    return _head(params, x, cfg, mesh, rules)
 
 
 def loss_fn(params, batch, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,

@@ -31,7 +31,9 @@ DEFAULT_LLM_RULES: Rules = {
     "qkv": "tp",
     "vocab": "tp",
     "expert": "ep",
-    "layers": None,
+    # layer stacks shard over pp (each pipeline stage holds a contiguous
+    # block); _prune drops the rule on meshes without a pp axis
+    "layers": "pp",
     "stage": "pp",
 }
 

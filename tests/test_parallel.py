@@ -168,3 +168,89 @@ def test_host_plane_collectives_between_actors():
             np.testing.assert_allclose(np.concatenate(o), [0, 1])
     finally:
         ray_tpu.shutdown()
+
+
+# -- pipeline parallelism ---------------------------------------------------
+
+def _pp_loss(mesh, cfg, params, tokens):
+    from ray_tpu.models import gpt
+    from ray_tpu.train.step import shard_batch
+    with mesh:
+        batch = shard_batch({"tokens": tokens}, mesh)
+        return float(jax.jit(
+            lambda p, b: gpt.loss_fn(p, b, cfg, mesh=mesh,
+                                     rules=DEFAULT_LLM_RULES))(params, batch))
+
+
+def test_pipeline_forward_matches_single_device(devices):
+    """pp=2 GPipe pipeline: loss parity with the unpipelined model."""
+    from ray_tpu.models import gpt
+    cfg = gpt.GPTConfig(vocab_size=256, max_seq=32, d_model=32, n_heads=2,
+                        n_layers=4, d_ff=64, remat=False,
+                        dtype=jnp.float32, pp_microbatches=4)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, 256,
+                                dtype=jnp.int32)
+    ref = float(gpt.loss_fn(params, {"tokens": tokens}, cfg))
+
+    mesh = create_mesh({"pp": 2}, devices=jax.devices("cpu")[:2])
+    got = _pp_loss(mesh, cfg, params, tokens)
+    assert abs(got - ref) < 1e-4, (got, ref)
+
+
+def test_pipeline_composes_with_dp_tp(devices):
+    """pp2 x dp2 x tp2 over 8 devices, gradients flow through the
+    pipeline (one real optimizer step changes the loss)."""
+    import optax
+    from ray_tpu.models import gpt
+    from ray_tpu.train.step import make_train_step, shard_batch
+    cfg = gpt.GPTConfig(vocab_size=256, max_seq=32, d_model=32, n_heads=2,
+                        n_layers=4, d_ff=64, remat=True,
+                        dtype=jnp.float32, pp_microbatches=4)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, 256,
+                                dtype=jnp.int32)
+    ref = float(gpt.loss_fn(params, {"tokens": tokens}, cfg))
+
+    mesh = create_mesh({"pp": 2, "dp": 2, "tp": 2},
+                       devices=jax.devices("cpu")[:8])
+    init_fn, step_fn = make_train_step(
+        lambda p, b: gpt.loss_fn(p, b, cfg, mesh=mesh,
+                                 rules=DEFAULT_LLM_RULES),
+        optax.adamw(1e-2), mesh=mesh,
+        params_logical=gpt.param_logical_axes(cfg),
+        rules=DEFAULT_LLM_RULES)
+    with mesh:
+        state = init_fn(params)
+        batch = shard_batch({"tokens": tokens}, mesh)
+        state, m1 = step_fn(state, batch)
+        loss1 = float(m1["loss"])
+        state, m2 = step_fn(state, batch)
+        loss2 = float(m2["loss"])
+    assert abs(loss1 - ref) < 1e-4, (loss1, ref)  # step-0 fwd parity
+    assert loss2 < loss1  # the optimizer step actually descended
+
+
+def test_pipeline_layer_sharding_rule(devices):
+    """'layers' logical axis maps to pp, so stage param blocks live on
+    their stage's devices."""
+    mesh = create_mesh({"pp": 2, "dp": 2}, devices=jax.devices("cpu")[:4])
+    spec = spec_for(("layers", "embed", "mlp"), DEFAULT_LLM_RULES, mesh)
+    assert spec == PartitionSpec("pp", None, None)
+
+
+def test_pipeline_bert_parity(devices):
+    """BERT rides the same generic pipeline runner: pp2 parity."""
+    from ray_tpu.models import bert
+    cfg = bert.BERTConfig.tiny(n_layers=2, pp_microbatches=2)
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    ref = np.asarray(bert.encode(params, tokens, cfg))
+
+    mesh = create_mesh({"pp": 2}, devices=jax.devices("cpu")[:2])
+    with mesh:
+        got = np.asarray(jax.jit(
+            lambda p, t: bert.encode(p, t, cfg, mesh=mesh,
+                                     rules=DEFAULT_LLM_RULES))(params, tokens))
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
