@@ -63,12 +63,28 @@ class GPTConfig:
     tie_embeddings: bool = True
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
     pp_microbatches: Optional[int] = None  # None = 2*pp stages (GPipe)
+    # MoE (0 = dense MLP).  When n_experts > 0 every layer's MLP becomes
+    # a top-k routed expert layer (GShard/Switch formulation: static
+    # capacity, one-hot dispatch/combine einsums — the dispatch einsum
+    # IS the all-to-all when experts are sharded over the ep mesh axis).
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01     # load-balance aux loss coefficient
 
     def __post_init__(self):
         if self.remat_policy not in (None, "dots"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; expected "
                 "None (full recompute) or 'dots'")
+        if self.n_experts:
+            if not 1 <= self.expert_top_k <= self.n_experts:
+                raise ValueError(
+                    f"expert_top_k {self.expert_top_k} must be in "
+                    f"[1, n_experts={self.n_experts}]")
+            if self.capacity_factor <= 0:
+                raise ValueError(
+                    f"capacity_factor {self.capacity_factor} must be > 0")
 
     @property
     def head_dim(self) -> int:
@@ -86,6 +102,12 @@ class GPTConfig:
         return GPTConfig(**{**dict(vocab_size=512, max_seq=128, d_model=64,
                                    n_heads=4, n_layers=2, d_ff=128,
                                    remat=False), **kw})
+
+    @staticmethod
+    def tiny_moe(**kw) -> "GPTConfig":
+        """Test-sized mixture-of-experts config."""
+        return GPTConfig.tiny(**{**dict(n_experts=4, expert_top_k=2,
+                                        dtype=jnp.float32), **kw})
 
 
 # -- params ----------------------------------------------------------------
@@ -114,8 +136,21 @@ PARAM_AXES = {
 }
 
 
+# MoE layers swap the dense MLP leaves for expert-stacked ones; the
+# "expert" logical axis maps to the ep mesh axis (sharding.py rules)
+MOE_MLP_AXES = {
+    "w_router": ("layers", "embed", None),
+    "w_up": ("layers", "expert", "embed", "mlp"),
+    "b_up": ("layers", "expert", "mlp"),
+    "w_down": ("layers", "expert", "mlp", "embed"),
+    "b_down": ("layers", "expert", "embed"),
+}
+
+
 def param_logical_axes(cfg: GPTConfig):
     axes = dict(PARAM_AXES)
+    if cfg.n_experts:
+        axes["layers"] = {**axes["layers"], **MOE_MLP_AXES}
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     return axes
@@ -133,6 +168,22 @@ def init_params(cfg: GPTConfig, rng: jax.Array):
     def norm(key, shape, s=std):
         return (jax.random.normal(key, shape) * s).astype(pd)
 
+    if cfg.n_experts:
+        E = cfg.n_experts
+        mlp = {
+            "w_router": norm(next(k), (L, d, E)),
+            "w_up": norm(next(k), (L, E, d, f)),
+            "b_up": jnp.zeros((L, E, f), pd),
+            "w_down": norm(next(k), (L, E, f, d), res_std),
+            "b_down": jnp.zeros((L, E, d), pd),
+        }
+    else:
+        mlp = {
+            "w_up": norm(next(k), (L, d, f)),
+            "b_up": jnp.zeros((L, f), pd),
+            "w_down": norm(next(k), (L, f, d), res_std),
+            "b_down": jnp.zeros((L, d), pd),
+        }
     params = {
         "wte": norm(next(k), (cfg.vocab_size, d)),
         "wpe": norm(next(k), (cfg.max_seq, d), 0.01),
@@ -146,10 +197,7 @@ def init_params(cfg: GPTConfig, rng: jax.Array):
             "bo": jnp.zeros((L, d), pd),
             "ln2_scale": jnp.ones((L, d), pd),
             "ln2_bias": jnp.zeros((L, d), pd),
-            "w_up": norm(next(k), (L, d, f)),
-            "b_up": jnp.zeros((L, f), pd),
-            "w_down": norm(next(k), (L, f, d), res_std),
-            "b_down": jnp.zeros((L, d), pd),
+            **mlp,
         },
     }
     if not cfg.tie_embeddings:
@@ -189,10 +237,80 @@ def _attend(q, k, v, cfg: GPTConfig, mesh: Optional[Mesh], rules: Rules):
     return attention(q, k, v, causal=True, impl=cfg.attn_impl)
 
 
+def _moe_mlp(y, lp, cfg: GPTConfig, mesh: Optional[Mesh], rules: Rules):
+    """Top-k routed expert MLP, GShard/Switch formulation with groups.
+
+    Tokens route in GROUPS (one group per sequence, the GShard device
+    group): capacity is per group (C = cf·k·s/E), so the dispatch and
+    combine tensors are [G, s, E, C] — O(s²) per group, with the group
+    dim sharded over the data axes, NOT O(N²) global.  The dispatch
+    einsum scatters tokens into each group's [E, C, d] buffer; with
+    experts sharded over ``ep`` ("expert"→ep rule) that einsum IS the
+    all-to-all, inserted by XLA, while expert compute stays sharded over
+    the data axes on the group dim (green-field capability, SURVEY.md §7
+    M4: the reference has no MoE engine).  Returns
+    (output [b, s, d], load-balance aux loss scalar)."""
+    b, s, d = y.shape                  # groups G = b, tokens/group n = s
+    E, k = cfg.n_experts, cfg.expert_top_k
+    C = max(1, int(math.ceil(cfg.capacity_factor * k * s / E)))
+
+    logits = jnp.einsum("gnd,de->gne", y.astype(jnp.float32),
+                        lp["w_router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)              # [G, n, E] f32
+
+    remaining = probs
+    counts = jnp.zeros((b, E), jnp.float32)   # per-group expert fill
+    combine = jnp.zeros((b, s, E, C), jnp.float32)
+    gates_sum = jnp.zeros((b, s), jnp.float32)
+    top1_frac = None
+    for i in range(k):
+        idx = jnp.argmax(remaining, axis=-1)              # [G, n]
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [G, n, E]
+        gate = jnp.sum(remaining * mask, axis=-1)         # [G, n]
+        # position of each token in its chosen expert's queue (0-based,
+        # offset by earlier rounds' fill of this group's queues)
+        pos = jnp.cumsum(mask, axis=1) - 1.0 + counts[:, None, :]
+        posn = jnp.sum(pos * mask, axis=-1)               # [G, n]
+        keep = (posn < C).astype(jnp.float32)             # capacity drop
+        disp = (mask * keep[..., None])[..., None] \
+            * jax.nn.one_hot(posn.astype(jnp.int32), C,
+                             dtype=jnp.float32)[..., None, :]
+        combine = combine + gate[..., None, None] * disp  # [G, n, E, C]
+        gates_sum = gates_sum + gate * keep
+        counts = counts + jnp.sum(mask * keep[..., None], axis=1)
+        if i == 0:
+            top1_frac = jnp.mean(mask, axis=(0, 1))       # [E]
+        remaining = remaining * (1.0 - mask)
+    # normalize the selected gates to sum to 1 per token (GShard)
+    combine = combine / jnp.maximum(gates_sum, 1e-9)[..., None, None]
+    dispatch = (combine > 0).astype(cfg.dtype)            # [G, n, E, C]
+
+    # Switch load-balance loss: E * Σ_e f_e · P_e (f from the top-1
+    # routing decision, P the mean router probability)
+    aux = E * jnp.sum(top1_frac * jnp.mean(probs, axis=(0, 1)))
+
+    yd = y.astype(cfg.dtype)
+    expert_in = jnp.einsum("gnec,gnd->gecd", dispatch, yd)  # [G, E, C, d]
+    expert_in = _constrain(expert_in, ("batch", "expert", None, "embed"),
+                           mesh, rules)
+    hid = jnp.einsum("gecd,edf->gecf", expert_in,
+                     lp["w_up"].astype(cfg.dtype)) \
+        + lp["b_up"].astype(cfg.dtype)[None, :, None, :]
+    hid = _constrain(hid, ("batch", "expert", None, "mlp"), mesh, rules)
+    hid = jax.nn.gelu(hid)
+    out_e = jnp.einsum("gecf,efd->gecd", hid,
+                       lp["w_down"].astype(cfg.dtype)) \
+        + lp["b_down"].astype(cfg.dtype)[None, :, None, :]
+    out_e = _constrain(out_e, ("batch", "expert", None, "embed"),
+                       mesh, rules)
+    out = jnp.einsum("gnec,gecd->gnd", combine.astype(cfg.dtype), out_e)
+    return out, aux
+
+
 def _transformer_layer(x, lp, cfg: GPTConfig, mesh: Optional[Mesh],
                        rules: Rules):
     """One pre-LN transformer block; x [b, s, d], lp = one layer's params
-    (no leading layers dim)."""
+    (no leading layers dim).  Returns (x, moe aux loss — 0 when dense)."""
     b, s, _ = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
 
@@ -212,21 +330,28 @@ def _transformer_layer(x, lp, cfg: GPTConfig, mesh: Optional[Mesh],
     x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
 
     y = _layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
-    u = jnp.einsum("bsd,df->bsf", y, lp["w_up"].astype(cfg.dtype)) \
-        + lp["b_up"].astype(cfg.dtype)
-    u = _constrain(u, ("batch", "seq", "mlp"), mesh, rules)
-    u = jax.nn.gelu(u)
-    dn = jnp.einsum("bsf,fd->bsd", u, lp["w_down"].astype(cfg.dtype)) \
-        + lp["b_down"].astype(cfg.dtype)
+    if cfg.n_experts:
+        dn, aux = _moe_mlp(y, lp, cfg, mesh, rules)
+    else:
+        u = jnp.einsum("bsd,df->bsf", y, lp["w_up"].astype(cfg.dtype)) \
+            + lp["b_up"].astype(cfg.dtype)
+        u = _constrain(u, ("batch", "seq", "mlp"), mesh, rules)
+        u = jax.nn.gelu(u)
+        dn = jnp.einsum("bsf,fd->bsd", u, lp["w_down"].astype(cfg.dtype)) \
+            + lp["b_down"].astype(cfg.dtype)
+        aux = jnp.zeros((), jnp.float32)
     x = x + dn
     x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
-    return x
+    return x, aux
 
 
 def _layer_scan_body(cfg: GPTConfig, mesh, rules):
-    """Scan body over a stacked layer dim, rematerialized per cfg."""
-    def layer(x, lp):
-        return _transformer_layer(x, lp, cfg, mesh, rules), None
+    """Scan body over a stacked layer dim, rematerialized per cfg.
+    Carry is (x, accumulated moe aux loss)."""
+    def layer(carry, lp):
+        x, aux = carry
+        x, a = _transformer_layer(x, lp, cfg, mesh, rules)
+        return (x, aux + a), None
 
     if cfg.remat:
         # "dots" keeps matmul outputs and recomputes only the cheap
@@ -256,35 +381,43 @@ def _head(params, x, cfg: GPTConfig, mesh, rules):
 
 
 def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
-            rules: Rules = DEFAULT_LLM_RULES):
+            rules: Rules = DEFAULT_LLM_RULES, return_aux: bool = False):
     """tokens [b, s] int32 → logits [b, s, vocab] (f32).
 
     With a mesh, activations carry sharding constraints so pjit lays out
     batch over dp/fsdp, heads/mlp over tp, seq over sp; without one it is
     an ordinary single-device jax function.  A mesh with pp > 1 runs the
     layer stack as a GPipe microbatch pipeline (parallel.pipeline).
+    ``return_aux`` also returns the summed MoE load-balance loss.
     """
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
-        return _forward_pipelined(params, tokens, cfg, mesh, rules)
+        return _forward_pipelined(params, tokens, cfg, mesh, rules,
+                                  return_aux)
 
     x = _embed(params, tokens, cfg, mesh, rules)
-    x, _ = lax.scan(_layer_scan_body(cfg, mesh, rules), x, params["layers"])
-    return _head(params, x, cfg, mesh, rules)
+    (x, aux), _ = lax.scan(_layer_scan_body(cfg, mesh, rules),
+                           (x, jnp.zeros((), jnp.float32)),
+                           params["layers"])
+    logits = _head(params, x, cfg, mesh, rules)
+    return (logits, aux) if return_aux else logits
 
 
 def _forward_pipelined(params, tokens, cfg: GPTConfig, mesh: Mesh,
-                       rules: Rules):
+                       rules: Rules, return_aux: bool = False):
     """Pipeline-parallel forward: embedding and head run under GSPMD auto
     sharding (once, sharded over dp/tp); only the layer stack rides the
     pp pipeline (parallel.pipeline.pipeline_apply, single-hop ppermute
     hand-offs).  Composes with dp/fsdp/tp; sp+pp is not supported (ring
-    attention would nest shard_maps)."""
+    attention would nest shard_maps), and MoE+pp is future work (the aux
+    loss would have to ride the ppermute hand-off)."""
     from ray_tpu.parallel.pipeline import pipeline_apply
 
     if mesh.shape.get("sp", 1) > 1:
         raise NotImplementedError(
             "sp and pp on the same mesh are not supported; shard long "
             "sequences with sp, deep stacks with pp")
+    if cfg.n_experts:
+        raise NotImplementedError("MoE + pp pipeline is not supported yet")
     S = mesh.shape["pp"]
     if cfg.n_layers % S != 0:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp={S}")
@@ -302,12 +435,16 @@ def _forward_pipelined(params, tokens, cfg: GPTConfig, mesh: Mesh,
     body = _layer_scan_body(cfg, mesh, rules)
 
     def stage_fn(local_layers, x):
-        x, _ = lax.scan(body, x, local_layers)
+        (x, _), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                             local_layers)
         return x
 
     outs = pipeline_apply(stage_fn, x_mb, params["layers"], mesh=mesh)
     x = outs.reshape(b, s, cfg.d_model)
-    return _head(params, x, cfg, mesh, rules)
+    logits = _head(params, x, cfg, mesh, rules)
+    if return_aux:
+        return logits, jnp.zeros((), jnp.float32)
+    return logits
 
 
 def loss_fn(params, batch, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
@@ -319,10 +456,14 @@ def loss_fn(params, batch, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
         inp, tgt = tokens, batch["targets"]
     else:
         inp, tgt = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inp, cfg, mesh=mesh, rules=rules)
+    logits, aux = forward(params, inp, cfg, mesh=mesh, rules=rules,
+                          return_aux=True)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    ce = jnp.mean(logz - gold)
+    if cfg.n_experts:
+        return ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 def generate(params, cfg: GPTConfig, prompt, max_new: int, *,
