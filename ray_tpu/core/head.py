@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._config import RayTpuConfig
+from ray_tpu.core.resources import bundle_total as _bundle_total
 from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
                                   EventLoopService)
 
@@ -92,6 +93,11 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         # bypass the head entirely
         self.locate_requests = 0
         self.pgs: dict[bytes, PGDir] = {}
+        # creation queue: pg_id -> {"bundles", "strategy", "busy"}
+        # (reference: gcs_placement_group_manager pending queue)
+        self.pending_pgs: dict[bytes, dict] = {}
+        # tasks routed to a still-pending PG, replayed on commit
+        self._pg_waiters: dict[bytes, list] = {}
 
         # durable control-plane state (reference: gcs_server.cc:58-61 —
         # the Redis/file-backed GCS table storage that lets the head
@@ -278,6 +284,8 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             n.available = dict(m["available"])
             n.total = dict(m["total"])
             n.queued = dict(m.get("queued") or {})
+        if self.pending_pgs:
+            self._try_place_pending_pgs()
         if "reqid" in m:
             self._reply(rec, m["reqid"], view=self._view())
 
@@ -400,8 +408,17 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         if pg is not None:
             pgd = self.pgs.get(pg[0])
             if pgd is None or pgd.state != "created":
+                if pg[0] in self.pending_pgs:
+                    # creation is still queued/committing: hold the task
+                    # and re-route once the 2PC lands.  Drop the source's
+                    # availability snapshot — it will be stale by then
+                    # and would overwrite fresher heartbeat truth.
+                    held = {k: v for k, v in m.items()
+                            if k != "src_available"}
+                    self._pg_waiters.setdefault(pg[0], []).append((rec, held))
+                    return
                 self._reply(rec, m["reqid"],
-                            error=f"placement group unknown or damaged")
+                            error="placement group unknown or damaged")
                 return
             target = pgd.assignment[pg[1]]
         else:
@@ -464,6 +481,13 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                       restarts_left=spec.get("max_restarts", 0))
         self.actors[aid] = ad
         self.mark_dirty()
+        # optimistic accounting (same as _h_cluster_submit): debit the
+        # choice so back-to-back creations don't all pile onto the same
+        # node; heartbeats re-sync the truth
+        tn = self.nodes.get(target)
+        if tn is not None:
+            for k, v in self._demand(spec).items():
+                tn.available[k] = max(0.0, tn.available.get(k, 0.0) - v)
         c = self._node_conn(target)
         spec = dict(spec)
         spec["_routed"] = True
@@ -635,15 +659,48 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         pg_id: bytes = m["pg_id"]
         bundles: list = m["bundles"]
         strategy = m.get("strategy", "PACK")
-        assignment = self._plan_pg(bundles, strategy)
-        if assignment is None:
+        if not self._pg_feasible(bundles, strategy):
+            # will NEVER fit even on an idle cluster — fail creation
+            # synchronously (a pending PG that can't ever place would
+            # hang ready() forever)
             self._reply(rec, m["reqid"],
-                        error=f"Cannot place bundles {bundles} with "
-                              f"strategy {strategy} on "
-                              f"{[(n.node_hex[:8], n.available) for n in self.nodes.values() if n.alive]}")
+                        error=f"Infeasible placement group: bundles "
+                              f"{bundles} exceed cluster capacity "
+                              f"{[(n.node_hex[:8], n.total) for n in self.nodes.values() if n.alive]}")
             return
+        # Creation is asynchronous (reference:
+        # gcs_placement_group_manager.h:222 pending queue + retry):
+        # reply immediately, queue, and attempt placement; PlacementGroup
+        # .ready() gates on pg_state reporting "created".
+        self._reply(rec, m["reqid"], ok=True, state="pending")
+        self.pending_pgs[pg_id] = {"bundles": bundles,
+                                   "strategy": strategy, "busy": False}
+        self._try_place_pending_pgs()
+
+    def _pg_feasible(self, bundles: list, strategy: str) -> bool:
+        """Could these bundles fit on an IDLE version of today's cluster?
+        Exact: runs the real planner against node totals, so a PG that
+        can never place fails creation synchronously instead of pending
+        forever."""
+        return self._plan_pg(bundles, strategy, idle=True) is not None
+
+    def _try_place_pending_pgs(self) -> None:
+        """Attempt 2PC placement of queued PGs (called whenever resources
+        may have freed: heartbeats, pg removal, 2PC completion)."""
+        for pg_id, info in list(self.pending_pgs.items()):
+            if info["busy"]:
+                continue
+            assignment = self._plan_pg(info["bundles"], info["strategy"])
+            if assignment is None:
+                continue
+            info["busy"] = True
+            self._start_pg_2pc(pg_id, info, assignment)
+
+    def _start_pg_2pc(self, pg_id: bytes, info: dict,
+                      assignment: list) -> None:
         # 2PC (reference: gcs_placement_group_scheduler.h:104 prepare all,
         # then commit all; rollback prepared on any failure)
+        bundles, strategy = info["bundles"], info["strategy"]
         state = {"pending": len(bundles), "failed": False}
 
         def prepared(i: int, reply: dict) -> None:
@@ -658,20 +715,32 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                     if c is not None:
                         self._push(c, {"t": "pg_rollback", "pg_id": pg_id,
                                        "bundle_idx": j})
-                self._reply(rec, m["reqid"],
-                            error="placement group reservation failed "
-                                  "(node raced out of resources)")
+                # a node raced out of resources — back to the queue
+                if pg_id in self.pending_pgs:
+                    self.pending_pgs[pg_id]["busy"] = False
+                return
+            if pg_id not in self.pending_pgs:
+                # removed while committing: the reservations are still
+                # only PREPARED — roll them back (pg_remove_local frees
+                # committed bundles only and would leak the debit)
+                for j, h in enumerate(assignment):
+                    c = self._node_conn(h)
+                    if c is not None:
+                        self._push(c, {"t": "pg_rollback", "pg_id": pg_id,
+                                       "bundle_idx": j})
                 return
             for j, h in enumerate(assignment):
                 c = self._node_conn(h)
                 if c is not None:
                     self._push(c, {"t": "pg_commit", "pg_id": pg_id,
                                    "bundle_idx": j})
+            del self.pending_pgs[pg_id]
             self.pgs[pg_id] = PGDir(pg_id=pg_id, bundles=bundles,
                                     strategy=strategy,
                                     assignment=assignment)
             self.mark_dirty()
-            self._reply(rec, m["reqid"], ok=True, assignment=assignment)
+            for wrec, wm in self._pg_waiters.pop(pg_id, []):
+                self.post(lambda r=wrec, mm=wm: self._h_cluster_submit(r, mm))
 
         for i, (b, h) in enumerate(zip(bundles, assignment)):
             c = self._node_conn(h)
@@ -682,25 +751,38 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                           "bundle_idx": i, "bundle": b},
                       lambda reply, i=i: prepared(i, reply))
 
-    def _plan_pg(self, bundles: list, strategy: str) -> Optional[list]:
+    def _h_pg_state(self, rec: ClientRec, m: dict) -> None:
+        pg_id = m["pg_id"]
+        if pg_id in self.pgs:
+            st = self.pgs[pg_id].state  # "created"
+        elif pg_id in self.pending_pgs:
+            st = "pending"
+        else:
+            st = "removed"
+        self._reply(rec, m["reqid"], ok=True, state=st)
+
+    def _plan_pg(self, bundles: list, strategy: str,
+                 idle: bool = False) -> Optional[list]:
+        """Bundle→node assignment against current availability, or — with
+        ``idle=True`` — against an idle cluster's totals (the exact
+        feasibility oracle: a PG is worth queueing iff a plan exists on
+        the idle cluster)."""
         alive = [n for n in self.nodes.values() if n.alive]
+        cap = (lambda n: n.total) if idle else (lambda n: n.available)
         if not alive:
             return None
         if strategy in ("PACK", "STRICT_PACK"):
-            total: dict[str, float] = {}
-            for b in bundles:
-                for k, v in b.items():
-                    total[k] = total.get(k, 0.0) + v
-            for n in sorted(alive, key=lambda n: -sum(n.available.values())):
-                if all(n.available.get(k, 0.0) + 1e-9 >= v
+            total = _bundle_total(bundles)
+            for n in sorted(alive, key=lambda n: -sum(cap(n).values())):
+                if all(cap(n).get(k, 0.0) + 1e-9 >= v
                        for k, v in total.items()):
                     return [n.node_hex] * len(bundles)
             if strategy == "STRICT_PACK":
                 return None
             strategy = "SPREAD"   # PACK falls back to spreading
         # SPREAD / STRICT_SPREAD: round-robin with per-node running totals
-        budget = {n.node_hex: dict(n.available) for n in alive}
-        order = sorted(alive, key=lambda n: -sum(n.available.values()))
+        budget = {n.node_hex: dict(cap(n)) for n in alive}
+        order = sorted(alive, key=lambda n: -sum(cap(n).values()))
         assignment: list[Optional[str]] = []
         used_nodes: set[str] = set()
         for b in bundles:
@@ -724,6 +806,10 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
     def _h_remove_pg(self, rec: ClientRec, m: dict) -> None:
         pgd = self.pgs.pop(m["pg_id"], None)
+        self.pending_pgs.pop(m["pg_id"], None)
+        for wrec, wm in self._pg_waiters.pop(m["pg_id"], []):
+            self._reply(wrec, wm["reqid"],
+                        error="placement group removed before scheduling")
         self.mark_dirty()
         if pgd is not None:
             for i, h in enumerate(pgd.assignment):
@@ -731,6 +817,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                 if c is not None:
                     self._push(c, {"t": "pg_remove_local",
                                    "pg_id": m["pg_id"], "bundle_idx": i})
+            self._try_place_pending_pgs()
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
 

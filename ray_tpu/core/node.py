@@ -50,6 +50,7 @@ from typing import Any, Optional
 from ray_tpu._config import RayTpuConfig
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
+from ray_tpu.core.resources import bundle_total, covers
 from ray_tpu.core.object_store import (NativeObjectStoreCore,
                                        make_object_store_core)
 from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
@@ -225,6 +226,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._fwd_by_oid: dict[bytes, bytes] = {}      # return oid -> task_id
         self._pg_prepared: dict[tuple, dict] = {}      # (pg,idx) -> bundle
         self._pg_bundles: dict[tuple, dict] = {}       # committed originals
+        self._pending_local_pgs: dict[bytes, dict] = {}  # single-node queue
         self._released_wait: set[ObjectID] = set()     # owner-released oids
         self._nested_count: dict[bytes, int] = {}      # id -> container holds
         # ---- ownership + lineage (reference: reference_count.h /
@@ -1275,6 +1277,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             return
         for k, v in demand.items():
             self.available[k] = self.available.get(k, 0.0) + v
+        if self._pending_local_pgs:
+            self._try_place_local_pgs()
 
     def _feasible(self, spec) -> bool:
         demand = self._demand(spec)
@@ -1308,6 +1312,25 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     break
                 self._queue_pop(q)
                 self._dispatch_task(w, spec)
+            if not tpu and q:
+                self._dispatch_zero_demand(q)
+
+    def _dispatch_zero_demand(self, q: deque) -> None:
+        """Zero-demand tasks (e.g. PlacementGroup.ready() pollers) take
+        nothing from the pool, so FIFO head-of-line blocking must not
+        starve them: dispatch any such spec stuck behind a blocked head."""
+        for spec in [s for s in q
+                     if not s.get("placement_group")
+                     and all(v <= 0 for v in self._demand(s).values())]:
+            w = self._find_idle_worker(tpu=False,
+                                       env_hash=spec.get("env_hash"))
+            if w is None:
+                self._maybe_spawn_worker()
+                return
+            q.remove(spec)
+            for k, v in self._demand(spec).items():
+                self._queued_demand[k] = self._queued_demand.get(k, 0.0) - v
+            self._dispatch_task(w, spec)
 
     def _find_idle_worker(self, tpu: bool,
                           env_hash: Optional[str] = None
@@ -1369,13 +1392,18 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                    and c.dedicated_actor is None)
         # Tasks can only run while CPU is available, so a pool larger than
         # the free CPUs is waste; placement-group tasks draw on their
-        # bundle reservation instead, and actors hold no CPU — both always
-        # need a process.  Concurrent startups are capped (reference:
-        # worker_pool.h maximum_startup_concurrency :192,717).
+        # bundle reservation, zero-cpu tasks (e.g. PlacementGroup.ready()
+        # pollers) run regardless of CPU pressure, and actors hold no CPU
+        # — all three always need a process.  Concurrent startups are
+        # capped (reference: worker_pool.h maximum_startup_concurrency
+        # :192,717).
         n_pg = min(self._queued_pg, len(self.runnable_cpu))
-        cpu_demand = min(len(self.runnable_cpu) - n_pg,
+        n_zero = sum(1 for s in self.runnable_cpu
+                     if not s.get("placement_group")
+                     and all(v <= 0 for v in self._demand(s).values()))
+        cpu_demand = min(len(self.runnable_cpu) - n_pg - n_zero,
                          max(0, int(self.available.get("CPU", 0.0))))
-        demand = cpu_demand + n_pg + n_actors_waiting
+        demand = cpu_demand + n_pg + n_zero + n_actors_waiting
         max_concurrent_startup = max(2, os.cpu_count() or 1)
         want = min(demand - idle - self._spawning,
                    self.config.max_workers - registered - self._spawning,
@@ -1810,38 +1838,63 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         if self.head_conn is not None:
             self._proxy_to_head(rec, m)   # head runs the cross-node 2PC
             return
-        pg_id = PlacementGroupID(m["pg_id"])
         bundles = m["bundles"]
-        # single-node prepare+commit in one step
-        total: dict[str, float] = {}
-        for b in bundles:
-            for k, v in b.items():
-                total[k] = total.get(k, 0.0) + v
-        if not all(self.available.get(k, 0.0) + 1e-9 >= v
-                   for k, v in total.items()):
+        total = bundle_total(bundles)
+        if not covers(self.total_resources, total):
+            # can NEVER fit on this node — fail creation synchronously
             self._reply(rec, m["reqid"],
-                        error=f"Cannot reserve bundles {total}; "
-                              f"available {self.available}")
+                        error=f"Infeasible placement group {total}; "
+                              f"node total {self.total_resources}")
             return
-        for k, v in total.items():
-            self.available[k] -= v
-        self.pgs[pg_id] = PGRec(pg_id=pg_id, bundles=bundles,
-                                strategy=m.get("strategy", "PACK"))
-        for i, b in enumerate(bundles):
-            self.pg_available[(pg_id.binary(), i)] = dict(b)
-        self._reply(rec, m["reqid"], ok=True)
+        # creation is async: reply now, reserve when resources allow;
+        # PlacementGroup.ready() gates on pg_state == "created"
+        self._reply(rec, m["reqid"], ok=True, state="pending")
+        self._pending_local_pgs[m["pg_id"]] = {
+            "bundles": bundles, "strategy": m.get("strategy", "PACK")}
+        self._try_place_local_pgs()
+
+    def _try_place_local_pgs(self) -> None:
+        """Reserve queued single-node PGs once resources free up."""
+        for pgb, info in list(self._pending_local_pgs.items()):
+            total = bundle_total(info["bundles"])
+            if not covers(self.available, total):
+                continue
+            for k, v in total.items():
+                self.available[k] -= v
+            pg_id = PlacementGroupID(pgb)
+            self.pgs[pg_id] = PGRec(pg_id=pg_id, bundles=info["bundles"],
+                                    strategy=info["strategy"])
+            for i, b in enumerate(info["bundles"]):
+                self.pg_available[(pgb, i)] = dict(b)
+            del self._pending_local_pgs[pgb]
+            self._schedule()
+
+    def _h_pg_state(self, rec, m):
+        if self.head_conn is not None:
+            self._proxy_to_head(rec, m)
+            return
+        pg_id = PlacementGroupID(m["pg_id"])
+        if pg_id in self.pgs:
+            st = "created"
+        elif m["pg_id"] in self._pending_local_pgs:
+            st = "pending"
+        else:
+            st = "removed"
+        self._reply(rec, m["reqid"], ok=True, state=st)
 
     def _h_remove_pg(self, rec, m):
         if self.head_conn is not None:
             self._proxy_to_head(rec, m)
             return
         pg_id = PlacementGroupID(m["pg_id"])
+        self._pending_local_pgs.pop(m["pg_id"], None)
         pg = self.pgs.pop(pg_id, None)
         if pg is not None:
             for i, b in enumerate(pg.bundles):
                 self.pg_available.pop((pg_id.binary(), i), None)
                 for k, v in b.items():
                     self.available[k] = self.available.get(k, 0.0) + v
+            self._try_place_local_pgs()
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
 

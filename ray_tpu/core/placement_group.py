@@ -25,11 +25,45 @@ class PlacementGroup:
     id: PlacementGroupID
     bundles: list
     strategy: str
+    _ready_ref: object = None
 
     def ready(self):
-        """Returns an ObjectRef resolving when the group is reserved.
-        Single-node reservation is synchronous, so this is immediate."""
-        return get_runtime().put(True)
+        """ObjectRef resolving when the group's 2PC reservation commits
+        (reference: python/ray/util/placement_group.py ready() gating on
+        gcs_placement_group_manager.h:222 creation).  Creation is async —
+        on a busy cluster the ref stays unresolved until capacity frees;
+        a removed group makes the ref raise."""
+        if self._ready_ref is None:
+            from ray_tpu.core.remote_function import remote
+
+            @remote(num_cpus=0)
+            def _pg_ready(pg_id_bin: bytes) -> bool:
+                import time as _t
+                rt = get_runtime()
+                while True:
+                    st = rt.client.request({"t": "pg_state",
+                                            "pg_id": pg_id_bin})["state"]
+                    if st == "created":
+                        return True
+                    if st == "removed":
+                        raise RuntimeError(
+                            "placement group was removed before it was "
+                            "scheduled")
+                    _t.sleep(0.02)
+
+            self._ready_ref = _pg_ready.remote(self.id.binary())
+        return self._ready_ref
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        """Block until created (True) or timeout (False).  A REMOVED
+        group raises instead — callers retry-looping on wait() must be
+        able to tell a busy cluster from a permanently dead PG."""
+        from ray_tpu.core.client import GetTimeoutError
+        try:
+            get_runtime().get(self.ready(), timeout=timeout_seconds)
+            return True
+        except GetTimeoutError:
+            return False
 
     @property
     def bundle_specs(self) -> list:

@@ -1,0 +1,19 @@
+"""Small shared resource-arithmetic helpers (used by the node scheduler,
+the head's placement-group planner, and feasibility checks — one
+definition so reservation and feasibility can't disagree)."""
+
+from __future__ import annotations
+
+
+def bundle_total(bundles: list[dict]) -> dict[str, float]:
+    """Element-wise sum of resource bundles."""
+    total: dict[str, float] = {}
+    for b in bundles:
+        for k, v in b.items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def covers(capacity: dict, demand: dict, eps: float = 1e-9) -> bool:
+    """capacity >= demand on every resource key (with float slack)."""
+    return all(capacity.get(k, 0.0) + eps >= v for k, v in demand.items())

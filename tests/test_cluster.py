@@ -298,3 +298,34 @@ def test_head_restart_with_persistence(tmp_path):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_placement_group_ready_blocks_until_node_frees(cluster):
+    """ready() stays unresolved while the cluster is saturated and the
+    head's pending-PG queue holds the group; killing the hog commits the
+    2PC and resolves it (reference: gcs_placement_group_manager.h:222)."""
+    n0 = cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=n0.address)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Hog:
+        def ping(self):
+            return "ok"
+
+    hogs = [Hog.remote() for _ in range(2)]
+    for h in hogs:
+        assert ray_tpu.get(h.ping.remote(), timeout=90) == "ok"
+
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}],
+                                 strategy="STRICT_SPREAD")
+    ref = pg.ready()
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=2)
+
+    for h in hogs:
+        ray_tpu.kill(h)
+    assert ray_tpu.get(pg.ready(), timeout=120) is True
+    ray_tpu.remove_placement_group(pg)

@@ -63,8 +63,52 @@ def test_placement_group_lifecycle(rt):
 
 
 def test_placement_group_infeasible_raises(rt):
-    with pytest.raises(Exception, match="Cannot reserve"):
+    with pytest.raises(Exception, match="Infeasible"):
         rt.placement_group([{"CPU": 64}])
+
+
+def test_placement_group_ready_blocks_until_capacity(rt):
+    """ready() is truthful: a PG demanding busy resources stays pending
+    until the holder releases them (reference:
+    python/ray/util/placement_group.py ready() + the GCS pending queue)."""
+    import time
+
+    @ray_tpu.remote(num_cpus=2)
+    class Hog:
+        def ping(self):
+            return "ok"
+
+    hog = Hog.remote()
+    assert rt.get(hog.ping.remote(), timeout=60) == "ok"
+
+    pg = rt.placement_group([{"CPU": 2}])
+    ref = pg.ready()
+    # the hog holds both CPUs: the PG must NOT report ready
+    with pytest.raises(Exception):
+        rt.get(ref, timeout=1.5)
+    state = rt.get_runtime().client.request(
+        {"t": "pg_state", "pg_id": pg.id.binary()})["state"]
+    assert state == "pending"
+
+    ray_tpu.kill(hog)
+    assert rt.get(pg.ready(), timeout=60) is True
+    rt.remove_placement_group(pg)
+
+
+def test_placement_group_ready_raises_after_remove(rt):
+    @ray_tpu.remote(num_cpus=2)
+    class Hog2:
+        def ping(self):
+            return "ok"
+
+    hog = Hog2.remote()
+    assert rt.get(hog.ping.remote(), timeout=60) == "ok"
+    pg = rt.placement_group([{"CPU": 2}])   # stays pending behind the hog
+    ref = pg.ready()
+    rt.remove_placement_group(pg)
+    with pytest.raises(Exception, match="removed"):
+        rt.get(ref, timeout=60)
+    ray_tpu.kill(hog)
 
 
 def test_placement_group_bad_strategy(rt):
