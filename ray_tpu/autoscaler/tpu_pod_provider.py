@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import shutil
 import subprocess
+import time
 import uuid
 from typing import Optional
 
@@ -48,6 +49,7 @@ class TpuPodNodeProvider(NodeProvider):
         self.runtime_version = runtime_version
         self.name_prefix = name_prefix
         self.chips_per_host = chips_per_host
+        self._poll_s = 5.0            # state-poll cadence (tests shrink it)
 
     # -- gcloud plumbing ----------------------------------------------------
 
@@ -65,6 +67,11 @@ class TpuPodNodeProvider(NodeProvider):
     # -- provider interface -------------------------------------------------
 
     def create_node(self, head_address: str, node_config: dict) -> str:
+        """Full lifecycle: create → wait READY → bootstrap every host →
+        verify the node service came up.  Any failure deletes the slice —
+        a half-bootstrapped TPU VM must never leak billable capacity
+        (reference lifecycle: autoscaler/_private/gcp/node_provider.py
+        create_node + wait_for_operation)."""
         suffix = uuid.uuid4().hex[:8]
         name = f"{self.name_prefix}-{suffix}"
         self._run("create", name,
@@ -72,14 +79,60 @@ class TpuPodNodeProvider(NodeProvider):
                   f"{node_config.get('accelerator_type', self.accelerator_type)}",
                   f"--version="
                   f"{node_config.get('runtime_version', self.runtime_version)}")
-        bootstrap = _BOOTSTRAP.format(
-            head=head_address, suffix=suffix, name=name,
-            chips=node_config.get("num_tpus", self.chips_per_host))
-        # --worker=all: every host of a multi-host slice starts a node
-        # service (one NodeService per TPU host, the gang-member shape)
-        self._run("ssh", name, "--worker=all",
-                  f"--command={bootstrap}", timeout=900.0)
+        try:
+            self._wait_state(name, "READY", timeout=600.0)
+            bootstrap = _BOOTSTRAP.format(
+                head=head_address, suffix=suffix, name=name,
+                chips=node_config.get("num_tpus", self.chips_per_host))
+            # --worker=all: every host of a multi-host slice starts a node
+            # service (one NodeService per TPU host, the gang-member shape)
+            self._run("ssh", name, "--worker=all",
+                      f"--command={bootstrap}", timeout=900.0)
+            self._verify_bootstrap(name)
+        except Exception:
+            try:
+                self._run("delete", name)
+            except Exception:
+                pass  # already raising the root cause; deletion is best-effort
+            raise
         return name
+
+    def _wait_state(self, name: str, want: str, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            raw = self._run("describe", name)
+            state = (json.loads(raw or "{}") or {}).get("state", "")
+            if state == want:
+                return
+            if state in ("FAILED", "TERMINATED", "DELETING"):
+                raise RuntimeError(f"TPU VM {name} entered state {state} "
+                                   f"while waiting for {want}")
+            time.sleep(self._poll_s)
+        raise RuntimeError(f"TPU VM {name} not {want} after {timeout:.0f}s")
+
+    def _verify_bootstrap(self, name: str, attempts: int = 5) -> None:
+        """The bootstrap command backgrounds the node service, so ssh exit
+        0 proves nothing — probe that the process is actually alive on
+        every host, and surface the node log tail if it is not."""
+        for i in range(attempts):
+            try:
+                out = self._run(
+                    "ssh", name, "--worker=all",
+                    "--command=pgrep -f ray_tpu.core.node >/dev/null "
+                    "&& echo BOOTSTRAP_ALIVE", timeout=120.0)
+                if "BOOTSTRAP_ALIVE" in out:
+                    return
+            except RuntimeError:
+                pass  # ssh itself can flake while the VM settles
+            time.sleep(self._poll_s)
+        try:
+            log = self._run("ssh", name, "--worker=all",
+                            "--command=tail -n 40 /tmp/ray_tpu_node.log",
+                            timeout=120.0)
+        except RuntimeError:
+            log = "<log unavailable>"
+        raise RuntimeError(
+            f"node service never came up on {name}; log tail:\n{log}")
 
     def terminate_node(self, node_id: str) -> None:
         self._run("delete", node_id)

@@ -30,7 +30,8 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._config import RayTpuConfig
-from ray_tpu.core.resources import bundle_total as _bundle_total
+from ray_tpu.core.resources import (bundle_total as _bundle_total,
+                                    covers as _covers)
 from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
                                   EventLoopService)
 
@@ -295,6 +296,12 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         for h, n in list(self.nodes.items()):
             if n.alive and n.last_beat < cutoff:
                 self._node_dead(h, "heartbeat timeout")
+        # backstop for a 2PC whose participant is alive but never replies
+        # (node death mid-2PC is handled eagerly in _node_dead)
+        stuck = time.monotonic() - max(10.0, 3 * timeout)
+        for pg_id, info in list(self.pending_pgs.items()):
+            if info.get("busy") and info.get("busy_since", 0) < stuck:
+                self._reset_stuck_pg_2pc(pg_id, info)
         if (self.persistence_path and self._dirty
                 and time.monotonic() - self._last_snapshot > 0.5):
             try:
@@ -364,6 +371,13 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                 self._replace_actor(ad, cause)
             else:
                 self._actor_dead(ad, f"node died: {cause}")
+        # pending PGs mid-2PC with the dead node as a participant would
+        # never see their prepare complete — roll back and requeue now
+        for pg_id, info in list(self.pending_pgs.items()):
+            if info.get("busy") and node_hex in (info.get("assignment")
+                                                 or []):
+                self._reset_stuck_pg_2pc(pg_id, info)
+        self._try_place_pending_pgs()
         self._publish("node_state", {"node_id": node_hex, "state": "dead",
                                      "cause": cause})
         self._broadcast_view()
@@ -694,14 +708,38 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             if assignment is None:
                 continue
             info["busy"] = True
-            self._start_pg_2pc(pg_id, info, assignment)
+            info["busy_since"] = time.monotonic()
+            info["assignment"] = assignment
+            # epoch fences late callbacks from an abandoned 2PC attempt
+            info["epoch"] = info.get("epoch", 0) + 1
+            self._start_pg_2pc(pg_id, info, assignment, info["epoch"])
+
+    def _reset_stuck_pg_2pc(self, pg_id: bytes, info: dict) -> None:
+        """A participant died (or never replied) mid-2PC: roll back every
+        prepared bundle and requeue — without this the closure-held
+        prepare count never reaches zero and the PG pends forever."""
+        for j, h in enumerate(info.get("assignment") or []):
+            c = self._node_conn(h)
+            if c is not None:
+                self._push(c, {"t": "pg_rollback", "pg_id": pg_id,
+                               "bundle_idx": j})
+        info["busy"] = False
+        info.pop("busy_since", None)
+        info.pop("assignment", None)
 
     def _start_pg_2pc(self, pg_id: bytes, info: dict,
-                      assignment: list) -> None:
+                      assignment: list, epoch: int) -> None:
         # 2PC (reference: gcs_placement_group_scheduler.h:104 prepare all,
         # then commit all; rollback prepared on any failure)
         bundles, strategy = info["bundles"], info["strategy"]
         state = {"pending": len(bundles), "failed": False}
+
+        def rollback_all() -> None:
+            for j, h in enumerate(assignment):
+                c = self._node_conn(h)
+                if c is not None:
+                    self._push(c, {"t": "pg_rollback", "pg_id": pg_id,
+                                   "bundle_idx": j})
 
         def prepared(i: int, reply: dict) -> None:
             state["pending"] -= 1
@@ -709,25 +747,24 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                 state["failed"] = True
             if state["pending"] > 0:
                 return
-            if state["failed"]:
-                for j, h in enumerate(assignment):
-                    c = self._node_conn(h)
-                    if c is not None:
-                        self._push(c, {"t": "pg_rollback", "pg_id": pg_id,
-                                       "bundle_idx": j})
-                # a node raced out of resources — back to the queue
-                if pg_id in self.pending_pgs:
-                    self.pending_pgs[pg_id]["busy"] = False
+            cur = self.pending_pgs.get(pg_id)
+            if cur is not None and cur.get("epoch") != epoch:
+                # this attempt was abandoned (participant died, bundles
+                # already rolled back); never commit on its late replies
                 return
-            if pg_id not in self.pending_pgs:
+            if state["failed"]:
+                rollback_all()
+                # a node raced out of resources — back to the queue
+                if cur is not None:
+                    cur["busy"] = False
+                    cur.pop("busy_since", None)
+                    cur.pop("assignment", None)
+                return
+            if cur is None:
                 # removed while committing: the reservations are still
                 # only PREPARED — roll them back (pg_remove_local frees
                 # committed bundles only and would leak the debit)
-                for j, h in enumerate(assignment):
-                    c = self._node_conn(h)
-                    if c is not None:
-                        self._push(c, {"t": "pg_rollback", "pg_id": pg_id,
-                                       "bundle_idx": j})
+                rollback_all()
                 return
             for j, h in enumerate(assignment):
                 c = self._node_conn(h)
@@ -774,8 +811,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         if strategy in ("PACK", "STRICT_PACK"):
             total = _bundle_total(bundles)
             for n in sorted(alive, key=lambda n: -sum(cap(n).values())):
-                if all(cap(n).get(k, 0.0) + 1e-9 >= v
-                       for k, v in total.items()):
+                if _covers(cap(n), total):
                     return [n.node_hex] * len(bundles)
             if strategy == "STRICT_PACK":
                 return None
@@ -791,7 +827,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                 if strategy == "STRICT_SPREAD" and n.node_hex in used_nodes:
                     continue
                 bud = budget[n.node_hex]
-                if all(bud.get(k, 0.0) + 1e-9 >= v for k, v in b.items()):
+                if _covers(bud, b):
                     for k, v in b.items():
                         bud[k] = bud.get(k, 0.0) - v
                     placed = n.node_hex

@@ -1315,13 +1315,17 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             if not tpu and q:
                 self._dispatch_zero_demand(q)
 
+    def _is_zero_demand(self, spec: dict) -> bool:
+        """True for specs that take nothing from the pool (e.g.
+        PlacementGroup.ready() pollers) — they always deserve a worker."""
+        return (not spec.get("placement_group")
+                and all(v <= 0 for v in self._demand(spec).values()))
+
     def _dispatch_zero_demand(self, q: deque) -> None:
-        """Zero-demand tasks (e.g. PlacementGroup.ready() pollers) take
-        nothing from the pool, so FIFO head-of-line blocking must not
-        starve them: dispatch any such spec stuck behind a blocked head."""
-        for spec in [s for s in q
-                     if not s.get("placement_group")
-                     and all(v <= 0 for v in self._demand(s).values())]:
+        """Zero-demand tasks take nothing from the pool, so FIFO
+        head-of-line blocking must not starve them: dispatch any such
+        spec stuck behind a blocked head."""
+        for spec in [s for s in q if self._is_zero_demand(s)]:
             w = self._find_idle_worker(tpu=False,
                                        env_hash=spec.get("env_hash"))
             if w is None:
@@ -1399,8 +1403,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # :192,717).
         n_pg = min(self._queued_pg, len(self.runnable_cpu))
         n_zero = sum(1 for s in self.runnable_cpu
-                     if not s.get("placement_group")
-                     and all(v <= 0 for v in self._demand(s).values()))
+                     if self._is_zero_demand(s))
         cpu_demand = min(len(self.runnable_cpu) - n_pg - n_zero,
                          max(0, int(self.available.get("CPU", 0.0))))
         demand = cpu_demand + n_pg + n_zero + n_actors_waiting

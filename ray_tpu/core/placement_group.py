@@ -19,6 +19,38 @@ from ray_tpu.core.runtime import get_runtime
 
 VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
+_pg_ready_fn = None
+
+
+def _get_pg_ready_fn():
+    """Module-level zero-cpu poller (one registration, shared by every
+    PlacementGroup instance)."""
+    global _pg_ready_fn
+    if _pg_ready_fn is None:
+        from ray_tpu.core.remote_function import remote
+
+        @remote(num_cpus=0)
+        def _pg_ready(pg_id_bin: bytes) -> bool:
+            import time as _t
+            rt = get_runtime()
+            delay = 0.02
+            while True:
+                st = rt.client.request({"t": "pg_state",
+                                        "pg_id": pg_id_bin})["state"]
+                if st == "created":
+                    return True
+                if st == "removed":
+                    raise RuntimeError(
+                        "placement group was removed before it was "
+                        "scheduled")
+                _t.sleep(delay)
+                # back off: pending groups can pend for minutes — don't
+                # hammer the single-threaded head with 50 Hz state RPCs
+                delay = min(delay * 1.5, 0.5)
+
+        _pg_ready_fn = _pg_ready
+    return _pg_ready_fn
+
 
 @dataclass
 class PlacementGroup:
@@ -34,24 +66,7 @@ class PlacementGroup:
         on a busy cluster the ref stays unresolved until capacity frees;
         a removed group makes the ref raise."""
         if self._ready_ref is None:
-            from ray_tpu.core.remote_function import remote
-
-            @remote(num_cpus=0)
-            def _pg_ready(pg_id_bin: bytes) -> bool:
-                import time as _t
-                rt = get_runtime()
-                while True:
-                    st = rt.client.request({"t": "pg_state",
-                                            "pg_id": pg_id_bin})["state"]
-                    if st == "created":
-                        return True
-                    if st == "removed":
-                        raise RuntimeError(
-                            "placement group was removed before it was "
-                            "scheduled")
-                    _t.sleep(0.02)
-
-            self._ready_ref = _pg_ready.remote(self.id.binary())
+            self._ready_ref = _get_pg_ready_fn().remote(self.id.binary())
         return self._ready_ref
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:

@@ -105,16 +105,93 @@ def test_tpu_pod_provider_gcloud_surface(monkeypatch):
         if args[0] == "list":
             return ('[{"name": "projects/p/locations/z/nodes/ray-tpu-abc",'
                     ' "state": "READY"}]')
+        if args[0] == "describe":
+            return '{"state": "READY"}'
+        if args[0] == "ssh" and any("pgrep" in a for a in args):
+            return "BOOTSTRAP_ALIVE\n"
         return "{}"
 
     monkeypatch.setattr(tp.TpuPodNodeProvider, "_run", fake_run)
     p = tp.TpuPodNodeProvider(project="p", zone="us-central2-b")
+    p._poll_s = 0.01
     nid = p.create_node("10.0.0.1:6380", {"num_tpus": 4})
     assert nid.startswith("ray-tpu-")
     assert calls[0][0] == "create"
-    assert any("--worker=all" in a for a in calls[1])
-    assert any("10.0.0.1:6380" in a for a in calls[1])
+    boot = next(c for c in calls if c[0] == "ssh"
+                and not any("pgrep" in a for a in c))
+    assert any("--worker=all" in a for a in boot)
+    assert any("10.0.0.1:6380" in a for a in boot)
     nodes = p.non_terminated_nodes()
     assert nodes and nodes[0].status == "running"
     p.terminate_node(nid)
+    assert calls[-1][0] == "delete"
+
+
+def _stub_provider(monkeypatch, fake_run):
+    import shutil as _shutil
+    from ray_tpu.autoscaler import tpu_pod_provider as tp
+    monkeypatch.setattr(_shutil, "which", lambda _: "/usr/bin/gcloud")
+    monkeypatch.setattr(tp.TpuPodNodeProvider, "_run", fake_run)
+    p = tp.TpuPodNodeProvider(project="p", zone="us-central2-b")
+    p._poll_s = 0.01
+    return p
+
+
+def test_tpu_pod_provider_bootstrap_failure_cleans_up(monkeypatch):
+    """ssh bootstrap exits non-zero → the half-created slice is deleted
+    (never leak billable TPU VMs) and the error carries the root cause."""
+    import pytest as _pytest
+    calls = []
+
+    def fake_run(self, *args, timeout=600.0):
+        calls.append(args)
+        if args[0] == "describe":
+            return '{"state": "READY"}'
+        if args[0] == "ssh":
+            raise RuntimeError("gcloud failed: ssh exited 255")
+        return "{}"
+
+    p = _stub_provider(monkeypatch, fake_run)
+    with _pytest.raises(RuntimeError, match="ssh exited 255"):
+        p.create_node("10.0.0.1:6380", {})
+    assert calls[-1][0] == "delete", "failed create must delete the VM"
+
+
+def test_tpu_pod_provider_dead_bootstrap_detected(monkeypatch):
+    """ssh returns 0 but the backgrounded node service is not running:
+    the pgrep probe catches it, surfaces the log tail, and cleans up."""
+    import pytest as _pytest
+    calls = []
+
+    def fake_run(self, *args, timeout=600.0):
+        calls.append(args)
+        if args[0] == "describe":
+            return '{"state": "READY"}'
+        if args[0] == "ssh" and any("pgrep" in a for a in args):
+            return ""          # process not found on some host
+        if args[0] == "ssh" and any("tail" in a for a in args):
+            return "ImportError: no module named jax\n"
+        return "{}"
+
+    p = _stub_provider(monkeypatch, fake_run)
+    with _pytest.raises(RuntimeError, match="never came up"):
+        p.create_node("10.0.0.1:6380", {})
+    assert calls[-1][0] == "delete"
+
+
+def test_tpu_pod_provider_create_failed_state(monkeypatch):
+    """The slice lands in FAILED while provisioning → create_node raises
+    and deletes instead of waiting out the full timeout."""
+    import pytest as _pytest
+    calls = []
+
+    def fake_run(self, *args, timeout=600.0):
+        calls.append(args)
+        if args[0] == "describe":
+            return '{"state": "FAILED"}'
+        return "{}"
+
+    p = _stub_provider(monkeypatch, fake_run)
+    with _pytest.raises(RuntimeError, match="FAILED"):
+        p.create_node("10.0.0.1:6380", {})
     assert calls[-1][0] == "delete"

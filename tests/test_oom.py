@@ -36,22 +36,29 @@ def test_oom_kill_retries_without_losing_node(local_rt, tmp_path):
     svc = local_rt.node_service
     assert svc.memory_monitor is not None, "monitor should be on by default"
     marker = tmp_path / "pids.txt"
+    stop = tmp_path / "all_clear"
 
     @ray_tpu.remote(max_retries=2)
-    def hog(path):
+    def hog(path, stop_path):
         with open(path, "a") as f:
             f.write(f"{os.getpid()}\n")
             f.flush()
-        time.sleep(2.0)
+        # run until OOM-killed or the test says all-clear — a fixed sleep
+        # raced the monitor tick under parallel suite load (the task
+        # could finish before the kill landed, leaving nothing to kill)
+        deadline = time.time() + 60
+        while not os.path.exists(stop_path) and time.time() < deadline:
+            time.sleep(0.05)
         return "done"
 
     _press(svc)                      # simulated pressure: no allocation
-    ref = hog.remote(str(marker))
+    ref = hog.remote(str(marker), str(stop))
     deadline = time.time() + 60
     while time.time() < deadline and svc.oom_kill_count == 0:
         time.sleep(0.05)
     assert svc.oom_kill_count >= 1, "monitor never killed the hog"
     _relax(svc)
+    stop.write_text("go")            # let the retried execution finish
 
     assert ray_tpu.get(ref, timeout=120) == "done"
     pids = [int(x) for x in marker.read_text().split()]
