@@ -50,6 +50,11 @@ _define("object_spilling_dir", str, "",
         "Directory for spilled objects; empty = <session dir>/spill.")
 _define("object_store_full_delay_ms", int, 10,
         "Backoff when the object store is full and eviction is in progress.")
+_define("device_object_budget_mb", int, 0,
+        "Per-process HBM budget for device-resident object entries "
+        "(core/device_objects.py); oldest entries spill to the host store "
+        "when exceeded.  0 = unlimited (spill only on remote demand). "
+        "No reference analogue: plasma is host-only (store.h:55).")
 
 # --- scheduling -----------------------------------------------------------
 _define("num_workers", int, 0,

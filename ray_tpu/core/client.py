@@ -15,9 +15,11 @@ import os
 import queue
 import threading
 import uuid
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
 from ray_tpu.core import protocol
+from ray_tpu.core.device_objects import DeviceObjectTable
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_store import ObjectExists, make_shm_client
 from ray_tpu.core.serialization import (SerializedObject, get_context)
@@ -83,6 +85,16 @@ class NodeClient:
                                    native=bool(info.get("native_store")),
                                    on_full=self._need_space)
         self._serde = get_context()
+        # device-resident entries this process owns (HBM objects — see
+        # core/device_objects.py); materialization runs off the recv
+        # thread so big device→host copies don't stall reply routing
+        budget_mb = self.config_dict.get("device_object_budget_mb", 0)
+        self.device_table = DeviceObjectTable(
+            budget_bytes=int(budget_mb) * (1 << 20) if budget_mb else None)
+        # eager: lazy init from both the recv thread and caller threads
+        # could race into two pools, losing one-at-a-time ordering
+        self._materialize_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="raytpu-devmat")
 
     # ----------------------------------------------------------- plumbing
 
@@ -112,6 +124,10 @@ class NodeClient:
                 q = self._replies.pop(msg["reqid"], None)
                 if q is not None:
                     q.put(msg)
+            elif msg.get("t") == "materialize_object":
+                self._materialize_async(msg["object_id"])
+            elif msg.get("t") == "drop_device_object":
+                self.device_table.pop(msg["object_id"])
             elif self._push_handler is not None:
                 try:
                     self._push_handler(msg)
@@ -154,11 +170,64 @@ class NodeClient:
 
     def put_object(self, object_id: ObjectID, value: Any,
                    owner: Optional[str] = None,
-                   is_error: bool = False) -> int:
-        """Serialize and store; returns stored size."""
+                   is_error: bool = False,
+                   allow_device: bool = False) -> int:
+        """Serialize and store; returns stored size.
+
+        With ``allow_device`` (the explicit ray.put path), values holding
+        jax.Array leaves become device-resident entries: the buffers stay
+        in HBM in this process, only a placeholder descriptor reaches the
+        store (reference contrast: plasma store.h:55 is host-only)."""
+        if allow_device and not is_error:
+            captured: list = []
+            so = self._serde.serialize(value, device_capture=captured)
+            if captured:
+                return self._put_device(object_id, so, captured, owner)
         so = self._serde.serialize(value)
         return self.put_serialized(object_id, so, owner=owner,
                                    is_error=is_error)
+
+    def _put_device(self, object_id: ObjectID, descriptor: SerializedObject,
+                    leaves: list, owner: Optional[str]) -> int:
+        desc_bytes = descriptor.to_bytes()
+        spill = self.device_table.put(object_id.binary(), leaves, desc_bytes)
+        nested = [r.binary() for r in descriptor.nested_refs]
+        nbytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in leaves)
+        self.send({"t": "put_device", "object_id": object_id.binary(),
+                   "descriptor": desc_bytes, "size": nbytes,
+                   "owner": owner or self.worker_id,
+                   "nested_refs": nested})
+        for ob in spill:
+            # budget pressure: flush oldest entries to the host store
+            self._materialize_async(ob)
+        return nbytes
+
+    def _materialize_async(self, oid_bin: bytes) -> None:
+        self._materialize_pool.submit(self._materialize, oid_bin)
+
+    def _materialize(self, oid_bin: bytes) -> None:
+        """Spill one device entry to the host store (on remote demand or
+        budget pressure): rebuild the value from descriptor + leaves,
+        store it the ordinary way, then drop the HBM references."""
+        try:
+            leaves = self.device_table.leaves(oid_bin)
+            desc = self.device_table.descriptor(oid_bin)
+            if leaves is None or desc is None:
+                return  # freed concurrently
+            so = SerializedObject.from_buffer(desc)
+            value = self._serde.deserialize_with_leaves(so, leaves)
+            self.put_object(ObjectID(oid_bin), value, allow_device=False)
+            self.device_table.pop(oid_bin)
+        except Exception as e:
+            # the node flipped the entry to pending; if we stay silent
+            # every getter hangs — report so it seals an error object
+            import traceback
+            traceback.print_exc()
+            try:
+                self.send({"t": "materialize_failed", "object_id": oid_bin,
+                           "error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
 
     def put_serialized(self, object_id: ObjectID, so: SerializedObject,
                        owner: Optional[str] = None,
@@ -205,6 +274,20 @@ class NodeClient:
                 if res["loc"] == "shm":
                     buf = self.shm.map(oid)
                     so = SerializedObject.from_buffer(buf[:res["size"]])
+                elif res["loc"] == "device_local":
+                    # we ARE the owner: splice our own HBM leaves back in
+                    leaves = self.device_table.leaves(oid.binary())
+                    if leaves is None:
+                        # raced a budget spill: the entry just moved to
+                        # the host store (its register preceded our pop on
+                        # this same socket, so a re-get sees the host copy)
+                        out.append(self.get_objects([oid],
+                                                    timeout=timeout)[0])
+                        continue
+                    so = SerializedObject.from_buffer(res["data"])
+                    out.append(self._serde.deserialize_with_leaves(
+                        so, leaves))
+                    continue
                 else:
                     so = SerializedObject.from_buffer(res["data"])
                 value = self._serde.deserialize(so)

@@ -63,11 +63,14 @@ from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
 @dataclass
 class ObjInfo:
     state: str = "pending"       # pending | ready | error
-    loc: str = ""                # inline | shm
+    loc: str = ""                # inline | shm | device
     data: Optional[bytes] = None  # inline payload (SerializedObject wire bytes)
     size: int = 0
     owner: str = ""
     is_error: bool = False
+    # device-resident entries: conn_id of the process holding the HBM
+    # buffers (core/device_objects.py); data holds the descriptor
+    owner_conn: Optional[int] = None
     loc_reported: bool = False   # location pushed to the head
     nested: tuple = ()           # ids this object's value embeds refs to
     wait_waiters: list = field(default_factory=list)
@@ -227,6 +230,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._pg_prepared: dict[tuple, dict] = {}      # (pg,idx) -> bundle
         self._pg_bundles: dict[tuple, dict] = {}       # committed originals
         self._pending_local_pgs: dict[bytes, dict] = {}  # single-node queue
+        self._device_pending_pulls: dict[bytes, list] = {}  # ob -> [(conn,m)]
         self._released_wait: set[ObjectID] = set()     # owner-released oids
         self._nested_count: dict[bytes, int] = {}      # id -> container holds
         # ---- ownership + lineage (reference: reference_count.h /
@@ -613,8 +617,16 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _h_get_objects(self, rec, m):
         """Batched blocking get: reply once ALL requested objects resolve."""
         ids = [ObjectID(b) for b in m["object_ids"]]
+        for o in ids:
+            info = self.objects.setdefault(o, ObjInfo())
+            if (info.loc == "device" and info.state == "ready"
+                    and info.owner_conn != rec.conn_id):
+                # another process wants a device-resident object: ask the
+                # owner to spill it to the host store once (materialize-
+                # on-demand), then this get resolves like any other
+                self._request_materialize(o, info)
         pending = [o for o in ids
-                   if self.objects.setdefault(o, ObjInfo()).state == "pending"]
+                   if self.objects[o].state == "pending"]
         if not pending:
             self._reply_batch(rec, m["reqid"], ids)
             return
@@ -622,17 +634,75 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._multigets[key] = {"ids": ids, "remaining": set(pending)}
         for o in pending:
             self._mg_by_oid.setdefault(o, set()).add(key)
-        self._ensure_remote_watch(pending)
+        self._ensure_remote_watch([o for o in pending
+                                   if self.objects[o].loc != "device"])
         if rec.state == "busy":
             rec.state = "blocked"
             self._release_task_cpu(rec)
             self._schedule()
 
+    # -- device-resident objects (core/device_objects.py) -------------------
+
+    def _h_put_device(self, rec, m):
+        oid = ObjectID(m["object_id"])
+        info = self.objects.setdefault(oid, ObjInfo())
+        info.state = "ready"
+        info.loc = "device"
+        info.data = m["descriptor"]
+        info.size = m.get("size", 0)
+        info.owner = info.owner or m.get("owner", rec.worker_id)
+        info.owner_conn = rec.conn_id
+        if self.head_conn is not None and not info.owner_node:
+            info.owner_node = (self.node_id.hex(), self.address)
+        self._track_nested(info, m.get("nested_refs"))
+        self._resolve_waiters(oid, info)
+
+    def _h_materialize_failed(self, rec, m):
+        oid = ObjectID(m["object_id"])
+        info = self.objects.get(oid)
+        if (info is not None and info.state == "pending"
+                and info.loc == "device"):
+            self._seal_error_object(oid, RuntimeError(
+                f"device object materialization failed: {m.get('error')}"))
+
+    def _request_materialize(self, oid: ObjectID, info: ObjInfo) -> None:
+        owner = self.clients.get(info.owner_conn)
+        if owner is None:
+            self._device_owner_lost(oid, info)
+            return
+        info.state = "pending"
+        self._push(owner, {"t": "materialize_object",
+                           "object_id": oid.binary()})
+
+    def _device_owner_lost(self, oid: ObjectID, info: ObjInfo) -> None:
+        """The process holding a device entry's HBM buffers died: the
+        value is gone.  Reconstruction via lineage applies exactly as for
+        any lost object; without lineage the get errors."""
+        info.loc = ""
+        info.data = None
+        info.owner_conn = None
+        info.state = "pending"
+        if not self._try_reconstruct_device(oid):
+            self._seal_error_object(
+                oid, RuntimeError(
+                    "owner process of device-resident object died"))
+
+    def _try_reconstruct_device(self, oid: ObjectID) -> bool:
+        rec_ = self.owned.get(oid.binary())
+        if rec_ is not None and rec_.task_id:
+            return self._reconstruct(rec_.task_id)
+        return False
+
     def _reply_batch(self, rec, reqid, ids):
         results = []
         for oid in ids:
             info = self.objects[oid]
-            if info.loc == "shm":
+            if info.loc == "device":
+                # only the owner reaches here with a device loc (others
+                # were routed through materialization in _h_get_objects)
+                results.append({"loc": "device_local", "data": info.data,
+                                "is_error": False})
+            elif info.loc == "shm":
                 if self.store.is_spilled(oid):
                     self.store.restore(oid)
                 self.store.touch(oid)
@@ -685,6 +755,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _object_ready_hook(self, oid: ObjectID, info: ObjInfo) -> None:
         """Cluster bookkeeping when an object becomes ready/error here."""
         ob = oid.binary()
+        if info.loc != "device":
+            for conn_id, pm in self._device_pending_pulls.pop(ob, []):
+                peer = self.clients.get(conn_id)
+                if peer is not None:
+                    self._h_pull_object(peer, pm)
         self._watched.discard(ob)
         self._pull_attempts.pop(ob, None)
         self._owner_watch.pop(ob, None)
@@ -838,6 +913,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _delete_local_object(self, oid: ObjectID) -> None:
         info = self.objects.get(oid)
+        # capture BEFORE sealing: _seal_error_object rewrites loc to
+        # "inline", which would skip the owner's HBM release below
+        was_device = info is not None and info.loc == "device"
+        device_owner = info.owner_conn if was_device else None
         if info is not None and (info.state == "pending"
                                  or oid in self._mg_by_oid
                                  or info.wait_waiters
@@ -845,6 +924,12 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             # fail anyone blocked on it before it vanishes
             self._seal_error_object(
                 oid, RuntimeError(f"Object {oid.hex()[:16]} was freed"))
+        if was_device:
+            # tell the owner process to release the HBM buffers
+            owner = self.clients.get(device_owner)
+            if owner is not None:
+                self._push(owner, {"t": "drop_device_object",
+                                   "object_id": oid.binary()})
         self._forget_object(oid)
 
     def _h_free_objects(self, rec, m):
@@ -2404,6 +2489,14 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         ob = m["object_id"]
         oid = ObjectID(ob)
         info = self.objects.get(oid)
+        if info is not None and info.loc == "device":
+            # device-resident: spill to host first, then serve the pull
+            # (the queued request replays when materialization lands)
+            self._device_pending_pulls.setdefault(ob, []).append(
+                (rec.conn_id, dict(m)))
+            if info.state == "ready":
+                self._request_materialize(oid, info)
+            return
         if info is None or info.state == "pending":
             self._push(rec, {"t": "pull_failed", "object_id": ob,
                              "error": "object not found on this node"})
@@ -2789,6 +2882,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         for oid, _ts in rec.held_pins:
             self.store.unpin(oid)
         rec.held_pins.clear()
+        # device-resident entries die with their owner process
+        for oid, info in list(self.objects.items()):
+            if info.loc == "device" and info.owner_conn == rec.conn_id:
+                self._device_owner_lost(oid, info)
         # drop any outbound transfers to this peer
         for key in [k for k in self._out_transfers if k[0] == rec.conn_id]:
             st = self._out_transfers.pop(key)

@@ -290,7 +290,10 @@ class Runtime:
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.for_put(current_task_id(), self._next_put_index())
-        self.client.put_object(oid, value)
+        # explicit puts keep jax.Array leaves device-resident (HBM
+        # objects, core/device_objects.py) — no host bounce until a
+        # different process actually asks for the value
+        self.client.put_object(oid, value, allow_device=True)
         return ObjectRef(oid, owner=self.client.worker_id)
 
     def get(self, refs: Sequence[ObjectRef],

@@ -13,9 +13,11 @@ from __future__ import annotations
 import io
 import pickle
 from dataclasses import dataclass, field
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import cloudpickle
+
+from ray_tpu.core import device_objects
 
 
 @dataclass
@@ -82,11 +84,19 @@ class SerializationContext:
 
     # -- serialize ---------------------------------------------------------
 
-    def serialize(self, value: Any) -> SerializedObject:
+    def serialize(self, value: Any,
+                  device_capture: Optional[list] = None) -> SerializedObject:
+        """With ``device_capture`` (a list), jax.Array leaves are NOT
+        materialized to host bytes: each is appended to the list and the
+        pickle stream carries a placeholder (device-resident put path —
+        see core/device_objects.py; the reference's plasma cannot do
+        this, store.h:55 is host-only)."""
         buffers: list = []
         nested_refs: list = []
         threshold = self._out_of_band_threshold
         custom = self._custom
+        jax_types = (device_objects.try_jax_array_types()
+                     if device_capture is not None else None)
 
         def buffer_callback(buf: pickle.PickleBuffer):
             raw = buf.raw()
@@ -101,6 +111,11 @@ class SerializationContext:
                 if isinstance(obj, ObjectRef):
                     nested_refs.append(obj)
                     return (_deserialize_object_ref, (obj.binary(), obj.owner))
+                if jax_types is not None and isinstance(obj, jax_types[0]) \
+                        and not isinstance(obj, jax_types[1]):
+                    device_capture.append(obj)
+                    return (device_objects._device_leaf,
+                            (len(device_capture) - 1,))
                 for klass, (ser, de) in custom.items():
                     if isinstance(obj, klass):
                         return (_apply_custom, (de, ser(obj)))
@@ -120,6 +135,17 @@ class SerializationContext:
 
     def deserialize(self, so: SerializedObject) -> Any:
         return pickle.loads(so.inband, buffers=so.buffers)
+
+    def deserialize_with_leaves(self, so: SerializedObject,
+                                leaves: list) -> Any:
+        """Deserialize a device-resident descriptor, splicing the process
+        -local jax.Array leaves back in (fresh container, shared immutable
+        leaves — zero copies)."""
+        device_objects.set_splice_leaves(leaves)
+        try:
+            return pickle.loads(so.inband, buffers=so.buffers)
+        finally:
+            device_objects.set_splice_leaves(None)
 
 
 def _apply_custom(deserializer, payload):
