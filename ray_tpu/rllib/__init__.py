@@ -23,7 +23,9 @@ from ray_tpu.rllib.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.pg import PG, PGConfig
 from ray_tpu.rllib.policy_server import PolicyClient, PolicyServerInput
+from ray_tpu.rllib.dreamer import Dreamer, DreamerConfig, LinearLatentEnv
 from ray_tpu.rllib.dt import DT, DTConfig
+from ray_tpu.rllib.maml import MAML, MAMLConfig, SinusoidTasks
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, SpreadLine
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, TeamSwitch
 from ray_tpu.rllib.r2d2 import R2D2, R2D2Config
@@ -73,6 +75,8 @@ __all__ = [
     "QMIXConfig", "TeamSwitch", "MADDPG", "MADDPGConfig", "SpreadLine",
     "RLModule", "MultiRLModule", "DiscretePGModule", "Learner",
     "LearnerGroup", "DT", "DTConfig",
+    "Dreamer", "DreamerConfig", "LinearLatentEnv",
+    "MAML", "MAMLConfig", "SinusoidTasks",
 ]
 
 from ray_tpu import usage_stats as _usage_stats

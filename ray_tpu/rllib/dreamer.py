@@ -1,0 +1,520 @@
+"""DreamerV1: world-model RL — learn latent dynamics, imagine, act.
+
+Reference capability: rllib/algorithms/dreamer/ (dreamer.py,
+dreamer_torch_policy.py:50-147 losses, dreamer_model.py RSSM) — an RSSM
+world model (deterministic GRU path + stochastic gaussian latent),
+observation/reward decoders, and an actor-critic trained entirely on
+imagined latent rollouts with λ-returns, backpropagating through the
+learned dynamics.
+
+TPU redesign: the ENTIRE update — posterior scan over the observed
+sequence, KL/reconstruction/reward losses, imagination scan over the
+horizon (gradients flow through the dynamics), λ-return scan, actor and
+critic updates — is ONE jitted program of three nested ``lax.scan``s;
+the reference splits this across three torch optimizers and eager
+rollouts (dreamer_torch_policy.py:203 three Adam instances — kept, as
+three optax partitions inside the same compiled step).  Dense
+encoder/decoder (vector observations; the reference's 64×64 conv
+encoder is a pixels-specific frontend, dreamer_model.py:23).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+# -- toy latent-dynamics env (convergence workload) -------------------------
+
+class LinearLatentEnv:
+    """Hidden linear dynamics observed through a random projection:
+    x' = Ax + Ba + ε, obs = Cx, reward = -|x|² - 0.01|a|².  The world
+    model must recover the latent to act; an agent that learns it can
+    hold |x| near 0."""
+
+    OBS_DIM, LATENT, ACT_DIM = 6, 2, 2
+    HORIZON = 64
+
+    def __init__(self, seed: Optional[int] = None):
+        r = np.random.RandomState(0)   # fixed dynamics across instances
+        # gains sized so rewards stay O(1) per step (Dreamer's losses
+        # assume control-suite-scale rewards; huge reward magnitudes
+        # swamp the model loss and destabilize imagined returns)
+        self.A = np.eye(self.LATENT) * 0.9
+        self.B = r.randn(self.LATENT, self.ACT_DIM) * 0.15
+        self.C = r.randn(self.OBS_DIM, self.LATENT) * 0.5
+        self.rng = np.random.RandomState(seed)
+        self.observation_dim = self.OBS_DIM
+        self.action_dim = self.ACT_DIM
+        self.x = None
+        self.t = 0
+
+    def reset(self):
+        self.x = (self.rng.randn(self.LATENT) * 0.7).astype(np.float32)
+        self.t = 0
+        return (self.C @ self.x).astype(np.float32)
+
+    def step(self, action):
+        a = np.clip(np.asarray(action, np.float32), -1.0, 1.0)
+        noise = self.rng.randn(self.LATENT).astype(np.float32) * 0.01
+        self.x = (self.A @ self.x + self.B @ a + noise).astype(np.float32)
+        self.t += 1
+        reward = float(-(self.x ** 2).sum() - 0.01 * (a ** 2).sum())
+        done = self.t >= self.HORIZON
+        return (self.C @ self.x).astype(np.float32), reward, done
+
+
+# -- config -----------------------------------------------------------------
+
+@dataclass
+class DreamerConfig(AlgorithmConfig):
+    # model sizes (reference defaults scaled to vector obs:
+    # dreamer.py DreamerConfig dreamer_model/hidden_size)
+    deter_size: int = 64                 # GRU state
+    stoch_size: int = 8                  # stochastic latent
+    hidden: int = 64                     # MLP width
+    # losses (reference dreamer.py: kl_coeff=1.0, free_nats=3.0,
+    # lambda=0.95, imagine_horizon=15)
+    kl_coeff: float = 1.0
+    free_nats: float = 1.0
+    lambda_: float = 0.95
+    imagine_horizon: int = 10
+    gamma: float = 0.99
+    # training (reference: td_model_lr/actor_lr/critic_lr + grad_clip)
+    model_lr: float = 3e-3
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    grad_clip: float = 100.0
+    batch_size: int = 16                 # sequences per update
+    seq_len: int = 16
+    buffer_episodes: int = 200
+    prefill_episodes: int = 5
+    model_warmup_updates: int = 40       # model-only updates before the
+    #                                      actor trains on imagination
+    train_iters_per_step: int = 10       # model updates per training_step
+    episodes_per_step: int = 2
+    explore_noise: float = 0.3
+
+    def build(self, algo_cls=None) -> "Dreamer":
+        return Dreamer({"_config": self})
+
+
+# -- model ------------------------------------------------------------------
+
+def _dense(key, nin, nout, scale=1.0):
+    k1, _ = jax.random.split(key)
+    lim = scale * np.sqrt(6.0 / (nin + nout))
+    return {"w": jax.random.uniform(k1, (nin, nout), jnp.float32,
+                                    -lim, lim),
+            "b": jnp.zeros((nout,), jnp.float32)}
+
+
+def _mlp(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.elu(x)
+    return x
+
+
+def init_dreamer_params(cfg: DreamerConfig, obs_dim: int, act_dim: int,
+                        rng) -> dict:
+    ks = iter(jax.random.split(rng, 24))
+    H, D, S = cfg.hidden, cfg.deter_size, cfg.stoch_size
+    feat = D + S
+    return {
+        "encoder": [_dense(next(ks), obs_dim, H), _dense(next(ks), H, H)],
+        # GRU cell: input [stoch + action] -> deter
+        "gru": {"wi": _dense(next(ks), S + act_dim, 3 * D),
+                "wh": _dense(next(ks), D, 3 * D)},
+        # prior p(s|h) and posterior q(s|h, embed): mean+std heads
+        "prior": [_dense(next(ks), D, H), _dense(next(ks), H, 2 * S)],
+        "post": [_dense(next(ks), D + H, H), _dense(next(ks), H, 2 * S)],
+        "obs_dec": [_dense(next(ks), feat, H), _dense(next(ks), H, obs_dim)],
+        "rew_dec": [_dense(next(ks), feat, H), _dense(next(ks), H, 1)],
+        # small-init output head: actions start near tanh(0) instead of
+        # saturated, so the world model trains on diverse actions first
+        "actor": [_dense(next(ks), feat, H), _dense(next(ks), H, H),
+                  _dense(next(ks), H, 2 * act_dim, scale=0.1)],
+        "critic": [_dense(next(ks), feat, H), _dense(next(ks), H, 1)],
+    }
+
+
+def _gru(p, x, h):
+    """GRU cell; the candidate's hidden contribution passes through the
+    reset gate (standard formulation)."""
+    xi = x @ p["wi"]["w"] + p["wi"]["b"]
+    hh = h @ p["wh"]["w"] + p["wh"]["b"]
+    D = h.shape[-1]
+    r = jax.nn.sigmoid(xi[..., :D] + hh[..., :D])
+    z = jax.nn.sigmoid(xi[..., D:2 * D] + hh[..., D:2 * D])
+    n = jnp.tanh(xi[..., 2 * D:] + r * hh[..., 2 * D:])
+    return (1 - z) * n + z * h
+
+
+def _stats(raw):
+    S = raw.shape[-1] // 2
+    mean, std = raw[..., :S], jax.nn.softplus(raw[..., S:]) + 0.1
+    return mean, std
+
+
+def _img_step(p, stoch, deter, action):
+    """Prior step: (s, h, a) -> (h', prior mean/std)."""
+    h = _gru(p["gru"], jnp.concatenate([stoch, action], -1), deter)
+    mean, std = _stats(_mlp(p["prior"], h))
+    return h, mean, std
+
+
+def _obs_step(p, stoch, deter, action, embed):
+    """Posterior step: also condition on the encoded observation."""
+    h, pmean, pstd = _img_step(p, stoch, deter, action)
+    x = jnp.concatenate([h, embed], -1)
+    qmean, qstd = _stats(_mlp(p["post"], x))
+    return h, (pmean, pstd), (qmean, qstd)
+
+
+def _kl(qm, qs, pm, ps):
+    return (jnp.log(ps / qs)
+            + (qs ** 2 + (qm - pm) ** 2) / (2 * ps ** 2) - 0.5).sum(-1)
+
+
+def make_dreamer_update(cfg: DreamerConfig, obs_dim: int, act_dim: int,
+                        tx_model, tx_actor, tx_critic):
+    free_nats = cfg.free_nats
+    H = cfg.imagine_horizon
+
+    def observe(p, obs_seq, act_seq, rng):
+        """Posterior scan over [B, T, ...]; returns features and KL.
+
+        The transition INTO step t is conditioned on a_{t-1} (the action
+        taken at obs_{t-1}) — the same causal filtering policy_step does
+        online; buffer actions are stored as taken-AT-obs_t, so they
+        shift right by one with a zero first action."""
+        B, T = obs_seq.shape[:2]
+        embed = _mlp(p["encoder"], obs_seq)              # [B, T, H]
+        prev_act = jnp.concatenate(
+            [jnp.zeros_like(act_seq[:, :1]), act_seq[:, :-1]], axis=1)
+
+        def step(carry, xs):
+            stoch, deter, rng = carry
+            a, e = xs
+            h, (pm, ps), (qm, qs) = _obs_step(p, stoch, deter, a, e)
+            rng, sub = jax.random.split(rng)
+            s = qm + qs * jax.random.normal(sub, qm.shape)
+            kl = _kl(qm, qs, pm, ps)                     # [B]
+            return (s, h, rng), (jnp.concatenate([h, s], -1), kl)
+
+        stoch0 = jnp.zeros((B, cfg.stoch_size))
+        deter0 = jnp.zeros((B, cfg.deter_size))
+        (_, _, _), (feats, kls) = jax.lax.scan(
+            step, (stoch0, deter0, rng),
+            (prev_act.transpose(1, 0, 2), embed.transpose(1, 0, 2)))
+        return feats, kls                                # [T, B, feat], [T, B]
+
+    def model_loss(p, batch, rng):
+        obs, act, rew = batch["obs"], batch["actions"], batch["rewards"]
+        feats, kls = observe(p, obs, act, rng)
+        obs_t = obs.transpose(1, 0, 2)                   # [T, B, obs]
+        rew_t = rew.transpose(1, 0)                      # [T, B]
+        obs_pred = _mlp(p["obs_dec"], feats)
+        # arrival-reward convention: rew[t-1] (the reward produced by
+        # a_{t-1}) is predicted from feat_t — matching imagination, where
+        # the decoder reads the arrived-at state
+        rew_pred = _mlp(p["rew_dec"], feats[1:])[..., 0]
+        # unit-variance gaussian NLL ≡ MSE (reference: image/reward
+        # log_prob, dreamer_torch_policy.py:76-77)
+        recon = 0.5 * ((obs_pred - obs_t) ** 2).sum(-1).mean()
+        rloss = 0.5 * ((rew_pred - rew_t[:-1]) ** 2).mean()
+        div = jnp.maximum(kls.mean(), free_nats)
+        loss = cfg.kl_coeff * div + recon + rloss
+        return loss, (feats, {"model_loss": loss, "obs_loss": recon,
+                              "reward_loss": rloss, "kl": kls.mean()})
+
+    def actor_sample(p, feat, rng):
+        raw = _mlp(p["actor"], feat)
+        mean, std = _stats(raw)
+        eps = jax.random.normal(rng, mean.shape)
+        return jnp.tanh(mean + std * eps)
+
+    def imagine(p, actor_p, feats0, rng):
+        """Imagination rollout from every posterior state, gradients flow
+        through the dynamics (Dreamer's defining trick)."""
+        stoch = feats0[..., cfg.deter_size:]
+        deter = feats0[..., :cfg.deter_size]
+
+        def step(carry, _):
+            stoch, deter, rng = carry
+            feat = jnp.concatenate([deter, stoch], -1)
+            rng, sub1, sub2 = jax.random.split(rng, 3)
+            a = actor_sample({"actor": actor_p}, feat, sub1)
+            h, pm, ps = _img_step(p, stoch, deter, a)
+            s = pm + ps * jax.random.normal(sub2, pm.shape)
+            return (s, h, rng), jnp.concatenate([h, s], -1)
+
+        (_, _, _), feats = jax.lax.scan(step, (stoch, deter, rng),
+                                        None, length=H)
+        return feats                                     # [H, N, feat]
+
+    def lambda_returns(rew, val, gamma, lam):
+        """[H, N] λ-returns (reference dreamer_torch_policy.py:101-104)."""
+        inputs = rew[:-1] + gamma * val[1:] * (1 - lam)
+
+        def agg(nxt, x):
+            y = x + gamma * lam * nxt
+            return y, y
+
+        _, rets = jax.lax.scan(agg, val[-1], (inputs)[::-1])
+        return rets[::-1]                                # [H-1, N]
+
+    def actor_loss(actor_p, model_p, feats_flat, rng):
+        p = {**model_p, "actor": actor_p}
+        ifeats = imagine(p, actor_p, feats_flat, rng)    # [H, N, feat]
+        rew = _mlp(p["rew_dec"], ifeats)[..., 0]         # [H, N]
+        val = _mlp(p["critic"], ifeats)[..., 0]
+        rets = lambda_returns(rew, val, cfg.gamma, cfg.lambda_)
+        disc = jnp.cumprod(
+            jnp.concatenate([jnp.ones((1,)),
+                             jnp.full((H - 2,), cfg.gamma)]), 0)
+        loss = -(disc[:, None] * rets).mean()
+        return loss, (ifeats, rets)
+
+    def critic_loss(critic_p, model_p, ifeats, rets):
+        p = {**model_p, "critic": critic_p}
+        val = _mlp(p["critic"], ifeats[:-1])[..., 0]
+        return 0.5 * ((val - jax.lax.stop_gradient(rets)) ** 2).mean()
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("train_ac",))
+    def update(state, batch, rng, train_ac: bool = True):
+        params, opt_m, opt_a, opt_c = state
+        r1, r2, r3 = jax.random.split(rng, 3)
+
+        model_p = {k: v for k, v in params.items()
+                   if k not in ("actor", "critic")}
+        (mloss, (feats, metrics)), g_model = jax.value_and_grad(
+            model_loss, has_aux=True)(model_p, batch, r1)
+        upd_m, opt_m = tx_model.update(g_model, opt_m, model_p)
+        model_p = optax.apply_updates(model_p, upd_m)
+
+        if not train_ac:
+            # warmup phase: let the world model settle before the actor
+            # starts trusting (and exploiting) its imagination
+            new_params = {**model_p, "actor": params["actor"],
+                          "critic": params["critic"]}
+            metrics = {**metrics,
+                       "actor_loss": jnp.zeros(()),
+                       "critic_loss": jnp.zeros(())}
+            return (new_params, opt_m, opt_a, opt_c), metrics
+
+        feats_flat = jax.lax.stop_gradient(
+            feats.reshape(-1, feats.shape[-1]))
+        full_p = {**model_p, "critic": params["critic"]}
+        (aloss, (ifeats, rets)), g_actor = jax.value_and_grad(
+            actor_loss, has_aux=True)(params["actor"], full_p,
+                                      feats_flat, r2)
+        upd_a, opt_a = tx_actor.update(g_actor, opt_a, params["actor"])
+        actor_p = optax.apply_updates(params["actor"], upd_a)
+
+        closs, g_critic = jax.value_and_grad(critic_loss)(
+            params["critic"], model_p,
+            jax.lax.stop_gradient(ifeats), rets)
+        upd_c, opt_c = tx_critic.update(g_critic, opt_c, params["critic"])
+        critic_p = optax.apply_updates(params["critic"], upd_c)
+
+        new_params = {**model_p, "actor": actor_p, "critic": critic_p}
+        metrics = {**metrics, "actor_loss": aloss, "critic_loss": closs}
+        return (new_params, opt_m, opt_a, opt_c), metrics
+
+    return update, observe, actor_sample
+
+
+# -- sequence replay --------------------------------------------------------
+
+class EpisodeBuffer:
+    """Whole episodes host-side; samples [B, seq_len] windows."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.episodes: list[dict] = []
+        self.capacity = capacity
+        self.rng = np.random.RandomState(seed)
+
+    def add(self, ep: dict) -> None:
+        self.episodes.append(ep)
+        if len(self.episodes) > self.capacity:
+            self.episodes.pop(0)
+
+    def __len__(self):
+        return len(self.episodes)
+
+    def sample(self, batch_size: int, seq_len: int) -> dict:
+        outs = {"obs": [], "actions": [], "rewards": []}
+        for _ in range(batch_size):
+            ep = self.episodes[self.rng.randint(len(self.episodes))]
+            T = len(ep["rewards"])
+            start = self.rng.randint(max(1, T - seq_len + 1))
+            sl = slice(start, start + seq_len)
+            for k in outs:
+                seq = ep[k][sl]
+                if len(seq) < seq_len:   # pad short tails by repetition
+                    pad = np.repeat(seq[-1:], seq_len - len(seq), axis=0)
+                    seq = np.concatenate([seq, pad], 0)
+                outs[k].append(seq)
+        return {k: np.stack(v) for k, v in outs.items()}
+
+
+# -- algorithm --------------------------------------------------------------
+
+class Dreamer(Algorithm):
+    _default_config = DreamerConfig
+
+    def _build(self):
+        cfg = self.config
+        # the base config's env DEFAULT is the discrete CartPole string;
+        # Dreamer is continuous-control, so only that inherited default
+        # maps to the latent toy env — explicit strings resolve normally
+        env = cfg.env
+        if isinstance(env, str):
+            if env == AlgorithmConfig.env:
+                env = LinearLatentEnv
+            else:
+                from ray_tpu.rllib.env import make_env
+                env = make_env(env, seed=cfg.seed)
+        self.env = env(seed=cfg.seed) if callable(env) else env
+        if not hasattr(self.env, "action_dim"):
+            raise ValueError(
+                f"Dreamer needs a continuous env exposing action_dim; "
+                f"{type(self.env).__name__} does not")
+        obs_dim = self.env.observation_dim
+        act_dim = getattr(self.env, "action_dim", 1)
+        self.act_dim = act_dim
+        self.params_rng = jax.random.PRNGKey(cfg.seed)
+        params = init_dreamer_params(cfg, obs_dim, act_dim, self.params_rng)
+        def tx(lr):
+            return optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                               optax.adam(lr))
+
+        self.tx_model = tx(cfg.model_lr)
+        self.tx_actor = tx(cfg.actor_lr)
+        self.tx_critic = tx(cfg.critic_lr)
+        model_p = {k: v for k, v in params.items()
+                   if k not in ("actor", "critic")}
+        self.state = (params, self.tx_model.init(model_p),
+                      self.tx_actor.init(params["actor"]),
+                      self.tx_critic.init(params["critic"]))
+        self._update, self._observe, self._actor_sample = \
+            make_dreamer_update(cfg, obs_dim, act_dim, self.tx_model,
+                                self.tx_actor, self.tx_critic)
+
+        @jax.jit
+        def policy_step(params, stoch, deter, prev_action, obs, rng):
+            """Online filtering: one posterior step then act."""
+            embed = _mlp(params["encoder"], obs)
+            h, _, (qm, qs) = _obs_step(params, stoch, deter,
+                                       prev_action, embed)
+            rng, s1, s2 = jax.random.split(rng, 3)
+            s = qm + qs * jax.random.normal(s1, qm.shape)
+            feat = jnp.concatenate([h, s], -1)
+            raw = _mlp(params["actor"], feat)
+            mean, std = _stats(raw)
+            a = jnp.tanh(mean + std * jax.random.normal(s2, mean.shape))
+            return s, h, a, rng
+
+        self._policy_step = policy_step
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        self._model_updates = 0
+        self.buffer = EpisodeBuffer(cfg.buffer_episodes, seed=cfg.seed)
+        for _ in range(cfg.prefill_episodes):
+            self._collect_episode(random_policy=True)
+
+    def _collect_episode(self, random_policy: bool = False,
+                         explore: bool = True,
+                         record: bool = True) -> float:
+        cfg = self.config
+        obs = self.env.reset()
+        stoch = jnp.zeros((1, cfg.stoch_size))
+        deter = jnp.zeros((1, cfg.deter_size))
+        prev_a = jnp.zeros((1, self.act_dim))
+        traj = {"obs": [], "actions": [], "rewards": []}
+        ep_rew, done = 0.0, False
+        params = self.state[0]
+        while not done:
+            if random_policy:
+                a = np.random.RandomState(
+                    int(self._timesteps)).uniform(
+                    -1, 1, (self.act_dim,)).astype(np.float32)
+            else:
+                stoch, deter, a_j, self._rng = self._policy_step(
+                    params, stoch, deter, prev_a,
+                    jnp.asarray(obs, jnp.float32)[None], self._rng)
+                a = np.asarray(a_j)[0]
+                if explore and cfg.explore_noise > 0:
+                    # exploration noise on the executed action (Dreamer
+                    # paper: ε ~ N(0, 0.3)) keeps the replayed action
+                    # distribution wide enough that the model can't be
+                    # exploited in unvisited action regions
+                    a = np.clip(
+                        a + np.asarray(
+                            jax.random.normal(
+                                jax.random.fold_in(
+                                    self._rng, self._timesteps),
+                                a.shape)) * cfg.explore_noise,
+                        -1.0, 1.0).astype(np.float32)
+                prev_a = jnp.asarray(a, jnp.float32)[None]
+            nobs, rew, done = self.env.step(a)
+            traj["obs"].append(np.asarray(obs, np.float32))
+            traj["actions"].append(np.asarray(a, np.float32))
+            traj["rewards"].append(np.float32(rew))
+            obs = nobs
+            ep_rew += rew
+            if record:
+                self._timesteps += 1
+        if record:
+            self.buffer.add({k: np.stack(v) for k, v in traj.items()})
+            self._ep_returns.append(ep_rew)
+        return ep_rew
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        for _ in range(cfg.episodes_per_step):
+            self._collect_episode()
+        metrics = {}
+        for _ in range(cfg.train_iters_per_step):
+            b = self.buffer.sample(cfg.batch_size, cfg.seq_len)
+            jb = {k: jnp.asarray(v) for k, v in b.items()}
+            self._rng, sub = jax.random.split(self._rng)
+            train_ac = self._model_updates >= cfg.model_warmup_updates
+            self.state, m = self._update(self.state, jb, sub,
+                                         train_ac=train_ac)
+            self._model_updates += 1
+            metrics = {k: float(v) for k, v in m.items()}
+        return {"steps_this_iter":
+                cfg.episodes_per_step * getattr(self.env, "HORIZON", 64),
+                **metrics}
+
+    def evaluate_episodes(self, n: int = 4) -> float:
+        """Mean return of noise-free policy episodes (the honest policy
+        metric — collection episodes carry exploration noise).  Side-
+        effect free: eval episodes enter neither the buffer nor the
+        training counters."""
+        return float(np.mean(
+            [self._collect_episode(explore=False, record=False)
+             for _ in range(n)]))
+
+    def save_checkpoint(self) -> dict:
+        return {"state": jax.tree.map(np.asarray, self.state),
+                "timesteps": self._timesteps,
+                "model_updates": self._model_updates}
+
+    def load_checkpoint(self, ck):
+        self.state = jax.tree.map(jnp.asarray, ck["state"])
+        self._timesteps = ck.get("timesteps", 0)
+        # without this a restored agent re-enters the model-only warmup
+        self._model_updates = ck.get("model_updates", 0)

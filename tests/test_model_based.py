@@ -1,0 +1,84 @@
+"""Model-based / meta-RL family: DreamerV1 + MAML.
+
+Reference analogues: rllib/algorithms/dreamer/tests/test_dreamer.py,
+rllib/algorithms/maml/tests/test_maml.py (compilation + learning
+smoke); convergence thresholds here follow the repo's test strategy of
+asserting actual learning, not just API shape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (Dreamer, DreamerConfig, LinearLatentEnv,
+                           MAML, MAMLConfig)
+
+
+def test_maml_sinusoid_adaptation():
+    """The MAML claim: after meta-training, a few inner gradient steps on
+    10 support points of an unseen sinusoid cut query MSE well below the
+    unadapted loss (Finn et al. 2017 §5.1).  Full-paper convergence takes
+    70k iterations; 800 establishes the adaptation gap robustly."""
+    algo = MAMLConfig(meta_batch_size=25, meta_iters_per_step=200,
+                      seed=0).build()
+    for _ in range(4):                       # 800 meta-updates
+        r = algo.training_step()
+    assert np.isfinite(r["meta_loss"])
+    ev = algo.evaluate_adaptation(n_tasks=50)
+    assert ev["post_adapt_loss"] < 2.0, ev
+    assert ev["post_adapt_loss"] < 0.55 * ev["pre_adapt_loss"], ev
+
+
+def test_maml_first_order_variant():
+    algo = MAMLConfig(first_order=True, inner_steps=2,
+                      meta_batch_size=10, meta_iters_per_step=30,
+                      seed=1).build()
+    r = algo.training_step()
+    assert np.isfinite(r["meta_loss"])
+
+
+def test_maml_checkpoint_roundtrip():
+    algo = MAMLConfig(meta_iters_per_step=5, meta_batch_size=5,
+                      seed=2).build()
+    algo.training_step()
+    ck = algo.save_checkpoint()
+    algo2 = MAMLConfig(meta_iters_per_step=5, meta_batch_size=5,
+                       seed=3).build()
+    algo2.load_checkpoint(ck)
+    for a, b in zip(algo.params, algo2.params):
+        np.testing.assert_array_equal(np.asarray(a["w"]),
+                                      np.asarray(b["w"]))
+
+
+def test_dreamer_learns_latent_env():
+    """World model + imagination policy on the latent-dynamics toy env:
+    the trained (noise-free) policy must beat the random-action baseline
+    by a wide margin (random injects disturbances; the latent controller
+    recenters the hidden state)."""
+    algo = DreamerConfig(seed=0, prefill_episodes=6,
+                         episodes_per_step=2, train_iters_per_step=15,
+                         batch_size=8, seq_len=12, actor_lr=3e-4,
+                         model_warmup_updates=45).build()
+    # baseline: the prefill episodes were random-action
+    random_ret = float(np.mean(algo._ep_returns))
+    results = [algo.training_step() for _ in range(14)]
+    eval_ret = algo.evaluate_episodes(4)
+    assert eval_ret > random_ret + 10.0, (random_ret, eval_ret)
+    # the world model itself must reconstruct observations well
+    assert results[-1]["obs_loss"] < 0.3, results[-1]
+
+
+def test_dreamer_checkpoint_roundtrip():
+    algo = DreamerConfig(seed=1, prefill_episodes=2, episodes_per_step=1,
+                         train_iters_per_step=2, batch_size=4,
+                         seq_len=8).build()
+    algo.training_step()
+    ck = algo.save_checkpoint()
+    algo2 = DreamerConfig(seed=2, prefill_episodes=2, episodes_per_step=1,
+                          train_iters_per_step=2, batch_size=4,
+                          seq_len=8).build()
+    algo2.load_checkpoint(ck)
+    a = np.asarray(algo.state[0]["gru"]["wi"]["w"])
+    b = np.asarray(algo2.state[0]["gru"]["wi"]["w"])
+    np.testing.assert_array_equal(a, b)
