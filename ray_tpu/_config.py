@@ -50,6 +50,11 @@ _define("object_spilling_dir", str, "",
         "Directory for spilled objects; empty = <session dir>/spill.")
 _define("object_store_full_delay_ms", int, 10,
         "Backoff when the object store is full and eviction is in progress.")
+_define("rpc", str, "socket",
+        "Control-plane transport: 'socket' (framed TCP, default) or "
+        "'grpc' — hosts every service's frame stream over a gRPC bidi "
+        "method (core/grpc_transport.py; reference: "
+        "src/ray/rpc/grpc_server.h).  Read from RAY_TPU_RPC.")
 _define("device_object_budget_mb", int, 0,
         "Per-process HBM budget for device-resident object entries "
         "(core/device_objects.py); oldest entries spill to the host store "

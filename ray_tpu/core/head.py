@@ -223,10 +223,23 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                 for h, n in self.nodes.items() if n.alive}
 
     def _choose_node(self, demand: dict,
-                     prefer: Optional[str] = None) -> Optional[str]:
+                     prefer: Optional[str] = None,
+                     spread_by_actor_count: bool = False) -> Optional[str]:
         """Pick a node whose TOTAL covers the demand; rank: available
         covers now > preferred > most spare capacity (a compact version of
-        the reference hybrid policy, hybrid_scheduling_policy.h)."""
+        the reference hybrid policy, hybrid_scheduling_policy.h).
+
+        ``spread_by_actor_count`` ranks fewest-hosted-actors above the
+        preference tiebreak — the actor placement policy (reference: the
+        GCS actor scheduler spreads).  Zero-resource actors make the
+        plain ranking useless: every node 'fits', so the preferred node
+        would win every tie and pile actors onto one worker pool until
+        it hits max_workers and creation wedges silently."""
+        counts: dict[str, int] = {}
+        if spread_by_actor_count:
+            for ad in self.actors.values():
+                if ad.state != "dead":
+                    counts[ad.node_hex] = counts.get(ad.node_hex, 0) + 1
         best, best_key = None, None
         for h, n in self.nodes.items():
             if not n.alive:
@@ -237,10 +250,15 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             fits_now = all(n.available.get(k, 0.0) + 1e-9 >= v
                            for k, v in demand.items())
             spare = sum(n.available.get(k, 0.0) for k in ("CPU", "TPU"))
-            key = (fits_now, h == prefer, spare)
+            key = (fits_now, -counts.get(h, 0), h == prefer, spare)
             if best_key is None or key > best_key:
                 best, best_key = h, key
         return best
+
+    def _choose_actor_node(self, demand: dict,
+                           prefer: Optional[str] = None) -> Optional[str]:
+        return self._choose_node(demand, prefer=prefer,
+                                 spread_by_actor_count=True)
 
     @staticmethod
     def _demand(spec: dict) -> dict:
@@ -383,7 +401,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         self._broadcast_view()
 
     def _replace_actor(self, ad: ActorDir, cause: str) -> None:
-        target = self._choose_node(self._demand(ad.spec))
+        target = self._choose_actor_node(self._demand(ad.spec))
         if target is None:
             self._actor_dead(ad, f"node died ({cause}); no feasible "
                                  "node to restart on")
@@ -482,7 +500,8 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                                   f"namespace '{ns}'")
                 return
             self.named_actors[key] = aid
-        target = self._choose_node(self._demand(spec), prefer=rec.node_hex)
+        target = self._choose_actor_node(self._demand(spec),
+                                         prefer=rec.node_hex)
         if target is None:
             if name:
                 self.named_actors.pop((ns, name), None)

@@ -186,6 +186,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # dispatch queues): tasks wait on deps, then join a runnable FIFO
         # per executor class.
         self.runnable_cpu: deque[dict] = deque()
+        self.runnable_zero: deque[dict] = deque()   # zero-demand specs
         self.runnable_tpu: deque[dict] = deque()
         # incremental aggregates over the runnable queues: admission and
         # spawn decisions run PER EVENT, so recomputing by iterating a
@@ -971,7 +972,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         on this node — storage for these must survive the owner's
         release until the work completes."""
         s: set = set()
-        for q in (self.runnable_cpu, self.runnable_tpu):
+        for q in (self.runnable_cpu, self.runnable_tpu,
+                  self.runnable_zero):
             for spec in q:
                 s.update(spec.get("arg_ids", ()))
         for specs in self.dep_waiting.values():
@@ -1262,6 +1264,12 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _make_runnable(self, spec: dict) -> None:
         if spec.get("num_tpus"):
             self.runnable_tpu.append(spec)
+        elif self._is_zero_demand(spec):
+            # zero-demand tasks (PlacementGroup.ready() pollers) get
+            # their own queue: they can always run, so they must not sit
+            # behind a resource-blocked FIFO head — and keeping them out
+            # of runnable_cpu keeps _schedule O(1), no per-event scans
+            self.runnable_zero.append(spec)
         else:
             self.runnable_cpu.append(spec)
         if spec.get("placement_group"):
@@ -1277,7 +1285,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         else:
             for k, v in self._demand(spec).items():
                 self._queued_demand[k] = self._queued_demand.get(k, 0.0) - v
-        if not self.runnable_cpu and not self.runnable_tpu:
+        if (not self.runnable_cpu and not self.runnable_tpu
+                and not self.runnable_zero):
             # drain point: clear float drift
             self._queued_demand.clear()
             self._queued_pg = 0
@@ -1384,7 +1393,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         LocalTaskManager::DispatchScheduledTasksToWorkers,
         local_task_manager.cc:101).  O(1) amortized per event: stops at the
         first queue head that cannot be placed."""
-        for q, tpu in ((self.runnable_cpu, False), (self.runnable_tpu, True)):
+        for q, tpu in ((self.runnable_cpu, False), (self.runnable_tpu, True),
+                       (self.runnable_zero, False)):
             while q:
                 spec = q[0]
                 w = self._find_idle_worker(tpu=tpu,
@@ -1397,29 +1407,14 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     break
                 self._queue_pop(q)
                 self._dispatch_task(w, spec)
-            if not tpu and q:
-                self._dispatch_zero_demand(q)
 
     def _is_zero_demand(self, spec: dict) -> bool:
         """True for specs that take nothing from the pool (e.g.
-        PlacementGroup.ready() pollers) — they always deserve a worker."""
+        PlacementGroup.ready() pollers) — they always deserve a worker
+        and ride their own queue, immune to CPU-FIFO head blocking."""
         return (not spec.get("placement_group")
+                and not spec.get("num_tpus")
                 and all(v <= 0 for v in self._demand(spec).values()))
-
-    def _dispatch_zero_demand(self, q: deque) -> None:
-        """Zero-demand tasks take nothing from the pool, so FIFO
-        head-of-line blocking must not starve them: dispatch any such
-        spec stuck behind a blocked head."""
-        for spec in [s for s in q if self._is_zero_demand(s)]:
-            w = self._find_idle_worker(tpu=False,
-                                       env_hash=spec.get("env_hash"))
-            if w is None:
-                self._maybe_spawn_worker()
-                return
-            q.remove(spec)
-            for k, v in self._demand(spec).items():
-                self._queued_demand[k] = self._queued_demand.get(k, 0.0) - v
-            self._dispatch_task(w, spec)
 
     def _find_idle_worker(self, tpu: bool,
                           env_hash: Optional[str] = None
@@ -1487,9 +1482,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # capped (reference: worker_pool.h maximum_startup_concurrency
         # :192,717).
         n_pg = min(self._queued_pg, len(self.runnable_cpu))
-        n_zero = sum(1 for s in self.runnable_cpu
-                     if self._is_zero_demand(s))
-        cpu_demand = min(len(self.runnable_cpu) - n_pg - n_zero,
+        n_zero = len(self.runnable_zero)
+        cpu_demand = min(len(self.runnable_cpu) - n_pg,
                          max(0, int(self.available.get("CPU", 0.0))))
         demand = cpu_demand + n_pg + n_zero + n_actors_waiting
         max_concurrent_startup = max(2, os.cpu_count() or 1)

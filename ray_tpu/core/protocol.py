@@ -129,6 +129,13 @@ def connect(address: str, timeout: float = 30.0,
         sock.settimeout(timeout)
         sock.connect(address[len("unix://"):])
     else:
+        from ray_tpu.core import grpc_transport
+        if grpc_transport.transport() == "grpc":
+            # RAY_TPU_RPC=grpc: the frame stream rides a gRPC bidi
+            # method (reference: src/ray/rpc/grpc_server.h hosting)
+            sock = grpc_transport.grpc_connect_socket(address,
+                                                      timeout=timeout)
+            return Connection(sock, encoding=default_encoding(remote))
         host, port = address.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=timeout)
     sock.settimeout(None)

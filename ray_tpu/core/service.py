@@ -61,14 +61,25 @@ class EventLoopService:
     name = "service"
 
     def __init__(self, listen_host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.core import grpc_transport
+        self._grpc_server = None
+        grpc_mode = grpc_transport.transport() == "grpc"
         self.sel = selectors.DefaultSelector()
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.listener.bind((listen_host, port))
+        # grpc mode: the selector keeps its loop on a private loopback
+        # port and the PUBLIC address is the gRPC front that bridges
+        # streams onto it (core/grpc_transport.py)
+        self.listener.bind(("127.0.0.1", 0) if grpc_mode
+                           else (listen_host, port))
         self.listener.listen(512)
         self.listener.setblocking(False)
         self.address = "%s:%d" % self.listener.getsockname()
         self.sel.register(self.listener, selectors.EVENT_READ, None)
+        if grpc_mode:
+            self._grpc_server, self.address = \
+                grpc_transport.start_grpc_front(
+                    self.address, host=listen_host, port=port)
 
         self._next_conn = 0
         self.clients: dict[int, ClientRec] = {}
@@ -169,6 +180,11 @@ class EventLoopService:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._grpc_server is not None:
+            try:
+                self._grpc_server.stop(0)
+            except Exception:
+                pass
         if (self._thread is not None
                 and self._thread is not threading.current_thread()):
             self._thread.join(timeout=5)

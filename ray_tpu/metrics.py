@@ -87,7 +87,8 @@ def _snapshot_once(svc) -> list[tuple]:
         ("ray_tpu_workers", "gauge", "Connected worker processes",
          float(workers)),
         ("ray_tpu_runnable_tasks", "gauge", "Queued runnable tasks",
-         float(len(svc.runnable_cpu) + len(svc.runnable_tpu))),
+         float(len(svc.runnable_cpu) + len(svc.runnable_tpu)
+               + len(svc.runnable_zero))),
     ]
 
 

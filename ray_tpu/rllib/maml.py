@@ -174,8 +174,12 @@ class MAML(Algorithm):
 
     def save_checkpoint(self) -> dict:
         return {"params": jax.tree.map(np.asarray, self.params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
                 "timesteps": self._timesteps}
 
     def load_checkpoint(self, ck):
         self.params = jax.tree.map(jnp.asarray, ck["params"])
+        if "opt_state" in ck:
+            # without the Adam moments a resumed run spikes on step one
+            self.opt_state = jax.tree.map(jnp.asarray, ck["opt_state"])
         self._timesteps = ck.get("timesteps", 0)
