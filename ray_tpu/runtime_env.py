@@ -35,13 +35,19 @@ _EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules",
 
 
 def validate(runtime_env: dict) -> dict:
-    known = {"env_vars", "working_dir", "py_modules", "pip"}
+    known = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
     unknown = set(runtime_env) - known
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; supported: "
-            f"{sorted(known)} (conda/container are out of scope: the "
-            "cluster image is the base environment)")
+            f"{sorted(known)} (container is out of scope: the cluster "
+            "image is the base environment)")
+    conda = runtime_env.get("conda")
+    if conda is not None and not isinstance(conda, (str, dict)):
+        raise ValueError(
+            "conda must be an env name, a path to an environment.yml, "
+            "or an environment dict (reference: "
+            "_private/runtime_env/conda.py shapes)")
     ev = runtime_env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in ev.items()):
@@ -113,6 +119,17 @@ def prepare(runtime_env: dict, client) -> dict:
             _upload_wheel(client, p)
             if p.endswith(".whl") and os.path.isfile(p) else p
             for p in pip]
+    conda = env.get("conda")
+    if isinstance(conda, str) and conda.endswith((".yml", ".yaml")):
+        if not os.path.isfile(conda):
+            # fail at SUBMISSION with the real problem, not worker-side
+            # with a FileNotFoundError naming the submitter's path
+            raise ValueError(
+                f"runtime_env conda spec file not found: {conda!r}")
+        # inline the spec text so remote nodes never need the
+        # submitter's filesystem
+        with open(conda) as f:
+            env["conda"] = {"__environment_yaml__": f.read()}
     return env
 
 
@@ -155,24 +172,16 @@ def _extract_wheel(whl_path: str, cache_root: str) -> str:
     return path
 
 
-def ensure_pip_env(client, pip: list, cache_root: Optional[str] = None,
-                   ) -> str:
-    """Install a pip requirement list into a per-hash target directory,
-    once per cluster host (reference: pip.py PipProcessor; --target
-    keeps the base environment untouched).  Local-wheel refs install
-    with --no-index, so the path is offline-capable."""
-    cache_root = cache_root or os.path.join("/tmp/ray_tpu",
-                                            "runtime_env_cache")
-    h = hashlib.sha256(json.dumps(sorted(pip)).encode()).hexdigest()[:16]
-    target = os.path.join(cache_root, "pip", h)
+def _install_once(target: str, install, what: str) -> str:
+    """Create ``target`` once per host: the first process runs
+    ``install()`` then drops a .ready marker; racers wait on it.  The
+    lock records the installer's pid so a SIGKILLed installer (e.g. the
+    OOM monitor) can't deadlock the env forever — waiters steal a lock
+    whose owner is dead."""
     marker = os.path.join(target, ".ready")
     if os.path.exists(marker):
         return target
     os.makedirs(target, exist_ok=True)
-    # cross-process guard: first creator installs, racers wait on the
-    # marker.  The lock records the installer's pid so a SIGKILLed
-    # installer (e.g. the OOM monitor) can't deadlock the env forever —
-    # waiters steal a lock whose owner is dead.
     lock = os.path.join(target, ".lock")
 
     def acquire() -> bool:
@@ -212,30 +221,153 @@ def ensure_pip_env(client, pip: list, cache_root: Optional[str] = None,
                     break
             if time.time() > deadline:
                 raise RuntimeError("timed out waiting for a concurrent "
-                                   f"pip install of {pip}")
+                                   f"install of {what}")
             time.sleep(0.2)
     try:
-        wheels = [_materialize_wheel(client, p, cache_root)
-                  for p in pip if p.startswith("whl:")]
-        named = [p for p in pip if not p.startswith("whl:")]
-        base = [sys.executable, "-m", "pip", "install", "--quiet",
-                "--no-warn-script-location", "--target", target]
-        if wheels:
-            subprocess.run(base + ["--no-index", "--no-deps"] + wheels,
-                           check=True, capture_output=True, text=True)
-        if named:
-            subprocess.run(base + named, check=True,
-                           capture_output=True, text=True)
+        install()
         open(marker, "w").close()
-    except subprocess.CalledProcessError as e:
-        raise RuntimeError(
-            f"pip install failed for {pip}: {e.stderr}") from e
     finally:
         try:
             os.remove(lock)
         except OSError:
             pass
     return target
+
+
+def ensure_pip_env(client, pip: list, cache_root: Optional[str] = None,
+                   ) -> str:
+    """Install a pip requirement list into a per-hash target directory,
+    once per cluster host (reference: pip.py PipProcessor; --target
+    keeps the base environment untouched).  Local-wheel refs install
+    with --no-index, so the path is offline-capable."""
+    cache_root = cache_root or os.path.join("/tmp/ray_tpu",
+                                            "runtime_env_cache")
+    h = hashlib.sha256(json.dumps(sorted(pip)).encode()).hexdigest()[:16]
+    target = os.path.join(cache_root, "pip", h)
+
+    def install():
+        wheels = [_materialize_wheel(client, p, cache_root)
+                  for p in pip if p.startswith("whl:")]
+        named = [p for p in pip if not p.startswith("whl:")]
+        base = [sys.executable, "-m", "pip", "install", "--quiet",
+                "--no-warn-script-location", "--target", target]
+        try:
+            if wheels:
+                subprocess.run(base + ["--no-index", "--no-deps"] + wheels,
+                               check=True, capture_output=True, text=True)
+            if named:
+                subprocess.run(base + named, check=True,
+                               capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"pip install failed for {pip}: {e.stderr}") from e
+
+    return _install_once(target, install, f"pip {pip}")
+
+
+# -- conda environments ------------------------------------------------------
+# (reference: _private/runtime_env/conda.py — named envs activate an
+# existing environment; dict/yaml specs create one per env hash)
+
+# per-process cache of named-env prefix resolutions
+_named_env_prefixes: dict[str, str] = {}
+
+
+def _conda_exe() -> str:
+    import shutil
+    exe = shutil.which("conda")
+    if exe is None:
+        raise RuntimeError(
+            "runtime_env 'conda' requires the conda CLI on every node; "
+            "it is not on PATH")
+    return exe
+
+
+def conda_site_packages(prefix: str) -> Optional[str]:
+    import glob
+    cands = sorted(glob.glob(os.path.join(prefix, "lib", "python*",
+                                          "site-packages")))
+    return cands[0] if cands else None
+
+
+def _emit_environment_yaml(spec: dict) -> str:
+    """Minimal YAML emitter for the environment.yml shapes conda
+    accepts (name/channels/dependencies with one level of pip nesting)
+    — avoids a hard pyyaml dependency."""
+    lines = []
+    if spec.get("name"):
+        lines.append(f"name: {spec['name']}")
+    for key in ("channels", "dependencies"):
+        vals = spec.get(key)
+        if not vals:
+            continue
+        lines.append(f"{key}:")
+        for v in vals:
+            if isinstance(v, dict):   # {"pip": [...]} nested block
+                for k2, sub in v.items():
+                    lines.append(f"  - {k2}:")
+                    for s in sub:
+                        lines.append(f"    - {s}")
+            else:
+                lines.append(f"  - {v}")
+    return "\n".join(lines) + "\n"
+
+
+def ensure_conda_env(client, conda, cache_root: Optional[str] = None,
+                     ) -> str:
+    """Resolve/create the conda env; returns its PREFIX path.
+
+    str (not *.yml) — a named env that must already exist on the node;
+    str *.yml / *.yaml — a spec file (prepare() inlines its text so
+    remote nodes don't need the submitter's filesystem);
+    dict — an environment spec, created once per hash per host."""
+    cache_root = cache_root or os.path.join("/tmp/ray_tpu",
+                                            "runtime_env_cache")
+    exe = _conda_exe()
+    if isinstance(conda, str) and not conda.endswith((".yml", ".yaml")):
+        # applied_env runs per task: cache the resolved prefix so the
+        # hot path doesn't shell out `conda env list` every execution
+        cached = _named_env_prefixes.get(conda)
+        if cached is not None:
+            return cached
+        out = subprocess.run([exe, "env", "list", "--json"], check=True,
+                             capture_output=True, text=True)
+        for p in json.loads(out.stdout or "{}").get("envs", []):
+            if os.path.basename(p) == conda:
+                _named_env_prefixes[conda] = p
+                return p
+        raise RuntimeError(f"conda env {conda!r} not found on this node")
+
+    if isinstance(conda, str):
+        with open(conda) as f:
+            spec_text = f.read()
+    elif "__environment_yaml__" in conda:
+        spec_text = conda["__environment_yaml__"]
+    else:
+        spec_text = _emit_environment_yaml(conda)
+
+    h = hashlib.sha256(spec_text.encode()).hexdigest()[:16]
+    target = os.path.join(cache_root, "conda", h)
+    prefix = os.path.join(target, "env")
+
+    def install():
+        spec_file = os.path.join(target, "environment.yml")
+        with open(spec_file, "w") as f:
+            f.write(spec_text)
+        # a previous attempt may have died mid-create; conda refuses to
+        # create into a non-empty prefix, so clear the debris first
+        import shutil
+        shutil.rmtree(prefix, ignore_errors=True)
+        try:
+            subprocess.run([exe, "env", "create", "-q", "-p", prefix,
+                            "-f", spec_file],
+                           check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"conda env create failed: {e.stderr}") from e
+
+    _install_once(target, install, "conda env")
+    return prefix
 
 
 def package_directory(path: str) -> bytes:
@@ -344,6 +476,18 @@ class applied_env:
             self._saved_env[k] = os.environ.get(k)
             os.environ[k] = v
         cache_root = os.path.join("/tmp/ray_tpu", "runtime_env_cache")
+        conda = self.env.get("conda")
+        if conda:
+            prefix = ensure_conda_env(self.client, conda)
+            sp = conda_site_packages(prefix)
+            if sp:
+                sys.path.insert(0, sp)
+                self.paths.append(sp)
+            for k, v in (("CONDA_PREFIX", prefix),
+                         ("PATH", os.path.join(prefix, "bin")
+                          + os.pathsep + os.environ.get("PATH", ""))):
+                self._saved_env.setdefault(k, os.environ.get(k))
+                os.environ[k] = v
         pip = self.env.get("pip")
         if pip:
             target = ensure_pip_env(self.client, list(pip))

@@ -1,0 +1,119 @@
+"""Conda runtime environments (stubbed CLI — the image has no conda).
+
+Reference: python/ray/_private/runtime_env/conda.py — named envs
+activate an existing environment, dict/yaml specs create one per env
+hash.  The stub conda records invocations and fabricates the env
+layout (lib/pythonX/site-packages), so resolution, creation-once
+locking, sys.path application, and module eviction are all exercised
+for real.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu import runtime_env as re_mod
+
+
+@pytest.fixture
+def fake_conda(tmp_path, monkeypatch):
+    """A conda stub: `env list --json` reports one named env; `env
+    create -p <prefix> -f <file>` materializes a site-packages with a
+    marker module and logs the call."""
+    named_prefix = tmp_path / "conda_envs" / "mldev"
+    sp = named_prefix / "lib" / "python3.12" / "site-packages"
+    sp.mkdir(parents=True)
+    (sp / "named_env_marker.py").write_text("WHERE = 'named'\n")
+
+    log = tmp_path / "calls.log"
+    stub = tmp_path / "bin" / "conda"
+    stub.parent.mkdir(parents=True)
+    stub.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        echo "$@" >> {log}
+        if [ "$1" = "env" ] && [ "$2" = "list" ]; then
+            echo '{{"envs": ["{named_prefix}"]}}'
+            exit 0
+        fi
+        if [ "$1" = "env" ] && [ "$2" = "create" ]; then
+            # args: env create -q -p <prefix> -f <file>
+            prefix="$5"
+            mkdir -p "$prefix/lib/python3.12/site-packages"
+            echo "WHERE = 'created'" \\
+                > "$prefix/lib/python3.12/site-packages/spec_env_marker.py"
+            exit 0
+        fi
+        exit 1
+    """))
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{stub.parent}:{os.environ['PATH']}")
+    return {"log": log, "named_prefix": str(named_prefix),
+            "cache": str(tmp_path / "cache")}
+
+
+def test_named_env_resolves_prefix(fake_conda):
+    prefix = re_mod.ensure_conda_env(None, "mldev",
+                                     cache_root=fake_conda["cache"])
+    assert prefix == fake_conda["named_prefix"]
+
+
+def test_named_env_missing_raises(fake_conda):
+    with pytest.raises(RuntimeError, match="not found"):
+        re_mod.ensure_conda_env(None, "nope",
+                                cache_root=fake_conda["cache"])
+
+
+def test_spec_creates_once_and_caches(fake_conda):
+    spec = {"name": "t", "channels": ["conda-forge"],
+            "dependencies": ["python=3.12", {"pip": ["einops"]}]}
+    p1 = re_mod.ensure_conda_env(None, spec,
+                                 cache_root=fake_conda["cache"])
+    p2 = re_mod.ensure_conda_env(None, spec,
+                                 cache_root=fake_conda["cache"])
+    assert p1 == p2
+    creates = [ln for ln in fake_conda["log"].read_text().splitlines()
+               if ln.startswith("env create")]
+    assert len(creates) == 1, creates
+    # the emitted environment.yml is faithful
+    yml = open(os.path.join(os.path.dirname(p1),
+                            "environment.yml")).read()
+    assert "conda-forge" in yml and "python=3.12" in yml \
+        and "einops" in yml
+
+
+def test_applied_env_activates_and_evicts(fake_conda, monkeypatch):
+    monkeypatch.setattr(
+        re_mod, "ensure_conda_env",
+        lambda client, conda, cache_root=None: fake_conda["named_prefix"])
+    env = {"conda": "mldev"}
+    with re_mod.applied_env(env):
+        import named_env_marker
+        assert named_env_marker.WHERE == "named"
+        assert os.environ["CONDA_PREFIX"] == fake_conda["named_prefix"]
+        assert os.environ["PATH"].startswith(
+            os.path.join(fake_conda["named_prefix"], "bin"))
+    assert "named_env_marker" not in sys.modules
+    assert os.environ.get("CONDA_PREFIX") != fake_conda["named_prefix"]
+
+
+def test_prepare_inlines_yaml_file(fake_conda, tmp_path):
+    yml = tmp_path / "environment.yml"
+    yml.write_text("name: inline-me\ndependencies:\n  - python\n")
+    env = re_mod.prepare({"conda": str(yml)}, client=None)
+    assert env["conda"] == {
+        "__environment_yaml__": yml.read_text()}
+    # and the inlined form round-trips through creation
+    prefix = re_mod.ensure_conda_env(None, env["conda"],
+                                     cache_root=fake_conda["cache"])
+    assert os.path.isdir(prefix)
+
+
+def test_validate_rejects_bad_conda():
+    with pytest.raises(ValueError, match="conda must be"):
+        re_mod.validate({"conda": 42})

@@ -52,8 +52,11 @@ def test_validate_and_hash():
     env = validate({"pip": {"packages": ["a", "b"]}})
     assert env["pip"] == ["a", "b"]
     assert validate({"pip": "solo"})["pip"] == ["solo"]
+    # conda is supported since round 4; container stays out of scope
     with pytest.raises(ValueError):
-        validate({"conda": {}})
+        validate({"container": {"image": "x"}})
+    with pytest.raises(ValueError):
+        validate({"conda": 42})
     h1 = env_hash({"pip": ["a"], "env_vars": {"X": "1"}})
     h2 = env_hash({"env_vars": {"X": "1"}, "pip": ["a"]})
     assert h1 == h2 and h1 != env_hash({"pip": ["b"]})
