@@ -4,9 +4,9 @@ The full-size counterpart of tests/test_scale.py, mirroring the
 reference's release scheduling benchmarks
 (release/benchmarks/README.md:5-31: many nodes, many actors, 1M queued
 tasks) at the scale one 1-core box can honestly host.  Writes a JSON
-evidence file (SCALE_r03.json at the repo root by default).
+evidence file (SCALE_r04.json at the repo root by default).
 
-Run:  python benchmarks/scale_envelope.py --out SCALE_r03.json
+Run:  python benchmarks/scale_envelope.py --out SCALE_r04.json
 """
 
 from __future__ import annotations
@@ -125,10 +125,10 @@ def main() -> int:
     ap.add_argument("--actors", type=int, default=250)
     ap.add_argument("--actor-wave", type=int, default=25)
     ap.add_argument("--broadcast-mb", type=int, default=1024)
-    ap.add_argument("--out", default="SCALE_r03.json")
+    ap.add_argument("--out", default="SCALE_r04.json")
     args = ap.parse_args()
 
-    result = {"round": 3, "env": {
+    result = {"round": 4, "env": {
         "physical_cores": os.cpu_count(),
         "note": "virtual multi-node cluster on one machine "
                 "(cluster_utils), every node a full NodeService with "

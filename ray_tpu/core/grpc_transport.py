@@ -114,12 +114,8 @@ def grpc_connect_socket(address: str, timeout: float = 30.0):
     # a raw TCP probe keeps down-endpoint detection at socket-mode
     # latency (milliseconds, not the full reconnect timeout)
     host, port = address.rsplit(":", 1)
-    probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    try:
-        probe.settimeout(min(timeout, 5.0))
-        probe.connect((host, int(port)))
-    finally:
-        probe.close()
+    socket.create_connection((host, int(port)),
+                             timeout=min(timeout, 5.0)).close()
 
     channel = grpc.insecure_channel(address, options=[
         ("grpc.max_send_message_length", -1),

@@ -105,13 +105,16 @@ class _JobSupervisor:
     def run(self) -> str:
         """Blocks until the entrypoint exits; returns terminal status."""
         from ray_tpu.runtime_env import applied_env
-        env = dict(os.environ)
-        env.update(self.runtime_env.get("env_vars") or {})
-        # the job's own driver connects to the SAME cluster
-        env["RAY_TPU_ADDRESS"] = self.node_address
         cwd = None
         with applied_env({k: v for k, v in self.runtime_env.items()
                           if k != "env_vars"}, self._client()) as ae:
+            # snapshot INSIDE applied_env: conda prepends PATH and sets
+            # CONDA_PREFIX on os.environ — the entrypoint subprocess
+            # must see the activated environment too
+            env = dict(os.environ)
+            env.update(self.runtime_env.get("env_vars") or {})
+            # the job's own driver connects to the SAME cluster
+            env["RAY_TPU_ADDRESS"] = self.node_address
             if self.runtime_env.get("working_dir"):
                 cwd = os.getcwd()   # applied_env chdir'd into the pkg
             if ae.paths:

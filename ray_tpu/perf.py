@@ -43,13 +43,30 @@ def timeit(name: str, fn, multiplier: int = 1, unit: str = "ops/s",
                 break
         rates.append(count * multiplier / dt)
     rates.sort()
-    med = rates[len(rates) // 2]
+    med = rates[(len(rates) - 1) // 2]   # lower-median: never best-of-N
     spread = (rates[-1] - rates[0]) / med if med else 0.0
     out = {"name": name, "value": round(med, 2), "unit": unit,
            "spread": round(spread, 3)}
     print(json.dumps(out), flush=True)
-    gc.collect()
+    _settle()
     return out
+
+
+def _settle() -> None:
+    """Isolate benchmarks from each other: collect dropped refs NOW and
+    give the node a moment to process the batched release storm, so the
+    next benchmark measures its own operation rather than the previous
+    one's cleanup."""
+    gc.collect()
+    try:
+        import ray_tpu
+        from ray_tpu.core.object_ref import get_tracker
+        get_tracker().flush()
+        rt = ray_tpu.get_runtime()
+        time.sleep(0.3)
+        rt.client.request({"t": "ping"}, timeout=30)
+    except Exception:
+        time.sleep(0.3)
 
 
 def main(quick: bool = False) -> list[dict]:

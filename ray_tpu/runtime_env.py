@@ -290,6 +290,25 @@ def conda_site_packages(prefix: str) -> Optional[str]:
     return cands[0] if cands else None
 
 
+def _check_conda_python_compat(prefix: str) -> None:
+    """This runtime activates conda envs by site-packages injection into
+    the RUNNING worker interpreter (the reference re-execs the env's own
+    python) — so an env pinning a different python would import
+    wrong-ABI extensions.  Fail with the real story instead."""
+    import re
+    sp = conda_site_packages(prefix)
+    if not sp:
+        return
+    m = re.search(r"python(\d+)\.(\d+)", sp)
+    if m and (int(m.group(1)), int(m.group(2))) != sys.version_info[:2]:
+        raise RuntimeError(
+            f"conda env at {prefix} provides python "
+            f"{m.group(1)}.{m.group(2)} but this cluster's workers run "
+            f"{sys.version_info[0]}.{sys.version_info[1]}; pin the same "
+            "python in the env spec (activation injects site-packages "
+            "into the running interpreter)")
+
+
 def _emit_environment_yaml(spec: dict) -> str:
     """Minimal YAML emitter for the environment.yml shapes conda
     accepts (name/channels/dependencies with one level of pip nesting)
@@ -332,7 +351,16 @@ def ensure_conda_env(client, conda, cache_root: Optional[str] = None,
             return cached
         out = subprocess.run([exe, "env", "list", "--json"], check=True,
                              capture_output=True, text=True)
-        for p in json.loads(out.stdout or "{}").get("envs", []):
+        envs = json.loads(out.stdout or "{}").get("envs", [])
+        if conda == "base":
+            # the base env IS the install prefix (its basename is the
+            # distribution dir, not "base"): it's the entry not nested
+            # under any <root>/envs/
+            roots = [p for p in envs if f"{os.sep}envs{os.sep}" not in p]
+            if roots:
+                _named_env_prefixes[conda] = roots[0]
+                return roots[0]
+        for p in envs:
             if os.path.basename(p) == conda:
                 _named_env_prefixes[conda] = p
                 return p
@@ -479,6 +507,7 @@ class applied_env:
         conda = self.env.get("conda")
         if conda:
             prefix = ensure_conda_env(self.client, conda)
+            _check_conda_python_compat(prefix)
             sp = conda_site_packages(prefix)
             if sp:
                 sys.path.insert(0, sp)

@@ -117,3 +117,35 @@ def test_prepare_inlines_yaml_file(fake_conda, tmp_path):
 def test_validate_rejects_bad_conda():
     with pytest.raises(ValueError, match="conda must be"):
         re_mod.validate({"conda": 42})
+
+
+def test_python_version_mismatch_raises(fake_conda, tmp_path, monkeypatch):
+    """The injection activation model requires the env's python to match
+    the worker interpreter — mismatches fail with the real story, not a
+    downstream ABI ImportError."""
+    bad = tmp_path / "badenv"
+    (bad / "lib" / "python3.7" / "site-packages").mkdir(parents=True)
+    monkeypatch.setattr(re_mod, "ensure_conda_env",
+                        lambda client, conda, cache_root=None: str(bad))
+    with pytest.raises(RuntimeError, match="workers run"):
+        with re_mod.applied_env({"conda": "whatever"}):
+            pass
+
+
+def test_base_env_resolves_root_prefix(fake_conda, tmp_path, monkeypatch):
+    """conda's base env is the install prefix itself (basename is the
+    distribution dir, not 'base')."""
+    import subprocess as sp
+
+    root = str(tmp_path / "miniconda3")
+    named = str(tmp_path / "miniconda3" / "envs" / "other")
+
+    class FakeOut:
+        stdout = json.dumps({"envs": [root, named]})
+
+    monkeypatch.setattr(re_mod, "_conda_exe", lambda: "/fake/conda")
+    monkeypatch.setattr(sp, "run", lambda *a, **k: FakeOut())
+    re_mod._named_env_prefixes.clear()
+    assert re_mod.ensure_conda_env(None, "base") == root
+    assert re_mod.ensure_conda_env(None, "other") == named
+    re_mod._named_env_prefixes.clear()
