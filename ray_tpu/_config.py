@@ -89,9 +89,13 @@ _define("health_check_failure_threshold", int, 5,
 _define("heartbeat_period_ms", int, 250,
         "Node -> head resource heartbeat cadence (reference: "
         "ray_syncer.h:30 RAY_CONFIG raylet_report_resources_period_ms).")
-_define("node_death_timeout_ms", int, 3000,
+_define("node_death_timeout_ms", int, 10_000,
         "Missed-heartbeat window after which the head declares a node "
-        "dead (reference: gcs_health_check_manager.cc timeout).")
+        "dead (reference: gcs_health_check_manager.cc; its default "
+        "window is ~30s).  Killed/crashed nodes are detected instantly "
+        "via connection drop — this window only catches wedged-but-"
+        "connected nodes, so it must ride out worker-pool fork storms "
+        "that starve node loops on small hosts.")
 _define("object_transfer_chunk_size", int, 4 * 1024 * 1024,
         "Chunk size for node-to-node object transfer (reference: "
         "object_manager.h:117 chunked Push, default 5MiB chunks).")

@@ -40,20 +40,37 @@ class Cluster:
         self.head.start_thread()
         self.nodes: list[NodeService] = []
 
-    def restart_head(self) -> None:
+    def restart_head(self, simulate_machine_loss: bool = False) -> None:
         """Kill the head and bring a new one up on the SAME address with
         the persisted state; nodes rejoin automatically (head-FT test
-        shape — reference: GCS restart with Redis-backed storage)."""
+        shape — reference: GCS restart with Redis-backed storage).
+
+        ``simulate_machine_loss`` deletes the local snapshot first and
+        recovers from a surviving node's replica instead — the
+        lose-the-head-MACHINE story the reference needs Redis for."""
         assert self.persistence_path, "construct with head_persistence=True"
         port = int(self.head.address.rsplit(":", 1)[1])
         self.head.stop()
+        recover_from = None
+        if simulate_machine_loss:
+            try:
+                os.remove(self.persistence_path)
+            except OSError:
+                pass
+            alive = [n for n in self.nodes
+                     if n._thread is not None and n._thread.is_alive()]
+            assert alive, "machine-loss recovery needs a surviving node"
+            # every survivor is offered: recovery picks the freshest
+            # replica by seq (a fan-out may have missed some nodes)
+            recover_from = ",".join(n.address for n in alive)
         deadline = time.time() + 30
         last_err = None
         while time.time() < deadline:
             try:
                 self.head = HeadService(
                     self.config, self.session, port=port,
-                    persistence_path=self.persistence_path)
+                    persistence_path=self.persistence_path,
+                    recover_from=recover_from)
                 break
             except OSError as e:   # port still in TIME_WAIT
                 last_err = e

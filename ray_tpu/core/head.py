@@ -25,6 +25,7 @@ does the same for GCS-bound client calls).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -76,7 +77,8 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
     def __init__(self, config: RayTpuConfig, session: str,
                  listen_host: str = "127.0.0.1", port: int = 0,
-                 persistence_path: Optional[str] = None):
+                 persistence_path: Optional[str] = None,
+                 recover_from: Optional[str] = None):
         super().__init__(listen_host, port)
         self.config = config
         self.session = session
@@ -102,18 +104,28 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
         # durable control-plane state (reference: gcs_server.cc:58-61 —
         # the Redis/file-backed GCS table storage that lets the head
-        # restart without losing the cluster's KV/actor/PG directory)
+        # restart without losing the cluster's KV/actor/PG directory).
+        # Instead of an EXTERNAL store, snapshots also replicate to every
+        # node (the cluster IS the database): a replacement head on a
+        # fresh machine bootstraps from any surviving node's replica
+        # (`recover_from=`), which survives losing the head MACHINE, not
+        # just the head process.
         self.persistence_path = persistence_path
         self._dirty = False
         self._last_snapshot = 0.0
         self._snapshot_writing = False
+        self._replica_seq = 0
         # actors restored as pending get a rejoin grace window; if their
         # node never comes back they re-place or die (reference: GCS
         # reconciles actors after the reconnection grace period)
         self._restored_pending: set = set()
         self._restored_at = 0.0
-        if persistence_path:
+        if persistence_path and os.path.exists(persistence_path):
             self._restore_snapshot()
+        elif recover_from:
+            # fresh machine, no local snapshot: pull the newest replica
+            # a node holds (head-MACHINE loss recovery)
+            self._recover_from_node(recover_from)
 
     def _cleanup(self) -> None:
         # graceful stop must not lose acknowledged mutations
@@ -149,18 +161,34 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         }
 
     def _write_snapshot(self, state: dict) -> None:
-        import os
         import pickle
         tmp = self.persistence_path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
         os.replace(tmp, self.persistence_path)
 
+    def _encode_replica(self, state: dict) -> dict:
+        import pickle
+        self._replica_seq += 1
+        return {"t": "head_snapshot", "seq": self._replica_seq,
+                "session": self.session, "data": pickle.dumps(state)}
+
+    def _fan_out_replicas(self, msg: dict) -> None:
+        """Push the snapshot to every alive node — losing the head
+        MACHINE (disk included) then costs nothing: a replacement head
+        recovers from the freshest surviving replica (`recover_from=`)."""
+        for n in self.nodes.values():
+            if n.alive:
+                c = self.clients.get(n.conn_id)
+                if c is not None:
+                    self._push(c, msg)
+
     def _snapshot(self, sync: bool = False) -> None:
         state = self._build_snapshot_state()
         self._dirty = False
         if sync:
             self._write_snapshot(state)
+            self._fan_out_replicas(self._encode_replica(state))
             return
         if self._snapshot_writing:
             self._dirty = True   # retry next tick
@@ -170,6 +198,10 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         def work():
             try:
                 self._write_snapshot(state)
+                # the expensive state pickle happens HERE, off-thread —
+                # only the per-node sends return to the loop thread
+                msg = self._encode_replica(state)
+                self.post(lambda: self._fan_out_replicas(msg))
             except Exception:
                 import traceback
                 traceback.print_exc()
@@ -180,12 +212,57 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                          name="raytpu-head-snapshot").start()
 
     def _restore_snapshot(self) -> None:
-        import os
         import pickle
         if not os.path.exists(self.persistence_path):
             return
         with open(self.persistence_path, "rb") as f:
             state = pickle.load(f)
+        self._apply_snapshot_state(state)
+
+    def _recover_from_node(self, addresses: str) -> None:
+        """Bootstrap a replacement head from node snapshot replicas
+        (reference capability: gcs_server.cc Redis-backed storage — here
+        the cluster itself is the store; see __init__ comment).
+
+        ``addresses`` may be comma-separated: every reachable node is
+        asked and the HIGHEST-seq replica wins — a fan-out that missed
+        one node must not resurrect stale state.  Wrong-session replies
+        are rejected (two clusters on one host is the normal test
+        shape).  All failures surface as RuntimeError so callers can
+        distinguish them from listener-bind errors."""
+        import pickle
+        from ray_tpu.core import protocol
+        best = None   # (seq, data)
+        errors = []
+        for address in [a.strip() for a in addresses.split(",") if a]:
+            try:
+                conn = protocol.connect(address, timeout=15.0)
+                try:
+                    conn.send({"t": "fetch_head_snapshot", "reqid": 1})
+                    reply = conn.recv(timeout=15.0)
+                finally:
+                    conn.close()
+            except (OSError, protocol.ConnectionClosed) as e:
+                errors.append(f"{address}: {e}")
+                continue
+            if reply.get("session") not in (None, self.session):
+                errors.append(f"{address}: replica belongs to session "
+                              f"{reply.get('session')!r}")
+                continue
+            data = reply.get("data")
+            if not data:
+                errors.append(f"{address}: {reply.get('error')}")
+                continue
+            seq = reply.get("seq", 0)
+            if best is None or seq > best[0]:
+                best = (seq, data)
+        if best is None:
+            raise RuntimeError(
+                f"no node holds a usable head snapshot replica: {errors}")
+        self._apply_snapshot_state(pickle.loads(best[1]))
+        self.mark_dirty()   # persist locally as soon as possible
+
+    def _apply_snapshot_state(self, state: dict) -> None:
         self.kv = state["kv"]
         self.functions = state["functions"]
         self.named_actors = state["named_actors"]

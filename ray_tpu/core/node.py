@@ -393,6 +393,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         if reply.get("error"):
             raise RuntimeError(f"head registration failed: {reply['error']}")
         self.cluster_view = reply.get("view", {})
+        # the head's session differs from this node's DERIVED session
+        # (per-node shm arenas) — replica validation uses the head's
+        self.head_session = reply.get("session", "")
         self.head_conn = conn
         t = threading.Thread(target=self._head_recv_loop, daemon=True,
                              name="raytpu-node-head")
@@ -471,6 +474,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         sys.stderr.write("[node] rejoined head service\n")
         self.head_conn = conn
         self.cluster_view = reply.get("view", {})
+        self.head_session = reply.get("session",
+                                      getattr(self, "head_session", ""))
         t = threading.Thread(target=self._head_recv_loop, daemon=True,
                              name="raytpu-node-head")
         t.start()
@@ -1982,6 +1987,39 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     # 2PC participant handlers (pushed by the head over the head channel;
     # reference: gcs_placement_group_scheduler.h Prepare/Commit on raylets)
+
+    def _hh_head_snapshot(self, m: dict) -> None:
+        """Persist the head's replicated snapshot (the cluster-as-the-
+        database head-FT store — see head.py _fan_out_replicas)."""
+        if m.get("session") not in (None, getattr(self, "head_session",
+                                                  "")):
+            return   # a different cluster's state must never land here
+        path = os.path.join(self.session_dir, "head_replica.state")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(m["data"])
+            os.replace(tmp, path)
+            self._head_replica_seq = m.get("seq", 0)
+        except OSError:
+            pass  # a missed replica is refreshed by the next snapshot
+
+    def _h_fetch_head_snapshot(self, rec, m):
+        """A replacement head bootstraps from this node's replica; the
+        reply carries this node's session so a head recovering against
+        the wrong cluster rejects it."""
+        path = os.path.join(self.session_dir, "head_replica.state")
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            self._reply(rec, m["reqid"],
+                        session=getattr(self, "head_session", ""),
+                        error="no head snapshot replica on this node")
+            return
+        self._reply(rec, m["reqid"], ok=True, data=data,
+                    session=getattr(self, "head_session", ""),
+                    seq=getattr(self, "_head_replica_seq", 0))
 
     def _hh_pg_prepare(self, m: dict) -> None:
         bundle = m["bundle"]

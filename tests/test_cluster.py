@@ -7,6 +7,7 @@ test_object_manager.py, run against the in-process virtual cluster
 
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -329,3 +330,37 @@ def test_placement_group_ready_blocks_until_node_frees(cluster):
         ray_tpu.kill(h)
     assert ray_tpu.get(pg.ready(), timeout=120) is True
     ray_tpu.remove_placement_group(pg)
+
+
+def test_head_machine_loss_recovers_from_node_replica():
+    """Losing the head MACHINE (local snapshot gone): a replacement head
+    bootstraps from a surviving node's replicated snapshot — the
+    capability the reference needs external Redis for
+    (gcs_server.cc:58-61); here the cluster is the database."""
+    c = Cluster(head_persistence=True)
+    try:
+        n0 = c.add_node(num_cpus=1)
+        c.add_node(num_cpus=1)
+        c.wait_for_nodes()
+        ray_tpu.init(address=n0.address)
+        rt = ray_tpu.get_runtime()
+        rt.client.kv_put(b"replicated", b"still-here")
+
+        # force a snapshot + replication cycle to land on the nodes
+        deadline = time.time() + 30
+        replica = os.path.join(c.nodes[0].session_dir,
+                               "head_replica.state")
+        while time.time() < deadline and not os.path.exists(replica):
+            time.sleep(0.2)
+        assert os.path.exists(replica), "snapshot never replicated"
+
+        c.restart_head(simulate_machine_loss=True)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if sum(1 for n in c.head.nodes.values() if n.alive) >= 2:
+                break
+            time.sleep(0.2)
+        assert rt.client.kv_get(b"replicated") == b"still-here"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
