@@ -7,10 +7,11 @@ from ray_tpu.tune import _report_bridge
 from ray_tpu.tune.callback import (Callback, CSVLoggerCallback,
                                    JSONLoggerCallback,
                                    TensorBoardLoggerCallback)
-from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
-                                     HyperBandScheduler,
+from ray_tpu.tune.schedulers import (ASHAScheduler, DistributeResources,
+                                     FIFOScheduler, HyperBandScheduler,
                                      MedianStoppingRule, PB2,
                                      PopulationBasedTraining,
+                                     ResourceChangingScheduler,
                                      TrialScheduler)
 from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
                                  Searcher, choice, grid_search, loguniform,
@@ -42,8 +43,9 @@ __all__ = [
     "FunctionTrainable", "wrap_function", "report", "get_checkpoint",
     "choice", "uniform", "loguniform", "randint", "grid_search",
     "BasicVariantGenerator", "ConcurrencyLimiter", "Searcher",
-    "ASHAScheduler", "FIFOScheduler", "HyperBandScheduler",
-    "MedianStoppingRule", "PopulationBasedTraining", "PB2", "TrialScheduler",
+    "ASHAScheduler", "DistributeResources", "FIFOScheduler",
+    "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "PB2", "ResourceChangingScheduler", "TrialScheduler",
     "Callback", "CSVLoggerCallback", "JSONLoggerCallback",
     "TensorBoardLoggerCallback",
 ]

@@ -312,3 +312,69 @@ class HyperBandScheduler(TrialScheduler):
 
     def on_complete(self, trial, result):
         self._assignment.pop(trial.trial_id, None)
+
+
+class DistributeResources:
+    """Even split of the cluster's CPUs among live trials (reference:
+    tune/schedulers/resource_changing_scheduler.py DistributeResources):
+    as trials finish, survivors absorb the freed capacity."""
+
+    def __init__(self, max_cpu_per_trial: Optional[float] = None):
+        self.max_cpu_per_trial = max_cpu_per_trial
+
+    def __call__(self, trial, result, live_trials: int,
+                 total_cpus: float) -> Optional[dict]:
+        if live_trials <= 0 or total_cpus <= 0:
+            return None
+        share = max(1.0, total_cpus // live_trials)
+        if self.max_cpu_per_trial is not None:
+            share = min(share, self.max_cpu_per_trial)
+        return {"CPU": float(share)}
+
+
+class ResourceChangingScheduler(TrialScheduler):
+    """Reallocate trial resources while they train (reference:
+    tune/schedulers/resource_changing_scheduler.py).  Wraps a base
+    scheduler for stop/continue decisions; after each result the
+    allocation function may assign the trial a new resource bundle, and
+    the runner restarts the trial's actor from its checkpoint with the
+    new allocation.  The trainable sees its current allocation as
+    ``config["trial_resources"]`` (the analogue of
+    ``tune.get_trial_resources()``)."""
+
+    def __init__(self, base_scheduler: Optional[TrialScheduler] = None,
+                 resources_allocation_function=None):
+        self.base = base_scheduler or FIFOScheduler()
+        self.alloc = (resources_allocation_function
+                      if resources_allocation_function is not None
+                      else DistributeResources())
+        self.pending_resource_changes: dict[str, dict] = {}
+        self._live_trials = 1
+        self._total_cpus = 1.0
+
+    def set_context(self, live_trials: int, total_cpus: float) -> None:
+        """Called by the runner before each on_result with the cluster
+        view the allocator needs."""
+        self._live_trials = live_trials
+        self._total_cpus = total_cpus
+
+    def on_result(self, trial, result: dict) -> str:
+        decision = self.base.on_result(trial, result)
+        if decision == CONTINUE:
+            new = self.alloc(trial, result, self._live_trials,
+                             self._total_cpus)
+            # an unset allocation means the 1-CPU default — comparing
+            # against {} would churn a pointless rebuild on every
+            # trial's first result
+            cur = getattr(trial, "resources", None) or {"CPU": 1.0}
+            if new and new != cur:
+                self.pending_resource_changes[trial.trial_id] = new
+        return decision
+
+    def on_complete(self, trial, result: Optional[dict]):
+        self.base.on_complete(trial, result)
+
+    @property
+    def pending_exploits(self):
+        # PBT as the base scheduler keeps working through the wrapper
+        return getattr(self.base, "pending_exploits", None)

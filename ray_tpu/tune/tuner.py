@@ -55,6 +55,8 @@ class Trial:
     error: Optional[str] = None
     runner: Any = None           # Trainable or actor handle
     checkpoint: Optional[dict] = None
+    # current allocation (ResourceChangingScheduler updates it mid-run)
+    resources: Optional[dict] = None
 
     @property
     def iterations(self) -> int:
@@ -147,7 +149,8 @@ class Tuner:
         state = [{"trial_id": t.trial_id, "config": t.config,
                   "status": t.status, "last_result": t.last_result,
                   "history": t.history, "error": t.error,
-                  "checkpoint": t.checkpoint} for t in trials]
+                  "checkpoint": t.checkpoint,
+                  "resources": t.resources} for t in trials]
         payload = {"trials": state, "param_space": self.param_space}
         # searcher + configs ride along so restore continues the SAME
         # experiment: remaining suggestions, metric/mode, stop criteria,
@@ -195,22 +198,37 @@ class Tuner:
             t = Trial(trial_id=ts["trial_id"], config=ts["config"],
                       status=ts["status"], last_result=ts["last_result"],
                       history=ts["history"], error=ts["error"],
-                      checkpoint=ts["checkpoint"])
+                      checkpoint=ts["checkpoint"],
+                      resources=ts.get("resources"))
             tuner._restored.append(t)
         return tuner
 
     # -- executor helpers --------------------------------------------------
 
     def _make_runner(self, trial: Trial):
+        cfg = dict(trial.config)
+        if trial.resources:
+            # the trainable reads its live allocation here (analogue of
+            # tune.get_trial_resources) and can resize accordingly
+            cfg["trial_resources"] = dict(trial.resources)
         if self.tune_config.use_actors:
             import cloudpickle
             import ray_tpu
             cls_bytes = cloudpickle.dumps(self.trainable_cls)
             Actor = ray_tpu.remote(_ActorTrialShim)
-            trial.runner = Actor.remote(cls_bytes, trial.config)
+            if trial.resources:
+                opts = {}
+                if "CPU" in trial.resources:
+                    opts["num_cpus"] = trial.resources["CPU"]
+                extra = {k: v for k, v in trial.resources.items()
+                         if k not in ("CPU",)}
+                if extra:
+                    opts["resources"] = extra
+                Actor = Actor.options(**opts)
+            trial.runner = Actor.remote(cls_bytes, cfg)
             trial._is_actor = True
         else:
-            trial.runner = self.trainable_cls(trial.config)
+            trial.runner = self.trainable_cls(cfg)
             trial._is_actor = False
         if trial.checkpoint is not None:
             self._runner_call(trial, "restore", trial.checkpoint)
@@ -321,6 +339,15 @@ class Tuner:
                 if exhausted or not made_progress:
                     break   # done, or searcher wedged with nothing live
                 continue
+            total_cpus = 1.0
+            if hasattr(scheduler, "set_context"):
+                # once per pass, not per result — the cluster view
+                # doesn't change between trials within one sweep
+                try:
+                    import ray_tpu
+                    total_cpus = ray_tpu.cluster_resources().get("CPU", 1.0)
+                except Exception:
+                    pass
             for t in list(live):
                 try:
                     result = self._runner_call(t, "train")
@@ -350,7 +377,39 @@ class Tuner:
                 for k, v in stop_criteria.items():
                     if k in result and result[k] >= v:
                         done = True
+                if hasattr(scheduler, "set_context"):
+                    scheduler.set_context(len(live), total_cpus)
                 decision = scheduler.on_result(t, result)
+                # resource reallocation: restart the runner from its
+                # checkpoint with the new bundle (reference:
+                # resource_changing_scheduler.py apply path).  Skipped
+                # when the trial is ending anyway or a PBT exploit will
+                # rebuild the runner this same iteration.
+                realloc = getattr(scheduler, "pending_resource_changes",
+                                  None)
+                exploit_pending = t.trial_id in (
+                    getattr(scheduler, "pending_exploits", None) or {})
+                if (realloc and t.trial_id in realloc
+                        and decision != STOP and not done
+                        and not exploit_pending):
+                    new_res = realloc.pop(t.trial_id)
+                    try:
+                        saved = self._runner_call(t, "save")
+                        self._runner_call(t, "cleanup")
+                        t.checkpoint = saved
+                        t.resources = new_res
+                        self._make_runner(t)
+                    except Exception:
+                        # a failed rebuild fails THIS trial, not fit()
+                        t.status = "ERROR"
+                        t.error = traceback.format_exc()
+                        live.remove(t)
+                        scheduler.on_complete(t, t.last_result)
+                        searcher.on_trial_complete(t.trial_id,
+                                                   t.last_result)
+                        for cb in callbacks:
+                            cb.on_trial_error(t)
+                        continue
                 # PBT exploit: clone src weights + new config
                 exploits = getattr(scheduler, "pending_exploits", None)
                 if exploits and t.trial_id in exploits:

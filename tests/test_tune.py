@@ -586,3 +586,44 @@ def test_bohb_with_hyperband(tmp_path):
     iters = sorted(t.iterations for t in res.trials)
     assert iters[0] < 9
     assert res.get_best_result().metrics["loss"] < 0.6
+
+
+def test_resource_changing_scheduler(tmp_path):
+    """Trials see their reallocated bundle in config["trial_resources"]
+    after a checkpointed runner restart (reference:
+    tune/schedulers/resource_changing_scheduler.py)."""
+    from ray_tpu.tune import ResourceChangingScheduler
+
+    class Sizer(tune.Trainable):
+        def setup(self, config):
+            self.i = 0
+
+        def step(self):
+            self.i += 1
+            res = self.config.get("trial_resources") or {}
+            return {"iters": self.i, "res_cpu": res.get("CPU", 0),
+                    "done": self.i >= 4}
+
+        def save_checkpoint(self):
+            return {"i": self.i}
+
+        def load_checkpoint(self, ck):
+            self.i = ck["i"]
+
+    def grow(trial, result, live_trials, total_cpus):
+        # deterministic allocator: always demand 2 CPUs
+        return {"CPU": 2.0}
+
+    sched = ResourceChangingScheduler(resources_allocation_function=grow)
+    tuner = Tuner(
+        Sizer,
+        param_space={"x": tune.grid_search([1.0])},
+        tune_config=TuneConfig(metric="iters", mode="max",
+                               scheduler=sched, use_actors=False),
+        run_config=RunConfig(name="rcs", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    t = grid.trials[0]
+    assert t.status == "TERMINATED"
+    assert t.resources == {"CPU": 2.0}
+    # the restarted runner reported the new allocation
+    assert t.last_result["res_cpu"] == 2.0
