@@ -1,8 +1,8 @@
 """Blocks: the unit of distributed data.
 
 Reference capability: ray.data blocks (python/ray/data/_internal/
-arrow_block.py, pandas_block.py — Arrow/pandas/list formats).  Two block
-layouts are first-class:
+arrow_block.py, pandas_block.py — Arrow/pandas/list formats).  Three
+block layouts are first-class:
 
   * **column dict of numpy arrays** (default) — the layout `device_put`
     wants, so the path from disk to HBM is: block → slice → jax.Array
@@ -10,6 +10,10 @@ layouts are first-class:
   * **pyarrow.Table** — zero-copy columnar interchange with parquet /
     pandas / the Arrow ecosystem (reference: arrow_block.py); accessors
     below dispatch on the block type so stages can mix formats.
+  * **pandas.DataFrame** — native pandas blocks (reference:
+    pandas_block.py): `from_pandas` keeps DataFrames as-is and
+    `map_batches(batch_format="pandas")` stages never leave pandas, so
+    DataFrame-heavy ETL pays zero format conversions between stages.
 
 List-of-rows blocks are accepted at the edges and normalized.
 """
@@ -25,17 +29,24 @@ try:
 except Exception:   # pragma: no cover - environment gates the dependency
     pa = None
 
-Block = Any  # dict[str -> np.ndarray] (equal length) | pyarrow.Table
+# dict[str -> np.ndarray] (equal length) | pyarrow.Table | pandas.DataFrame
+Block = Any
 
 
 def is_arrow(block) -> bool:
     return pa is not None and isinstance(block, pa.Table)
 
 
+def is_pandas(block) -> bool:
+    import sys
+    pd = sys.modules.get("pandas")
+    return pd is not None and isinstance(block, pd.DataFrame)
+
+
 def normalize(data) -> Block:
     """rows (list of dicts / scalars), columns (dict of arrays), or an
     Arrow table → Block."""
-    if is_arrow(data):
+    if is_arrow(data) or is_pandas(data):
         return data
     if isinstance(data, dict):
         return {k: np.asarray(v) for k, v in data.items()}
@@ -55,6 +66,8 @@ def to_columns(block: Block) -> dict:
     if is_arrow(block):
         return {c: block[c].to_numpy(zero_copy_only=False)
                 for c in block.column_names}
+    if is_pandas(block):
+        return {c: block[c].to_numpy() for c in block.columns}
     return block
 
 
@@ -64,12 +77,27 @@ def to_arrow(block: Block):
         raise ImportError("pyarrow is not available")
     if is_arrow(block):
         return block
+    if is_pandas(block):
+        return pa.Table.from_pandas(block, preserve_index=False)
     return pa.table({k: np.asarray(v) for k, v in block.items()})
+
+
+def to_pandas(block: Block):
+    """Any block → pandas.DataFrame (native pandas stage format)."""
+    import pandas as pd
+    if is_pandas(block):
+        return block
+    if is_arrow(block):
+        return block.to_pandas()
+    return pd.DataFrame({k: (list(v) if getattr(v, "ndim", 1) > 1 else v)
+                         for k, v in block.items()})
 
 
 def num_rows(block: Block) -> int:
     if is_arrow(block):
         return block.num_rows
+    if is_pandas(block):
+        return len(block)
     for v in block.values():
         return len(v)
     return 0
@@ -78,12 +106,16 @@ def num_rows(block: Block) -> int:
 def size_bytes(block: Block) -> int:
     if is_arrow(block):
         return block.nbytes
+    if is_pandas(block):
+        return int(block.memory_usage(deep=True).sum())
     return sum(v.nbytes for v in block.values())
 
 
 def slice_block(block: Block, start: int, end: int) -> Block:
     if is_arrow(block):
         return block.slice(start, end - start)
+    if is_pandas(block):
+        return block.iloc[start:end]
     return {k: v[start:end] for k, v in block.items()}
 
 
@@ -93,6 +125,14 @@ def concat(blocks: list[Block]) -> Block:
         return {}
     if any(is_arrow(b) for b in blocks):
         return pa.concat_tables([to_arrow(b) for b in blocks])
+    if all(is_pandas(b) for b in blocks):
+        import pandas as pd
+        return pd.concat(blocks, ignore_index=True)
+    if any(is_pandas(b) for b in blocks):
+        # MIXED pandas + dict: go through columns, not to_pandas — its
+        # ndim>1 list-wrapping would degrade 2D numpy columns to object
+        # dtype and break numeric consumers downstream
+        blocks = [to_columns(b) for b in blocks]
     keys = blocks[0].keys()
     return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
 
@@ -100,6 +140,8 @@ def concat(blocks: list[Block]) -> Block:
 def to_rows(block: Block) -> list[dict]:
     if is_arrow(block):
         return block.to_pylist()
+    if is_pandas(block):
+        return block.to_dict("records")
     n = num_rows(block)
     keys = list(block.keys())
     return [{k: block[k][i] for k in keys} for i in range(n)]
@@ -108,18 +150,24 @@ def to_rows(block: Block) -> list[dict]:
 def take_rows(block: Block, idx: np.ndarray) -> Block:
     if is_arrow(block):
         return block.take(pa.array(np.asarray(idx)))
+    if is_pandas(block):
+        return block.iloc[np.asarray(idx)].reset_index(drop=True)
     return {k: v[idx] for k, v in block.items()}
 
 
 def column(block: Block, name: str) -> np.ndarray:
     if is_arrow(block):
         return block[name].to_numpy(zero_copy_only=False)
+    if is_pandas(block):
+        return block[name].to_numpy()
     return np.asarray(block[name])
 
 
 def column_names(block: Block) -> list[str]:
     if is_arrow(block):
         return list(block.column_names)
+    if is_pandas(block):
+        return list(block.columns)
     return list(block.keys())
 
 
@@ -127,12 +175,16 @@ def drop(block: Block, cols: list[str]) -> Block:
     if is_arrow(block):
         return block.drop_columns([c for c in cols
                                    if c in block.column_names])
+    if is_pandas(block):
+        return block.drop(columns=[c for c in cols if c in block.columns])
     return {k: v for k, v in block.items() if k not in cols}
 
 
 def select(block: Block, cols: list[str]) -> Block:
     if is_arrow(block):
         return block.select(cols)
+    if is_pandas(block):
+        return block[list(cols)]
     return {k: block[k] for k in cols}
 
 

@@ -82,15 +82,16 @@ class Dataset:
 
     @staticmethod
     def from_pandas(dfs) -> "Dataset":
+        """DataFrames become NATIVE pandas blocks (reference:
+        pandas_block.py) — no conversion until a stage asks for another
+        format."""
         dfs = dfs if isinstance(dfs, list) else [dfs]
-        return Dataset([{c: df[c].to_numpy() for c in df.columns}
-                        for df in dfs] or [{}])
+        return Dataset([df.reset_index(drop=True) for df in dfs] or [{}])
 
     def to_pandas(self):
-        import pandas as pd
-        full = B.to_columns(B.concat(self._materialize()))
-        return pd.DataFrame({k: list(v) if v.ndim > 1 else v
-                             for k, v in full.items()})
+        # native pandas blocks concat straight to a DataFrame (dtypes —
+        # categoricals, nullable ints — survive untouched)
+        return B.to_pandas(B.concat(self._materialize()))
 
     @staticmethod
     def read_csv(paths: Union[str, list[str]]) -> "Dataset":
@@ -318,11 +319,15 @@ class Dataset:
                     batch_format: str = "numpy",
                     **_compat) -> "Dataset":
         """fn over batches (reference: dataset.py:364).  batch_format:
-        "numpy" hands fn a column dict; "arrow" hands it a
-        pyarrow.Table (reference arrow batch format)."""
+        "numpy" hands fn a column dict; "arrow" a pyarrow.Table;
+        "pandas" a DataFrame (stages stay format-native — a pandas
+        pipeline never round-trips through numpy)."""
         def convert(blk):
-            return (B.to_arrow(blk) if batch_format == "arrow"
-                    else dict(B.to_columns(blk)))
+            if batch_format == "arrow":
+                return B.to_arrow(blk)
+            if batch_format == "pandas":
+                return B.to_pandas(blk)
+            return dict(B.to_columns(blk))
 
         def stage(blk: B.Block) -> B.Block:
             if batch_size is None or B.num_rows(blk) <= batch_size:

@@ -93,15 +93,22 @@ def test_dashboard_task_drilldown_logs_and_stack(rt):
     assert ray_tpu.get(nap_ref, timeout=90) == "rested"
 
 
-def test_stack_cli(rt, capsys):
+def test_stack_cli(rt, capsys, tmp_path):
     from ray_tpu.scripts import main as cli_main
 
+    stop = tmp_path / "release_hold"
+
     @ray_tpu.remote
-    def hold():
-        time.sleep(5.0)
+    def hold(stop_path):
+        # run until the test has captured the stack — a fixed sleep
+        # raced the dump under parallel suite load
+        import os as _os
+        deadline = time.time() + 60
+        while not _os.path.exists(stop_path) and time.time() < deadline:
+            time.sleep(0.1)
         return 1
 
-    ref = hold.remote()
+    ref = hold.remote(str(stop))
     deadline = time.time() + 60
     while time.time() < deadline:
         svc = rt.node_service
@@ -111,6 +118,7 @@ def test_stack_cli(rt, capsys):
         time.sleep(0.2)
     rc = cli_main(["stack", "--address", rt.node_service.address])
     out = capsys.readouterr().out
+    stop.write_text("go")
     assert rc == 0
     assert "worker pid=" in out
     assert "sleep" in out or "hold" in out

@@ -203,3 +203,47 @@ class TestDistributedShuffleSort:
         desc = ds.sort("k", descending=True)
         kd = B.column(B.concat(desc._materialize()), "k")
         np.testing.assert_array_equal(kd, np.sort(kd)[::-1])
+
+
+# -- pandas-native blocks ----------------------------------------------------
+
+def test_pandas_native_blocks_stay_pandas():
+    """from_pandas keeps DataFrame blocks; a pandas-format map_batches
+    pipeline never round-trips through numpy (reference:
+    _internal/pandas_block.py)."""
+    import pandas as pd
+    from ray_tpu import data as rd
+    from ray_tpu.data import block as B
+
+    df = pd.DataFrame({"a": [3, 1, 2], "b": ["x", "y", "z"]})
+    ds = rd.Dataset.from_pandas(df)
+    seen_types = []
+
+    def stage(batch):
+        seen_types.append(type(batch).__name__)
+        batch = batch.copy()
+        batch["a2"] = batch["a"] * 2
+        return batch
+
+    out = ds.map_batches(stage, batch_format="pandas")
+    blocks = out._materialize()
+    assert seen_types == ["DataFrame"]
+    assert all(B.is_pandas(b) for b in blocks)
+    got = out.to_pandas()
+    assert list(got["a2"]) == [6, 2, 4]
+
+
+def test_pandas_blocks_through_relational_ops():
+    import pandas as pd
+    from ray_tpu import data as rd
+
+    df = pd.DataFrame({"k": ["a", "b", "a", "b"], "v": [1, 2, 3, 4]})
+    ds = rd.Dataset.from_pandas(df)
+    # filter + sort + take ride the block accessors' pandas branches
+    out = ds.filter(lambda r: r["v"] > 1).sort("v", descending=True)
+    rows = out.take(10)
+    assert [r["v"] for r in rows] == [4, 3, 2]
+    # groupby aggregates over pandas blocks
+    agg = ds.groupby("k").sum("v").take(10)
+    got = {r["k"]: r["sum(v)"] for r in agg}
+    assert got == {"a": 4, "b": 6}
