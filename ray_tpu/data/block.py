@@ -115,7 +115,9 @@ def slice_block(block: Block, start: int, end: int) -> Block:
     if is_arrow(block):
         return block.slice(start, end - start)
     if is_pandas(block):
-        return block.iloc[start:end]
+        # zero-based index like take_rows: stages doing index-aligned
+        # assignment on a later batch would otherwise misalign to NaN
+        return block.iloc[start:end].reset_index(drop=True)
     return {k: v[start:end] for k, v in block.items()}
 
 
@@ -123,6 +125,8 @@ def concat(blocks: list[Block]) -> Block:
     blocks = [b for b in blocks if num_rows(b)]
     if not blocks:
         return {}
+    if len(blocks) == 1:
+        return blocks[0]
     if any(is_arrow(b) for b in blocks):
         return pa.concat_tables([to_arrow(b) for b in blocks])
     if all(is_pandas(b) for b in blocks):

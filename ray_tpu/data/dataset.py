@@ -326,7 +326,10 @@ class Dataset:
             if batch_format == "arrow":
                 return B.to_arrow(blk)
             if batch_format == "pandas":
-                return B.to_pandas(blk)
+                # shallow copy: adding columns in fn must not mutate the
+                # parent dataset's stored block (same shielding the
+                # numpy path gets from dict())
+                return B.to_pandas(blk).copy(deep=False)
             return dict(B.to_columns(blk))
 
         def stage(blk: B.Block) -> B.Block:

@@ -45,18 +45,34 @@ def test_oom_kill_retries_without_losing_node(local_rt, tmp_path):
             f.flush()
         # run until OOM-killed or the test says all-clear — a fixed sleep
         # raced the monitor tick under parallel suite load (the task
-        # could finish before the kill landed, leaving nothing to kill)
-        deadline = time.time() + 60
+        # could finish before the kill landed, leaving nothing to kill).
+        # The backstop deadline must exceed the test's kill-wait window
+        # or the same race reappears at the boundary.
+        deadline = time.time() + 150
         while not os.path.exists(stop_path) and time.time() < deadline:
             time.sleep(0.05)
         return "done"
 
     _press(svc)                      # simulated pressure: no allocation
     ref = hog.remote(str(marker), str(stop))
+    # wait for the FIRST execution's pid, then for that process to die —
+    # asserting on oom_kill_count alone raced: a kill could be counted
+    # while the hog itself survived to finish without a retry
     deadline = time.time() + 60
-    while time.time() < deadline and svc.oom_kill_count == 0:
+    while time.time() < deadline and not marker.exists():
         time.sleep(0.05)
-    assert svc.oom_kill_count >= 1, "monitor never killed the hog"
+    assert marker.exists(), "hog never started"
+    first_pid = int(marker.read_text().split()[0])
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        try:
+            os.kill(first_pid, 0)
+        except OSError:
+            break                    # the hog's worker is gone
+        time.sleep(0.05)
+    else:
+        raise AssertionError("monitor never killed the hog's worker")
+    assert svc.oom_kill_count >= 1
     _relax(svc)
     stop.write_text("go")            # let the retried execution finish
 
