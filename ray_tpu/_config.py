@@ -75,6 +75,11 @@ _define("scheduler_spread_threshold", float, 0.5,
         "policy, raylet/scheduling/policy/hybrid_scheduling_policy.h).")
 _define("lease_timeout_s", float, 30.0, "Worker lease grant timeout.")
 
+_define("pg_ready_poll_timeout_s", float, 1800.0,
+        "Deadline for the zero-cpu PlacementGroup.ready() poller; an "
+        "abandoned ready() call on a never-placeable PG otherwise holds "
+        "a pool worker and polls the head forever.")
+
 # --- fault tolerance ------------------------------------------------------
 _define("task_max_retries", int, 3,
         "Default retries for tasks that die due to worker failure "

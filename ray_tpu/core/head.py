@@ -819,6 +819,10 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             if c is not None:
                 self._push(c, {"t": "pg_rollback", "pg_id": pg_id,
                                "bundle_idx": j})
+        # Fence the abandoned attempt immediately: a late all-ok prepare
+        # reply must not pass the epoch check and commit against bundles
+        # the nodes just rolled back.
+        info["epoch"] = info.get("epoch", 0) + 1
         info["busy"] = False
         info.pop("busy_since", None)
         info.pop("assignment", None)

@@ -32,8 +32,10 @@ def _get_pg_ready_fn():
         @remote(num_cpus=0)
         def _pg_ready(pg_id_bin: bytes) -> bool:
             import time as _t
+            from ray_tpu._config import get_config
             rt = get_runtime()
             delay = 0.02
+            deadline = _t.monotonic() + get_config().pg_ready_poll_timeout_s
             while True:
                 st = rt.client.request({"t": "pg_state",
                                         "pg_id": pg_id_bin})["state"]
@@ -43,6 +45,13 @@ def _get_pg_ready_fn():
                     raise RuntimeError(
                         "placement group was removed before it was "
                         "scheduled")
+                if _t.monotonic() > deadline:
+                    # an abandoned ready() on a never-placeable PG must
+                    # not hold this pool worker forever
+                    raise RuntimeError(
+                        "placement group was still pending after "
+                        "pg_ready_poll_timeout_s; call ready() again to "
+                        "keep waiting")
                 _t.sleep(delay)
                 # back off: pending groups can pend for minutes — don't
                 # hammer the single-threaded head with 50 Hz state RPCs
@@ -65,6 +74,17 @@ class PlacementGroup:
         gcs_placement_group_manager.h:222 creation).  Creation is async —
         on a busy cluster the ref stays unresolved until capacity frees;
         a removed group makes the ref raise."""
+        rt = get_runtime()
+        if self._ready_ref is not None:
+            # if the cached poller already gave up (poll-timeout error),
+            # respawn instead of handing back a permanently failed ref
+            done, _ = rt.wait([self._ready_ref], timeout=0)
+            if done:
+                try:
+                    rt.get([self._ready_ref], timeout=1)
+                except Exception as e:
+                    if "pg_ready_poll_timeout_s" in str(e):
+                        self._ready_ref = None
         if self._ready_ref is None:
             self._ready_ref = _get_pg_ready_fn().remote(self.id.binary())
         return self._ready_ref
@@ -73,12 +93,27 @@ class PlacementGroup:
         """Block until created (True) or timeout (False).  A REMOVED
         group raises instead — callers retry-looping on wait() must be
         able to tell a busy cluster from a permanently dead PG."""
+        import time
         from ray_tpu.core.client import GetTimeoutError
-        try:
-            get_runtime().get(self.ready(), timeout=timeout_seconds)
-            return True
-        except GetTimeoutError:
-            return False
+        deadline = time.monotonic() + timeout_seconds
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            try:
+                get_runtime().get([self.ready()], timeout=remaining)
+                return True
+            except GetTimeoutError:
+                return False
+            except Exception as e:
+                # remote exceptions surface as TaskError (not
+                # RuntimeError) — match the poll-timeout by its marker
+                if "pg_ready_poll_timeout_s" in str(e):
+                    # poller expired mid-wait: spawn a fresh one and keep
+                    # blocking for the caller's remaining budget
+                    self._ready_ref = None
+                    continue
+                raise
 
     @property
     def bundle_specs(self) -> list:

@@ -366,6 +366,12 @@ def is_initialized() -> bool:
 
 def attach_worker_runtime(client: NodeClient, executor: Executor) -> Runtime:
     global _runtime
+    # Adopt the node's resolved config (received at registration) so
+    # system_config overrides reach worker-side get_config() readers —
+    # the reference distributes _system_config cluster-wide the same way
+    # (ray_config.cc:29).  Worker-local RAY_TPU_* env still wins.
+    from ray_tpu._config import RayTpuConfig, set_config
+    set_config(RayTpuConfig(client.config_dict))
     with _runtime_lock:
         _runtime = Runtime(client, mode="worker", executor=executor)
     return _runtime

@@ -326,11 +326,20 @@ class Dataset:
             if batch_format == "arrow":
                 return B.to_arrow(blk)
             if batch_format == "pandas":
-                # shallow copy: adding columns in fn must not mutate the
-                # parent dataset's stored block (same shielding the
-                # numpy path gets from dict())
-                return B.to_pandas(blk).copy(deep=False)
-            return dict(B.to_columns(blk))
+                # idiomatic in-place mutation (batch['a'] *= 2) must not
+                # write through shared numpy buffers into the parent
+                # dataset's stored block (reference hands fn a
+                # conversion-produced fresh batch); only a native-pandas
+                # block returns its stored frame — other formats already
+                # materialize fresh buffers in to_pandas
+                df = B.to_pandas(blk)
+                return df.copy(deep=True) if B.is_pandas(blk) else df
+            # always hand out fresh writable arrays: dict-of-numpy blocks
+            # ARE the stored arrays, pandas columns are views, and arrow
+            # to_numpy can be zero-copy read-only — in-place mutation by
+            # fn must neither corrupt stored blocks nor raise
+            return {k: np.array(v, copy=True)
+                    for k, v in B.to_columns(blk).items()}
 
         def stage(blk: B.Block) -> B.Block:
             if batch_size is None or B.num_rows(blk) <= batch_size:

@@ -116,6 +116,19 @@ def test_placement_group_bad_strategy(rt):
         rt.placement_group([{"CPU": 1}], strategy="DIAGONAL")
 
 
+def test_placement_group_wait_returns_bool(rt):
+    """wait() is the retry-loop API: True when placed, False on timeout —
+    it must not leak the poller's internal exceptions."""
+    pg = rt.placement_group([{"CPU": 2}])
+    assert pg.wait(timeout_seconds=60) is True
+
+    pg2 = rt.placement_group([{"CPU": 2}])  # pends behind pg
+    assert pg2.wait(timeout_seconds=1.5) is False
+    rt.remove_placement_group(pg)
+    assert pg2.wait(timeout_seconds=60) is True
+    rt.remove_placement_group(pg2)
+
+
 def test_zero_copy_read_is_view(rt):
     """Reads from shm come back without an extra copy of the buffer."""
     arr = np.arange(1 << 20, dtype=np.float32)

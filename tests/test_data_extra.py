@@ -247,3 +247,43 @@ def test_pandas_blocks_through_relational_ops():
     agg = ds.groupby("k").sum("v").take(10)
     got = {r["k"]: r["sum(v)"] for r in agg}
     assert got == {"a": 4, "b": 6}
+
+
+def test_batch_mutation_does_not_corrupt_stored_blocks():
+    """In-place mutation of a handed-out batch (pandas OR numpy format)
+    must not write through shared buffers into the dataset's stored
+    blocks — re-running the pipeline has to see pristine inputs."""
+    import pandas as pd
+    from ray_tpu import data as rd
+
+    ds = rd.Dataset.from_pandas(pd.DataFrame({"a": [1.0, 2.0, 3.0]}))
+
+    def mut_df(df):
+        df["a"] *= 2
+        return df
+
+    def mut_np(b):
+        b["a"] *= 2
+        return b
+
+    first = [r["a"] for r in ds.map_batches(mut_df,
+                                            batch_format="pandas").take(10)]
+    second = [r["a"] for r in ds.map_batches(mut_df,
+                                             batch_format="pandas").take(10)]
+    assert first == second == [2.0, 4.0, 6.0]
+
+    first = [r["a"] for r in ds.map_batches(mut_np,
+                                            batch_format="numpy").take(10)]
+    second = [r["a"] for r in ds.map_batches(mut_np,
+                                             batch_format="numpy").take(10)]
+    assert first == second == [2.0, 4.0, 6.0]
+
+    # dict-of-numpy blocks ARE the stored arrays — the numpy path must
+    # shield those too, and mutation must not raise on arrow-backed reads
+    import numpy as np
+    ds2 = rd.from_items([{"a": 1.0}, {"a": 2.0}, {"a": 3.0}])
+    first = [r["a"] for r in ds2.map_batches(mut_np,
+                                             batch_format="numpy").take(10)]
+    second = [r["a"] for r in ds2.map_batches(mut_np,
+                                              batch_format="numpy").take(10)]
+    assert first == second == [2.0, 4.0, 6.0]

@@ -1,0 +1,45 @@
+"""PG ready-poller deadline: an abandoned ready() on a long-pending PG must
+release its pool worker (pg_ready_poll_timeout_s) without poisoning later
+ready()/wait() calls.  Also covers system_config propagation to workers
+(reference: cluster-wide _system_config distribution, ray_config.cc:29)."""
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 system_config={"pg_ready_poll_timeout_s": 1.0})
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_system_config_reaches_workers(rt):
+    @ray_tpu.remote
+    def read_flag():
+        from ray_tpu._config import get_config
+        return get_config().pg_ready_poll_timeout_s
+
+    assert rt.get(read_flag.remote(), timeout=60) == 1.0
+
+
+def test_poller_timeout_releases_worker_and_recovers(rt):
+    pg = rt.placement_group([{"CPU": 2}])
+    assert pg.wait(timeout_seconds=60) is True
+
+    pg2 = rt.placement_group([{"CPU": 2}])   # pends behind pg
+    # the poller gives up after 1s: wait() reports False, not an exception
+    assert pg2.wait(timeout_seconds=8) is False
+
+    # the expired poller released its worker: a zero-cpu task can run
+    @ray_tpu.remote(num_cpus=0)
+    def probe():
+        return "alive"
+    assert rt.get(probe.remote(), timeout=60) == "alive"
+
+    rt.remove_placement_group(pg)
+    # a stale failed ready-ref must not stick: wait() spawns a fresh poller
+    assert pg2.wait(timeout_seconds=60) is True
+    rt.remove_placement_group(pg2)
