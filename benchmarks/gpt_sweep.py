@@ -21,7 +21,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def run_one(name: str, *, batch: int, seq: int, remat, remat_policy,
-            mu_dtype: str, steps: int, warmup: int) -> dict:
+            mu_dtype: str, steps: int, warmup: int,
+            block_q: int = 512, block_k: int = 512) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -32,7 +33,9 @@ def run_one(name: str, *, batch: int, seq: int, remat, remat_policy,
 
     dev = jax.devices()[0]
     cfg = gpt.GPTConfig.gpt2_124m(max_seq=seq, remat=remat,
-                                  remat_policy=remat_policy)
+                                  remat_policy=remat_policy,
+                                  attn_block_q=block_q,
+                                  attn_block_k=block_k)
     params = gpt.init_params(cfg, jax.random.PRNGKey(0))
     n_params = int(sum(np.prod(p.shape)
                        for p in jax.tree_util.tree_leaves(params)))
@@ -104,6 +107,28 @@ GRID = [
                       remat_policy="dots", mu_dtype="f32")),
     ("bf16_moments_b24", dict(batch=24, seq=1024, remat=True,
                               remat_policy="dots", mu_dtype="bf16")),
+    # round-5: saved flash out/lse (backward skips the fwd kernel)
+    ("dots_flash_b16", dict(batch=16, seq=1024, remat=True,
+                            remat_policy="dots_flash", mu_dtype="f32")),
+    ("dots_flash_b24", dict(batch=24, seq=1024, remat=True,
+                            remat_policy="dots_flash", mu_dtype="f32")),
+    ("dots_flash_b32", dict(batch=32, seq=1024, remat=True,
+                            remat_policy="dots_flash", mu_dtype="f32")),
+    ("b32_dots", dict(batch=32, seq=1024, remat=True,
+                      remat_policy="dots", mu_dtype="f32")),
+    # round-5: pallas tile-size sweep (fwd + both bwd kernels)
+    ("dots_flash_bq256", dict(batch=16, seq=1024, remat=True,
+                              remat_policy="dots_flash", mu_dtype="f32",
+                              block_q=256, block_k=512)),
+    ("dots_flash_bk256", dict(batch=16, seq=1024, remat=True,
+                              remat_policy="dots_flash", mu_dtype="f32",
+                              block_q=512, block_k=256)),
+    ("dots_flash_bq1024", dict(batch=16, seq=1024, remat=True,
+                               remat_policy="dots_flash", mu_dtype="f32",
+                               block_q=1024, block_k=512)),
+    ("dots_flash_b256x256", dict(batch=16, seq=1024, remat=True,
+                                 remat_policy="dots_flash", mu_dtype="f32",
+                                 block_q=256, block_k=256)),
 ]
 
 

@@ -67,6 +67,12 @@ _define("num_workers", int, 0,
 _define("max_workers", int, 64,
         "Hard cap on worker processes per node (oversubscription for "
         "blocked-on-get workers is allowed up to this).")
+_define("prefork_workers", bool, True,
+        "Start worker processes by forking from a pre-imported template "
+        "(fork server) instead of cold python spawns.  The reference "
+        "amortizes worker startup with prestarted pool processes "
+        "(worker_pool.h:352 PrestartWorkers); here the interpreter + "
+        "import cost is paid once in the template.")
 _define("worker_register_timeout_s", float, 30.0,
         "Seconds to wait for a spawned worker to register.")
 _define("scheduler_spread_threshold", float, 0.5,

@@ -26,19 +26,23 @@ def _public_methods(cls) -> list[str]:
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
-                 num_returns: Any = 1):
+                 num_returns: Any = 1, concurrency_group: str = ""):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def options(self, **opts) -> "ActorMethod":
-        return ActorMethod(self._handle, self._name,
-                           num_returns=opts.get("num_returns",
-                                                self._num_returns))
+        return ActorMethod(
+            self._handle, self._name,
+            num_returns=opts.get("num_returns", self._num_returns),
+            concurrency_group=opts.get("concurrency_group",
+                                       self._concurrency_group))
 
     def remote(self, *args, **kwargs):
         return self._handle._actor_method_call(
-            self._name, args, kwargs, num_returns=self._num_returns)
+            self._name, args, kwargs, num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(f"Actor method '{self._name}' cannot be called "
@@ -68,12 +72,14 @@ class ActorHandle:
                 f"Actor {self._class_name!r} has no method {name!r}")
         return ActorMethod(self, name)
 
-    def _actor_method_call(self, method: str, args, kwargs, num_returns=1):
+    def _actor_method_call(self, method: str, args, kwargs, num_returns=1,
+                           concurrency_group: str = ""):
         rt = get_runtime()
         return rt.submit_actor_task(self._actor_id, self._nonce,
                                     self._seq.next(), method,
                                     args, kwargs, num_returns=num_returns,
-                                    name=f"{self._class_name}.{method}")
+                                    name=f"{self._class_name}.{method}",
+                                    concurrency_group=concurrency_group)
 
     def __reduce__(self):
         return (_rebuild_handle,
@@ -111,6 +117,14 @@ class ActorClass:
                 self._exported_to = rt
         o = self._options
         methods = _public_methods(self._cls)
+        # async actors default to high concurrency (reference:
+        # DEFAULT_MAX_CONCURRENCY_ASYNC=1000) — their calls interleave as
+        # coroutines on one long-lived loop, not as parallel threads
+        import inspect as _inspect
+        has_async = any(
+            _inspect.iscoroutinefunction(getattr(self._cls, n, None))
+            for n in methods)
+        default_mc = 1000 if has_async else 1
         actor_id = rt.create_actor(
             self._function_id, args, kwargs,
             class_name=self._cls.__name__,
@@ -122,7 +136,8 @@ class ActorClass:
             num_tpus=float(o.get("num_tpus") or 0),
             max_restarts=o.get("max_restarts",
                                -1 if o.get("lifetime") == "detached" else 0),
-            max_concurrency=o.get("max_concurrency", 1),
+            max_concurrency=o.get("max_concurrency", default_mc),
+            concurrency_groups=o.get("concurrency_groups"),
             placement_group=_pg_tuple(o),
             runtime_env=o.get("runtime_env"))
         return ActorHandle(actor_id, methods, self._cls.__name__)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
@@ -60,6 +61,30 @@ class OutOfMemoryError(RuntimeError):
     ray.exceptions.OutOfMemoryError / memory_monitor.h:52)."""
 
 
+class _SendBatch:
+    """Scope for NodeClient.batched_sends(): reentrant per thread; only
+    the outermost scope flushes."""
+
+    def __init__(self, client: "NodeClient"):
+        self._client = client
+        self._owner = False
+
+    def __enter__(self):
+        tls = self._client._batch_tls
+        if getattr(tls, "batch", None) is None:
+            tls.batch = []
+            self._owner = True
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._owner:
+            try:
+                self._client._flush_batch()
+            finally:
+                self._client._batch_tls.batch = None
+        return False
+
+
 class NodeClient:
     def __init__(self, address: str, kind: str, tpu: bool = False,
                  push_handler: Optional[Callable[[dict], None]] = None):
@@ -72,6 +97,18 @@ class NodeClient:
         self._replies: dict[int, queue.SimpleQueue] = {}
         self._push_handler = push_handler
         self._closed = threading.Event()
+        self._batch_tls = threading.local()   # per-thread send batching
+        # submit auto-batching: bursts of fire-and-forget submissions
+        # coalesce into one syscall; a micro-flusher bounds the delay and
+        # request()/send() flush first so same-socket ordering holds
+        self._auto: list = []
+        self._auto_lock = threading.Lock()
+        # held across swap+send: concurrent flushes (micro-flusher vs a
+        # request() on another thread) must not reorder batches on the
+        # wire — actor-call ordering rides arrival order
+        self._auto_send_lock = threading.Lock()
+        self._auto_event = threading.Event()
+        self._auto_thread: Optional[threading.Thread] = None
         self._recv_thread = threading.Thread(target=self._recv_loop,
                                              daemon=True,
                                              name=f"raytpu-recv-{kind}")
@@ -135,7 +172,23 @@ class NodeClient:
                     import traceback
                     traceback.print_exc()
 
+    def batched_sends(self):
+        """Context manager: coalesce fire-and-forget sends on this
+        thread into one syscall at exit (e.g. inline result puts +
+        task_done).  request() flushes first, so the node still sees
+        puts strictly before any later read from this thread."""
+        return _SendBatch(self)
+
+    def _flush_batch(self) -> None:
+        batch = getattr(self._batch_tls, "batch", None)
+        if batch:
+            self._batch_tls.batch = []
+            self._flush_auto()   # older coalesced submits go first
+            self.conn.send_batch(batch)
+
     def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        self._flush_batch()
+        self._flush_auto()
         reqid = self._next_reqid()
         msg["reqid"] = reqid
         q: queue.SimpleQueue = queue.SimpleQueue()
@@ -151,10 +204,60 @@ class NodeClient:
         return reply
 
     def send(self, msg: dict) -> None:
-        self.conn.send(msg)
+        batch = getattr(self._batch_tls, "batch", None)
+        if batch is not None:
+            batch.append(msg)
+        else:
+            self._flush_auto()
+            self.conn.send(msg)
+
+    def send_soon(self, msg: dict) -> None:
+        """Fire-and-forget send that MAY be coalesced with neighbors
+        (bounded-delay flush).  Any later send()/request() on this
+        client flushes first, so ordering relative to subsequent
+        traffic is preserved."""
+        with self._auto_lock:
+            self._auto.append(msg)
+            n = len(self._auto)
+        if n >= 64:
+            self._flush_auto()
+            return
+        if self._auto_thread is None:
+            t = threading.Thread(target=self._auto_flusher, daemon=True,
+                                 name="raytpu-autoflush")
+            self._auto_thread = t
+            t.start()
+        self._auto_event.set()
+
+    def _flush_auto(self) -> None:
+        if not self._auto:
+            return
+        with self._auto_send_lock:
+            with self._auto_lock:
+                batch, self._auto = self._auto, []
+            if len(batch) == 1:
+                self.conn.send(batch[0])
+            elif batch:
+                self.conn.send_batch(batch)
+
+    def _auto_flusher(self) -> None:
+        while not self._closed.is_set():
+            self._auto_event.wait(0.5)
+            self._auto_event.clear()
+            if self._auto:
+                time.sleep(0.0005)   # let the burst accumulate
+                try:
+                    self._flush_auto()
+                except protocol.ConnectionClosed:
+                    return
 
     def close(self) -> None:
+        try:
+            self._flush_auto()
+        except Exception:
+            pass
         self._closed.set()
+        self._auto_event.set()   # unblock the flusher so it exits
         self.conn.close()
         self.shm.shutdown()
 

@@ -46,6 +46,39 @@ def queue_push_handler(q: "queue.SimpleQueue"):
     return push
 
 
+class _ActorAsyncState:
+    """Long-lived event loop for ONE async actor: every in-flight call
+    runs as a coroutine on this loop, so calls interleave at awaits and
+    share asyncio primitives (reference: fiber-based async actors,
+    core_worker/transport/fiber.h — vs. a fresh asyncio.run per call,
+    which isolates each call on its own loop)."""
+
+    def __init__(self, name: str = "raytpu-actor-loop"):
+        import asyncio
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=name)
+        self.thread.start()
+        self._sems: dict[str, Any] = {}   # concurrency group -> Semaphore
+        self._sems_lock = threading.Lock()
+
+    def _run(self) -> None:
+        import asyncio
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def group_sem(self, group: str, limit: int):
+        import asyncio
+        with self._sems_lock:
+            sem = self._sems.get(group)
+            if sem is None:
+                sem = self._sems[group] = asyncio.Semaphore(limit)
+            return sem
+
+    def stop(self) -> None:
+        self.loop.call_soon_threadsafe(self.loop.stop)
+
+
 class Executor:
     def __init__(self, client: NodeClient,
                  msg_queue: Optional["queue.SimpleQueue"] = None,
@@ -55,6 +88,11 @@ class Executor:
         self._actors: dict[bytes, Any] = {}
         self._actor_envs: dict[bytes, dict] = {}
         self._actor_lock = threading.Lock()
+        # async-actor loops + concurrency-group state (reference:
+        # concurrency_group_manager.cc named groups with own executors)
+        self._actor_loops: dict[bytes, _ActorAsyncState] = {}
+        self._actor_groups: dict[bytes, dict] = {}     # aid -> {name: limit}
+        self._sync_sems: dict[tuple, Any] = {}         # (aid, group) -> sem
         self._serde = get_context()
         self._queue = msg_queue if msg_queue is not None else queue.SimpleQueue()
         self._shutdown = threading.Event()
@@ -103,8 +141,16 @@ class Executor:
                 self.create_actor(msg["spec"])
             elif t == "destroy_actor":
                 with self._actor_lock:
-                    self._actors.pop(msg["actor_id"], None)
-                    self._actor_envs.pop(msg["actor_id"], None)
+                    aid = msg["actor_id"]
+                    self._actors.pop(aid, None)
+                    self._actor_envs.pop(aid, None)
+                    self._actor_groups.pop(aid, None)
+                    self._sync_sems = {k: v for k, v in
+                                       self._sync_sems.items()
+                                       if k[0] != aid}
+                    st = self._actor_loops.pop(aid, None)
+                if st is not None:
+                    st.stop()
 
     # -- function store ----------------------------------------------------
 
@@ -196,7 +242,13 @@ class Executor:
                                kind="server",
                                remote_ctx=spec.get("trace_ctx")):
                 result = fn(*args, **kwargs)
-            self._store_returns(spec, result)
+            # one syscall for inline result puts + completion (hot path:
+            # per-task overhead, SURVEY hard part 6)
+            with self.client.batched_sends():
+                self._store_returns(spec, result)
+                self.client.send({"t": "task_done",
+                                  "task_id": spec["task_id"], "error": None})
+            return
         except BaseException as e:  # noqa: BLE001 — report all task errors
             tb = traceback.format_exc()
             error = f"{type(e).__name__}: {e}"
@@ -227,15 +279,70 @@ class Executor:
                 instance = cls(*args, **kwargs)
             with self._actor_lock:
                 self._actors[spec["actor_id"]] = instance
+                groups = dict(spec.get("concurrency_groups") or {})
+                if groups:
+                    # "" = the default group, bounded by max_concurrency
+                    groups[""] = int(spec.get("max_concurrency", 1))
+                self._actor_groups[spec["actor_id"]] = groups
         except BaseException as e:  # noqa: BLE001
             error = (f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
         self.client.send({"t": "actor_created", "actor_id": spec["actor_id"],
                           "error": error})
 
+    def _actor_loop_state(self, aid: bytes) -> _ActorAsyncState:
+        with self._actor_lock:
+            st = self._actor_loops.get(aid)
+            if st is None:
+                st = self._actor_loops[aid] = _ActorAsyncState()
+            return st
+
+    def _sync_group_sem(self, aid: bytes, group: str, limit: int):
+        with self._actor_lock:
+            sem = self._sync_sems.get((aid, group))
+            if sem is None:
+                sem = self._sync_sems[(aid, group)] = \
+                    threading.BoundedSemaphore(limit)
+            return sem
+
+    def _group_limit(self, spec: dict) -> Optional[int]:
+        groups = self._actor_groups.get(spec["actor_id"]) or {}
+        if not groups:
+            # no named groups declared: the node's max_concurrency
+            # admission cap alone governs
+            return None
+        # the node raises its dispatch cap to default+sum(groups), so
+        # the DEFAULT group ("" key, = max_concurrency) must be enforced
+        # here too — otherwise declaring any named group would unbound
+        # the default group's concurrency
+        group = spec.get("concurrency_group") or ""
+        limit = groups.get(group)
+        if limit is None:
+            raise ValueError(
+                f"Unknown concurrency group {group!r}; declared groups: "
+                f"{sorted(g for g in groups if g)}")
+        return int(limit)
+
+    def _finish_actor_task(self, spec: dict, result: Any,
+                           exc: Optional[BaseException],
+                           tb: str = "") -> None:
+        if exc is None:
+            try:
+                with self.client.batched_sends():
+                    self._store_returns(spec, result)
+                    self.client.send({"t": "task_done",
+                                      "task_id": spec["task_id"],
+                                      "error": None})
+                return
+            except BaseException as e:  # noqa: BLE001
+                exc, tb = e, traceback.format_exc()
+        error = f"{type(exc).__name__}: {exc}"
+        self._store_error(spec, exc, tb)
+        self.client.send({"t": "task_done", "task_id": spec["task_id"],
+                          "error": error})
+
     def execute_actor_task(self, spec: dict) -> None:
         from ray_tpu.core.runtime import task_context
         from ray_tpu.runtime_env import applied_env
-        error = None
         try:
             instance = self._actors.get(spec["actor_id"])
             if instance is None:
@@ -243,23 +350,77 @@ class Executor:
             from ray_tpu.util.tracing import start_span
             method = getattr(instance, spec["method"])
             args, kwargs = self._load_args(spec)
+            limit = self._group_limit(spec)
+            if inspect.iscoroutinefunction(method) or \
+                    inspect.iscoroutinefunction(
+                        getattr(method, "__func__", method)):
+                self._run_async_actor_task(spec, method, args, kwargs, limit)
+                return
+            sem = (self._sync_group_sem(spec["actor_id"],
+                                        spec.get("concurrency_group") or "",
+                                        limit)
+                   if limit is not None else None)
             with task_context(TaskID(spec["task_id"])), \
                     applied_env(self._actor_envs.get(spec["actor_id"]),
                                 self.client), \
                     start_span(f"actor::{spec.get('name', '?')}.execute",
                                kind="server",
                                remote_ctx=spec.get("trace_ctx")):
-                result = method(*args, **kwargs)
+                if sem is not None:
+                    with sem:
+                        result = method(*args, **kwargs)
+                else:
+                    result = method(*args, **kwargs)
                 if inspect.iscoroutine(result):
-                    import asyncio
-                    result = asyncio.run(result)
-            self._store_returns(spec, result)
+                    # async value from a non-coroutine callable (rare):
+                    # still run it on the shared actor loop
+                    self._run_async_actor_task(
+                        spec, lambda: result, (), {}, limit)
+                    return
         except BaseException as e:  # noqa: BLE001
-            tb = traceback.format_exc()
-            error = f"{type(e).__name__}: {e}"
-            self._store_error(spec, e, tb)
-        self.client.send({"t": "task_done", "task_id": spec["task_id"],
-                          "error": error})
+            self._finish_actor_task(spec, None, e, traceback.format_exc())
+            return
+        self._finish_actor_task(spec, result, None)
+
+    def _run_async_actor_task(self, spec: dict, method, args, kwargs,
+                              limit: Optional[int]) -> None:
+        """Schedule the call on the actor's long-lived loop and return —
+        completion is reported from the loop.  All in-flight calls
+        interleave at awaits and share asyncio primitives."""
+        import asyncio
+        from ray_tpu.core.runtime import task_context
+        from ray_tpu.runtime_env import applied_env
+        st = self._actor_loop_state(spec["actor_id"])
+
+        async def runner():
+            from ray_tpu.util.tracing import start_span
+            with task_context(TaskID(spec["task_id"])), \
+                    applied_env(self._actor_envs.get(spec["actor_id"]),
+                                self.client), \
+                    start_span(f"actor::{spec.get('name', '?')}.execute",
+                               kind="server",
+                               remote_ctx=spec.get("trace_ctx")):
+                if limit is not None:
+                    sem = st.group_sem(
+                        spec.get("concurrency_group") or "", limit)
+                    async with sem:
+                        return await method(*args, **kwargs)
+                return await method(*args, **kwargs)
+
+        def schedule():
+            task = st.loop.create_task(runner())
+
+            def done(t):
+                exc = t.exception()
+                if exc is not None:
+                    tb = "".join(traceback.format_exception(
+                        type(exc), exc, exc.__traceback__))
+                    self._finish_actor_task(spec, None, exc, tb)
+                else:
+                    self._finish_actor_task(spec, t.result(), None)
+            task.add_done_callback(done)
+
+        st.loop.call_soon_threadsafe(schedule)
 
     def get_actor_instance(self, actor_id: bytes) -> Optional[Any]:
         return self._actors.get(actor_id)

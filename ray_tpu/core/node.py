@@ -57,6 +57,48 @@ from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
                                   EventLoopService)
 
 # ---------------------------------------------------------------------------
+# fork-server worker handle
+
+
+class _ForkedProc:
+    """Popen-shaped handle for a worker forked by the prefork template
+    (core/prefork.py).  The template reaps exits, so liveness is probed
+    with signal 0 rather than waitpid."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._rc: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._rc is None:
+            try:
+                os.kill(self.pid, 0)
+            except (ProcessLookupError, PermissionError):
+                self._rc = 0
+        return self._rc
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.time() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return self._rc
+
+    def _signal(self, sig: int) -> None:
+        try:
+            os.kill(self.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+
+# ---------------------------------------------------------------------------
 # records
 
 
@@ -196,13 +238,27 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self.dep_waiting: dict[ObjectID, list] = {}  # oid -> waiting specs
         self.actors: dict[ActorID, ActorRec] = {}
         self.named_actors: dict[tuple[str, str], ActorID] = {}
+        self._actors_wanting_worker: deque = deque()
         self._init_stores()   # kv / pubsub / function store (mixin)
         self.pgs: dict[PlacementGroupID, PGRec] = {}
         self.pg_available: dict[tuple[bytes, int], dict] = {}  # (pg,bundle)->free
         self.task_events: deque = deque(maxlen=config.task_events_buffer_size)
+        # bounded retention of finished TaskRecs: the state API wants
+        # recent history, but an unbounded dict makes every scan over
+        # self.tasks O(everything ever run)
+        self._done_order: deque = deque()
         self._spawning = 0
-        self._worker_procs: list[subprocess.Popen] = []
+        self._worker_procs: list = []   # Popen | _ForkedProc
         self._worker_log_by_pid: dict[int, tuple] = {}  # pid -> (out, err)
+        # fork-server template (reference: worker_pool.h:352
+        # PrestartWorkers amortization; here startup cost is paid once
+        # in the template and workers fork in ~ms — core/prefork.py)
+        self._prefork_proc: Optional[subprocess.Popen] = None
+        self._prefork_conn = None       # control socket to the template
+        self._prefork_buf = b""
+        self._prefork_path = ""
+        if config.prefork_workers:
+            self._start_prefork_template()
         # Batched-get bookkeeping: (conn_id, reqid) -> {ids, remaining}.
         self._multigets: dict[tuple, dict] = {}
         self._mg_by_oid: dict[ObjectID, set] = {}
@@ -353,12 +409,25 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 self._flush(rec)
             except Exception:
                 pass
+        # closing the control connection tells the template to exit
+        if self._prefork_conn is not None:
+            try:
+                self._prefork_conn.close()
+            except OSError:
+                pass
+            self._prefork_conn = None
         deadline = time.time() + 2.0
         for p in self._worker_procs:
             try:
                 p.wait(timeout=max(0.0, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 p.kill()
+        if self._prefork_proc is not None:
+            try:
+                self._prefork_proc.wait(timeout=max(0.0,
+                                                    deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                self._prefork_proc.kill()
         for rec in list(self.clients.values()):
             try:
                 rec.sock.close()
@@ -581,6 +650,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     config=self.config.to_dict(),
                     native_store=isinstance(self.store,
                                             NativeObjectStoreCore))
+        while self._actors_wanting_worker:
+            ar = self._actors_wanting_worker.popleft()
+            if ar.state in ("pending", "restarting") and ar.conn_id is None:
+                self._place_actor(ar)
+                break   # one new worker hosts one actor
         self._schedule()
 
     # -- objects
@@ -781,6 +855,17 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             if info.owner_node[0] == self.node_id.hex():
                 self._owner_add_location(ob, self.node_id.hex(),
                                          self.address)
+            elif info.loc == "inline" and info.data is not None:
+                # inline result of forwarded work: ship the VALUE to the
+                # owner directly — a location report would cost the owner
+                # a locate + pull round trip for ~bytes of payload
+                # (reference contrast: small returns ride the
+                # PushTaskReply inline, core_worker.cc:2528)
+                self._owner_push(
+                    info.owner_node[0], info.owner_node[1],
+                    {"t": "owner_object_value", "object_id": ob,
+                     "data": info.data, "is_error": info.is_error,
+                     "node": self.node_id.hex(), "address": self.address})
             else:
                 self._owner_push(
                     info.owner_node[0], info.owner_node[1],
@@ -796,6 +881,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 if tr is not None and tr.state == "forwarded":
                     tr.state = "failed" if info.is_error else "finished"
                     tr.finished_at = time.time()
+                    self._note_task_finished(tid)
 
     def _resolve_waiters(self, oid: ObjectID, info: ObjInfo) -> None:
         self._object_ready_hook(oid, info)
@@ -989,9 +1075,14 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 s.update(spec.get("arg_ids", ()))
             for spec in ar.running.values():
                 s.update(spec.get("arg_ids", ()))
-        for tr in self.tasks.values():
-            if tr.state == "running":
-                s.update(tr.spec.get("arg_ids", ()))
+        # running (non-actor) work hangs off busy workers — iterating
+        # clients is O(pool), where iterating self.tasks would be
+        # O(task history) per release sweep
+        for rec in self.clients.values():
+            if rec.current_task is not None:
+                tr = self.tasks.get(rec.current_task)
+                if tr is not None:
+                    s.update(tr.spec.get("arg_ids", ()))
         # forwarded work: the destination node still has to PULL these
         # args from us — our copy must outlive the forward
         for fw in self._fwd_tasks.values():
@@ -1307,6 +1398,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             tr.state = "failed" if m.get("error") else "finished"
             tr.finished_at = time.time()
             tr.error = m.get("error", "")
+            self._note_task_finished(tid)
             self._record_event(tr.spec, "FAILED" if m.get("error") else "FINISHED")
         if rec.dedicated_actor is not None:
             ar = self.actors.get(rec.dedicated_actor)
@@ -1452,12 +1544,24 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._record_event(spec, "RUNNING", worker=w.conn_id)
         self._push(w, {"t": "execute", "spec": spec})
 
+    def _note_task_finished(self, tid: bytes) -> None:
+        """Bound the finished-task history (the live dict stays O(recent),
+        dupes are harmless — eviction re-checks state)."""
+        self._done_order.append(tid)
+        cap = max(1000, self.config.task_events_buffer_size // 5)
+        while len(self._done_order) > cap:
+            old = self._done_order.popleft()
+            tr = self.tasks.get(old)
+            if tr is not None and tr.state in ("finished", "failed"):
+                del self.tasks[old]
+
     def _fail_task(self, spec: dict, error: str) -> None:
         tr = self.tasks.get(spec["task_id"])
         if tr is not None:
             tr.state = "failed"
             tr.error = error
             tr.finished_at = time.time()
+            self._note_task_finished(spec["task_id"])
         self._record_event(spec, "FAILED")
         for b in spec["return_ids"]:
             self._seal_error_object(ObjectID(b), RuntimeError(error))
@@ -1465,7 +1569,30 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _maybe_spawn_worker(self, tpu: bool = False) -> None:
         if tpu:
             return  # TPU executors are registered by the driver, not spawned
-        # Self-heal the in-flight spawn counter against crashed spawns.
+        # Throttle: this runs on EVERY submit/completion event, but its
+        # demand scan is O(workers + clients) with a waitpid per proc —
+        # at thousands of events/s the scan itself became the scheduler's
+        # biggest cost.  Pool sizing only needs to be right within a few
+        # ms; the periodic tick re-evaluates regardless.
+        now = time.monotonic()
+        if now - getattr(self, "_last_spawn_eval", 0.0) < 0.005:
+            # re-arm so a lone skipped event still gets its evaluation
+            # promptly instead of waiting for the next tick
+            if not getattr(self, "_spawn_eval_armed", False):
+                self._spawn_eval_armed = True
+
+                def rearm():
+                    self._spawn_eval_armed = False
+                    self._schedule()
+                self.post_later(0.006, rearm)
+            return
+        self._last_spawn_eval = now
+        # Self-heal the in-flight spawn counter against crashed spawns;
+        # prune long-dead procs so the scan doesn't grow with history.
+        dead = [p for p in self._worker_procs if p.poll() is not None]
+        if len(dead) > 32:
+            self._worker_procs = [p for p in self._worker_procs
+                                  if p.poll() is None]
         alive_procs = sum(1 for p in self._worker_procs if p.poll() is None)
         registered = sum(1 for c in self.clients.values()
                          if c.kind == "worker" and not c.tpu)
@@ -1491,7 +1618,13 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         cpu_demand = min(len(self.runnable_cpu) - n_pg,
                          max(0, int(self.available.get("CPU", 0.0))))
         demand = cpu_demand + n_pg + n_zero + n_actors_waiting
-        max_concurrent_startup = max(2, os.cpu_count() or 1)
+        # cold spawns compete for CPU, so their concurrency is capped at
+        # roughly core count; forks from the warm template cost ~ms and
+        # can ramp much harder (reference: worker_pool.h:192,717)
+        if self._prefork_conn is not None or self._prefork_ready():
+            max_concurrent_startup = 16
+        else:
+            max_concurrent_startup = max(2, os.cpu_count() or 1)
         want = min(demand - idle - self._spawning,
                    self.config.max_workers - registered - self._spawning,
                    max_concurrent_startup - self._spawning)
@@ -1500,8 +1633,30 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._spawn_worker_proc()
 
     def _spawn_worker_proc(self) -> None:
+        logdir = os.path.join(self.session_dir, "logs")
+        idx = len(self._worker_procs)
+        outp = os.path.join(logdir, f"worker-{idx}.out")
+        errp = os.path.join(logdir, f"worker-{idx}.err")
+        proc = self._fork_worker(outp, errp)
+        if proc is None:
+            env = self._worker_env()
+            out = open(outp, "ab", buffering=0)
+            err = open(errp, "ab", buffering=0)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker",
+                 "--address", self.address, "--session", self.session],
+                env=env, stdout=out, stderr=err, start_new_session=True)
+        self._worker_procs.append(proc)
+        # stack dumps / the dashboard log view need pid -> log mapping
+        self._worker_log_by_pid[proc.pid] = (outp, errp)
+
+    def _worker_env(self) -> dict:
         env = dict(os.environ)
-        # Workers must not steal the TPU from the driver: force CPU jax.
+        # Workers must not steal the TPU from the driver: force CPU jax —
+        # and skip ambient TPU-plugin registration entirely (site hooks
+        # keyed on this env cost ~2.4 s of pure import time per process
+        # and risk contending for the chip the driver owns).
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
         env.setdefault("XLA_FLAGS", "")
         env["RAY_TPU_SESSION"] = self.session
@@ -1513,19 +1668,71 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         env["PYTHONPATH"] = os.pathsep.join(
             [p for p in sys.path if p] +
             [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+        return env
+
+    # -- fork-server template (core/prefork.py)
+
+    def _start_prefork_template(self) -> None:
+        """Spawn the pre-imported worker template.  Non-blocking: the
+        template warms up (~0.5 s) while the node finishes starting;
+        until its socket accepts, spawns fall back to cold Popen."""
         logdir = os.path.join(self.session_dir, "logs")
-        idx = len(self._worker_procs)
-        out = open(os.path.join(logdir, f"worker-{idx}.out"), "ab", buffering=0)
-        err = open(os.path.join(logdir, f"worker-{idx}.err"), "ab", buffering=0)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker",
-             "--address", self.address, "--session", self.session],
-            env=env, stdout=out, stderr=err, start_new_session=True)
-        self._worker_procs.append(proc)
-        # stack dumps / the dashboard log view need pid -> log mapping
-        self._worker_log_by_pid[proc.pid] = (
-            os.path.join(logdir, f"worker-{idx}.out"),
-            os.path.join(logdir, f"worker-{idx}.err"))
+        os.makedirs(logdir, exist_ok=True)
+        self._prefork_path = os.path.join(self.session_dir, "prefork.sock")
+        out = open(os.path.join(logdir, "prefork.out"), "ab", buffering=0)
+        err = open(os.path.join(logdir, "prefork.err"), "ab", buffering=0)
+        self._prefork_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.prefork",
+             "--socket", self._prefork_path],
+            env=self._worker_env(), stdout=out, stderr=err,
+            start_new_session=True)
+
+    def _prefork_ready(self) -> bool:
+        if self._prefork_conn is not None:
+            return True
+        if (self._prefork_proc is None
+                or self._prefork_proc.poll() is not None):
+            return False
+        import socket as _socket
+        s = _socket.socket(_socket.AF_UNIX)
+        s.settimeout(0.05)
+        try:
+            s.connect(self._prefork_path)
+        except OSError:
+            s.close()
+            return False
+        # short bound: this socket is read on the EVENT-LOOP thread, so
+        # a wedged template must not stall scheduling for long — on
+        # timeout we drop the template and cold-spawn instead
+        s.settimeout(2.0)
+        self._prefork_conn = s
+        self._prefork_buf = b""
+        return True
+
+    def _fork_worker(self, outp: str, errp: str):
+        """Request a forked worker from the template; None -> caller
+        should cold-spawn instead."""
+        if not self.config.prefork_workers or not self._prefork_ready():
+            return None
+        import json as _json
+        try:
+            req = {"address": self.address, "stdout": outp, "stderr": errp,
+                   "env": {"RAY_TPU_SESSION": self.session}}
+            self._prefork_conn.sendall(_json.dumps(req).encode() + b"\n")
+            while b"\n" not in self._prefork_buf:
+                chunk = self._prefork_conn.recv(4096)
+                if not chunk:
+                    raise OSError("prefork template closed")
+                self._prefork_buf += chunk
+            line, self._prefork_buf = self._prefork_buf.split(b"\n", 1)
+            return _ForkedProc(_json.loads(line)["pid"])
+        except (OSError, ValueError):
+            try:
+                self._prefork_conn.close()
+            except OSError:
+                pass
+            self._prefork_conn = None
+            return None
 
     # -- actors
 
@@ -1575,11 +1782,17 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _admit_actor(self, spec: dict) -> ActorRec:
         actor_id = ActorID(spec["actor_id"])
+        # named concurrency groups add their own in-flight budget on top
+        # of the default group's (reference: concurrency_group_manager.cc
+        # — per-group executors; the executor enforces per-group limits,
+        # the node only caps the total it pushes)
+        mc = spec.get("max_concurrency", 1) + \
+            sum((spec.get("concurrency_groups") or {}).values())
         ar = ActorRec(actor_id=actor_id, spec=spec,
                       name=spec.get("name") or "",
                       namespace=spec.get("namespace") or "default",
                       restarts_left=spec.get("max_restarts", 0),
-                      max_concurrency=spec.get("max_concurrency", 1))
+                      max_concurrency=mc)
         self.actors[actor_id] = ar
         self._place_actor(ar)
         return ar
@@ -1599,6 +1812,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         w = self._find_idle_worker(tpu=needs_tpu)
         if w is None:
             self._maybe_spawn_worker(tpu=needs_tpu)
+            # event-driven retry on the next worker registration (the
+            # 50 ms poll alone serialized bursts of actor creations)
+            self._actors_wanting_worker.append(ar)
             self.post_later(0.05, lambda: self._place_actor_if_pending(ar))
             return
         if not self._try_acquire(ar.spec):
@@ -2314,6 +2530,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 if tr is not None and tr.state == "forwarded":
                     tr.state = "finished"
                     tr.finished_at = time.time()
+                    self._note_task_finished(tid)
         if orec.watchers:
             watchers, orec.watchers = orec.watchers, set()
             for whex, waddr in watchers:
@@ -2373,6 +2590,26 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _h_object_at(self, rec, m):
         """Location push from an owner node (same shape as the head's)."""
         self._on_owner_object_at_push(m)
+
+    def _h_owner_object_value(self, rec, m):
+        """Inline VALUE pushed by the node that executed forwarded work
+        we own — seal it locally, skipping locate/pull round trips."""
+        ob = m["object_id"]
+        self._owner_watch.pop(ob, None)
+        self._watched.discard(ob)
+        oid = ObjectID(ob)
+        info = self.objects.setdefault(oid, ObjInfo())
+        if info.state != "pending":
+            return
+        info.state = "error" if m.get("is_error") else "ready"
+        info.loc = "inline"
+        info.data = m["data"]
+        info.is_error = bool(m.get("is_error"))
+        info.size = len(m["data"] or b"")
+        # the executing node still holds a replica — track it like an
+        # owner_object_at so release sweeps can reach it
+        self._owner_add_location(ob, m["node"], m["address"])
+        self._resolve_waiters(oid, info)
 
     def _on_owner_object_at_push(self, m: dict) -> None:
         self._owner_watch.pop(m["object_id"], None)
@@ -2906,6 +3143,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     p.kill()
                 except OSError:
                     pass
+        if self._prefork_proc is not None and self._prefork_proc.poll() is None:
+            try:
+                self._prefork_proc.kill()
+            except OSError:
+                pass
         self._stop.set()
 
     # -- disconnect handling

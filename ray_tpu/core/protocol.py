@@ -89,6 +89,19 @@ class Connection:
             except (BrokenPipeError, ConnectionResetError, OSError) as e:
                 raise ConnectionClosed(str(e)) from e
 
+    def send_batch(self, msgs: list) -> None:
+        """Frame several messages and write them in one syscall — the
+        per-message sendall otherwise costs a syscall + GIL drop + a
+        receiver wakeup each (hot on the task completion path)."""
+        payload = b"".join(
+            _HDR.pack(len(d)) + d
+            for d in (encode_payload(m, self.encoding) for m in msgs))
+        with self._send_lock:
+            try:
+                self.sock.sendall(payload)
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+
     def recv(self, timeout: Optional[float] = None) -> dict:
         self.sock.settimeout(timeout)
         try:

@@ -17,7 +17,7 @@ _VALID_OPTIONS = {
     "name", "num_returns", "num_cpus", "num_tpus", "resources",
     "max_retries", "max_restarts", "max_concurrency", "namespace",
     "get_if_exists", "placement_group", "placement_group_bundle_index",
-    "scheduling_strategy", "lifetime", "runtime_env",
+    "scheduling_strategy", "lifetime", "runtime_env", "concurrency_groups",
 }
 
 
@@ -30,6 +30,14 @@ def _validate_options(opts: dict) -> None:
     if nr is not None and nr != "dynamic" and (not isinstance(nr, int) or nr < 0):
         raise ValueError(f"num_returns must be a non-negative int or "
                          f"'dynamic', got {nr!r}")
+    cg = opts.get("concurrency_groups")
+    if cg is not None:
+        if (not isinstance(cg, dict) or not cg
+                or not all(isinstance(k, str) and isinstance(v, int)
+                           and v > 0 for k, v in cg.items())):
+            raise ValueError(
+                "concurrency_groups must be a non-empty dict of "
+                f"group name -> positive int limit, got {cg!r}")
 
 
 def _resources_from_options(opts: dict) -> dict:

@@ -17,6 +17,7 @@ import hashlib
 import os
 import threading
 import time
+import contextvars
 import uuid
 from typing import Any, Optional, Sequence
 
@@ -30,14 +31,18 @@ from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.serialization import get_context
 
 # --------------------------------------------------------------------------
-# per-thread task context
+# per-task execution context.  Contextvars, not threading.local: async
+# actors interleave many in-flight calls as coroutines on ONE event-loop
+# thread (reference: fiber.h async actors), and each asyncio.Task carries
+# its own Context copy — thread-locals would make interleaved calls stomp
+# each other's task ids and put counters.  Plain threads still get
+# per-thread isolation (each thread has its own context).
 
 
-class _TaskContext(threading.local):
-    def __init__(self):
-        self.task_id: Optional[TaskID] = None
-        self.put_counter = 0
-        self.task_counter = 0
+class _TaskContext:
+    task_id = contextvars.ContextVar("raytpu_task_id", default=None)
+    put_counter = contextvars.ContextVar("raytpu_put_counter", default=0)
+    task_counter = contextvars.ContextVar("raytpu_task_counter", default=0)
 
 
 _ctx = _TaskContext()
@@ -45,23 +50,26 @@ _ctx = _TaskContext()
 
 @contextlib.contextmanager
 def task_context(task_id: TaskID):
-    prev = (_ctx.task_id, _ctx.put_counter, _ctx.task_counter)
-    _ctx.task_id = task_id
-    _ctx.put_counter = 0
-    _ctx.task_counter = 0
+    t1 = _TaskContext.task_id.set(task_id)
+    t2 = _TaskContext.put_counter.set(0)
+    t3 = _TaskContext.task_counter.set(0)
     try:
         yield
     finally:
-        _ctx.task_id, _ctx.put_counter, _ctx.task_counter = prev
+        _TaskContext.task_id.reset(t1)
+        _TaskContext.put_counter.reset(t2)
+        _TaskContext.task_counter.reset(t3)
 
 
 def current_task_id() -> TaskID:
-    if _ctx.task_id is None:
+    tid = _TaskContext.task_id.get()
+    if tid is None:
         # thread outside any task: derive a stable per-thread driver task id
-        _ctx.task_id = TaskID(hashlib.sha1(
+        tid = TaskID(hashlib.sha1(
             f"thread-{threading.get_ident()}-{uuid.uuid4().hex}".encode()
         ).digest()[:20] + JobID.from_int(0).binary())
-    return _ctx.task_id
+        _TaskContext.task_id.set(tid)
+    return tid
 
 
 # --------------------------------------------------------------------------
@@ -161,12 +169,14 @@ class Runtime:
         return out
 
     def _next_put_index(self) -> int:
-        _ctx.put_counter += 1
-        return _ctx.put_counter
+        n = _TaskContext.put_counter.get() + 1
+        _TaskContext.put_counter.set(n)
+        return n
 
     def _next_task_id(self) -> TaskID:
-        _ctx.task_counter += 1
-        return TaskID.of(current_task_id(), _ctx.task_counter)
+        n = _TaskContext.task_counter.get() + 1
+        _TaskContext.task_counter.set(n)
+        return TaskID.of(current_task_id(), n)
 
     # ------------------------------------------------------------- submit
 
@@ -206,7 +216,7 @@ class Runtime:
         self._prepare_args(args, kwargs, spec)
         with start_span(f"task::{name}.remote", kind="client",
                         attributes={"task_id": task_id.hex()}):
-            self.client.send({"t": "submit_task", "spec": spec})
+            self.client.send_soon({"t": "submit_task", "spec": spec})
         refs = [ObjectRef(o, owner=self.client.worker_id) for o in return_ids]
         if num_returns == "dynamic" or num_returns == 1:
             return refs[0]
@@ -222,6 +232,7 @@ class Runtime:
                      get_if_exists: bool = False,
                      resources: Optional[dict] = None, num_tpus: float = 0,
                      max_restarts: int = 0, max_concurrency: int = 1,
+                     concurrency_groups: Optional[dict] = None,
                      placement_group=None, runtime_env=None) -> ActorID:
         if runtime_env:
             runtime_env, _ = self._prepare_env(runtime_env)
@@ -244,6 +255,7 @@ class Runtime:
             "num_tpus": num_tpus,
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,
+            "concurrency_groups": dict(concurrency_groups or {}),
             "placement_group": placement_group,
             "runtime_env": runtime_env,
         }
@@ -253,7 +265,8 @@ class Runtime:
 
     def submit_actor_task(self, actor_id: ActorID, caller_nonce: bytes,
                           seq: int, method: str,
-                          args, kwargs, *, num_returns=1, name: str = ""):
+                          args, kwargs, *, num_returns=1, name: str = "",
+                          concurrency_group: str = ""):
         task_id = TaskID.for_actor_task(actor_id, caller_nonce, seq)
         n_ret = 1 if num_returns == "dynamic" else max(num_returns, 0)
         return_ids = [ObjectID.for_task_return(task_id, i + 1)
@@ -269,12 +282,14 @@ class Runtime:
             "return_ids": [o.binary() for o in return_ids],
             "owner": self.client.worker_id,
         }
+        if concurrency_group:
+            spec["concurrency_group"] = concurrency_group
         from ray_tpu.util.tracing import inject_context
         tctx = inject_context()
         if tctx is not None:
             spec["trace_ctx"] = tctx
         self._prepare_args(args, kwargs, spec)
-        self.client.send({"t": "submit_actor_task", "spec": spec})
+        self.client.send_soon({"t": "submit_actor_task", "spec": spec})
         refs = [ObjectRef(o, owner=self.client.worker_id) for o in return_ids]
         if num_returns == "dynamic" or num_returns == 1:
             return refs[0]

@@ -73,6 +73,9 @@ def spec_to_proto(spec: Dict[str, Any]) -> "pb.TaskSpec":
     p.seq = int(spec.get("seq", 0))
     p.max_restarts = int(spec.get("max_restarts", 0))
     p.max_concurrency = int(spec.get("max_concurrency", 1))
+    for k, v in (spec.get("concurrency_groups") or {}).items():
+        p.concurrency_groups[k] = int(v)
+    p.concurrency_group = spec.get("concurrency_group", "") or ""
     p.namespace = spec.get("namespace", "") or ""
     p.get_if_exists = bool(spec.get("get_if_exists", False))
     tctx = spec.get("trace_ctx") or {}
@@ -116,8 +119,12 @@ def spec_from_proto(p: "pb.TaskSpec") -> Dict[str, Any]:
                     max_restarts=p.max_restarts,
                     max_concurrency=p.max_concurrency,
                     namespace=p.namespace, get_if_exists=p.get_if_exists)
+        if p.concurrency_groups:
+            spec["concurrency_groups"] = dict(p.concurrency_groups)
     if p.kind == "actor_task":
         spec.update(method=p.method, seq=p.seq)
+        if p.concurrency_group:
+            spec["concurrency_group"] = p.concurrency_group
     if p.trace_id:
         spec["trace_ctx"] = {"trace_id": p.trace_id,
                              "span_id": p.span_id}

@@ -101,6 +101,12 @@ class EventLoopService:
         # outbound RPC correlation: reqid -> callback(reply_msg)
         self._rpc_seq = 0
         self._rpc_pending: dict[int, Callable[[dict], None]] = {}
+        # write coalescing: _push appends to rec.wbuf and the loop sends
+        # each connection's accumulated frames in ONE syscall per
+        # iteration — N small sends per event (task_done -> dispatch ->
+        # waiter resolution ...) otherwise cost N syscalls + N GIL drops
+        # + N receiver wakeups each
+        self._cork_dirty: dict[int, ClientRec] = {}
 
     # ------------------------------------------------------------ threading
 
@@ -126,6 +132,7 @@ class EventLoopService:
         self._thread.start()
 
     def run(self) -> None:
+        self._thread = threading.current_thread()   # enables write corking
         while not self._stop.is_set():
             with self._posted_lock:
                 self._wake_armed = False
@@ -147,6 +154,9 @@ class EventLoopService:
                 except Exception:
                     sys.stderr.write(f"[{self.name}] tick error:\n"
                                      + traceback.format_exc())
+            # everything the previous iteration (posted callbacks, tick,
+            # event handlers) queued goes out now, one syscall per peer
+            self._flush_corked()
             try:
                 events = self.sel.select(timeout=0.05)
             except OSError:
@@ -273,25 +283,40 @@ class EventLoopService:
     def _push(self, rec: ClientRec, msg: dict) -> None:
         if rec.closed:
             return
-        frame = dumps_frame(msg, rec.encoding)
-        if rec.wbuf:
-            rec.wbuf += frame
+        rec.wbuf += dumps_frame(msg, rec.encoding)
+        if threading.current_thread() is self._thread:
+            # loop thread: defer the syscall; _flush_corked sends the
+            # whole batch right before the next select
+            self._cork_dirty[rec.conn_id] = rec
+        else:
+            self._write_out(rec)
+
+    def _write_out(self, rec: ClientRec) -> None:
+        if not rec.wbuf or rec.closed:
             return
         try:
-            sent = rec.sock.send(frame)
+            sent = rec.sock.send(rec.wbuf)
+            del rec.wbuf[:sent]
         except (BlockingIOError, InterruptedError):
-            sent = 0
+            pass
         except OSError:
             self._drop_client(rec)
             return
-        if sent < len(frame):
-            rec.wbuf += frame[sent:]
+        if rec.wbuf:
             try:
                 self.sel.modify(rec.sock,
                                 selectors.EVENT_READ | selectors.EVENT_WRITE,
                                 rec)
             except KeyError:
                 pass
+
+    def _flush_corked(self) -> None:
+        if not self._cork_dirty:
+            return
+        dirty = self._cork_dirty
+        self._cork_dirty = {}
+        for rec in dirty.values():
+            self._write_out(rec)
 
     def _flush(self, rec: ClientRec) -> None:
         rec.sock.setblocking(True)

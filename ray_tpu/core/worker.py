@@ -48,7 +48,10 @@ def _install_jax_cpu_pin() -> None:
     sys.meta_path.insert(0, _JaxCpuPin())
 
 
-def main() -> None:
+def run_worker(address: str) -> None:
+    """Connect to the node service and block in the execution loop.
+    Shared by the cold-spawn path (main below) and the fork-server
+    children (core/prefork.py)."""
     # Workers must not touch the TPU (the driver owns it).  The spawner
     # sets JAX_PLATFORMS=cpu, but ambient platform plugins can override
     # the env var, so pin via jax.config too: immediately if jax is
@@ -74,18 +77,13 @@ def main() -> None:
     except (AttributeError, ValueError):
         pass   # non-POSIX or non-main-thread: dumps unavailable
 
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--address", required=True)
-    parser.add_argument("--session", required=True)
-    args = parser.parse_args()
-
     from ray_tpu.core.client import NodeClient
     from ray_tpu.core.executor import (Executor, make_message_queue,
                                        queue_push_handler)
     from ray_tpu.core import runtime as rt
 
     inbox = make_message_queue()
-    client = NodeClient(args.address, kind="worker",
+    client = NodeClient(address, kind="worker",
                         push_handler=queue_push_handler(inbox))
     executor = Executor(client, msg_queue=inbox, threaded_actors=True)
 
@@ -98,6 +96,14 @@ def main() -> None:
         pass
     finally:
         client.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True)
+    parser.add_argument("--session", required=True)
+    args = parser.parse_args()
+    run_worker(args.address)
     sys.exit(0)
 
 

@@ -62,6 +62,8 @@ class GPTConfig:
     remat_policy: Optional[str] = None   # None=full recompute, "dots"
     tie_embeddings: bool = True
     attn_impl: Optional[str] = None  # None=auto, "flash", "reference"
+    attn_block_q: int = 512          # pallas flash tile sizes (fwd + bwd)
+    attn_block_k: int = 512
     pp_microbatches: Optional[int] = None  # None = 2*pp stages (GPipe)
     # MoE (0 = dense MLP).  When n_experts > 0 every layer's MLP becomes
     # a top-k routed expert layer (GShard/Switch formulation: static
@@ -73,10 +75,12 @@ class GPTConfig:
     moe_aux_weight: float = 0.01     # load-balance aux loss coefficient
 
     def __post_init__(self):
-        if self.remat_policy not in (None, "dots"):
+        if self.remat_policy not in (None, "dots", "dots_flash"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; expected "
-                "None (full recompute) or 'dots'")
+                "None (full recompute), 'dots', or 'dots_flash' (dots + "
+                "saved flash-attention out/lse so the backward pass never "
+                "re-runs the attention forward kernel)")
         if self.n_experts:
             if not 1 <= self.expert_top_k <= self.n_experts:
                 raise ValueError(
@@ -234,7 +238,22 @@ def _attend(q, k, v, cfg: GPTConfig, mesh: Optional[Mesh], rules: Rules):
         ring = partial(ring_attention, axis_name="sp", causal=True)
         return shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
-    return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    if cfg.remat_policy == "dots_flash":
+        # lse-exposing flash variant: the kernel outputs are named
+        # (flash_out/flash_lse) inside its vjp, so the scan's checkpoint
+        # policy saves them and the backward pass reconstructs the layer
+        # without re-running the attention forward kernel
+        from ray_tpu.ops.flash_attention import flash_attention_with_lse
+        tile_ok = (q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
+                   and q.shape[-1] in (64, 128, 256))
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu and tile_ok and cfg.attn_impl in (None, "flash"):
+            out, _lse = flash_attention_with_lse(
+                q, k, v, causal=True,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k)
+            return out
+    return attention(q, k, v, causal=True, impl=cfg.attn_impl,
+                     block_q=cfg.attn_block_q, block_k=cfg.attn_block_k)
 
 
 def _moe_mlp(y, lp, cfg: GPTConfig, mesh: Optional[Mesh], rules: Rules):
@@ -358,9 +377,18 @@ def _layer_scan_body(cfg: GPTConfig, mesh, rules):
         # elementwise/norm work in the backward pass — a fraction of
         # full-remat's extra FLOPs for modest activation memory
         # (the policy knob the scaling playbook recommends; validated
-        # at GPTConfig construction)
-        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                  if cfg.remat_policy == "dots" else None)
+        # at GPTConfig construction).  "dots_flash" additionally saves
+        # the named flash-attention outputs so the backward never
+        # re-runs the attention forward kernel.
+        cp = jax.checkpoint_policies
+        if cfg.remat_policy == "dots":
+            policy = cp.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "dots_flash":
+            policy = cp.save_from_both_policies(
+                cp.dots_with_no_batch_dims_saveable,
+                cp.save_only_these_names("flash_out", "flash_lse"))
+        else:
+            policy = None
         return jax.checkpoint(layer, policy=policy)
     return layer
 

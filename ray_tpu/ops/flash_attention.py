@@ -397,3 +397,57 @@ def _bwd_rule(scale, causal, block_q, block_k, res, do):
 
 
 _flash.defvjp(_fwd_rule, _bwd_rule)
+
+
+# -- lse-exposing variant ---------------------------------------------------
+#
+# Same kernel, but the log-sum-exp rides out as a PRIMAL output.  Under
+# jax.checkpoint, naming (out, lse) via jax.ad_checkpoint.checkpoint_name
+# lets a save_only_these_names policy keep both, so the backward pass
+# reconstructs the layer without re-running the flash forward kernel
+# (models/gpt.py remat_policy="dots_flash").
+
+
+def _named(out, lse):
+    from jax.ad_checkpoint import checkpoint_name
+    return (checkpoint_name(out, "flash_out"),
+            checkpoint_name(lse, "flash_lse"))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k):
+    s = (q.shape[-1] ** -0.5) if scale is None else scale
+    out, lse = _flash_fwd(q, k, v, s, causal, block_q, block_k,
+                          need_lse=True)
+    return _named(out, lse)
+
+
+def _fwd_rule_lse(q, k, v, scale, causal, block_q, block_k):
+    s = (q.shape[-1] ** -0.5) if scale is None else scale
+    out, lse = _flash_fwd(q, k, v, s, causal, block_q, block_k,
+                          need_lse=True)
+    # residuals ARE the named values: a save_only_these_names policy then
+    # keeps exactly what the backward kernel needs, and the recompute
+    # graph dead-code-eliminates the forward kernel call
+    out, lse = _named(out, lse)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _bwd_rule_lse(scale, causal, block_q, block_k, res, g):
+    do, _dlse = g   # lse is an auxiliary output; its cotangent is unused
+    return _bwd_rule(scale, causal, block_q, block_k, res, do)
+
+
+_flash_lse.defvjp(_fwd_rule_lse, _bwd_rule_lse)
+
+
+def flash_attention_with_lse(q, k, v, *, scale: Optional[float] = None,
+                             causal: bool = True, block_q: int = 512,
+                             block_k: int = 512):
+    """Fused attention returning (out, lse); [b, h, s, d] layout.
+
+    lse is a NON-DIFFERENTIABLE auxiliary output (stop_gradient): it
+    exists for checkpoint-policy saves and inference-side diagnostics.
+    A z-loss-style term on lse needs its own differentiable path."""
+    out, lse = _flash_lse(q, k, v, scale, causal, block_q, block_k)
+    return out, jax.lax.stop_gradient(lse)

@@ -121,6 +121,21 @@ def _run(quick: bool) -> list[dict]:
         lambda: ray_tpu.get([a.noop.remote() for _ in range(B)], timeout=120),
         multiplier=B, unit="calls/s", quick=quick))
 
+    # actor creation rate: create a wave, ack with one ping each, kill
+    # (reference: ray_perf.py actor-creation rows; round-5 target after
+    # the fork-server worker pool — see core/prefork.py)
+    W = 4 if quick else 10
+
+    def create_wave():
+        actors = [Actor.remote() for _ in range(W)]
+        ray_tpu.get([x.noop.remote() for x in actors], timeout=120)
+        for x in actors:
+            ray_tpu.kill(x)
+
+    results.append(timeit(
+        "actor_create", create_wave, multiplier=W, unit="actors/s",
+        quick=quick, windows=3))
+
     small = {"k": 1}
     results.append(timeit(
         "put_small", lambda: ray_tpu.put(small), unit="puts/s", quick=quick))
