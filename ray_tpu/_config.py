@@ -107,6 +107,11 @@ _define("node_death_timeout_ms", int, 10_000,
         "via connection drop — this window only catches wedged-but-"
         "connected nodes, so it must ride out worker-pool fork storms "
         "that starve node loops on small hosts.")
+_define("same_host_object_fastpath", bool, True,
+        "Hand objects between same-process nodes (virtual clusters) by "
+        "direct arena copy instead of socket streams — the same-host "
+        "semantics the reference gets from one shared plasma store per "
+        "machine.  Disable to exercise the wire path in tests.")
 _define("object_transfer_chunk_size", int, 4 * 1024 * 1024,
         "Chunk size for node-to-node object transfer (reference: "
         "object_manager.h:117 chunked Push, default 5MiB chunks).")

@@ -1,0 +1,228 @@
+"""Cluster launcher: up/down/attach/exec from a YAML cluster config.
+
+The user-facing entrypoint that turns a config file into a running
+cluster (reference: python/ray/scripts/scripts.py up:1216 down:1292
+attach:1376 exec:1674 over autoscaler/_private/commands.py).  The
+north-star flow: ``ray_tpu up cluster.yaml`` provisions a TPU pod as a
+head plus workers via TpuPodNodeProvider, ``exec`` runs commands over
+ssh, ``down`` tears everything down.
+
+Cluster config schema (the minimal analogue of ray-schema.json):
+
+    cluster_name: demo
+    provider:
+      type: tpu_pod            # or "local" (testing)
+      project: my-project
+      zone: us-central2-b
+      accelerator_type: v5litepod-8
+      runtime_version: v2-alpha-tpuv5-lite
+    min_workers: 0
+    max_workers: 4
+    initial_workers: 1
+    head:
+      port: 6380
+    worker_nodes:              # node_config passed to create_node
+      num_tpus: 4
+
+Cluster state (head node id + address, launched workers) persists in
+``~/.ray_tpu/clusters/<name>.json`` so later commands find the cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from typing import Optional
+
+_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+class ClusterConfigError(ValueError):
+    pass
+
+
+def load_cluster_config(path: str) -> dict:
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    if not isinstance(cfg, dict):
+        raise ClusterConfigError("cluster config must be a mapping")
+    if not cfg.get("cluster_name"):
+        raise ClusterConfigError("cluster_name is required")
+    prov = cfg.get("provider") or {}
+    if prov.get("type") not in ("tpu_pod", "local"):
+        raise ClusterConfigError(
+            "provider.type must be 'tpu_pod' or 'local', got "
+            f"{prov.get('type')!r}")
+    if prov.get("type") == "tpu_pod":
+        for key in ("project", "zone"):
+            if not prov.get(key):
+                raise ClusterConfigError(f"provider.{key} is required "
+                                         "for tpu_pod")
+    mn = int(cfg.get("min_workers", 0))
+    mx = int(cfg.get("max_workers", max(mn, 1)))
+    if mn < 0 or mx < mn:
+        raise ClusterConfigError(
+            f"need 0 <= min_workers <= max_workers, got {mn}..{mx}")
+    cfg["min_workers"], cfg["max_workers"] = mn, mx
+    cfg.setdefault("initial_workers", mn)
+    cfg.setdefault("head", {})
+    cfg.setdefault("worker_nodes", {})
+    return cfg
+
+
+def make_provider(cfg: dict):
+    prov = cfg["provider"]
+    if prov["type"] == "tpu_pod":
+        from ray_tpu.autoscaler.tpu_pod_provider import TpuPodNodeProvider
+        kw = {k: prov[k] for k in ("accelerator_type", "runtime_version",
+                                   "chips_per_host") if k in prov}
+        return TpuPodNodeProvider(
+            project=prov["project"], zone=prov["zone"],
+            name_prefix=prov.get("name_prefix",
+                                 f"ray-tpu-{cfg['cluster_name']}"), **kw)
+    from ray_tpu.autoscaler.node_provider import LocalNodeProvider
+    # a DETERMINISTIC base dir: `down` runs in a fresh process and finds
+    # the nodes `up` started via the provider's pid files
+    base = prov.get("base_dir") or os.path.join(
+        "/tmp/ray_tpu", f"launcher_{cfg['cluster_name']}")
+    return LocalNodeProvider(base_dir=base)
+
+
+# -- cluster state ----------------------------------------------------------
+
+def _state_path(name: str) -> str:
+    return os.path.join(_STATE_DIR, f"{name}.json")
+
+
+def load_state(name: str) -> Optional[dict]:
+    try:
+        with open(_state_path(name)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def save_state(name: str, state: dict) -> None:
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    with open(_state_path(name), "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def drop_state(name: str) -> None:
+    try:
+        os.unlink(_state_path(name))
+    except FileNotFoundError:
+        pass
+
+
+# -- commands ---------------------------------------------------------------
+
+def up(cfg: dict, provider=None, log=print) -> dict:
+    """Provision head + initial workers; idempotent on the head (a
+    second `up` against a live cluster only reconciles workers)."""
+    name = cfg["cluster_name"]
+    provider = provider or make_provider(cfg)
+    state = load_state(name)
+    if state is None:
+        log(f"[{name}] creating head node ...")
+        head_id, head_address = provider.create_head(
+            dict(cfg.get("head") or {}),
+            port=int((cfg.get("head") or {}).get("port", 6380)))
+        state = {"cluster_name": name, "head_id": head_id,
+                 "head_address": head_address, "workers": []}
+        save_state(name, state)
+        log(f"[{name}] head {head_id} at {head_address}")
+    else:
+        log(f"[{name}] head already up at {state['head_address']}")
+    want = max(int(cfg.get("initial_workers", 0)),
+               int(cfg.get("min_workers", 0)))
+    while len(state["workers"]) < want:
+        log(f"[{name}] creating worker "
+            f"{len(state['workers']) + 1}/{want} ...")
+        wid = provider.create_node(state["head_address"],
+                                   dict(cfg.get("worker_nodes") or {}))
+        state["workers"].append(wid)
+        save_state(name, state)
+    log(f"[{name}] up: head + {len(state['workers'])} workers")
+    return state
+
+
+def down(cfg: dict, provider=None, log=print,
+         keep_head: bool = False) -> None:
+    name = cfg["cluster_name"]
+    provider = provider or make_provider(cfg)
+    state = load_state(name)
+    if state is None:
+        log(f"[{name}] no recorded cluster state; checking provider ...")
+        for n in provider.non_terminated_nodes():
+            log(f"[{name}] terminating {n.node_id}")
+            provider.terminate_node(n.node_id)
+        return
+    for wid in list(state["workers"]):
+        log(f"[{name}] terminating worker {wid}")
+        try:
+            provider.terminate_node(wid)
+        except Exception as e:     # keep tearing down the rest
+            log(f"[{name}] WARNING: {wid}: {e}")
+        state["workers"].remove(wid)
+        save_state(name, state)
+    if not keep_head:
+        log(f"[{name}] terminating head {state['head_id']}")
+        try:
+            provider.terminate_node(state["head_id"])
+        finally:
+            drop_state(name)
+    log(f"[{name}] down")
+
+
+def exec_cmd(cfg: dict, command: str, provider=None,
+             all_workers: bool = False, on_head: bool = True) -> str:
+    """Run a shell command on the head (or every worker host)."""
+    name = cfg["cluster_name"]
+    state = load_state(name)
+    if state is None:
+        raise RuntimeError(f"cluster {name!r} is not up (no state)")
+    provider = provider or make_provider(cfg)
+    targets = [state["head_id"]] if on_head else list(state["workers"])
+    out = []
+    for t in targets:
+        out.append(provider.exec_on(t, command, all_workers=all_workers))
+    return "\n".join(out)
+
+
+def attach_argv(cfg: dict, provider=None) -> list[str]:
+    """argv for an interactive shell on the head node."""
+    name = cfg["cluster_name"]
+    state = load_state(name)
+    if state is None:
+        raise RuntimeError(f"cluster {name!r} is not up (no state)")
+    provider = provider or make_provider(cfg)
+    return provider.ssh_command(state["head_id"])
+
+
+def attach(cfg: dict, provider=None) -> int:
+    argv = attach_argv(cfg, provider)
+    return subprocess.call(argv)
+
+
+def submit(cfg: dict, script_path: str, provider=None, log=print) -> str:
+    """Copy a local script to the head and run it there (`ray submit`)."""
+    name = cfg["cluster_name"]
+    state = load_state(name)
+    if state is None:
+        raise RuntimeError(f"cluster {name!r} is not up (no state)")
+    provider = provider or make_provider(cfg)
+    import base64
+    with open(script_path, "rb") as f:
+        body = f.read()
+    remote = f"/tmp/ray_tpu_submit_{os.path.basename(script_path)}"
+    # base64 keeps the upload safe for ARBITRARY script content (a
+    # heredoc delimiter appearing in the body would truncate it and
+    # shell-execute the tail) while staying on one ssh primitive
+    b64 = base64.b64encode(body).decode()
+    provider.exec_on(state["head_id"],
+                     f"echo {b64} | base64 -d > {remote}")
+    log(f"[{name}] running {remote} on head")
+    return provider.exec_on(state["head_id"], f"python {remote}")

@@ -54,7 +54,36 @@ class LocalNodeProvider(NodeProvider):
         self._base = base_dir or os.path.join(
             "/tmp/ray_tpu", f"autoscale_{uuid.uuid4().hex[:8]}")
         os.makedirs(self._base, exist_ok=True)
-        self._n = 0
+        # pid files make nodes findable across provider INSTANCES — the
+        # launcher's `down` runs in a fresh process and must still reap
+        # what `up` started
+        self._n = self._next_index()
+
+    def _next_index(self) -> int:
+        import glob
+        mx = 0
+        for p in glob.glob(os.path.join(self._base, "*.pid")):
+            tail = os.path.basename(p).rsplit("-", 1)[-1][:-4]
+            if tail.isdigit():
+                mx = max(mx, int(tail))
+        return mx
+
+    def _write_pid(self, node_id: str, pid: int) -> None:
+        with open(os.path.join(self._base, f"{node_id}.pid"), "w") as f:
+            f.write(str(pid))
+
+    def _read_pid(self, node_id: str) -> Optional[int]:
+        try:
+            with open(os.path.join(self._base, f"{node_id}.pid")) as f:
+                return int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            return None
+
+    def _drop_pid(self, node_id: str) -> None:
+        try:
+            os.unlink(os.path.join(self._base, f"{node_id}.pid"))
+        except FileNotFoundError:
+            pass
 
     def create_node(self, head_address: str, node_config: dict) -> str:
         self._n += 1
@@ -78,23 +107,108 @@ class LocalNodeProvider(NodeProvider):
         log = open(os.path.join(self._base, f"{node_id}.log"), "ab")
         self._procs[node_id] = subprocess.Popen(
             args, env=env, stdout=log, stderr=log, start_new_session=True)
+        self._write_pid(node_id, self._procs[node_id].pid)
         return node_id
 
+    def create_head(self, node_config: dict, port: int = 0
+                    ) -> tuple[str, str]:
+        """Local head process for the launcher's `local` provider type:
+        spawn a head service, read its address from the ready file."""
+        self._n += 1
+        node_id = f"local-head-{self._n:03d}"
+        addr_file = os.path.join(self._base, f"{node_id}.addr")
+        args = [sys.executable, "-m", "ray_tpu.core.head",
+                "--port", str(port), "--address-file", addr_file]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p]
+            + [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+        log = open(os.path.join(self._base, f"{node_id}.log"), "ab")
+        self._procs[node_id] = subprocess.Popen(
+            args, env=env, stdout=log, stderr=log, start_new_session=True)
+        self._write_pid(node_id, self._procs[node_id].pid)
+        import time as _t
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline:
+            try:
+                with open(addr_file) as f:
+                    addr = f.read().strip()
+                if addr:
+                    return node_id, addr
+            except FileNotFoundError:
+                pass
+            _t.sleep(0.1)
+        raise RuntimeError("local head did not publish its address")
+
+    def exec_on(self, node_id: str, command: str,
+                all_workers: bool = False) -> str:
+        proc = subprocess.run(["sh", "-c", command], capture_output=True,
+                              text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(f"exec failed ({proc.returncode}): "
+                               f"{proc.stderr[-1000:]}")
+        return proc.stdout
+
+    def ssh_command(self, node_id: str) -> list[str]:
+        return ["sh"]   # "attach" to a local cluster is just a shell
+
     def terminate_node(self, node_id: str) -> None:
+        import signal as _signal
+        import time as _t
         p = self._procs.pop(node_id, None)
-        if p is None:
+        if p is not None:
+            p.terminate()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+            self._drop_pid(node_id)
             return
-        p.terminate()
-        try:
-            p.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            p.kill()
+        pid = self._read_pid(node_id)     # started by another process
+        if pid is None:
+            return
+        for sig in (_signal.SIGTERM, _signal.SIGKILL):
+            try:
+                os.kill(pid, sig)
+            except ProcessLookupError:
+                break
+            deadline = _t.monotonic() + 8
+            while _t.monotonic() < deadline:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    break
+                _t.sleep(0.1)
+            else:
+                continue
+            break
+        self._drop_pid(node_id)
 
     def non_terminated_nodes(self) -> list[NodeStatus]:
+        import glob
         out = []
+        seen = set()
         for nid, p in list(self._procs.items()):
+            seen.add(nid)
             if p.poll() is None:
                 out.append(NodeStatus(nid, "running", {"pid": p.pid}))
             else:
                 self._procs.pop(nid, None)
+                self._drop_pid(nid)
+        for path in glob.glob(os.path.join(self._base, "*.pid")):
+            nid = os.path.basename(path)[:-4]
+            if nid in seen:
+                continue
+            pid = self._read_pid(nid)
+            alive = False
+            if pid is not None:
+                try:
+                    os.kill(pid, 0)
+                    alive = True
+                except (ProcessLookupError, PermissionError):
+                    pass
+            if alive:
+                out.append(NodeStatus(nid, "running", {"pid": pid}))
+            else:
+                self._drop_pid(nid)
         return out

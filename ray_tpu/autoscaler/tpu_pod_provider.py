@@ -29,6 +29,11 @@ _BOOTSTRAP = (
     ">> /tmp/ray_tpu_node.log 2>&1 &"
 )
 
+_HEAD_BOOTSTRAP = (
+    "python -m ray_tpu start --head --port {port} "
+    ">> /tmp/ray_tpu_head.log 2>&1 &"
+)
+
 
 def available() -> bool:
     return shutil.which("gcloud") is not None
@@ -133,6 +138,79 @@ class TpuPodNodeProvider(NodeProvider):
             log = "<log unavailable>"
         raise RuntimeError(
             f"node service never came up on {name}; log tail:\n{log}")
+
+    def create_head(self, node_config: dict, port: int = 6380
+                    ) -> tuple[str, str]:
+        """Provision the HEAD node: create a TPU VM, start the head
+        service on worker 0, return (node_id, head_address) — the
+        cluster-launcher entrypoint (reference:
+        autoscaler/_private/commands.py get_or_create_head_node)."""
+        suffix = uuid.uuid4().hex[:8]
+        name = f"{self.name_prefix}-head-{suffix}"
+        self._run("create", name,
+                  f"--accelerator-type="
+                  f"{node_config.get('accelerator_type', self.accelerator_type)}",
+                  f"--version="
+                  f"{node_config.get('runtime_version', self.runtime_version)}")
+        try:
+            self._wait_state(name, "READY", timeout=600.0)
+            self._run("ssh", name, "--worker=0",
+                      f"--command={_HEAD_BOOTSTRAP.format(port=port)}",
+                      timeout=900.0)
+            self._verify_head(name)
+            ip = self._internal_ip(name)
+        except Exception:
+            try:
+                self._run("delete", name)
+            except Exception:
+                pass
+            raise
+        return name, f"{ip}:{port}"
+
+    def _verify_head(self, name: str, attempts: int = 5) -> None:
+        """The bootstrap backgrounds the head service, so ssh exit 0
+        proves nothing — probe the process and surface the log on
+        failure (same discipline as _verify_bootstrap; a dead head
+        address persisted to cluster state strands every worker)."""
+        for _ in range(attempts):
+            try:
+                out = self._run(
+                    "ssh", name, "--worker=0",
+                    "--command=pgrep -f 'ray_tpu start --head' "
+                    ">/dev/null && echo HEAD_ALIVE", timeout=120.0)
+                if "HEAD_ALIVE" in out:
+                    return
+            except RuntimeError:
+                pass
+            time.sleep(self._poll_s)
+        try:
+            log = self._run("ssh", name, "--worker=0",
+                            "--command=tail -n 40 /tmp/ray_tpu_head.log",
+                            timeout=120.0)
+        except RuntimeError:
+            log = "<log unavailable>"
+        raise RuntimeError(
+            f"head service never came up on {name}; log tail:\n{log}")
+
+    def _internal_ip(self, name: str) -> str:
+        raw = self._run("describe", name)
+        eps = (json.loads(raw or "{}") or {}).get("networkEndpoints") or []
+        if not eps or not eps[0].get("ipAddress"):
+            raise RuntimeError(f"TPU VM {name} has no network endpoint")
+        return eps[0]["ipAddress"]
+
+    def exec_on(self, node_id: str, command: str,
+                all_workers: bool = False) -> str:
+        """Run a shell command on a node's host(s) (`ray exec` shape)."""
+        worker = "all" if all_workers else "0"
+        return self._run("ssh", node_id, f"--worker={worker}",
+                         f"--command={command}", timeout=900.0)
+
+    def ssh_command(self, node_id: str) -> list[str]:
+        """argv for an interactive shell on the node (`ray attach`)."""
+        return ["gcloud", "compute", "tpus", "tpu-vm", "ssh", node_id,
+                f"--project={self.project}", f"--zone={self.zone}",
+                "--worker=0"]
 
     def terminate_node(self, node_id: str) -> None:
         self._run("delete", node_id)

@@ -219,14 +219,14 @@ class NodeClient:
         with self._auto_lock:
             self._auto.append(msg)
             n = len(self._auto)
+            if self._auto_thread is None:
+                self._auto_thread = threading.Thread(
+                    target=self._auto_flusher, daemon=True,
+                    name="raytpu-autoflush")
+                self._auto_thread.start()
         if n >= 64:
             self._flush_auto()
             return
-        if self._auto_thread is None:
-            t = threading.Thread(target=self._auto_flusher, daemon=True,
-                                 name="raytpu-autoflush")
-            self._auto_thread = t
-            t.start()
         self._auto_event.set()
 
     def _flush_auto(self) -> None:

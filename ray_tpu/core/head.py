@@ -1010,10 +1010,19 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="ray_tpu head service")
     parser.add_argument("--port", type=int, default=6380)
     parser.add_argument("--session", default=None)
+    parser.add_argument("--address-file", default=None,
+                        help="write the bound address here once "
+                             "listening (cluster-launcher handshake)")
     args = parser.parse_args()
     svc = HeadService(RayTpuConfig(), args.session or uuid.uuid4().hex,
                       port=args.port)
     print(f"ray_tpu head service listening on {svc.address}", flush=True)
+    if args.address_file:
+        tmp = args.address_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(svc.address)
+        import os as _os
+        _os.replace(tmp, args.address_file)
     try:
         svc.run()
     except KeyboardInterrupt:

@@ -51,7 +51,7 @@ from ray_tpu._config import RayTpuConfig
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
 from ray_tpu.core.resources import bundle_total, covers
-from ray_tpu.core.object_store import (NativeObjectStoreCore,
+from ray_tpu.core.object_store import (NativeObjectStoreCore, ObjectExists,
                                        make_object_store_core)
 from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
                                   EventLoopService)
@@ -179,6 +179,33 @@ def _wire_spec(spec: dict) -> dict:
             if not k.startswith("_") and k != "submitter"}
 
 
+def _gil_free_copy(dst, src, size: int) -> None:
+    """memcpy that RELEASES the GIL (ctypes foreign calls drop it):
+    a multi-hundred-MiB memoryview slice-assign holds the GIL and
+    stalls every other event loop thread in the process for its whole
+    duration — broadcast copies serialized behind each other."""
+    import ctypes
+    try:
+        dst_c = (ctypes.c_char * size).from_buffer(dst)
+        src_mv = memoryview(src)
+        if src_mv.readonly:
+            src_c = bytes(src_mv[:size])    # rare: readonly source
+        else:
+            src_c = (ctypes.c_char * size).from_buffer(src_mv)
+        ctypes.memmove(dst_c, src_c, size)
+    except (TypeError, ValueError):
+        dst[:size] = src[:size]
+
+
+# Same-process node registry: virtual clusters (cluster_utils) run many
+# NodeServices as threads of one process.  Object pulls between them can
+# hand the bytes over with one memcpy instead of a socket stream — the
+# same-host semantics the reference gets from one shared plasma store
+# per machine (plasma store.h:55; workers on a host never stream to
+# each other).  Real multi-host peers are never in this registry.
+_LOCAL_NODES_BY_HEX: dict[str, "NodeService"] = {}
+
+
 class NodeService(ClusterStoreMixin, EventLoopService):
     name = "node"
 
@@ -195,6 +222,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self.session = session
         self.session_dir = session_dir
         self.node_id = NodeID.from_random()
+        _LOCAL_NODES_BY_HEX[self.node_id.hex()] = self
         self.stop_on_driver_exit = stop_on_driver_exit
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
 
@@ -281,6 +309,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._pulls: dict[bytes, dict] = {}            # oid bytes -> state
         self._pull_attempts: dict[bytes, int] = {}
         self._out_transfers: dict[tuple, dict] = {}    # (conn_id, oid) -> st
+        self._bcast_tail: dict[bytes, tuple] = {}      # ob -> (hex, addr)
         self._watched: set[bytes] = set()              # locate sent for oid
         self._fwd_tasks: dict[bytes, dict] = {}        # task_id -> fwd info
         self._fwd_by_oid: dict[bytes, bytes] = {}      # return oid -> task_id
@@ -403,6 +432,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 moved += 1
 
     def _cleanup(self) -> None:
+        _LOCAL_NODES_BY_HEX.pop(self.node_id.hex(), None)
         for rec in list(self.clients.values()):
             try:
                 self._push(rec, {"t": "shutdown"})
@@ -783,14 +813,16 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 results.append({"loc": "device_local", "data": info.data,
                                 "is_error": False})
             elif info.loc == "shm":
+                # Pin FIRST, then restore: the pin must already protect
+                # the object when a later restore in this same batch (or
+                # restore's own capacity-balancing pass) evicts — the
+                # reply promises a mapped segment (reference: plasma pins
+                # objects for the duration of a Get).
+                self.store.pin(oid)
+                rec.held_pins.append((oid, time.monotonic()))
                 if self.store.is_spilled(oid):
                     self.store.restore(oid)
                 self.store.touch(oid)
-                # Pin until the client acks mapping (release_pins) so
-                # eviction can't unlink the segment mid-get (reference:
-                # plasma pins objects for the duration of a Get).
-                self.store.pin(oid)
-                rec.held_pins.append((oid, time.monotonic()))
                 results.append({"loc": "shm", "size": info.size,
                                 "is_error": info.is_error})
             else:
@@ -882,6 +914,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     tr.state = "failed" if info.is_error else "finished"
                     tr.finished_at = time.time()
                     self._note_task_finished(tid)
+                    self._release_arg_blob(fw["spec"])
 
     def _resolve_waiters(self, oid: ObjectID, info: ObjInfo) -> None:
         self._object_ready_hook(oid, info)
@@ -988,6 +1021,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         info = self.objects.pop(oid, None)
         self.store.delete(oid)
         ob = oid.binary()
+        self._bcast_tail.pop(ob, None)
         if info is not None and info.owner_node \
                 and info.owner_node[0] == self.node_id.hex():
             self._release_owned(ob)
@@ -1399,6 +1433,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             tr.finished_at = time.time()
             tr.error = m.get("error", "")
             self._note_task_finished(tid)
+            self._release_arg_blob(tr.spec)
             self._record_event(tr.spec, "FAILED" if m.get("error") else "FINISHED")
         if rec.dedicated_actor is not None:
             ar = self.actors.get(rec.dedicated_actor)
@@ -1544,6 +1579,16 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._record_event(spec, "RUNNING", worker=w.conn_id)
         self._push(w, {"t": "execute", "spec": spec})
 
+    def _release_arg_blob(self, spec: dict) -> None:
+        """Oversized (args, kwargs) tuples ride the store as a blob put
+        by the submitter purely to carry them (runtime._prepare_args);
+        no ObjectRef ever wraps the blob, so nothing releases it —
+        reclaim it on TERMINAL task completion (retries still need it)."""
+        b = spec.get("arg_blob")
+        if b:
+            self._released_wait.add(ObjectID(b))
+            self._sweep_released()
+
     def _note_task_finished(self, tid: bytes) -> None:
         """Bound the finished-task history (the live dict stays O(recent),
         dupes are harmless — eviction re-checks state)."""
@@ -1562,6 +1607,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             tr.error = error
             tr.finished_at = time.time()
             self._note_task_finished(spec["task_id"])
+        self._release_arg_blob(spec)
         self._record_event(spec, "FAILED")
         for b in spec["return_ids"]:
             self._seal_error_object(ObjectID(b), RuntimeError(error))
@@ -1634,7 +1680,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _spawn_worker_proc(self) -> None:
         logdir = os.path.join(self.session_dir, "logs")
-        idx = len(self._worker_procs)
+        # monotone counter, NOT len(): pruning dead procs shrinks the
+        # list and len() would hand a live worker's log index to a new
+        # one (interleaved logs, wrong dashboard attribution)
+        self._worker_seq = getattr(self, "_worker_seq", 0) + 1
+        idx = self._worker_seq
         outp = os.path.join(logdir, f"worker-{idx}.out")
         errp = os.path.join(logdir, f"worker-{idx}.err")
         proc = self._fork_worker(outp, errp)
@@ -2531,6 +2581,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     tr.state = "finished"
                     tr.finished_at = time.time()
                     self._note_task_finished(tid)
+                    self._release_arg_blob(fw["spec"])
         if orec.watchers:
             watchers, orec.watchers = orec.watchers, set()
             for whex, waddr in watchers:
@@ -2724,6 +2775,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         info = self.objects.get(oid)
         if info is None or info.state != "pending":
             return
+        if self._try_local_pull(oid, ob, node_hex):
+            return
         # reserve the pull slot BEFORE the async connect so concurrent
         # object_at notifications don't start duplicate transfers
         self._pulls[ob] = {"src": node_hex, "view": None, "size": None,
@@ -2740,7 +2793,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                                 lambda: self._ensure_remote_watch([oid]))
                 return
             try:
-                conn.send({"t": "pull_object", "object_id": ob})
+                conn.send({"t": "pull_object", "object_id": ob,
+                           # after any failed attempt, insist on a direct
+                           # stream — never bounce through a relay again
+                           "no_redirect":
+                               self._pull_attempts.get(ob, 0) > 0})
             except protocol.ConnectionClosed:
                 self._pulls.pop(ob, None)
                 self._watched.discard(ob)
@@ -2749,14 +2806,152 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                                 lambda: self._ensure_remote_watch([oid]))
         self._peer_conn_async(node_hex, address, go)
 
+    # same-process fast path -------------------------------------------------
+
+    def _try_local_pull(self, oid: ObjectID, ob: bytes,
+                        node_hex: str) -> bool:
+        """Peer lives in THIS process (virtual cluster): hand the bytes
+        over with one memcpy.  Thread discipline: the source's loop pins
+        + maps, our loop copies into our arena, the source's loop
+        unpins.  Falls back to the socket path on any miss."""
+        if not self.config.same_host_object_fastpath:
+            return False
+        src = _LOCAL_NODES_BY_HEX.get(node_hex)
+        if src is None or src is self or src._stop.is_set():
+            return False
+        self._pulls[ob] = {"src": node_hex, "view": None, "size": None,
+                           "received": 0, "is_error": False, "local": True}
+
+        def replay_pulls(queued):
+            # socket peers that asked for the object mid-memcpy: serve
+            # them now (object present -> stream; absent -> pull_failed
+            # so they re-route)
+            for cid, pm in queued:
+                peer = self.clients.get(cid)
+                if peer is not None:
+                    self._h_pull_object(peer, pm)
+
+        def fallback():
+            st = self._pulls.get(ob)
+            if st is not None and st.get("local"):
+                self._pulls.pop(ob, None)
+                self._watched.discard(ob)
+                replay_pulls(st.get("replay_pulls", []))
+                self.post_later(0.1,
+                                lambda: self._ensure_remote_watch([oid]))
+
+        def on_src():
+            info = src.objects.get(oid)
+            if (info is None or info.state != "ready"
+                    or info.loc not in ("shm", "inline")):
+                self.post(fallback)
+                return
+            if info.loc == "inline":
+                data, is_err = info.data, info.is_error
+                self.post(lambda: self._local_pull_inline(
+                    oid, ob, data, is_err))
+                return
+            if src.store.is_spilled(oid):
+                src.store.restore(oid)
+            src.store.pin(oid)
+            try:
+                view = src.store._shm.map(oid)
+            except Exception:
+                src.store.unpin(oid)
+                self.post(fallback)
+                return
+            size = src.objects[oid].size
+
+            def on_dst():
+                try:
+                    try:
+                        buf = self.store._shm.create(oid, size)
+                        _gil_free_copy(buf, view, size)
+                        del buf
+                        self.store._shm.seal(oid)
+                    except ObjectExists:
+                        pass
+                    st = self._pulls.pop(ob, None)
+                    if st is None:
+                        return   # resolved another way meanwhile
+                    self.store.register(oid, size)
+                    info2 = self.objects.setdefault(oid, ObjInfo())
+                    info2.state = "ready"
+                    info2.loc = "shm"
+                    info2.size = size
+                    self._resolve_waiters(oid, info2)
+                    replay_pulls(st.get("replay_pulls", []))
+                except Exception:
+                    fallback()
+                finally:
+                    src.post(lambda: src.store.unpin(oid))
+            self.post(on_dst)
+
+        src.post(on_src)
+        # safety net: a wedged source loop must not hang the pull
+        self.post_later(10.0, fallback)
+        return True
+
+    def _local_pull_inline(self, oid: ObjectID, ob: bytes, data,
+                           is_err: bool) -> None:
+        st = self._pulls.pop(ob, None)
+        if st is None:
+            return
+        info = self.objects.setdefault(oid, ObjInfo())
+        if info.state != "pending":
+            return
+        info.state = "error" if is_err else "ready"
+        info.loc = "inline"
+        info.data = data
+        info.size = len(data or b"")
+        info.is_error = is_err
+        self._resolve_waiters(oid, info)
+        for cid, pm in st.get("replay_pulls", []):
+            peer = self.clients.get(cid)
+            if peer is not None:
+                self._h_pull_object(peer, pm)
+
     # sender side -----------------------------------------------------------
 
     def _h_pull_object(self, rec, m):
         """A peer wants an object stored here: inline goes in one frame,
         shm goes in windowed chunks (reference: object_manager.proto:61
-        Push with chunked ObjectChunk stream)."""
+        Push with chunked ObjectChunk stream).
+
+        Broadcast shaping (reference: push_manager.h rate-limited
+        parallel pushes; here a relay CHAIN): if this node is itself
+        still RECEIVING the object, it serves the request as a relay —
+        forwarding chunks as they arrive — and if this node is the
+        source already streaming to someone, later requesters are
+        redirected to the most recent receiver, so an N-node broadcast
+        pipelines through the receivers instead of serializing N full
+        streams at the source."""
         ob = m["object_id"]
         oid = ObjectID(ob)
+        pst = self._pulls.get(ob)
+        if pst is not None:
+            if pst.get("local"):
+                # same-process fast path in flight: chunk relay state
+                # never materializes — replay this request when the
+                # memcpy lands (or fails) instead of parking it forever
+                pst.setdefault("replay_pulls", []).append(
+                    (rec.conn_id, dict(m)))
+                return
+            # mid-pull here: relay chunks to this requester as they land
+            self._relay_register(rec, ob, pst)
+            return
+        if not m.get("no_redirect"):
+            tail = self._bcast_tail.get(ob)
+            if tail is not None and tail[0] != rec.node_hex \
+                    and (rec.conn_id, ob) not in self._out_transfers:
+                active = any(o == ob for (_c, o) in self._out_transfers)
+                if active:
+                    # chain: newest requester fetches from the previous
+                    # one; we keep streaming only the first copy
+                    self._push(rec, {"t": "pull_redirect", "object_id": ob,
+                                     "node": tail[0], "address": tail[1]})
+                    self._note_bcast_tail(ob, rec)
+                    return
         info = self.objects.get(oid)
         if info is not None and info.loc == "device":
             # device-resident: spill to host first, then serve the pull
@@ -2785,34 +2980,116 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._push(rec, {"t": "pull_failed", "object_id": ob,
                              "error": "object vanished mid-pull"})
             return
-        st = {"oid": oid, "view": view, "size": info.size, "next_off": 0}
+        st = {"oid": oid, "view": view, "size": info.size, "next_off": 0,
+              "pinned": True}
         self._out_transfers[(rec.conn_id, ob)] = st
+        self._note_bcast_tail(ob, rec)
         for _ in range(self.config.object_transfer_window):
             if not self._send_next_chunk(rec, st):
                 break
 
+    def _note_bcast_tail(self, ob: bytes, rec: ClientRec) -> None:
+        """Remember the most recent receiver as the chain tail for later
+        requesters (only peers with a known node identity qualify)."""
+        if rec.node_hex and rec.node_hex in self.cluster_view:
+            addr = self.cluster_view[rec.node_hex].get("address")
+            if addr:
+                self._bcast_tail[ob] = (rec.node_hex, addr)
+
     def _send_next_chunk(self, rec: ClientRec, st: dict) -> bool:
         off = st["next_off"]
-        if off >= st["size"]:
+        limit = st["size"] if st.get("available") is None \
+            else min(st["size"], st["available"])
+        if off >= limit or st["view"] is None:
             return False
-        n = min(self.config.object_transfer_chunk_size, st["size"] - off)
-        chunk = bytes(st["view"][off:off + n])
+        n = min(self.config.object_transfer_chunk_size, limit - off)
         st["next_off"] = off + n
-        self._push(rec, {"t": "obj_chunk", "object_id": st["oid"].binary(),
-                         "offset": off, "total_size": st["size"],
-                         "data": chunk})
+        # blob frame: the chunk bytes ride out-of-band of the pickle —
+        # one copy into the socket buffer instead of slice+pickle+buffer
+        self._push_blob(rec, {"t": "obj_chunk",
+                              "object_id": st["oid"].binary(),
+                              "offset": off, "total_size": st["size"]},
+                        st["view"][off:off + n])
         if st["next_off"] >= st["size"]:
             # final chunk queued: release our references now; remaining
             # acks for this transfer are ignored
             st["view"] = None
-            self.store.unpin(st["oid"])
+            if st.get("pinned"):
+                self.store.unpin(st["oid"])
             self._out_transfers.pop((rec.conn_id, st["oid"].binary()), None)
         return True
 
     def _h_obj_chunk_ack(self, rec, m):
         st = self._out_transfers.get((rec.conn_id, m["object_id"]))
         if st is not None:
-            self._send_next_chunk(rec, st)
+            st["outstanding"] = max(0, st.get("outstanding", 1) - 1)
+            if self._send_next_chunk(rec, st):
+                st["outstanding"] = st.get("outstanding", 0) + 1
+
+    # relay (chain broadcast) ------------------------------------------------
+
+    def _relay_register(self, rec, ob: bytes, pst: dict) -> None:
+        """Serve a pull for an object we are still receiving: forward
+        already-received bytes now, the rest as chunks arrive."""
+        oid = ObjectID(ob)
+        if pst.get("size") is None:
+            # no chunk yet: start the relay when the first one lands
+            pst.setdefault("relay_waiting", []).append(rec.conn_id)
+            return
+        st = {"oid": oid, "view": pst["view"], "size": pst["size"],
+              "next_off": 0, "available": pst["received"],
+              "outstanding": 0, "pinned": False, "relay": True}
+        self._out_transfers[(rec.conn_id, ob)] = st
+        pst.setdefault("relay_conns", []).append(rec.conn_id)
+        self._note_bcast_tail(ob, rec)
+        self._relay_advance(rec, st)
+
+    def _relay_advance(self, rec, st: dict) -> None:
+        window = self.config.object_transfer_window
+        while st.get("outstanding", 0) < window:
+            if not self._send_next_chunk(rec, st):
+                break
+            st["outstanding"] = st.get("outstanding", 0) + 1
+
+    def _relay_on_upstream_chunk(self, ob: bytes, pst: dict) -> None:
+        """Upstream bytes advanced: wake pending relays and push more."""
+        for cid in pst.pop("relay_waiting", []):
+            peer = self.clients.get(cid)
+            if peer is not None:
+                self._relay_register(peer, ob, pst)
+        for cid in list(pst.get("relay_conns", [])):
+            st = self._out_transfers.get((cid, ob))
+            peer = self.clients.get(cid)
+            if st is None or peer is None:
+                pst["relay_conns"].remove(cid)
+                continue
+            st["available"] = pst["received"]
+            self._relay_advance(peer, st)
+
+    def _relay_on_pull_done(self, oid: ObjectID, pst: dict) -> None:
+        """Our pull finished and the buffer was sealed: re-map (pinned)
+        for relays that still have bytes to send."""
+        ob = oid.binary()
+        for cid in pst.get("relay_conns", []):
+            st = self._out_transfers.get((cid, ob))
+            if st is None:
+                continue
+            st["available"] = st["size"]
+            try:
+                st["view"] = self.store._shm.map(oid)
+                self.store.pin(oid)
+                st["pinned"] = True
+            except Exception:
+                self._out_transfers.pop((cid, ob), None)
+                peer = self.clients.get(cid)
+                if peer is not None:
+                    self._push(peer, {"t": "pull_failed", "object_id": ob,
+                                      "error": "relay source lost the "
+                                               "object mid-stream"})
+                continue
+            peer = self.clients.get(cid)
+            if peer is not None:
+                self._relay_advance(peer, st)
 
     # receiver side ----------------------------------------------------------
 
@@ -2823,6 +3100,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 self._on_obj_chunk(node_hex, m)
             elif t == "obj_inline":
                 self._on_obj_inline(m)
+            elif t == "pull_redirect":
+                self._on_pull_redirect(m)
             elif t == "pull_failed":
                 self._on_pull_failed(m)
             elif t == "object_at":
@@ -2867,6 +3146,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 conn.send({"t": "obj_chunk_ack", "object_id": ob})
             except protocol.ConnectionClosed:
                 pass
+        if st.get("relay_waiting") or st.get("relay_conns"):
+            # chain broadcast: forward the new bytes downstream
+            self._relay_on_upstream_chunk(ob, st)
         if st["received"] >= st["size"]:
             st["view"] = None   # release buffer before seal/register
             self.store._shm.seal(oid)
@@ -2876,7 +3158,25 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             info.state = "ready"
             info.loc = "shm"
             info.size = st["size"]
+            if st.get("relay_conns"):
+                self._relay_on_pull_done(oid, st)
             self._resolve_waiters(oid, info)
+
+    def _on_pull_redirect(self, m: dict) -> None:
+        """The source is busy broadcasting: fetch from the chain tail it
+        named instead.  Ignored once bytes started flowing; a failed
+        relay fetch falls back through the normal re-watch path (which
+        sets no_redirect, so the source then serves directly)."""
+        ob = m["object_id"]
+        st = self._pulls.get(ob)
+        if st is None or st.get("size") is not None:
+            return
+        self._pulls.pop(ob, None)
+        self._watched.discard(ob)
+        # a redirect counts as an attempt: if the relay fetch fails, the
+        # re-watch retries the source with no_redirect set (direct serve)
+        self._pull_attempts[ob] = self._pull_attempts.get(ob, 0) + 1
+        self._request_pull(ObjectID(ob), m["node"], m["address"])
 
     def _on_obj_inline(self, m: dict) -> None:
         ob = m["object_id"]
@@ -3165,7 +3465,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             st = self._out_transfers.pop(key)
             if st.get("view") is not None:
                 st["view"] = None
-                self.store.unpin(st["oid"])
+                if st.get("pinned", True):
+                    self.store.unpin(st["oid"])
         # fail or retry the running task (reference: worker death →
         # owner retries, task_manager.h:406)
         if rec.current_task is not None:

@@ -183,15 +183,21 @@ class ObjectStoreCore:
         del buf
         self._shm.seal(object_id)
         e.in_shm = True
-        # refresh recency: the end-of-restore eviction pass must not pick
-        # the object we just brought back
         e.last_access = time.monotonic()
         self.used += e.size
         os.unlink(e.spill_path)
         e.spill_path = None
         self.num_restored += 1
         if self.used > self.capacity:
-            self._evict(self.used - self.capacity)
+            # hold a pin across the balancing eviction: recency alone
+            # does NOT protect the object we just restored — when it is
+            # the only unpinned resident, LRU picks it and the caller's
+            # reply would describe an object that is no longer mapped
+            e.pin_count += 1
+            try:
+                self._evict(self.used - self.capacity)
+            finally:
+                e.pin_count -= 1
 
     def delete(self, object_id: ObjectID) -> None:
         e = self.entries.pop(object_id, None)

@@ -103,7 +103,18 @@ def main() -> None:
         pass
     lst.bind(args.socket)
     lst.listen(1)
-    conn, _ = lst.accept()
+    # The node may die (SIGKILL, no cleanup) before ever connecting —
+    # a plain accept() would orphan this template forever.  Poll for
+    # reparenting (our parent IS the node service process).
+    lst.settimeout(1.0)
+    parent = os.getppid()
+    while True:
+        try:
+            conn, _ = lst.accept()
+            break
+        except socket.timeout:
+            if os.getppid() != parent:
+                sys.exit(0)     # orphaned before first connection
     lst.close()
     signal.signal(signal.SIGCHLD, signal.SIG_DFL)
     conn.setblocking(False)

@@ -25,6 +25,12 @@ _HDR = struct.Struct("<Q")
 # coexist on one socket (the reply always matches the request's encoding)
 _TAG_PICKLE = b"\x00"
 _TAG_PROTO = b"\x01"
+# blob frames carry bulk bytes OUT-OF-BAND of the pickle: a small pickled
+# meta dict + the raw payload appended verbatim.  Pickling a multi-MiB
+# chunk costs a full extra copy per hop on both ends — on the object
+# plane that copy dominates transfer CPU.
+_TAG_BLOB = b"\x02"
+_BLOB_META = struct.Struct("<I")
 
 
 def encode_payload(msg: dict, encoding: str = "pickle") -> bytes:
@@ -36,12 +42,30 @@ def encode_payload(msg: dict, encoding: str = "pickle") -> bytes:
     return _TAG_PICKLE + pickle.dumps(msg, protocol=5)
 
 
-def decode_payload(data: bytes) -> dict:
-    tag, body = data[:1], data[1:]
+def decode_payload(data) -> dict:
+    mv = memoryview(data)
+    tag = bytes(mv[:1])
+    if tag == _TAG_BLOB:
+        (meta_len,) = _BLOB_META.unpack_from(mv, 1)
+        msg = pickle.loads(mv[5:5 + meta_len])
+        # zero extra copy: the consumer writes the view straight into
+        # its destination buffer
+        msg["data"] = mv[5 + meta_len:]
+        return msg
     if tag == _TAG_PROTO:
         from ray_tpu.core import schema
-        return schema.decode(body)
-    return pickle.loads(body)
+        return schema.decode(bytes(mv[1:]))
+    return pickle.loads(mv[1:])
+
+
+def blob_frame_parts(meta: dict, data) -> list:
+    """Length-prefixed blob frame as (header+meta, raw-data) parts —
+    callers concatenate/queue without ever pickling `data`."""
+    meta_b = pickle.dumps(meta, protocol=5)
+    total = 1 + _BLOB_META.size + len(meta_b) + len(data)
+    head = b"".join((_HDR.pack(total), _TAG_BLOB,
+                     _BLOB_META.pack(len(meta_b)), meta_b))
+    return [head, data]
 
 
 def payload_encoding(data: bytes) -> str:
@@ -86,6 +110,14 @@ class Connection:
         with self._send_lock:
             try:
                 self.sock.sendall(_HDR.pack(len(data)) + data)
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                raise ConnectionClosed(str(e)) from e
+
+    def send_blob(self, meta: dict, data) -> None:
+        payload = b"".join(blob_frame_parts(meta, data))
+        with self._send_lock:
+            try:
+                self.sock.sendall(payload)
             except (BrokenPipeError, ConnectionResetError, OSError) as e:
                 raise ConnectionClosed(str(e)) from e
 

@@ -280,6 +280,22 @@ class EventLoopService:
         if not rec.wbuf:
             self.sel.modify(rec.sock, selectors.EVENT_READ, rec)
 
+    def _push_blob(self, rec: ClientRec, meta: dict, data) -> None:
+        """Queue a bulk frame without pickling `data` (one copy into the
+        write buffer instead of slice+pickle+buffer)."""
+        if rec.closed:
+            return
+        from ray_tpu.core.protocol import blob_frame_parts
+        for part in blob_frame_parts(meta, data):
+            rec.wbuf += part
+        self._queue_write(rec)
+
+    def _queue_write(self, rec: ClientRec) -> None:
+        if threading.current_thread() is self._thread:
+            self._cork_dirty[rec.conn_id] = rec
+        else:
+            self._write_out(rec)
+
     def _push(self, rec: ClientRec, msg: dict) -> None:
         if rec.closed:
             return

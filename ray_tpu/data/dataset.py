@@ -317,11 +317,19 @@ class Dataset:
     def map_batches(self, fn: Callable[[dict], dict], *,
                     batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
+                    compute: Optional[str] = None,
+                    num_actors: int = 2,
+                    max_tasks_per_actor: int = 2,
                     **_compat) -> "Dataset":
         """fn over batches (reference: dataset.py:364).  batch_format:
         "numpy" hands fn a column dict; "arrow" a pyarrow.Table;
         "pandas" a DataFrame (stages stay format-native — a pandas
-        pipeline never round-trips through numpy)."""
+        pipeline never round-trips through numpy).
+
+        compute="actors" runs this stage on a pool of ``num_actors``
+        long-lived actors in the streaming path (reference:
+        ActorPoolStrategy / actor_pool_map_operator.py — stateful or
+        expensive-setup fns amortize across blocks)."""
         def convert(blk):
             if batch_format == "arrow":
                 return B.to_arrow(blk)
@@ -349,6 +357,12 @@ class Dataset:
                 outs.append(B.normalize(fn(
                     convert(B.slice_block(blk, s, s + batch_size)))))
             return B.concat(outs)
+        if compute == "actors" or getattr(compute, "__class__",
+                                          type(None)).__name__ \
+                == "ActorPoolStrategy":
+            stage._compute = "actors"
+            stage._pool_size = getattr(compute, "size", None) or num_actors
+            stage._max_tasks_per_actor = max_tasks_per_actor
         return self._with_stage(stage)
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
@@ -577,10 +591,12 @@ class Dataset:
         max_in_flight blocks submitted — op-level backpressure
         (reference: streaming_executor.py:31)."""
         if parallelism == "streaming" and self._stages:
-            from ray_tpu.data.streaming import StreamingExecutor
-            yield from StreamingExecutor(
-                self._stages,
-                max_in_flight=max_in_flight).execute(self._resolve_blocks())
+            from ray_tpu.data.execution import (StreamingExecutor,
+                                                build_operator_chain)
+            ops = build_operator_chain(self._stages,
+                                       max_in_flight=max_in_flight)
+            yield from StreamingExecutor(ops).execute(
+                self._resolve_blocks())
             return
         for i, blk in enumerate(self._resolve_blocks()):
             yield _apply_stages(blk, self._stages, i)
@@ -670,10 +686,12 @@ class Dataset:
             np.random.default_rng(shuffle_seed).shuffle(order)
 
         if parallelism == "streaming" and self._stages:
-            from ray_tpu.data.streaming import StreamingExecutor
-            staged_iter = StreamingExecutor(
-                self._stages, max_in_flight=max_in_flight).execute(
-                    (blocks[bi] for bi in order), indices=order)
+            from ray_tpu.data.execution import (StreamingExecutor,
+                                                build_operator_chain)
+            ops = build_operator_chain(self._stages,
+                                       max_in_flight=max_in_flight)
+            staged_iter = StreamingExecutor(ops).execute(
+                (blocks[bi] for bi in order), indices=order)
         else:
             staged_iter = (_apply_stages(blocks[bi], self._stages, bi)
                            for bi in order)
@@ -695,11 +713,16 @@ class Dataset:
 
     def iter_batches_sharded(self, mesh, *, batch_size: int = 256,
                              prefetch: int = 2,
-                             repeat: bool = False) -> Iterator:
+                             repeat: bool = False,
+                             parallelism: str = "inline",
+                             max_in_flight: int = 4) -> Iterator:
         """Device-feeding iterator: each host batch is device_put with the
         mesh's batch sharding (data axes), with a prefetch depth so the
         H2D transfer of batch k+1 overlaps step k (the analogue of
-        iter_torch_batches+pin_memory, TPU-shaped)."""
+        iter_torch_batches+pin_memory, TPU-shaped).
+        parallelism="streaming" runs the stage pipeline through the
+        operator-graph executor (data/execution.py) so cpu map work —
+        including actor-pool stages — overlaps the device feed."""
         import jax
         from ray_tpu.parallel.mesh import batch_sharding
         sh = batch_sharding(mesh)
@@ -707,7 +730,9 @@ class Dataset:
         def host_iter():
             while True:
                 yield from self.iter_batches(batch_size=batch_size,
-                                             drop_last=True)
+                                             drop_last=True,
+                                             parallelism=parallelism,
+                                             max_in_flight=max_in_flight)
                 if not repeat:
                     return
 

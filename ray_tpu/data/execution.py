@@ -1,0 +1,339 @@
+"""Pull-based streaming operator graph for Dataset execution.
+
+The real analogue of the reference's streaming executor
+(reference: python/ray/data/_internal/execution/streaming_executor.py:31,
+operators/map_operator.py, operators/task_pool_map_operator.py,
+operators/actor_pool_map_operator.py): a linear chain of physical
+operators, each with its OWN in-flight budget, connected by bounded
+queues.  The driver-side scheduling loop moves ready outputs downstream,
+dispatches work only into operators with both input and budget, and
+yields final blocks at the consumer's pace — so a slow consumer
+backpressures every operator transitively and the object store never
+holds more than the sum of the per-operator budgets.
+
+Blocks travel between operators as ObjectRefs: a task-pool operator's
+output ref feeds the next operator's task/actor call as a plain argument
+(resolved executor-side), so intermediate blocks never surface to the
+driver.  Refs are dropped as soon as a block leaves its last operator,
+which releases store memory — datasets much larger than the store budget
+stream through it.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Any, Callable, Iterator, Optional
+
+from ray_tpu.data.dataset import _apply_stages, _BlockWorker
+
+
+def _free_now(payload) -> None:
+    """Eagerly release an intermediate block the pipeline just consumed.
+    The tracker's BATCHED release (64 ids / 0.5 s) is tuned for small
+    objects; multi-MiB blocks retained across a batch window blow the
+    bounded-store guarantee, so the executor — sole owner of its
+    intermediates — frees them the moment their consumer completes."""
+    import ray_tpu
+    from ray_tpu.core.object_ref import ObjectRef
+    if isinstance(payload, ObjectRef):
+        try:
+            ray_tpu.free([payload])
+        except Exception:
+            pass
+
+
+class _OrderedOut:
+    """Release completed items in input order (head-of-line buffering —
+    keeps execution deterministic for index-seeded stages and batch
+    carry; the reference's preserve_order option)."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._next = 0
+
+    def put(self, seq: int, item) -> None:
+        heapq.heappush(self._heap, (seq, item))
+
+    def pop_ready(self) -> list:
+        out = []
+        while self._heap and self._heap[0][0] == self._next:
+            out.append(heapq.heappop(self._heap)[1])
+            self._next += 1
+        return out
+
+
+class PhysicalOperator:
+    """One stage of the streaming graph.  Subclasses implement dispatch
+    over the core runtime; the executor only sees queues + budgets."""
+
+    def __init__(self, name: str, max_in_flight: int = 4):
+        self.name = name
+        self.max_in_flight = max(1, max_in_flight)
+        self.outqueue: list = []           # ready (idx, payload) tuples
+        self._ordered = _OrderedOut()
+        self._seq = 0
+        self._inputs_done = False
+        self.stats = {"inputs": 0, "outputs": 0, "submitted": 0,
+                      "peak_in_flight": 0, "wall_s": 0.0}
+        self._t0 = time.perf_counter()
+
+    # -- executor-facing surface
+
+    def can_accept(self) -> bool:
+        """Backpressure: bounded in-flight AND bounded ready-output."""
+        return (self.in_flight() < self.max_in_flight
+                and len(self.outqueue) < self.max_in_flight)
+
+    def add_input(self, idx: int, payload, owned: bool = False) -> None:
+        """owned=True marks a ref PRODUCED by this pipeline (safe to free
+        once consumed); source refs belong to the Dataset and must
+        survive re-iteration."""
+        self.stats["inputs"] += 1
+        self._dispatch(self._seq, idx, payload, owned)
+        self._seq += 1
+        self.stats["submitted"] += 1
+        self.stats["peak_in_flight"] = max(self.stats["peak_in_flight"],
+                                           self.in_flight())
+
+    def inputs_done(self) -> None:
+        self._inputs_done = True
+
+    def has_next(self) -> bool:
+        return bool(self.outqueue)
+
+    def get_next(self):
+        self.stats["outputs"] += 1
+        return self.outqueue.pop(0)
+
+    def completed(self) -> bool:
+        done = (self._inputs_done and self.in_flight() == 0
+                and not self.outqueue)
+        if done:
+            self.stats["wall_s"] = round(time.perf_counter() - self._t0, 3)
+        return done
+
+    def _complete(self, seq: int, idx: int, payload) -> None:
+        self._ordered.put(seq, (idx, payload))
+        self.outqueue.extend(self._ordered.pop_ready())
+
+    # -- subclass surface
+
+    def in_flight(self) -> int:
+        raise NotImplementedError
+
+    def in_flight_refs(self) -> list:
+        raise NotImplementedError
+
+    def poll(self) -> None:
+        """Collect finished work without blocking."""
+        raise NotImplementedError
+
+    def _dispatch(self, seq: int, idx: int, payload, owned: bool) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class TaskMapOperator(PhysicalOperator):
+    """Stage group executed as stateless remote tasks (reference:
+    task_pool_map_operator.py)."""
+
+    def __init__(self, stages: list, max_in_flight: int = 4,
+                 name: str = "map(tasks)"):
+        super().__init__(name, max_in_flight)
+        self._stages = stages
+        self._pending: dict = {}    # ref -> (seq, idx)
+        import ray_tpu
+        self._task = ray_tpu.remote(_apply_stages)
+
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    def in_flight_refs(self) -> list:
+        return list(self._pending)
+
+    def _dispatch(self, seq: int, idx: int, payload, owned: bool) -> None:
+        ref = self._task.remote(payload, self._stages, idx)
+        self._pending[ref] = (seq, idx, payload if owned else None)
+
+    def poll(self) -> None:
+        if not self._pending:
+            return
+        import ray_tpu
+        ready, _ = ray_tpu.wait(list(self._pending),
+                                num_returns=len(self._pending), timeout=0)
+        for ref in ready:
+            seq, idx, consumed = self._pending.pop(ref)
+            _free_now(consumed)
+            # pass the REF downstream: the block stays in the store
+            self._complete(seq, idx, ref)
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    """Stage group executed on a pool of long-lived actors (reference:
+    actor_pool_map_operator.py — stateful/expensive-setup map fns)."""
+
+    def __init__(self, stages: list, pool_size: int = 2,
+                 max_tasks_per_actor: int = 2,
+                 name: str = "map(actors)"):
+        super().__init__(name, pool_size * max_tasks_per_actor)
+        self._stages = stages
+        self._pool_size = max(1, pool_size)
+        self._per_actor = max(1, max_tasks_per_actor)
+        self._actors: list = []
+        self._load: dict = {}       # actor index -> in-flight count
+        self._pending: dict = {}    # ref -> (seq, idx, actor_index)
+
+    def _ensure_pool(self) -> None:
+        if self._actors:
+            return
+        import ray_tpu
+        Worker = ray_tpu.remote(_BlockWorker)
+        self._actors = [Worker.remote(self._stages)
+                        for _ in range(self._pool_size)]
+        self._load = {i: 0 for i in range(self._pool_size)}
+
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    def in_flight_refs(self) -> list:
+        return list(self._pending)
+
+    def _dispatch(self, seq: int, idx: int, payload, owned: bool) -> None:
+        self._ensure_pool()
+        ai = min(self._load, key=self._load.get)
+        ref = self._actors[ai].run.remote(payload, idx)
+        self._load[ai] += 1
+        self._pending[ref] = (seq, idx, ai, payload if owned else None)
+
+    def poll(self) -> None:
+        if not self._pending:
+            return
+        import ray_tpu
+        ready, _ = ray_tpu.wait(list(self._pending),
+                                num_returns=len(self._pending), timeout=0)
+        for ref in ready:
+            seq, idx, ai, consumed = self._pending.pop(ref)
+            self._load[ai] -= 1
+            _free_now(consumed)
+            self._complete(seq, idx, ref)
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+
+class StreamingExecutor:
+    """Drives an operator chain over an input block iterator.
+
+    Pull-based: the consumer's next() powers one scheduling round —
+    move outputs downstream where the next operator has budget, dispatch
+    inputs, yield what reaches the end.  When nothing is ready, block on
+    the union of all operators' in-flight refs (no busy spin)."""
+
+    def __init__(self, operators: list, get_timeout: float = 600.0):
+        assert operators, "need at least one operator"
+        self.operators = operators
+        self.get_timeout = get_timeout
+
+    def stats(self) -> list:
+        return [{"operator": op.name, **op.stats} for op in self.operators]
+
+    def execute(self, blocks, indices=None) -> Iterator:
+        import ray_tpu
+        ops = self.operators
+        it = iter(zip(indices, blocks) if indices is not None
+                  else enumerate(blocks))
+        src_exhausted = False
+        try:
+            while True:
+                progressed = False
+                for op in ops:
+                    op.poll()
+                # move data downstream (last hop first so freed budget
+                # propagates upstream within one round)
+                for i in range(len(ops) - 2, -1, -1):
+                    while ops[i].has_next() and ops[i + 1].can_accept():
+                        idx, payload = ops[i].get_next()
+                        ops[i + 1].add_input(idx, payload, owned=True)
+                        progressed = True
+                    if ops[i].completed() and not ops[i + 1]._inputs_done:
+                        ops[i + 1].inputs_done()
+                        progressed = True
+                # feed the head operator from the (lazy) source
+                while not src_exhausted and ops[0].can_accept():
+                    try:
+                        idx, blk = next(it)
+                    except StopIteration:
+                        src_exhausted = True
+                        ops[0].inputs_done()
+                        break
+                    ops[0].add_input(idx, blk)
+                    progressed = True
+                # drain the tail: yield resolved blocks at consumer pace
+                while ops[-1].has_next():
+                    _idx, payload = ops[-1].get_next()
+                    if isinstance(payload, ray_tpu.ObjectRef):
+                        blk = ray_tpu.get(payload,
+                                          timeout=self.get_timeout)
+                        _free_now(payload)   # eager store release
+                    else:
+                        blk = payload
+                    del payload
+                    yield blk
+                    progressed = True
+                if all(op.completed() for op in ops) and src_exhausted:
+                    return
+                if not progressed:
+                    refs = [r for op in ops for r in op.in_flight_refs()]
+                    if refs:
+                        ray_tpu.wait(refs, num_returns=1, timeout=1.0)
+                    else:
+                        time.sleep(0.005)
+        finally:
+            for op in ops:
+                op.shutdown()
+
+
+def build_operator_chain(stages: list, *, max_in_flight: int = 4
+                         ) -> list:
+    """Compile a fused stage list into physical operators: consecutive
+    stages with the same compute strategy share one operator (stage
+    fusion — reference: _internal/planner fusion of compatible maps).
+    A stage carries its strategy via ``_compute``/``_pool_size`` attrs
+    set by Dataset.map_batches(compute=...)."""
+    ops: list = []
+    group: list = []
+    group_kind: Optional[tuple] = None
+
+    def flush():
+        nonlocal group, group_kind
+        if not group:
+            return
+        kind = group_kind or ("tasks", 0, 0)
+        if kind[0] == "actors":
+            ops.append(ActorPoolMapOperator(
+                group, pool_size=kind[1] or 2,
+                max_tasks_per_actor=kind[2] or 2,
+                name=f"map(actors x{kind[1] or 2})"))
+        else:
+            ops.append(TaskMapOperator(group, max_in_flight=max_in_flight))
+        group, group_kind = [], None
+
+    for st in stages:
+        kind = (getattr(st, "_compute", "tasks"),
+                getattr(st, "_pool_size", 0),
+                getattr(st, "_max_tasks_per_actor", 0))
+        if group_kind is not None and kind != group_kind:
+            flush()
+        group.append(st)
+        group_kind = kind
+    flush()
+    return ops

@@ -312,6 +312,42 @@ def cmd_serve(args) -> int:
     return 2
 
 
+def cmd_up(args) -> int:
+    """`ray_tpu up cluster.yaml` (reference: scripts.py up:1216)."""
+    from ray_tpu.autoscaler.commands import load_cluster_config, up
+    up(load_cluster_config(args.cluster_config))
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler.commands import down, load_cluster_config
+    down(load_cluster_config(args.cluster_config),
+         keep_head=args.keep_head)
+    return 0
+
+
+def cmd_attach(args) -> int:
+    from ray_tpu.autoscaler.commands import attach, load_cluster_config
+    return attach(load_cluster_config(args.cluster_config))
+
+
+def cmd_exec(args) -> int:
+    from ray_tpu.autoscaler.commands import exec_cmd, load_cluster_config
+    out = exec_cmd(load_cluster_config(args.cluster_config),
+                   " ".join(args.command),
+                   on_head=not args.workers,
+                   all_workers=args.all_hosts)
+    print(out, end="" if out.endswith("\n") else "\n")
+    return 0
+
+
+def cmd_submit(args) -> int:
+    from ray_tpu.autoscaler.commands import load_cluster_config, submit
+    out = submit(load_cluster_config(args.cluster_config), args.script)
+    print(out, end="" if out.endswith("\n") else "\n")
+    return 0
+
+
 def _load_yaml_or_json(text: str) -> dict:
     import json as _json
     try:
@@ -344,6 +380,35 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("stop", help="kill local ray_tpu processes")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML config "
+                                  "(reference: `ray up`)")
+    p.add_argument("cluster_config")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear a launched cluster down")
+    p.add_argument("cluster_config")
+    p.add_argument("--keep-head", action="store_true")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("attach", help="interactive shell on the head")
+    p.add_argument("cluster_config")
+    p.set_defaults(fn=cmd_attach)
+
+    p = sub.add_parser("exec", help="run a shell command on the cluster")
+    p.add_argument("cluster_config")
+    p.add_argument("--workers", action="store_true",
+                   help="run on worker nodes instead of the head")
+    p.add_argument("--all-hosts", action="store_true",
+                   help="every host of a multi-host slice")
+    p.add_argument("command", nargs="+")
+    p.set_defaults(fn=cmd_exec)
+
+    p = sub.add_parser("submit", help="copy a script to the head and "
+                                      "run it (reference: `ray submit`)")
+    p.add_argument("cluster_config")
+    p.add_argument("script")
+    p.set_defaults(fn=cmd_submit)
 
     for name, fn in (("status", cmd_status), ("memory", cmd_memory)):
         p = sub.add_parser(name)

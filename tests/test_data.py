@@ -425,16 +425,19 @@ def test_map_batches_arrow_format():
 def test_streaming_executor_backpressure(rt_init):
     import numpy as np
     from ray_tpu.data import Dataset
-    from ray_tpu.data.streaming import StreamingExecutor
+    from ray_tpu.data.execution import (StreamingExecutor,
+                                        build_operator_chain)
 
     ds = Dataset.from_numpy({"x": np.arange(64.0)}, parallelism=8)
     ds2 = ds.map_batches(lambda b: {"x": b["x"] * 3})
-    ex = StreamingExecutor(ds2._stages, max_in_flight=2)
+    ops = build_operator_chain(ds2._stages, max_in_flight=2)
+    ex = StreamingExecutor(ops)
     out = list(ex.execute(ds2._resolve_blocks()))
     assert sum(b["x"].sum() for b in out) == 3 * np.arange(64.0).sum()
-    assert ex.stats["blocks"] == 8
+    stats = ex.stats()
+    assert stats[0]["outputs"] == 8
     # backpressure: never more than max_in_flight submitted at once
-    assert ex.stats["max_in_flight_observed"] <= 2
+    assert stats[0]["peak_in_flight"] <= 2
 
 
 def test_iter_batches_streaming_matches_inline(rt_init):
