@@ -42,6 +42,10 @@ from ray_tpu.rllib.offline import (JsonReader, JsonWriter,
 from ray_tpu.rllib.policy import (JaxPolicy, PolicyConfig, compute_gae,
                                   init_policy_params, policy_forward)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, ppo_loss
+from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
+from ray_tpu.rllib.mbmpo import MBMPO, MBMPOConfig
+from ray_tpu.rllib.alpha_star import (AlphaStar, AlphaStarConfig, League,
+                                      Player, rps_payoff)
 from ray_tpu.rllib.replay_buffer import (MinSegmentTree,
                                          PrioritizedReplayBuffer,
                                          ReplayBuffer,
@@ -60,7 +64,9 @@ __all__ = [
     "Impala", "ImpalaConfig", "vtrace", "JsonReader", "JsonWriter",
     "importance_sampling_estimate", "JaxPolicy", "PolicyConfig",
     "compute_gae", "init_policy_params", "policy_forward",
-    "PPO", "PPOConfig", "ppo_loss", "MinSegmentTree",
+    "PPO", "PPOConfig", "ppo_loss", "DDPPO", "DDPPOConfig",
+    "MBMPO", "MBMPOConfig", "AlphaStar", "AlphaStarConfig", "League",
+    "Player", "rps_payoff", "MinSegmentTree",
     "PrioritizedReplayBuffer", "ReplayBuffer", "ReservoirReplayBuffer",
     "SumSegmentTree", "RolloutWorker", "SAC", "SACConfig", "SampleBatch",
     "APPO", "APPOConfig", "MultiAgentEnv", "MultiAgentCartPole",

@@ -1,0 +1,224 @@
+"""DD-PPO: decentralized distributed PPO — workers learn locally and
+allreduce gradients among themselves; there is no central learner.
+
+Reference capability: rllib/algorithms/ddppo/ddppo.py:91,131-152 —
+rollout workers each run SGD on their own samples and average gradients
+through a torch process group created among the workers
+(torch_distributed_backend="gloo"), with the driver only coordinating
+and aggregating metrics.
+
+TPU redesign: the gradient plane is the framework's own host-plane
+collective group (parallel/collectives.py CollectiveGroup — epoch-
+aligned named-actor rendezvous) instead of an out-of-band gloo ring, so
+the learner gang needs nothing but the core runtime.  Each worker's
+per-minibatch gradient step is a jitted program; ranks stay in lockstep
+because they start from identical params (shared seed) and apply the
+same averaged gradients.  On TPU pods the same shape maps onto one
+jitted step with psum over the dp axis (learner-less gangs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.ppo import PPOConfig, ppo_loss
+
+
+@dataclass
+class DDPPOConfig(PPOConfig):
+    # reference defaults (ddppo.py:91): sgd on workers, small per-worker
+    # batches; train_batch_size is PER WORKER here
+    num_rollout_workers: int = 2
+
+    def build(self, algo_cls=None) -> "DDPPO":
+        return DDPPO({"_config": self})
+
+
+class _DDPPOWorker:
+    """One decentralized worker: rollouts + local SGD + gradient
+    allreduce (the reference's rollout-worker-with-learner shape)."""
+
+    def __init__(self, cfg: DDPPOConfig, rank: int, world: int,
+                 group: str):
+        import jax
+        import optax
+
+        from ray_tpu.parallel.collectives import CollectiveGroup
+        from ray_tpu.rllib import sample_batch as SB
+        from ray_tpu.rllib.policy import (PolicyConfig, init_policy_params,
+                                          policy_forward)
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        self.cfg = cfg
+        self.rank, self.world = rank, world
+        self.worker = RolloutWorker(
+            cfg.env, seed=cfg.seed + 1000 * rank,
+            num_envs=cfg.num_envs_per_worker,
+            rollout_length=cfg.rollout_length,
+            gamma=cfg.gamma, lam=cfg.lam, hiddens=cfg.hiddens)
+        pcfg = PolicyConfig(obs_dim=self.worker.cfg.obs_dim,
+                            num_actions=self.worker.cfg.num_actions,
+                            hiddens=tuple(cfg.hiddens))
+        # SAME seed on every rank: identical initial params, and the
+        # averaged gradients keep them in lockstep forever
+        self.params = init_policy_params(pcfg, jax.random.PRNGKey(cfg.seed))
+        self.tx = optax.adam(cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.group = CollectiveGroup(group, world, rank)
+        self._rng = np.random.RandomState(cfg.seed + 31 * rank)
+
+        loss_fn = partial(ppo_loss, clip=cfg.clip_param,
+                          vf_clip=cfg.vf_clip_param,
+                          vf_coeff=cfg.vf_loss_coeff,
+                          ent_coeff=cfg.entropy_coeff)
+
+        @jax.jit
+        def grad_step(params, mb):
+            (l, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            return grads, {**aux, "total_loss": l}
+
+        @jax.jit
+        def apply_step(params, opt_state, grads):
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._grad_step = grad_step
+        self._apply_step = apply_step
+        self._SB = SB
+        self._jax = jax
+        self.worker.set_weights(jax.tree.map(np.asarray, self.params))
+
+    def _allreduce_grads(self, grads):
+        """ONE rendezvous exchange per minibatch: flatten the pytree to
+        a single vector (reference: a single gloo allreduce over the
+        bucketed grads, ddppo.py:131-152)."""
+        jax = self._jax
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        flat = np.concatenate([np.asarray(g).ravel() for g in leaves])
+        avg = self.group.allreduce(flat, op="mean")
+        out, off = [], 0
+        for g in leaves:
+            n = int(np.prod(g.shape))
+            out.append(avg[off:off + n].reshape(g.shape))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def train_once(self) -> dict:
+        import jax.numpy as jnp
+        SB = self._SB
+        cfg = self.cfg
+        batches, steps = [], 0
+        from ray_tpu.rllib.sample_batch import SampleBatch
+        while steps < cfg.train_batch_size:
+            b = SampleBatch(self.worker.sample())
+            batches.append(b)
+            steps += b.count
+        batch = SampleBatch.concat_samples(batches)
+
+        jb = {k: np.asarray(v) for k, v in batch.items()
+              if k in (SB.OBS, SB.ACTIONS, SB.LOGP, SB.ADVANTAGES,
+                       SB.VALUE_TARGETS, SB.VF_PREDS)}
+        adv = jb[SB.ADVANTAGES]
+        jb[SB.ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        n = jb[SB.OBS].shape[0]
+        mb = min(cfg.minibatch_size, n)
+        num_mb = max(1, n // mb)
+        metrics = []
+        for _ in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            shuf = {k: v[perm] for k, v in jb.items()}
+            for i in range(num_mb):
+                sl = {k: jnp.asarray(v[i * mb:(i + 1) * mb])
+                      for k, v in shuf.items()}
+                grads, aux = self._grad_step(self.params, sl)
+                grads = self._allreduce_grads(grads)
+                self.params, self.opt_state = self._apply_step(
+                    self.params, self.opt_state, grads)
+                metrics.append({k: float(v) for k, v in aux.items()})
+        self.worker.set_weights(
+            self._jax.tree.map(np.asarray, self.params))
+        out = {k: float(np.mean([m[k] for m in metrics]))
+               for k in metrics[0]}
+        out["count"] = batch.count
+        out["episode_returns"] = self.worker.episode_returns()
+        return out
+
+    def get_weights(self):
+        return self._jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax.numpy as jnp
+        self.params = self._jax.tree.map(jnp.asarray, weights)
+        self.opt_state = self.tx.init(self.params)
+        self.worker.set_weights(self._jax.tree.map(np.asarray, self.params))
+
+
+class DDPPO(Algorithm):
+    _default_config = DDPPOConfig
+
+    def _build(self):
+        import uuid
+
+        import ray_tpu
+        from ray_tpu.parallel.collectives import create_collective_group
+
+        cfg = self.config
+        if not ray_tpu.is_initialized():
+            raise RuntimeError(
+                "DD-PPO is decentralized by definition (reference "
+                "ddppo.py:91): it needs the core runtime for its worker "
+                "gang — call ray_tpu.init() first")
+        world = max(2, cfg.num_rollout_workers)
+        self._group_name = f"ddppo-{uuid.uuid4().hex[:8]}"
+        create_collective_group(self._group_name, world)
+        Worker = ray_tpu.remote(_DDPPOWorker)
+        self.workers = [Worker.remote(cfg, rank, world, self._group_name)
+                        for rank in range(world)]
+        # fail fast if a worker died during construction
+        ray_tpu.get([w.get_weights.remote() for w in self.workers],
+                    timeout=600)
+
+    def training_step(self) -> dict:
+        import ray_tpu
+        results = ray_tpu.get(
+            [w.train_once.remote() for w in self.workers], timeout=1200)
+        for r in results:
+            self._ep_returns.extend(r.pop("episode_returns", []))
+        steps = sum(r.pop("count") for r in results)
+        self._timesteps += steps
+        out = {k: float(np.mean([r[k] for r in results]))
+               for k in results[0]}
+        out["steps_this_iter"] = steps
+        return out
+
+    def save_checkpoint(self) -> dict:
+        import ray_tpu
+        return {"params": ray_tpu.get(self.workers[0].get_weights.remote(),
+                                      timeout=600),
+                "timesteps": self._timesteps}
+
+    def load_checkpoint(self, ck):
+        import ray_tpu
+        ray_tpu.get([w.set_weights.remote(ck["params"])
+                     for w in self.workers], timeout=600)
+        self._timesteps = ck.get("timesteps", 0)
+
+    def cleanup(self):
+        import ray_tpu
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            # the per-instance rendezvous actor would otherwise outlive us
+            ray_tpu.kill(
+                ray_tpu.get_actor(f"rt_collective::{self._group_name}"))
+        except Exception:
+            pass

@@ -1,0 +1,178 @@
+"""The distributed-training tail of the RLlib family (VERDICT r4 #7):
+
+  * DD-PPO — workers learn locally + allreduce gradients among
+    themselves over the host collective plane (reference:
+    rllib/algorithms/ddppo/ddppo.py:91,131-152)
+  * MB-MPO — dynamics-ensemble + MAML adaptation through imagined
+    rollouts (reference: rllib/algorithms/mbmpo/mbmpo.py:481)
+  * AlphaStar league — roles, payoff matrix, PFSP matchmaking,
+    snapshots (reference: alpha_star/alpha_star.py:247,
+    league_builder.py)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_ddppo_requires_runtime():
+    from ray_tpu.rllib import DDPPOConfig
+    assert not ray_tpu.is_initialized()
+    with pytest.raises(RuntimeError, match="decentralized"):
+        DDPPOConfig(env="CartPole-v1").build()
+
+
+def test_ddppo_learns_cartpole_decentralized(rt):
+    from ray_tpu.rllib import DDPPOConfig
+
+    algo = DDPPOConfig(env="CartPole-v1", num_rollout_workers=2,
+                       num_envs_per_worker=4, rollout_length=64,
+                       train_batch_size=512, minibatch_size=128,
+                       num_epochs=2, lr=5e-3, seed=0).build()
+    try:
+        best = 0.0
+        for _ in range(25):
+            r = algo.train()
+            best = max(best, r.get("episode_reward_mean", 0.0))
+            if best > 90:
+                break
+        # random CartPole sits near 20
+        assert best > 90, f"DD-PPO failed to learn: best {best}"
+    finally:
+        algo.cleanup()
+
+
+def test_ddppo_ranks_stay_in_lockstep(rt):
+    """Decentralization invariant: identical init + averaged gradients
+    keep every rank's params byte-equal — no central weight sync."""
+    from ray_tpu.rllib import DDPPOConfig
+
+    algo = DDPPOConfig(env="CartPole-v1", num_rollout_workers=2,
+                       num_envs_per_worker=2, rollout_length=32,
+                       train_batch_size=128, minibatch_size=64,
+                       num_epochs=1, seed=3).build()
+    try:
+        algo.train()
+        w0, w1 = ray_tpu.get(
+            [w.get_weights.remote() for w in algo.workers], timeout=600)
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(w0),
+                        jax.tree_util.tree_leaves(w1)):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        algo.cleanup()
+
+
+def test_mbmpo_model_based_improvement():
+    from ray_tpu.rllib import MBMPOConfig
+
+    algo = MBMPOConfig(env="CartPole-v1", num_rollout_workers=0,
+                       num_envs_per_worker=8, rollout_length=64,
+                       real_batch_size=1024, ensemble_size=3,
+                       model_epochs=60, meta_steps=6, inner_lr=0.1,
+                       lr=8e-3, seed=0).build()
+    try:
+        first_model_loss = None
+        best = 0.0
+        for _ in range(20):
+            r = algo.train()
+            if first_model_loss is None:
+                first_model_loss = r["model_loss_mean"]
+            best = max(best, r.get("episode_reward_mean", 0.0))
+            if best > 48:
+                break
+        # the learned dynamics get sharper AND the meta-updated policy
+        # improves on the REAL env (random CartPole sits near 20)
+        assert r["model_loss_mean"] < first_model_loss
+        assert best > 48, f"MB-MPO no improvement: best {best}"
+    finally:
+        algo.cleanup()
+
+
+def test_mbmpo_checkpoint_roundtrip():
+    from ray_tpu.rllib import MBMPOConfig
+
+    algo = MBMPOConfig(env="CartPole-v1", num_envs_per_worker=4,
+                       rollout_length=32, real_batch_size=128,
+                       ensemble_size=2, model_epochs=5, meta_steps=2,
+                       seed=1).build()
+    try:
+        algo.train()
+        ck = algo.save_checkpoint()
+        algo2 = MBMPOConfig(env="CartPole-v1", num_envs_per_worker=4,
+                            rollout_length=32, real_batch_size=128,
+                            ensemble_size=2, model_epochs=5,
+                            meta_steps=2, seed=2).build()
+        algo2.load_checkpoint(ck)
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(algo.params),
+                        jax.tree_util.tree_leaves(algo2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        algo2.cleanup()
+    finally:
+        algo.cleanup()
+
+
+# -- AlphaStar league -------------------------------------------------------
+
+def test_league_pfsp_prioritizes_hard_opponents():
+    from ray_tpu.rllib import League, Player
+
+    lg = League()
+    for pid in ("main", "easy", "hard"):
+        lg.add(Player(pid, "main", np.zeros(3, np.float32),
+                      frozen=(pid != "main")))
+    for _ in range(20):                # converge the payoff EMA
+        lg.record("main", "easy", 1.0)     # main beats easy
+        lg.record("main", "hard", -1.0)    # main loses to hard
+    w = dict(zip(["easy", "hard"],
+                 lg.pfsp_weights("main", ["easy", "hard"])))
+    assert w["hard"] > 2 * w["easy"]
+
+
+def test_league_snapshot_freezes_and_inherits_payoffs():
+    from ray_tpu.rllib import League, Player
+
+    lg = League()
+    lg.add(Player("main", "main", np.array([1., 0., 0.], np.float32)))
+    lg.add(Player("x", "league_exploiter", np.zeros(3, np.float32)))
+    lg.record("main", "x", 0.5)
+    sid = lg.snapshot("main")
+    snap = lg.players[sid]
+    assert snap.frozen and snap.parent == "main"
+    assert lg.payoff[(sid, "x")] == lg.payoff[("main", "x")]
+    # mutating main must not touch the snapshot
+    lg.players["main"].logits[0] = -9.0
+    assert snap.logits[0] == 1.0
+
+
+def test_alpha_star_league_approaches_nash():
+    """On RPS the league's main-agent mixture must approach the Nash
+    strategy: mixture exploitability small and the main exploiter
+    unable to hold an edge (reference evidence shape: AlphaStar's
+    league payoff table / exploiter win-rates)."""
+    import jax
+
+    from ray_tpu.rllib import AlphaStarConfig
+
+    algo = AlphaStarConfig(seed=0, snapshot_every=5,
+                           entropy_coeff=0.05, league_lr=0.3).build()
+    for _ in range(100):
+        r = algo.train()
+    assert r["league_exploitability"] < 0.25, r
+    assert abs(r.get("mexp0_vs_main", 1.0)) < 0.25, r
+    assert r["league_size"] > 10          # snapshots accumulated
+
+    # checkpoint roundtrip preserves the league
+    ck = algo.save_checkpoint()
+    algo2 = AlphaStarConfig(seed=9).build()
+    algo2.load_checkpoint(ck)
+    assert set(algo2.league.players) == set(algo.league.players)
