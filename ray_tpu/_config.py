@@ -46,6 +46,10 @@ _define("task_rpc_inlined_bytes_limit", int, 10 * 1024 * 1024,
         "(reference: ray_config_def.h:496).")
 _define("object_store_memory", int, 2 * 1024 * 1024 * 1024,
         "Bytes of shared memory reserved for the node object store.")
+_define("object_spilling_uri", str, "",
+        "Spill target: '' = session spill dir, file:///path, or "
+        "s3://bucket/prefix (reference: external_storage.py smart_open "
+        "URI backend; s3 needs boto3).")
 _define("object_spilling_dir", str, "",
         "Directory for spilled objects; empty = <session dir>/spill.")
 _define("object_store_full_delay_ms", int, 10,

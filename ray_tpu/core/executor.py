@@ -40,10 +40,36 @@ def make_message_queue() -> "queue.SimpleQueue":
     return queue.SimpleQueue()
 
 
-def queue_push_handler(q: "queue.SimpleQueue"):
+def queue_push_handler(q: "queue.SimpleQueue",
+                       client_cell: Optional[dict] = None):
+    """Route pushes into the executor inbox.  With ``client_cell``
+    (filled with {"client": NodeClient} after connect), "profile"
+    requests are served straight off the RECEIVE thread — a worker
+    busy inside a long task is exactly the one worth profiling, and
+    its inbox won't drain until the task ends."""
     def push(msg: dict) -> None:
+        if (msg.get("t") == "profile" and client_cell
+                and client_cell.get("client") is not None):
+            _serve_profile(client_cell["client"], msg)
+            return
         q.put(msg)
     return push
+
+
+def _serve_profile(client, msg: dict) -> None:
+    def run():
+        from ray_tpu.util.profiling import sample_folded
+        try:
+            folded = sample_folded(
+                duration=float(msg.get("duration", 2.0)),
+                hz=float(msg.get("hz", 99.0)))
+            client.send({"t": "profile_result",
+                         "prof_id": msg["prof_id"], "folded": folded})
+        except Exception as e:
+            client.send({"t": "profile_result",
+                         "prof_id": msg["prof_id"], "error": str(e)})
+    threading.Thread(target=run, daemon=True,
+                     name="raytpu-sampler").start()
 
 
 class _ActorAsyncState:
@@ -139,6 +165,11 @@ class Executor:
                     self.execute_actor_task(msg["spec"])
             elif t == "create_actor_exec":
                 self.create_actor(msg["spec"])
+            elif t == "profile":
+                # normally served on the receive thread
+                # (queue_push_handler); kept here for executors fed by
+                # other transports
+                _serve_profile(self.client, msg)
             elif t == "destroy_actor":
                 with self._actor_lock:
                     aid = msg["actor_id"]

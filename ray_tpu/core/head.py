@@ -86,6 +86,10 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
         self.nodes: dict[str, NodeRec] = {}
         self._node_by_conn: dict[int, str] = {}
+        # tie-break randomization for the hybrid policy (seeded: test
+        # runs stay reproducible per head instance)
+        import random as _random
+        self._sched_rng = _random.Random(0xC0FFEE)
         self.actors: dict[bytes, ActorDir] = {}
         self.named_actors: dict[tuple[str, str], bytes] = {}
         self._init_stores()   # kv / pubsub / function store (mixin)
@@ -301,23 +305,35 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
     def _choose_node(self, demand: dict,
                      prefer: Optional[str] = None,
-                     spread_by_actor_count: bool = False) -> Optional[str]:
-        """Pick a node whose TOTAL covers the demand; rank: available
-        covers now > preferred > most spare capacity (a compact version of
-        the reference hybrid policy, hybrid_scheduling_policy.h).
+                     spread_by_actor_count: bool = False,
+                     arg_ids: tuple = ()) -> Optional[str]:
+        """The hybrid scheduling policy (reference:
+        raylet/scheduling/policy/hybrid_scheduling_policy.cc +
+        locality-aware lease targeting, core_worker/lease_policy.h:56).
 
-        ``spread_by_actor_count`` ranks fewest-hosted-actors above the
-        preference tiebreak — the actor placement policy (reference: the
-        GCS actor scheduler spreads).  Zero-resource actors make the
-        plain ranking useless: every node 'fits', so the preferred node
-        would win every tie and pile actors onto one worker pool until
-        it hits max_workers and creation wedges silently."""
+        Ranking, most significant first:
+          1. AVAILABLE (demand fits the node's free resources now)
+             strictly above merely FEASIBLE (total covers, busy now).
+          2. fewest hosted actors when ``spread_by_actor_count`` (the
+             GCS actor scheduler's spread; zero-resource actors make
+             resource ranking useless and would pile onto one pool).
+          3. critical-resource utilization, TRUNCATED below
+             ``scheduler_spread_threshold``: lightly-loaded nodes tie
+             instead of packing onto the single emptiest node.
+          4. locality: nodes already holding more of the task's args
+             (the head's object-location view) save transfer bytes.
+          5. the submitting node (no forward hop).
+        Exact ties resolve by RANDOM choice — the truncation makes all
+        lightly-loaded nodes tie, so this is the reference's top-k
+        randomization: racing submitters decorrelate instead of all
+        stampeding the deterministic argmax."""
         counts: dict[str, int] = {}
         if spread_by_actor_count:
             for ad in self.actors.values():
                 if ad.state != "dead":
                     counts[ad.node_hex] = counts.get(ad.node_hex, 0) + 1
-        best, best_key = None, None
+        thr = self.config.scheduler_spread_threshold
+        best_key, pool = None, []
         for h, n in self.nodes.items():
             if not n.alive:
                 continue
@@ -326,11 +342,23 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                 continue
             fits_now = all(n.available.get(k, 0.0) + 1e-9 >= v
                            for k, v in demand.items())
-            spare = sum(n.available.get(k, 0.0) for k in ("CPU", "TPU"))
-            key = (fits_now, -counts.get(h, 0), h == prefer, spare)
+            util = 0.0
+            for k, tot in n.total.items():
+                if tot > 0:
+                    used = tot - n.available.get(k, 0.0)
+                    util = max(util, used / tot)
+            util_rank = 0.0 if util < thr else util
+            locality = sum(1 for ob in arg_ids
+                           if h in self.object_locs.get(ob, ()))
+            key = (fits_now, -counts.get(h, 0), -util_rank, locality,
+                   h == prefer)
             if best_key is None or key > best_key:
-                best, best_key = h, key
-        return best
+                best_key, pool = key, [h]
+            elif key == best_key:
+                pool.append(h)
+        if not pool:
+            return None
+        return pool[self._sched_rng.randrange(len(pool))]
 
     def _choose_actor_node(self, demand: dict,
                            prefer: Optional[str] = None) -> Optional[str]:
@@ -531,8 +559,9 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                 return
             target = pgd.assignment[pg[1]]
         else:
-            target = self._choose_node(self._demand(spec),
-                                       prefer=rec.node_hex)
+            target = self._choose_node(
+                self._demand(spec), prefer=rec.node_hex,
+                arg_ids=tuple(spec.get("arg_ids") or ()))
         if target is None:
             self._reply(rec, m["reqid"],
                         error="Infeasible resource demand "

@@ -248,7 +248,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         spill_dir = config.object_spilling_dir or os.path.join(session_dir, "spill")
         self.store = make_object_store_core(session,
                                             config.object_store_memory,
-                                            spill_dir)
+                                            spill_dir,
+                                            spill_uri=config.object_spilling_uri)
 
         self.objects: dict[ObjectID, ObjInfo] = {}
         self.tasks: dict[bytes, TaskRec] = {}
@@ -3379,6 +3380,51 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                         data=data.decode("utf-8", "replace"), size=size)
         except OSError as e:
             self._reply(rec, m["reqid"], error=str(e))
+
+    def _h_profile_worker(self, rec, m):
+        """Sampling-profile a live worker (reference: dashboard
+        profile_manager.py py-spy wrapper): route the request to the
+        worker's executor, which samples its own interpreter and pushes
+        folded stacks back."""
+        pid = int(m["pid"])
+        target = next((c for c in self.clients.values()
+                       if c.kind in ("worker", "tpu_executor")
+                       and c.pid == pid), None)
+        if target is None:
+            self._reply(rec, m["reqid"],
+                        error=f"no live worker with pid {pid}")
+            return
+        self._profile_seq = getattr(self, "_profile_seq", 0) + 1
+        prof_id = self._profile_seq
+        self._profile_pending = getattr(self, "_profile_pending", {})
+        self._profile_pending[prof_id] = (rec.conn_id, m["reqid"])
+        duration = float(m.get("duration", 2.0))
+        self._push(target, {"t": "profile", "prof_id": prof_id,
+                            "duration": duration,
+                            "hz": float(m.get("hz", 99.0))})
+
+        def expire():
+            pend = self._profile_pending.pop(prof_id, None)
+            if pend is not None:
+                w = self.clients.get(pend[0])
+                if w is not None:
+                    self._reply(w, pend[1],
+                                error="profile timed out (worker busy "
+                                      "outside its message loop?)")
+        self.post_later(duration + 30.0, expire)
+
+    def _h_profile_result(self, rec, m):
+        pend = getattr(self, "_profile_pending", {}).pop(
+            m.get("prof_id"), None)
+        if pend is None:
+            return
+        w = self.clients.get(pend[0])
+        if w is None:
+            return
+        if m.get("error"):
+            self._reply(w, pend[1], error=m["error"])
+        else:
+            self._reply(w, pend[1], folded=m.get("folded", ""))
 
     def _h_stack_dump(self, rec, m):
         """Dump a live worker's thread stacks (reference: `ray stack`,

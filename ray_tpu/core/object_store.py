@@ -129,7 +129,9 @@ class ObjectStoreCore:
     the node service thread itself in v1).
     """
 
-    def __init__(self, session: str, capacity: int, spill_dir: str):
+    def __init__(self, session: str, capacity: int, spill_dir: str,
+                 spill_uri: str = ""):
+        from ray_tpu.core.spill import make_spill_backend
         self.session = session
         self.capacity = capacity
         self.used = 0
@@ -137,6 +139,9 @@ class ObjectStoreCore:
         self.entries: dict[ObjectID, _Entry] = {}
         self._shm = SharedMemoryClient(session)
         os.makedirs(spill_dir, exist_ok=True)
+        # pluggable target (reference: external_storage.py FileSystem/
+        # smart_open backends) — file:// by default, s3:// opt-in
+        self.spill_backend = make_spill_backend(spill_uri, spill_dir)
         self.num_spilled = 0
         self.num_restored = 0
 
@@ -176,8 +181,7 @@ class ObjectStoreCore:
         e = self.entries[object_id]
         if e.in_shm:
             return
-        with open(e.spill_path, "rb") as f:
-            data = f.read()
+        data = self.spill_backend.get(e.spill_path)
         buf = self._shm.create(object_id, len(data))
         buf[:] = data
         del buf
@@ -185,7 +189,7 @@ class ObjectStoreCore:
         e.in_shm = True
         e.last_access = time.monotonic()
         self.used += e.size
-        os.unlink(e.spill_path)
+        self.spill_backend.delete(e.spill_path)
         e.spill_path = None
         self.num_restored += 1
         if self.used > self.capacity:
@@ -207,10 +211,7 @@ class ObjectStoreCore:
             self.used -= e.size
             self._shm.unlink(object_id)
         elif e.spill_path:
-            try:
-                os.unlink(e.spill_path)
-            except FileNotFoundError:
-                pass
+            self.spill_backend.delete(e.spill_path)
 
     def evict_for(self, nbytes: int) -> int:
         """Free >= nbytes (client need-space requests)."""
@@ -231,14 +232,12 @@ class ObjectStoreCore:
 
     def _spill(self, object_id: ObjectID) -> int:
         e = self.entries[object_id]
-        path = os.path.join(self.spill_dir, object_id.hex())
         buf = self._shm.map(object_id)
-        with open(path, "wb") as f:
-            f.write(buf[: e.size])
+        locator = self.spill_backend.put(object_id.hex(), buf[: e.size])
         del buf
         self._shm.unlink(object_id)
         e.in_shm = False
-        e.spill_path = path
+        e.spill_path = locator
         self.used -= e.size
         self.num_spilled += 1
         return e.size
@@ -380,12 +379,15 @@ class NativeObjectStoreCore(ObjectStoreCore):
     eviction).
     """
 
-    def __init__(self, session: str, capacity: int, spill_dir: str):
+    def __init__(self, session: str, capacity: int, spill_dir: str,
+                 spill_uri: str = ""):
+        from ray_tpu.core.spill import make_spill_backend
         from ray_tpu.native.store import NativeArena
         self.session = session
         self.capacity = capacity
         self.used = 0
         self.spill_dir = spill_dir
+        self.spill_backend = make_spill_backend(spill_uri, spill_dir)
         self.entries: dict[ObjectID, _Entry] = {}
         self._arena = NativeArena(arena_name(session), capacity=capacity,
                                   create=True)
@@ -435,10 +437,7 @@ class NativeObjectStoreCore(ObjectStoreCore):
             if self._delete_or_defer(object_id, e.size):
                 self.used -= e.size
         elif e.spill_path:
-            try:
-                os.unlink(e.spill_path)
-            except FileNotFoundError:
-                pass
+            self.spill_backend.delete(e.spill_path)
 
     def _spill(self, object_id: ObjectID) -> int:
         e = self.entries[object_id]
@@ -446,16 +445,14 @@ class NativeObjectStoreCore(ObjectStoreCore):
         buf = self._arena.lookup(id_bytes)
         if buf is None:
             return 0
-        path = os.path.join(self.spill_dir, object_id.hex())
-        with open(path, "wb") as f:
-            f.write(buf[: e.size])
+        locator = self.spill_backend.put(object_id.hex(), buf[: e.size])
         del buf
         if not self._arena.delete(id_bytes):
             # a zero-copy view is alive somewhere; can't reclaim yet
-            os.unlink(path)
+            self.spill_backend.delete(locator)
             return 0
         e.in_shm = False
-        e.spill_path = path
+        e.spill_path = locator
         self.used -= e.size
         self.num_spilled += 1
         return e.size
@@ -485,14 +482,17 @@ class NativeObjectStoreCore(ObjectStoreCore):
         self._arena.destroy()
 
 
-def make_object_store_core(session: str, capacity: int, spill_dir: str):
+def make_object_store_core(session: str, capacity: int, spill_dir: str,
+                           spill_uri: str = ""):
     """Node-side factory: native C++ arena when buildable, else python."""
     if native_store_enabled():
         try:
-            return NativeObjectStoreCore(session, capacity, spill_dir)
+            return NativeObjectStoreCore(session, capacity, spill_dir,
+                                         spill_uri=spill_uri)
         except Exception as e:
             import logging
             logging.getLogger("ray_tpu").warning(
                 "native object store unavailable (%s: %s); falling back "
                 "to the pure-python store", type(e).__name__, e)
-    return ObjectStoreCore(session, capacity, spill_dir)
+    return ObjectStoreCore(session, capacity, spill_dir,
+                           spill_uri=spill_uri)

@@ -44,9 +44,11 @@ def observer_connect(address: str, *, timeout: float = 10.0,
     return conn, request
 
 
-def observer_query(address: str, queries: list[dict]) -> list[dict]:
+def observer_query(address: str, queries: list[dict],
+                   request_timeout: float = 30.0) -> list[dict]:
     """One-shot batch of queries over a short-lived connection."""
-    conn, request = observer_connect(address)
+    conn, request = observer_connect(address,
+                                     request_timeout=request_timeout)
     try:
         return [request(q) for q in queries]
     finally:

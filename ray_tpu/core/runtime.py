@@ -467,8 +467,11 @@ def init(*, num_cpus: Optional[float] = None, num_tpus: Optional[float] = None,
             from ray_tpu.core.executor import (make_message_queue,
                                                queue_push_handler)
             inbox = make_message_queue()
+            cell: dict = {}
             ex_client = NodeClient(address, kind="tpu_executor", tpu=True,
-                                   push_handler=queue_push_handler(inbox))
+                                   push_handler=queue_push_handler(inbox,
+                                                                   cell))
+            cell["client"] = ex_client
             ex = Executor(ex_client, msg_queue=inbox)
             t = threading.Thread(target=ex.run_loop, daemon=True,
                                  name="raytpu-tpu-executor")

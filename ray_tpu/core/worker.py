@@ -83,8 +83,10 @@ def run_worker(address: str) -> None:
     from ray_tpu.core import runtime as rt
 
     inbox = make_message_queue()
+    cell: dict = {}
     client = NodeClient(address, kind="worker",
-                        push_handler=queue_push_handler(inbox))
+                        push_handler=queue_push_handler(inbox, cell))
+    cell["client"] = client
     executor = Executor(client, msg_queue=inbox, threaded_actors=True)
 
     # Make the public API (ray_tpu.get/put/remote/...) work inside tasks.

@@ -30,6 +30,9 @@ th { background: #f5f5f5; }
 #updated { color: #888; font-size: .8rem; }
 </style></head><body>
 <h1>ray_tpu dashboard</h1><div id="updated"></div>
+<h2>History</h2><canvas id="spark" width="900" height="90"
+  style="border:1px solid #ddd"></canvas>
+<div id="sparklegend" style="font-size:.8rem;color:#666"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Resources</h2><table id="resources"></table>
 <h2>Actors</h2><table id="actors"></table>
@@ -119,7 +122,33 @@ function stack(pid) { showText("/api/stack?pid=" + pid); }
 function logs(name) {
   showText("/api/logs" + (name ? "?name=" + encodeURIComponent(name) : ""));
 }
+const SPARK = [["cpu_used", "#e4593b"], ["tasks_running", "#2f6db3"],
+               ["store_used_mb", "#0a7d36"]];
+async function sparkline() {
+  const hist = await (await fetch("/api/metrics/history")).json();
+  const c = document.getElementById("spark");
+  const ctx = c.getContext("2d");
+  ctx.clearRect(0, 0, c.width, c.height);
+  if (!hist.length) return;
+  let legend = [];
+  for (const [key, color] of SPARK) {
+    const vals = hist.map(h => h[key] ?? 0);
+    const max = Math.max(...vals, 1e-9);
+    ctx.strokeStyle = color; ctx.beginPath();
+    vals.forEach((v, i) => {
+      const x = i / Math.max(vals.length - 1, 1) * (c.width - 4) + 2;
+      const y = c.height - 4 - v / max * (c.height - 8);
+      i ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+    });
+    ctx.stroke();
+    legend.push(`<span style="color:${color}">&#9632;</span> ` +
+                `${key} (now ${vals[vals.length-1].toFixed(1)}, ` +
+                `max ${max.toFixed(1)})`);
+  }
+  document.getElementById("sparklegend").innerHTML = legend.join(" · ");
+}
 refresh(); setInterval(refresh, 2000);
+sparkline(); setInterval(sparkline, 5000);
 </script></body></html>
 """
 
@@ -132,9 +161,11 @@ class _StateSource:
     def __init__(self, address: str):
         self.address = address
 
-    def _request_many(self, queries: list[dict]) -> list[dict]:
+    def _request_many(self, queries: list[dict],
+                      timeout: float = 30.0) -> list[dict]:
         from ray_tpu.core.observer import observer_query
-        return observer_query(self.address, queries)
+        return observer_query(self.address, queries,
+                              request_timeout=timeout)
 
     def summary(self) -> dict:
         from ray_tpu.util.state import group_counts
@@ -211,11 +242,57 @@ class _StateSource:
         return {"pid": pid, "data": reply.get("data"),
                 "log": reply.get("log")}
 
+    def metrics_sample(self) -> dict:
+        """One lightweight point for the history ring (reference:
+        dashboard/modules/metrics timeseries — here self-contained, no
+        Prometheus/Grafana dependency)."""
+        res, ostats, tasks = self._request_many([
+            {"t": "state", "what": "resources"},
+            {"t": "object_stats"},
+            {"t": "state", "what": "tasks"},
+        ])
+        data = res.get("data") or {"total": {}, "available": {}}
+        total = data.get("total", {})
+        avail = data.get("available", {})
+        running = 0
+        for states in (tasks.get("data") or {}).values() \
+                if isinstance(tasks.get("data"), dict) else []:
+            if isinstance(states, dict):
+                running += states.get("RUNNING", 0)
+        st = ostats.get("stats") or {}
+        return {
+            "ts": time.time(),
+            "cpu_used": total.get("CPU", 0.0) - avail.get("CPU", 0.0),
+            "cpu_total": total.get("CPU", 0.0),
+            "tpu_used": total.get("TPU", 0.0) - avail.get("TPU", 0.0),
+            "tasks_running": running,
+            "store_used_mb": round(st.get("used_bytes", 0) / 1e6, 2),
+            "store_spilled": st.get("num_spilled", 0),
+        }
+
+    def profile(self, pid: int, duration: float = 2.0) -> dict:
+        """Sampling profile of a live worker (reference: dashboard
+        profile_manager.py) — folded stacks via the node's router."""
+        try:
+            (reply,) = self._request_many(
+                [{"t": "profile_worker", "pid": pid,
+                  "duration": duration}], timeout=duration + 40)
+        except RuntimeError as e:
+            return {"pid": pid, "error": str(e)}
+        return {"pid": pid, "folded": reply.get("folded", "")}
+
 
 class Dashboard:
     def __init__(self, address: str, host: str = "127.0.0.1",
-                 port: int = 8265):
+                 port: int = 8265, history_interval_s: float = 5.0,
+                 history_points: int = 720):
+        from collections import deque
         source = _StateSource(address)
+        self._source_address = address
+        self._history: "deque[dict]" = deque(maxlen=history_points)
+        self._history_interval = history_interval_s
+        self._history_stop = threading.Event()
+        history = self._history
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
@@ -257,6 +334,28 @@ class Dashboard:
                         self._send(200, json.dumps(
                             source.stack_dump(pid),
                             default=str).encode(), "application/json")
+                    elif path == "/api/metrics/history":
+                        self._send(200, json.dumps(
+                            list(history), default=str).encode(),
+                            "application/json")
+                    elif path == "/api/profile":
+                        pid = int((qs.get("pid") or ["0"])[0])
+                        dur = float((qs.get("duration") or ["2"])[0])
+                        self._send(200, json.dumps(
+                            source.profile(pid, dur),
+                            default=str).encode(), "application/json")
+                    elif path == "/api/flame":
+                        from ray_tpu.util.profiling import flamegraph_svg
+                        pid = int((qs.get("pid") or ["0"])[0])
+                        dur = float((qs.get("duration") or ["2"])[0])
+                        prof = source.profile(pid, dur)
+                        if prof.get("error"):
+                            self._send(502, json.dumps(prof).encode(),
+                                       "application/json")
+                        else:
+                            svg = flamegraph_svg(prof["folded"])
+                            self._send(200, svg.encode(),
+                                       "image/svg+xml")
                     else:
                         self._send(404, b'{"error": "not found"}',
                                    "application/json")
@@ -275,6 +374,18 @@ class Dashboard:
                                         name="raytpu-dashboard")
         self._thread.start()
 
+        def sample_loop():
+            src = _StateSource(self._source_address)
+            while not self._history_stop.wait(self._history_interval):
+                try:
+                    self._history.append(src.metrics_sample())
+                except Exception:
+                    pass   # cluster briefly unreachable: skip the point
+        self._sampler = threading.Thread(target=sample_loop, daemon=True,
+                                         name="raytpu-dash-metrics")
+        self._sampler.start()
+
     def stop(self) -> None:
+        self._history_stop.set()
         self._server.shutdown()
         self._server.server_close()

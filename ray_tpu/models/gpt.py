@@ -435,17 +435,16 @@ def _forward_pipelined(params, tokens, cfg: GPTConfig, mesh: Mesh,
     """Pipeline-parallel forward: embedding and head run under GSPMD auto
     sharding (once, sharded over dp/tp); only the layer stack rides the
     pp pipeline (parallel.pipeline.pipeline_apply, single-hop ppermute
-    hand-offs).  Composes with dp/fsdp/tp; sp+pp is not supported (ring
-    attention would nest shard_maps), and MoE+pp is future work (the aux
-    loss would have to ride the ppermute hand-off)."""
+    hand-offs).  Composes with dp/fsdp/tp AND MoE (the load-balance aux
+    loss rides the same ppermute hand-off as the activation, summed at
+    the last stage); sp+pp is not supported (ring attention would nest
+    shard_maps — shard long sequences with sp, deep stacks with pp)."""
     from ray_tpu.parallel.pipeline import pipeline_apply
 
     if mesh.shape.get("sp", 1) > 1:
         raise NotImplementedError(
             "sp and pp on the same mesh are not supported; shard long "
             "sequences with sp, deep stacks with pp")
-    if cfg.n_experts:
-        raise NotImplementedError("MoE + pp pipeline is not supported yet")
     S = mesh.shape["pp"]
     if cfg.n_layers % S != 0:
         raise ValueError(f"n_layers {cfg.n_layers} not divisible by pp={S}")
@@ -462,16 +461,17 @@ def _forward_pipelined(params, tokens, cfg: GPTConfig, mesh: Mesh,
     # never mention pp)
     body = _layer_scan_body(cfg, mesh, rules)
 
-    def stage_fn(local_layers, x):
-        (x, _), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                             local_layers)
-        return x
+    def stage_fn(local_layers, x, aux):
+        (x, aux), _ = lax.scan(body, (x, aux), local_layers)
+        return x, aux
 
-    outs = pipeline_apply(stage_fn, x_mb, params["layers"], mesh=mesh)
+    outs, aux = pipeline_apply(stage_fn, x_mb, params["layers"],
+                               mesh=mesh, carry_aux=True)
     x = outs.reshape(b, s, cfg.d_model)
     logits = _head(params, x, cfg, mesh, rules)
     if return_aux:
-        return logits, jnp.zeros((), jnp.float32)
+        # per-microbatch means summed over M microbatches -> batch mean
+        return logits, aux / M
     return logits
 
 

@@ -187,12 +187,13 @@ class AlphaStar(Algorithm):
                 jnp.asarray(p.logits), opp_lgs, jnp.asarray(w)))
             # play matches to refresh the payoff table (exact expected
             # payoff stands in for match outcomes on matrix games; the
-            # EMA keeps the bookkeeping path identical)
-            for o in opps:
-                res = float(self._expected_payoff(
-                    jnp.asarray(p.logits),
-                    jnp.asarray(self.league.players[o].logits)))
-                self.league.record(p.pid, o, res)
+            # EMA keeps the bookkeeping path identical).  One batched
+            # program per learner — vmapped over the opponent stack.
+            results = np.asarray(jax.vmap(
+                self._expected_payoff,
+                in_axes=(None, 0))(jnp.asarray(p.logits), opp_lgs))
+            for o, res in zip(opps, results):
+                self.league.record(p.pid, o, float(res))
         if self._iter % cfg.snapshot_every == 0:
             for p in list(learners):
                 self.league.snapshot(p.pid)

@@ -174,7 +174,12 @@ class DDPPO(Algorithm):
                 "DD-PPO is decentralized by definition (reference "
                 "ddppo.py:91): it needs the core runtime for its worker "
                 "gang — call ray_tpu.init() first")
-        world = max(2, cfg.num_rollout_workers)
+        if cfg.num_rollout_workers < 2:
+            raise ValueError(
+                "DD-PPO needs num_rollout_workers >= 2 (train_batch_size "
+                "is PER WORKER; silently adding workers would change the "
+                f"experiment), got {cfg.num_rollout_workers}")
+        world = cfg.num_rollout_workers
         self._group_name = f"ddppo-{uuid.uuid4().hex[:8]}"
         create_collective_group(self._group_name, world)
         Worker = ray_tpu.remote(_DDPPOWorker)

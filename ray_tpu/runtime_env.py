@@ -35,13 +35,24 @@ _EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules",
 
 
 def validate(runtime_env: dict) -> dict:
-    known = {"env_vars", "working_dir", "py_modules", "pip", "conda"}
+    known = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+             "container"}
     unknown = set(runtime_env) - known
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)}; supported: "
-            f"{sorted(known)} (container is out of scope: the cluster "
-            "image is the base environment)")
+            f"{sorted(known)}")
+    container = runtime_env.get("container")
+    if container is not None:
+        if (not isinstance(container, dict)
+                or not isinstance(container.get("image"), str)
+                or not container["image"]):
+            raise ValueError(
+                "container must be {'image': <str>, 'run_options': "
+                "[...]} (reference: _private/runtime_env/container.py)")
+        ro = container.get("run_options") or []
+        if not all(isinstance(o, str) for o in ro):
+            raise ValueError("container run_options must be strings")
     conda = runtime_env.get("conda")
     if conda is not None and not isinstance(conda, (str, dict)):
         raise ValueError(
@@ -66,6 +77,37 @@ def validate(runtime_env: dict) -> dict:
                              "or local wheel paths")
         runtime_env["pip"] = pip
     return runtime_env
+
+
+def container_runtime() -> Optional[str]:
+    """podman preferred, docker fallback (reference:
+    _private/runtime_env/container.py uses podman)."""
+    import shutil
+    for rt in ("podman", "docker"):
+        if shutil.which(rt):
+            return rt
+    return None
+
+
+def container_command(container: dict, worker_cmd: list,
+                      session_dir: str,
+                      runtime: Optional[str] = None) -> list:
+    """argv that launches a worker INSIDE the requested image: host
+    network (the node's control socket), host IPC (the shm object
+    store), and the session dir mounted through (logs, spill, sockets).
+    Raises when no container runtime exists — at SPAWN time, with the
+    real problem named."""
+    rt = runtime or container_runtime()
+    if rt is None:
+        raise RuntimeError(
+            "runtime_env requests a container but neither podman nor "
+            "docker is installed on this node")
+    return ([rt, "run", "--rm", "--network=host", "--ipc=host",
+             "-v", f"{session_dir}:{session_dir}",
+             "-v", "/dev/shm:/dev/shm",
+             "-e", f"RAY_TPU_CONTAINER_IMAGE={container['image']}"]
+            + list(container.get("run_options") or [])
+            + [container["image"]] + list(worker_cmd))
 
 
 def env_hash(runtime_env: Optional[dict]) -> str:
@@ -500,6 +542,22 @@ class applied_env:
         self._saved_cwd: Optional[str] = None
 
     def __enter__(self):
+        container = self.env.get("container")
+        if container:
+            # containerized envs only apply inside a worker that was
+            # LAUNCHED in that image (container_command below); a plain
+            # worker can't re-root itself mid-task
+            have = os.environ.get("RAY_TPU_CONTAINER_IMAGE", "")
+            if have != container["image"]:
+                runtime = container_runtime()
+                hint = ("no container runtime (podman/docker) on this "
+                        "node" if runtime is None else
+                        f"this worker runs outside the image "
+                        f"(in {have or 'the host'})")
+                raise RuntimeError(
+                    f"runtime_env container image "
+                    f"{container['image']!r} unavailable: {hint} "
+                    "(reference: _private/runtime_env/container.py)")
         for k, v in (self.env.get("env_vars") or {}).items():
             self._saved_env[k] = os.environ.get(k)
             os.environ[k] = v

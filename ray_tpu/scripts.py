@@ -191,6 +191,24 @@ def cmd_stack(args) -> int:
         conn.close()
 
 
+def cmd_flame(args) -> int:
+    """Flamegraph a live worker by pid (folded stacks sampled in the
+    worker; rendered here)."""
+    from ray_tpu.core.observer import observer_query
+    from ray_tpu.util.profiling import flamegraph_svg
+    (reply,) = observer_query(
+        args.address,
+        [{"t": "profile_worker", "pid": args.pid,
+          "duration": args.duration}],
+        request_timeout=args.duration + 40)
+    folded = reply.get("folded", "")
+    with open(args.output, "w") as f:
+        f.write(flamegraph_svg(folded))
+    n = len([ln for ln in folded.splitlines() if ln.strip()])
+    print(f"wrote {args.output} ({n} distinct stacks)")
+    return 0
+
+
 def cmd_kill_random_node(args) -> int:
     from ray_tpu.util.chaos import kill_random_node
     victim = kill_random_node(args.address,
@@ -435,6 +453,15 @@ def main(argv=None) -> int:
                                      "(reference: `ray stack`)")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("flame", help="sampling-profile a worker into a "
+                                     "flamegraph SVG (reference: the "
+                                     "dashboard's py-spy profiling)")
+    p.add_argument("--address", required=True)
+    p.add_argument("--pid", type=int, required=True)
+    p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("-o", "--output", default="flame.svg")
+    p.set_defaults(fn=cmd_flame)
 
     p = sub.add_parser("kill-random-node",
                        help="chaos: hard-stop a random alive node "

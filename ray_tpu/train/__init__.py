@@ -14,6 +14,10 @@ from ray_tpu.train.result import Result
 from ray_tpu.train.step import (TrainState, make_train_step, shard_batch,
                                 state_shardings)
 from ray_tpu.train.huggingface import TransformersTrainer
+from ray_tpu.train.tensorflow import (TensorflowConfig, TensorflowTrainer,
+                                      build_tf_config)
+from ray_tpu.train.horovod import (HorovodConfig, HorovodTrainer,
+                                   build_horovod_env)
 from ray_tpu.train.torch_trainer import (TorchConfig, TorchTrainer,
                                          prepare_model)
 from ray_tpu.train.trainer import (BaseTrainer, DataParallelTrainer,
@@ -29,6 +33,8 @@ __all__ = [
     "XGBoostTrainer", "LightGBMTrainer", "Predictor", "JaxPredictor",
     "SklearnPredictor", "BatchPredictor", "TorchTrainer", "TorchConfig",
     "prepare_model", "TransformersTrainer",
+    "TensorflowTrainer", "TensorflowConfig", "build_tf_config",
+    "HorovodTrainer", "HorovodConfig", "build_horovod_env",
 ]
 
 from ray_tpu import usage_stats as _usage_stats

@@ -1,0 +1,166 @@
+"""Horovod distributed-training backend.
+
+Reference capability: train/horovod/config.py:32 HorovodConfig — the
+backend assembles Horovod's rendezvous environment on every worker
+(rank/size/local-rank layout + the gloo rendezvous server address on
+rank 0) and the user loop's ``hvd.init()`` forms the ring.  horovod
+itself is imported only by the USER loop; the backend's env contract is
+testable without it.
+"""
+
+from __future__ import annotations
+
+import socket
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import BaseTrainer
+
+
+@dataclass
+class HorovodConfig:
+    """(reference: horovod/config.py:32)"""
+    init_timeout_s: float = 120.0
+
+
+def build_horovod_env(hosts: list, rank: int,
+                      rendezvous_addr: str,
+                      rendezvous_port: int) -> dict:
+    """Per-rank Horovod env (reference: horovod/config.py + the
+    horovod.ray coordinator): global rank/size, per-host local
+    rank/size, cross-host rank/size, gloo rendezvous location."""
+    by_host: dict = defaultdict(list)
+    for r, h in enumerate(hosts):
+        by_host[h].append(r)
+    host = hosts[rank]
+    local_ranks = by_host[host]
+    host_order = list(dict.fromkeys(hosts))
+    return {
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(len(hosts)),
+        "HOROVOD_LOCAL_RANK": str(local_ranks.index(rank)),
+        "HOROVOD_LOCAL_SIZE": str(len(local_ranks)),
+        "HOROVOD_CROSS_RANK": str(host_order.index(host)),
+        "HOROVOD_CROSS_SIZE": str(len(host_order)),
+        "HOROVOD_CONTROLLER": "gloo",
+        "HOROVOD_CPU_OPERATIONS": "gloo",
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": rendezvous_addr,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
+        "HOROVOD_HOSTNAME": host,
+    }
+
+
+class _HorovodWorker:
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self._ckpt_payload = None
+
+    def hostname(self) -> str:
+        return socket.gethostbyname(socket.gethostname())
+
+    def probe_port(self) -> int:
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def setup(self, hosts: list, rendezvous_addr: str,
+              rendezvous_port: int) -> dict:
+        import os
+        env = build_horovod_env(hosts, self.rank, rendezvous_addr,
+                                rendezvous_port)
+        os.environ.update(env)
+        return env
+
+    def run(self, loop: Callable, config: dict, restore_payload) -> dict:
+        from ray_tpu.train import session as _s
+        worker = self
+
+        def ckpt_cb(data):
+            worker._ckpt_payload = data
+            return None
+
+        latest = (Checkpoint.from_dict(restore_payload)
+                  if restore_payload is not None else None)
+        st = _s._start(world_rank=self.rank, world_size=self.world_size,
+                       checkpoint_cb=ckpt_cb, latest_checkpoint=latest)
+        try:
+            if loop.__code__.co_argcount == 0:
+                loop()
+            else:
+                loop(dict(config))
+        except StopIteration:
+            pass
+        finally:
+            _s._end()
+        reports = [{k: v for k, v in r.items()
+                    if k != "_checkpoint_path"} for r in st.results]
+        return {"reports": reports,
+                "checkpoint": self._ckpt_payload if self.rank == 0
+                else None}
+
+
+class HorovodTrainer(BaseTrainer):
+    """(reference: train/horovod/horovod_trainer.py)"""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[dict] = None,
+                 horovod_config: Optional[HorovodConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self._loop = train_loop_per_worker
+        self._loop_config = train_loop_config or {}
+        self._hvd_config = horovod_config or HorovodConfig()
+
+    @property
+    def _num_workers(self) -> int:
+        sc = self.scaling_config
+        if sc.num_workers is not None:
+            return sc.num_workers
+        dp = sc.mesh.get("dp", 1)
+        return dp if dp > 0 else 1
+
+    def _attempt(self) -> None:
+        import ray_tpu
+        from ray_tpu.train import session as _session
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        hc = self._hvd_config
+        world = self._num_workers
+        Worker = ray_tpu.remote(_HorovodWorker)
+        workers = [Worker.remote(r, world) for r in range(world)]
+        st = _session._state()
+        st.world_size = world
+        restore = st.latest_checkpoint
+        restore_payload = restore.to_dict() if restore is not None else None
+        try:
+            hosts = ray_tpu.get([w.hostname.remote() for w in workers],
+                                timeout=hc.init_timeout_s)
+            port = ray_tpu.get(workers[0].probe_port.remote(),
+                               timeout=hc.init_timeout_s)
+            ray_tpu.get([w.setup.remote(hosts, hosts[0], port)
+                         for w in workers], timeout=hc.init_timeout_s)
+            outs = ray_tpu.get(
+                [w.run.remote(self._loop, self._loop_config,
+                              restore_payload) for w in workers],
+                timeout=None)
+            rank0 = outs[0]
+            n = len(rank0["reports"])
+            for i, metrics in enumerate(rank0["reports"]):
+                ck = rank0["checkpoint"] if i == n - 1 else None
+                _session.report(metrics, checkpoint=ck)
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass

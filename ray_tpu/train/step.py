@@ -176,3 +176,80 @@ def shard_batch(batch, mesh: Mesh):
         return jax.make_array_from_process_local_data(
             sh, local, global_shape)
     return jax.tree.map(shard_one, batch)
+
+
+def train_step_1f1b(cfg, mesh: Mesh, *, batch_n: int, seq: int,
+                    check_parity: bool = True) -> float:
+    """One GPT train pass through the fused 1F1B pipeline schedule
+    (parallel/pipeline_1f1b.py): embedding runs outside under jax.vjp,
+    the layer stack rides the 1F1B scan, the loss tail (final norm +
+    head + CE) is folded into the last stage's backward.  Asserts loss
+    parity with the plain single-device loss and that gradients flow to
+    EVERY parameter (tied embeddings get both the embed- and head-side
+    contributions).  Returns the loss."""
+    from jax import lax
+
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel.pipeline_1f1b import pipeline_value_and_grads_1f1b
+
+    S = mesh.shape["pp"]
+    M = cfg.pp_microbatches or 2 * S
+    assert batch_n % M == 0, (batch_n, M)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((batch_n, seq + 1), jnp.int32)
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    body = gpt._layer_scan_body(cfg, mesh, DEFAULT_LLM_RULES)
+    tied = cfg.tie_embeddings
+
+    def stage_fn(lp, x):
+        (x, _), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), lp)
+        return x
+
+    def last_fn(tp, x, y):
+        # reuse the model's own head (tie-embeddings convention, logit
+        # dtype policy); mesh=None — constraints don't apply inside the
+        # pipeline's manual region
+        logits = gpt._head(tp, x, cfg, None, DEFAULT_LLM_RULES)
+        logz = jax.nn.logsumexp(logits, -1)
+        gold = jnp.take_along_axis(logits, y[..., None], -1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    def embed_fn(ep, toks):
+        return gpt._embed(ep, toks, cfg, None, DEFAULT_LLM_RULES)
+
+    tail_keys = ["ln_f_scale", "ln_f_bias"] + \
+        (["wte"] if tied else ["lm_head"])
+
+    @jax.jit
+    def step(params):
+        eparams = {"wte": params["wte"], "wpe": params["wpe"]}
+        tail = {k: params[k] for k in tail_keys}
+        x, embed_vjp = jax.vjp(lambda ep: embed_fn(ep, inp), eparams)
+        mb = batch_n // M
+        x_mb = x.reshape(M, mb, seq, cfg.d_model)
+        y_mb = tgt.reshape(M, mb, seq)
+        loss, d_layers, d_tail, d_x = pipeline_value_and_grads_1f1b(
+            stage_fn, last_fn, x_mb, y_mb, params["layers"], tail,
+            mesh=mesh)
+        (d_embed,) = embed_vjp(
+            d_x.reshape(batch_n, seq, cfg.d_model).astype(x.dtype))
+        grads = {"layers": d_layers, "wpe": d_embed["wpe"],
+                 "ln_f_scale": d_tail["ln_f_scale"],
+                 "ln_f_bias": d_tail["ln_f_bias"]}
+        if tied:
+            grads["wte"] = d_embed["wte"] + d_tail["wte"]
+        else:
+            grads["wte"] = d_embed["wte"]
+            grads["lm_head"] = d_tail["lm_head"]
+        return loss, grads
+
+    with mesh:
+        loss, grads = step(params)
+        jax.block_until_ready(grads)
+    gnorm = float(optax.global_norm(grads))
+    assert jnp.isfinite(gnorm) and gnorm > 0.0, gnorm
+    if check_parity:
+        ref = float(gpt.loss_fn(params, {"tokens": tokens}, cfg))
+        assert abs(float(loss) - ref) < 1e-3 + 1e-3 * abs(ref), (
+            f"1F1B loss {float(loss)} != reference {ref}")
+    return float(loss)

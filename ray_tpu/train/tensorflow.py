@@ -1,0 +1,146 @@
+"""TensorFlow distributed-training backend.
+
+Reference capability: train/tensorflow/config.py:21 TensorflowConfig —
+the backend's ONLY job is assembling TF_CONFIG on every worker so the
+user loop's ``tf.distribute.MultiWorkerMirroredStrategy()`` forms the
+collective ring; Ray stays out of the gradient path.  Same split here:
+a worker gang probes reachable host:port pairs, the driver assembles
+the cluster spec, each rank gets TF_CONFIG before the user loop runs.
+TensorFlow itself is imported only by the USER loop — this backend is
+import-gated exactly where the reference is (tf absent = the loop's
+import fails with the obvious message, the backend still works).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import BaseTrainer
+
+
+@dataclass
+class TensorflowConfig:
+    """(reference: tensorflow/config.py:21)"""
+    init_timeout_s: float = 120.0
+
+
+def build_tf_config(worker_addrs: list, rank: int) -> str:
+    """The TF_CONFIG JSON for MultiWorkerMirroredStrategy (reference:
+    tensorflow/config.py _setup_tensorflow_environment)."""
+    return json.dumps({
+        "cluster": {"worker": list(worker_addrs)},
+        "task": {"type": "worker", "index": rank},
+    })
+
+
+class _TFWorker:
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self._ckpt_payload = None
+
+    def probe_address(self) -> str:
+        host = socket.gethostbyname(socket.gethostname())
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"{host}:{port}"
+
+    def setup(self, worker_addrs: list) -> bool:
+        os.environ["TF_CONFIG"] = build_tf_config(worker_addrs,
+                                                  self.rank)
+        return True
+
+    def run(self, loop: Callable, config: dict, restore_payload) -> dict:
+        from ray_tpu.train import session as _s
+        worker = self
+
+        def ckpt_cb(data):
+            worker._ckpt_payload = data
+            return None
+
+        latest = (Checkpoint.from_dict(restore_payload)
+                  if restore_payload is not None else None)
+        st = _s._start(world_rank=self.rank, world_size=self.world_size,
+                       checkpoint_cb=ckpt_cb, latest_checkpoint=latest)
+        try:
+            if loop.__code__.co_argcount == 0:
+                loop()
+            else:
+                loop(dict(config))
+        except StopIteration:
+            pass
+        finally:
+            _s._end()
+        reports = [{k: v for k, v in r.items()
+                    if k != "_checkpoint_path"} for r in st.results]
+        return {"reports": reports,
+                "checkpoint": self._ckpt_payload if self.rank == 0
+                else None}
+
+
+class TensorflowTrainer(BaseTrainer):
+    """(reference: train/tensorflow/tensorflow_trainer.py)"""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[dict] = None,
+                 tensorflow_config: Optional[TensorflowConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint)
+        self._loop = train_loop_per_worker
+        self._loop_config = train_loop_config or {}
+        self._tf_config = tensorflow_config or TensorflowConfig()
+
+    @property
+    def _num_workers(self) -> int:
+        sc = self.scaling_config
+        if sc.num_workers is not None:
+            return sc.num_workers
+        dp = sc.mesh.get("dp", 1)
+        return dp if dp > 0 else 1
+
+    def _attempt(self) -> None:
+        import ray_tpu
+        from ray_tpu.train import session as _session
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self._tf_config
+        world = self._num_workers
+        Worker = ray_tpu.remote(_TFWorker)
+        workers = [Worker.remote(r, world) for r in range(world)]
+        st = _session._state()
+        st.world_size = world
+        restore = st.latest_checkpoint
+        restore_payload = restore.to_dict() if restore is not None else None
+        try:
+            addrs = ray_tpu.get(
+                [w.probe_address.remote() for w in workers],
+                timeout=tc.init_timeout_s)
+            ray_tpu.get([w.setup.remote(addrs) for w in workers],
+                        timeout=tc.init_timeout_s)
+            outs = ray_tpu.get(
+                [w.run.remote(self._loop, self._loop_config,
+                              restore_payload) for w in workers],
+                timeout=None)
+            rank0 = outs[0]
+            n = len(rank0["reports"])
+            for i, metrics in enumerate(rank0["reports"]):
+                ck = rank0["checkpoint"] if i == n - 1 else None
+                _session.report(metrics, checkpoint=ck)
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass

@@ -1,0 +1,139 @@
+"""Sampling profiler + flamegraphs, dependency-free.
+
+Reference capability: the dashboard's on-demand py-spy profiling
+(reference: dashboard/modules/reporter/profile_manager.py:11-14 — wraps
+the py-spy binary for flamegraphs of live workers).  py-spy is an
+external Rust tool; here the sampler is in-process — a thread walks
+``sys._current_frames()`` at a fixed rate and aggregates FOLDED stacks
+(the flamegraph interchange format), and a small deterministic SVG
+renderer turns them into a self-contained flamegraph.  In-process
+sampling sees exactly the interpreter's Python frames (it cannot profile
+a foreign pid like py-spy; the node routes profile requests to each
+worker instead, core/executor.py "profile").
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Optional
+
+_EXCLUDE_THREADS = ("raytpu-recv", "raytpu-autoflush", "raytpu-sampler",
+                    "raytpu-devmat")
+
+
+def sample_folded(duration: float = 2.0, hz: float = 99.0,
+                  all_threads: bool = True,
+                  target_thread: Optional[int] = None) -> str:
+    """Sample this process's Python stacks for ``duration`` seconds.
+
+    Returns folded-stack lines: ``mod.func;mod.func2;... COUNT`` —
+    the flamegraph.pl / speedscope interchange format."""
+    counts: Counter = Counter()
+    interval = 1.0 / hz
+    me = threading.get_ident()
+    names = {}
+    deadline = time.monotonic() + duration
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me or (target_thread and tid != target_thread):
+                continue
+            th = names.get(tid)
+            if th is None:
+                th = names[tid] = next(
+                    (t.name for t in threading.enumerate()
+                     if t.ident == tid), f"thread-{tid}")
+            if not all_threads and any(th.startswith(p)
+                                       for p in _EXCLUDE_THREADS):
+                continue
+            stack = []
+            f = frame
+            while f is not None:
+                co = f.f_code
+                mod = co.co_filename.rsplit("/", 1)[-1]
+                stack.append(f"{mod}:{co.co_name}")
+                f = f.f_back
+            counts[";".join([th] + stack[::-1])] += 1
+        time.sleep(interval)
+    return "\n".join(f"{k} {v}" for k, v in
+                     sorted(counts.items(), key=lambda kv: -kv[1]))
+
+
+# -- flamegraph rendering ---------------------------------------------------
+
+_PALETTE = ["#e4593b", "#e9743a", "#ec8b3c", "#efa23f", "#f1b843",
+            "#d8873b", "#c95f38"]
+
+
+def _build_trie(folded: str):
+    root = {"name": "all", "value": 0, "children": {}}
+    for line in folded.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        path, _, cnt = line.rpartition(" ")
+        try:
+            n = int(cnt)
+        except ValueError:
+            continue
+        root["value"] += n
+        node = root
+        for part in path.split(";"):
+            child = node["children"].get(part)
+            if child is None:
+                child = node["children"][part] = {
+                    "name": part, "value": 0, "children": {}}
+            child["value"] += n
+            node = child
+    return root
+
+
+def flamegraph_svg(folded: str, width: int = 1200,
+                   row_h: int = 16) -> str:
+    """Folded stacks → a self-contained SVG flamegraph (hover titles,
+    deterministic layout/colors — no JS, no external assets)."""
+    root = _build_trie(folded)
+    total = max(root["value"], 1)
+    rects = []
+    depth_max = [0]
+
+    def walk(node, x0: float, depth: int):
+        depth_max[0] = max(depth_max[0], depth)
+        w = node["value"] / total * width
+        if w >= 0.5 and depth >= 0:
+            color = _PALETTE[hash(node["name"]) % len(_PALETTE)]
+            rects.append((x0, depth, w, node["name"], node["value"],
+                          color))
+        x = x0
+        for child in sorted(node["children"].values(),
+                            key=lambda c: -c["value"]):
+            walk(child, x, depth + 1)
+            x += child["value"] / total * width
+
+    walk(root, 0.0, 0)
+    height = (depth_max[0] + 2) * row_h
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+           f'height="{height}" font-family="monospace" font-size="11">',
+           f'<rect width="{width}" height="{height}" fill="#fffdf7"/>']
+    for x, depth, w, name, value, color in rects:
+        y = height - (depth + 1) * row_h
+        pct = 100.0 * value / total
+        label = name if w > 7 * len(name) * 0.9 else (
+            name[: max(0, int(w / 7)) - 1] + "…" if w > 20 else "")
+        out.append(
+            f'<g><title>{_esc(name)} — {value} samples '
+            f'({pct:.1f}%)</title>'
+            f'<rect x="{x:.1f}" y="{y}" width="{max(w - 0.3, 0.2):.1f}" '
+            f'height="{row_h - 1}" fill="{color}" rx="1"/>'
+            + (f'<text x="{x + 2:.1f}" y="{y + row_h - 5}" '
+               f'fill="#2a1f1a">{_esc(label)}</text>' if label else "")
+            + "</g>")
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def _esc(s: str) -> str:
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))

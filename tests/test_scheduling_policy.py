@@ -1,0 +1,92 @@
+"""Hybrid scheduling policy unit tests (reference:
+raylet/scheduling/policy/hybrid_scheduling_policy.cc +
+policy/hybrid_scheduling_policy_test.cc; locality targeting
+core_worker/lease_policy.h:56)."""
+
+import pytest
+
+from ray_tpu._config import RayTpuConfig
+from ray_tpu.core.head import HeadService, NodeRec
+
+
+@pytest.fixture
+def head():
+    h = HeadService(RayTpuConfig(), "testsession")
+    yield h
+    try:
+        h.listener.close()
+        h.sel.close()
+    except Exception:
+        pass
+
+
+def _node(h, hex_, total, avail):
+    h.nodes[hex_] = NodeRec(node_hex=hex_, address=f"addr-{hex_}",
+                            conn_id=0, total=dict(total),
+                            available=dict(avail))
+
+
+def test_available_beats_feasible(head):
+    _node(head, "busy", {"CPU": 8}, {"CPU": 0})      # feasible only
+    _node(head, "free", {"CPU": 2}, {"CPU": 2})      # fits now
+    for _ in range(10):
+        assert head._choose_node({"CPU": 2}) == "free"
+
+
+def test_feasible_fallback_when_nothing_available(head):
+    _node(head, "busy", {"CPU": 8}, {"CPU": 0})
+    _node(head, "small", {"CPU": 1}, {"CPU": 1})     # can NEVER fit 4
+    assert head._choose_node({"CPU": 4}) == "busy"
+    assert head._choose_node({"CPU": 16}) is None
+
+
+def test_utilization_truncation_spreads_light_nodes(head):
+    """Below scheduler_spread_threshold every node ties, so the random
+    tie-break spreads racing submits across ALL light nodes instead of
+    stampeding a single deterministic argmax."""
+    for i in range(4):
+        _node(head, f"n{i}", {"CPU": 10}, {"CPU": 10 - i})  # util 0..0.3
+    picks = {head._choose_node({"CPU": 1}) for _ in range(100)}
+    assert picks == {"n0", "n1", "n2", "n3"}
+
+
+def test_heavily_loaded_nodes_rank_by_utilization(head):
+    _node(head, "hot", {"CPU": 10}, {"CPU": 2})      # util 0.8
+    _node(head, "warm", {"CPU": 10}, {"CPU": 4})     # util 0.6
+    for _ in range(10):
+        assert head._choose_node({"CPU": 1}) == "warm"
+
+
+def test_locality_breaks_utilization_ties(head):
+    _node(head, "far", {"CPU": 4}, {"CPU": 4})
+    _node(head, "near", {"CPU": 4}, {"CPU": 4})
+    head.object_locs[b"obj1"] = {"near"}
+    head.object_locs[b"obj2"] = {"near", "far"}
+    for _ in range(10):
+        assert head._choose_node({"CPU": 1},
+                                 arg_ids=(b"obj1", b"obj2")) == "near"
+
+
+def test_prefer_submitter_when_all_else_ties(head):
+    _node(head, "a", {"CPU": 4}, {"CPU": 4})
+    _node(head, "b", {"CPU": 4}, {"CPU": 4})
+    for _ in range(10):
+        assert head._choose_node({"CPU": 1}, prefer="b") == "b"
+
+
+def test_actor_spread_by_count_dominates(head):
+    from ray_tpu.core.head import ActorDir
+    _node(head, "a", {"CPU": 4}, {"CPU": 4})
+    _node(head, "b", {"CPU": 4}, {"CPU": 4})
+    for i in range(3):
+        head.actors[bytes([i])] = ActorDir(
+            actor_id=bytes([i]), node_hex="a", state="alive", spec={})
+    for _ in range(10):
+        assert head._choose_actor_node({}) == "b"
+
+
+def test_dead_nodes_skipped(head):
+    _node(head, "dead", {"CPU": 8}, {"CPU": 8})
+    head.nodes["dead"].alive = False
+    _node(head, "live", {"CPU": 2}, {"CPU": 2})
+    assert head._choose_node({"CPU": 1}) == "live"
