@@ -3,10 +3,12 @@
 The full-size counterpart of tests/test_scale.py, mirroring the
 reference's release scheduling benchmarks
 (release/benchmarks/README.md:5-31: many nodes, many actors, 1M queued
-tasks) at the scale one 1-core box can honestly host.  Writes a JSON
-evidence file (SCALE_r04.json at the repo root by default).
+tasks) at the scale one small box can honestly host.  Writes a JSON
+evidence file (SCALE_r<round>.json at the repo root by default).
 
-Run:  python benchmarks/scale_envelope.py --out SCALE_r04.json
+Run:  python benchmarks/scale_envelope.py
+(writes SCALE_r<round>.json at the repo root by default; the round
+stamp comes from ray_tpu.perf.ROUND so it can't go stale again)
 """
 
 from __future__ import annotations
@@ -125,10 +127,16 @@ def main() -> int:
     ap.add_argument("--actors", type=int, default=250)
     ap.add_argument("--actor-wave", type=int, default=25)
     ap.add_argument("--broadcast-mb", type=int, default=1024)
-    ap.add_argument("--out", default="SCALE_r04.json")
+    from ray_tpu.perf import ROUND
+    ap.add_argument("--out", default=f"SCALE_r{ROUND:02d}.json")
     args = ap.parse_args()
 
-    result = {"round": 4, "env": {
+    try:
+        load = os.getloadavg()[0]
+    except OSError:
+        load = -1.0
+    result = {"round": ROUND, "env": {
+        "loadavg_1m": round(load, 2),
         "physical_cores": os.cpu_count(),
         "note": "virtual multi-node cluster on one machine "
                 "(cluster_utils), every node a full NodeService with "

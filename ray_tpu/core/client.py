@@ -109,10 +109,19 @@ class NodeClient:
         self._auto_send_lock = threading.Lock()
         self._auto_event = threading.Event()
         self._auto_thread: Optional[threading.Thread] = None
-        self._recv_thread = threading.Thread(target=self._recv_loop,
-                                             daemon=True,
-                                             name=f"raytpu-recv-{kind}")
-        self._recv_thread.start()
+        from ray_tpu.core.local_lane import LaneConnection
+        if isinstance(self.conn, LaneConnection):
+            # in-process node: replies/pushes are delivered by the node
+            # loop calling straight into this client — no recv thread,
+            # no decode, no extra wakeup hop on the reply path
+            self._recv_thread = None
+            self.conn.deliver = self._on_message
+            self.conn.on_close = self._on_conn_closed
+        else:
+            self._recv_thread = threading.Thread(target=self._recv_loop,
+                                                 daemon=True,
+                                                 name=f"raytpu-recv-{kind}")
+            self._recv_thread.start()
         info = self.request({"t": "register", "kind": kind, "tpu": tpu,
                              "worker_id": self.worker_id, "pid": os.getpid()})
         self.session: str = info["session"]
@@ -145,32 +154,41 @@ class NodeClient:
             try:
                 msg = self.conn.recv()
             except protocol.ConnectionClosed:
-                self._closed.set()
-                # wake all pending requesters with an error
-                for q in list(self._replies.values()):
-                    q.put({"error": "node connection closed"})
-                if self._push_handler is not None:
-                    try:
-                        self._push_handler({"t": "shutdown"})
-                    except Exception:
-                        pass
+                self._on_conn_closed()
                 return
             except Exception:
                 continue
-            if msg.get("t") == "reply":
-                q = self._replies.pop(msg["reqid"], None)
-                if q is not None:
-                    q.put(msg)
-            elif msg.get("t") == "materialize_object":
-                self._materialize_async(msg["object_id"])
-            elif msg.get("t") == "drop_device_object":
-                self.device_table.pop(msg["object_id"])
-            elif self._push_handler is not None:
-                try:
-                    self._push_handler(msg)
-                except Exception:
-                    import traceback
-                    traceback.print_exc()
+            self._on_message(msg)
+
+    def _on_conn_closed(self) -> None:
+        self._closed.set()
+        # wake all pending requesters with an error
+        for q in list(self._replies.values()):
+            q.put({"error": "node connection closed"})
+        if self._push_handler is not None:
+            try:
+                self._push_handler({"t": "shutdown"})
+            except Exception:
+                pass
+
+    def _on_message(self, msg: dict) -> None:
+        """One incoming message — called from the recv thread, or (lane
+        clients) directly from the node's loop thread, so every branch
+        must stay quick and non-blocking."""
+        if msg.get("t") == "reply":
+            q = self._replies.pop(msg["reqid"], None)
+            if q is not None:
+                q.put(msg)
+        elif msg.get("t") == "materialize_object":
+            self._materialize_async(msg["object_id"])
+        elif msg.get("t") == "drop_device_object":
+            self.device_table.pop(msg["object_id"])
+        elif self._push_handler is not None:
+            try:
+                self._push_handler(msg)
+            except Exception:
+                import traceback
+                traceback.print_exc()
 
     def batched_sends(self):
         """Context manager: coalesce fire-and-forget sends on this
@@ -188,12 +206,21 @@ class NodeClient:
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
         self._flush_batch()
-        self._flush_auto()
         reqid = self._next_reqid()
         msg["reqid"] = reqid
         q: queue.SimpleQueue = queue.SimpleQueue()
         self._replies[reqid] = q
-        self.conn.send(msg)
+        # piggyback coalesced fire-and-forget sends (submits, puts) into
+        # the SAME syscall as the request — the sync-task hot path is
+        # exactly submit-then-get, previously two sendalls
+        with self._auto_send_lock:
+            with self._auto_lock:
+                batch, self._auto = self._auto, []
+            if batch:
+                batch.append(msg)
+                self.conn.send_batch(batch)
+            else:
+                self.conn.send(msg)
         try:
             reply = q.get(timeout=timeout)
         except queue.Empty:

@@ -11,6 +11,7 @@ driver keeps jax device ownership, SURVEY.md §7 design delta 1).
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import queue
 import threading
@@ -23,6 +24,18 @@ from ray_tpu.core.client import NodeClient, TaskError
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.serialization import SerializedObject, get_context
+
+
+# reusable span stand-in for the no-tracing hot path (nullcontext is
+# stateless, so one instance serves every task)
+_NULL_SPAN = contextlib.nullcontext()
+
+
+def _task_span(name: str, spec: dict):
+    from ray_tpu.util.tracing import start_span, tracing_enabled
+    if not tracing_enabled():
+        return _NULL_SPAN
+    return start_span(name, kind="server", remote_ctx=spec.get("trace_ctx"))
 
 
 class _ArgSlot:
@@ -264,14 +277,12 @@ class Executor:
         from ray_tpu.runtime_env import applied_env
         error = None
         try:
-            from ray_tpu.util.tracing import start_span
             fn = self._get_function(spec["function_id"])
             args, kwargs = self._load_args(spec)
             with task_context(TaskID(spec["task_id"])), \
                     applied_env(spec.get("runtime_env"), self.client), \
-                    start_span(f"task::{spec.get('name', '?')}.execute",
-                               kind="server",
-                               remote_ctx=spec.get("trace_ctx")):
+                    _task_span(f"task::{spec.get('name', '?')}.execute",
+                               spec):
                 result = fn(*args, **kwargs)
             # one syscall for inline result puts + completion (hot path:
             # per-task overhead, SURVEY hard part 6)
@@ -378,7 +389,6 @@ class Executor:
             instance = self._actors.get(spec["actor_id"])
             if instance is None:
                 raise RuntimeError("actor instance not found in this worker")
-            from ray_tpu.util.tracing import start_span
             method = getattr(instance, spec["method"])
             args, kwargs = self._load_args(spec)
             limit = self._group_limit(spec)
@@ -394,9 +404,8 @@ class Executor:
             with task_context(TaskID(spec["task_id"])), \
                     applied_env(self._actor_envs.get(spec["actor_id"]),
                                 self.client), \
-                    start_span(f"actor::{spec.get('name', '?')}.execute",
-                               kind="server",
-                               remote_ctx=spec.get("trace_ctx")):
+                    _task_span(f"actor::{spec.get('name', '?')}.execute",
+                               spec):
                 if sem is not None:
                     with sem:
                         result = method(*args, **kwargs)

@@ -26,6 +26,7 @@ does the same for GCS-bound client calls).
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -45,6 +46,13 @@ class NodeRec:
     total: dict
     available: dict
     queued: dict = field(default_factory=dict)   # demand waiting locally
+    # demand optimistically routed here since the last heartbeat: the
+    # debit-only `available` saturates during a burst, and without a
+    # backlog signal every post-saturation task tie-broke to the
+    # SUBMITTER — one node ended up with ~97% of a 4000-task burst
+    # while seven sat idle (measured).  Heartbeats reset this; `queued`
+    # then carries the ground truth.
+    routed: dict = field(default_factory=dict)
     labels: dict = field(default_factory=dict)   # e.g. provider_node_id
     last_beat: float = field(default_factory=time.monotonic)
     alive: bool = True
@@ -119,6 +127,13 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         self._last_snapshot = 0.0
         self._snapshot_writing = False
         self._replica_seq = 0
+        self._written_seq = 0
+        self._snap_write_lock = threading.Lock()
+        # replica seq numbers are scoped to one head INCARNATION: a
+        # restarted head (seq reset to 0) must not be "stale" vs the
+        # replicas its predecessor fanned out
+        import uuid as _uuid
+        self._boot_id = _uuid.uuid4().hex
         # actors restored as pending get a rejoin grace window; if their
         # node never comes back they re-place or die (reference: GCS
         # reconciles actors after the reconnection grace period)
@@ -164,17 +179,31 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                      "state": p.state} for p in self.pgs.values()],
         }
 
-    def _write_snapshot(self, state: dict) -> None:
+    def _write_snapshot(self, state: dict, seq: int = 0) -> None:
         import pickle
-        tmp = self.persistence_path + ".tmp"
+        import threading as _threading
+        # unique tmp per writer + seq fence: the sync path
+        # (snapshot_now) can run while the async snapshot thread is
+        # mid-write — a shared tmp would interleave two pickles into
+        # garbage, and an older writer finishing LAST would clobber the
+        # newer snapshot.  os.replace keeps each install atomic.
+        tmp = (f"{self.persistence_path}.tmp."
+               f"{_threading.get_ident()}")
         with open(tmp, "wb") as f:
             pickle.dump(state, f)
-        os.replace(tmp, self.persistence_path)
+        with self._snap_write_lock:
+            if seq < self._written_seq:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return   # a newer snapshot already landed
+            os.replace(tmp, self.persistence_path)
+            self._written_seq = seq
 
-    def _encode_replica(self, state: dict) -> dict:
+    def _encode_replica(self, state: dict, seq: int) -> dict:
         import pickle
-        self._replica_seq += 1
-        return {"t": "head_snapshot", "seq": self._replica_seq,
+        return {"t": "head_snapshot", "seq": seq, "boot": self._boot_id,
                 "session": self.session, "data": pickle.dumps(state)}
 
     def _fan_out_replicas(self, msg: dict) -> None:
@@ -190,9 +219,14 @@ class HeadService(ClusterStoreMixin, EventLoopService):
     def _snapshot(self, sync: bool = False) -> None:
         state = self._build_snapshot_state()
         self._dirty = False
+        # seq assigned HERE on the loop thread: both paths get a total
+        # order, and nodes use it to drop a stale async replica that
+        # fans out after a newer snapshot_now one
+        self._replica_seq += 1
+        seq = self._replica_seq
         if sync:
-            self._write_snapshot(state)
-            self._fan_out_replicas(self._encode_replica(state))
+            self._write_snapshot(state, seq)
+            self._fan_out_replicas(self._encode_replica(state, seq))
             return
         if self._snapshot_writing:
             self._dirty = True   # retry next tick
@@ -201,10 +235,10 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
         def work():
             try:
-                self._write_snapshot(state)
+                self._write_snapshot(state, seq)
                 # the expensive state pickle happens HERE, off-thread —
                 # only the per-node sends return to the loop thread
-                msg = self._encode_replica(state)
+                msg = self._encode_replica(state, seq)
                 self.post(lambda: self._fan_out_replicas(msg))
             except Exception:
                 import traceback
@@ -214,6 +248,19 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         import threading
         threading.Thread(target=work, daemon=True,
                          name="raytpu-head-snapshot").start()
+
+    def _h_snapshot_now(self, rec: ClientRec, m: dict) -> None:
+        """Force a durable snapshot + replica fan-out NOW and reply
+        after the fan-out pushes are queued — on each node's head
+        channel the replica strictly precedes this reply, so a caller
+        that sees the reply can rely on its own node's replica being
+        on disk (event-driven replication barrier; used by tests and
+        pre-maintenance flushes instead of polling the 0.5 s cycle)."""
+        if self.persistence_path:
+            self._snapshot(sync=True)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True,
+                        replicated=bool(self.persistence_path))
 
     def _restore_snapshot(self) -> None:
         import pickle
@@ -348,10 +395,20 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                     used = tot - n.available.get(k, 0.0)
                     util = max(util, used / tot)
             util_rank = 0.0 if util < thr else util
+            # backlog per unit capacity: once every node is saturated
+            # (fits_now False across the board, util ties at 1.0), the
+            # spread signal is how much demand is already PARKED there —
+            # last heartbeat's queue plus optimistic routes since
+            backlog = 0.0
+            for k, v in demand.items():
+                tot = n.total.get(k, 0.0)
+                if tot > 0 and v > 0:
+                    parked = n.queued.get(k, 0.0) + n.routed.get(k, 0.0)
+                    backlog = max(backlog, parked / tot)
             locality = sum(1 for ob in arg_ids
                            if h in self.object_locs.get(ob, ()))
-            key = (fits_now, -counts.get(h, 0), -util_rank, locality,
-                   h == prefer)
+            key = (fits_now, -counts.get(h, 0), -util_rank, -backlog,
+                   locality, h == prefer)
             if best_key is None or key > best_key:
                 best_key, pool = key, [h]
             elif key == best_key:
@@ -408,6 +465,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             n.available = dict(m["available"])
             n.total = dict(m["total"])
             n.queued = dict(m.get("queued") or {})
+            n.routed = {}
         if self.pending_pgs:
             self._try_place_pending_pgs()
         if "reqid" in m:
@@ -573,7 +631,15 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         tn = self.nodes.get(target)
         if tn is not None:
             for k, v in self._demand(spec).items():
-                tn.available[k] = max(0.0, tn.available.get(k, 0.0) - v)
+                avail = tn.available.get(k, 0.0)
+                tn.available[k] = max(0.0, avail - v)
+                if v > 0 and avail < v:
+                    # node saturated: the UNMET portion of this routing
+                    # parks in its queue — count it so the next choice
+                    # spreads (charging full v would overstate backlog
+                    # on a fractionally-short node)
+                    tn.routed[k] = tn.routed.get(k, 0.0) + (v - max(
+                        0.0, avail))
         if target == rec.node_hex:
             self._reply(rec, m["reqid"], local=True, node=target)
             return

@@ -1,0 +1,212 @@
+"""In-process loopback transport ("lane") for the control plane.
+
+When both endpoints of a control-plane link live in one process — the
+driver talking to the node thread it started (``ray_tpu.init()``), the
+in-process TPU executor, or every node/head of a virtual cluster
+(``cluster_utils``) — the socket stack is pure overhead: each message
+pays encode + sendall + select wakeup + recv + decode, and on the
+client side an extra receive-thread hop, for bytes that never leave the
+process.  A lane hands the message OBJECT across threads instead: sends
+post straight onto the service's event loop, and service→client pushes
+run a deliver callback (or land in a queue) with no serialization and
+no syscalls.  This is the loopback analogue of the reference's
+same-process direct-call fast path (reference: core_worker.cc submits
+to local raylet over a unix socket; in-process work skips the RPC
+stack entirely).
+
+Lane endpoints keep the ``protocol.Connection`` surface (``send`` /
+``send_batch`` / ``send_blob`` / ``recv`` / ``close``), so callers are
+transport-agnostic: ``protocol.connect`` returns a lane whenever the
+target address is a service registered in THIS process.
+
+Isolation: inter-service links (node↔head, node↔node; ``copy=True``)
+pickle-roundtrip each message because both sides mutate and retain
+specs — exactly the isolation a socket gave them, minus the syscalls
+and wakeups.  Client links (driver/TPU-executor ↔ node) share the
+objects directly; the client never mutates a message after send.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import sys
+import threading
+import traceback
+from typing import Callable, Optional
+
+# address -> EventLoopService living in this process.  Services register
+# at startup and unregister at cleanup; a hit proves the peer is local.
+_services: dict = {}
+_lock = threading.Lock()
+
+
+def register_service(svc) -> None:
+    with _lock:
+        _services[svc.address] = svc
+
+
+def unregister_service(svc) -> None:
+    with _lock:
+        if _services.get(svc.address) is svc:
+            del _services[svc.address]
+
+
+def lookup(address: str):
+    with _lock:
+        return _services.get(address)
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_LOCAL_LANE", "1").lower() \
+        not in ("0", "false", "no")
+
+
+class _LaneSock:
+    """Socket stand-in for lane ClientRecs — the event loop never
+    selects on it, but generic cleanup paths call these."""
+
+    def close(self) -> None:
+        pass
+
+    def setblocking(self, flag: bool) -> None:
+        pass
+
+    def sendall(self, data) -> None:
+        pass
+
+
+_CLOSED = object()
+
+
+class LaneConnection:
+    """Client-side endpoint of an in-process lane to one service."""
+
+    encoding = "pickle"   # Connection-surface parity; never used to encode
+
+    def __init__(self, svc, copy: bool = False):
+        self._svc = svc
+        self._copy = copy
+        self._rx: queue.SimpleQueue = queue.SimpleQueue()
+        # service→client fast path: when set, pushes are delivered by
+        # calling this on the SERVICE LOOP THREAD (must be quick and
+        # never block) instead of landing in the recv queue
+        self.deliver: Optional[Callable[[dict], None]] = None
+        self.on_close: Optional[Callable[[], None]] = None
+        self._closed = threading.Event()
+        self.rec = None
+        svc._attach_lane(self)   # populates self.rec (waits on the loop)
+
+    @property
+    def sock(self):   # Connection-surface parity (never selected on)
+        return None
+
+    # ------------------------------------------------- client -> service
+
+    def _iso(self, msg: dict) -> dict:
+        if self._copy:
+            return pickle.loads(pickle.dumps(msg, protocol=5))
+        return msg
+
+    def send(self, msg: dict) -> None:
+        self._post([self._iso(msg)])
+
+    def send_batch(self, msgs: list) -> None:
+        self._post([self._iso(m) for m in msgs])
+
+    def send_blob(self, meta: dict, data) -> None:
+        m = dict(meta)
+        m["data"] = bytes(data) if self._copy else data
+        self._post([m])
+
+    def _post(self, msgs: list) -> None:
+        from ray_tpu.core.protocol import ConnectionClosed
+        if self._closed.is_set():
+            raise ConnectionClosed("lane closed")
+        svc, rec = self._svc, self.rec
+
+        def run():
+            if rec.closed or svc.clients.get(rec.conn_id) is not rec:
+                return
+            for m in msgs:
+                svc._dispatch(rec, m)
+        svc.post(run)
+
+    # ------------------------------------------------- service -> client
+
+    def _deliver(self, msg: dict) -> None:
+        """Runs on the service loop thread (from _push)."""
+        if self._copy:
+            # inter-service links isolate BOTH directions: a pushed view
+            # or spec may reference the sender's live mutable state
+            # (e.g. the head's per-node availability dicts), and the
+            # receiver mutates specs it admits
+            msg = pickle.loads(pickle.dumps(msg, protocol=5))
+        cb = self.deliver
+        if cb is not None:
+            try:
+                cb(msg)
+            except Exception:
+                sys.stderr.write("[lane] deliver callback failed:\n"
+                                 + traceback.format_exc())
+        else:
+            self._rx.put(msg)
+
+    def set_deliver(self, cb: Callable[[dict], None]) -> None:
+        """Switch to direct delivery AFTER some recv() use (e.g. a
+        bootstrap handshake).  The swap runs on the service loop thread
+        — the only thread that delivers — so queued messages drain to
+        `cb` strictly before any later direct delivery."""
+        def swap():
+            while True:
+                try:
+                    msg = self._rx.get_nowait()
+                except queue.Empty:
+                    break
+                if msg is _CLOSED:
+                    self._rx.put(_CLOSED)
+                    break
+                try:
+                    cb(msg)
+                except Exception:
+                    sys.stderr.write("[lane] deliver callback failed:\n"
+                                     + traceback.format_exc())
+            self.deliver = cb
+        self._svc.post(swap)
+
+    def recv(self, timeout: Optional[float] = None) -> dict:
+        import socket as _socket
+        try:
+            msg = self._rx.get(timeout=timeout)
+        except queue.Empty:
+            raise _socket.timeout("lane recv timed out") from None
+        if msg is _CLOSED:
+            from ray_tpu.core.protocol import ConnectionClosed
+            self._rx.put(_CLOSED)   # keep the sentinel for other waiters
+            raise ConnectionClosed("lane closed")
+        return msg
+
+    # ------------------------------------------------------------ close
+
+    def _mark_closed(self) -> None:
+        """Either side closed: wake recv()ers and tell the owner."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self._rx.put(_CLOSED)
+        cb = self.on_close
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                sys.stderr.write("[lane] on_close callback failed:\n"
+                                 + traceback.format_exc())
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._mark_closed()
+        svc, rec = self._svc, self.rec
+        if rec is not None:
+            svc.post(lambda: svc._drop_client(rec))

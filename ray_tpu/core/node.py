@@ -225,6 +225,15 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         _LOCAL_NODES_BY_HEX[self.node_id.hex()] = self
         self.stop_on_driver_exit = stop_on_driver_exit
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        # same-host workers connect over a unix socket (cheaper per
+        # message than TCP loopback); falls back to the TCP address
+        self.worker_address = self.address
+        try:
+            port = self.address.rsplit(":", 1)[1]
+            self.worker_address = self.add_unix_listener(
+                os.path.join(session_dir, f"node-{port}.sock"))
+        except OSError:
+            pass
 
         ncpu = num_cpus if num_cpus is not None else float(os.cpu_count() or 1)
         self.total_resources: dict[str, float] = {"CPU": ncpu}
@@ -339,6 +348,13 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._oom_kills: dict[bytes, str] = {}     # task_id -> detail
         self.oom_kill_count = 0
 
+        # per-iteration coalescing for head/peer channels: handlers emit
+        # several small messages per task (location reports, owner
+        # pushes, forwards); one batched send per loop pass replaces a
+        # send (syscall or lane post + peer wakeup) per message
+        self._head_out: list = []
+        self._peer_out: dict[int, tuple] = {}   # id(conn) -> (conn, [msgs])
+
         self._last_hb = 0.0
         self._hb_period = config.heartbeat_period_ms / 1000.0
         # ticks must run at least as often as heartbeats are due
@@ -359,6 +375,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def on_tick(self) -> None:
         # periodic re-dispatch: recovers from missed wakeups and
         # re-evaluates worker-pool health (dead spawns etc.)
+        self._audit_worker_pool()
         self._schedule()
         self._rebalance()
         self._expire_stale_pins()
@@ -420,7 +437,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         for q in (self.runnable_cpu, self.runnable_tpu):
             while q and moved < 8:
                 spec = q[0]
-                if spec.get("_routed") or spec.get("placement_group"):
+                if spec.get("placement_group"):
                     break   # FIFO: don't reorder past an unmovable head
                 demand = self._demand(spec)
                 if all(self.available.get(k, 0.0) + 1e-9 >= v
@@ -428,11 +445,19 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                     break   # dispatches here as soon as a worker frees
                 if not self._cluster_has_capacity(spec):
                     break
+                # _routed (head-parked) specs move too: during a burst
+                # the head parks work on saturated nodes; when capacity
+                # appears LATER (autoscaler launch, drain elsewhere) the
+                # parked backlog must chase it.  No ping-pong: we only
+                # re-forward when the view shows another node free NOW,
+                # and the head ranks available-now targets first.
                 self._queue_pop(q)
                 self._forward_task(spec)
                 moved += 1
 
     def _cleanup(self) -> None:
+        from ray_tpu.core import local_lane
+        local_lane.unregister_service(self)
         _LOCAL_NODES_BY_HEX.pop(self.node_id.hex(), None)
         for rec in list(self.clients.values()):
             try:
@@ -464,7 +489,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 rec.sock.close()
             except OSError:
                 pass
+            if rec.lane is not None:
+                rec.lane._mark_closed()
         self.listener.close()
+        self._close_extra_listeners()
         self.sel.close()
         for conn in self._peer_conns.values():
             try:
@@ -497,6 +525,18 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # (per-node shm arenas) — replica validation uses the head's
         self.head_session = reply.get("session", "")
         self.head_conn = conn
+        self._start_head_recv(conn)
+
+    def _start_head_recv(self, conn) -> None:
+        """Route head pushes onto the event loop.  A lane connection
+        (same-process head) delivers straight from the head's loop —
+        no dedicated recv thread, one wakeup fewer per message."""
+        from ray_tpu.core.local_lane import LaneConnection
+        if isinstance(conn, LaneConnection):
+            conn.on_close = lambda: self.post(self._head_lost)
+            conn.set_deliver(
+                lambda m: self.post(lambda m=m: self._on_head_msg(m)))
+            return
         t = threading.Thread(target=self._head_recv_loop, daemon=True,
                              name="raytpu-node-head")
         t.start()
@@ -576,9 +616,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self.cluster_view = reply.get("view", {})
         self.head_session = reply.get("session",
                                       getattr(self, "head_session", ""))
-        t = threading.Thread(target=self._head_recv_loop, daemon=True,
-                             name="raytpu-node-head")
-        t.start()
+        self._start_head_recv(conn)
         try:
             # re-establish cluster-visible state: subscriptions, object
             # locations, actor liveness (a restarted head restored its
@@ -598,6 +636,47 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         except protocol.ConnectionClosed:
             self._head_lost()
 
+    def _head_send(self, msg: dict) -> None:
+        """Queue a head-bound message; the loop flushes the batch once
+        per iteration (_flush_corked).  Send failures surface there and
+        run the normal head-loss path."""
+        if self.head_conn is None:
+            return
+        self._head_out.append(msg)
+
+    def _conn_send(self, conn, msg: dict) -> None:
+        """Queue a peer-bound message for the per-iteration batch
+        flush."""
+        ent = self._peer_out.get(id(conn))
+        if ent is None:
+            self._peer_out[id(conn)] = (conn, [msg])
+        else:
+            ent[1].append(msg)
+
+    def _flush_corked(self) -> None:
+        if self._head_out:
+            out, self._head_out = self._head_out, []
+            conn = self.head_conn
+            if conn is not None:
+                try:
+                    if len(out) == 1:
+                        conn.send(out[0])
+                    else:
+                        conn.send_batch(out)
+                except protocol.ConnectionClosed:
+                    self._head_lost()
+        if self._peer_out:
+            batches, self._peer_out = self._peer_out, {}
+            for conn, msgs in batches.values():
+                try:
+                    if len(msgs) == 1:
+                        conn.send(msgs[0])
+                    else:
+                        conn.send_batch(msgs)
+                except (protocol.ConnectionClosed, OSError):
+                    pass   # peer drop is handled by its recv/on_close path
+        super()._flush_corked()
+
     def _head_rpc(self, msg: dict, cb=None) -> None:
         if self.head_conn is None:
             if cb is not None:
@@ -607,13 +686,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._head_seq += 1
             msg["reqid"] = self._head_seq
             self._head_pending[self._head_seq] = cb
-        try:
-            self.head_conn.send(msg)
-        except protocol.ConnectionClosed:
-            self._head_pending.pop(msg.get("reqid", -1), None)
-            self._head_lost()
-            if cb is not None:
-                cb({"error": "no head connection"})
+        self._head_send(msg)
 
     def _on_head_msg(self, m: dict) -> None:
         if m.get("t") == "reply":
@@ -637,10 +710,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _head_reply(self, reqid: int, **kw) -> None:
         kw["t"] = "reply"
         kw["reqid"] = reqid
-        try:
-            self.head_conn.send(kw)
-        except (protocol.ConnectionClosed, AttributeError):
-            pass
+        self._head_send(kw)
 
     def _heartbeat(self) -> None:
         if self.head_conn is None or self._hb_inflight:
@@ -878,10 +948,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._owner_watch.pop(ob, None)
         if self.head_conn is not None and not info.loc_reported:
             info.loc_reported = True
-            try:
-                self.head_conn.send({"t": "report_locations", "adds": [ob]})
-            except protocol.ConnectionClosed:
-                self._head_lost()
+            self._head_send({"t": "report_locations", "adds": [ob]})
         if self.head_conn is not None and info.owner_node:
             # tell the object's OWNER a copy lives here — the owner, not
             # the head, serves location queries for owned objects
@@ -1063,11 +1130,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         for b in m["object_ids"]:
             self._delete_local_object(ObjectID(b))
         if self.head_conn is not None:
-            try:
-                self.head_conn.send({"t": "free_objects",
-                                     "object_ids": list(m["object_ids"])})
-            except protocol.ConnectionClosed:
-                self._head_lost()
+            self._head_send({"t": "free_objects",
+                             "object_ids": list(m["object_ids"])})
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
 
@@ -1154,11 +1218,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             freed.append(oid.binary())
         if freed and self.head_conn is not None:
             # replicas pulled to other nodes die with the owner's copy
-            try:
-                self.head_conn.send({"t": "free_objects",
-                                     "object_ids": freed})
-            except protocol.ConnectionClosed:
-                self._head_lost()
+            self._head_send({"t": "free_objects", "object_ids": freed})
 
     # -- functions
 
@@ -1166,12 +1226,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._store_function(m["function_id"], m["pickled"])
         if self.head_conn is not None:
             # cluster-wide export so any node's workers can fetch it
-            try:
-                self.head_conn.send({"t": "register_function",
-                                     "function_id": m["function_id"],
-                                     "pickled": m["pickled"]})
-            except protocol.ConnectionClosed:
-                self._head_lost()
+            self._head_send({"t": "register_function",
+                             "function_id": m["function_id"],
+                             "pickled": m["pickled"]})
         if "reqid" in m:
             self._reply(rec, m["reqid"], ok=True)
 
@@ -1335,11 +1392,16 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._fail_task(spec, "Infeasible resource demand: "
                             f"{self._demand(spec)} on {self.total_resources}")
             return
-        elif (clustered and not self._available_covers(spec)
-              and self._cluster_has_capacity(spec)):
-            # spillover: another node can run it NOW, we can't
-            # (reference: hybrid scheduling policy spills when the local
-            # node is saturated, hybrid_scheduling_policy.h)
+        elif clustered and not self._available_covers(spec):
+            # spillover: we can't run it NOW — let the head place it.
+            # The head ranks by availability AND parked backlog, so this
+            # must not be gated on the view showing free capacity: the
+            # view's availability is optimistically debited to zero
+            # during any burst, and gating on it made a submitter keep
+            # ~95% of a 4000-task burst while seven nodes sat idle
+            # (reference: saturated tasks go to the cluster scheduler,
+            # cluster_task_manager.h — placement is ITS call, not the
+            # submitting raylet's)
             self._forward_task(spec)
             return
         if spec.get("_routed") and not self._feasible(spec):
@@ -1613,14 +1675,28 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         for b in spec["return_ids"]:
             self._seal_error_object(ObjectID(b), RuntimeError(error))
 
+    def _audit_worker_pool(self) -> None:
+        """Self-heal the in-flight spawn counter against crashed spawns
+        and prune long-dead procs.  Runs on the periodic tick, NOT per
+        event: each liveness probe is a waitpid/kill syscall per proc,
+        and at thousands of events/s this scan alone was ~45% of the
+        node loop (sampled; the 5 ms throttle still admitted it every
+        few events)."""
+        alive = [p for p in self._worker_procs if p.poll() is None]
+        if len(self._worker_procs) - len(alive) > 32:
+            self._worker_procs = alive
+        registered = sum(1 for c in self.clients.values()
+                         if c.kind == "worker" and not c.tpu)
+        # on_tick runs _schedule() right after this, so just correct
+        # the counter here
+        self._spawning = max(0, len(alive) - registered)
+
     def _maybe_spawn_worker(self, tpu: bool = False) -> None:
         if tpu:
             return  # TPU executors are registered by the driver, not spawned
-        # Throttle: this runs on EVERY submit/completion event, but its
-        # demand scan is O(workers + clients) with a waitpid per proc —
-        # at thousands of events/s the scan itself became the scheduler's
-        # biggest cost.  Pool sizing only needs to be right within a few
-        # ms; the periodic tick re-evaluates regardless.
+        # Throttle: this runs on EVERY submit/completion event.  Pool
+        # sizing only needs to be right within a few ms; the periodic
+        # tick re-audits (and self-heals `_spawning`) regardless.
         now = time.monotonic()
         if now - getattr(self, "_last_spawn_eval", 0.0) < 0.005:
             # re-arm so a lone skipped event still gets its evaluation
@@ -1634,16 +1710,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 self.post_later(0.006, rearm)
             return
         self._last_spawn_eval = now
-        # Self-heal the in-flight spawn counter against crashed spawns;
-        # prune long-dead procs so the scan doesn't grow with history.
-        dead = [p for p in self._worker_procs if p.poll() is not None]
-        if len(dead) > 32:
-            self._worker_procs = [p for p in self._worker_procs
-                                  if p.poll() is None]
-        alive_procs = sum(1 for p in self._worker_procs if p.poll() is None)
         registered = sum(1 for c in self.clients.values()
                          if c.kind == "worker" and not c.tpu)
-        self._spawning = max(0, alive_procs - registered)
         # Demand-driven pool growth (reference: worker_pool.h capped startup
         # concurrency :192): one worker per waiting task/actor, capped.
         n_actors_waiting = sum(
@@ -1695,7 +1763,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             err = open(errp, "ab", buffering=0)
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.core.worker",
-                 "--address", self.address, "--session", self.session],
+                 "--address", self.worker_address,
+                 "--session", self.session],
                 env=env, stdout=out, stderr=err, start_new_session=True)
         self._worker_procs.append(proc)
         # stack dumps / the dashboard log view need pid -> log mapping
@@ -1767,7 +1836,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             return None
         import json as _json
         try:
-            req = {"address": self.address, "stdout": outp, "stderr": errp,
+            req = {"address": self.worker_address,
+                   "stdout": outp, "stderr": errp,
                    "env": {"RAY_TPU_SESSION": self.session}}
             self._prefork_conn.sendall(_json.dumps(req).encode() + b"\n")
             while b"\n" not in self._prefork_buf:
@@ -1888,13 +1958,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         """State fan-out: via the head in cluster mode (it publishes and
         resolves watchers), locally otherwise."""
         if self.head_conn is not None:
-            try:
-                self.head_conn.send({"t": "actor_state_report",
-                                     "actor_id": ar.actor_id.binary(),
-                                     "state": ar.state,
-                                     "death_cause": ar.death_cause})
-            except protocol.ConnectionClosed:
-                self._head_lost()
+            self._head_send({"t": "actor_state_report",
+                             "actor_id": ar.actor_id.binary(),
+                             "state": ar.state,
+                             "death_cause": ar.death_cause})
         else:
             self._publish_local("actor_state",
                                 {"actor_id": ar.actor_id.hex(),
@@ -2172,10 +2239,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         reqid = m.get("reqid")
         fwd = {k: v for k, v in m.items() if k != "reqid"}
         if reqid is None:
-            try:
-                self.head_conn.send(fwd)
-            except protocol.ConnectionClosed:
-                self._head_lost()
+            self._head_send(fwd)
             return
 
         def cb(reply):
@@ -2261,6 +2325,16 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         if m.get("session") not in (None, getattr(self, "head_session",
                                                   "")):
             return   # a different cluster's state must never land here
+        # seq fence per head incarnation: a slow async snapshot can fan
+        # out AFTER a newer snapshot_now one — applying it would undo
+        # the barrier's guarantee (and lose whatever the newer snapshot
+        # captured on a later head-machine recovery)
+        boot = m.get("boot")
+        if boot != getattr(self, "_head_replica_boot", None):
+            self._head_replica_boot = boot
+            self._head_replica_seq = 0
+        if m.get("seq", 0) < getattr(self, "_head_replica_seq", 0):
+            return   # stale replica from an older snapshot
         path = os.path.join(self.session_dir, "head_replica.state")
         tmp = path + ".tmp"
         try:
@@ -2356,22 +2430,16 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             # clients fan out from the node (reference: pubsub long-poll
             # through the raylet)
             self._head_subs.add(ch)
-            try:
-                self.head_conn.send({"t": "subscribe", "channel": ch})
-            except protocol.ConnectionClosed:
-                self._head_lost()
+            self._head_send({"t": "subscribe", "channel": ch})
         super()._h_subscribe(rec, m)
 
     def _publish(self, channel: str, data: Any) -> None:
         if self.head_conn is not None:
             # cluster-wide: the head fans out to subscribed nodes
             # (including this one), which deliver locally on _hh_pub
-            try:
-                self.head_conn.send({"t": "publish", "channel": channel,
-                                     "data": data})
-                return
-            except protocol.ConnectionClosed:
-                self._head_lost()
+            self._head_send({"t": "publish", "channel": channel,
+                             "data": data})
+            return
         self._publish_local(channel, data)
 
     def _hh_pub(self, m: dict) -> None:
@@ -2419,10 +2487,19 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         cbs = self._peer_connecting.pop(node_hex, [])
         if conn is not None:
             self._peer_conns[node_hex] = conn
-            t = threading.Thread(target=self._peer_recv_loop,
-                                 args=(node_hex, conn), daemon=True,
-                                 name=f"raytpu-peer-{node_hex[:8]}")
-            t.start()
+            from ray_tpu.core.local_lane import LaneConnection
+            if isinstance(conn, LaneConnection):
+                # same-process peer: deliver from its loop, no recv thread
+                conn.on_close = \
+                    lambda: self.post(lambda: self._drop_peer(node_hex))
+                conn.set_deliver(
+                    lambda m: self.post(
+                        lambda m=m: self._on_peer_msg(node_hex, m)))
+            else:
+                t = threading.Thread(target=self._peer_recv_loop,
+                                     args=(node_hex, conn), daemon=True,
+                                     name=f"raytpu-peer-{node_hex[:8]}")
+                t.start()
         for cb in cbs:
             try:
                 cb(conn)
@@ -2554,10 +2631,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         def go(conn):
             if conn is None:
                 return
-            try:
-                conn.send(msg)
-            except protocol.ConnectionClosed:
-                self._drop_peer(node_hex)
+            # corked: one owner push per finished task — the batch flush
+            # turns a per-task send into one send per loop pass (a dead
+            # peer is noticed by its recv/on_close path)
+            self._conn_send(conn, msg)
         self._peer_conn_async(node_hex, address, go)
 
     def _owner_add_location(self, ob: bytes, node_hex: str,
@@ -3450,15 +3527,20 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._reply(rec, m["reqid"], error=str(e))
             return
 
-        def collect(attempt: int = 0):
-            # the dump is async — poll THIS worker's own .err for growth
-            # (other workers' stderr chatter must not be misattributed)
+        def collect(attempt: int = 0, last: int = -1):
+            # The dump is async — poll THIS worker's own .err for growth
+            # (other workers' stderr chatter must not be misattributed),
+            # then wait until it QUIESCES: faulthandler writes the
+            # threads one at a time with the CURRENT thread (the one
+            # executing the task) LAST, so replying on first growth
+            # captured a partial dump missing exactly the frames the
+            # caller wants (`ray stack` showed only the recv thread).
             try:
                 size = os.path.getsize(err_path)
             except OSError:
                 size = start
-            if size <= start and attempt < 20:
-                self.post_later(0.05, lambda: collect(attempt + 1))
+            if attempt < 40 and (size <= start or size != last):
+                self.post_later(0.05, lambda: collect(attempt + 1, size))
                 return
             if size <= start:
                 self._reply(rec, m["reqid"],
@@ -3475,6 +3557,26 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _h_ping(self, rec, m):
         self._reply(rec, m["reqid"], ok=True, time=time.time())
+
+    def _h_head_flush(self, rec, m):
+        """Replication barrier: force the head to snapshot + fan out
+        replicas, reply once THIS node's replica has landed (the
+        head_snapshot push precedes the head's reply on this channel)."""
+        if self.head_conn is None:
+            self._reply(rec, m["reqid"], ok=True, replicated=False)
+            return
+        reqid = m["reqid"]
+
+        def cb(reply):
+            w = self.clients.get(rec.conn_id)
+            if w is None:
+                return
+            if reply.get("error"):
+                self._reply(w, reqid, error=reply["error"])
+            else:
+                self._reply(w, reqid, ok=True,
+                            replicated=bool(reply.get("replicated")))
+        self._head_rpc({"t": "snapshot_now"}, cb)
 
     def _h_stop_node(self, rec, m):
         """Hard-stop this node on request — the chaos-testing kill switch

@@ -169,6 +169,16 @@ class Connection:
 
 def connect(address: str, timeout: float = 30.0,
             remote: bool = False) -> Connection:
+    from ray_tpu.core import local_lane
+    if local_lane.enabled():
+        svc = local_lane.lookup(address)
+        if svc is not None:
+            # same-process peer: hand messages across threads instead of
+            # through the socket stack.  Inter-service links (remote=True)
+            # isolate each message with a pickle roundtrip — both ends
+            # mutate and retain specs — which is still far cheaper than
+            # encode+syscall+select+decode.
+            return local_lane.LaneConnection(svc, copy=remote)
     if address.startswith("unix://"):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(timeout)
@@ -182,6 +192,11 @@ def connect(address: str, timeout: float = 30.0,
                                                       timeout=timeout)
             return Connection(sock, encoding=default_encoding(remote))
         host, port = address.rsplit(":", 1)
+        if remote and host in ("127.0.0.1", "localhost", "::1"):
+            # the proto wire buys language-neutrality across MACHINES;
+            # a loopback "remote" link (virtual clusters, single-host
+            # multi-node) pays its 3-6x python encode cost for nothing
+            remote = False
         sock = socket.create_connection((host, int(port)), timeout=timeout)
     sock.settimeout(None)
     return Connection(sock, encoding=default_encoding(remote))

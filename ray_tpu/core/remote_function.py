@@ -69,6 +69,7 @@ class RemoteFunction:
         self._options = options
         self._function_id: Optional[str] = None
         self._exported_to = None
+        self._template: Optional[dict] = None
         functools.update_wrapper(self, fn)
 
     def options(self, **options) -> "RemoteFunction":
@@ -85,17 +86,38 @@ class RemoteFunction:
         if self._function_id is None or self._exported_to is not rt:
             self._function_id = rt.export_function(self._function)
             self._exported_to = rt
+            self._template = None
         o = self._options
-        return rt.submit_task(
-            self._function_id, args, kwargs,
-            name=o.get("name") or self._function.__qualname__,
-            num_returns=o.get("num_returns", 1),
-            resources=_resources_from_options(o),
-            num_tpus=float(o.get("num_tpus") or 0),
-            max_retries=o.get("max_retries",
-                              rt.client.config_dict["task_max_retries"]),
-            placement_group=_pg_tuple(o),
-            runtime_env=o.get("runtime_env"))
+        make_template = getattr(rt, "make_task_template", None)
+        if make_template is None:
+            # duck-typed runtimes (ray:// ClientRuntime) take the plain
+            # submit path
+            return rt.submit_task(
+                self._function_id, args, kwargs,
+                name=o.get("name") or self._function.__qualname__,
+                num_returns=o.get("num_returns", 1),
+                resources=_resources_from_options(o),
+                num_tpus=float(o.get("num_tpus") or 0),
+                max_retries=o.get("max_retries",
+                                  rt.client.config_dict["task_max_retries"]),
+                placement_group=_pg_tuple(o),
+                runtime_env=o.get("runtime_env"))
+        # The static spec fields (descriptor, resources, prepared env)
+        # are resolved once per (function, runtime) and cached — each
+        # call only stamps ids and args (reference: _raylet.pyx caches
+        # the serialized function descriptor on the RemoteFunction).
+        if self._template is None:
+            self._template = make_template(
+                self._function_id,
+                name=o.get("name") or self._function.__qualname__,
+                num_returns=o.get("num_returns", 1),
+                resources=_resources_from_options(o),
+                num_tpus=float(o.get("num_tpus") or 0),
+                max_retries=o.get("max_retries",
+                                  rt.client.config_dict["task_max_retries"]),
+                placement_group=_pg_tuple(o),
+                runtime_env=o.get("runtime_env"))
+        return rt.submit_task_template(self._template, args, kwargs)
 
     def bind(self, *args, **kwargs):
         """Lazy DAG node (reference: ray DAG .bind, dag/dag_node.py)."""
@@ -110,9 +132,12 @@ class RemoteFunction:
 
     def __getstate__(self):
         # The runtime handle is process-local (holds sockets) — the
-        # receiving process re-exports against its own runtime.
+        # receiving process re-exports against its own runtime.  The
+        # template embeds this process's worker_id (owner), so it must
+        # be rebuilt too.
         state = self.__dict__.copy()
         state["_exported_to"] = None
+        state["_template"] = None
         return state
 
 

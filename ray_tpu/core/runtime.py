@@ -180,25 +180,26 @@ class Runtime:
 
     # ------------------------------------------------------------- submit
 
-    def submit_task(self, function_id: str, args, kwargs, *,
-                    name: str = "", num_returns=1,
-                    resources: Optional[dict] = None,
-                    num_tpus: float = 0, max_retries: int = 0,
-                    placement_group=None, runtime_env=None):
+    def make_task_template(self, function_id: str, *,
+                           name: str = "", num_returns=1,
+                           resources: Optional[dict] = None,
+                           num_tpus: float = 0, max_retries: int = 0,
+                           placement_group=None, runtime_env=None) -> dict:
+        """Static spec fields resolved ONCE per RemoteFunction: env
+        preparation/hashing, resource map, descriptor — the per-call
+        path only stamps ids and args (reference: the task spec
+        builder caches the serialized function descriptor,
+        _raylet.pyx TaskSpecification reuse)."""
         env_h = ""
         if runtime_env:
             runtime_env, env_h = self._prepare_env(runtime_env)
-        task_id = self._next_task_id()
-        n_ret = 1 if num_returns == "dynamic" else max(num_returns, 0)
-        return_ids = [ObjectID.for_task_return(task_id, i + 1)
-                      for i in range(max(n_ret, 1))]
-        spec = {
-            "task_id": task_id.binary(),
+        return {
+            "task_id": b"",
             "kind": "task",
             "name": name,
             "function_id": function_id,
             "num_returns": num_returns,
-            "return_ids": [o.binary() for o in return_ids],
+            "return_ids": (),
             "resources": resources or {},
             "num_tpus": num_tpus,
             "max_retries": max_retries,
@@ -209,20 +210,47 @@ class Runtime:
             # core_worker.h — the caller, not the executor, owns results)
             "owner": self.client.worker_id,
         }
-        from ray_tpu.util.tracing import inject_context, start_span
-        tctx = inject_context()
-        if tctx is not None:
-            spec["trace_ctx"] = tctx
-        self._prepare_args(args, kwargs, spec)
-        with start_span(f"task::{name}.remote", kind="client",
-                        attributes={"task_id": task_id.hex()}):
+
+    def submit_task_template(self, template: dict, args, kwargs):
+        task_id = self._next_task_id()
+        num_returns = template["num_returns"]
+        n_ret = 1 if num_returns == "dynamic" else max(num_returns, 0)
+        returns = [ObjectID.for_task_return(task_id, i + 1)
+                   for i in range(max(n_ret, 1))]
+        spec = dict(template)
+        spec["task_id"] = task_id.binary()
+        spec["return_ids"] = [o.binary() for o in returns]
+        from ray_tpu.util.tracing import tracing_enabled
+        if tracing_enabled():
+            from ray_tpu.util.tracing import inject_context, start_span
+            tctx = inject_context()
+            if tctx is not None:
+                spec["trace_ctx"] = tctx
+            self._prepare_args(args, kwargs, spec)
+            with start_span(f"task::{spec['name']}.remote", kind="client",
+                            attributes={"task_id": task_id.hex()}):
+                self.client.send_soon({"t": "submit_task", "spec": spec})
+        else:
+            self._prepare_args(args, kwargs, spec)
             self.client.send_soon({"t": "submit_task", "spec": spec})
-        refs = [ObjectRef(o, owner=self.client.worker_id) for o in return_ids]
+        owner = self.client.worker_id
+        refs = [ObjectRef(o, owner=owner) for o in returns]
         if num_returns == "dynamic" or num_returns == 1:
             return refs[0]
         if num_returns == 0:
             return None
         return refs
+
+    def submit_task(self, function_id: str, args, kwargs, *,
+                    name: str = "", num_returns=1,
+                    resources: Optional[dict] = None,
+                    num_tpus: float = 0, max_retries: int = 0,
+                    placement_group=None, runtime_env=None):
+        template = self.make_task_template(
+            function_id, name=name, num_returns=num_returns,
+            resources=resources, num_tpus=num_tpus, max_retries=max_retries,
+            placement_group=placement_group, runtime_env=runtime_env)
+        return self.submit_task_template(template, args, kwargs)
 
     # ------------------------------------------------------------- actors
 

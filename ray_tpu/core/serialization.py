@@ -40,6 +40,10 @@ class SerializedObject:
                 + sum(8 + len(memoryview(b).cast("B")) for b in self.buffers))
 
     def to_bytes(self) -> bytes:
+        if not self.buffers:
+            # hot path: small inline objects (task args/returns)
+            return (len(self.inband).to_bytes(8, "little") + self.inband
+                    + _ZERO8)
         out = io.BytesIO()
         self.write_to(out)
         return out.getvalue()
@@ -68,6 +72,42 @@ class SerializedObject:
         return cls(inband=inband, buffers=bufs)
 
 
+_ZERO8 = (0).to_bytes(8, "little")
+
+
+class _ContextPickler(cloudpickle.Pickler):
+    """Module-level pickler class (defining it inside serialize() cost a
+    __build_class__ per call — measured on the worker hot path)."""
+
+    def __init__(self, f, *, buffer_callback, custom, nested_refs,
+                 device_capture, jax_types):
+        super().__init__(f, protocol=5, buffer_callback=buffer_callback)
+        self._custom = custom
+        self._nested_refs = nested_refs
+        self._device_capture = device_capture
+        self._jax_types = jax_types
+
+    def reducer_override(self, obj):  # noqa: N802
+        from ray_tpu.core.object_ref import ObjectRef
+        if isinstance(obj, ObjectRef):
+            self._nested_refs.append(obj)
+            return (_deserialize_object_ref, (obj.binary(), obj.owner))
+        jax_types = self._jax_types
+        if jax_types is not None and isinstance(obj, jax_types[0]) \
+                and not isinstance(obj, jax_types[1]):
+            self._device_capture.append(obj)
+            return (device_objects._device_leaf,
+                    (len(self._device_capture) - 1,))
+        for klass, (ser, de) in self._custom.items():
+            if isinstance(obj, klass):
+                return (_apply_custom, (de, ser(obj)))
+        # delegate to cloudpickle's own reducer_override — it is
+        # what pickles local functions/classes by value; returning
+        # NotImplemented here would skip it and fall back to
+        # pickle's by-reference lookup, which fails for closures
+        return super().reducer_override(obj)
+
+
 class SerializationContext:
     """Per-process serializer with pluggable custom reducers."""
 
@@ -94,7 +134,6 @@ class SerializationContext:
         buffers: list = []
         nested_refs: list = []
         threshold = self._out_of_band_threshold
-        custom = self._custom
         jax_types = (device_objects.try_jax_array_types()
                      if device_capture is not None else None)
 
@@ -105,28 +144,11 @@ class SerializationContext:
             buffers.append(raw)
             return False
 
-        class _Pickler(cloudpickle.Pickler):
-            def reducer_override(self, obj):  # noqa: N802
-                from ray_tpu.core.object_ref import ObjectRef
-                if isinstance(obj, ObjectRef):
-                    nested_refs.append(obj)
-                    return (_deserialize_object_ref, (obj.binary(), obj.owner))
-                if jax_types is not None and isinstance(obj, jax_types[0]) \
-                        and not isinstance(obj, jax_types[1]):
-                    device_capture.append(obj)
-                    return (device_objects._device_leaf,
-                            (len(device_capture) - 1,))
-                for klass, (ser, de) in custom.items():
-                    if isinstance(obj, klass):
-                        return (_apply_custom, (de, ser(obj)))
-                # delegate to cloudpickle's own reducer_override — it is
-                # what pickles local functions/classes by value; returning
-                # NotImplemented here would skip it and fall back to
-                # pickle's by-reference lookup, which fails for closures
-                return super().reducer_override(obj)
-
         f = io.BytesIO()
-        p = _Pickler(f, protocol=5, buffer_callback=buffer_callback)
+        p = _ContextPickler(f, buffer_callback=buffer_callback,
+                            custom=self._custom, nested_refs=nested_refs,
+                            device_capture=device_capture,
+                            jax_types=jax_types)
         p.dump(value)
         return SerializedObject(inband=f.getvalue(), buffers=buffers,
                                 nested_refs=nested_refs)

@@ -13,6 +13,8 @@ enough because bulk data rides the shared-memory plane, not this one.
 
 from __future__ import annotations
 
+import heapq
+import os
 import pickle
 import selectors
 import socket
@@ -50,9 +52,16 @@ class ClientRec:
     node_hex: str = ""           # for kind in (node, peer): peer node id
     encoding: str = "pickle"     # wire encoding this client speaks
     seen_envs: set = field(default_factory=set)  # runtime-env hashes run
+    # in-process clients (core/local_lane.py): pushes are handed over as
+    # objects on the loop thread instead of being framed onto a socket
+    lane: Any = None
 
 
 _WAKER = object()   # selector sentinel for the self-pipe
+
+
+def _NOOP() -> None:
+    pass
 
 
 class EventLoopService:
@@ -82,6 +91,7 @@ class EventLoopService:
                     self.address, host=listen_host, port=port)
 
         self._next_conn = 0
+        self._extra_listeners: list = []   # (socket, unlink_path)
         self.clients: dict[int, ClientRec] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -89,6 +99,20 @@ class EventLoopService:
         self._posted_lock = threading.Lock()
         self._last_tick = 0.0
         self.tick_interval = 0.25
+        # Opt-in adaptive busy-poll: for a short window after each event
+        # the loop polls (select timeout=0) instead of blocking — on
+        # hosts with spare cores and slow idle wakeups this skips a
+        # cold epoll wake on every hot-path message (reference:
+        # gRPC/DPDK-style busy polling).  Default OFF: on small hosts
+        # the spinning loop steals cycles from the workers it serves
+        # (measured 2x WORSE on a 2-core box).
+        import os as _os
+        self._spin_s = float(_os.environ.get("RAY_TPU_SPIN_US", "0")) / 1e6
+        self._spin_until = 0.0
+        # deferred callbacks (post_later): min-heap drained by the loop
+        self._timers: list = []
+        self._timer_seq = 0
+        self._timer_lock = threading.Lock()
         # self-pipe waker: post() from another thread (peer receivers,
         # the head channel, timers) must interrupt select() NOW — waiting
         # out the poll timeout adds up to 50 ms to every cross-thread
@@ -101,6 +125,9 @@ class EventLoopService:
         # outbound RPC correlation: reqid -> callback(reply_msg)
         self._rpc_seq = 0
         self._rpc_pending: dict[int, Callable[[dict], None]] = {}
+        # same-process clients skip the socket stack entirely
+        from ray_tpu.core import local_lane
+        local_lane.register_service(self)
         # write coalescing: _push appends to rec.wbuf and the loop sends
         # each connection's accumulated frames in ONE syscall per
         # iteration — N small sends per event (task_done -> dispatch ->
@@ -121,9 +148,38 @@ class EventLoopService:
                     pass   # already saturated: the loop will wake anyway
 
     def post_later(self, delay: float, fn) -> None:
-        t = threading.Timer(delay, lambda: self.post(fn))
-        t.daemon = True
-        t.start()
+        """Run `fn` on the loop thread after ~`delay` seconds.  Timers
+        ride the select timeout (a heap popped each iteration) — the
+        previous per-call threading.Timer burned a whole thread
+        start/join per deferred call, which at thousands of
+        events/s was a measurable slice of the scheduler's CPU."""
+        deadline = time.monotonic() + delay
+        with self._timer_lock:
+            self._timer_seq += 1
+            heapq.heappush(self._timers, (deadline, self._timer_seq, fn))
+            wake = self._timers[0][0] == deadline
+        if wake and threading.current_thread() is not self._thread:
+            # new earliest deadline: force a loop pass so the select
+            # timeout shrinks to it
+            self.post(_NOOP)
+
+    def _run_due_timers(self, now: float) -> None:
+        while True:
+            with self._timer_lock:
+                if not self._timers or self._timers[0][0] > now:
+                    return
+                _, _, fn = heapq.heappop(self._timers)
+            try:
+                fn()
+            except Exception:
+                sys.stderr.write(f"[{self.name}] timer callback failed:\n"
+                                 + traceback.format_exc())
+
+    def _next_timeout(self, now: float) -> float:
+        with self._timer_lock:
+            if not self._timers:
+                return 0.05
+            return min(0.05, max(0.0, self._timers[0][0] - now))
 
     def start_thread(self) -> None:
         self._thread = threading.Thread(target=self.run,
@@ -147,6 +203,7 @@ class EventLoopService:
                     sys.stderr.write(f"[{self.name}] posted callback "
                                      "failed:\n" + traceback.format_exc())
             now = time.monotonic()
+            self._run_due_timers(now)
             if now - self._last_tick > self.tick_interval:
                 self._last_tick = now
                 try:
@@ -158,9 +215,13 @@ class EventLoopService:
             # event handlers) queued goes out now, one syscall per peer
             self._flush_corked()
             try:
-                events = self.sel.select(timeout=0.05)
+                events = self.sel.select(
+                    timeout=0 if now < self._spin_until
+                    else self._next_timeout(now))
             except OSError:
                 continue
+            if events or self._posted:
+                self._spin_until = time.monotonic() + self._spin_s
             for key, mask in events:
                 if key.data is _WAKER:
                     try:
@@ -169,7 +230,7 @@ class EventLoopService:
                     except (BlockingIOError, OSError):
                         pass
                 elif key.data is None:
-                    self._accept()
+                    self._accept(key.fileobj)
                 else:
                     rec: ClientRec = key.data
                     try:
@@ -208,6 +269,8 @@ class EventLoopService:
         pass
 
     def _cleanup(self) -> None:
+        from ray_tpu.core import local_lane
+        local_lane.unregister_service(self)
         for rec in list(self.clients.values()):
             try:
                 self._push(rec, {"t": "shutdown"})
@@ -219,7 +282,10 @@ class EventLoopService:
                 rec.sock.close()
             except OSError:
                 pass
+            if rec.lane is not None:
+                rec.lane._mark_closed()
         self.listener.close()
+        self._close_extra_listeners()
         for s in (self._waker_r, self._waker_w):
             try:
                 s.close()
@@ -229,13 +295,32 @@ class EventLoopService:
 
     # ----------------------------------------------------------------- io
 
-    def _accept(self) -> None:
+    def add_unix_listener(self, path: str) -> str:
+        """Second accept socket on a unix path — same-host clients
+        (worker pool) skip the TCP loopback stack, which costs ~1.5x a
+        unix send per message on some hosts (reference: the raylet
+        serves local workers over a unix socket, node_manager.cc)."""
+        lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
-            sock, _ = self.listener.accept()
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        lst.bind(path)
+        lst.listen(512)
+        lst.setblocking(False)
+        self.sel.register(lst, selectors.EVENT_READ, None)
+        self._extra_listeners.append((lst, path))
+        return "unix://" + path
+
+    def _accept(self, listener=None) -> None:
+        lst = listener if listener is not None else self.listener
+        try:
+            sock, _ = lst.accept()
         except OSError:
             return
         sock.setblocking(False)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if sock.family != socket.AF_UNIX:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._next_conn += 1
         rec = ClientRec(conn_id=self._next_conn, sock=sock)
         self.clients[rec.conn_id] = rec
@@ -280,10 +365,38 @@ class EventLoopService:
         if not rec.wbuf:
             self.sel.modify(rec.sock, selectors.EVENT_READ, rec)
 
+    def _attach_lane(self, lane) -> None:
+        """Register an in-process client (core/local_lane.py) as a
+        ClientRec.  Runs the mutation on the loop thread; the caller
+        blocks until its rec exists so its first send can't race."""
+        done = threading.Event()
+
+        def attach():
+            self._next_conn += 1
+            rec = ClientRec(conn_id=self._next_conn, sock=None)
+            from ray_tpu.core.local_lane import _LaneSock
+            rec.sock = _LaneSock()
+            rec.lane = lane
+            self.clients[rec.conn_id] = rec
+            lane.rec = rec
+            done.set()
+        self.post(attach)
+        if not done.wait(timeout=10.0):
+            raise RuntimeError(f"[{self.name}] lane attach timed out "
+                               "(service loop not running?)")
+
     def _push_blob(self, rec: ClientRec, meta: dict, data) -> None:
         """Queue a bulk frame without pickling `data` (one copy into the
         write buffer instead of slice+pickle+buffer)."""
         if rec.closed:
+            return
+        if rec.lane is not None:
+            m = dict(meta)
+            # the receiver must own the payload: the source buffer is a
+            # view into this service's arena and can be evicted after
+            # the push (a socket send would have copied it to the wire)
+            m["data"] = bytes(data)
+            rec.lane._deliver(m)
             return
         from ray_tpu.core.protocol import blob_frame_parts
         for part in blob_frame_parts(meta, data):
@@ -298,6 +411,9 @@ class EventLoopService:
 
     def _push(self, rec: ClientRec, msg: dict) -> None:
         if rec.closed:
+            return
+        if rec.lane is not None:
+            rec.lane._deliver(msg)
             return
         rec.wbuf += dumps_frame(msg, rec.encoding)
         if threading.current_thread() is self._thread:
@@ -335,6 +451,8 @@ class EventLoopService:
             self._write_out(rec)
 
     def _flush(self, rec: ClientRec) -> None:
+        if rec.lane is not None:
+            return
         rec.sock.setblocking(True)
         if rec.wbuf:
             try:
@@ -342,6 +460,18 @@ class EventLoopService:
             except OSError:
                 pass
             rec.wbuf.clear()
+
+    def _close_extra_listeners(self) -> None:
+        for lst, path in self._extra_listeners:
+            try:
+                lst.close()
+            except OSError:
+                pass
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._extra_listeners = []
 
     def _reply(self, rec: ClientRec, reqid: int, **kw) -> None:
         kw["t"] = "reply"
@@ -391,14 +521,17 @@ class EventLoopService:
         if rec.closed:
             return
         rec.closed = True
-        try:
-            self.sel.unregister(rec.sock)
-        except (KeyError, ValueError):
-            pass
-        try:
-            rec.sock.close()
-        except OSError:
-            pass
+        if rec.lane is None:
+            try:
+                self.sel.unregister(rec.sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                rec.sock.close()
+            except OSError:
+                pass
+        else:
+            rec.lane._mark_closed()
         self.clients.pop(rec.conn_id, None)
         self.on_client_drop(rec)
 

@@ -41,10 +41,7 @@ from ray_tpu.ops.attention import attention
 from ray_tpu.ops.ring_attention import ring_attention
 from ray_tpu.parallel.sharding import (DEFAULT_LLM_RULES, Rules, spec_for)
 
-try:  # jax>=0.9 top-level export
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from ray_tpu.parallel.jax_compat import shard_map
 
 
 @dataclass(frozen=True)

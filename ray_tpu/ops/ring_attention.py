@@ -66,8 +66,11 @@ def ring_attention(q, k, v, axis_name: str, *, causal: bool = True,
     if hasattr(jax.lax, "pcast"):  # jax>=0.9 spelling of pvary
         def _pvary(x, axes):
             return jax.lax.pcast(x, axes, to="varying")
-    else:  # pragma: no cover - older jax
+    elif hasattr(jax.lax, "pvary"):  # pragma: no cover - 0.5/0.6 jax
         _pvary = jax.lax.pvary
+    else:  # pragma: no cover - pre-varying-types jax: shard_map has no
+        def _pvary(x, axes):  # rep/vma tracking, the cast is an identity
+            return x
     acc0, m0, l0 = _pvary(
         (jnp.zeros((b, h, sl, d), jnp.float32),
          jnp.full((b, h, sl, 1), NEG_INF, jnp.float32),

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec
-from jax import shard_map
+from ray_tpu.parallel.jax_compat import shard_map
 
 # ---------------------------------------------------------------------------
 # compiled plane — use inside shard_map'd / pjit'd functions

@@ -139,8 +139,16 @@ class GangMember:
             # must land before first backend touch in this fresh process
             _jax.config.update("jax_platforms", "cpu")
             if self.local_device_count:
-                _jax.config.update("jax_num_cpu_devices",
-                                   self.local_device_count)
+                try:
+                    _jax.config.update("jax_num_cpu_devices",
+                                       self.local_device_count)
+                except AttributeError:
+                    # pre-0.5 jax spelling; same pre-backend-init timing
+                    import os as _os
+                    _os.environ["XLA_FLAGS"] = (
+                        _os.environ.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count="
+                        + str(self.local_device_count))
         if self.world > 1 and not self._initialized:
             _jax.distributed.initialize(
                 coordinator_address=coordinator,

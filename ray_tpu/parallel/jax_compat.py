@@ -1,0 +1,43 @@
+"""Version-compat shims for jax APIs with moved/renamed surfaces.
+
+The toolchain image pins an older jax where ``shard_map`` lives in
+``jax.experimental.shard_map``, its replication check is spelled
+``check_rep`` (newer: top-level ``jax.shard_map`` with ``check_vma``),
+and partial-manual meshes use ``auto=`` (newer: ``axis_names=``).
+Callers write the NEW spelling and import from here; the shim
+translates downward when running on the older jax.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:                                    # jax >= 0.6 top-level export
+    from jax import shard_map as _shard_map
+except ImportError:                     # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f=None, **kw):
+    if "check_vma" in kw and "check_vma" not in _PARAMS:
+        kw["check_rep"] = kw.pop("check_vma")
+    if "axis_names" in kw and "axis_names" not in _PARAMS:
+        # old spelling is the complement: `auto` lists the mesh axes
+        # shard_map must NOT bind manually
+        axis_names = kw.pop("axis_names")
+        mesh = kw.get("mesh")
+        auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
+                if mesh is not None else frozenset())
+        if auto:
+            if "auto" not in _PARAMS:
+                # dropping the restriction would silently bind every
+                # mesh axis manually — wrong collectives, not an error
+                raise NotImplementedError(
+                    "this jax's shard_map supports neither axis_names "
+                    "nor auto; partial-manual meshes are unavailable")
+            kw["auto"] = auto
+    if f is None:
+        return lambda g: shard_map(g, **kw)
+    return _shard_map(f, **kw)

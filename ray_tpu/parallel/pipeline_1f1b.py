@@ -45,10 +45,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax>=0.9 top-level export
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from ray_tpu.parallel.jax_compat import shard_map
 
 
 class Schedule(NamedTuple):

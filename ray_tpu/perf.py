@@ -18,6 +18,10 @@ import time
 
 import numpy as np
 
+# bumped every growth round so committed evidence files (PERF_rNN.json)
+# are self-identifying; scale_envelope.py shares this stamp
+ROUND = 6
+
 
 def timeit(name: str, fn, multiplier: int = 1, unit: str = "ops/s",
            min_time: float = 1.0, quick: bool = False,
@@ -69,7 +73,7 @@ def _settle() -> None:
         time.sleep(0.3)
 
 
-def main(quick: bool = False) -> list[dict]:
+def main(quick: bool = False, out: str = "") -> list[dict]:
     import ray_tpu
 
     if ray_tpu.is_initialized():
@@ -78,9 +82,18 @@ def main(quick: bool = False) -> list[dict]:
             "run it in a process without an active ray_tpu.init()")
     ray_tpu.init(num_cpus=4, num_tpus=0)
     try:
-        return _run(quick)
+        results = _run(quick)
     finally:
         ray_tpu.shutdown()
+    if out:
+        import os
+        doc = {"round": ROUND, "quick": quick,
+               "env": {"physical_cores": os.cpu_count()},
+               "results": results}
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {out}")
+    return results
 
 
 def _run(quick: bool) -> list[dict]:
@@ -194,5 +207,7 @@ def _run(quick: bool) -> list[dict]:
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true")
+    p.add_argument("--out", default="",
+                   help=f"write a PERF_r{ROUND:02d}.json-style artifact")
     args = p.parse_args()
-    main(quick=args.quick)
+    main(quick=args.quick, out=args.out)

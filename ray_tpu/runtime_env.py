@@ -542,6 +542,8 @@ class applied_env:
         self._saved_cwd: Optional[str] = None
 
     def __enter__(self):
+        if not self.env:
+            return self   # hot path: the vast majority of tasks
         container = self.env.get("container")
         if container:
             # containerized envs only apply inside a worker that was

@@ -346,12 +346,13 @@ def test_head_machine_loss_recovers_from_node_replica():
         rt = ray_tpu.get_runtime()
         rt.client.kv_put(b"replicated", b"still-here")
 
-        # force a snapshot + replication cycle to land on the nodes
-        deadline = time.time() + 30
+        # event-driven replication barrier: the head snapshots + fans
+        # out synchronously and our node's replica precedes the reply
+        # on its head channel — no fixed window to race under suite load
+        reply = rt.client.request({"t": "head_flush"}, timeout=60)
+        assert reply.get("replicated"), reply
         replica = os.path.join(c.nodes[0].session_dir,
                                "head_replica.state")
-        while time.time() < deadline and not os.path.exists(replica):
-            time.sleep(0.2)
         assert os.path.exists(replica), "snapshot never replicated"
 
         c.restart_head(simulate_machine_loss=True)
@@ -360,7 +361,18 @@ def test_head_machine_loss_recovers_from_node_replica():
             if sum(1 for n in c.head.nodes.values() if n.alive) >= 2:
                 break
             time.sleep(0.2)
-        assert rt.client.kv_get(b"replicated") == b"still-here"
+        # the kv may need a beat to settle while nodes re-register:
+        # retry until the deadline rather than asserting one-shot
+        value = None
+        while time.time() < deadline:
+            try:
+                value = rt.client.kv_get(b"replicated")
+            except RuntimeError:
+                value = None   # head channel still re-establishing
+            if value == b"still-here":
+                break
+            time.sleep(0.2)
+        assert value == b"still-here"
     finally:
         ray_tpu.shutdown()
         c.shutdown()

@@ -125,3 +125,48 @@ def test_namespace_scoping(rt):
         ray_tpu.get_actor("ns_actor", namespace="team_b")
     h = ray_tpu.get_actor("ns_actor", namespace="team_a")
     assert rt.get(h.ok.remote(), timeout=60) == 1
+
+
+def test_prefork_template_death_recovers_worker_supply(rt):
+    """Kill the fork-server template mid-wave: in-flight work must
+    finish, and the pool must keep supplying NEW workers through the
+    cold-spawn fallback (`_maybe_spawn_worker` self-heal — previously
+    untested; the template is a single point of worker supply)."""
+    runtime = ray_tpu.get_runtime()
+    svc = runtime.node_service
+
+    @ray_tpu.remote(max_retries=4)
+    def wave_task(i):
+        time.sleep(0.05)
+        return i
+
+    # wave 1 warms the pool (template-forked workers)
+    assert rt.get([wave_task.remote(i) for i in range(8)],
+                  timeout=120) == list(range(8))
+
+    # mid-wave kill: start a wave, then SIGKILL the template while the
+    # wave is in flight
+    refs = [wave_task.remote(100 + i) for i in range(8)]
+    tmpl = svc._prefork_proc
+    if tmpl is not None and tmpl.poll() is None:
+        tmpl.kill()
+        tmpl.wait(timeout=30)
+    assert rt.get(refs, timeout=120) == [100 + i for i in range(8)]
+
+    # kill every live worker too: the next wave can only be served by
+    # NEW workers, which now must come from the cold-spawn fallback
+    for proc in list(svc._worker_procs):
+        if proc.poll() is None:
+            proc.kill()
+    out = rt.get([wave_task.remote(200 + i) for i in range(8)],
+                 timeout=180)
+    assert out == [200 + i for i in range(8)]
+    # supply really recovered: a live registered worker exists again
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if any(c.kind == "worker" and not c.tpu
+               for c in svc.clients.values()):
+            break
+        time.sleep(0.2)
+    assert any(c.kind == "worker" and not c.tpu
+               for c in svc.clients.values())

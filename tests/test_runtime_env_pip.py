@@ -52,9 +52,14 @@ def test_validate_and_hash():
     env = validate({"pip": {"packages": ["a", "b"]}})
     assert env["pip"] == ["a", "b"]
     assert validate({"pip": "solo"})["pip"] == ["solo"]
-    # conda is supported since round 4; container stays out of scope
+    # container VALIDATES since round 5 (launch support is spawn-time);
+    # malformed requests still raise
+    assert validate({"container": {"image": "x"}})["container"] == \
+        {"image": "x"}
     with pytest.raises(ValueError):
-        validate({"container": {"image": "x"}})
+        validate({"container": {"image": ""}})
+    with pytest.raises(ValueError):
+        validate({"container": "not-a-dict"})
     with pytest.raises(ValueError):
         validate({"conda": 42})
     h1 = env_hash({"pip": ["a"], "env_vars": {"X": "1"}})

@@ -5,7 +5,7 @@ envelope: many nodes / actors / queued tasks), the NodeKiller chaos
 utility (_private/test_utils.py:1337), and chaos release tests where
 training survives node churn.  CI runs moderate sizes on this 1-core
 box; `benchmarks/scale_envelope.py` runs the full envelope and records
-SCALE_r04.json.
+SCALE_r<round>.json (see benchmarks/scale_envelope.py).
 """
 
 from __future__ import annotations
