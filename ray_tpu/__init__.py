@@ -14,7 +14,8 @@ from ray_tpu.core.remote_function import remote
 from ray_tpu.core.actor import get_actor, kill, ActorHandle
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.client import (TaskError, GetTimeoutError, ActorDiedError,
-                                 ObjectLostError, OutOfMemoryError)
+                                 ObjectLostError, OutOfMemoryError,
+                                 RetryPolicy)
 from ray_tpu.core.placement_group import (placement_group,
                                           remove_placement_group,
                                           PlacementGroup,
@@ -86,7 +87,7 @@ __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "put",
     "get", "wait", "free", "get_actor", "kill", "ActorHandle", "ObjectRef",
     "ObjectRefGenerator", "TaskError", "GetTimeoutError", "ActorDiedError",
-    "ObjectLostError", "OutOfMemoryError",
+    "ObjectLostError", "OutOfMemoryError", "RetryPolicy",
     "placement_group", "remove_placement_group", "PlacementGroup",
     "PlacementGroupSchedulingStrategy", "available_resources",
     "cluster_resources", "nodes", "timeline",

@@ -91,6 +91,29 @@ _define("pg_ready_poll_timeout_s", float, 1800.0,
         "a pool worker and polls the head forever.")
 
 # --- fault tolerance ------------------------------------------------------
+_define("fault_plan_path", str, "",
+        "Path to a pickled FaultPlan (core/fault_injection.py) to arm in "
+        "this process at node/worker startup — the cross-process leg of "
+        "the chaos plane (in-process plans install programmatically).  "
+        "Empty = disabled; with no plan installed every chaos hook is a "
+        "single is-None check (zero-overhead contract, held to the "
+        "committed PERF artifact).")
+_define("client_retry_deadline_s", float, 30.0,
+        "Total deadline for NodeClient's RetryPolicy on idempotent "
+        "control-plane requests: transient cluster-plane errors (head "
+        "failover mid-get, 'no head connection') retry with jittered "
+        "exponential backoff until this deadline instead of surfacing "
+        "(reference: gcs_rpc_client.h RETRYABLE_RPC deadline).")
+_define("client_retry_base_ms", int, 50,
+        "First backoff of the client RetryPolicy; doubles per attempt "
+        "(jittered, capped at 2s).")
+_define("actor_locate_failover_grace_s", float, 20.0,
+        "How long a node parks actor-bound tasks whose head locate was "
+        "cut off by a head failover before failing them.  The old "
+        "behavior (fail instantly on head loss) turned every failover "
+        "into client-visible actor errors; the grace window lets the "
+        "standby head finish promotion (reference: GCS client "
+        "reconnection grace).")
 _define("task_max_retries", int, 3,
         "Default retries for tasks that die due to worker failure "
         "(reference: task_manager.h:406).")

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import queue
+import random
 import threading
 import time
 import uuid
@@ -61,6 +62,69 @@ class OutOfMemoryError(RuntimeError):
     ray.exceptions.OutOfMemoryError / memory_monitor.h:52)."""
 
 
+class RetryPolicy:
+    """One retry discipline for control-plane requests, replacing the
+    ad-hoc per-call timeouts that used to decide each call site's fate
+    independently: jittered exponential backoff, a total deadline, and
+    an explicit retryable-error classification (reference:
+    gcs_rpc_client.h RETRYABLE_RPC macros — every GCS-bound call gets
+    the same backoff/deadline treatment).
+
+    Only TRANSIENT CLUSTER-PLANE failures are retryable — the error
+    strings a node reply carries while the head is failing over.  A
+    dead local node (ConnectionClosed) is terminal: the node is this
+    process's lifeline.  Caller-visible timeouts (GetTimeoutError) stay
+    timeouts: a caller that bounded its wait keeps that bound."""
+
+    # substrings of reply errors that mean "the cluster plane is mid-
+    # failover; the standby head will pick this up"
+    TRANSIENT = ("head connection lost", "no head connection",
+                 "chosen node vanished", "head registration failed")
+
+    def __init__(self, deadline_s: Optional[float] = None,
+                 base_s: float = 0.05, multiplier: float = 2.0,
+                 max_backoff_s: float = 2.0, jitter: float = 0.25,
+                 seed: Optional[int] = None):
+        self.deadline_s = deadline_s
+        self.base_s = base_s
+        self.multiplier = multiplier
+        self.max_backoff_s = max_backoff_s
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+
+    @classmethod
+    def from_config(cls, config: dict) -> "RetryPolicy":
+        return cls(deadline_s=float(config.get("client_retry_deadline_s",
+                                               30.0)),
+                   base_s=float(config.get("client_retry_base_ms", 50))
+                   / 1000.0)
+
+    def retryable(self, exc: BaseException) -> bool:
+        if isinstance(exc, RuntimeError) and not isinstance(
+                exc, (ActorDiedError, ObjectLostError, OutOfMemoryError)):
+            text = str(exc)
+            return any(p in text for p in self.TRANSIENT)
+        return False
+
+    def backoffs(self):
+        """Infinite jittered backoff schedule; the deadline cuts it."""
+        delay = self.base_s
+        while True:
+            yield delay * (1.0 + self.jitter * self._rng.random())
+            delay = min(delay * self.multiplier, self.max_backoff_s)
+
+
+# Requests safe to re-issue after a transient failure: pure reads, or
+# writes whose repeat is a no-op.  Submission-like messages (actor
+# creation, task submit) are NOT here — a blind resend could double
+# them.
+_IDEMPOTENT = frozenset((
+    "get_objects", "wait", "free_objects", "kv_put", "kv_get", "kv_del",
+    "kv_keys", "ping", "pg_state", "get_named_actor", "list_named_actors",
+    "state", "object_stats", "head_flush", "need_space", "remove_pg",
+))
+
+
 class _SendBatch:
     """Scope for NodeClient.batched_sends(): reentrant per thread; only
     the outermost scope flushes."""
@@ -91,7 +155,8 @@ class NodeClient:
         self.address = address
         self.kind = kind
         self.worker_id = f"{kind}-{uuid.uuid4().hex[:12]}"
-        self.conn = protocol.connect(address)
+        self.conn = protocol.connect(address,
+                                     label=(f"client:{kind}", address))
         self._reqid = 0
         self._reqlock = threading.Lock()
         self._replies: dict[int, queue.SimpleQueue] = {}
@@ -109,6 +174,9 @@ class NodeClient:
         self._auto_send_lock = threading.Lock()
         self._auto_event = threading.Event()
         self._auto_thread: Optional[threading.Thread] = None
+        # armed after registration (needs the node's resolved config);
+        # pre-registration requests run un-retried
+        self._retry_policy: Optional[RetryPolicy] = None
         from ray_tpu.core.local_lane import LaneConnection
         if isinstance(self.conn, LaneConnection):
             # in-process node: replies/pushes are delivered by the node
@@ -123,10 +191,13 @@ class NodeClient:
                                                  name=f"raytpu-recv-{kind}")
             self._recv_thread.start()
         info = self.request({"t": "register", "kind": kind, "tpu": tpu,
-                             "worker_id": self.worker_id, "pid": os.getpid()})
+                             "worker_id": self.worker_id, "pid": os.getpid(),
+                             "container_image": os.environ.get(
+                                 "RAY_TPU_CONTAINER_IMAGE", "")})
         self.session: str = info["session"]
         self.node_id: str = info["node_id"]
         self.config_dict: dict = info["config"]
+        self._retry_policy = RetryPolicy.from_config(self.config_dict)
         self.shm = make_shm_client(self.session,
                                    native=bool(info.get("native_store")),
                                    on_full=self._need_space)
@@ -204,7 +275,44 @@ class NodeClient:
             self._flush_auto()   # older coalesced submits go first
             self.conn.send_batch(batch)
 
-    def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
+    def request(self, msg: dict, timeout: Optional[float] = None,
+                retry: Optional[RetryPolicy] = None) -> dict:
+        """Round-trip a request.  Idempotent message types ride the
+        client's RetryPolicy by default: a transient cluster-plane
+        error (head failover mid-get) retries with jittered backoff
+        until the policy deadline instead of surfacing — callers see
+        the post-failover answer, not the failover."""
+        t = msg.get("t")
+        if retry is None and t in _IDEMPOTENT:
+            # kv_put's added-flag is first-writer-wins ONLY with
+            # overwrite: a retried conditional put that actually landed
+            # would tell its own writer it lost
+            if not (t == "kv_put" and not msg.get("overwrite", True)):
+                retry = self._retry_policy
+        if retry is None:
+            return self._request_once(msg, timeout)
+        # a caller-bounded wait keeps its bound even when the failure
+        # surfaces as a fast transient error reply rather than a timeout
+        budget = retry.deadline_s or 30.0
+        if timeout is not None:
+            budget = min(budget, timeout)
+        deadline = time.monotonic() + budget
+        backoffs = retry.backoffs()
+        while True:
+            remaining = deadline - time.monotonic()
+            attempt_timeout = timeout if timeout is None \
+                else min(timeout, max(0.001, remaining))
+            try:
+                return self._request_once(msg, attempt_timeout)
+            except BaseException as e:
+                if (self._closed.is_set() or not retry.retryable(e)
+                        or time.monotonic() >= deadline):
+                    raise
+                time.sleep(min(next(backoffs),
+                               max(0.0, deadline - time.monotonic())))
+
+    def _request_once(self, msg: dict, timeout: Optional[float] = None
+                      ) -> dict:
         self._flush_batch()
         reqid = self._next_reqid()
         msg["reqid"] = reqid

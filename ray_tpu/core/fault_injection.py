@@ -1,0 +1,413 @@
+"""Deterministic, seedable fault-injection plane ("chaos plane").
+
+Every fault-tolerance behavior this framework ships — worker-death
+retries, actor re-placement, gang re-formation, head failover — used to
+be tested by killing real processes and racing wall-clock sleeps, which
+made each FT test a flake budget (the round-5 head-FT load flake was
+exactly this).  This module turns faults into a *scripted schedule*:
+counters, not clocks, decide when a fault fires, and a seeded RNG makes
+probabilistic faults replayable.
+
+The plane hooks the three choke points every message and process
+already passes through:
+
+  * transport — ``protocol.Connection.send/send_batch/send_blob/recv``
+    and ``local_lane.LaneConnection._post/_deliver``: drop / delay /
+    duplicate individual messages, or partition a link, selected by a
+    (link-label, message) predicate.  Link labels are attached where
+    connections are created (node→head ``("node:<hex8>", "head")``,
+    node→node ``("node:<a>", "node:<b>")``, clients
+    ``("client:<kind>", <address>)``).
+  * process — ``node.NodeService``: kill worker N's process at the K-th
+    task dispatch, delay or fail worker spawns (slow-spawn / spawn
+    outage).
+  * control — ``EventLoopService._dispatch`` and ``HeadService
+    .on_tick``: run a scripted trigger (e.g. stop the head — a
+    deterministic "head dies mid-operation") at the N-th matching
+    service message or tick, or drop the message outright.
+
+Zero-overhead contract: when no plan is installed (the default,
+production state) every hook is a single module-global ``is None``
+check — nothing else executes on the hot path.  The acceptance gate
+for this file is the committed PERF artifact staying within noise of
+the previous round with the plane compiled in but disabled.
+
+In-process only by default: ``install()`` arms the plan for the current
+process (the normal shape — virtual clusters run head+nodes in the test
+process, so the control plane is fully covered).  For faults inside
+spawned node/worker processes, write the plan to disk
+(``FaultPlan.save``) and set ``RAY_TPU_FAULT_PLAN=<path>`` in their
+environment; ``autoinstall_from_env()`` runs at node/worker startup.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+import signal as _signal
+import threading
+import time
+from typing import Any, Callable, Optional
+
+# The armed plan.  Hooks read this module attribute directly
+# (``_active is not None``) so the disabled path costs one global load.
+_active: Optional["FaultPlan"] = None
+
+
+def active() -> Optional["FaultPlan"]:
+    return _active
+
+
+def install(plan: "FaultPlan") -> "FaultPlan":
+    global _active
+    _active = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+class injected:
+    """``with fault_injection.injected(plan): ...`` — scoped install."""
+
+    def __init__(self, plan: "FaultPlan"):
+        self.plan = plan
+
+    def __enter__(self) -> "FaultPlan":
+        return install(self.plan)
+
+    def __exit__(self, *exc) -> bool:
+        uninstall()
+        return False
+
+
+def autoinstall_from_env() -> None:
+    """Arm a pickled plan in a freshly spawned process (node daemon or
+    worker) when the ``fault_plan_path`` config flag (env:
+    RAY_TPU_FAULT_PLAN_PATH) names one.  Callable-free plans
+    (message/spawn/dispatch rules) pickle cleanly; scripted ``fn``
+    rules are in-process only."""
+    if _active is not None:
+        return
+    path = os.environ.get("RAY_TPU_FAULT_PLAN_PATH")
+    if not path:
+        try:
+            from ray_tpu._config import get_config
+            path = get_config().fault_plan_path
+        except Exception:
+            path = ""
+    if not path:
+        return
+    try:
+        with open(path, "rb") as f:
+            install(pickle.load(f))
+    except Exception:
+        pass   # a missing/garbled plan must never break startup
+
+
+# ---------------------------------------------------------------------------
+# rules
+
+
+class Rule:
+    """One scripted fault.  Deterministic: the rule keeps a match
+    counter; ``nth`` fires on the n-th match (1-based), ``times`` caps
+    total firings, ``prob`` draws from the PLAN's seeded RNG — same
+    seed, same schedule, every run."""
+
+    def __init__(self, point: str, action: str, *,
+                 msg_type: Optional[str] = None,
+                 link: Optional[str] = None,
+                 service: Optional[str] = None,
+                 where: Optional[Callable] = None,
+                 nth: Optional[int] = None,
+                 times: Optional[int] = None,
+                 prob: Optional[float] = None,
+                 delay: float = 0.0,
+                 sig: int = _signal.SIGKILL,
+                 fn: Optional[Callable] = None):
+        self.point = point          # send|recv|deliver|spawn|dispatch|
+        #                             service_msg|service_tick
+        self.action = action        # drop|delay|dup|kill|fail|script
+        self.msg_type = msg_type    # match msg["t"]
+        self.link = link            # substring matched against the link label
+        self.service = service      # match EventLoopService.name
+        self.where = where          # extra predicate(label_or_svc, msg_or_spec)
+        self.nth = nth
+        self.times = times
+        self.prob = prob
+        self.delay = delay
+        self.sig = sig
+        self.fn = fn
+        self.matches = 0
+        self.fired = 0
+
+    def _matches_link(self, label: tuple) -> bool:
+        if self.link is None:
+            return True
+        return any(self.link in str(part) for part in label)
+
+    def decide(self, plan: "FaultPlan", label: Any, payload: Any) -> bool:
+        """Count a candidate event; True = the fault fires now."""
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.matches += 1
+        if self.nth is not None and self.matches != self.nth:
+            return False
+        if self.prob is not None and plan.rng.random() >= self.prob:
+            return False
+        self.fired += 1
+        return True
+
+
+class Partition:
+    """An active network partition between two link-label patterns.
+    Messages on any link whose label matches both sides are dropped (in
+    BOTH directions) until ``heal()``."""
+
+    def __init__(self, a: str, b: str):
+        self.a = a
+        self.b = b
+        self.healed = False
+
+    def heal(self) -> None:
+        self.healed = True
+
+    def severs(self, label: tuple) -> bool:
+        if self.healed:
+            return False
+        text = [str(part) for part in label]
+        return (any(self.a in t for t in text)
+                and any(self.b in t for t in text))
+
+
+class FaultPlan:
+    """A scripted fault schedule.  Build rules, ``install()`` it, run
+    the scenario, ``uninstall()``.  All decisions are counter-driven
+    (plus an explicitly seeded RNG), so a failing chaos test replays
+    byte-identically."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.rules: list[Rule] = []
+        self.partitions: list[Partition] = []
+        self.log: list[tuple] = []   # (point, action, detail) audit trail
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ authoring
+
+    def add(self, rule: Rule) -> Rule:
+        self.rules.append(rule)
+        return rule
+
+    def drop_messages(self, *, msg_type: Optional[str] = None,
+                      link: Optional[str] = None, nth: Optional[int] = None,
+                      times: Optional[int] = None,
+                      prob: Optional[float] = None,
+                      point: str = "send",
+                      where: Optional[Callable] = None) -> Rule:
+        return self.add(Rule(point, "drop", msg_type=msg_type, link=link,
+                             nth=nth, times=times, prob=prob, where=where))
+
+    def delay_messages(self, seconds: float, *,
+                       msg_type: Optional[str] = None,
+                       link: Optional[str] = None, nth: Optional[int] = None,
+                       times: Optional[int] = None,
+                       prob: Optional[float] = None,
+                       point: str = "send",
+                       where: Optional[Callable] = None) -> Rule:
+        return self.add(Rule(point, "delay", delay=seconds,
+                             msg_type=msg_type, link=link, nth=nth,
+                             times=times, prob=prob, where=where))
+
+    def duplicate_messages(self, *, msg_type: Optional[str] = None,
+                           link: Optional[str] = None,
+                           nth: Optional[int] = None,
+                           times: Optional[int] = None,
+                           prob: Optional[float] = None,
+                           point: str = "send",
+                           where: Optional[Callable] = None) -> Rule:
+        return self.add(Rule(point, "dup", msg_type=msg_type, link=link,
+                             nth=nth, times=times, prob=prob, where=where))
+
+    def partition(self, a: str, b: str) -> Partition:
+        p = Partition(a, b)
+        self.partitions.append(p)
+        return p
+
+    def kill_worker_at_dispatch(self, k: int, *,
+                                sig: int = _signal.SIGKILL,
+                                where: Optional[Callable] = None,
+                                times: int = 1) -> Rule:
+        """SIGKILL the worker that receives the k-th dispatched task
+        (counted across this process's node services, or per ``where``
+        predicate on (node_service, spec))."""
+        return self.add(Rule("dispatch", "kill", nth=k, sig=sig,
+                             where=where, times=times))
+
+    def slow_spawn(self, seconds: float, *,
+                   times: Optional[int] = None) -> Rule:
+        return self.add(Rule("spawn", "delay", delay=seconds, times=times))
+
+    def fail_spawn(self, *, times: Optional[int] = None,
+                   nth: Optional[int] = None) -> Rule:
+        return self.add(Rule("spawn", "fail", times=times, nth=nth))
+
+    def script(self, fn: Callable, *, point: str = "service_msg",
+               service: Optional[str] = None,
+               msg_type: Optional[str] = None,
+               nth: int = 1, times: int = 1,
+               drop: bool = False) -> Rule:
+        """Run ``fn(service)`` (tick point) or ``fn(service, rec, msg)``
+        (message point) at the nth matching event — e.g. stop the head
+        at the 3rd cluster_submit to script a head death mid-burst.
+        ``drop=True`` also swallows the triggering message (the crash
+        happened "before" it was processed)."""
+        r = Rule(point, "script", service=service, msg_type=msg_type,
+                 nth=nth, times=times, fn=fn)
+        r.drop_message = drop
+        return self.add(r)
+
+    def save(self, path: str) -> str:
+        """Persist for RAY_TPU_FAULT_PLAN autoinstall in spawned
+        processes (callable-free plans only)."""
+        with open(path, "wb") as f:
+            pickle.dump(self, f)
+        return path
+
+    def __getstate__(self):
+        st = dict(self.__dict__)
+        del st["_lock"]
+        return st
+
+    def __setstate__(self, st):
+        self.__dict__.update(st)
+        self._lock = threading.Lock()
+
+    # -------------------------------------------------------------- hooks
+    #
+    # Called from hot paths ONLY when this plan is installed.  Each hook
+    # takes the lock: chaos-test rates are far below the contention
+    # threshold, and deterministic counters beat racy ones.
+
+    def message_verdict(self, point: str, label: tuple,
+                        msg: dict) -> Optional[Any]:
+        """None = pass through, "drop", "dup", or ("delay", seconds).
+        Partitions are checked first and drop silently in both
+        directions."""
+        with self._lock:
+            for p in self.partitions:
+                if p.severs(label):
+                    self.log.append((point, "partition_drop",
+                                     msg.get("t")))
+                    return "drop"
+            for r in self.rules:
+                if r.point != point:
+                    continue
+                if r.msg_type is not None and msg.get("t") != r.msg_type:
+                    continue
+                if not r._matches_link(label):
+                    continue
+                if r.where is not None and not r.where(label, msg):
+                    continue
+                if not r.decide(self, label, msg):
+                    continue
+                self.log.append((point, r.action, msg.get("t")))
+                if r.action == "drop":
+                    return "drop"
+                if r.action == "dup":
+                    return "dup"
+                if r.action == "delay":
+                    return ("delay", r.delay)
+        return None
+
+    def on_dispatch(self, node, worker_rec, spec: dict) -> None:
+        """After a task is pushed to a worker: scripted worker kill."""
+        with self._lock:
+            for r in self.rules:
+                if r.point != "dispatch":
+                    continue
+                if r.where is not None and not r.where(node, spec):
+                    continue
+                if not r.decide(self, node, spec):
+                    continue
+                self.log.append(("dispatch", r.action,
+                                 (worker_rec.pid,
+                                  spec.get("task_id", b"").hex()[:12]
+                                  if isinstance(spec.get("task_id"), bytes)
+                                  else "")))
+                if r.action == "kill" and worker_rec.pid:
+                    try:
+                        os.kill(worker_rec.pid, r.sig)
+                    except OSError:
+                        pass
+
+    def spawn_verdict(self, node) -> Optional[Any]:
+        """None = spawn normally, "fail" = spawn silently dies,
+        ("delay", seconds) = spawn lands late."""
+        with self._lock:
+            for r in self.rules:
+                if r.point != "spawn":
+                    continue
+                if r.where is not None and not r.where(node, None):
+                    continue
+                if not r.decide(self, node, None):
+                    continue
+                self.log.append(("spawn", r.action, r.delay))
+                if r.action == "fail":
+                    return "fail"
+                if r.action == "delay":
+                    return ("delay", r.delay)
+        return None
+
+    def on_service_msg(self, svc, rec, msg: dict) -> bool:
+        """Scripted triggers at a service's message dispatch; True =
+        swallow the message."""
+        fire = []
+        drop = False
+        with self._lock:
+            for r in self.rules:
+                if r.point != "service_msg":
+                    continue
+                if r.service is not None and svc.name != r.service:
+                    continue
+                if r.msg_type is not None and msg.get("t") != r.msg_type:
+                    continue
+                if r.where is not None and not r.where(svc, msg):
+                    continue
+                if not r.decide(self, svc, msg):
+                    continue
+                self.log.append(("service_msg", "script", msg.get("t")))
+                fire.append(r)
+                drop = drop or getattr(r, "drop_message", False)
+        for r in fire:   # outside the lock: fn may re-enter hooks
+            if r.fn is not None:
+                r.fn(svc, rec, msg)
+        return drop
+
+    def on_service_tick(self, svc) -> None:
+        fire = []
+        with self._lock:
+            for r in self.rules:
+                if r.point != "service_tick":
+                    continue
+                if r.service is not None and svc.name != r.service:
+                    continue
+                if not r.decide(self, svc, None):
+                    continue
+                self.log.append(("service_tick", "script", svc.name))
+                fire.append(r)
+        for r in fire:
+            if r.fn is not None:
+                r.fn(svc)
+
+
+def apply_delay(seconds: float) -> None:
+    """Shared delay primitive so hooks stay one-liners.  Sleeping on
+    the calling thread is deliberate: a slow link stalls its sender —
+    exactly the backpressure shape real congestion has."""
+    time.sleep(seconds)

@@ -36,6 +36,8 @@ import threading
 import traceback
 from typing import Callable, Optional
 
+from ray_tpu.core import fault_injection as _fi
+
 # address -> EventLoopService living in this process.  Services register
 # at startup and unregister at cleanup; a hit proves the peer is local.
 _services: dict = {}
@@ -85,9 +87,14 @@ class LaneConnection:
 
     encoding = "pickle"   # Connection-surface parity; never used to encode
 
-    def __init__(self, svc, copy: bool = False):
+    def __init__(self, svc, copy: bool = False,
+                 label: Optional[tuple] = None):
         self._svc = svc
         self._copy = copy
+        # chaos-plane link label (core/fault_injection.py); lanes carry
+        # the same label surface as socket Connections so partitions
+        # and message rules apply to in-process links too
+        self.fi_label = label or ("lane", getattr(svc, "name", "?"))
         self._rx: queue.SimpleQueue = queue.SimpleQueue()
         # service→client fast path: when set, pushes are delivered by
         # calling this on the SERVICE LOOP THREAD (must be quick and
@@ -124,6 +131,11 @@ class LaneConnection:
         from ray_tpu.core.protocol import ConnectionClosed
         if self._closed.is_set():
             raise ConnectionClosed("lane closed")
+        if _fi._active is not None:
+            from ray_tpu.core.protocol import _chaos_filter
+            msgs = _chaos_filter(self.fi_label, msgs)
+            if not msgs:
+                return
         svc, rec = self._svc, self.rec
 
         def run():
@@ -137,6 +149,19 @@ class LaneConnection:
 
     def _deliver(self, msg: dict) -> None:
         """Runs on the service loop thread (from _push)."""
+        if _fi._active is not None:
+            v = _fi._active.message_verdict("deliver", self.fi_label, msg)
+            if v == "drop":
+                return
+            if v == "dup":
+                self._deliver_one(msg)
+            elif type(v) is tuple:
+                # stalls the SERVICE loop: a slow consumer backpressures
+                # its server exactly like a wedged socket peer would
+                _fi.apply_delay(v[1])
+        self._deliver_one(msg)
+
+    def _deliver_one(self, msg: dict) -> None:
         if self._copy:
             # inter-service links isolate BOTH directions: a pushed view
             # or spec may reference the sender's live mutable state

@@ -48,6 +48,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ray_tpu._config import RayTpuConfig
+from ray_tpu.core import fault_injection as _fi
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
 from ray_tpu.core.resources import bundle_total, covers
@@ -96,6 +97,20 @@ class _ForkedProc:
 
     def kill(self) -> None:
         self._signal(signal.SIGKILL)
+
+
+class _PendingLaunch:
+    """Popen-shaped placeholder guarding a container launch that has
+    been SCHEDULED but not yet exec'd (e.g. chaos slow-spawn).  poll()
+    reads in-flight until the register window expires, then done —
+    re-arming retries for a launch that silently died."""
+
+    def __init__(self, ttl_s: float):
+        self._deadline = time.monotonic() + ttl_s
+        self.pid = 0
+
+    def poll(self) -> Optional[int]:
+        return None if time.monotonic() < self._deadline else 0
 
 
 # ---------------------------------------------------------------------------
@@ -218,6 +233,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                  stop_on_driver_exit: bool = True,
                  labels: Optional[dict] = None):
         super().__init__(listen_host, port)
+        _fi.autoinstall_from_env()   # chaos plane in spawned node daemons
         self.config = config
         self.session = session
         self.session_dir = session_dir
@@ -297,6 +313,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._prefork_path = ""
         if config.prefork_workers:
             self._start_prefork_template()
+        # containerized-worker spawns in flight: image -> Popen.  One
+        # at a time per image (a container cold-start is seconds; a
+        # burst would stampede podman), re-armed when the worker
+        # registers or its launcher process dies.
+        self._container_spawning: dict[str, Any] = {}
         # Batched-get bookkeeping: (conn_id, reqid) -> {ids, remaining}.
         self._multigets: dict[tuple, dict] = {}
         self._mg_by_oid: dict[ObjectID, set] = {}
@@ -316,6 +337,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         # actor_id(bytes) -> ("alive", node_hex, address)
         self.actor_cache: dict[bytes, tuple] = {}
         self._awaiting_actor: dict[bytes, list] = {}   # aid -> queued specs
+        # aid -> when its locate was orphaned by a head failover
+        self._actor_wait_parked: dict[bytes, float] = {}
         self._pulls: dict[bytes, dict] = {}            # oid bytes -> state
         self._pull_attempts: dict[bytes, int] = {}
         self._out_transfers: dict[tuple, dict] = {}    # (conn_id, oid) -> st
@@ -381,7 +404,23 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._expire_stale_pins()
         self._sweep_released()
         self._memory_check()
+        self._expire_parked_actor_waits()
         self._heartbeat()
+
+    def _expire_parked_actor_waits(self) -> None:
+        """Actor-bound tasks parked through a head failover fail once
+        the grace window runs out with the head still gone."""
+        if not self._actor_wait_parked or self.head_conn is not None:
+            return
+        grace = self.config.actor_locate_failover_grace_s
+        cutoff = time.monotonic() - grace
+        for ab, since in list(self._actor_wait_parked.items()):
+            if since < cutoff:
+                self._actor_wait_parked.pop(ab, None)
+                for spec in self._awaiting_actor.pop(ab, []):
+                    self._fail_task(
+                        spec, "Actor location unknown: head connection "
+                              f"lost and not recovered within {grace:.0f}s")
 
     def _memory_check(self) -> None:
         """OOM protection: when node memory crosses the threshold, kill
@@ -511,7 +550,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     # ------------------------------------------------------- head channel
 
     def _connect_head(self) -> None:
-        conn = protocol.connect(self.head_address, remote=True)
+        conn = protocol.connect(
+            self.head_address, remote=True,
+            label=(f"node:{self.node_id.hex()[:8]}", "head"))
         conn.send({"t": "register_node", "reqid": 0,
                    "node_id": self.node_id.hex(), "address": self.address,
                    "resources": self.total_resources,
@@ -573,11 +614,14 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             except Exception:
                 sys.stderr.write("[node] head-lost callback failed:\n"
                                  + traceback.format_exc())
-        for ab, specs in list(self._awaiting_actor.items()):
-            self._awaiting_actor.pop(ab, None)
-            for spec in specs:
-                self._fail_task(spec, "Actor location unknown: head "
-                                      "connection lost")
+        # actor-bound tasks whose locate was cut off stay PARKED for the
+        # failover grace window (config actor_locate_failover_grace_s):
+        # failing them instantly turned every head failover into
+        # client-visible actor errors.  _head_rejoined re-issues the
+        # locates; on_tick expires the ones the grace ran out on.
+        now = time.monotonic()
+        for ab in self._awaiting_actor:
+            self._actor_wait_parked.setdefault(ab, now)
         self.post_later(1.0, self._try_reconnect_head)
 
     def _try_reconnect_head(self) -> None:
@@ -586,7 +630,9 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
         def work():
             try:
-                conn = protocol.connect(self.head_address, timeout=3.0, remote=True)
+                conn = protocol.connect(
+                    self.head_address, timeout=3.0, remote=True,
+                    label=(f"node:{self.node_id.hex()[:8]}", "head"))
                 conn.send({"t": "register_node", "reqid": 0,
                            "node_id": self.node_id.hex(),
                            "address": self.address,
@@ -633,6 +679,12 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             for ar in self.actors.values():
                 if ar.state != "dead":
                     self._report_actor_state(ar)
+            # re-ask for every actor whose locate the failover orphaned;
+            # the parked specs resume the moment the new head answers
+            for ab in list(self._awaiting_actor):
+                self._head_rpc(
+                    {"t": "locate_actor", "actor_id": ab},
+                    lambda reply, ab=ab: self._on_actor_located(ab, reply))
         except protocol.ConnectionClosed:
             self._head_lost()
 
@@ -740,12 +792,20 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         rec.pid = m.get("pid", 0)
         rec.tpu = bool(m.get("tpu", False))
         rec.node_hex = m.get("node_hex", "")
+        rec.container_image = m.get("container_image", "")
         if rec.kind == "driver" and self._owner_driver is None:
             # the FIRST driver owns this node's lifetime; later drivers
             # (job entrypoints, attached shells) come and go freely
             self._owner_driver = rec.conn_id
         if rec.kind in ("worker", "tpu_executor"):
-            self._spawning = max(0, self._spawning - 1)
+            if rec.container_image:
+                # container launches track per-image (_container_
+                # spawning), never the host _spawning counter — a
+                # decrement here would mark an unrelated in-flight host
+                # spawn as done
+                self._container_spawning.pop(rec.container_image, None)
+            else:
+                self._spawning = max(0, self._spawning - 1)
         self._reply(rec, m["reqid"], session=self.session,
                     node_id=self.node_id.hex(), address=self.address,
                     config=self.config.to_dict(),
@@ -1592,10 +1652,24 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                        (self.runnable_zero, False)):
             while q:
                 spec = q[0]
-                w = self._find_idle_worker(tpu=tpu,
-                                           env_hash=spec.get("env_hash"))
+                container = (spec.get("runtime_env") or {}).get("container")
+                if container and tpu:
+                    # the TPU executor lives in the driver process; a
+                    # containerized worker can never satisfy it — fail
+                    # fast instead of wedging the TPU queue head
+                    self._queue_pop(q)
+                    self._fail_task(
+                        spec, "runtime_env.container is not supported "
+                              "for TPU tasks (TPU work runs on the "
+                              "driver's in-process executor)")
+                    continue
+                w = self._find_idle_worker(
+                    tpu=tpu, env_hash=spec.get("env_hash"),
+                    container_image=(container or {}).get("image", ""))
                 if w is None:
-                    if not tpu:
+                    if container:
+                        self._maybe_spawn_container_worker(container)
+                    elif not tpu:
                         self._maybe_spawn_worker()
                     break
                 if not self._try_acquire(spec):
@@ -1612,12 +1686,18 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 and all(v <= 0 for v in self._demand(spec).values()))
 
     def _find_idle_worker(self, tpu: bool,
-                          env_hash: Optional[str] = None
+                          env_hash: Optional[str] = None,
+                          container_image: str = ""
                           ) -> Optional[ClientRec]:
         best = None
         for rec in self.clients.values():
             if (rec.kind in ("worker", "tpu_executor") and rec.state == "idle"
                     and rec.dedicated_actor is None and rec.tpu == tpu):
+                # container tasks only run inside a matching image;
+                # plain tasks never borrow a containerized worker (its
+                # filesystem is the image's, not the host's)
+                if rec.container_image != container_image:
+                    continue
                 if not env_hash:
                     return rec
                 # prefer a worker that already materialized this env
@@ -1627,6 +1707,62 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 if best is None:
                     best = rec
         return best
+
+    def _maybe_spawn_container_worker(self, container: dict) -> None:
+        """Launch a worker exec'd inside the requested image
+        (runtime_env.container — ROADMAP 5a).  One launch in flight per
+        image: container cold-starts are seconds, and every _schedule
+        pass would otherwise stampede podman.  A launcher that dies
+        before its worker registers re-arms on the next pass."""
+        image = container["image"]
+        prev = self._container_spawning.get(image)
+        if prev is not None and prev.poll() is None:
+            return
+        # arm the guard BEFORE the spawn call: a chaos-delayed spawn
+        # returns without a Popen, and every _schedule pass until the
+        # delay elapsed would otherwise queue another launch.  The
+        # placeholder expires after the register window so a silently
+        # failed launch re-arms; _do_spawn_worker overwrites it with
+        # the real proc.
+        self._container_spawning[image] = _PendingLaunch(
+            self.config.worker_register_timeout_s)
+        try:
+            self._spawn_worker_proc(container=dict(container))
+        except Exception as e:
+            self._container_spawning.pop(image, None)
+            # no container runtime / unlaunchable image: a spec that can
+            # never dispatch must not wedge the queue head forever —
+            # fail the demand with the real problem named
+            self._fail_container_demand(
+                image, f"containerized worker for image '{image}' "
+                       f"cannot launch: {e}")
+
+    def _fail_container_demand(self, image: str, error: str) -> None:
+        for q in (self.runnable_cpu, self.runnable_tpu,
+                  self.runnable_zero):
+            doomed = [s for s in q
+                      if (((s.get("runtime_env") or {}).get("container")
+                           or {}).get("image")) == image]
+            for spec in doomed:
+                q.remove(spec)
+                # mirror _queue_pop's aggregate accounting
+                if spec.get("placement_group"):
+                    self._queued_pg = max(0, self._queued_pg - 1)
+                else:
+                    for k, v in self._demand(spec).items():
+                        self._queued_demand[k] = \
+                            self._queued_demand.get(k, 0.0) - v
+                self._fail_task(spec, error)
+        if (not self.runnable_cpu and not self.runnable_tpu
+                and not self.runnable_zero):
+            self._queued_demand.clear()
+            self._queued_pg = 0
+        for ar in list(self.actors.values()):
+            if (ar.state in ("pending", "restarting")
+                    and ar.conn_id is None
+                    and (((ar.spec.get("runtime_env") or {})
+                          .get("container") or {}).get("image")) == image):
+                self._mark_actor_dead(ar, error)
 
     def _dispatch_task(self, w: ClientRec, spec: dict) -> None:
         tr = self.tasks[spec["task_id"]]
@@ -1641,6 +1777,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self.store.pin(ObjectID(b))
         self._record_event(spec, "RUNNING", worker=w.conn_id)
         self._push(w, {"t": "execute", "spec": spec})
+        if _fi._active is not None:
+            # chaos plane: "kill the worker that got the K-th dispatch"
+            # — the task is in flight, so this exercises the
+            # worker-death retry/FAILED path deterministically
+            _fi._active.on_dispatch(self, w, spec)
 
     def _release_arg_blob(self, spec: dict) -> None:
         """Oversized (args, kwargs) tuples ride the store as a blob put
@@ -1718,9 +1859,12 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             1 for a in self.actors.values()
             if a.state in ("pending", "restarting") and a.conn_id is None
             and not a.spec.get("num_tpus"))
+        # containerized workers don't count as spare capacity here: they
+        # can only take matching-image tasks, so an idle one must not
+        # mask the need for a host worker
         idle = sum(1 for c in self.clients.values()
                    if c.kind == "worker" and not c.tpu and c.state == "idle"
-                   and c.dedicated_actor is None)
+                   and c.dedicated_actor is None and not c.container_image)
         # Tasks can only run while CPU is available, so a pool larger than
         # the free CPUs is waste; placement-group tasks draw on their
         # bundle reservation, zero-cpu tasks (e.g. PlacementGroup.ready()
@@ -1747,7 +1891,22 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._spawning += 1
             self._spawn_worker_proc()
 
-    def _spawn_worker_proc(self) -> None:
+    def _spawn_worker_proc(self, container: Optional[dict] = None) -> None:
+        if _fi._active is not None:
+            # chaos plane: slow-spawn (the fork lands late) or a spawn
+            # that silently dies; _audit_worker_pool self-heals the
+            # in-flight counter either way, exactly as for a real
+            # crashed spawn
+            v = _fi._active.spawn_verdict(self)
+            if v == "fail":
+                return
+            if type(v) is tuple:
+                self.post_later(
+                    v[1], lambda: self._do_spawn_worker(container))
+                return
+        self._do_spawn_worker(container)
+
+    def _do_spawn_worker(self, container: Optional[dict] = None) -> None:
         logdir = os.path.join(self.session_dir, "logs")
         # monotone counter, NOT len(): pruning dead procs shrinks the
         # list and len() would hand a live worker's log index to a new
@@ -1756,16 +1915,28 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         idx = self._worker_seq
         outp = os.path.join(logdir, f"worker-{idx}.out")
         errp = os.path.join(logdir, f"worker-{idx}.err")
-        proc = self._fork_worker(outp, errp)
+        # containerized workers (runtime_env.container) always bypass
+        # the prefork template: the child must be exec'd INSIDE the
+        # image, and a fork of this host's pre-imported interpreter is
+        # by definition not that (reference:
+        # _private/runtime_env/container.py worker command wrapping)
+        proc = None if container else self._fork_worker(outp, errp)
         if proc is None:
             env = self._worker_env()
+            worker_cmd = [sys.executable, "-m", "ray_tpu.core.worker",
+                          "--address", self.worker_address,
+                          "--session", self.session]
+            if container:
+                from ray_tpu.runtime_env import container_command
+                worker_cmd = container_command(container, worker_cmd,
+                                               self.session_dir)
             out = open(outp, "ab", buffering=0)
             err = open(errp, "ab", buffering=0)
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker",
-                 "--address", self.worker_address,
-                 "--session", self.session],
+                worker_cmd,
                 env=env, stdout=out, stderr=err, start_new_session=True)
+            if container:
+                self._container_spawning[container["image"]] = proc
         self._worker_procs.append(proc)
         # stack dumps / the dashboard log view need pid -> log mapping
         self._worker_log_by_pid[proc.pid] = (outp, errp)
@@ -1930,9 +2101,21 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _place_actor(self, ar: ActorRec) -> None:
         needs_tpu = bool(ar.spec.get("num_tpus"))
-        w = self._find_idle_worker(tpu=needs_tpu)
+        container = (ar.spec.get("runtime_env") or {}).get("container")
+        if container and needs_tpu:
+            self._mark_actor_dead(
+                ar, "runtime_env.container is not supported for TPU "
+                    "actors (TPU work runs on the driver's in-process "
+                    "executor)")
+            return
+        w = self._find_idle_worker(
+            tpu=needs_tpu,
+            container_image=(container or {}).get("image", ""))
         if w is None:
-            self._maybe_spawn_worker(tpu=needs_tpu)
+            if container:
+                self._maybe_spawn_container_worker(container)
+            else:
+                self._maybe_spawn_worker(tpu=needs_tpu)
             # event-driven retry on the next worker registration (the
             # 50 ms poll alone serialized bursts of actor creations)
             self._actors_wanting_worker.append(ar)
@@ -2040,6 +2223,13 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _on_actor_located(self, ab: bytes, reply: dict) -> None:
         state = reply.get("state")
+        if reply.get("error") and self.head_conn is None:
+            # transient: the head died mid-locate.  Keep the specs
+            # parked through the failover grace window — the rejoin
+            # path re-asks, on_tick expires the window.
+            self._actor_wait_parked.setdefault(ab, time.monotonic())
+            return
+        self._actor_wait_parked.pop(ab, None)   # the head answered
         if reply.get("error") or state in ("dead", "unknown"):
             cause = reply.get("death_cause") or reply.get("error") \
                 or "actor not found"
@@ -2207,8 +2397,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._report_actor_state(ar)
 
     def _h_get_named_actor(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)
+        if self._cluster_scope(rec, m):
             return
         key = (m.get("namespace") or "default", m["name"])
         aid = self.named_actors.get(key)
@@ -2221,8 +2410,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 "class_name": ar.spec.get("class_name", "")})
 
     def _h_list_named_actors(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)
+        if self._cluster_scope(rec, m):
             return
         out = [{"namespace": ns, "name": n}
                for (ns, n), aid in self.named_actors.items()
@@ -2232,6 +2420,27 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._reply(rec, m["reqid"], actors=out)
 
     # -- head proxying ------------------------------------------------------
+
+    def _cluster_scope(self, rec: ClientRec, m: dict) -> bool:
+        """Route a cluster-scope client request.  True = handled here
+        (proxied to the head, or failed transiently); False = this node
+        is STANDALONE and should serve it from its local stores.
+
+        The distinction matters during a head failover: a cluster
+        node with its head temporarily gone must NOT silently fall back
+        to its (empty) local store — that's a split-brain read.  It
+        answers with a transient, RetryPolicy-retryable error instead,
+        so clients ride out the failover and then read the truth."""
+        if self.head_address is None:
+            return False
+        if self.head_conn is None:
+            if "reqid" in m:
+                self._reply(rec, m["reqid"],
+                            error="head connection lost (failover in "
+                                  "progress)")
+            return True
+        self._proxy_to_head(rec, m)
+        return True
 
     def _proxy_to_head(self, rec: ClientRec, m: dict) -> None:
         """Forward a cluster-scope client request to the head verbatim and
@@ -2253,9 +2462,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     # -- placement groups
 
     def _h_create_pg(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)   # head runs the cross-node 2PC
-            return
+        if self._cluster_scope(rec, m):
+            return   # head (or failover error) ran the cross-node 2PC
         bundles = m["bundles"]
         total = bundle_total(bundles)
         if not covers(self.total_resources, total):
@@ -2288,8 +2496,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             self._schedule()
 
     def _h_pg_state(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)
+        if self._cluster_scope(rec, m):
             return
         pg_id = PlacementGroupID(m["pg_id"])
         if pg_id in self.pgs:
@@ -2301,8 +2508,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._reply(rec, m["reqid"], ok=True, state=st)
 
     def _h_remove_pg(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)
+        if self._cluster_scope(rec, m):
             return
         pg_id = PlacementGroupID(m["pg_id"])
         self._pending_local_pgs.pop(m["pg_id"], None)
@@ -2400,26 +2606,22 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     # -- kv / pubsub
 
     def _h_kv_put(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)
+        if self._cluster_scope(rec, m):
             return
         super()._h_kv_put(rec, m)
 
     def _h_kv_get(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)
+        if self._cluster_scope(rec, m):
             return
         super()._h_kv_get(rec, m)
 
     def _h_kv_del(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)
+        if self._cluster_scope(rec, m):
             return
         super()._h_kv_del(rec, m)
 
     def _h_kv_keys(self, rec, m):
-        if self.head_conn is not None:
-            self._proxy_to_head(rec, m)
+        if self._cluster_scope(rec, m):
             return
         super()._h_kv_keys(rec, m)
 
@@ -2467,7 +2669,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         def work():
             c = None
             try:
-                c = protocol.connect(address, timeout=5.0, remote=True)
+                c = protocol.connect(
+                    address, timeout=5.0, remote=True,
+                    label=(f"node:{self.node_id.hex()[:8]}",
+                           f"node:{node_hex[:8]}"))
                 c.send({"t": "register", "kind": "peer", "reqid": 0,
                         "node_hex": self.node_id.hex(),
                         "worker_id": f"peer-{self.node_id.hex()[:12]}"})

@@ -19,6 +19,8 @@ import struct
 import threading
 from typing import Any, Optional
 
+from ray_tpu.core import fault_injection as _fi
+
 _HDR = struct.Struct("<Q")
 
 # frame payload = 1 tag byte + body; self-describing so mixed encodings
@@ -97,23 +99,43 @@ class ConnectionClosed(Exception):
 class Connection:
     """Framed, thread-safe-send connection over a stream socket."""
 
-    def __init__(self, sock: socket.socket, encoding: Optional[str] = None):
+    def __init__(self, sock: socket.socket, encoding: Optional[str] = None,
+                 label: Optional[tuple] = None):
         self.sock = sock
         self.encoding = encoding or default_encoding()
+        # chaos-plane link label (core/fault_injection.py): who talks to
+        # whom, attached at creation; only read when a plan is installed
+        self.fi_label = label or ("conn", "?")
         self._send_lock = threading.Lock()
         self._recv_buf = b""
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
             if sock.family != socket.AF_UNIX else None
 
     def send(self, msg: dict) -> None:
+        repeats = 1
+        if _fi._active is not None:
+            v = _fi._active.message_verdict("send", self.fi_label, msg)
+            if v == "drop":
+                return
+            if v == "dup":
+                repeats = 2
+            elif type(v) is tuple:
+                _fi.apply_delay(v[1])
         data = encode_payload(msg, self.encoding)
         with self._send_lock:
             try:
-                self.sock.sendall(_HDR.pack(len(data)) + data)
+                for _ in range(repeats):
+                    self.sock.sendall(_HDR.pack(len(data)) + data)
             except (BrokenPipeError, ConnectionResetError, OSError) as e:
                 raise ConnectionClosed(str(e)) from e
 
     def send_blob(self, meta: dict, data) -> None:
+        if _fi._active is not None:
+            v = _fi._active.message_verdict("send", self.fi_label, meta)
+            if v == "drop":
+                return
+            if type(v) is tuple:
+                _fi.apply_delay(v[1])
         payload = b"".join(blob_frame_parts(meta, data))
         with self._send_lock:
             try:
@@ -125,6 +147,10 @@ class Connection:
         """Frame several messages and write them in one syscall — the
         per-message sendall otherwise costs a syscall + GIL drop + a
         receiver wakeup each (hot on the task completion path)."""
+        if _fi._active is not None:
+            msgs = _chaos_filter(self.fi_label, msgs)
+            if not msgs:
+                return
         payload = b"".join(
             _HDR.pack(len(d)) + d
             for d in (encode_payload(m, self.encoding) for m in msgs))
@@ -135,18 +161,26 @@ class Connection:
                 raise ConnectionClosed(str(e)) from e
 
     def recv(self, timeout: Optional[float] = None) -> dict:
-        self.sock.settimeout(timeout)
-        try:
-            hdr = self._recv_exact(_HDR.size)
-            (n,) = _HDR.unpack(hdr)
-            data = self._recv_exact(n)
-        except (ConnectionResetError, OSError) as e:
-            if isinstance(e, socket.timeout):
-                raise
-            raise ConnectionClosed(str(e)) from e
-        finally:
-            self.sock.settimeout(None)
-        return decode_payload(data)
+        while True:
+            self.sock.settimeout(timeout)
+            try:
+                hdr = self._recv_exact(_HDR.size)
+                (n,) = _HDR.unpack(hdr)
+                data = self._recv_exact(n)
+            except (ConnectionResetError, OSError) as e:
+                if isinstance(e, socket.timeout):
+                    raise
+                raise ConnectionClosed(str(e)) from e
+            finally:
+                self.sock.settimeout(None)
+            msg = decode_payload(data)
+            if _fi._active is not None:
+                v = _fi._active.message_verdict("recv", self.fi_label, msg)
+                if v == "drop":
+                    continue   # the frame "never arrived"
+                if type(v) is tuple:
+                    _fi.apply_delay(v[1])
+            return msg
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -167,8 +201,27 @@ class Connection:
         self.sock.close()
 
 
+def _chaos_filter(label: tuple, msgs: list) -> list:
+    """Per-message chaos verdicts over a batch (drop removes, dup
+    doubles, delay stalls the whole batch — batches share a syscall, so
+    a delayed member delays its neighbors exactly like a real stall)."""
+    plan = _fi._active
+    out = []
+    for m in msgs:
+        v = plan.message_verdict("send", label, m)
+        if v == "drop":
+            continue
+        if type(v) is tuple:
+            _fi.apply_delay(v[1])
+        out.append(m)
+        if v == "dup":
+            out.append(m)
+    return out
+
+
 def connect(address: str, timeout: float = 30.0,
-            remote: bool = False) -> Connection:
+            remote: bool = False,
+            label: Optional[tuple] = None) -> Connection:
     from ray_tpu.core import local_lane
     if local_lane.enabled():
         svc = local_lane.lookup(address)
@@ -178,7 +231,7 @@ def connect(address: str, timeout: float = 30.0,
             # isolate each message with a pickle roundtrip — both ends
             # mutate and retain specs — which is still far cheaper than
             # encode+syscall+select+decode.
-            return local_lane.LaneConnection(svc, copy=remote)
+            return local_lane.LaneConnection(svc, copy=remote, label=label)
     if address.startswith("unix://"):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         sock.settimeout(timeout)
@@ -190,7 +243,8 @@ def connect(address: str, timeout: float = 30.0,
             # method (reference: src/ray/rpc/grpc_server.h hosting)
             sock = grpc_transport.grpc_connect_socket(address,
                                                       timeout=timeout)
-            return Connection(sock, encoding=default_encoding(remote))
+            return Connection(sock, encoding=default_encoding(remote),
+                              label=label)
         host, port = address.rsplit(":", 1)
         if remote and host in ("127.0.0.1", "localhost", "::1"):
             # the proto wire buys language-neutrality across MACHINES;
@@ -199,7 +253,7 @@ def connect(address: str, timeout: float = 30.0,
             remote = False
         sock = socket.create_connection((host, int(port)), timeout=timeout)
     sock.settimeout(None)
-    return Connection(sock, encoding=default_encoding(remote))
+    return Connection(sock, encoding=default_encoding(remote), label=label)
 
 
 def dumps_frame(msg: dict, encoding: str = "pickle") -> bytes:

@@ -27,6 +27,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ray_tpu.core import fault_injection as _fi
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID
 from ray_tpu.core.protocol import dumps_frame
@@ -52,6 +53,10 @@ class ClientRec:
     node_hex: str = ""           # for kind in (node, peer): peer node id
     encoding: str = "pickle"     # wire encoding this client speaks
     seen_envs: set = field(default_factory=set)  # runtime-env hashes run
+    # image this worker was exec'd inside (runtime_env.container); ""
+    # for plain host workers.  Container tasks only dispatch to a
+    # matching-image worker and vice versa.
+    container_image: str = ""
     # in-process clients (core/local_lane.py): pushes are handed over as
     # objects on the loop thread instead of being framed onto a socket
     lane: Any = None
@@ -207,6 +212,10 @@ class EventLoopService:
             if now - self._last_tick > self.tick_interval:
                 self._last_tick = now
                 try:
+                    if _fi._active is not None:
+                        # chaos plane: scripted per-tick triggers (e.g.
+                        # "stop the head at tick N")
+                        _fi._active.on_service_tick(self)
                     self.on_tick()
                 except Exception:
                     sys.stderr.write(f"[{self.name}] tick error:\n"
@@ -481,6 +490,12 @@ class EventLoopService:
     # ------------------------------------------------------------- dispatch
 
     def _dispatch(self, rec: ClientRec, msg: dict) -> None:
+        if _fi._active is not None:
+            # chaos plane: scripted triggers keyed on the Nth matching
+            # service message ("head dies mid-cluster_submit"); True
+            # swallows the message, as if the crash preceded it
+            if _fi._active.on_service_msg(self, rec, msg):
+                return
         if msg.get("t") == "reply":
             cb = self._rpc_pending.pop(msg.get("reqid"), None)
             if cb is not None:

@@ -77,10 +77,13 @@ def run_worker(address: str) -> None:
     except (AttributeError, ValueError):
         pass   # non-POSIX or non-main-thread: dumps unavailable
 
+    from ray_tpu.core import fault_injection
     from ray_tpu.core.client import NodeClient
     from ray_tpu.core.executor import (Executor, make_message_queue,
                                        queue_push_handler)
     from ray_tpu.core import runtime as rt
+
+    fault_injection.autoinstall_from_env()   # chaos plane in workers
 
     inbox = make_message_queue()
     cell: dict = {}

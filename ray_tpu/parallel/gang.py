@@ -21,6 +21,7 @@ mesh, so recovery = rebuild the gang and restore from checkpoint
 from __future__ import annotations
 
 import socket
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -28,6 +29,44 @@ import jax
 from jax.sharding import Mesh
 
 from ray_tpu.parallel.mesh import batch_sharding, create_mesh, mesh_shape
+
+
+class GangMemberDied(RuntimeError):
+    """A member actor died (or its call failed) during a collective
+    gang operation.  Carries the rank so elastic recovery can name
+    survivors without parsing error strings."""
+
+    def __init__(self, rank: int, message: str):
+        self.rank = rank
+        super().__init__(message)
+
+
+def _gather(refs: list, timeout: Optional[float], what: str) -> list:
+    """Collective get with PER-MEMBER completion watching: the first
+    member failure surfaces immediately as GangMemberDied naming the
+    rank, instead of blocking until the stragglers a dead/failed peer
+    has wedged (e.g. the rest of a formation barrier) time out."""
+    import ray_tpu
+    from ray_tpu.core.client import GetTimeoutError
+    deadline = None if timeout is None else time.monotonic() + timeout
+    pending = {ref: i for i, ref in enumerate(refs)}
+    out: list = [None] * len(refs)
+    while pending:
+        ready, _ = ray_tpu.wait(list(pending), num_returns=len(pending),
+                                timeout=1.0)
+        for ref in ready:
+            i = pending.pop(ref)
+            try:
+                out[i] = ray_tpu.get([ref])[0]
+            except Exception as e:
+                raise GangMemberDied(
+                    i, f"gang member rank {i}/{len(refs)} failed during "
+                       f"{what}: {e}") from e
+        if deadline is not None and time.monotonic() > deadline and pending:
+            raise GetTimeoutError(
+                f"gang {what} timed out; ranks still pending: "
+                f"{sorted(pending.values())}")
+    return out
 
 
 @dataclass
@@ -126,6 +165,7 @@ class GangMember:
         self.cpu_backend = cpu_backend
         self.local_device_count = local_device_count
         self._initialized = False
+        self._busy = False
 
     def choose_coordinator(self) -> str:
         """Rank 0 picks the rendezvous address ON ITS OWN HOST (the
@@ -133,11 +173,14 @@ class GangMember:
         ip = _routable_ip()
         return f"{ip}:{_free_port()}"
 
-    def setup(self, coordinator: str) -> dict:
+    def _pin_backend(self) -> None:
         import jax as _jax
         if self.cpu_backend:
             # must land before first backend touch in this fresh process
             _jax.config.update("jax_platforms", "cpu")
+            from ray_tpu.parallel.jax_compat import \
+                enable_cpu_gloo_collectives
+            enable_cpu_gloo_collectives()
             if self.local_device_count:
                 try:
                     _jax.config.update("jax_num_cpu_devices",
@@ -149,20 +192,71 @@ class GangMember:
                         _os.environ.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count="
                         + str(self.local_device_count))
-        if self.world > 1 and not self._initialized:
-            _jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=self.world, process_id=self.rank)
-            self._initialized = True
+
+    def _info(self) -> dict:
+        import jax as _jax
         return {"rank": self.rank,
                 "global_devices": len(_jax.devices()),
                 "local_devices": len(_jax.local_devices()),
                 "pid": __import__("os").getpid()}
 
+    def setup(self, coordinator: str) -> dict:
+        from ray_tpu.parallel.jax_compat import distributed_initialize
+        self._pin_backend()
+        if self.world > 1 and not self._initialized:
+            # resilient client: a PEER's death must surface as a
+            # collective error here, not terminate this process — the
+            # property the elastic gang is built on (jax_compat)
+            distributed_initialize(coordinator, self.world, self.rank)
+            self._initialized = True
+        return self._info()
+
+    def reinit(self, coordinator: str, world: int, rank: int) -> dict:
+        """Leave the current (possibly poisoned) distributed world IN
+        PLACE — same process, same pid — and join a new one at the new
+        world size/rank.  The elastic re-gang step: abandon (no
+        collective barrier), drop cached backends so the global device
+        view shrinks/grows, re-initialize."""
+        from ray_tpu.parallel.jax_compat import (clear_backends,
+                                                 distributed_abandon,
+                                                 distributed_initialize)
+        self._await_idle()
+        if self._initialized:
+            distributed_abandon()
+            self._initialized = False
+        clear_backends()
+        self.rank = rank
+        self.world = world
+        if world > 1:
+            distributed_initialize(coordinator, world, rank)
+            self._initialized = True
+        return self._info()
+
     def run(self, pickled_fn: bytes, *args):
         import cloudpickle
         fn = cloudpickle.loads(pickled_fn)
-        return fn(self.rank, *args)
+        self._busy = True
+        try:
+            return fn(self.rank, *args)
+        finally:
+            self._busy = False
+
+    def _await_idle(self, timeout: float = 45.0) -> None:
+        """A reform may land while this member's run() thread is still
+        wedged in a collective its dead peer poisoned; tearing the
+        backend down under a live computation is undefined.  Gloo
+        surfaces peer death as an error within seconds, so wait for the
+        attempt to unwind before abandoning the world."""
+        deadline = time.monotonic() + timeout
+        while getattr(self, "_busy", False) and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+    def ping(self) -> dict:
+        """Liveness probe; dispatched concurrently with run() (the gang
+        creates members with max_concurrency>1), so a member wedged in
+        a broken collective still answers."""
+        import os
+        return {"rank": self.rank, "pid": os.getpid()}
 
     def pid(self) -> int:
         import os
@@ -177,58 +271,174 @@ class MultiHostGang:
     (reference: train/_internal/backend_executor.py:94 start +
     worker_group.py:92); formation here is one collective
     jax.distributed.initialize instead of a framework process-group
-    bootstrap.  A member death breaks the gang; recovery is re-forming a
-    NEW gang (fresh coordinator, fresh processes) and restoring state
-    from a checkpoint (reference: backend_executor.py:571 restart).
+    bootstrap.
+
+    The gang is ELASTIC: a member death no longer forces a full restart.
+    ``reform(survivors)`` re-forms the gang at reduced world size from
+    the SURVIVING member actors — same processes, same pids, fresh
+    coordinator, fresh jax.distributed world, dp axis resharded to the
+    new world — and ``readmit()`` grows it back toward the target size
+    with replacement actors at the next re-gang boundary.  Full teardown
+    + re-formation (reference: backend_executor.py:571 restart) remains
+    the fallback when no member survives or reform itself fails.
     """
 
     def __init__(self, num_members: int, *, num_tpus_per_member: float = 0,
                  cpu_backend: bool = False, devices_per_member: int = 0,
                  resources_per_member: Optional[dict] = None,
-                 setup_timeout: float = 120.0):
+                 setup_timeout: float = 120.0,
+                 member_cls: Optional[type] = None):
         import ray_tpu
 
         self.num_members = num_members
-        opts: dict = {}
+        self.target_members = num_members
+        self.setup_timeout = setup_timeout
+        self._cpu_backend = cpu_backend
+        self._devices_per_member = devices_per_member
+        opts: dict = {"max_concurrency": 4}   # ping/reinit beside run
         if num_tpus_per_member:
             opts["num_tpus"] = num_tpus_per_member
         if resources_per_member:
             opts["resources"] = resources_per_member
-        member_cls = ray_tpu.remote(GangMember)
-        if opts:
-            member_cls = member_cls.options(**opts)
+        self._actor_cls = ray_tpu.remote(member_cls or GangMember) \
+            .options(**opts)
         self.members = [
-            member_cls.remote(rank=i, world=num_members,
-                              cpu_backend=cpu_backend,
-                              local_device_count=devices_per_member)
+            self._actor_cls.remote(rank=i, world=num_members,
+                                   cpu_backend=cpu_backend,
+                                   local_device_count=devices_per_member)
             for i in range(num_members)]
-        # rank 0 picks the rendezvous address on ITS host (it may be
-        # scheduled on any node), then setup is a collective barrier:
-        # all members must be in flight together
-        self.coordinator = ray_tpu.get(
-            self.members[0].choose_coordinator.remote(),
-            timeout=setup_timeout)
-        self.infos = ray_tpu.get(
-            [m.setup.remote(self.coordinator) for m in self.members],
-            timeout=setup_timeout)
+        try:
+            # rank 0 picks the rendezvous address on ITS host (it may be
+            # scheduled on any node), then setup is a collective barrier:
+            # all members must be in flight together.  _gather surfaces
+            # the FIRST failed setup promptly — the others are wedged in
+            # a barrier that can no longer complete.
+            self.coordinator = ray_tpu.get(
+                self.members[0].choose_coordinator.remote(),
+                timeout=setup_timeout)
+            self.infos = _gather(
+                [m.setup.remote(self.coordinator) for m in self.members],
+                setup_timeout, "formation setup")
+        except BaseException:
+            # partial formation must not leak the members that DID come
+            # up: one failed/timed-out setup used to leave world-1
+            # actors alive (and holding TPU reservations) forever
+            self.shutdown()
+            raise
         self.global_devices = self.infos[0]["global_devices"]
+
+    # ----------------------------------------------------------- execution
 
     def run(self, fn: Callable, *args,
             timeout: Optional[float] = None) -> list:
         """Run ``fn(rank, *args)`` on every member; returns per-rank
         results (SPMD: all ranks execute the same program).  No default
-        timeout: a member-side attempt may legitimately run for hours —
-        member death still fails the get with an actor-death error."""
+        timeout: a member-side attempt may legitimately run for hours.
+
+        Completion is watched PER MEMBER: the first failure — actor
+        death or member exception — surfaces immediately as
+        ``GangMemberDied`` naming the rank, instead of blocking on
+        stragglers a dead peer has wedged in a broken collective."""
         import cloudpickle
-        import ray_tpu
         payload = cloudpickle.dumps(fn)
-        refs = [m.run.remote(payload, *args) for m in self.members]
-        return ray_tpu.get(refs, timeout=timeout)
+        return _gather([m.run.remote(payload, *args)
+                        for m in self.members], timeout, "run")
 
     def member_pids(self) -> list[int]:
         import ray_tpu
         return ray_tpu.get([m.pid.remote() for m in self.members],
                            timeout=60)
+
+    # ------------------------------------------------------------ elasticity
+
+    def alive_ranks(self, timeout: float = 15.0) -> list[int]:
+        """Probe every member concurrently; returns the ranks that still
+        answer.  One shared deadline over ALL probes — a handful of
+        wedged members must cost one window, not one window each.  Death
+        errors surface promptly (event-driven actor-death sealing), so
+        the common case costs one round-trip."""
+        import ray_tpu
+        probes = [(i, m.ping.remote()) for i, m in enumerate(self.members)]
+        ready, _ = ray_tpu.wait([r for _, r in probes],
+                                num_returns=len(probes), timeout=timeout)
+        ready_set = set(ready)
+        out = []
+        for i, ref in probes:
+            if ref not in ready_set:
+                continue   # unresponsive within the window: not alive
+            try:
+                ray_tpu.get([ref], timeout=5)
+                out.append(i)
+            except Exception:
+                pass       # sealed as an actor-death error: dead
+        return out
+
+    def reform(self, survivors: list[int]) -> None:
+        """Re-form the gang from the surviving member actors at world
+        size ``len(survivors)`` — their PROCESSES are kept (same pids);
+        only the jax.distributed world is torn down and rebuilt, with
+        the dp axis implicitly resharded to the new global device set.
+        Dead members' actor handles are reaped."""
+        import ray_tpu
+        if not survivors:
+            raise ValueError("reform needs at least one survivor")
+        survivors = sorted(survivors)
+        dead = [m for i, m in enumerate(self.members) if i not in survivors]
+        keep = [self.members[i] for i in survivors]
+        world = len(keep)
+        # new rank 0 picks a FRESH coordinator on its host (the old
+        # coordinator may have died with rank 0, and a stale service
+        # must never adopt the new world)
+        self.coordinator = ray_tpu.get(
+            keep[0].choose_coordinator.remote(), timeout=self.setup_timeout)
+        refs = [m.reinit.remote(self.coordinator, world, i)
+                for i, m in enumerate(keep)]
+        self.infos = _gather(refs, self.setup_timeout, "reform")
+        self.members = keep
+        self.num_members = world
+        self.global_devices = self.infos[0]["global_devices"]
+        for m in dead:
+            try:
+                ray_tpu.kill(m)
+            except Exception:
+                pass
+
+    def readmit(self, count: Optional[int] = None) -> int:
+        """Grow the gang back toward ``target_members`` with REPLACEMENT
+        member actors (fresh processes), re-initializing the whole world
+        at the larger size.  Survivor processes are still kept — this is
+        the "re-admit a replacement host at the next re-gang boundary"
+        step.  Returns the new world size."""
+        import ray_tpu
+        want = self.target_members - self.num_members \
+            if count is None else count
+        if want <= 0:
+            return self.num_members
+        world = self.num_members + want
+        fresh = [
+            self._actor_cls.remote(rank=self.num_members + j, world=world,
+                                   cpu_backend=self._cpu_backend,
+                                   local_device_count=self._devices_per_member)
+            for j in range(want)]
+        try:
+            self.coordinator = ray_tpu.get(
+                self.members[0].choose_coordinator.remote(),
+                timeout=self.setup_timeout)
+            refs = [m.reinit.remote(self.coordinator, world, i)
+                    for i, m in enumerate(self.members)]
+            refs += [m.setup.remote(self.coordinator) for m in fresh]
+            self.infos = _gather(refs, self.setup_timeout, "readmit")
+        except BaseException:
+            for m in fresh:   # don't leak half-admitted replacements
+                try:
+                    ray_tpu.kill(m)
+                except Exception:
+                    pass
+            raise
+        self.members = self.members + fresh
+        self.num_members = world
+        self.global_devices = self.infos[0]["global_devices"]
+        return world
 
     def shutdown(self) -> None:
         import ray_tpu

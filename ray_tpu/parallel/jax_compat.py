@@ -41,3 +41,154 @@ def shard_map(f=None, **kw):
     if f is None:
         return lambda g: shard_map(g, **kw)
     return _shard_map(f, **kw)
+
+
+# ---------------------------------------------------------------------------
+# elastic jax.distributed (parallel/gang.py)
+#
+# Three version-gated capabilities the elastic gang needs that the public
+# jax.distributed surface doesn't expose:
+#
+#   * SURVIVABLE membership: the stock DistributedRuntimeClient's
+#     missed-heartbeat/error-poll callback LOG(FATAL)s the process the
+#     moment ANY peer dies — the exact opposite of shrink-and-resume.
+#     ``distributed_initialize(resilient=True)`` builds the client with a
+#     no-op callback and ``shutdown_on_destruction=False`` so member
+#     death is an ERROR the gang layer handles, not process suicide.
+#   * FAST detection: heartbeat interval/threshold knobs (seconds, not
+#     the stock ~100 s window) so a dead member poisons collectives
+#     quickly and reform isn't hostage to a long timeout.
+#   * ABANDON: ``distributed_abandon()`` force-leaves a (possibly
+#     poisoned) world without the collective shutdown barrier — the
+#     barrier can never complete once a peer is dead — then
+#     ``clear_backends()`` drops the cached global-device view so the
+#     next initialize sees the NEW world.
+
+
+def distributed_initialize(coordinator_address: str, num_processes: int,
+                           process_id: int, *, resilient: bool = True,
+                           heartbeat_interval_s: int = 1,
+                           max_missing_heartbeats: int = 5,
+                           init_timeout_s: int = 120) -> str:
+    """Initialize jax.distributed; returns "resilient" when the
+    peer-death-survivable client was installed, "plain" when this jax's
+    private surface moved and we fell back to the public API (elastic
+    shrink then degrades to full-restart recovery)."""
+    import jax
+    if not resilient:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return "plain"
+    try:
+        from jax._src import distributed
+        from jax._src.lib import xla_extension
+        st = distributed.global_state
+        if st.client is not None:
+            raise RuntimeError("jax.distributed already initialized")
+        port = coordinator_address.rsplit(":", 1)[1]
+        if process_id == 0:
+            st.service = xla_extension.get_distributed_runtime_service(
+                "[::]:" + port, num_processes,
+                heartbeat_interval=heartbeat_interval_s,
+                max_missing_heartbeats=max_missing_heartbeats)
+        client = xla_extension.get_distributed_runtime_client(
+            coordinator_address, process_id,
+            init_timeout=init_timeout_s, shutdown_timeout=5,
+            heartbeat_interval=heartbeat_interval_s,
+            max_missing_heartbeats=max_missing_heartbeats,
+            missed_heartbeat_callback=lambda *a, **k: None,
+            shutdown_on_destruction=False, use_compression=True)
+        client.connect()
+        st.client = client
+        st.process_id = process_id
+        st.num_processes = num_processes
+        st.coordinator_address = coordinator_address
+        return "resilient"
+    except (ImportError, AttributeError, TypeError):
+        # moved private surface: correctness over elasticity.  A
+        # partially-built resilient setup (e.g. the service came up but
+        # the client factory's signature changed) must be torn down
+        # first, or the public-API fallback re-binds the same port.
+        try:
+            from jax._src import distributed as _dist
+            st = _dist.global_state
+            for attr in ("client", "service"):
+                obj = getattr(st, attr, None)
+                if obj is not None:
+                    setattr(st, attr, None)
+                    try:
+                        obj.shutdown()
+                    except Exception:
+                        pass
+        except ImportError:
+            pass
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return "plain"
+
+
+def distributed_abandon(timeout_s: float = 20.0) -> None:
+    """Leave the current jax.distributed world WITHOUT requiring the
+    collective shutdown barrier to succeed (it can't once a member is
+    dead).  The barrier attempt runs on a bounded side thread: with the
+    dead peer already marked by the coordination service it fails fast;
+    a wedged one is abandoned to the daemon thread."""
+    import threading
+
+    try:
+        from jax._src import distributed
+        st = distributed.global_state
+    except ImportError:
+        import jax
+        jax.distributed.shutdown()
+        return
+    client, service = st.client, st.service
+    st.client = None
+    st.service = None
+    st.preemption_sync_manager = None
+    st.process_id = None
+    st.num_processes = None
+    st.coordinator_address = None
+
+    def quiet_shutdown(obj):
+        try:
+            obj.shutdown()
+        except Exception:
+            pass
+
+    for obj in (client, service):
+        if obj is None:
+            continue
+        t = threading.Thread(target=quiet_shutdown, args=(obj,),
+                             daemon=True)
+        t.start()
+        t.join(timeout=timeout_s)
+
+
+def clear_backends() -> None:
+    """Drop cached XLA backends (and with them the stale global-device
+    view) so the next backend touch re-initializes against the CURRENT
+    jax.distributed world."""
+    import jax
+    f = getattr(jax, "clear_backends", None)
+    if f is None:
+        from jax.extend import backend as _xb
+        f = _xb.clear_backends
+    f()
+
+
+def enable_cpu_gloo_collectives() -> None:
+    """Make CPU-backend cross-process collectives real (the multi-host
+    test shape): newer jax spells it jax_cpu_collectives_implementation,
+    older jax_cpu_enable_gloo_collectives.  Must run before the CPU
+    backend initializes."""
+    import jax
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        try:
+            jax.config.update("jax_cpu_enable_gloo_collectives", True)
+        except (AttributeError, ValueError):
+            pass   # very old jax: single-host only

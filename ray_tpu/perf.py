@@ -20,7 +20,7 @@ import numpy as np
 
 # bumped every growth round so committed evidence files (PERF_rNN.json)
 # are self-identifying; scale_envelope.py shares this stamp
-ROUND = 6
+ROUND = 7
 
 
 def timeit(name: str, fn, multiplier: int = 1, unit: str = "ops/s",

@@ -102,7 +102,12 @@ def container_command(container: dict, worker_cmd: list,
         raise RuntimeError(
             "runtime_env requests a container but neither podman nor "
             "docker is installed on this node")
+    # --pid=host: the worker registers os.getpid() with the node, and
+    # every node-side signal (OOM kill, stack dump, chaos kills) targets
+    # that pid on the HOST — a private pid namespace would make the node
+    # signal the wrong process (or init) for every one of them
     return ([rt, "run", "--rm", "--network=host", "--ipc=host",
+             "--pid=host",
              "-v", f"{session_dir}:{session_dir}",
              "-v", "/dev/shm:/dev/shm",
              "-e", f"RAY_TPU_CONTAINER_IMAGE={container['image']}"]

@@ -29,6 +29,14 @@ class ScalingConfig:
     # extra custom resources each gang member reserves (placement)
     resources_per_host: Optional[dict] = None
     num_tpus_per_host: float = 0
+    # Elastic gang recovery: on member death, shrink to the survivors
+    # (same processes, dp resharded) and resume from checkpoint instead
+    # of tearing the whole gang down; replacements are re-admitted at
+    # the next re-gang boundary.  Full restart remains the fallback
+    # whenever fewer than ``min_hosts`` members survive or the reform
+    # itself fails.
+    elastic: bool = True
+    min_hosts: int = 1
     # reference-compat aliases: ScalingConfig(num_workers=8) on a CPU mesh
     num_workers: Optional[int] = None
 

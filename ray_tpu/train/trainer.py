@@ -123,6 +123,10 @@ class DataParallelTrainer(BaseTrainer):
         self._loop_config = train_loop_config or {}
         self._datasets = datasets or {}
         self._gang: Optional[TpuGang] = None
+        # set by an elastic shrink so the immediately following RESUME
+        # attempt runs at the reduced size; replacements are re-admitted
+        # only at the NEXT re-gang boundary after that
+        self._elastic_shrunk = False
 
     @property
     def gang(self):
@@ -162,6 +166,50 @@ class DataParallelTrainer(BaseTrainer):
             else:
                 self._loop(cfg)
 
+    def _elastic_recover(self, gang: MultiHostGang) -> bool:
+        """Attempted in-place gang recovery after a failed multihost
+        attempt.  True = the gang was re-formed from surviving member
+        PROCESSES (shrunk to survivors, or re-admitted back toward the
+        target size when this boundary saw no new deaths) and the next
+        attempt should reuse it; False = fall back to full teardown +
+        re-formation."""
+        sc = self.scaling_config
+        if not getattr(sc, "elastic", False):
+            return False
+        try:
+            alive = gang.alive_ranks()
+        except Exception:
+            return False
+        if len(alive) < max(1, getattr(sc, "min_hosts", 1)):
+            return False
+        try:
+            if len(alive) < gang.num_members:
+                logger.warning(
+                    "elastic re-gang: %d/%d members survive; shrinking "
+                    "and resuming from the latest checkpoint",
+                    len(alive), gang.num_members)
+                gang.reform(alive)
+                self._elastic_shrunk = True
+            elif gang.num_members < gang.target_members:
+                # a re-gang boundary with no new deaths: re-admit
+                # replacement members up to the target world size
+                logger.warning(
+                    "elastic re-gang: re-admitting %d replacement "
+                    "member(s)",
+                    gang.target_members - gang.num_members)
+                gang.readmit()
+            else:
+                # all members alive (the failure was in the attempt, not
+                # membership): rebuild the distributed world in place so
+                # a poisoned collective runtime can't leak into the retry
+                gang.reform(list(range(gang.num_members)))
+        except Exception:
+            logger.warning("elastic re-gang failed; falling back to full "
+                           "gang restart", exc_info=True)
+            return False
+        self._gang = gang
+        return True
+
     def _attempt_multihost(self, gang: MultiHostGang) -> None:
         """One SPMD attempt across gang members.
 
@@ -170,13 +218,27 @@ class DataParallelTrainer(BaseTrainer):
         root (shared storage — the reference's workers likewise upload
         to storage_path), so the driver's CheckpointManager discovers
         them for restart-based FT.  A member death fails the attempt;
-        fit() re-forms a fresh gang and restores
-        (reference: backend_executor.py:571)."""
+        with ``scaling_config.elastic`` the gang re-forms IN PLACE from
+        the survivors (same pids) and fit() resumes from the latest
+        checkpoint; otherwise — or when recovery fails — fit() re-forms
+        a fresh gang (reference: backend_executor.py:571)."""
         if self._datasets:
             raise NotImplementedError(
                 "datasets= with num_hosts>1: iterate data inside the "
                 "train loop (each member sees the same iterator and "
                 "feeds its own shard via shard_batch)")
+        sc = self.scaling_config
+        if (getattr(sc, "elastic", False) and not self._elastic_shrunk
+                and gang.num_members < gang.target_members):
+            # fresh attempt at a re-gang boundary (not the immediate
+            # post-shrink resume): restore the target world size
+            try:
+                gang.readmit()
+            except Exception:
+                logger.warning("replacement re-admission failed; "
+                               "continuing at world=%d", gang.num_members,
+                               exc_info=True)
+        self._elastic_shrunk = False
         st = _session._state()
         st.world_size = gang.num_members
         run_dir = self.run_config.resolved_storage_path()
@@ -245,7 +307,12 @@ class DataParallelTrainer(BaseTrainer):
         try:
             outs = gang.run(member_attempt)
         except Exception:
-            # broken gang: tear it down so the retry forms a fresh one
+            if self._elastic_recover(gang):
+                # survivors re-formed in place; fit() restores from the
+                # latest checkpoint and the next attempt reuses them
+                raise
+            # no survivors / reform failed: tear the gang down so the
+            # retry forms a fresh one
             gang.shutdown()
             self._gang = None
             raise

@@ -41,3 +41,8 @@ def cpu_mesh_devices():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: longer learning/convergence tests")
+    config.addinivalue_line(
+        "markers", "chaos: scripted fault-injection tests "
+                   "(core/fault_injection.py); quick deterministic ones "
+                   "run in tier-1, long kill-a-host flows are also "
+                   "marked slow")

@@ -1,0 +1,102 @@
+"""Containerized workers: runtime_env.container now reaches the node's
+SPAWN path (ROADMAP 5a closed — validation + argv building existed, but
+no spawn ever exec'd it).  Tested through a stubbed ``podman`` on PATH,
+the launcher's stubbed-gcloud pattern: the stub records the argv it was
+handed, then execs the worker command with the image env applied — so
+the task genuinely runs inside the container argv.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+
+import pytest
+
+import ray_tpu
+
+IMAGE = "fake.registry/chaos-img:1"
+
+_STUB = """#!/usr/bin/env python3
+import json, os, sys
+args = sys.argv[1:]
+with open({log!r}, "a") as f:
+    f.write(json.dumps(args) + "\\n")
+image = next(a.split("=", 1)[1] for a in args
+             if a.startswith("RAY_TPU_CONTAINER_IMAGE="))
+cmd = args[args.index(image) + 1:]
+os.environ["RAY_TPU_CONTAINER_IMAGE"] = image
+os.execvp(cmd[0], cmd)
+"""
+
+
+@pytest.fixture
+def podman_stub(tmp_path, monkeypatch):
+    log = tmp_path / "podman_calls.jsonl"
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    stub = bindir / "podman"
+    stub.write_text(_STUB.format(log=str(log)))
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH",
+                       f"{bindir}{os.pathsep}{os.environ.get('PATH', '')}")
+    return log
+
+
+@pytest.fixture
+def rt(podman_stub):
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_task_runs_inside_container_argv(rt, podman_stub):
+    @ray_tpu.remote(runtime_env={"container": {"image": IMAGE}})
+    def where_am_i():
+        return {"image": os.environ.get("RAY_TPU_CONTAINER_IMAGE", ""),
+                "pid": os.getpid()}
+
+    out = ray_tpu.get(where_am_i.remote(), timeout=120)
+    # the worker really came up through the container argv: the image
+    # env only exists inside the stub-exec'd command
+    assert out["image"] == IMAGE
+
+    calls = [json.loads(line)
+             for line in podman_stub.read_text().splitlines()]
+    assert calls, "podman was never invoked"
+    argv = calls[0]
+    assert argv[0] == "run"
+    assert "--network=host" in argv and "--ipc=host" in argv
+    # --pid=host: the registered worker pid must be signalable by the
+    # node (OOM kills, stack dumps, chaos kills)
+    assert "--pid=host" in argv
+    assert IMAGE in argv
+    worker_cmd = argv[argv.index(IMAGE) + 1:]
+    # prefork bypass: a template fork can't exec inside an image, so
+    # the spawn must be the cold worker argv wrapped by the runtime
+    assert "ray_tpu.core.worker" in worker_cmd
+
+
+def test_plain_tasks_do_not_borrow_container_workers(rt, podman_stub):
+    @ray_tpu.remote(runtime_env={"container": {"image": IMAGE}})
+    def containered():
+        return os.environ.get("RAY_TPU_CONTAINER_IMAGE", "")
+
+    @ray_tpu.remote
+    def plain():
+        return os.environ.get("RAY_TPU_CONTAINER_IMAGE", "")
+
+    assert ray_tpu.get(containered.remote(), timeout=120) == IMAGE
+    # a host task scheduled right after must not land in the (now
+    # idle) containerized worker
+    assert ray_tpu.get(plain.remote(), timeout=120) == ""
+
+
+def test_container_validation_still_guards_bad_shapes():
+    from ray_tpu.runtime_env import validate
+    with pytest.raises(ValueError):
+        validate({"container": {"run_options": ["x"]}})   # no image
+    ok = validate({"container": {"image": IMAGE,
+                                 "run_options": ["--cap-add=NET_ADMIN"]}})
+    assert ok["container"]["image"] == IMAGE

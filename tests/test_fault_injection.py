@@ -1,0 +1,237 @@
+"""Chaos plane: deterministic, seedable fault injection
+(core/fault_injection.py) exercised at every choke point — message
+drop/delay/duplicate/partition on control-plane links, scripted worker
+kills at dispatch, spawn outages, scripted head death — plus the
+RetryPolicy that lets clients ride out a head failover.
+
+These are the QUICK deterministic chaos tests (tier-1); the long
+kill-a-host-mid-epoch flows live in test_elastic_gang.py /
+test_chaos_e2e.py behind the ``slow`` marker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    fi.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# pure-plan determinism
+
+
+def test_probabilistic_rules_replay_identically():
+    def schedule(seed):
+        plan = fi.FaultPlan(seed=seed)
+        plan.drop_messages(msg_type="hb", prob=0.3)
+        return [plan.message_verdict("send", ("a", "b"), {"t": "hb"})
+                for _ in range(200)]
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7) != schedule(8)   # seed actually matters
+
+
+def test_nth_and_times_counters():
+    plan = fi.FaultPlan()
+    plan.drop_messages(msg_type="x", nth=3)
+    verdicts = [plan.message_verdict("send", ("a", "b"), {"t": "x"})
+                for _ in range(5)]
+    assert verdicts == [None, None, "drop", None, None]
+
+    plan2 = fi.FaultPlan()
+    plan2.drop_messages(msg_type="x", times=2)
+    verdicts = [plan2.message_verdict("send", ("a", "b"), {"t": "x"})
+                for _ in range(4)]
+    assert verdicts == ["drop", "drop", None, None]
+
+
+def test_partition_and_heal():
+    plan = fi.FaultPlan()
+    p = plan.partition("node:aa", "head")
+    label = ("node:aabb11", "head")
+    assert plan.message_verdict("send", label, {"t": "heartbeat"}) == "drop"
+    assert plan.message_verdict("deliver", label, {"t": "pub"}) == "drop"
+    # other links unaffected
+    assert plan.message_verdict("send", ("node:ff00", "head"),
+                                {"t": "heartbeat"}) is None
+    p.heal()
+    assert plan.message_verdict("send", label, {"t": "heartbeat"}) is None
+
+
+# ---------------------------------------------------------------------------
+# live single-node runtime under a plan
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_drop_nth_request_then_recover(rt):
+    client = ray_tpu.get_runtime().client
+    plan = fi.FaultPlan()
+    # drop exactly the next object_stats request on the driver link
+    plan.drop_messages(msg_type="object_stats", link="client:driver",
+                       nth=1)
+    with fi.injected(plan):
+        from ray_tpu.core.client import GetTimeoutError
+        with pytest.raises(GetTimeoutError):
+            client._request_once({"t": "object_stats"}, timeout=0.6)
+        # the very next one passes — the schedule is exact, not lossy
+        assert client.request({"t": "object_stats"},
+                              timeout=30) is not None
+    assert ("send", "drop", "object_stats") in plan.log
+
+
+def test_delay_injects_measured_latency(rt):
+    client = ray_tpu.get_runtime().client
+    plan = fi.FaultPlan()
+    plan.delay_messages(0.4, msg_type="ping", link="client:driver",
+                        times=1)
+    with fi.injected(plan):
+        t0 = time.perf_counter()
+        client.request({"t": "ping"}, timeout=30)
+        dt = time.perf_counter() - t0
+    assert dt >= 0.4
+
+
+def test_duplicate_request_is_harmless(rt):
+    client = ray_tpu.get_runtime().client
+    plan = fi.FaultPlan()
+    plan.duplicate_messages(msg_type="ping", link="client:driver",
+                            times=1)
+    with fi.injected(plan):
+        assert client.request({"t": "ping"}, timeout=30)["ok"]
+        # the duplicate produced a second reply for a reqid that is
+        # already resolved; correlation must swallow it and later
+        # traffic must be unaffected
+        assert client.request({"t": "ping"}, timeout=30)["ok"]
+    assert ("send", "dup", "ping") in plan.log
+
+
+def test_kill_worker_at_first_dispatch_retries(rt):
+    plan = fi.FaultPlan()
+    plan.kill_worker_at_dispatch(1)
+
+    @ray_tpu.remote(max_retries=2)
+    def work(x):
+        return x * 2
+
+    with fi.injected(plan):
+        assert ray_tpu.get(work.remote(21), timeout=120) == 42
+    kills = [e for e in plan.log if e[0] == "dispatch"]
+    assert len(kills) == 1   # the schedule fired exactly once
+
+
+def test_spawn_outage_self_heals(rt):
+    plan = fi.FaultPlan()
+    plan.fail_spawn(times=2)   # the first two spawn attempts vanish
+
+    @ray_tpu.remote
+    def probe():
+        return "up"
+
+    with fi.injected(plan):
+        assert ray_tpu.get(probe.remote(), timeout=120) == "up"
+    assert [e for e in plan.log if e[0] == "spawn"]
+
+
+# ---------------------------------------------------------------------------
+# scripted head death + retry-through-failover (virtual cluster)
+
+
+def test_scripted_head_stop_is_deterministic():
+    from ray_tpu.cluster_utils import Cluster
+    c = Cluster()
+    try:
+        n0 = c.add_node(num_cpus=1)
+        c.wait_for_nodes()
+        plan = fi.FaultPlan()
+        stopped = threading.Event()
+        plan.script(lambda svc, rec, m: (svc.stop(), stopped.set()),
+                    service="head", msg_type="heartbeat", nth=3)
+        with fi.injected(plan):
+            assert stopped.wait(timeout=30), \
+                "scripted head stop never fired"
+            deadline = time.time() + 30
+            while time.time() < deadline and n0.head_conn is not None:
+                time.sleep(0.05)
+            assert n0.head_conn is None   # the node noticed the loss
+        assert ("service_msg", "script", "heartbeat") in plan.log
+    finally:
+        c.shutdown()
+
+
+def test_retry_policy_classification():
+    p = ray_tpu.RetryPolicy(deadline_s=1)
+    assert p.retryable(RuntimeError("head connection lost"))
+    assert p.retryable(RuntimeError("no head connection"))
+    assert not p.retryable(RuntimeError("Actor is dead: worker died"))
+    from ray_tpu.core.client import ActorDiedError, GetTimeoutError
+    assert not p.retryable(ActorDiedError("head connection lost maybe"))
+    assert not p.retryable(GetTimeoutError("request timed out"))
+    # backoff schedule is jittered but deterministic under a seed
+    a = [round(x, 6) for x, _ in zip(
+        ray_tpu.RetryPolicy(seed=3).backoffs(), range(5))]
+    b = [round(x, 6) for x, _ in zip(
+        ray_tpu.RetryPolicy(seed=3).backoffs(), range(5))]
+    assert a == b
+
+
+def test_kv_get_rides_out_head_restart():
+    """The RetryPolicy acceptance: a proxied read issued while the head
+    is DOWN backs off and returns the answer once the head is back,
+    instead of surfacing the failover to the caller."""
+    from ray_tpu.cluster_utils import Cluster
+    c = Cluster(head_persistence=True)
+    try:
+        n0 = c.add_node(num_cpus=1)
+        c.wait_for_nodes()
+        ray_tpu.init(address=n0.address)
+        client = ray_tpu.get_runtime().client
+        client.kv_put(b"durable", b"value")
+        # replication barrier so the restarted head restores the key
+        client.request({"t": "head_flush"}, timeout=60)
+
+        c.head.stop()
+        deadline = time.time() + 30
+        while time.time() < deadline and n0.head_conn is not None:
+            time.sleep(0.05)
+        assert n0.head_conn is None
+
+        holder: dict = {}
+
+        def read():
+            try:
+                holder["value"] = client.kv_get(b"durable")
+            except Exception as e:
+                holder["error"] = e
+
+        t = threading.Thread(target=read)
+        t.start()
+        time.sleep(1.0)          # the read is now failing + backing off
+        c.restart_head()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert "error" not in holder, holder.get("error")
+        assert holder["value"] == b"value"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        c.shutdown()
