@@ -171,6 +171,13 @@ _define("mesh_dcn_axis", str, "dcn",
         "Name of the cross-slice (DCN) mesh axis.")
 
 # --- observability --------------------------------------------------------
+_define("flight_recorder", bool, False,
+        "Arm the task-lifecycle flight recorder (core/flight_recorder.py): "
+        "per-stage monotonic stamps ride each task spec and the node folds "
+        "them into log-bucketed latency histograms (/metrics) plus a ring "
+        "of lifecycle records for `ray_tpu timeline`.  Disabled, every "
+        "hook is a single module-global is-None check (same contract as "
+        "fault_plan_path).  Env: RAY_TPU_FLIGHT_RECORDER.")
 _define("metrics_report_interval_ms", int, 2000, "Metrics export cadence.")
 _define("metrics_export_port", int, 0,
         "Port for the node's Prometheus /metrics endpoint; 0 disables "

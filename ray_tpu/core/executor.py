@@ -15,6 +15,7 @@ import contextlib
 import inspect
 import queue
 import threading
+import time
 import traceback
 from typing import Any, Optional
 
@@ -276,27 +277,44 @@ class Executor:
         from ray_tpu.core.runtime import task_context
         from ray_tpu.runtime_env import applied_env
         error = None
+        # flight recorder: data-driven — stamp only when the submitter
+        # started a lifecycle record (one dict.get when disabled), and
+        # ship the stamps back inside task_done for the node to fold in
+        fr = spec.get("fr")
+        if fr is not None:
+            fr.append(("worker_recv", time.monotonic()))
         try:
             fn = self._get_function(spec["function_id"])
             args, kwargs = self._load_args(spec)
+            if fr is not None:
+                fr.append(("exec_start", time.monotonic()))
             with task_context(TaskID(spec["task_id"])), \
                     applied_env(spec.get("runtime_env"), self.client), \
                     _task_span(f"task::{spec.get('name', '?')}.execute",
                                spec):
                 result = fn(*args, **kwargs)
+            if fr is not None:
+                fr.append(("exec_end", time.monotonic()))
             # one syscall for inline result puts + completion (hot path:
             # per-task overhead, SURVEY hard part 6)
             with self.client.batched_sends():
                 self._store_returns(spec, result)
-                self.client.send({"t": "task_done",
-                                  "task_id": spec["task_id"], "error": None})
+                done = {"t": "task_done", "task_id": spec["task_id"],
+                        "error": None}
+                if fr is not None:
+                    fr.append(("result_store", time.monotonic()))
+                    done["fr"] = fr
+                self.client.send(done)
             return
         except BaseException as e:  # noqa: BLE001 — report all task errors
             tb = traceback.format_exc()
             error = f"{type(e).__name__}: {e}"
             self._store_error(spec, e, tb)
-        self.client.send({"t": "task_done", "task_id": spec["task_id"],
-                          "error": error})
+        done = {"t": "task_done", "task_id": spec["task_id"],
+                "error": error}
+        if fr is not None:
+            done["fr"] = fr
+        self.client.send(done)
 
     def create_actor(self, spec: dict) -> None:
         error = None
@@ -367,24 +385,34 @@ class Executor:
     def _finish_actor_task(self, spec: dict, result: Any,
                            exc: Optional[BaseException],
                            tb: str = "") -> None:
+        fr = spec.get("fr")
         if exc is None:
             try:
                 with self.client.batched_sends():
                     self._store_returns(spec, result)
-                    self.client.send({"t": "task_done",
-                                      "task_id": spec["task_id"],
-                                      "error": None})
+                    done = {"t": "task_done", "task_id": spec["task_id"],
+                            "error": None}
+                    if fr is not None:
+                        fr.append(("result_store", time.monotonic()))
+                        done["fr"] = fr
+                    self.client.send(done)
                 return
             except BaseException as e:  # noqa: BLE001
                 exc, tb = e, traceback.format_exc()
         error = f"{type(exc).__name__}: {exc}"
         self._store_error(spec, exc, tb)
-        self.client.send({"t": "task_done", "task_id": spec["task_id"],
-                          "error": error})
+        done = {"t": "task_done", "task_id": spec["task_id"],
+                "error": error}
+        if fr is not None:
+            done["fr"] = fr
+        self.client.send(done)
 
     def execute_actor_task(self, spec: dict) -> None:
         from ray_tpu.core.runtime import task_context
         from ray_tpu.runtime_env import applied_env
+        fr = spec.get("fr")
+        if fr is not None:
+            fr.append(("worker_recv", time.monotonic()))
         try:
             instance = self._actors.get(spec["actor_id"])
             if instance is None:
@@ -392,6 +420,8 @@ class Executor:
             method = getattr(instance, spec["method"])
             args, kwargs = self._load_args(spec)
             limit = self._group_limit(spec)
+            if fr is not None:
+                fr.append(("exec_start", time.monotonic()))
             if inspect.iscoroutinefunction(method) or \
                     inspect.iscoroutinefunction(
                         getattr(method, "__func__", method)):
@@ -420,6 +450,8 @@ class Executor:
         except BaseException as e:  # noqa: BLE001
             self._finish_actor_task(spec, None, e, traceback.format_exc())
             return
+        if fr is not None:
+            fr.append(("exec_end", time.monotonic()))
         self._finish_actor_task(spec, result, None)
 
     def _run_async_actor_task(self, spec: dict, method, args, kwargs,
@@ -451,6 +483,12 @@ class Executor:
             task = st.loop.create_task(runner())
 
             def done(t):
+                fr = spec.get("fr")
+                if fr is not None:
+                    # async path returns before execute_actor_task's
+                    # sync-side exec_end stamp — stamp here instead so
+                    # coroutine runtime isn't folded into result_store
+                    fr.append(("exec_end", time.monotonic()))
                 exc = t.exception()
                 if exc is not None:
                     tb = "".join(traceback.format_exception(

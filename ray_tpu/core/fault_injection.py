@@ -36,8 +36,9 @@ In-process only by default: ``install()`` arms the plan for the current
 process (the normal shape — virtual clusters run head+nodes in the test
 process, so the control plane is fully covered).  For faults inside
 spawned node/worker processes, write the plan to disk
-(``FaultPlan.save``) and set ``RAY_TPU_FAULT_PLAN=<path>`` in their
-environment; ``autoinstall_from_env()`` runs at node/worker startup.
+(``FaultPlan.save``) and set ``RAY_TPU_FAULT_PLAN_PATH=<path>`` in
+their environment; ``autoinstall_from_env()`` runs at node/worker
+startup.
 """
 
 from __future__ import annotations
@@ -279,6 +280,16 @@ class FaultPlan:
             pickle.dump(self, f)
         return path
 
+    def _note(self, point: str, action: str, detail: Any) -> None:
+        """Audit-trail append (shape unchanged: 3-tuples) + a timestamped
+        copy into the flight recorder when one is armed, so injected
+        chaos shows up ATTRIBUTED in the merged `ray_tpu timeline`
+        instead of as mystery latency."""
+        self.log.append((point, action, detail))
+        from ray_tpu.core import flight_recorder as _fr
+        if _fr._active is not None:
+            _fr._active.note_fault(point, action, detail)
+
     def __getstate__(self):
         st = dict(self.__dict__)
         del st["_lock"]
@@ -302,8 +313,7 @@ class FaultPlan:
         with self._lock:
             for p in self.partitions:
                 if p.severs(label):
-                    self.log.append((point, "partition_drop",
-                                     msg.get("t")))
+                    self._note(point, "partition_drop", msg.get("t"))
                     return "drop"
             for r in self.rules:
                 if r.point != point:
@@ -316,7 +326,7 @@ class FaultPlan:
                     continue
                 if not r.decide(self, label, msg):
                     continue
-                self.log.append((point, r.action, msg.get("t")))
+                self._note(point, r.action, msg.get("t"))
                 if r.action == "drop":
                     return "drop"
                 if r.action == "dup":
@@ -335,11 +345,11 @@ class FaultPlan:
                     continue
                 if not r.decide(self, node, spec):
                     continue
-                self.log.append(("dispatch", r.action,
-                                 (worker_rec.pid,
-                                  spec.get("task_id", b"").hex()[:12]
-                                  if isinstance(spec.get("task_id"), bytes)
-                                  else "")))
+                self._note("dispatch", r.action,
+                           (worker_rec.pid,
+                            spec.get("task_id", b"").hex()[:12]
+                            if isinstance(spec.get("task_id"), bytes)
+                            else ""))
                 if r.action == "kill" and worker_rec.pid:
                     try:
                         os.kill(worker_rec.pid, r.sig)
@@ -357,7 +367,7 @@ class FaultPlan:
                     continue
                 if not r.decide(self, node, None):
                     continue
-                self.log.append(("spawn", r.action, r.delay))
+                self._note("spawn", r.action, r.delay)
                 if r.action == "fail":
                     return "fail"
                 if r.action == "delay":
@@ -381,7 +391,7 @@ class FaultPlan:
                     continue
                 if not r.decide(self, svc, msg):
                     continue
-                self.log.append(("service_msg", "script", msg.get("t")))
+                self._note("service_msg", "script", msg.get("t"))
                 fire.append(r)
                 drop = drop or getattr(r, "drop_message", False)
         for r in fire:   # outside the lock: fn may re-enter hooks
@@ -399,7 +409,7 @@ class FaultPlan:
                     continue
                 if not r.decide(self, svc, None):
                     continue
-                self.log.append(("service_tick", "script", svc.name))
+                self._note("service_tick", "script", svc.name)
                 fire.append(r)
         for r in fire:
             if r.fn is not None:

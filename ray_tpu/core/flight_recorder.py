@@ -1,0 +1,252 @@
+"""Task-lifecycle flight recorder: per-stage timestamps → histograms.
+
+The control plane can tell you *that* a task took 5 ms round-trip but
+not *where* the milliseconds went — client serialization? the head's
+routing hop?  queueing behind a saturated worker pool?  This module is
+the Dapper-style answer scoped to one framework: every task carries a
+list of ``(stage, t_monotonic)`` stamps through its whole journey
+
+    submit → encode → node_recv → [forward → head_route → node_recv]
+    → enqueue → dispatch → worker_recv → exec_start → exec_end
+    → result_store → done
+
+and the node that sees ``task_done`` folds the per-stage deltas into
+log-bucketed latency histograms (exported as real Prometheus
+``histogram`` metrics via ``ray_tpu.metrics``) plus a bounded ring of
+completed lifecycle records for the ``ray_tpu timeline`` Perfetto
+export.  The reference ships the same capability split across
+``ray.timeline()`` and the per-stage metrics agent
+(python/ray/_private/metrics_agent.py).
+
+Zero-overhead contract (same ``is None`` discipline as
+``core/fault_injection.py``): when no recorder is armed — the default,
+production state — every control-plane hook is a single module-global
+``is None`` check and nothing else executes on the hot path.  Worker-
+side hooks are *data-driven* instead: they stamp only when the spec
+already carries a record (one ``dict.get`` per execution), so pooled
+workers spawned before the recorder was armed still participate.
+
+Clocks: stamps are ``time.monotonic()``.  On Linux CLOCK_MONOTONIC is
+system-wide, so same-host stamps from different processes (driver,
+node, workers) are directly comparable — exactly the committed-artifact
+use case.  Each record also carries one wall-clock anchor (``fr_w0``)
+taken at the first stamp so timelines can be exported in epoch time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# The armed recorder.  Hot paths read this module attribute directly
+# (``_active is not None``) so the disabled path costs one global load.
+_active: Optional["FlightRecorder"] = None
+
+
+def active() -> Optional["FlightRecorder"]:
+    return _active
+
+
+def enable(**kw) -> "FlightRecorder":
+    """Arm a recorder in this process (idempotent) and mark the env so
+    processes spawned from here arm themselves too."""
+    global _active
+    if _active is None:
+        _active = FlightRecorder(**kw)
+    os.environ["RAY_TPU_FLIGHT_RECORDER"] = "1"
+    return _active
+
+
+def disable() -> None:
+    global _active
+    _active = None
+    os.environ.pop("RAY_TPU_FLIGHT_RECORDER", None)
+
+
+def autoenable_from_env() -> None:
+    """Arm at process startup when the ``flight_recorder`` config flag
+    (env: RAY_TPU_FLIGHT_RECORDER) says so — the worker/node leg of the
+    cross-process story (mirrors fault_injection.autoinstall_from_env)."""
+    if _active is not None:
+        return
+    raw = os.environ.get("RAY_TPU_FLIGHT_RECORDER", "")
+    if raw.lower() in ("1", "true", "yes", "on"):
+        enable()
+
+
+# Log-bucketed bounds: 1 µs doubling up to ~67 s.  Latency spans six
+# orders of magnitude between a lane hand-off and a cold container
+# spawn; exponential buckets keep resolution proportional everywhere.
+BUCKET_BOUNDS: tuple = tuple(1e-6 * (2.0 ** k) for k in range(27))
+
+
+class Histogram:
+    """One log-bucketed latency histogram (Prometheus ``histogram``
+    semantics: cumulative ``le`` buckets + sum + count)."""
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self):
+        self.counts = [0] * (len(BUCKET_BOUNDS) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect_left(BUCKET_BOUNDS, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def snapshot(self) -> dict:
+        """Cumulative exposition form for metrics.render_prometheus."""
+        cum = 0
+        buckets: List[tuple] = []
+        for bound, c in zip(BUCKET_BOUNDS, self.counts):
+            cum += c
+            buckets.append((bound, cum))
+        buckets.append((float("inf"), cum + self.counts[-1]))
+        return {"buckets": buckets, "sum": self.sum, "count": self.count}
+
+
+def _quantile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+class FlightRecorder:
+    """Per-process aggregation point: histograms + p50/p99 samples per
+    stage, a ring of completed lifecycle records, and chaos (fault-
+    injection) events for the merged timeline."""
+
+    def __init__(self, keep_records: int = 4096,
+                 keep_samples: int = 20_000,
+                 keep_faults: int = 4096):
+        self.anchor_wall = time.time()
+        self.anchor_mono = time.monotonic()
+        self._lock = threading.Lock()
+        self.hist: Dict[str, Histogram] = {}
+        self.samples: Dict[str, deque] = {}
+        self.records: deque = deque(maxlen=keep_records)
+        self.faults: deque = deque(maxlen=keep_faults)
+        self._keep_samples = keep_samples
+
+    # ------------------------------------------------------------ stamping
+    #
+    # start() runs on the submitting client; stamp() everywhere else.
+    # Both are called ONLY behind the module-global gate (or, worker
+    # side, only when the spec already carries a record), so they can
+    # afford the list append + monotonic call.
+
+    def start(self, spec: dict, stage: str = "submit") -> None:
+        spec["fr"] = [(stage, time.monotonic())]
+        spec["fr_w0"] = time.time()
+
+    @staticmethod
+    def stamp(spec: dict, stage: str) -> None:
+        fr = spec.get("fr")
+        if fr is not None:
+            fr.append((stage, time.monotonic()))
+
+    def start_or_stamp(self, spec: dict, stage: str) -> None:
+        """Continue the submitter's record, or open one at this stage
+        when the submitter had no recorder armed (remote drivers)."""
+        if spec.get("fr") is None:
+            self.start(spec, stage)
+        else:
+            spec["fr"].append((stage, time.monotonic()))
+
+    # --------------------------------------------------------- aggregation
+
+    def observe(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            h = self.hist.get(stage)
+            if h is None:
+                h = self.hist[stage] = Histogram()
+                self.samples[stage] = deque(maxlen=self._keep_samples)
+            h.observe(seconds)
+            self.samples[stage].append(seconds)
+
+    def finish(self, spec: dict, worker: Any = None) -> None:
+        """Fold one completed lifecycle into the aggregates.  Interval
+        names follow the LATER stamp: ``dispatch`` = time from enqueue
+        (or whatever preceded) until the dispatch stamp."""
+        fr = spec.get("fr")
+        if not fr or len(fr) < 2:
+            return
+        w0 = spec.get("fr_w0") or self.anchor_wall
+        record = {
+            "task_id": spec["task_id"].hex()
+            if isinstance(spec.get("task_id"), bytes)
+            else str(spec.get("task_id")),
+            "name": spec.get("name", ""),
+            "worker": worker,
+            "start_ts": w0,
+            # wall-clock stage stamps: first stamp anchors at w0
+            "stages": [(s, w0 + (t - fr[0][1])) for s, t in fr],
+        }
+        with self._lock:
+            self.records.append(record)
+        prev_t = fr[0][1]
+        for stage, t in fr[1:]:
+            self.observe(stage, max(0.0, t - prev_t))
+            prev_t = t
+        self.observe("total", max(0.0, fr[-1][1] - fr[0][1]))
+
+    def note_fault(self, point: str, action: str, detail: Any) -> None:
+        """Chaos-plane event (core/fault_injection.py) for the merged
+        timeline — injected faults show up attributed, not as mystery
+        latency."""
+        with self._lock:
+            self.faults.append({"t": time.time(), "point": point,
+                                "action": action, "detail": repr(detail)})
+
+    def reset(self) -> None:
+        """Drop aggregates (between benchmark phases)."""
+        with self._lock:
+            self.hist.clear()
+            self.samples.clear()
+            self.records.clear()
+            self.faults.clear()
+
+    # ------------------------------------------------------------- reading
+
+    def stage_summary(self) -> dict:
+        """{stage: {n, p50_us, p99_us, mean_us}} from the bounded raw
+        samples — the committed-artifact table."""
+        with self._lock:
+            snap = {k: list(v) for k, v in self.samples.items()}
+        out = {}
+        for stage, vals in sorted(snap.items()):
+            vals.sort()   # outside the lock: hot-path observes proceed
+            if not vals:
+                continue
+            out[stage] = {
+                "n": len(vals),
+                "p50_us": round(_quantile(vals, 0.50) * 1e6, 1),
+                "p99_us": round(_quantile(vals, 0.99) * 1e6, 1),
+                "mean_us": round(sum(vals) / len(vals) * 1e6, 1),
+            }
+        return out
+
+    def export_records(self, limit: int = 2000) -> list:
+        with self._lock:
+            recs = list(self.records)
+        return recs[-limit:]
+
+    def export_faults(self) -> list:
+        with self._lock:   # note_fault appends from other threads
+            return list(self.faults)
+
+    def metrics_snapshot(self) -> Dict[tuple, dict]:
+        """{((label_key, label_val),): histogram_snapshot} for the
+        Prometheus exporter (metrics.render_prometheus histogram kind).
+        Snapshots are taken under the lock so a mid-scrape observe()
+        can't make the exported _count disagree with the +Inf bucket."""
+        with self._lock:
+            return {(("stage", stage),): h.snapshot()
+                    for stage, h in self.hist.items()}

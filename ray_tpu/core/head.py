@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ray_tpu._config import RayTpuConfig
+from ray_tpu.core import flight_recorder as _fr
 from ray_tpu.core.resources import (bundle_total as _bundle_total,
                                     covers as _covers)
 from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
@@ -91,6 +92,10 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         self.config = config
         self.session = session
         self.tick_interval = 0.1
+        # flight recorder: a standalone head process must arm itself or
+        # the head_route stamp never fires in multi-machine deployments
+        if config.flight_recorder and _fr._active is None:
+            _fr.enable()
 
         self.nodes: dict[str, NodeRec] = {}
         self._node_by_conn: dict[int, str] = {}
@@ -649,6 +654,10 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             return
         spec = dict(spec)
         spec["_routed"] = True
+        if _fr._active is not None:
+            # flight recorder: attribute the routing decision itself
+            # (same-host monotonic stamps are directly comparable)
+            _fr._active.stamp(spec, "head_route")
         self._push(c, {"t": "remote_submit", "spec": spec})
         self._reply(rec, m["reqid"], node=target)
 

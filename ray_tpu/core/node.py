@@ -49,6 +49,7 @@ from typing import Any, Optional
 
 from ray_tpu._config import RayTpuConfig
 from ray_tpu.core import fault_injection as _fi
+from ray_tpu.core import flight_recorder as _fr
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
 from ray_tpu.core.resources import bundle_total, covers
@@ -382,6 +383,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._hb_period = config.heartbeat_period_ms / 1000.0
         # ticks must run at least as often as heartbeats are due
         self.tick_interval = min(self.tick_interval, self._hb_period)
+
+        # flight recorder (core/flight_recorder.py): armed per process
+        # by config/env; workers stamp data-driven off the spec instead
+        if config.flight_recorder and _fr._active is None:
+            _fr.enable()
 
         self.metrics_exporter = None
         if config.metrics_export_port:
@@ -1327,6 +1333,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _admit_task(self, spec: dict) -> None:
         tr = TaskRec(spec=spec, retries_left=spec.get("max_retries", 0))
         self.tasks[spec["task_id"]] = tr
+        if _fr._active is not None:
+            _fr._active.start_or_stamp(spec, "node_recv")
         if self.head_conn is not None and not spec.get("owner_node"):
             # first admission on the submitter's node: WE own the returns
             spec["owner_node"] = (self.node_id.hex(), self.address)
@@ -1485,6 +1493,10 @@ class NodeService(ClusterStoreMixin, EventLoopService):
 
     def _forward_task(self, spec: dict) -> None:
         tid = spec["task_id"]
+        if _fr._active is not None:
+            # the interval ending at the DESTINATION's node_recv stamp
+            # is then the head-route + wire hop
+            _fr._active.stamp(spec, "forward")
 
         def cb(reply):
             if reply.get("error"):
@@ -1515,6 +1527,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._admit_task(spec)
 
     def _make_runnable(self, spec: dict) -> None:
+        if _fr._active is not None:
+            _fr._active.stamp(spec, "enqueue")
         if spec.get("num_tpus"):
             self.runnable_tpu.append(spec)
         elif self._is_zero_demand(spec):
@@ -1557,6 +1571,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             tr.error = m.get("error", "")
             self._note_task_finished(tid)
             self._release_arg_blob(tr.spec)
+            if _fr._active is not None:
+                self._fr_finish(tr, m)
             self._record_event(tr.spec, "FAILED" if m.get("error") else "FINISHED")
         if rec.dedicated_actor is not None:
             ar = self.actors.get(rec.dedicated_actor)
@@ -1776,6 +1792,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         for b in spec.get("arg_ids", []):
             self.store.pin(ObjectID(b))
         self._record_event(spec, "RUNNING", worker=w.conn_id)
+        if _fr._active is not None:
+            _fr._active.stamp(spec, "dispatch")
         self._push(w, {"t": "execute", "spec": spec})
         if _fi._active is not None:
             # chaos plane: "kill the worker that got the K-th dispatch"
@@ -2185,6 +2203,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             if onode and not info.owner_node:
                 info.owner_node = onode
         self.tasks[spec["task_id"]] = TaskRec(spec=spec)
+        if _fr._active is not None:
+            _fr._active.start_or_stamp(spec, "node_recv")
         self._record_event(spec, "PENDING")
         if ar is not None:
             if ar.state == "dead":
@@ -2325,6 +2345,8 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 tr.started_at = time.time()
                 tr.worker = w.conn_id
             self._record_event(spec, "RUNNING", worker=w.conn_id)
+            if _fr._active is not None:
+                _fr._active.stamp(spec, "dispatch")
             self._push(w, {"t": "execute_actor", "spec": spec})
 
     def _wait_args_then(self, spec, cb) -> None:
@@ -3018,6 +3040,12 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             return False
         lin["recons"] += 1
         spec = dict(lin["spec"])
+        # fresh flight-recorder record: the captured wire spec shares
+        # the original attempt's stamp list, and stamping into it would
+        # misattribute the whole loss-detection gap to node_recv
+        spec.pop("fr", None)
+        spec.pop("fr_w0", None)
+        spec.pop("fr_done", None)
         sys.stderr.write(f"[node] reconstructing task "
                          f"{tid.hex()[:12]} (attempt {lin['recons']})\n")
         self._admit_task(spec)
@@ -3566,12 +3594,52 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 # object_recovery_manager.h reconstruction)
                 spec = dict(spec)
                 spec["max_retries"] = fw["retries"] - 1
+                if _fr._active is not None:
+                    _fr._active.stamp(spec, "retry")
                 self._forward_task(spec)
             else:
                 self._fail_task(spec, f"Node {node_hex[:8]} died while "
                                       "running forwarded task")
 
     # -- state API
+
+    def _fr_finish(self, tr: TaskRec, m: dict) -> None:
+        """Fold a completed task's lifecycle stamps into the flight
+        recorder.  The worker ships its stamps back inside task_done
+        (socket workers executed a COPY of the spec); lane executors
+        appended to the shared list, in which case both sides are the
+        same object and the merge is a no-op."""
+        spec = tr.spec
+        if spec.get("fr_done"):
+            # already folded: a duplicated task_done (chaos dup) must
+            # not re-install the message's stamps and count twice
+            return
+        wfr = m.get("fr")
+        nfr = spec.get("fr")
+        if wfr is not None and wfr is not nfr \
+                and (nfr is None or len(wfr) >= len(nfr)):
+            spec["fr"] = wfr
+        if spec.get("fr") is not None:
+            rec = _fr._active
+            if rec is not None:
+                rec.stamp(spec, "done")
+                rec.finish(spec, worker=tr.worker)
+            spec["fr"] = None
+            spec["fr_done"] = True
+
+    def _h_flight_recorder(self, rec, m):
+        """Observer query: completed lifecycle records + chaos events +
+        the per-stage summary (the `ray_tpu timeline` source)."""
+        fr = _fr.active()
+        if fr is None:
+            self._reply(rec, m["reqid"], enabled=False, records=[],
+                        faults=[], stages={})
+            return
+        self._reply(rec, m["reqid"], enabled=True,
+                    records=fr.export_records(
+                        limit=int(m.get("limit", 2000))),
+                    faults=fr.export_faults(),
+                    stages=fr.stage_summary())
 
     def _record_event(self, spec: dict, state: str,
                       worker: Optional[int] = None) -> None:
@@ -3832,6 +3900,11 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 if tr.retries_left > 0:
                     tr.retries_left -= 1
                     tr.state = "pending"
+                    if _fr._active is not None:
+                        # name the failed attempt + death-detection gap
+                        # explicitly so it doesn't pollute the retry's
+                        # enqueue interval in the stage histograms
+                        _fr._active.stamp(tr.spec, "retry")
                     self._make_runnable(tr.spec)
                 elif oom_detail is not None:
                     from ray_tpu.core.client import OutOfMemoryError

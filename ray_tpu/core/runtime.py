@@ -24,6 +24,7 @@ from typing import Any, Optional, Sequence
 import cloudpickle
 
 from ray_tpu._config import RayTpuConfig, set_config
+from ray_tpu.core import flight_recorder as _fr
 from ray_tpu.core.client import NodeClient, TaskError  # noqa: F401
 from ray_tpu.core.executor import Executor, _ArgSlot
 from ray_tpu.core.ids import (ActorID, JobID, ObjectID, TaskID, _Counter)
@@ -220,18 +221,30 @@ class Runtime:
         spec = dict(template)
         spec["task_id"] = task_id.binary()
         spec["return_ids"] = [o.binary() for o in returns]
+        if _fr._active is not None:
+            # flight recorder: open the lifecycle record; "encode" below
+            # isolates client-side arg serialization from the wire hop
+            _fr._active.start(spec)
         from ray_tpu.util.tracing import tracing_enabled
         if tracing_enabled():
-            from ray_tpu.util.tracing import inject_context, start_span
-            tctx = inject_context()
-            if tctx is not None:
-                spec["trace_ctx"] = tctx
-            self._prepare_args(args, kwargs, spec)
+            from ray_tpu.util.tracing import start_span
+            # the submit span is the PARENT of the worker's execute span
+            # (reference: tracing_helper injects the client span's
+            # context), so its context — not the ambient one — goes
+            # into the spec
             with start_span(f"task::{spec['name']}.remote", kind="client",
-                            attributes={"task_id": task_id.hex()}):
+                            attributes={"task_id": task_id.hex()}) as sp:
+                if sp:
+                    spec["trace_ctx"] = {"trace_id": sp["trace_id"],
+                                         "span_id": sp["span_id"]}
+                self._prepare_args(args, kwargs, spec)
+                if _fr._active is not None:
+                    _fr._active.stamp(spec, "encode")
                 self.client.send_soon({"t": "submit_task", "spec": spec})
         else:
             self._prepare_args(args, kwargs, spec)
+            if _fr._active is not None:
+                _fr._active.stamp(spec, "encode")
             self.client.send_soon({"t": "submit_task", "spec": spec})
         owner = self.client.worker_id
         refs = [ObjectRef(o, owner=owner) for o in returns]
@@ -316,7 +329,11 @@ class Runtime:
         tctx = inject_context()
         if tctx is not None:
             spec["trace_ctx"] = tctx
+        if _fr._active is not None:
+            _fr._active.start(spec)
         self._prepare_args(args, kwargs, spec)
+        if _fr._active is not None:
+            _fr._active.stamp(spec, "encode")
         self.client.send_soon({"t": "submit_actor_task", "spec": spec})
         refs = [ObjectRef(o, owner=self.client.worker_id) for o in return_ids]
         if num_returns == "dynamic" or num_returns == 1:
@@ -341,7 +358,20 @@ class Runtime:
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> list[Any]:
-        return self.client.get_objects([r.id for r in refs], timeout=timeout)
+        if _fr._active is None:
+            return self.client.get_objects([r.id for r in refs],
+                                           timeout=timeout)
+        # flight recorder: the caller-visible tail of the lifecycle
+        # (result_store → get return) lands in its own histogram.
+        # Success only — a timeout would fold the caller's timeout
+        # SETTING into the latency histogram as if it were a roundtrip
+        t0 = time.monotonic()
+        out = self.client.get_objects([r.id for r in refs],
+                                      timeout=timeout)
+        rec = _fr._active
+        if rec is not None:
+            rec.observe("get_roundtrip", time.monotonic() - t0)
+        return out
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None):

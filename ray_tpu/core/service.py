@@ -104,6 +104,11 @@ class EventLoopService:
         self._posted_lock = threading.Lock()
         self._last_tick = 0.0
         self.tick_interval = 0.25
+        # observability: how late the last periodic tick ran vs its
+        # schedule — a saturated loop (GIL-starved, handler stuck in a
+        # long copy) shows up here before anything else degrades.
+        # Exported as ray_tpu_event_loop_lag_seconds (metrics.py).
+        self.loop_lag_s = 0.0
         # Opt-in adaptive busy-poll: for a short window after each event
         # the loop polls (select timeout=0) instead of blocking — on
         # hosts with spare cores and slow idle wakeups this skips a
@@ -210,6 +215,9 @@ class EventLoopService:
             now = time.monotonic()
             self._run_due_timers(now)
             if now - self._last_tick > self.tick_interval:
+                if self._last_tick:
+                    self.loop_lag_s = max(
+                        0.0, (now - self._last_tick) - self.tick_interval)
                 self._last_tick = now
                 try:
                     if _fi._active is not None:

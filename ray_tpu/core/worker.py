@@ -77,13 +77,14 @@ def run_worker(address: str) -> None:
     except (AttributeError, ValueError):
         pass   # non-POSIX or non-main-thread: dumps unavailable
 
-    from ray_tpu.core import fault_injection
+    from ray_tpu.core import fault_injection, flight_recorder
     from ray_tpu.core.client import NodeClient
     from ray_tpu.core.executor import (Executor, make_message_queue,
                                        queue_push_handler)
     from ray_tpu.core import runtime as rt
 
     fault_injection.autoinstall_from_env()   # chaos plane in workers
+    flight_recorder.autoenable_from_env()    # lifecycle stamps in workers
 
     inbox = make_message_queue()
     cell: dict = {}

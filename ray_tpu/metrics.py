@@ -21,14 +21,32 @@ def _escape_label(v) -> str:
             .replace("\n", "\\n"))
 
 
+def _fmt_le(bound: float) -> str:
+    return "+Inf" if bound == float("inf") else repr(bound)
+
+
 def render_prometheus(metrics: list[tuple]) -> str:
     """metrics: [(name, kind, help, value_or_labeled_values)] where the
-    last element is a float OR a dict {labels_dict_as_tuple: float}."""
+    last element is a float OR a dict {labels_dict_as_tuple: float}.
+    kind "histogram" takes {labels_tuple: {"buckets": [(le, cum), ...],
+    "sum": s, "count": n}} (cumulative buckets ending at +Inf — the
+    shape FlightRecorder.Histogram.snapshot produces) and renders the
+    full ``_bucket``/``_sum``/``_count`` exposition."""
     lines: list[str] = []
     for name, kind, help_text, value in metrics:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {kind}")
-        if isinstance(value, dict):
+        if kind == "histogram":
+            for labels, h in sorted(value.items()):
+                base = ",".join(f'{k}="{_escape_label(val)}"'
+                                for k, val in labels)
+                sep = "," if base else ""
+                for bound, cum in h["buckets"]:
+                    lines.append(f'{name}_bucket{{{base}{sep}'
+                                 f'le="{_fmt_le(bound)}"}} {int(cum)}')
+                lines.append(f"{name}_sum{{{base}}} {float(h['sum'])}")
+                lines.append(f"{name}_count{{{base}}} {int(h['count'])}")
+        elif isinstance(value, dict):
             for labels, v in sorted(value.items()):
                 lab = ",".join(f'{k}="{_escape_label(val)}"'
                                for k, val in labels)
@@ -67,7 +85,17 @@ def _snapshot_once(svc) -> list[tuple]:
     store = svc.store.stats()
     workers = sum(1 for c in list(svc.clients.values())
                   if c.kind in ("worker", "tpu_executor"))
-    return [
+    # per-queue depths + event-loop lag: the tick-loop health gauges
+    # ("is the scheduler keeping up") that a task-count gauge can't show
+    queue_depth = {
+        (("queue", "runnable_cpu"),): float(len(svc.runnable_cpu)),
+        (("queue", "runnable_tpu"),): float(len(svc.runnable_tpu)),
+        (("queue", "runnable_zero"),): float(len(svc.runnable_zero)),
+        (("queue", "dep_waiting"),): float(sum(
+            len(v) for v in list(svc.dep_waiting.values()))),
+        (("queue", "posted"),): float(len(svc._posted)),
+    }
+    out = [
         ("ray_tpu_tasks", "gauge", "Tasks by state on this node",
          tasks_by_state or {(("state", "none"),): 0}),
         ("ray_tpu_actors", "gauge", "Actors by state on this node",
@@ -89,7 +117,20 @@ def _snapshot_once(svc) -> list[tuple]:
         ("ray_tpu_runnable_tasks", "gauge", "Queued runnable tasks",
          float(len(svc.runnable_cpu) + len(svc.runnable_tpu)
                + len(svc.runnable_zero))),
+        ("ray_tpu_queue_depth", "gauge",
+         "Control-plane queue depths on this node", queue_depth),
+        ("ray_tpu_event_loop_lag_seconds", "gauge",
+         "How late the node event loop's last periodic tick ran",
+         float(getattr(svc, "loop_lag_s", 0.0))),
     ]
+    from ray_tpu.core import flight_recorder as _fr
+    rec = _fr.active()
+    if rec is not None:
+        out.append((
+            "ray_tpu_task_stage_duration_seconds", "histogram",
+            "Per-stage task lifecycle latency (flight recorder; stage = "
+            "interval ending at that stamp)", rec.metrics_snapshot()))
+    return out
 
 
 class MetricsExporter:

@@ -20,7 +20,7 @@ import numpy as np
 
 # bumped every growth round so committed evidence files (PERF_rNN.json)
 # are self-identifying; scale_envelope.py shares this stamp
-ROUND = 7
+ROUND = 8
 
 
 def timeit(name: str, fn, multiplier: int = 1, unit: str = "ops/s",
@@ -191,6 +191,31 @@ def _run(quick: bool) -> list[dict]:
            "spread": round((rates[-1] - rates[0]) / med, 3)}
     print(json.dumps(out), flush=True)
     results.append(out)
+
+    # per-stage latency breakdown (flight recorder): a SEPARATE pass so
+    # the headline rows above keep measuring the uninstrumented path.
+    # Stage names are intervals ending at that stamp — "where do the
+    # milliseconds go" as a committed artifact, not a guess.
+    from ray_tpu.core import flight_recorder as _fr
+    rec = _fr.enable()
+    n_sync = 100 if quick else 400
+    for _ in range(n_sync):
+        ray_tpu.get(noop.remote(), timeout=60)
+    time.sleep(0.3)   # let trailing task_done folds land
+    row = {"name": "stages_tasks_sync", "value": n_sync, "unit": "tasks",
+           "stages": rec.stage_summary()}
+    print(json.dumps(row), flush=True)
+    results.append(row)
+    _settle()
+    rec.reset()
+    n_drain = 300 if quick else 2000
+    ray_tpu.get([noop.remote() for _ in range(n_drain)], timeout=600)
+    time.sleep(0.3)
+    row = {"name": "stages_drain", "value": n_drain, "unit": "tasks",
+           "stages": rec.stage_summary()}
+    print(json.dumps(row), flush=True)
+    results.append(row)
+    _fr.disable()
 
     import os as _os
     try:

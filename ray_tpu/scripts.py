@@ -139,18 +139,38 @@ def cmd_summary(args) -> int:
 
 
 def cmd_timeline(args) -> int:
+    """Merged Perfetto export: task events + flight-recorder lifecycle
+    stages + tracing spans + chaos events in one trace (reference:
+    ray.timeline Chrome-trace export)."""
     conn, request = _observer(args.address)
     try:
         events = request({"t": "state", "what": "task_events"})["data"]
+        # export the recorder's WHOLE ring, not the server default
+        fr = request({"t": "flight_recorder", "limit": 1_000_000})
     finally:
         conn.close()
-    from ray_tpu.util.state import events_to_trace
-    trace = events_to_trace(events)
+    spans = []
+    trace_dir = getattr(args, "trace_dir", None) \
+        or os.environ.get("RAY_TPU_TRACE_DIR")
+    if trace_dir:
+        from ray_tpu.util.tracing import collect_spans
+        spans = collect_spans(trace_dir)
+    from ray_tpu.util.timeline import build_trace
+    trace = build_trace(task_events=events,
+                        records=fr.get("records", []),
+                        spans=spans,
+                        faults=fr.get("faults", []))
     out = args.output or f"timeline-{int(time.time())}.json"
     with open(out, "w") as f:
         json.dump(trace, f)
-    print(f"wrote {len(trace)} events to {out} "
-          "(open in chrome://tracing or perfetto)")
+    n = len(trace["traceEvents"])
+    lifecycle = sum(1 for e in trace["traceEvents"]
+                    if e.get("cat") == "lifecycle")
+    print(f"wrote {n} events ({lifecycle} lifecycle stage slices) to "
+          f"{out} (open in chrome://tracing or ui.perfetto.dev)"
+          + ("" if fr.get("enabled") else
+             "; flight recorder disabled — set "
+             "RAY_TPU_FLIGHT_RECORDER=1 for per-stage slices"))
     return 0
 
 
@@ -444,9 +464,13 @@ def main(argv=None) -> int:
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_summary)
 
-    p = sub.add_parser("timeline")
+    p = sub.add_parser("timeline",
+                       help="merged Perfetto trace: task events + "
+                            "flight-recorder stages + spans + chaos")
     p.add_argument("--address", required=True)
     p.add_argument("-o", "--output", default=None)
+    p.add_argument("--trace-dir", default=None,
+                   help="RAY_TPU_TRACE_DIR to merge span files from")
     p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("stack", help="dump live worker thread stacks "

@@ -9,13 +9,23 @@ one trace id.
 Dependency-light redesign (no opentelemetry wheel in this image): spans
 are plain dicts with W3C-style ids (128-bit trace id, 64-bit span id);
 context propagates in-process via a contextvar and cross-process inside
-the task spec (``trace_ctx``). Finished spans land in an in-process
+the task spec (``trace_ctx``).  Finished spans land in an in-process
 buffer and, when ``RAY_TPU_TRACE_DIR`` is set, one JSONL file per
 process — ``collect_spans()`` merges them for analysis/tests.
+
+Emission is batched: ``_emit`` appends to a pending list under the
+span-buffer lock and the actual ``write+flush`` runs under a separate
+I/O lock, draining everything pending in one write.  Threads that find
+the I/O lock busy just leave their span pending for the current writer
+— the hot path never blocks on disk (the previous design held the one
+global lock across ``write``+``flush`` per span, serializing every
+tracer behind the disk).  ``flush_spans()`` (also run at exit and by
+``collect_spans``) force-drains.
 """
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import contextvars
 import glob
@@ -29,10 +39,13 @@ from typing import Any, Dict, Iterator, List, Optional
 _current: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
     "ray_tpu_trace_ctx", default=None)
 
-_lock = threading.Lock()
+_lock = threading.Lock()          # span buffer + pending list
+_io_lock = threading.Lock()       # file open/write/flush
 _finished: List[dict] = []
+_pending: List[dict] = []         # spans awaiting a file write
 _MAX_BUFFER = 10_000
 _file = None
+_file_dir: Optional[str] = None   # dir _file was opened in (reset on change)
 _enabled: Optional[bool] = None
 
 
@@ -48,37 +61,82 @@ def tracing_enabled() -> bool:
 
 def enable_tracing(trace_dir: Optional[str] = None) -> None:
     global _enabled
+    flush_spans()   # leftover pending spans belong to the PREVIOUS dir
     _enabled = True
     os.environ["RAY_TPU_TRACING"] = "1"
     if trace_dir:
+        # the drain notices the dir change and re-points the cached file
         os.environ["RAY_TPU_TRACE_DIR"] = trace_dir
 
 
 def disable_tracing() -> None:
-    global _enabled, _file
+    global _enabled, _file, _file_dir
+    flush_spans()
     _enabled = False
     os.environ.pop("RAY_TPU_TRACING", None)
     os.environ.pop("RAY_TPU_TRACE_DIR", None)
-    with _lock:
+    with _io_lock:
         if _file is not None:
             _file.close()
             _file = None
+            _file_dir = None
 
 
 def _emit(span: dict) -> None:
-    global _file
     with _lock:
         _finished.append(span)
         if len(_finished) > _MAX_BUFFER:
             del _finished[:len(_finished) - _MAX_BUFFER]
-        d = os.environ.get("RAY_TPU_TRACE_DIR")
-        if d:
-            if _file is None:
-                os.makedirs(d, exist_ok=True)
-                _file = open(os.path.join(
-                    d, f"spans-{os.getpid()}.jsonl"), "a")
-            _file.write(json.dumps(span) + "\n")
-            _file.flush()
+        if not os.environ.get("RAY_TPU_TRACE_DIR"):
+            return
+        _pending.append(span)
+    # opportunistic drain: whoever gets the I/O lock writes the whole
+    # batch; a contended emitter's span is picked up by a retry here —
+    # the in-flight writer popped its batch BEFORE this append landed,
+    # so someone must come back for it or it sits undurable
+    while True:
+        if not _io_lock.acquire(blocking=False):
+            return   # the current writer re-checks after its drain
+        try:
+            _drain_locked()
+        finally:
+            _io_lock.release()
+        with _lock:
+            if not _pending:
+                return
+
+
+def flush_spans() -> None:
+    """Force-drain pending spans to the trace file (blocking)."""
+    with _io_lock:
+        _drain_locked()
+
+
+atexit.register(flush_spans)
+
+
+def _drain_locked() -> None:
+    """Write+flush everything pending.  Caller holds _io_lock."""
+    global _file, _file_dir
+    with _lock:
+        if not _pending:
+            return
+        batch, _pending[:] = list(_pending), []
+    d = os.environ.get("RAY_TPU_TRACE_DIR")
+    if not d:
+        return
+    if _file is None or _file_dir != d:
+        # dir changed between disable/enable cycles: re-point the file
+        if _file is not None:
+            try:
+                _file.close()
+            except OSError:
+                pass
+        os.makedirs(d, exist_ok=True)
+        _file = open(os.path.join(d, f"spans-{os.getpid()}.jsonl"), "a")
+        _file_dir = d
+    _file.write("".join(json.dumps(s) + "\n" for s in batch))
+    _file.flush()
 
 
 @contextlib.contextmanager
@@ -134,10 +192,14 @@ def get_finished_spans(name: Optional[str] = None) -> List[dict]:
 def clear() -> None:
     with _lock:
         _finished.clear()
+        _pending.clear()
 
 
 def collect_spans(trace_dir: Optional[str] = None) -> List[dict]:
-    """Merge every process's span file (worker spans included)."""
+    """Merge every process's span file (worker spans included).  A
+    truncated trailing line (a writer crashed or was killed mid-write)
+    is skipped instead of poisoning the whole collection."""
+    flush_spans()   # this process's pending spans must be readable too
     d = trace_dir or os.environ.get("RAY_TPU_TRACE_DIR")
     if not d:
         return get_finished_spans()
@@ -145,6 +207,11 @@ def collect_spans(trace_dir: Optional[str] = None) -> List[dict]:
     for p in sorted(glob.glob(os.path.join(d, "spans-*.jsonl"))):
         with open(p) as f:
             for line in f:
-                if line.strip():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
                     out.append(json.loads(line))
+                except ValueError:
+                    continue   # truncated/garbled line: skip, keep rest
     return out

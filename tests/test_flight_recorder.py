@@ -1,0 +1,254 @@
+"""Flight-recorder tests: lifecycle stage stamping across the worker
+boundary, Prometheus histogram exposition well-formedness, the
+disabled-path zero-overhead gate, and the merged Perfetto timeline
+(lifecycle + spans + chaos events)."""
+
+import dis
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import flight_recorder as fr
+
+
+@pytest.fixture
+def recorder():
+    rec = fr.enable()
+    rec.reset()
+    yield rec
+    fr.disable()
+
+
+def _wait_records(rec, n, timeout=20.0):
+    deadline = time.time() + timeout
+    while len(rec.records) < n and time.time() < deadline:
+        time.sleep(0.05)
+    return rec.export_records()
+
+
+# -- lifecycle stamping -----------------------------------------------------
+
+def test_task_lifecycle_stages_recorded(recorder, rt_init):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get([f.remote(i) for i in range(4)],
+                       timeout=120) == [1, 2, 3, 4]
+    records = _wait_records(recorder, 4)
+    assert len(records) >= 4
+    rec = records[-1]
+    stages = [s for s, _ in rec["stages"]]
+    # the whole journey, client → node → worker → node, in order
+    for want in ("submit", "encode", "node_recv", "enqueue", "dispatch",
+                 "worker_recv", "exec_start", "exec_end", "result_store",
+                 "done"):
+        assert want in stages, (want, stages)
+    assert stages.index("submit") < stages.index("dispatch") \
+        < stages.index("exec_start") < stages.index("done")
+    # wall-clock stamps are monotone non-decreasing
+    ts = [t for _, t in rec["stages"]]
+    assert ts == sorted(ts)
+
+    summ = recorder.stage_summary()
+    for want in ("dispatch", "exec_end", "total", "get_roundtrip"):
+        assert want in summ
+        assert summ[want]["n"] >= 1
+        assert summ[want]["p99_us"] >= summ[want]["p50_us"] >= 0
+
+
+def test_actor_lifecycle_stages_recorded(recorder, rt_init):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "pong"
+    records = _wait_records(recorder, 1)
+    actor_recs = [r for r in records if r["name"].endswith("ping")]
+    assert actor_recs
+    stages = [s for s, _ in actor_recs[-1]["stages"]]
+    for want in ("submit", "node_recv", "dispatch", "worker_recv",
+                 "exec_start", "exec_end", "result_store", "done"):
+        assert want in stages, (want, stages)
+
+
+# -- /metrics histogram exposition ------------------------------------------
+
+def test_metrics_histogram_exposition_well_formed(recorder, rt_init):
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.metrics import MetricsExporter, node_metrics_snapshot
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(3)], timeout=120)
+    _wait_records(recorder, 3)
+
+    svc = get_runtime().node_service
+    exporter = MetricsExporter(lambda: node_metrics_snapshot(svc), port=0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/metrics",
+            timeout=10).read().decode()
+    finally:
+        exporter.stop()
+
+    name = "ray_tpu_task_stage_duration_seconds"
+    assert f"# TYPE {name} histogram" in body
+    # per-stage series: cumulative le buckets ending at +Inf, plus
+    # matching _sum and _count
+    lines = body.splitlines()
+    stages = set()
+    for ln in lines:
+        if ln.startswith(f"{name}_bucket{{stage="):
+            stages.add(ln.split('stage="', 1)[1].split('"', 1)[0])
+    assert "dispatch" in stages and "total" in stages
+    for stage in stages:
+        prefix = f'{name}_bucket{{stage="{stage}",le="'
+        series = [(ln.split('le="', 1)[1].split('"', 1)[0],
+                   int(ln.rsplit(" ", 1)[1]))
+                  for ln in lines if ln.startswith(prefix)]
+        assert series, stage
+        assert series[-1][0] == "+Inf"
+        counts = [c for _, c in series]
+        assert counts == sorted(counts)          # cumulative
+        les = [float(le) for le, _ in series[:-1]]
+        assert les == sorted(les)                # ascending bounds
+        count_line = next(ln for ln in lines if ln.startswith(
+            f'{name}_count{{stage="{stage}"}}'))
+        assert int(count_line.rsplit(" ", 1)[1]) == counts[-1]
+        assert any(ln.startswith(f'{name}_sum{{stage="{stage}"}}')
+                   for ln in lines)
+    # tick-loop health gauges ride along
+    assert "# TYPE ray_tpu_queue_depth gauge" in body
+    assert 'ray_tpu_queue_depth{queue="runnable_cpu"}' in body
+    assert "# TYPE ray_tpu_event_loop_lag_seconds gauge" in body
+
+
+# -- zero-overhead disabled path --------------------------------------------
+
+def test_disabled_path_leaves_specs_clean(rt_init):
+    fr.disable()
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=120) == 1
+    from ray_tpu.core.runtime import get_runtime
+    svc = get_runtime().node_service
+    assert all(tr.spec.get("fr") is None for tr in svc.tasks.values())
+
+
+def test_dispatch_gate_is_single_is_none_check():
+    """The disabled-path contract on the dispatch hot path: the ONLY
+    flight-recorder touch is loading the module global and checking
+    ``_active is None`` — no further attribute lookups or calls happen
+    outside the guarded branch."""
+    from ray_tpu.core.node import NodeService
+
+    for fn in (NodeService._dispatch_task, NodeService._make_runnable,
+               NodeService._admit_task):
+        instrs = list(dis.get_instructions(fn))
+        fr_loads = [i for i, ins in enumerate(instrs)
+                    if "LOAD" in ins.opname and ins.argval == "_fr"]
+        assert fr_loads, fn.__name__   # the hook exists
+        for i in fr_loads:
+            nxt = instrs[i + 1]
+            # _fr may only ever be dereferenced as _fr._active ...
+            assert nxt.opname == "LOAD_ATTR" and nxt.argval == "_active", \
+                (fn.__name__, nxt)
+        # ... and _active is compared against None (the gate) at least
+        # once per function
+        src = __import__("inspect").getsource(fn)
+        assert "_fr._active is not None" in src, fn.__name__
+
+
+def test_duplicate_task_done_counts_once(recorder):
+    """A chaos-duplicated task_done must not fold the same lifecycle
+    twice (the consume marker survives the dup's fr re-merge)."""
+    from ray_tpu.core.node import NodeService, TaskRec
+
+    t0 = time.monotonic()
+    spec = {"task_id": b"\x01" * 22, "name": "dup",
+            "fr_w0": time.time(),
+            "fr": [("submit", t0), ("dispatch", t0 + 0.001)]}
+    tr = TaskRec(spec=spec)
+    m = {"t": "task_done", "task_id": spec["task_id"],
+         "fr": list(spec["fr"]) + [("result_store", t0 + 0.002)]}
+    NodeService._fr_finish(object.__new__(NodeService), tr, m)
+    assert len(recorder.records) == 1
+    NodeService._fr_finish(object.__new__(NodeService), tr, m)   # the dup
+    assert len(recorder.records) == 1
+    assert recorder.stage_summary()["dispatch"]["n"] == 1
+
+
+# -- merged timeline (lifecycle + spans + chaos) ----------------------------
+
+def test_timeline_merges_lifecycle_spans_and_chaos(tmp_path):
+    from ray_tpu.core import fault_injection as fi
+    from ray_tpu.util import tracing
+
+    rec = fr.enable()
+    rec.reset()
+    tracing.enable_tracing(str(tmp_path / "traces"))
+    plan = fi.FaultPlan(seed=7)
+    plan.delay_messages(0.01, msg_type="submit_task", times=2)
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        with fi.injected(plan):
+            @ray_tpu.remote
+            def f(i):
+                return i
+
+            assert ray_tpu.get([f.remote(i) for i in range(6)],
+                               timeout=120) == list(range(6))
+        _wait_records(rec, 6)
+        assert plan.log   # the chaos rules really fired
+        assert rec.export_faults()
+
+        from ray_tpu.core.observer import observer_query
+        from ray_tpu.core.runtime import get_runtime
+        svc = get_runtime().node_service
+        (reply,) = observer_query(svc.address, [{"t": "flight_recorder"}])
+        assert reply["enabled"] and reply["records"]
+        assert reply["stages"].get("dispatch", {}).get("n", 0) >= 1
+
+        events = get_runtime().client.request(
+            {"t": "state", "what": "task_events"})["data"]
+        spans = tracing.collect_spans()
+        from ray_tpu.util.timeline import build_trace
+        trace = build_trace(task_events=events,
+                            records=reply["records"],
+                            spans=spans, faults=reply["faults"])
+        json.dumps(trace)   # Perfetto-loadable = valid JSON
+        assert trace["traceEvents"]
+        cats = {e["cat"] for e in trace["traceEvents"]}
+        assert {"lifecycle", "span", "chaos"} <= cats
+        chaos = [e for e in trace["traceEvents"] if e["cat"] == "chaos"]
+        assert all(e["ph"] == "i" for e in chaos)
+        slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert all(e["dur"] >= 0 for e in slices)
+        # events come out time-ordered
+        ts = [e["ts"] for e in trace["traceEvents"]]
+        assert ts == sorted(ts)
+    finally:
+        ray_tpu.shutdown()
+        tracing.disable_tracing()
+        fr.disable()
+
+
+def test_observer_reports_disabled_recorder(rt_init):
+    fr.disable()
+    from ray_tpu.core.observer import observer_query
+    from ray_tpu.core.runtime import get_runtime
+    svc = get_runtime().node_service
+    (reply,) = observer_query(svc.address, [{"t": "flight_recorder"}])
+    assert reply["enabled"] is False
+    assert reply["records"] == [] and reply["faults"] == []

@@ -61,7 +61,60 @@ def test_task_spans_stitch_across_processes(traced, rt_init):
     assert any("work.execute" in n for n in names)
     submit = next(s for s in spans if "work.remote" in s["name"])
     execute = next(s for s in spans if "work.execute" in s["name"])
-    # one trace across submission and (worker-side) execution
+    # one trace across submission and (worker-side) execution, with
+    # correct PARENTAGE: the worker's execute span is a child of the
+    # client's submit span (not merely a sibling under the root), and
+    # the submit span is a child of the ambient driver span
     assert execute["trace_id"] == submit["trace_id"]
+    assert execute["parent_id"] == submit["span_id"]
+    assert execute["pid"] != submit["pid"]   # a REAL process boundary
     root = next(s for s in spans if s["name"] == "driver_root")
     assert submit["parent_id"] == root["span_id"]
+
+
+def test_collect_spans_skips_truncated_tail(tmp_path):
+    """A writer killed mid-write leaves a truncated trailing JSONL line;
+    collection must skip it, not raise."""
+    d = tmp_path / "traces"
+    d.mkdir()
+    good = {"name": "ok", "trace_id": "t", "span_id": "s",
+            "start": 1.0, "end": 2.0}
+    import json as _json
+    (d / "spans-12345.jsonl").write_text(
+        _json.dumps(good) + "\n" + '{"name": "trunca')
+    spans = tracing.collect_spans(str(d))
+    assert [s["name"] for s in spans] == ["ok"]
+
+
+def test_trace_dir_change_after_disable_reopens_file(tmp_path):
+    """disable_tracing() then enable_tracing(new_dir) must re-point the
+    cached span file at the NEW dir (the old cached handle is stale)."""
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    tracing.enable_tracing(a)
+    with tracing.start_span("in_a"):
+        pass
+    tracing.disable_tracing()
+    tracing.enable_tracing(b)
+    with tracing.start_span("in_b"):
+        pass
+    tracing.flush_spans()
+    names_a = {s["name"] for s in tracing.collect_spans(a)}
+    names_b = {s["name"] for s in tracing.collect_spans(b)}
+    tracing.disable_tracing()
+    tracing.clear()
+    assert names_a == {"in_a"}
+    assert names_b == {"in_b"}
+
+
+def test_emit_batches_are_flushed_by_collect(tmp_path):
+    """Batched emission: collect_spans force-drains this process's
+    pending spans so nothing is lost to the write batch."""
+    d = str(tmp_path / "traces")
+    tracing.enable_tracing(d)
+    for i in range(5):
+        with tracing.start_span(f"s{i}"):
+            pass
+    spans = tracing.collect_spans(d)
+    tracing.disable_tracing()
+    tracing.clear()
+    assert {s["name"] for s in spans} >= {f"s{i}" for i in range(5)}
