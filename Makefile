@@ -1,0 +1,18 @@
+# Repo tooling.  `make lint` is the control-plane invariant analyzer
+# (ray_tpu/analysis/) with the reviewed baseline; tier-1 CI runs the
+# same thing through tests/test_lint_clean.py, so a red `make lint`
+# means a red tier-1.
+
+PYTHON ?= python
+
+.PHONY: lint lint-json test
+
+lint:
+	$(PYTHON) -m ray_tpu lint --baseline .lint-baseline.json
+
+lint-json:
+	$(PYTHON) -m ray_tpu lint --baseline .lint-baseline.json --json
+
+test:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider

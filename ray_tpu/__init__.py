@@ -11,7 +11,8 @@ from ray_tpu._version import __version__  # noqa: F401
 from ray_tpu.core.runtime import (init, shutdown, is_initialized,
                                   get_runtime)
 from ray_tpu.core.remote_function import remote
-from ray_tpu.core.actor import get_actor, kill, ActorHandle
+from ray_tpu.core.actor import (get_actor, kill, ActorHandle,
+                                list_named_actors)
 from ray_tpu.core.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu.core.client import (TaskError, GetTimeoutError, ActorDiedError,
                                  ObjectLostError, OutOfMemoryError,
@@ -85,7 +86,8 @@ def cluster_resources():
 
 __all__ = [
     "__version__", "init", "shutdown", "is_initialized", "remote", "put",
-    "get", "wait", "free", "get_actor", "kill", "ActorHandle", "ObjectRef",
+    "get", "wait", "free", "get_actor", "list_named_actors", "kill",
+    "ActorHandle", "ObjectRef",
     "ObjectRefGenerator", "TaskError", "GetTimeoutError", "ActorDiedError",
     "ObjectLostError", "OutOfMemoryError", "RetryPolicy",
     "placement_group", "remove_placement_group", "PlacementGroup",

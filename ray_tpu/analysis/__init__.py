@@ -1,0 +1,75 @@
+"""Control-plane invariant analyzer (``ray_tpu lint``).
+
+Four static passes over the control plane, each enforcing an invariant
+that a past PR shipped a bug against (see ARCHITECTURE.md
+"Control-plane invariants"):
+
+  * protocol   — every literal ``{"t": ...}`` message type sent anywhere
+                 in the package has a handler (``_h_*`` / ``_hh_*`` /
+                 client-side dispatch), and every defined handler has a
+                 sender: the ``getattr(self, "_h_" + t)`` dispatch makes
+                 drift silent at runtime.
+  * blocking   — no ``time.sleep`` / blocking socket / ``subprocess`` /
+                 ``waitpid``-without-WNOHANG call is reachable from an
+                 event-loop entry point (``_h_*`` handlers, ``on_tick``,
+                 ``_dispatch``): one blocking call stalls a whole node.
+  * hotpath    — every registered disabled-by-default hook (flight
+                 recorder, fault injection) compiles to a module-global
+                 load + ``is None`` branch and nothing else on the
+                 disabled path (bytecode-verified).
+  * locks      — no file/socket write, pickle, or ``send*`` call runs
+                 lexically inside a ``with <lock>:`` block unless
+                 baselined with a justification.
+
+The reference codebase leans on C++ sanitizers and clang-tidy for this
+class of invariant; our control plane is Python, so the AST/``dis``
+passes live here.  Findings are suppressible via a checked-in baseline
+(``.lint-baseline.json``) carrying a per-finding justification; the
+suite runs in tier-1 (``tests/test_lint_clean.py``) so regressions fail
+CI, and ``python -m ray_tpu lint`` runs it from the command line.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.analysis.common import Finding, repo_root
+from ray_tpu.analysis import (baseline, blocking_pass, hotpath_pass,
+                              locks_pass, protocol_pass)
+
+PASSES = ("protocol", "blocking", "hotpath", "locks")
+
+
+def run_passes(root=None, passes=PASSES) -> list:
+    """Run the selected passes over the repo at ``root`` (default: the
+    tree containing the imported ray_tpu package) and return the
+    combined, sorted finding list (unsuppressed — apply a baseline with
+    ``baseline.apply``)."""
+    import os as _os
+    root = root or repo_root()
+    findings: list[Finding] = []
+    if "protocol" in passes:
+        findings += protocol_pass.run(root)
+    if "blocking" in passes:
+        findings += blocking_pass.run(root)
+    if "hotpath" in passes:
+        # the hotpath pass checks COMPILED bytecode, so it can only ever
+        # see the imported ray_tpu package — running it against some
+        # other tree would silently report on the wrong code
+        if _os.path.realpath(root) == _os.path.realpath(repo_root()):
+            findings += hotpath_pass.run()
+        else:
+            findings.append(Finding(
+                pass_id="hotpath", rule="skipped-foreign-root",
+                ident="hotpath:skipped-foreign-root",
+                file="", line=0,
+                message=f"hotpath pass checks the IMPORTED ray_tpu "
+                        f"package's bytecode and cannot lint {root!r}; "
+                        f"run it from that tree's own interpreter "
+                        f"(or drop it via --passes)"))
+    if "locks" in passes:
+        findings += locks_pass.run(root)
+    findings.sort(key=lambda f: (f.pass_id, f.file, f.line, f.ident))
+    return findings
+
+
+__all__ = ["Finding", "PASSES", "run_passes", "repo_root", "baseline",
+           "protocol_pass", "blocking_pass", "hotpath_pass", "locks_pass"]

@@ -1,0 +1,81 @@
+"""Baseline file: suppress known, *justified* findings.
+
+``.lint-baseline.json`` is checked in at the repo root.  Every entry
+must carry a non-empty justification — the baseline is a reviewed list
+of decisions ("this lock-held send IS the point of the lock"), not a
+mute button.  Stale entries (nothing matches them anymore) are reported
+so the file tracks reality; ``tests/test_lint_clean.py`` fails on them.
+
+Format:
+
+    {"findings": [
+        {"id": "locks:ray_tpu/core/protocol.py:send:.sendall()",
+         "justification": "the per-connection wire lock exists to ..."}
+    ]}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+DEFAULT_BASELINE = ".lint-baseline.json"
+
+
+def load(path: str) -> dict:
+    """-> {ident: justification}.  Raises ValueError on entries missing
+    a justification (an unexplained suppression is itself a finding)."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out = {}
+    for entry in data.get("findings", []):
+        ident = entry.get("id", "")
+        just = (entry.get("justification") or "").strip()
+        if not ident:
+            raise ValueError("baseline entry missing 'id'")
+        if not just or just.upper().startswith("TODO"):
+            raise ValueError(
+                f"baseline entry {ident!r} has no real justification "
+                f"(empty or TODO placeholder) — every suppression must "
+                f"say why")
+        out[ident] = just
+    return out
+
+
+def apply(findings: list, baseline: Optional[dict]) -> tuple:
+    """-> (active, suppressed, stale_ids)."""
+    baseline = baseline or {}
+    active = [f for f in findings if f.ident not in baseline]
+    suppressed = [f for f in findings if f.ident in baseline]
+    matched = {f.ident for f in suppressed}
+    stale = sorted(i for i in baseline if i not in matched)
+    return active, suppressed, stale
+
+
+def write(findings: list, path: str,
+          justification: str = "TODO: justify or fix") -> None:
+    """Emit a baseline covering ``findings`` (dedup by ident).  Used by
+    ``ray_tpu lint --write-baseline``.  Justifications already present
+    in the file at ``path`` are PRESERVED — refreshing a baseline in
+    place must not destroy its reviewed entries — and only genuinely
+    new idents get the TODO placeholder, which MUST be filled in before
+    commit: ``load()`` rejects it, so a skeleton committed as-is fails
+    tier-1 instead of muting findings."""
+    existing = {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for entry in json.load(f).get("findings", []):
+                if entry.get("id") and entry.get("justification"):
+                    existing[entry["id"]] = entry["justification"]
+    except (OSError, ValueError):
+        pass
+    seen = {}
+    for f in findings:
+        seen.setdefault(f.ident, f)
+    data = {"findings": [
+        {"id": ident, "finding": seen[ident].render(),
+         "justification": existing.get(ident, justification)}
+        for ident in sorted(seen)]}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")

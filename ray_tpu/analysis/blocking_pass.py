@@ -1,0 +1,359 @@
+"""Pass 2: event-loop blocking calls.
+
+Every control-plane service is ONE thread (``core/service.py``): a
+selector loop that runs ``_h_*`` handlers, the periodic ``on_tick``,
+posted callbacks, and timers inline.  A single blocking call anywhere
+under a handler stalls task dispatch, heartbeats (getting a healthy
+node declared dead), and object transfers for the whole node — which is
+why worker-process reaping moved off ``waitpid`` scans and peer/head
+dials run on dedicated threads.
+
+This pass builds a conservative call graph over ``ray_tpu/core/`` and
+walks it from the event-loop entry points, reporting any reachable
+blocking primitive with the call chain that reaches it:
+
+  * ``time.sleep``                       (incl. transitively, e.g.
+                                          ``fault_injection.apply_delay``,
+                                          and bare ``from time import
+                                          sleep`` imports)
+  * ``subprocess.run/call/check_call/check_output``
+  * ``os.waitpid`` without ``WNOHANG``
+  * ``socket.create_connection``; ``sendall`` by attribute name (always
+    blocking on a blocking socket — per-receiver mode is out of static
+    reach); argless ``.wait()`` / ``.communicate()`` (indefinite block:
+    a timeout argument bounds them and is accepted).
+
+Call-graph edges (deliberately conservative — unresolved calls are
+dropped, and the tier-1 fixture tests pin the shapes that must keep
+resolving):
+
+  * bare names → same-module functions / from-imports of core modules
+  * ``mod.func(...)`` through a module alias (``_fi.apply_delay``)
+  * ``self.meth(...)`` through the class and its bases (NodeService →
+    EventLoopService/ClusterStoreMixin)
+  * ``<alias>._active.meth(...)`` → methods of the classes in that
+    module (the fault-injection / flight-recorder hook surface)
+  * ``obj.meth(...)`` when exactly one scanned class defines ``meth``
+    (unique-name dispatch; ambiguous names are skipped, not guessed)
+
+Nested ``def``s are attributed to their enclosing function: a closure
+built in a handler and posted back to the loop still runs on the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.analysis.common import (Finding, FunctionIndexer,
+                                     import_aliases, iter_py_files,
+                                     parse_file, rel, repo_root)
+
+DEFAULT_SUBDIRS = ["ray_tpu/core"]
+
+# loop-thread entry points: message handlers, head/peer push dispatch,
+# the periodic tick, and the dispatcher itself
+ROOT_NAMES = {"on_tick", "_dispatch", "_on_head_msg", "_on_peer_msg",
+              "_run_due_timers"}
+
+_BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"): "time.sleep",
+    ("subprocess", "run"): "subprocess.run",
+    ("subprocess", "call"): "subprocess.call",
+    ("subprocess", "check_call"): "subprocess.check_call",
+    ("subprocess", "check_output"): "subprocess.check_output",
+    ("socket", "create_connection"): "socket.create_connection",
+}
+
+# attribute names that block regardless of receiver type
+_BLOCKING_ATTRS = {"sendall"}
+
+# attribute calls that block INDEFINITELY when called with no arguments
+# (Popen.wait(), Event.wait(), Popen.communicate()); a timeout argument
+# bounds them, so only the bare form is flagged
+_BLOCKING_IF_ARGLESS = {"wait", "communicate"}
+
+
+@dataclass
+class _Fn:
+    info: object
+    calls: list = field(default_factory=list)       # resolved (kind, key)
+    primitives: list = field(default_factory=list)  # (name, line)
+
+
+def _thread_target_names(func_node) -> set:
+    """Names of nested defs handed to ``threading.Thread(target=...)``
+    (or a pool's ``submit``): those bodies run on their OWN thread, not
+    the event loop, so the enclosing-function attribution must skip
+    them.  Other closures (posted callbacks, RPC continuations) stay
+    attributed to the enclosing function — they do run on the loop."""
+    out = set()
+    for n in ast.walk(func_node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if name == "Thread":
+            for kw in n.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                    out.add(kw.value.id)
+        elif name == "submit" and n.args \
+                and isinstance(n.args[0], ast.Name):
+            out.add(n.args[0].id)
+    return out
+
+
+class _BodyScan(ast.NodeVisitor):
+    """Collect call edges + blocking primitives from one function body."""
+
+    def __init__(self, fn: _Fn, aliases: dict, module_key: str):
+        self.fn = fn
+        self.aliases = aliases
+        self.module_key = module_key
+        self._root = None
+        self._skip_defs: set = set()
+
+    def _visit_func(self, node) -> None:
+        if self._root is None:
+            self._root = node
+            self._skip_defs = _thread_target_names(node)
+            self.generic_visit(node)
+        elif node.name not in self._skip_defs:
+            self.generic_visit(node)
+        # else: a Thread-target closure — runs off-loop, skip its body
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Name):
+            target = self.aliases.get(f.id, f.id)
+            if "." in target:
+                parts = target.split(".")
+                # bare-name from-import of a blocking primitive:
+                # `from time import sleep; sleep(1)`
+                if (parts[0], parts[-1]) in _BLOCKING_MODULE_CALLS:
+                    self.fn.primitives.append(
+                        (_BLOCKING_MODULE_CALLS[(parts[0], parts[-1])],
+                         node.lineno))
+                elif parts[0] == "os" and parts[-1] == "waitpid":
+                    self._check_waitpid(node)
+                elif len(parts) >= 3 \
+                        and ".".join(parts[:-2]).endswith("ray_tpu.core"):
+                    # from-import of a core function:
+                    # "ray_tpu.core.fault_injection.apply_delay"
+                    self.fn.calls.append(("modfunc",
+                                          (parts[-2], parts[-1])))
+            else:
+                self.fn.calls.append(("local",
+                                      (self.module_key, f.id)))
+        elif isinstance(f, ast.Attribute):
+            self._attr_call(f, node)
+        self.generic_visit(node)
+
+    def _check_waitpid(self, node: ast.Call) -> None:
+        if not any("WNOHANG" in ast.dump(a) for a in node.args[1:]):
+            self.fn.primitives.append(
+                ("os.waitpid (no WNOHANG)", node.lineno))
+
+    def _attr_call(self, f: ast.Attribute, node: ast.Call) -> None:
+        attr = f.attr
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            target_mod = self.aliases.get(recv.id)
+            if target_mod is not None:
+                top = target_mod.split(".")[0]
+                leaf = target_mod.split(".")[-1]
+                if (top, attr) in _BLOCKING_MODULE_CALLS:
+                    self.fn.primitives.append(
+                        (_BLOCKING_MODULE_CALLS[(top, attr)], node.lineno))
+                    return
+                if top == "os" and attr == "waitpid":
+                    self._check_waitpid(node)
+                    return
+                if target_mod.startswith("ray_tpu."):
+                    self.fn.calls.append(("modfunc", (leaf, attr)))
+                # any other module alias: a non-blocking stdlib call —
+                # never unique-name dispatch (os.kill must not resolve
+                # to a scanned class's .kill method)
+                return
+            if recv.id == "self":
+                self.fn.calls.append(("self", attr))
+                return
+        # <alias>._active.meth(...) — the chaos/recorder hook surface
+        if isinstance(recv, ast.Attribute) and recv.attr == "_active" \
+                and isinstance(recv.value, ast.Name):
+            target_mod = self.aliases.get(recv.value.id, "")
+            if target_mod.startswith("ray_tpu."):
+                self.fn.calls.append(
+                    ("modmethod", (target_mod.split(".")[-1], attr)))
+                return
+        if attr in _BLOCKING_ATTRS:
+            self.fn.primitives.append((attr, node.lineno))
+            return
+        if attr in _BLOCKING_IF_ARGLESS and not node.args \
+                and not node.keywords:
+            self.fn.primitives.append(
+                (f".{attr}() with no timeout", node.lineno))
+            return
+        # fall through: unique-name dispatch resolved later
+        self.fn.calls.append(("unique", attr))
+
+
+@dataclass
+class CallGraph:
+    fns: dict = field(default_factory=dict)        # qual key -> _Fn
+    by_module: dict = field(default_factory=dict)  # mod -> {qual: _Fn}
+    classes: dict = field(default_factory=dict)    # class -> (mod, bases)
+    methods: dict = field(default_factory=dict)    # class -> {name: key}
+    method_name_index: dict = field(default_factory=dict)  # name -> [keys]
+
+    def key(self, module_key: str, qualname: str) -> str:
+        return f"{module_key}:{qualname}"
+
+    def resolve_self(self, cls: str, meth: str) -> Optional[str]:
+        seen = set()
+        queue = deque([cls])
+        while queue:
+            c = queue.popleft()
+            if c in seen:
+                continue
+            seen.add(c)
+            key = self.methods.get(c, {}).get(meth)
+            if key is not None:
+                return key
+            _, bases = self.classes.get(c, ("", []))
+            queue.extend(bases)
+        return None
+
+    def edges(self, key: str) -> list:
+        fn = self.fns.get(key)
+        if fn is None:
+            return []
+        out = []
+        cls = fn.info.class_name
+        for kind, ref in fn.calls:
+            if kind == "local":
+                mod, name = ref
+                k = self.key(mod, name)
+                if k in self.fns:
+                    out.append(k)
+            elif kind == "modfunc":
+                mod, name = ref
+                k = self.key(mod, name)
+                if k in self.fns:
+                    out.append(k)
+            elif kind == "self" and cls:
+                k = self.resolve_self(cls, ref)
+                if k:
+                    out.append(k)
+            elif kind == "modmethod":
+                mod, name = ref
+                for k in self.method_name_index.get(name, []):
+                    if k.startswith(mod + ":"):
+                        out.append(k)
+            elif kind == "unique":
+                keys = self.method_name_index.get(ref, [])
+                if len(keys) == 1:
+                    out.append(keys[0])
+        return out
+
+
+def build_graph(root: Optional[str] = None,
+                subdirs: Optional[list] = None) -> CallGraph:
+    root = root or repo_root()
+    graph = CallGraph()
+    for path in iter_py_files(root, subdirs or DEFAULT_SUBDIRS):
+        tree = parse_file(path)
+        if tree is None:
+            continue
+        relfile = rel(path, root)
+        module_key = relfile.rsplit("/", 1)[-1][:-3]
+        idx = FunctionIndexer(relfile, module_key)
+        idx.visit(tree)
+        aliases = import_aliases(tree)
+        for cls, bases in idx.classes.items():
+            graph.classes[cls] = (module_key, bases)
+        for qual, info in idx.functions.items():
+            fn = _Fn(info=info)
+            _BodyScan(fn, aliases, module_key).visit(info.node)
+            key = graph.key(module_key, qual)
+            graph.fns[key] = fn
+            graph.by_module.setdefault(module_key, {})[qual] = fn
+            if info.class_name:
+                graph.methods.setdefault(info.class_name, {})[
+                    info.name] = key
+                graph.method_name_index.setdefault(info.name, []).append(
+                    key)
+    return graph
+
+
+def roots_of(graph: CallGraph) -> list:
+    out = []
+    for key, fn in graph.fns.items():
+        name = fn.info.name
+        if name.startswith("_h_") or name.startswith("_hh_") \
+                or name in ROOT_NAMES:
+            out.append(key)
+    return sorted(out)
+
+
+def run(root: Optional[str] = None,
+        subdirs: Optional[list] = None,
+        max_depth: int = 12) -> list:
+    graph = build_graph(root, subdirs)
+    # BFS from all roots at once; first (shortest) path to a function wins
+    parent: dict[str, Optional[str]] = {}
+    depth: dict[str, int] = {}
+    queue: deque = deque()
+    for r in roots_of(graph):
+        parent[r] = None
+        depth[r] = 0
+        queue.append(r)
+    while queue:
+        key = queue.popleft()
+        if depth[key] >= max_depth:
+            continue
+        for nxt in graph.edges(key):
+            if nxt not in parent:
+                parent[nxt] = key
+                depth[nxt] = depth[key] + 1
+                queue.append(nxt)
+
+    # the ident is line-free (stable for baselining), so multiple
+    # occurrences of one primitive in one function share a finding —
+    # every line is listed, or fixing the first would just reveal the
+    # next on a later run
+    grouped: dict = {}
+    for key in parent:
+        fn = graph.fns[key]
+        for prim, line in fn.primitives:
+            ident = (f"blocking:{fn.info.file}:{fn.info.qualname}"
+                     f":{prim.split(' ')[0]}")
+            if ident not in grouped:
+                chain = []
+                k = key
+                while k is not None:
+                    chain.append(graph.fns[k].info.qualname)
+                    k = parent[k]
+                chain.reverse()
+                grouped[ident] = (fn, prim, chain, [line])
+            else:
+                grouped[ident][3].append(line)
+    findings = []
+    for ident, (fn, prim, chain, lines) in grouped.items():
+        lines.sort()
+        also = (f" (also at line{'s' if len(lines) > 2 else ''} "
+                + ", ".join(str(ln) for ln in lines[1:]) + ")"
+                if len(lines) > 1 else "")
+        findings.append(Finding(
+            pass_id="blocking", rule="loop-blocking-call",
+            ident=ident, file=fn.info.file, line=lines[0],
+            message=f"{prim} reachable from the event loop via "
+                    + " -> ".join(chain) + also))
+    findings.sort(key=lambda f: (f.file, f.line))
+    return findings

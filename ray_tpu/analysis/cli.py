@@ -1,0 +1,119 @@
+"""``ray_tpu lint`` — run the control-plane invariant analyzer.
+
+    python -m ray_tpu lint                         # all passes, no baseline
+    python -m ray_tpu lint --baseline .lint-baseline.json
+    python -m ray_tpu lint --passes protocol,locks
+    python -m ray_tpu lint --write-baseline out.json   # bootstrap a baseline
+    make lint                                      # repo wiring
+
+Exit codes: 0 clean (after baseline), 1 findings (or stale baseline
+entries), 2 usage/config error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from ray_tpu import analysis
+from ray_tpu.analysis import baseline as baseline_mod
+
+
+def run_lint(args) -> int:
+    root = args.root or analysis.repo_root()
+    passes = tuple(p.strip() for p in args.passes.split(",")) \
+        if args.passes else analysis.PASSES
+    unknown = [p for p in passes if p not in analysis.PASSES]
+    if unknown:
+        print(f"unknown pass(es): {', '.join(unknown)} "
+              f"(have: {', '.join(analysis.PASSES)})", file=sys.stderr)
+        return 2
+
+    findings = analysis.run_passes(root=root, passes=passes)
+
+    if args.write_baseline:
+        baseline_mod.write(findings, args.write_baseline)
+        print(f"wrote {len({f.ident for f in findings})} baseline "
+              f"entries to {args.write_baseline} — fill in the "
+              f"justifications")
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path and getattr(args, "no_baseline", False):
+        print("--baseline and --no-baseline conflict — pick one",
+              file=sys.stderr)
+        return 2
+    if baseline_path is None and not getattr(args, "no_baseline", False):
+        # default to the linted tree's committed baseline, so the bare
+        # `ray_tpu lint` agrees with `make lint` and tier-1 instead of
+        # re-reporting every reviewed suppression
+        candidate = os.path.join(root, baseline_mod.DEFAULT_BASELINE)
+        if os.path.exists(candidate):
+            baseline_path = candidate
+    bl = {}
+    if baseline_path:
+        if not os.path.exists(baseline_path):
+            print(f"baseline file not found: {baseline_path}",
+                  file=sys.stderr)
+            return 2
+        try:
+            bl = baseline_mod.load(baseline_path)
+        except ValueError as e:
+            print(f"bad baseline: {e}", file=sys.stderr)
+            return 2
+        # entries for passes NOT selected this run can't match anything
+        # — without this filter `--passes protocol` would call every
+        # other pass's suppression stale and tell the user to delete it
+        bl = {i: j for i, j in bl.items()
+              if i.split(":", 1)[0] in passes}
+    active, suppressed, stale = baseline_mod.apply(findings, bl)
+
+    if args.json:
+        print(json.dumps({
+            "active": [vars(f) for f in active],
+            "suppressed": [vars(f) for f in suppressed],
+            "stale_baseline_ids": stale,
+        }, indent=2))
+    else:
+        for f in active:
+            print(f.render())
+        for ident in stale:
+            print(f"[baseline/stale] {ident}: baselined but no longer "
+                  f"reported — remove the entry")
+        counts = {}
+        for f in active:
+            counts[f.pass_id] = counts.get(f.pass_id, 0) + 1
+        per_pass = ", ".join(f"{p}={counts.get(p, 0)}" for p in passes)
+        print(f"lint: {len(active)} finding"
+              f"{'s' if len(active) != 1 else ''} "
+              f"({len(suppressed)} baselined, {len(stale)} stale "
+              f"baseline entr{'ies' if len(stale) != 1 else 'y'}) "
+              f"[{per_pass}]")
+    return 1 if (active or stale) else 0
+
+
+def add_parser(sub) -> None:
+    p = sub.add_parser(
+        "lint", help="static control-plane invariant analysis "
+                     "(protocol drift, event-loop blocking, hot-path "
+                     "gates, lock-held I/O)")
+    p.add_argument("--baseline", default=None,
+                   help="suppress findings listed (with justification) "
+                        "in this JSON file (default: the linted tree's "
+                        ".lint-baseline.json when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report raw findings, ignoring any committed "
+                        "baseline")
+    p.add_argument("--passes", default=None,
+                   help="comma-separated subset of: "
+                        + ",".join(analysis.PASSES))
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: the tree the "
+                        "imported ray_tpu package lives in)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--write-baseline", default=None, metavar="PATH",
+                   help="write current findings as a baseline skeleton "
+                        "and exit 0")
+    p.set_defaults(fn=run_lint)

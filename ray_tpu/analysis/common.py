@@ -1,0 +1,153 @@
+"""Shared plumbing for the analyzer passes: the Finding record, file
+discovery, and AST helpers used by more than one pass."""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+# Directories never scanned: generated protobuf stubs aren't ours to
+# lint, and the analyzer itself is full of pattern strings that would
+# read as protocol traffic.
+_SKIP_DIRS = {"generated", "analysis", "__pycache__"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding.
+
+    ``ident`` is the stable suppression key used by the baseline file —
+    it deliberately contains no line number, so a finding survives
+    unrelated edits above it (the same rule clang-tidy NOLINT files and
+    ruff baselines follow)."""
+
+    pass_id: str          # protocol | blocking | hotpath | locks
+    rule: str             # short rule slug within the pass
+    ident: str            # stable suppression id (no line numbers)
+    file: str             # repo-relative posix path ("" for module-level)
+    line: int             # 1-based line of the finding (0 if n/a)
+    message: str          # human-readable description
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.file else "<module>"
+        return f"[{self.pass_id}/{self.rule}] {loc}: {self.message}"
+
+
+def repo_root() -> str:
+    """The tree the analyzer lints: the directory containing the
+    imported ``ray_tpu`` package."""
+    import ray_tpu
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+
+
+def rel(path: str, root: str) -> str:
+    return os.path.relpath(os.path.abspath(path),
+                           os.path.abspath(root)).replace(os.sep, "/")
+
+
+def iter_py_files(root: str, subdirs: Optional[list] = None
+                  ) -> Iterator[str]:
+    """Yield .py paths under ``root`` (or root/<subdir> for each given
+    subdir), skipping generated/analysis/caches."""
+    bases = [os.path.join(root, s) for s in subdirs] if subdirs \
+        else [root]
+    for base in bases:
+        if os.path.isfile(base) and base.endswith(".py"):
+            yield base
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d not in _SKIP_DIRS
+                           and not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def parse_file(path: str) -> Optional[ast.Module]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+
+
+@dataclass
+class FuncInfo:
+    """One function/method as the passes see it."""
+
+    qualname: str                 # "Class.method" or "func"
+    name: str                     # bare name
+    file: str                     # repo-relative path
+    lineno: int
+    node: ast.AST = field(repr=False, default=None)
+    class_name: Optional[str] = None
+    module_key: str = ""          # file stem, e.g. "node"
+
+
+class FunctionIndexer(ast.NodeVisitor):
+    """Collect every function/method of a module with its enclosing
+    class, plus class→bases for MRO-ish resolution.  Nested defs are
+    attributed to their outermost enclosing function (a closure defined
+    inside a handler runs, for our purposes, as part of it)."""
+
+    def __init__(self, relfile: str, module_key: str):
+        self.relfile = relfile
+        self.module_key = module_key
+        self.functions: dict[str, FuncInfo] = {}
+        self.classes: dict[str, list] = {}      # class -> base names
+        self.methods: dict[str, dict] = {}      # class -> {name: FuncInfo}
+        self._class_stack: list = []
+        self._func_depth = 0
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if self._func_depth:
+            return  # classes defined inside functions: out of scope
+        bases = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                bases.append(b.attr)
+        self.classes[node.name] = bases
+        self.methods.setdefault(node.name, {})
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        if self._func_depth:
+            # nested def: body already owned by the outer function
+            return
+        cls = self._class_stack[-1] if self._class_stack else None
+        qual = f"{cls}.{node.name}" if cls else node.name
+        info = FuncInfo(qualname=qual, name=node.name, file=self.relfile,
+                        lineno=node.lineno, node=node, class_name=cls,
+                        module_key=self.module_key)
+        self.functions[qual] = info
+        if cls:
+            self.methods[cls][node.name] = info
+        self._func_depth += 1
+        self.generic_visit(node)
+        self._func_depth -= 1
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def import_aliases(tree: ast.Module) -> dict:
+    """Map local alias -> dotted module path for module-level imports
+    (``import subprocess``, ``from ray_tpu.core import protocol``,
+    ``from ray_tpu.core import fault_injection as _fi``)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out

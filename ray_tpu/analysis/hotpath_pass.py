@@ -1,0 +1,284 @@
+"""Pass 3: hot-path gate discipline, verified at the bytecode level.
+
+Generalizes the one-off ``dis``-based test PR 3 wrote for the flight
+recorder's disabled path into a reusable pass over every registered
+disabled-by-default hook (``hotpath_registry.HOT_GATES``): the flight
+recorder and fault-injection hooks on the dispatch/send/recv hot paths.
+
+For a registered ``gate`` function the pass asserts, on the compiled
+bytecode (nested code objects — closures, comprehensions — included):
+
+  1. the hook alias (``_fr`` / ``_fi``) is only ever dereferenced as
+     ``<alias>._active`` — no method calls, no other attributes: the
+     disabled path must not pay an extra lookup or a call;
+  2. at least one genuine ``is None`` gate exists: either
+     ``<alias>._active is [not] None`` with nothing between the
+     attribute load and the comparison, or the store-then-test shape
+     ``x = <alias>._active`` ... ``x is [not] None``.
+
+``use`` functions get rule 1 only (they run behind a caller's gate);
+``cold`` functions are exempt but must be listed.  Any OTHER function
+in a registered module that touches a hook alias is reported — new hook
+sites must register, which is how the contract stays enforced instead
+of remembered.
+"""
+
+from __future__ import annotations
+
+import dis
+import importlib
+import types
+from typing import Iterator, Optional
+
+from ray_tpu.analysis.common import Finding
+from ray_tpu.analysis.hotpath_registry import HOT_GATES
+
+_LOADS = ("LOAD_GLOBAL", "LOAD_NAME")
+_ATTR_LOADS = ("LOAD_ATTR", "LOAD_METHOD")
+# 3.11+ fuses `is None` jumps into one opcode
+_NONE_JUMPS = ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE",
+               "POP_JUMP_FORWARD_IF_NONE", "POP_JUMP_FORWARD_IF_NOT_NONE")
+
+
+def _iter_codes(code) -> Iterator:
+    yield code
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            yield from _iter_codes(const)
+
+
+def _none_test_polarity(instrs, j):
+    """If ``instrs[j:]`` starts an ``is [not] None`` test of the value
+    on the stack, return ``(jump_index, guards_fallthrough)`` —
+    ``guards_fallthrough`` True means the NOT-None case falls through
+    (the code between the jump and its target runs armed).  None when
+    it isn't a None test."""
+    a = instrs[j] if j < len(instrs) else None
+    if a is None:
+        return None
+    if a.opname in _NONE_JUMPS:
+        # POP_JUMP_[FORWARD_]IF_NONE jumps AWAY on None
+        return j, "IF_NONE" in a.opname
+    b = instrs[j + 1] if j + 1 < len(instrs) else None
+    c = instrs[j + 2] if j + 2 < len(instrs) else None
+    if a.opname == "LOAD_CONST" and a.argval is None \
+            and b is not None and b.opname == "IS_OP" \
+            and c is not None and c.opname.startswith("POP_JUMP"):
+        is_not = bool(b.arg)                 # IS_OP 1 == `is not`
+        jump_on_true = "IF_TRUE" in c.opname
+        # fall-through runs the not-None arm when the jump is taken on
+        # the None outcome: (`is not` + jump-on-false) or
+        # (`is` + jump-on-true)
+        return j + 2, is_not != jump_on_true
+    return None
+
+
+def _check_code(code, alias: str, mode: str,
+                extra_attrs: tuple = ()) -> list:
+    """Return problem strings for one code object.  Every ``_active``
+    load site is judged INDIVIDUALLY: a gate site opens a guarded
+    region, a use site (``<alias>._active.meth(...)``) must sit inside
+    one, and a store site's local must be None-tested somewhere — one
+    gated touch must not launder an ungated one elsewhere in the same
+    function (that shape crashes the moment the hook is disabled).
+    ``extra_attrs`` names attributes the registry explicitly allows
+    besides ``_active`` (e.g. ``apply_delay`` for the chaos delay
+    inside an armed branch)."""
+    problems = []
+    for co in _iter_codes(code):
+        if alias not in co.co_names:
+            continue
+        # EXTENDED_ARG prefixes (big functions: const/jump args > 255)
+        # are already folded into the next instruction's argval by dis —
+        # drop them so pattern stepping sees the logical sequence
+        instrs = [ins for ins in dis.get_instructions(co)
+                  if ins.opname != "EXTENDED_ARG"]
+        regions: list = []    # (lo_offset, hi_offset) proven-armed code
+
+        def note_gate(jump_idx, guards_fallthrough):
+            jump = instrs[jump_idx]
+            target = jump.argval          # jump target byte offset
+            if jump_idx + 1 >= len(instrs):
+                return
+            here = instrs[jump_idx + 1].offset
+            if guards_fallthrough:
+                # fall-through arm runs only when _active is not None
+                regions.append((here, target))
+            else:
+                # fall-through arm handles None; if it unconditionally
+                # exits (early-return shape), everything from the jump
+                # target onward runs armed
+                arm = [x for x in instrs if here <= x.offset < target]
+                if arm and arm[-1].opname in ("RETURN_VALUE",
+                                              "RAISE_VARARGS", "RERAISE",
+                                              "RETURN_CONST"):
+                    regions.append((target, float("inf")))
+
+        # phase 1: which locals are bound from `<alias>._active`?  Only
+        # THEIR None-tests open armed regions — an unrelated guard
+        # (`if spec is not None:`) proves nothing about the hook
+        bound_locals: set = set()
+        for i, ins in enumerate(instrs):
+            if ins.opname in _LOADS and ins.argval == alias \
+                    and i + 2 < len(instrs) \
+                    and instrs[i + 1].opname in _ATTR_LOADS \
+                    and instrs[i + 1].argval == "_active" \
+                    and instrs[i + 2].opname == "STORE_FAST":
+                bound_locals.add(instrs[i + 2].argval)
+
+        gate_count = 0
+        store_sites: list = []   # (local_name, line)
+        use_sites: list = []     # (byte_offset, line)
+        tested_locals: set = set()
+        cur_line = co.co_firstlineno
+        for i, ins in enumerate(instrs):
+            # 3.13 renamed the int-valued field to line_number and made
+            # starts_line a bool
+            ln = getattr(ins, "line_number", None)
+            if ln is None and not isinstance(ins.starts_line, bool):
+                ln = ins.starts_line
+            if ln is not None:
+                cur_line = ln
+            if ins.opname == "LOAD_FAST":
+                if ins.argval not in bound_locals:
+                    continue
+                t = _none_test_polarity(instrs, i + 1)
+                if t is not None:
+                    tested_locals.add(ins.argval)
+                    note_gate(*t)
+                elif i + 1 < len(instrs) \
+                        and instrs[i + 1].opname == "RETURN_VALUE":
+                    pass   # returning the (possibly None) recorder is safe
+                else:
+                    # a USE of the bound local: must sit in a guarded
+                    # region like a direct `_active` use — a None test
+                    # somewhere else must not launder this site
+                    use_sites.append((ins.offset, cur_line))
+                continue
+            if not (ins.opname in _LOADS and ins.argval == alias):
+                continue
+            nxt = instrs[i + 1] if i + 1 < len(instrs) else None
+            if nxt is not None and nxt.opname in _ATTR_LOADS \
+                    and nxt.argval in extra_attrs:
+                continue
+            if nxt is None or nxt.opname not in _ATTR_LOADS \
+                    or nxt.argval != "_active":
+                what = (f"{alias}.{nxt.argval}" if nxt is not None
+                        and nxt.opname in _ATTR_LOADS else alias)
+                problems.append(
+                    f"dereferences {what!r} at line {cur_line} — the "
+                    f"only allowed touch is `{alias}._active`")
+                continue
+            t = _none_test_polarity(instrs, i + 2)
+            if t is not None:
+                gate_count += 1
+                note_gate(*t)
+            elif i + 2 < len(instrs) \
+                    and instrs[i + 2].opname == "STORE_FAST":
+                store_sites.append((instrs[i + 2].argval, cur_line,
+                                    ins.offset))
+            else:
+                use_sites.append((ins.offset, cur_line))
+        if mode != "gate":
+            # "use" helpers run behind their CALLER's gate: only the
+            # deref rule applies; an untested local bind is their normal
+            # shape (`plan = _fi._active` in _chaos_filter)
+            continue
+        for local, line, off in store_sites:
+            if local in tested_locals:
+                gate_count += 1
+            elif not any(lo <= off < hi for lo, hi in regions):
+                # a store inside an already-guarded region (re-reading
+                # the global after an early-return gate) needs no second
+                # test; an unguarded, untested one is a disabled-path
+                # crash
+                problems.append(
+                    f"binds `{local} = {alias}._active` at line {line} "
+                    f"but never None-tests it — crashes when the hook "
+                    f"is disabled")
+        for off, line in use_sites:
+            if not any(lo <= off < hi for lo, hi in regions):
+                problems.append(
+                    f"uses `{alias}._active` at line {line} outside any "
+                    f"`is None`-guarded branch — crashes when the hook "
+                    f"is disabled")
+        if mode == "gate" and gate_count == 0 and not problems:
+            problems.append(
+                f"touches `{alias}._active` but has no `is None` gate "
+                f"(direct or through a local)")
+    return problems
+
+
+def _functions_of(mod) -> dict:
+    """{qualname: function} for module functions and class methods."""
+    out = {}
+    for name, obj in vars(mod).items():
+        if isinstance(obj, types.FunctionType) \
+                and obj.__module__ == mod.__name__:
+            out[name] = obj
+        elif isinstance(obj, type) and obj.__module__ == mod.__name__:
+            for mname, mobj in vars(obj).items():
+                fn = mobj
+                if isinstance(fn, (staticmethod, classmethod)):
+                    fn = fn.__func__
+                if isinstance(fn, types.FunctionType):
+                    out[f"{name}.{mname}"] = fn
+    return out
+
+
+def check_module(module_path: str, aliases: tuple, functions: dict,
+                 mod=None, extra_attrs: tuple = ()) -> list:
+    """Check one module against its registry entry.  ``mod`` may be a
+    pre-built module object (fixture tests)."""
+    if mod is None:
+        mod = importlib.import_module(module_path)
+    relfile = module_path.replace(".", "/") + ".py"
+    findings = []
+    for qual, fn in sorted(_functions_of(mod).items()):
+        code = fn.__code__
+        touched = [a for a in aliases
+                   if any(a in co.co_names for co in _iter_codes(code))]
+        if not touched:
+            continue
+        mode = functions.get(qual)
+        if mode is None:
+            findings.append(Finding(
+                pass_id="hotpath", rule="unregistered-gate-site",
+                ident=f"hotpath:unregistered:{module_path}:{qual}",
+                file=relfile, line=code.co_firstlineno,
+                message=f"{qual} touches {'/'.join(touched)} but is not "
+                        f"in hotpath_registry.HOT_GATES — register it "
+                        f"(gate/use/cold) so the disabled-path contract "
+                        f"is explicit"))
+            continue
+        if mode == "cold":
+            continue
+        for alias in touched:
+            for prob in _check_code(code, alias, mode, extra_attrs):
+                findings.append(Finding(
+                    pass_id="hotpath", rule="fat-disabled-path",
+                    ident=f"hotpath:gate:{module_path}:{qual}:{alias}",
+                    file=relfile, line=code.co_firstlineno,
+                    message=f"{qual}: {prob}"))
+    # registry entries that no longer exist are drift too
+    have = set(_functions_of(mod))
+    for qual in functions:
+        if qual not in have:
+            findings.append(Finding(
+                pass_id="hotpath", rule="stale-registry-entry",
+                ident=f"hotpath:stale:{module_path}:{qual}",
+                file=relfile, line=0,
+                message=f"hotpath_registry lists {qual} but the module "
+                        f"no longer defines it"))
+    return findings
+
+
+def run(registry: Optional[dict] = None) -> list:
+    registry = registry if registry is not None else HOT_GATES
+    findings = []
+    for module_path, entry in sorted(registry.items()):
+        findings += check_module(
+            module_path, tuple(entry["aliases"]),
+            dict(entry["functions"]),
+            extra_attrs=tuple(entry.get("extra_attrs", ())))
+    return findings

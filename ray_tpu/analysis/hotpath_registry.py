@@ -1,0 +1,95 @@
+"""Registry of disabled-by-default hook sites and their gate contract.
+
+The flight recorder (``_fr``) and the chaos plane (``_fi``) both
+promise ZERO overhead when disarmed: every hot-path touch is one
+module-global load plus an ``is None`` branch, and nothing else runs on
+the disabled path (the committed perf artifacts are the acceptance gate
+for that promise).  ``hotpath_pass`` verifies the promise at the
+BYTECODE level for every function listed here — and flags any function
+in these modules that touches a hook alias *without* being registered,
+so a new hook site can't quietly skip the contract.
+
+Modes:
+
+  * ``gate`` — hot path.  Full contract: the alias may only ever be
+    dereferenced as ``<alias>._active``, and the function must contain
+    an ``is None`` / ``is not None`` test of it (directly or through a
+    local: ``rec = _fr._active`` ... ``if rec is None``) with nothing
+    between the attribute load and the test.
+  * ``use``  — helper only ever called from behind a caller's gate
+    (e.g. ``protocol._chaos_filter``).  The alias must still only be
+    dereferenced as ``._active``, but no gate is required locally.
+  * ``cold`` — setup/teardown code (``__init__`` arming the recorder,
+    ``autoinstall_from_env``).  Exempt from the contract, but must be
+    listed so the exemption is explicit and reviewed.
+"""
+
+from __future__ import annotations
+
+# module import path -> (aliases checked, {qualname: mode})
+HOT_GATES: dict = {
+    "ray_tpu.core.service": {
+        "aliases": ("_fi",),
+        "functions": {
+            "EventLoopService.run": "gate",          # per-tick chaos hook
+            "EventLoopService._dispatch": "gate",    # per-message hook
+        },
+    },
+    "ray_tpu.core.protocol": {
+        "aliases": ("_fi",),
+        # the chaos delay call sits inside the armed branch — it never
+        # executes disabled, so the registry allows the deref by name
+        "extra_attrs": ("apply_delay",),
+        "functions": {
+            "Connection.send": "gate",
+            "Connection.send_blob": "gate",
+            "Connection.send_batch": "gate",
+            "Connection.recv": "gate",
+            "_chaos_filter": "use",
+        },
+    },
+    "ray_tpu.core.local_lane": {
+        "aliases": ("_fi",),
+        "extra_attrs": ("apply_delay",),
+        "functions": {
+            "LaneConnection._post": "gate",
+            "LaneConnection._deliver": "gate",
+        },
+    },
+    "ray_tpu.core.node": {
+        "aliases": ("_fi", "_fr"),
+        "functions": {
+            # flight-recorder lifecycle stamps (hot: every task)
+            "NodeService._admit_task": "gate",
+            "NodeService._forward_task": "gate",
+            "NodeService._make_runnable": "gate",
+            "NodeService._h_task_done": "gate",
+            "NodeService._dispatch_task": "gate",    # also _fi dispatch kill
+            "NodeService._h_submit_actor_task": "gate",
+            "NodeService._dispatch_actor_queue": "gate",
+            "NodeService._fr_finish": "gate",
+            "NodeService._h_flight_recorder": "gate",
+            # colder paths that still honor the gate shape
+            "NodeService._hh_node_dead": "gate",
+            "NodeService.on_client_drop": "gate",
+            "NodeService._spawn_worker_proc": "gate",  # _fi spawn verdict
+            # arming/teardown — contract-exempt by design
+            "NodeService.__init__": "cold",
+        },
+    },
+    "ray_tpu.core.runtime": {
+        "aliases": ("_fr",),
+        "functions": {
+            "Runtime.submit_task_template": "gate",
+            "Runtime.submit_actor_task": "gate",
+            "Runtime.get": "gate",
+        },
+    },
+    "ray_tpu.core.head": {
+        "aliases": ("_fr",),
+        "functions": {
+            "HeadService._h_cluster_submit": "gate",
+            "HeadService.__init__": "cold",
+        },
+    },
+}

@@ -1,0 +1,233 @@
+"""Pass 4: I/O (or pickling) lexically inside ``with <lock>:`` blocks.
+
+PR 3 had to move span file writes out from under the tracing buffer
+lock — every tracer in the process was serializing behind the disk.
+The same shape (grab a lock, then write to a file/socket or pickle a
+large object while holding it) turns a lock that should bound
+microseconds of mutation into one that bounds milliseconds of I/O, and
+on the control plane it can deadlock outright when the I/O blocks on
+the very loop that needs the lock.
+
+The pass scans ``ray_tpu/core/`` and ``ray_tpu/util/tracing.py`` for
+``with`` statements whose context expression *names a lock* (terminal
+identifier containing "lock", case-insensitive — matching this repo's
+uniform naming) and flags, lexically inside the block body:
+
+  * socket/file write calls by attribute name (``send``, ``sendall``,
+    ``send_batch``, ``send_blob``, ``sendto``, ``write``,
+    ``writelines``, ``flush``)
+  * pickling/encoding: ``pickle``/``cloudpickle``/``json``/``marshal``
+    ``dump[s]``/``load[s]`` through an import alias, and the protocol
+    encoders (``dumps_frame``, ``encode_payload``, ``blob_frame_parts``)
+  * file-system mutation: builtin ``open`` and ``os.write/replace/
+    rename/unlink/fsync/makedirs``
+  * calls to same-file helpers whose bodies directly contain any of the
+    above (one level deep — catches ``_drain_locked()``-style splits)
+
+Deliberate holds (a dedicated wire lock whose *purpose* is serializing
+the write) stay, baselined with a justification — the point is that
+every lock-held write is a decision someone wrote down, not an
+accident.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ray_tpu.analysis.common import (Finding, import_aliases,
+                                     iter_py_files, parse_file, rel,
+                                     repo_root)
+
+DEFAULT_TARGETS = ["ray_tpu/core", "ray_tpu/util/tracing.py"]
+
+_IO_ATTRS = {"send", "sendall", "send_batch", "send_blob", "sendto",
+             "write", "writelines", "flush"}
+_PICKLE_MODULES = {"pickle", "cloudpickle", "json", "marshal"}
+_PICKLE_ATTRS = {"dump", "dumps", "load", "loads"}
+_ENCODER_NAMES = {"dumps_frame", "encode_payload", "blob_frame_parts"}
+_OS_ATTRS = {"write", "replace", "rename", "unlink", "fsync", "makedirs"}
+
+
+def _terminal_name(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _terminal_name(node.func)
+    return None
+
+
+def _is_lock_expr(node) -> bool:
+    name = _terminal_name(node)
+    return name is not None and "lock" in name.lower()
+
+
+def _local_imports(fn_node) -> dict:
+    """Function-local ``import pickle`` style aliases."""
+    out = {}
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+    return out
+
+
+class _FileScan(ast.NodeVisitor):
+    def __init__(self, relfile: str, aliases: dict):
+        self.relfile = relfile
+        self.aliases = aliases
+        self.func_stack: list = []
+        self.lock_stack: list = []        # lock source names
+        self.hits: list = []              # (func, lock, what, line)
+        # first pass fills this: helper name -> direct primitive labels
+        self.helper_io: dict = {}
+
+    # -- structure ----------------------------------------------------
+
+    def _visit_func(self, node) -> None:
+        self.func_stack.append(node.name)
+        merged = dict(self.aliases)
+        merged.update(_local_imports(node))
+        old, self.aliases = self.aliases, merged
+        # a def nested under `with lock:` runs LATER, off-lock (it's a
+        # deferred callback) — its body must not inherit the lock scope
+        saved_locks, self.lock_stack = self.lock_stack, []
+        self.generic_visit(node)
+        self.lock_stack = saved_locks
+        self.aliases = old
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved_locks, self.lock_stack = self.lock_stack, []
+        self.generic_visit(node)
+        self.lock_stack = saved_locks
+
+    def visit_With(self, node: ast.With) -> None:
+        lock_idx = next((i for i, it in enumerate(node.items)
+                         if _is_lock_expr(it.context_expr)), None)
+        if lock_idx is not None:
+            # items BEFORE the lock enter first (lock not yet held);
+            # items AFTER it — `with self._lock, open(p) as f:` — run
+            # while holding it, exactly like the body
+            for it in node.items[:lock_idx]:
+                self.visit(it.context_expr)
+            self.lock_stack.append(
+                ast.unparse(node.items[lock_idx].context_expr))
+            for it in node.items[lock_idx + 1:]:
+                self.visit(it.context_expr)
+            for stmt in node.body:
+                self.visit(stmt)
+            self.lock_stack.pop()
+            return
+        self.generic_visit(node)
+
+    # -- classification -----------------------------------------------
+
+    def _classify(self, node: ast.Call) -> Optional[str]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            target = self.aliases.get(f.id, f.id)
+            if f.id == "open" or target == "open":
+                return "open()"
+            if f.id in _ENCODER_NAMES or target.rsplit(".", 1)[-1] \
+                    in _ENCODER_NAMES:
+                return f"{f.id}() (pickles the message)"
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        attr = f.attr
+        if isinstance(f.value, ast.Name):
+            mod = self.aliases.get(f.value.id, "").split(".")[0]
+            if mod in _PICKLE_MODULES and attr in _PICKLE_ATTRS:
+                return f"{mod}.{attr}"
+            if mod == "os" and attr in _OS_ATTRS:
+                return f"os.{attr}"
+            if mod in _PICKLE_MODULES or mod == "os":
+                return None   # other calls on these modules: not I/O
+        if attr in _IO_ATTRS:
+            return f".{attr}()"
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.lock_stack:
+            what = self._classify(node)
+            if what is None:
+                # one-level helper expansion: same-file function whose
+                # body does direct I/O
+                name = None
+                f = node.func
+                if isinstance(f, ast.Name):
+                    name = f.id
+                elif isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self":
+                    name = f.attr
+                if name in self.helper_io:
+                    what = (f"{name}() (does "
+                            f"{', '.join(self.helper_io[name])})")
+            if what is not None:
+                func = self.func_stack[-1] if self.func_stack \
+                    else "<module>"
+                self.hits.append((func, self.lock_stack[-1], what,
+                                  node.lineno))
+        self.generic_visit(node)
+
+
+def _collect_helper_io(tree, relfile: str, aliases: dict) -> dict:
+    """Map function name -> labels of direct I/O primitives in its body
+    (ignoring lock context — used for the one-level expansion).  Walks
+    with its own visitor; only the classifier is borrowed."""
+    scan = _FileScan(relfile, aliases)
+    out: dict = {}
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = []
+
+        def _fn(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = _fn
+        visit_AsyncFunctionDef = _fn
+
+        def visit_Call(self, node):
+            if self.stack:
+                what = scan._classify(node)
+                if what is not None:
+                    out.setdefault(self.stack[-1], [])
+                    if what not in out[self.stack[-1]]:
+                        out[self.stack[-1]].append(what)
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+def run(root: Optional[str] = None,
+        targets: Optional[list] = None) -> list:
+    root = root or repo_root()
+    findings = []
+    for path in iter_py_files(root, targets or DEFAULT_TARGETS):
+        tree = parse_file(path)
+        if tree is None:
+            continue
+        relfile = rel(path, root)
+        aliases = import_aliases(tree)
+        scan = _FileScan(relfile, aliases)
+        scan.helper_io = _collect_helper_io(tree, relfile, aliases)
+        scan.visit(tree)
+        for func, lock, what, line in scan.hits:
+            findings.append(Finding(
+                pass_id="locks", rule="io-under-lock",
+                ident=f"locks:{relfile}:{func}:{what.split(' ')[0]}",
+                file=relfile, line=line,
+                message=f"{func} calls {what} while holding {lock}"))
+    findings.sort(key=lambda f: (f.file, f.line))
+    return findings

@@ -1,0 +1,213 @@
+"""Pass 1: protocol consistency.
+
+The control plane dispatches by name — ``getattr(self, "_h_" + msg["t"],
+None)`` in ``core/service.py`` and ``getattr(self, "_hh_" + m["t"],
+None)`` for head pushes in ``core/node.py`` — so a renamed handler or a
+typo'd message type fails *silently*: the message is dropped (or dies
+with "unknown message" only when the sender asked for a reply).  PR 2's
+split-brain hid behind exactly this kind of drift.
+
+This pass cross-references, across the whole package:
+
+  * **sent types** — every literal ``{"t": "<type>", ...}`` dict and
+    every ``x["t"] = "<type>"`` assignment (messages are always built as
+    literals at the send site; forwarding reuses an existing dict and
+    introduces no new types), and
+  * **handled types** — every ``_h_<type>`` / ``_hh_<type>`` method
+    (server side: service.py's ClusterStoreMixin + EventLoopService,
+    head.py, node.py) and every string the code compares against a
+    message's ``"t"`` field (client side: client.py reply routing,
+    executor.py's run loop, observer.py's reply matching, node.py's
+    peer dispatch), including comparisons through a local alias
+    (``t = msg.get("t")`` ... ``t == "execute"``).
+
+and reports types sent with no handler anywhere, and ``_h_*``/``_hh_*``
+handlers no code path sends (dead handlers — usually a removed feature
+or a test-only RPC; the latter gets baselined with a justification).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.analysis.common import (Finding, iter_py_files, parse_file,
+                                     rel, repo_root)
+
+HANDLER_PREFIXES = ("_h_", "_hh_")
+
+# Scan scope for SEND sites and client-side dispatch comparisons: the
+# whole package (the CLI, dashboard, and util helpers all speak the
+# protocol).  ``_h_*``/``_hh_*`` HANDLER DEFINITIONS are only collected
+# from the protocol services under core/ — elsewhere the prefix is just
+# a naming coincidence (rllib's value-rescaling ``_h_inv`` is math, not
+# a message handler).
+DEFAULT_SUBDIRS = ["ray_tpu"]
+HANDLER_DEF_PREFIX = "ray_tpu/core/"
+
+# Files whose ``t == "..."`` comparisons are CODEC dispatch (choosing a
+# wire encoding arm), not message consumption — counting them as
+# handlers would mask a genuinely dropped handler behind the encoder.
+MATCH_EXCLUDE = ("ray_tpu/core/schema.py",)
+
+
+@dataclass
+class ProtocolReport:
+    """Raw cross-reference tables, exposed for tests and tooling."""
+
+    sends: dict = field(default_factory=dict)      # type -> [(file, line)]
+    handlers: dict = field(default_factory=dict)   # type -> [(file, line, how)]
+    unhandled: list = field(default_factory=list)  # sorted types
+    dead: list = field(default_factory=list)       # [(type, file, line)]
+
+    def handler_files(self) -> set:
+        return {f for locs in self.handlers.values() for (f, _, _) in locs}
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_t_lookup(node) -> bool:
+    """``<expr>.get("t")`` / ``<expr>.get("t", default)`` or
+    ``<expr>["t"]``."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "get" and node.args:
+        return _const_str(node.args[0]) == "t"
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        return _const_str(sl) == "t"
+    return False
+
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self, relfile: str, report: ProtocolReport,
+                 collect_defs: bool = True, collect_matches: bool = True):
+        self.relfile = relfile
+        self.report = report
+        self.collect_defs = collect_defs
+        self.collect_matches = collect_matches
+        self._tvars: list[set] = []   # per-function: names aliasing msg["t"]
+
+    # -- send sites ---------------------------------------------------
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        for k, v in zip(node.keys, node.values):
+            if k is not None and _const_str(k) == "t":
+                t = _const_str(v)
+                if t is not None:
+                    self.report.sends.setdefault(t, []).append(
+                        (self.relfile, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript) \
+                    and _const_str(tgt.slice) == "t":
+                t = _const_str(node.value)
+                if t is not None:
+                    self.report.sends.setdefault(t, []).append(
+                        (self.relfile, node.lineno))
+            # t = msg.get("t") — remember the alias for comparisons
+            if self._tvars and isinstance(tgt, ast.Name) \
+                    and _is_t_lookup(node.value):
+                self._tvars[-1].add(tgt.id)
+        self.generic_visit(node)
+
+    # -- handler sites ------------------------------------------------
+
+    def _visit_func(self, node) -> None:
+        for prefix in HANDLER_PREFIXES if self.collect_defs else ():
+            if node.name.startswith(prefix):
+                t = node.name[len(prefix):]
+                self.report.handlers.setdefault(t, []).append(
+                    (self.relfile, node.lineno, "def " + node.name))
+        self._tvars.append(set())
+        self.generic_visit(node)
+        self._tvars.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _is_t_ref(self, node) -> bool:
+        if _is_t_lookup(node):
+            return True
+        return (isinstance(node, ast.Name) and self._tvars
+                and node.id in self._tvars[-1])
+
+    def _note_handled(self, node, lineno: int) -> None:
+        t = _const_str(node)
+        if t is not None:
+            self.report.handlers.setdefault(t, []).append(
+                (self.relfile, lineno, "match"))
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for el in node.elts:
+                self._note_handled(el, lineno)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if self.collect_matches and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.Eq, ast.In)):
+            left, right = node.left, node.comparators[0]
+            if self._is_t_ref(left):
+                self._note_handled(right, node.lineno)
+            elif self._is_t_ref(right):
+                self._note_handled(left, node.lineno)
+        self.generic_visit(node)
+
+
+def collect(root: Optional[str] = None,
+            subdirs: Optional[list] = None,
+            handler_def_prefix: Optional[str] = None) -> ProtocolReport:
+    """Build the send/handler cross-reference for the tree at ``root``.
+
+    ``handler_def_prefix`` limits where ``def _h_*`` counts as a handler
+    ("" = everywhere, for fixture trees)."""
+    root = root or repo_root()
+    if handler_def_prefix is None:
+        handler_def_prefix = HANDLER_DEF_PREFIX
+    report = ProtocolReport()
+    for path in iter_py_files(root, subdirs or DEFAULT_SUBDIRS):
+        tree = parse_file(path)
+        if tree is None:
+            continue
+        relfile = rel(path, root)
+        _Collector(relfile, report,
+                   collect_defs=relfile.startswith(handler_def_prefix),
+                   collect_matches=relfile not in MATCH_EXCLUDE
+                   ).visit(tree)
+    report.unhandled = sorted(t for t in report.sends
+                              if t not in report.handlers)
+    report.dead = sorted(
+        (t, f, ln)
+        for t, locs in report.handlers.items() if t not in report.sends
+        for (f, ln, how) in locs if how.startswith("def "))
+    return report
+
+
+def run(root: Optional[str] = None,
+        subdirs: Optional[list] = None,
+        handler_def_prefix: Optional[str] = None) -> list:
+    report = collect(root, subdirs,
+                     handler_def_prefix=handler_def_prefix)
+    findings = []
+    for t in report.unhandled:
+        f, ln = report.sends[t][0]
+        n = len(report.sends[t])
+        findings.append(Finding(
+            pass_id="protocol", rule="unhandled-message-type",
+            ident=f"protocol:unhandled:{t}",
+            file=f, line=ln,
+            message=f'message type "{t}" is sent ({n} site'
+                    f'{"s" if n > 1 else ""}) but no _h_/_hh_ handler or '
+                    f'client-side dispatch matches it'))
+    for t, f, ln in report.dead:
+        findings.append(Finding(
+            pass_id="protocol", rule="dead-handler",
+            ident=f"protocol:dead-handler:{t}:{f}",
+            file=f, line=ln,
+            message=f'handler for "{t}" defined here but nothing in the '
+                    f'package sends that message type'))
+    return findings

@@ -174,5 +174,23 @@ def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
                        meta["class_name"])
 
 
+def list_named_actors(all_namespaces: bool = False,
+                      namespace: str | None = None) -> list:
+    """Names of live named actors (reference: ray.util.list_named_actors).
+    Default scope is this driver's namespace; ``all_namespaces=True``
+    returns ``{"namespace", "name"}`` dicts across all of them."""
+    if all_namespaces and namespace is not None:
+        raise ValueError("namespace= conflicts with all_namespaces=True "
+                         "(the scan already spans every namespace)")
+    rt = get_runtime()
+    reply = rt.client.request({"t": "list_named_actors",
+                               "namespace": namespace or rt.namespace,
+                               "all_namespaces": all_namespaces})
+    actors = reply["actors"]
+    if all_namespaces:
+        return actors
+    return [a["name"] for a in actors]
+
+
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     get_runtime().kill_actor(actor.actor_id, no_restart=no_restart)

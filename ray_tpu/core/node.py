@@ -3630,7 +3630,7 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _h_flight_recorder(self, rec, m):
         """Observer query: completed lifecycle records + chaos events +
         the per-stage summary (the `ray_tpu timeline` source)."""
-        fr = _fr.active()
+        fr = _fr._active
         if fr is None:
             self._reply(rec, m["reqid"], enabled=False, records=[],
                         faults=[], stages={})

@@ -497,6 +497,9 @@ def main(argv=None) -> int:
                    help="node address to never kill (repeatable)")
     p.set_defaults(fn=cmd_kill_random_node)
 
+    from ray_tpu.analysis.cli import add_parser as _add_lint
+    _add_lint(sub)
+
     p = sub.add_parser("dashboard", help="serve the web dashboard")
     p.add_argument("--address", required=True)
     p.add_argument("--port", type=int, default=8265)

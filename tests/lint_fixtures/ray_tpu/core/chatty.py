@@ -1,0 +1,30 @@
+"""Protocol-pass fixture: one unhandled send, one dead handler, plus a
+handled pair in each style (handler def + client-side comparison).
+Never imported — the analyzer reads it as text."""
+
+
+class Chat:
+    def _h_used(self, rec, m):            # handled: send below
+        rec.reply(m)
+
+    def _h_never_sent(self, rec, m):      # DEAD: nothing sends "never_sent"
+        pass
+
+    def send_stuff(self, conn):
+        conn.send({"t": "used"})
+        conn.send({"t": "orphan_ping"})   # UNHANDLED: no _h_/comparison
+
+    def route(self, msg):
+        t = msg.get("t")
+        if t == "pushy":                  # client-side dispatch, via alias
+            return True
+        if msg.get("t") in ("stoppy", "droppy"):   # membership form
+            return False
+
+    def push(self, conn):
+        conn.send({"t": "pushy"})
+        conn.send({"t": "stoppy"})
+        conn.send({"t": "droppy"})
+
+    def tag(self, out):
+        out["t"] = "used"                 # subscript-assign send form

@@ -1,0 +1,44 @@
+"""Locks-pass fixture: pickling and sends under a lock, an I/O helper
+called under a lock (one-level expansion), and a clean shape that must
+NOT be flagged.  Never imported — the analyzer reads it as text."""
+
+import pickle
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.buf = None
+
+    def bad_pickle(self, obj):
+        with self._lock:
+            return pickle.dumps(obj)         # flagged
+
+    def bad_send(self, conn, msg):
+        with self._lock:
+            conn.send(msg)                   # flagged
+
+    def bad_helper(self):
+        with self._lock:
+            self._write_it()                 # flagged via helper body
+
+    def _write_it(self):
+        with open("/tmp/x", "w") as f:
+            f.write("x")
+
+    def good(self, obj):
+        data = pickle.dumps(obj)             # ok: outside the lock
+        with self._lock:
+            self.buf = data
+
+    def bad_item_open(self, line):
+        with self._lock, open("/tmp/y", "a") as f:   # flagged: open
+            f.write(line)                            # (and the write)
+
+    def good_deferred(self, conn, cbs):
+        with self._lock:
+            def later():                     # ok: runs AFTER the lock
+                conn.send(self.buf)
+
+            cbs.append(later)

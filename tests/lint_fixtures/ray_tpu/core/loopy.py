@@ -1,0 +1,42 @@
+"""Blocking-pass fixture: a sleep reached through a helper, a bare
+waitpid, a subprocess.run in the tick, a WNOHANG waitpid that must NOT
+be flagged, and a Thread-target closure that must NOT be flagged.
+Never imported — the analyzer reads it as text."""
+
+import os
+import subprocess
+import threading
+import time
+from time import sleep
+
+
+class Svc:
+    def _h_sleepy(self, rec, m):
+        self._drain()
+
+    def _h_bare_import_sleep(self, rec, m):
+        sleep(0.1)                           # flagged: from-import form
+
+    def _h_waits_forever(self, rec, m):
+        m["proc"].wait()                     # flagged: no timeout
+
+    def _h_bounded_wait(self, rec, m):
+        m["proc"].wait(timeout=2.0)          # ok: bounded
+
+    def _drain(self):
+        time.sleep(0.5)                      # flagged (via _h_sleepy)
+
+    def _h_reaper(self, rec, m):
+        os.waitpid(-1, 0)                    # flagged: no WNOHANG
+
+    def _h_fine(self, rec, m):
+        os.waitpid(-1, os.WNOHANG)           # ok
+
+    def on_tick(self):
+        subprocess.run(["true"])             # flagged
+
+    def _h_threaded(self, rec, m):
+        def work():
+            time.sleep(9.0)                  # ok: runs on its own thread
+
+        threading.Thread(target=work, daemon=True).start()

@@ -71,6 +71,21 @@ def test_named_actor(rt):
     assert rt.get(h.value.remote(), timeout=60) == 7
 
 
+def test_list_named_actors(rt):
+    """The `list_named_actors` RPC existed on the head AND node since
+    the named-actor PR but nothing ever sent it — `ray_tpu lint`'s
+    protocol pass surfaced the dead handlers, and this public API
+    (reference: ray.util.list_named_actors) is the fix."""
+    h = Counter.options(name="lna_cnt").remote(1)
+    rt.get(h.value.remote(), timeout=60)
+    names = ray_tpu.list_named_actors()
+    assert "lna_cnt" in names
+    full = ray_tpu.list_named_actors(all_namespaces=True)
+    assert {"namespace": "default", "name": "lna_cnt"} in full
+    with pytest.raises(ValueError, match="conflicts"):
+        ray_tpu.list_named_actors(all_namespaces=True, namespace="x")
+
+
 def test_named_actor_duplicate_raises(rt):
     Counter.options(name="dup_cnt").remote(0)
     with pytest.raises(Exception, match="already taken"):

@@ -1,0 +1,412 @@
+"""Unit tests for the control-plane invariant analyzer
+(ray_tpu/analysis/): each pass against a fixture tree carrying one
+deliberate violation per rule, the bytecode gate checker against
+synthetic modules, and — the acceptance case — the protocol pass
+cross-referencing the REAL service/head/node/observer modules by
+dropping one handler from a copy of each and watching the report."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import types
+
+import pytest
+
+from ray_tpu import analysis
+from ray_tpu.analysis import (baseline, blocking_pass, hotpath_pass,
+                              locks_pass, protocol_pass)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+def _fixture_line(fname: str, needle: str) -> int:
+    """1-based line of ``needle`` in a fixture file — findings must
+    point at the violation itself, not just the file."""
+    path = os.path.join(FIXTURES, "ray_tpu", "core", fname)
+    for i, line in enumerate(open(path), 1):
+        if needle in line:
+            return i
+    raise AssertionError(f"{needle!r} not in {fname}")
+
+
+# -- pass 1: protocol consistency (fixture tree) ----------------------------
+
+def test_protocol_pass_reports_unhandled_and_dead():
+    report = protocol_pass.collect(FIXTURES)
+    assert "orphan_ping" in report.unhandled
+    assert "used" not in report.unhandled        # handler def matches
+    assert "pushy" not in report.unhandled       # aliased comparison
+    assert "stoppy" not in report.unhandled      # membership comparison
+    assert any(t == "never_sent" for t, _, _ in report.dead)
+    assert not any(t == "used" for t, _, _ in report.dead)
+
+    findings = protocol_pass.run(FIXTURES)
+    orphan = [f for f in findings if "orphan_ping" in f.ident]
+    assert orphan and orphan[0].file == "ray_tpu/core/chatty.py" \
+        and orphan[0].line > 0
+    dead = [f for f in findings if f.rule == "dead-handler"]
+    assert any("never_sent" in f.ident for f in dead)
+
+
+# -- pass 1 on the real tree: drops one handler per protocol class ----------
+
+def _copy_package(tmp_path):
+    src = os.path.join(analysis.repo_root(), "ray_tpu")
+    dst = tmp_path / "ray_tpu"
+    shutil.copytree(src, dst,
+                    ignore=shutil.ignore_patterns("__pycache__",
+                                                  "*.pyc", "generated"))
+    return tmp_path
+
+
+def _edit(root, relfile, old, new):
+    p = os.path.join(root, relfile)
+    text = open(p).read()
+    assert old in text, (relfile, old)
+    open(p, "w").write(text.replace(old, new))
+
+
+# one handler dropped from each of service/head/node, plus a synthetic
+# handler ADDED to observer.py (it defines none today) — all applied to
+# one shared package copy, so the tree is copied and re-scanned once
+_DROPS = [
+    ("ray_tpu/core/service.py", "_h_publish", "publish"),
+    ("ray_tpu/core/head.py", "_h_heartbeat", "heartbeat"),
+    ("ray_tpu/core/node.py", "_h_task_done", "task_done"),
+]
+
+
+@pytest.fixture(scope="module")
+def mutated_report(tmp_path_factory):
+    root = str(_copy_package(tmp_path_factory.mktemp("lintpkg")))
+    for relfile, handler, _ in _DROPS:
+        _edit(root, relfile, f"def {handler}(", f"def _x{handler}(")
+    with open(os.path.join(root, "ray_tpu/core/observer.py"), "a") as f:
+        f.write("\n\ndef _h_obs_only(rec, m):\n    pass\n")
+    return protocol_pass.collect(root)
+
+
+@pytest.fixture(scope="module")
+def real_report():
+    return protocol_pass.collect()          # the real, unmutated tree
+
+
+@pytest.mark.parametrize("relfile,handler,msg_type", _DROPS)
+def test_dropping_a_real_handler_is_reported(real_report, mutated_report,
+                                             relfile, handler, msg_type):
+    """The cross-reference really spans the live protocol classes:
+    delete ONE handler from a copy of the package and the type it
+    served turns up unhandled."""
+    assert msg_type not in real_report.unhandled
+    assert msg_type in mutated_report.unhandled, \
+        f"dropping {relfile}:{handler} not detected"
+
+
+def test_observer_module_is_cross_referenced(real_report, mutated_report):
+    """observer.py participates on both sides: its reply-matching
+    comparison registers as client-side handling, and a handler added
+    there is scanned like the other three modules (dead → reported)."""
+    report = real_report
+    assert any(f == "ray_tpu/core/observer.py"
+               for f, _, _ in report.handlers.get("reply", []))
+    # the four protocol modules all contribute handler-side entries
+    files = report.handler_files()
+    for mod in ("ray_tpu/core/service.py", "ray_tpu/core/head.py",
+                "ray_tpu/core/node.py", "ray_tpu/core/observer.py"):
+        assert mod in files, mod
+    assert any(t == "obs_only" and f == "ray_tpu/core/observer.py"
+               for t, f, _ in mutated_report.dead)
+
+
+# -- pass 2: event-loop blocking --------------------------------------------
+
+def test_blocking_pass_fixture_violations():
+    findings = blocking_pass.run(FIXTURES)
+    by_ident = {f.ident: f for f in findings}
+
+    sleepy = by_ident.get("blocking:ray_tpu/core/loopy.py:Svc._drain"
+                          ":time.sleep")
+    assert sleepy is not None, sorted(by_ident)
+    assert "_h_sleepy" in sleepy.message      # the chain names the root
+    assert sleepy.line == _fixture_line("loopy.py", "time.sleep(0.5)")
+
+    assert any("Svc._h_reaper:os.waitpid" in i for i in by_ident)
+    assert any("Svc.on_tick:subprocess.run" in i for i in by_ident)
+    # evasion shapes the review caught: bare from-import sleep and an
+    # argless (indefinite) .wait()
+    assert any("Svc._h_bare_import_sleep:time.sleep" in i
+               for i in by_ident)
+    assert any("Svc._h_waits_forever:.wait()" in i for i in by_ident)
+    # WNOHANG reap, a bounded wait, and the Thread-target closure stay
+    # clean
+    assert not any("_h_fine" in i for i in by_ident)
+    assert not any("_h_bounded_wait" in i for i in by_ident)
+    assert not any("_h_threaded" in i for i in by_ident)
+
+
+def test_blocking_pass_resolves_real_chaos_delay_chain():
+    """The shape the pass exists for: a handler push delivering onto an
+    in-process lane can hit the chaos delay (a deliberate sleep) — the
+    chain through _push -> _deliver -> apply_delay must keep resolving,
+    or the pass has gone blind to the loop's real call graph."""
+    findings = blocking_pass.run()
+    hits = [f for f in findings
+            if f.ident == "blocking:ray_tpu/core/fault_injection.py"
+                          ":apply_delay:time.sleep"]
+    assert hits, [f.ident for f in findings]
+    assert "_deliver" in hits[0].message
+
+
+# -- pass 3: hot-path gate (bytecode) ---------------------------------------
+
+def _module_from(src: str) -> types.ModuleType:
+    mod = types.ModuleType("lint_fix_mod")
+    mod._fr = types.SimpleNamespace(_active=None, active=lambda: None)
+    exec(compile(src, "<lint-fixture>", "exec"), mod.__dict__)
+    return mod
+
+
+GOOD_GATE = """
+def hook(spec):
+    if _fr._active is not None:
+        _fr._active.stamp(spec, "x")
+"""
+
+STORE_GATE = """
+def hook(spec):
+    rec = _fr._active
+    if rec is None:
+        return
+    rec.stamp(spec, "x")
+"""
+
+FAT_GATE = """
+def hook(spec):
+    if _fr.active() is not None:
+        _fr._active.stamp(spec, "x")
+"""
+
+UNGATED = """
+def hook(spec):
+    _fr._active.stamp(spec, "x")
+"""
+
+# one gated touch must not launder a second, ungated one (this exact
+# shape crashes on every dispatch the moment the hook is disarmed)
+LAUNDERED = """
+def hook(spec):
+    if _fr._active is not None:
+        _fr._active.stamp(spec, "x")
+    _fr._active.stamp(spec, "y")
+"""
+
+# an unrelated local's None-test must not open an "armed" region for
+# the hook (the guard proves nothing about _fr._active)
+UNRELATED_GUARD = """
+def hook(spec):
+    if _fr._active is not None:
+        _fr._active.stamp(spec, "x")
+    if spec is not None:
+        _fr._active.stamp(spec, "y")
+"""
+
+# laundering through a bound local: the None test guards only its own
+# branch; the trailing use still crashes disabled
+LAUNDERED_LOCAL = """
+def hook(spec):
+    rec = _fr._active
+    if rec is not None:
+        rec.stamp(spec, "x")
+    rec.stamp(spec, "y")
+"""
+
+EARLY_RETURN = """
+def hook(spec):
+    if _fr._active is None:
+        return spec
+    rec = _fr._active
+    rec.stamp(spec, "x")
+"""
+
+UNTESTED_BIND = """
+def hook(spec):
+    rec = _fr._active
+    rec.stamp(spec, "x")
+"""
+
+
+def test_hotpath_gate_shapes():
+    for src in (GOOD_GATE, STORE_GATE, EARLY_RETURN):
+        f = hotpath_pass.check_module("fix.mod", ("_fr",),
+                                      {"hook": "gate"},
+                                      mod=_module_from(src))
+        assert f == [], (src, [x.render() for x in f])
+    fat = hotpath_pass.check_module("fix.mod", ("_fr",), {"hook": "gate"},
+                                    mod=_module_from(FAT_GATE))
+    assert any(f.rule == "fat-disabled-path" and "active" in f.message
+               for f in fat)
+    ungated = hotpath_pass.check_module("fix.mod", ("_fr",),
+                                        {"hook": "gate"},
+                                        mod=_module_from(UNGATED))
+    assert any("guarded branch" in f.message for f in ungated)
+
+
+def test_hotpath_gate_is_per_site():
+    """Review-caught shapes: a gated touch elsewhere in the function
+    must not excuse an ungated one, and a local bound to ``_active``
+    without any None test is a disabled-path crash."""
+    laundered = hotpath_pass.check_module(
+        "fix.mod", ("_fr",), {"hook": "gate"},
+        mod=_module_from(LAUNDERED))
+    assert any("outside any" in f.message for f in laundered), \
+        [f.render() for f in laundered]
+    via_local = hotpath_pass.check_module(
+        "fix.mod", ("_fr",), {"hook": "gate"},
+        mod=_module_from(LAUNDERED_LOCAL))
+    assert any("outside any" in f.message for f in via_local), \
+        [f.render() for f in via_local]
+    bind = hotpath_pass.check_module(
+        "fix.mod", ("_fr",), {"hook": "gate"},
+        mod=_module_from(UNTESTED_BIND))
+    assert any("never None-tests" in f.message for f in bind)
+    # an unrelated guard must not count as the hook's gate
+    unrelated = hotpath_pass.check_module(
+        "fix.mod", ("_fr",), {"hook": "gate"},
+        mod=_module_from(UNRELATED_GUARD))
+    assert any("outside any" in f.message for f in unrelated), \
+        [f.render() for f in unrelated]
+    # "use" helpers run behind their caller's gate: the bind is legal
+    used = hotpath_pass.check_module(
+        "fix.mod", ("_fr",), {"hook": "use"},
+        mod=_module_from(UNTESTED_BIND))
+    assert used == [], [f.render() for f in used]
+
+
+def test_hotpath_unregistered_and_stale_entries():
+    mod = _module_from(GOOD_GATE)
+    unreg = hotpath_pass.check_module("fix.mod", ("_fr",), {}, mod=mod)
+    assert any(f.rule == "unregistered-gate-site" for f in unreg)
+    stale = hotpath_pass.check_module("fix.mod", ("_fr",),
+                                      {"hook": "gate", "gone": "gate"},
+                                      mod=mod)
+    assert any(f.rule == "stale-registry-entry" and "gone" in f.ident
+               for f in stale)
+
+
+# -- pass 4: lock-held I/O --------------------------------------------------
+
+def test_locks_pass_fixture_violations():
+    findings = locks_pass.run(FIXTURES, targets=["ray_tpu/core"])
+    idents = {f.ident: f for f in findings}
+    pick = idents.get("locks:ray_tpu/core/locky.py:bad_pickle"
+                      ":pickle.dumps")
+    assert pick is not None, sorted(idents)
+    assert pick.line == _fixture_line("locky.py",
+                                      "return pickle.dumps(obj)")
+    assert any("bad_send:.send()" in i for i in idents)
+    helper = [f for f in findings if "bad_helper" in f.ident]
+    assert helper and "_write_it" in helper[0].message
+    # a with-ITEM after the lock runs while holding it
+    assert any("bad_item_open:open" in i for i in idents), sorted(idents)
+    # clean shapes: I/O outside the lock, and a deferred callback DEF'D
+    # under the lock but run later
+    assert not any("good" in i.split(":")[2] for i in idents)
+    assert not any("later" in i.split(":")[2] for i in idents)
+
+
+# -- baseline + CLI ---------------------------------------------------------
+
+def test_baseline_requires_justification(tmp_path):
+    p = tmp_path / "bl.json"
+    p.write_text('{"findings": [{"id": "x:y", "justification": ""}]}')
+    with pytest.raises(ValueError):
+        baseline.load(str(p))
+    # a --write-baseline skeleton committed unchanged must fail too
+    p.write_text('{"findings": [{"id": "x:y", '
+                 '"justification": "TODO: justify or fix"}]}')
+    with pytest.raises(ValueError, match="TODO"):
+        baseline.load(str(p))
+
+
+def test_baseline_apply_partitions():
+    f = analysis.Finding("locks", "io-under-lock", "locks:a:b:c",
+                         "a.py", 3, "m")
+    active, suppressed, stale = baseline.apply(
+        [f], {"locks:a:b:c": "why", "locks:gone:x:y": "old"})
+    assert active == [] and suppressed == [f]
+    assert stale == ["locks:gone:x:y"]
+
+
+def test_cli_pass_subset_keeps_other_passes_baseline(capsys):
+    """Review-caught: `--passes protocol --baseline ...` must not call
+    the other passes' suppressions stale (the printed advice would have
+    the user delete valid entries and break the full run)."""
+    import argparse
+    from ray_tpu.analysis.cli import run_lint
+    args = argparse.Namespace(
+        root=None, passes="protocol", json=False, write_baseline=None,
+        baseline=os.path.join(analysis.repo_root(),
+                              ".lint-baseline.json"))
+    rc = run_lint(args)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 stale" in out and "[baseline/stale]" not in out
+
+
+def test_cli_defaults_to_committed_baseline(capsys):
+    """A bare `ray_tpu lint` on the repo must agree with `make lint`
+    (README documents exit 0 on a clean checkout) — the committed
+    .lint-baseline.json is picked up without --baseline."""
+    import argparse
+    from ray_tpu.analysis.cli import run_lint
+    args = argparse.Namespace(root=None, passes=None, json=False,
+                              write_baseline=None, baseline=None,
+                              no_baseline=False)
+    rc = run_lint(args)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "baselined" in out
+    # and --no-baseline reports the raw findings again
+    args.no_baseline = True
+    rc = run_lint(args)
+    out = capsys.readouterr().out
+    assert rc == 1 and "(0 baselined" in out
+
+
+def test_write_baseline_preserves_justifications(tmp_path):
+    f1 = analysis.Finding("locks", "io-under-lock", "locks:a:b:c",
+                          "a.py", 3, "m")
+    f2 = analysis.Finding("locks", "io-under-lock", "locks:d:e:f",
+                          "d.py", 9, "m2")
+    p = str(tmp_path / "bl.json")
+    baseline.write([f1], p)
+    data = json.loads(open(p).read())
+    data["findings"][0]["justification"] = "reviewed: deliberate"
+    open(p, "w").write(json.dumps(data))
+    baseline.write([f1, f2], p)       # refresh with one new finding
+    by_id = {e["id"]: e["justification"]
+             for e in json.loads(open(p).read())["findings"]}
+    assert by_id["locks:a:b:c"] == "reviewed: deliberate"
+    assert by_id["locks:d:e:f"].startswith("TODO")
+
+
+def test_cli_nonzero_on_fixtures_zero_on_repo():
+    """Acceptance: `ray_tpu lint` exits non-zero on the fixture
+    violations and zero on the repo with the committed baseline."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "lint", "--root", FIXTURES,
+         "--passes", "protocol,blocking,locks"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "orphan_ping" in r.stdout
+
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "lint",
+         "--baseline", os.path.join(analysis.repo_root(),
+                                    ".lint-baseline.json")],
+        capture_output=True, text=True, env=env,
+        cwd=analysis.repo_root(), timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
