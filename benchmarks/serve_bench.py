@@ -1,0 +1,221 @@
+"""Inference serving benchmark → SERVE_r10.json.
+
+The acceptance A/B for the continuous-batching engine: same box, same
+run, same model size —
+
+  * baseline_sequential    — naive one-request-at-a-time serving: an
+    engine with max_slots=1, requests submitted strictly back-to-back
+    (each waits for the previous to finish).  This is what serving looks
+    like without iteration-level scheduling: the decode batch is always
+    width 1.
+  * continuous_batching    — the real engine (max_slots=8), the same
+    request set offered concurrently; admissions interleave with decode
+    so the batch stays full.
+
+Both halves run the SAME compiled decode path and the SAME request mix
+(prompt/max_new per request are seeded identically), so the ratio
+isolates continuous batching itself.  A third section drives the full
+HTTP path (asyncio ingress → replica → engine) at a fixed offered load
+for p50/p99 wall latency.
+
+loadavg is recorded per the box-variance caveat in PERF.md: only the
+in-run A/B ratio is comparable across days, never the absolutes.
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/serve_bench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _pct(xs, p):
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    i = min(len(xs) - 1, max(0, int(round(p / 100 * (len(xs) - 1)))))
+    return xs[i]
+
+
+def make_requests(n, *, seed, vocab, prompt_len, max_new):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        pl = int(rng.integers(prompt_len // 2, prompt_len + 1))
+        out.append((rng.integers(0, vocab, pl).tolist(),
+                    int(rng.integers(max_new // 2, max_new + 1))))
+    return out
+
+
+def run_engine_side(params, cfg, reqs, *, max_slots, concurrent):
+    """Drive one engine over the request set; returns throughput +
+    latency stats.  ``concurrent=False`` = strict one-at-a-time."""
+    from ray_tpu.inference import EngineConfig, InferenceEngine
+    eng = InferenceEngine(params, cfg, EngineConfig(
+        max_slots=max_slots, max_seq=cfg.max_seq))
+    # warm both compiled programs (prefill + step) off the clock
+    eng.generate(reqs[0][0], max_new=2, timeout=300)
+    lat, toks = [], 0
+    t0 = time.perf_counter()
+    if concurrent:
+        handles = [eng.submit(p, max_new=m) for p, m in reqs]
+        for h in handles:
+            out = h.result(timeout=600)
+            lat.append(h.finished_s - h.created_s)
+            toks += len(out)
+    else:
+        for p, m in reqs:
+            h = eng.submit(p, max_new=m)
+            out = h.result(timeout=600)
+            lat.append(h.finished_s - h.created_s)
+            toks += len(out)
+    wall = time.perf_counter() - t0
+    st = eng.stats()
+    eng.shutdown()
+    return {
+        "requests": len(reqs),
+        "wall_s": round(wall, 3),
+        "req_s": round(len(reqs) / wall, 2),
+        "tokens_s": round(toks / wall, 1),
+        "p50_s": round(_pct(lat, 50), 4),
+        "p99_s": round(_pct(lat, 99), 4),
+        "batch_occupancy": round(st["batch_occupancy"], 3),
+        "max_slots": max_slots,
+    }
+
+
+def run_http_side(cfg, reqs, *, max_slots, offered_concurrency):
+    """Fixed offered load through the asyncio HTTP ingress."""
+    import urllib.request
+
+    from ray_tpu import serve
+    from ray_tpu.inference import EngineConfig, build_gpt_deployment
+    serve.run(build_gpt_deployment(
+        cfg=cfg, engine_cfg=EngineConfig(max_slots=max_slots), seed=0),
+        use_actors=False, http=True)
+    addr = serve.proxy_address()
+
+    def post(payload):
+        rq = urllib.request.Request(
+            addr + "/v1/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(rq, timeout=600) as resp:
+            return json.loads(resp.read())
+
+    post({"prompt": reqs[0][0], "max_tokens": 2})   # warm
+    lat, errs, toks = [], [], 0
+    lock = threading.Lock()
+    it = iter(reqs)
+
+    def worker():
+        nonlocal toks
+        while True:
+            with lock:
+                try:
+                    p, m = next(it)
+                except StopIteration:
+                    return
+            t0 = time.perf_counter()
+            try:
+                out = post({"prompt": p, "max_tokens": m})["result"]
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+                    toks += out["n"]
+            except Exception as e:   # noqa: BLE001
+                with lock:
+                    errs.append(str(e))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker)
+               for _ in range(offered_concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    serve.shutdown()
+    return {
+        "requests": len(lat),
+        "errors": len(errs),
+        "offered_concurrency": offered_concurrency,
+        "wall_s": round(wall, 3),
+        "sustained_req_s": round(len(lat) / wall, 2),
+        "tokens_s": round(toks / wall, 1),
+        "p50_s": round(_pct(lat, 50), 4),
+        "p99_s": round(_pct(lat, 99), 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default="SERVE_r10.json")
+    ap.add_argument("--requests", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt
+
+    cfg = gpt.GPTConfig(vocab_size=512, max_seq=128, d_model=128,
+                        n_heads=4, n_layers=4, d_ff=512, remat=False,
+                        dtype=jnp.float32)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    n_req = args.requests or (8 if args.quick else 32)
+    reqs = make_requests(n_req, seed=7, vocab=cfg.vocab_size,
+                         prompt_len=16, max_new=24 if args.quick else 32)
+
+    load0 = os.getloadavg()[0]
+    base = run_engine_side(params, cfg, reqs, max_slots=1,
+                           concurrent=False)
+    cont = run_engine_side(params, cfg, reqs, max_slots=8,
+                           concurrent=True)
+    http = run_http_side(cfg, reqs, max_slots=8,
+                         offered_concurrency=8)
+    load1 = os.getloadavg()[0]
+
+    artifact = {
+        "round": 10,
+        "quick": bool(args.quick),
+        "_conditions": {
+            "loadavg_1m_before": round(load0, 2),
+            "loadavg_1m_after": round(load1, 2),
+            "backend": jax.default_backend(),
+            "physical_cores": os.cpu_count(),
+            "note": "same-run A/B; only the ratio is portable across "
+                    "days (PERF.md box-variance caveat)",
+        },
+        "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                  "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
+                  "vocab": cfg.vocab_size, "max_seq": cfg.max_seq,
+                  "dtype": "float32"},
+        "request_mix": {"n": n_req, "prompt_len": "8..16",
+                        "max_new": "12..24" if args.quick else "16..32"},
+        "baseline_sequential": base,
+        "continuous_batching": cont,
+        "ratio_req_s": round(cont["req_s"] / base["req_s"], 2),
+        "ratio_tokens_s": round(cont["tokens_s"] / base["tokens_s"], 2),
+        "http_ingress": http,
+    }
+    out = json.dumps(artifact, indent=1)
+    print(out)
+    with open(args.out, "w") as f:
+        f.write(out + "\n")
+    ok = artifact["ratio_req_s"] >= 2.0
+    print(f"\ncontinuous/sequential req/s ratio: "
+          f"{artifact['ratio_req_s']} ({'PASS' if ok else 'FAIL'} >= 2.0)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""ray_tpu.inference: continuous-batching LLM inference under Serve.
+
+The "millions of users" leg of the north star (ROADMAP item 2): an
+end-to-end inference product over the sharded GPT —
+
+  * decode.py  — KV-cache'd incremental decode: prefill seeds the cache
+                 through the ordinary training forward
+                 (``gpt.forward(return_kv=True)``), a compiled-once
+                 fixed-width step decodes one token for every slot.
+  * cache.py   — KVCacheManager: preallocated slot pool, bounded memory
+                 regardless of request mix (vLLM's pool discipline in
+                 static-shape jax form).
+  * engine.py  — the Orca-style iteration-level scheduler: admits new
+                 requests at prefill boundaries mid-decode, evicts on
+                 EOS/max-tokens, streams tokens per request.
+  * serving.py — the Serve deployment (POST /v1/generate, JSON +
+                 chunked token streaming, replica autoscaling).
+
+Quick start::
+
+    from ray_tpu import serve
+    from ray_tpu.inference import build_gpt_deployment
+    serve.run(build_gpt_deployment(), use_actors=False, http=True)
+    # curl -d '{"prompt": [1,2,3], "max_tokens": 8}' \
+    #      http://127.0.0.1:<port>/v1/generate
+
+Benchmark receipt: benchmarks/serve_bench.py → SERVE_r10.json
+(continuous batching vs naive sequential A/B on the same box/run).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.inference.cache import KVCacheManager
+from ray_tpu.inference.decode import make_decode_step, make_prefill_fn
+from ray_tpu.inference.engine import (EngineConfig, GenerationRequest,
+                                      InferenceEngine, metrics_snapshot)
+from ray_tpu.inference.serving import (GPTServer, build_gpt_deployment,
+                                       encode_prompt, parse_stream_chunks)
+
+__all__ = [
+    "KVCacheManager", "make_decode_step", "make_prefill_fn",
+    "EngineConfig", "GenerationRequest", "InferenceEngine",
+    "metrics_snapshot", "GPTServer", "build_gpt_deployment",
+    "encode_prompt", "parse_stream_chunks",
+]
+
+from ray_tpu import usage_stats as _usage_stats
+_usage_stats.record_library_usage("inference")

@@ -1,0 +1,122 @@
+"""Incremental (KV-cache) decode for the GPT: prefill + one-token step.
+
+Two compiled programs, both with STATIC shapes so each compiles exactly
+once per engine regardless of request mix:
+
+  * prefill — the ordinary training forward with ``return_kv=True``
+    (models/gpt.py) over the prompt padded to the cache width.  Same
+    math, same code path: the K/V that seed the cache cannot drift from
+    the oracle.  Causality makes right-padding free — positions beyond
+    the prompt produce garbage K/V that the per-slot kv_lengths mask
+    hides and later decode steps overwrite.
+  * decode_step — one token for EVERY slot at once ([n_slots] batch).
+    Each slot sits at its own sequence position, so the cache write is a
+    one-hot scatter on the position axis and attention masks each row to
+    its own valid prefix (ops/attention.py kv_lengths).  Inactive slots
+    ride along masked — the batch width never changes, which is what
+    lets the engine admit/evict between steps without recompilation
+    (Orca's iteration-level scheduling in pjit form).
+
+The step mirrors gpt._transformer_layer's einsums exactly (dense MLP
+path); greedy token-parity with full-recompute ``generate()`` is pinned
+by tests/test_inference.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models import gpt
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.ops.attention import attention
+from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
+
+
+def make_prefill_fn(cfg: GPTConfig, *, mesh=None,
+                    rules: Rules = DEFAULT_LLM_RULES):
+    """jitted (params, tokens [b, S]) -> (logits [b, S, V], k, v
+    [L, b, h, S, hd] each)."""
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "the inference engine has no MoE decode path yet "
+            "(expert dispatch per cached token)")
+
+    @jax.jit
+    def prefill(params, tokens):
+        logits, (k, v) = gpt.forward(params, tokens, cfg, mesh=mesh,
+                                     rules=rules, return_kv=True)
+        return logits, k, v
+
+    return prefill
+
+
+def make_decode_step(cfg: GPTConfig, *, mesh=None,
+                     rules: Rules = DEFAULT_LLM_RULES):
+    """jitted one-token step over the whole slot batch.
+
+    (params, k_cache, v_cache [L, b, h, S, hd], tokens [b] int32,
+     positions [b] int32, active [b] bool)
+        -> (logits [b, vocab] f32, k_cache, v_cache)
+
+    ``tokens`` are the slots' current input tokens, each sitting at
+    ``positions[slot]``; the step writes that token's K/V into the cache
+    (masked by ``active`` so parked slots stay untouched), attends over
+    positions [0, positions[slot]] and returns next-token logits.
+    """
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "the inference engine has no MoE decode path yet "
+            "(expert dispatch per cached token)")
+    h, hd = cfg.n_heads, cfg.head_dim
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def step(params, k_cache, v_cache, tokens, positions, active):
+        b = tokens.shape[0]
+        S = k_cache.shape[3]
+        x = (params["wte"][tokens] + params["wpe"][positions])
+        x = x[:, None, :].astype(cfg.dtype)               # [b, 1, d]
+        # one-hot write mask on the position axis, zeroed for parked slots
+        write = ((jnp.arange(S)[None, :] == positions[:, None])
+                 & active[:, None])                       # [b, S]
+        kv_len = jnp.where(active, positions + 1, 1)      # >=1: no NaN rows
+
+        def layer(x, xs):
+            lp, ck, cv = xs                               # ck/cv [b,h,S,hd]
+            y = gpt._layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+            qkv = jnp.einsum("bsd,de->bse", y,
+                             lp["wqkv"].astype(cfg.dtype))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+            def heads(t):                                 # [b,1,d]->[b,h,1,hd]
+                return t.reshape(b, 1, h, hd).transpose(0, 2, 1, 3)
+
+            kh, vh = heads(k), heads(v)                   # [b, h, 1, hd]
+            ck = jnp.where(write[:, None, :, None], kh, ck)
+            cv = jnp.where(write[:, None, :, None], vh, cv)
+            o = attention(heads(q), ck, cv, causal=False,
+                          kv_lengths=kv_len, impl="reference")
+            o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+            o = jnp.einsum("bsd,de->bse", o, lp["wo"].astype(cfg.dtype)) \
+                + lp["bo"].astype(cfg.dtype)
+            x = x + o
+            y = gpt._layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+            u = jnp.einsum("bsd,df->bsf", y,
+                           lp["w_up"].astype(cfg.dtype)) \
+                + lp["b_up"].astype(cfg.dtype)
+            u = jax.nn.gelu(u)
+            dn = jnp.einsum("bsf,fd->bsd", u,
+                            lp["w_down"].astype(cfg.dtype)) \
+                + lp["b_down"].astype(cfg.dtype)
+            return x + dn, (ck, cv)
+
+        x, (k_cache, v_cache) = lax.scan(
+            layer, x, (params["layers"], k_cache, v_cache))
+        logits = gpt._head(params, x, cfg, mesh, rules)[:, 0, :]
+        return logits, k_cache, v_cache
+
+    return step

@@ -1,0 +1,489 @@
+"""Continuous-batching inference engine (Orca-style iteration-level
+scheduling over a fixed decode-batch width).
+
+One background loop owns the model state and runs one compiled decode
+step per iteration over ALL slots at once.  Between steps — the prefill
+boundary — it admits waiting requests into free cache slots (each
+admission is one prefill forward that seeds the slot's K/V and produces
+the request's first token) and evicts finished ones (EOS / max-tokens),
+returning their slots to the pool.  Requests therefore join and leave
+MID-DECODE of their neighbors: a long generation never blocks a short
+one behind it, and the decode batch stays as full as the offered load
+allows — the throughput lever the naive sequential baseline lacks
+(benchmarks/serve_bench.py is the A/B receipt).
+
+Tokens stream out per request as they are sampled: GenerationRequest is
+a tiny condition-variable mailbox whose ``stream()`` generator the serve
+layer turns into chunked transfer-encoding.  All waits are bounded
+condition waits (no bare ``Event.wait()`` / ``time.sleep`` polling — the
+control-plane lint's blocking rules are the house style even off the
+node event loop).
+
+Sampling runs on the host via models.gpt.sample_token — the SAME
+function the full-recompute oracle uses, so greedy decode is
+token-identical by construction (asserted in tests).  Per-request
+temperature/rng stay per-request because sampling is outside the
+compiled step; logits [n_slots, vocab] is a small transfer.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import weakref
+from dataclasses import dataclass
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.inference.cache import KVCacheManager
+from ray_tpu.inference.decode import make_decode_step, make_prefill_fn
+from ray_tpu.models import gpt
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
+
+
+@dataclass
+class EngineConfig:
+    """Engine knobs.  max_slots is the decode-batch width AND the cache
+    pool size — the engine's entire memory footprint is fixed by it."""
+    max_slots: int = 8
+    max_seq: Optional[int] = None        # cache width; None = model max_seq
+    eos_token: Optional[int] = None      # None = never stop early
+    default_max_new: int = 64
+    max_waiting: int = 1024              # admission-queue bound (backpressure)
+    idle_wait_s: float = 0.05            # loop park interval when empty
+
+
+class GenerationRequest:
+    """One in-flight generation: a mailbox the engine appends tokens to
+    and consumers drain via ``stream()`` / ``result()``."""
+
+    def __init__(self, req_id: int, prompt: np.ndarray, max_new: int,
+                 temperature: float, rng: Optional[jax.Array]):
+        self.id = req_id
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self._rng = rng
+        self.tokens: list[int] = []
+        self.done = False
+        self.cancelled = False
+        self.error: Optional[BaseException] = None
+        self._cond = threading.Condition()
+        self.created_s = time.perf_counter()
+        self.first_token_s: Optional[float] = None
+        self.finished_s: Optional[float] = None
+
+    # ---- engine side -----------------------------------------------------
+
+    def _emit(self, token: int) -> None:
+        with self._cond:
+            if self.first_token_s is None:
+                self.first_token_s = time.perf_counter()
+            self.tokens.append(int(token))
+            self._cond.notify_all()
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        with self._cond:
+            self.error = error
+            self.done = True
+            self.finished_s = time.perf_counter()
+            self._cond.notify_all()
+
+    def _next_rng(self) -> Optional[jax.Array]:
+        if self._rng is None:
+            return None
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ---- consumer side ---------------------------------------------------
+
+    def cancel(self) -> None:
+        """Abandon the request: the engine drops it from the waiting
+        queue, or evicts it at the next decode iteration, freeing its
+        slot for live work.  Idempotent; a no-op once done."""
+        with self._cond:
+            self.cancelled = True
+            self._cond.notify_all()
+
+    def stream(self, timeout: Optional[float] = None) -> Iterator[int]:
+        """Yield generated tokens as they arrive; returns at completion,
+        raises the engine-side error if the request failed."""
+        i = 0
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
+        while True:
+            with self._cond:
+                while len(self.tokens) <= i and not self.done:
+                    remain = 0.5
+                    if deadline is not None:
+                        remain = min(remain, deadline - time.perf_counter())
+                        if remain <= 0:
+                            raise TimeoutError(
+                                f"request {self.id}: no token within "
+                                f"{timeout}s")
+                    self._cond.wait(timeout=remain)
+                if len(self.tokens) > i:
+                    tok = self.tokens[i]
+                else:                      # done, mailbox drained
+                    if self.error is not None:
+                        raise self.error
+                    return
+            yield tok
+            i += 1
+
+    def result(self, timeout: Optional[float] = None) -> list[int]:
+        """Block until completion; returns the full generated-token list."""
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
+        with self._cond:
+            while not self.done:
+                remain = 0.5
+                if deadline is not None:
+                    remain = min(remain, deadline - time.perf_counter())
+                    if remain <= 0:
+                        raise TimeoutError(
+                            f"request {self.id} not done within {timeout}s")
+                self._cond.wait(timeout=remain)
+            if self.error is not None:
+                raise self.error
+            return list(self.tokens)
+
+
+# engine registry for /metrics export (weak: an engine dies with its
+# replica, the gauge series just disappears — the loop thread also only
+# holds its engine weakly, see _engine_loop)
+_ENGINES: "weakref.WeakValueDictionary[str, InferenceEngine]" = \
+    weakref.WeakValueDictionary()
+_engine_seq = itertools.count()
+_registry_lock = threading.Lock()
+
+
+def _engine_loop(ref: "weakref.ref[InferenceEngine]") -> None:
+    """Loop-thread driver.  A strong reference exists only DURING a
+    pass; between passes the engine is collectable, and a collected
+    engine simply ends the thread (its requests are unreachable too,
+    short of a consumer-held mailbox, which shutdown()/teardown covers
+    for the supported lifecycles)."""
+    while True:
+        eng = ref()
+        if eng is None:
+            return
+        try:
+            alive = eng._loop_pass()
+        except BaseException:
+            eng._drain_pending()
+            raise
+        if not alive:
+            eng._drain_pending()
+            return
+        del eng
+
+
+class InferenceEngine:
+    """Continuous-batching engine over one parameter set.
+
+    >>> eng = InferenceEngine(params, cfg, EngineConfig(max_slots=8))
+    >>> req = eng.submit([1, 2, 3], max_new=16)
+    >>> for tok in req.stream(): ...
+    """
+
+    def __init__(self, params, cfg: GPTConfig,
+                 engine_cfg: Optional[EngineConfig] = None, *,
+                 mesh=None, rules: Rules = DEFAULT_LLM_RULES,
+                 name: Optional[str] = None):
+        self.cfg = cfg
+        self.engine_cfg = engine_cfg or EngineConfig()
+        ec = self.engine_cfg
+        self.params = params
+        self.cache = KVCacheManager(cfg, ec.max_slots, max_seq=ec.max_seq)
+        self._prefill = make_prefill_fn(cfg, mesh=mesh, rules=rules)
+        self._step = make_decode_step(cfg, mesh=mesh, rules=rules)
+
+        n = ec.max_slots
+        self._slot_req: dict[int, GenerationRequest] = {}
+        self._tokens = np.zeros(n, np.int32)      # current input token
+        self._positions = np.zeros(n, np.int32)   # where it will be written
+        self._active = np.zeros(n, bool)
+        self._waiting: list[GenerationRequest] = []
+        self._req_seq = itertools.count()
+        self._cond = threading.Condition()
+        self._stopped = False
+
+        # metrics (guarded by _cond's lock via _mlock simplicity: own lock)
+        self._mlock = threading.Lock()
+        self._generated_tokens = 0
+        self._requests_completed = 0
+        self._decode_iterations = 0
+        self._occupancy_sum = 0.0      # Σ active/max_slots per iteration
+
+        with _registry_lock:
+            self.name = name or f"engine-{next(_engine_seq)}"
+            _ENGINES[self.name] = self
+
+        # the thread holds the engine only WEAKLY between passes: an
+        # engine abandoned without shutdown() becomes collectable (the
+        # loop then exits on its own), instead of a bound-method target
+        # pinning the KV pool + a 50 ms-tick thread alive forever
+        self._thread = threading.Thread(
+            target=_engine_loop, args=(weakref.ref(self),), daemon=True,
+            name=f"raytpu-inference-{self.name}")
+        self._thread.start()
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new: Optional[int] = None,
+               temperature: float = 0.0,
+               seed: int = 0) -> GenerationRequest:
+        """Queue a generation; returns immediately with the request
+        mailbox.  Admission happens at the next prefill boundary."""
+        ec = self.engine_cfg
+        prompt = np.asarray(list(prompt), np.int32)
+        max_new = int(max_new if max_new is not None else ec.default_max_new)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token list")
+        if prompt.min() < 0 or prompt.max() >= self.cfg.vocab_size:
+            raise ValueError(
+                f"prompt tokens out of range [0, {self.cfg.vocab_size})")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        total = int(prompt.size) + max_new
+        if total > self.cache.max_seq:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new ({max_new}) = {total} "
+                f"exceeds the cache width {self.cache.max_seq}")
+        rng = (jax.random.PRNGKey(seed) if temperature > 0.0 else None)
+        req = GenerationRequest(next(self._req_seq), prompt, max_new,
+                                float(temperature), rng)
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("engine is shut down")
+            if len(self._waiting) >= ec.max_waiting:
+                raise RuntimeError(
+                    f"engine admission queue full ({ec.max_waiting})")
+            self._waiting.append(req)
+            self._cond.notify_all()
+        return req
+
+    def generate(self, prompt: Sequence[int], *,
+                 max_new: Optional[int] = None, temperature: float = 0.0,
+                 seed: int = 0, timeout: Optional[float] = None) -> list[int]:
+        """Synchronous convenience wrapper around submit()+result()."""
+        return self.submit(prompt, max_new=max_new, temperature=temperature,
+                           seed=seed).result(timeout=timeout)
+
+    # ------------------------------------------------------------- loop
+
+    def _loop_pass(self) -> bool:
+        """One scheduler pass (reap → admit → decode); False when
+        stopped.  Runs on the loop thread, which holds the engine only
+        WEAKLY between passes (_engine_loop) so an engine abandoned
+        without shutdown() is still collectable."""
+        with self._cond:
+            # park unless there is work a pass can make progress
+            # on: an active slot to decode, or a waiting request
+            # AND a free slot to admit it into (waiting alone
+            # must not spin when the pool is fully handed out)
+            while (not self._stopped and not self._active.any()
+                   and not (self._waiting
+                            and self.cache.n_free > 0)):
+                self._cond.wait(self.engine_cfg.idle_wait_s)
+            if self._stopped:
+                return False
+            # reap cancelled waiters even when the pool is full:
+            # zombies must not consume max_waiting backpressure
+            # (a burst of timed-out clients would otherwise make
+            # submit() reject live work as "queue full")
+            live = []
+            for r in self._waiting:
+                if r.cancelled:
+                    r._finish()
+                else:
+                    live.append(r)
+            self._waiting = live
+            admits = []
+            while self._waiting and self.cache.n_free > 0:
+                req = self._waiting.pop(0)
+                admits.append((self.cache.alloc(), req))
+        for slot, req in admits:
+            # per-admit isolation: one bad prefill fails ONE
+            # request and returns its slot; neighbors proceed
+            try:
+                self._admit(slot, req)
+            except Exception as e:
+                try:
+                    self.cache.free(slot)
+                except ValueError:            # _admit already returned it
+                    pass
+                req._finish(e)
+        try:
+            if self._active.any():
+                self._decode_iteration()
+        except Exception as e:                # step failure: fail the
+            self._fail_all(e)                 # in-flight requests, keep serving
+        return True
+
+    def _drain_pending(self) -> None:
+        """Terminal cleanup: fail everything still queued or in-flight."""
+        with self._cond:
+            self._stopped = True
+            pending = list(self._slot_req.values()) + self._waiting
+            self._slot_req.clear()
+            self._waiting.clear()
+        err = RuntimeError("engine shut down")
+        for r in pending:
+            if not r.done:
+                r._finish(err)
+
+    def _admit(self, slot: int, req: GenerationRequest) -> None:
+        """Prefill boundary: seed the slot's cache, emit the first token."""
+        if req.cancelled:                 # abandoned while queued
+            self.cache.free(slot)
+            req._finish()
+            return
+        S = self.cache.max_seq
+        n = int(req.prompt.size)
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :n] = req.prompt
+        logits, k_new, v_new = self._prefill(self.params, padded)
+        self.cache.write_prefill(slot, k_new[:, 0], v_new[:, 0])
+        tok = int(gpt.sample_token(logits[0, n - 1],
+                                   temperature=req.temperature,
+                                   rng=req._next_rng()))
+        req._emit(tok)
+        if self._request_finished(req, tok):
+            self.cache.free(slot)
+            req._finish()
+            self._note_done()
+            return
+        self._slot_req[slot] = req
+        self._tokens[slot] = tok
+        self._positions[slot] = n
+        self._active[slot] = True
+
+    def _decode_iteration(self) -> None:
+        logits, k, v = self._step(
+            self.params, self.cache.k, self.cache.v,
+            jnp.asarray(self._tokens), jnp.asarray(self._positions),
+            jnp.asarray(self._active))
+        self.cache.swap(k, v)
+        logits = np.asarray(logits)
+        with self._mlock:
+            self._decode_iterations += 1
+            self._occupancy_sum += (float(self._active.sum())
+                                    / self.engine_cfg.max_slots)
+        # greedy rows sample in ONE vectorized call (the common/benchmark
+        # path: one argmax over [n_slots, vocab], not one dispatch per
+        # slot); temperature rows keep their per-request rng
+        greedy = np.asarray(gpt.sample_token(logits, temperature=0.0))
+        for slot in list(self._slot_req):
+            req = self._slot_req[slot]
+            if req.cancelled:             # abandoned (timeout/disconnect):
+                self._evict(slot)         # free the slot for live work
+                continue
+            if req.temperature == 0.0:
+                tok = int(greedy[slot])
+            else:
+                tok = int(gpt.sample_token(logits[slot],
+                                           temperature=req.temperature,
+                                           rng=req._next_rng()))
+            req._emit(tok)
+            self._positions[slot] += 1
+            self._tokens[slot] = tok
+            if self._request_finished(req, tok):
+                self._evict(slot)
+
+    def _request_finished(self, req: GenerationRequest, tok: int) -> bool:
+        with self._mlock:
+            self._generated_tokens += 1
+        eos = self.engine_cfg.eos_token
+        return (len(req.tokens) >= req.max_new
+                or (eos is not None and tok == eos))
+
+    def _evict(self, slot: int) -> None:
+        req = self._slot_req.pop(slot)
+        self._active[slot] = False
+        self.cache.free(slot)
+        req._finish()
+        self._note_done()
+        with self._cond:
+            self._cond.notify_all()   # wake loop in case admits are waiting
+
+    def _note_done(self) -> None:
+        with self._mlock:
+            self._requests_completed += 1
+
+    def _fail_all(self, e: BaseException) -> None:
+        for slot in list(self._slot_req):
+            req = self._slot_req.pop(slot)
+            self._active[slot] = False
+            self.cache.free(slot)
+            req._finish(e)
+        # the failed step may have invalidated the donated cache buffers
+        # (decode_step donates them); reallocate so the engine actually
+        # keeps serving instead of poisoning every later request
+        self.cache.reset_arrays()
+
+    # ------------------------------------------------------------- admin
+
+    def stats(self) -> dict:
+        with self._cond:
+            waiting = len(self._waiting)
+        with self._mlock:
+            iters = self._decode_iterations
+            occ = (self._occupancy_sum / iters) if iters else 0.0
+            generated = self._generated_tokens
+            completed = self._requests_completed
+        cache = self.cache.stats()
+        return {
+            "active_slots": cache["active_slots"],
+            "free_slots": cache["free_slots"],
+            "max_slots": self.engine_cfg.max_slots,
+            "waiting_requests": waiting,
+            "batch_occupancy": occ,
+            "generated_tokens": generated,
+            "requests_completed": completed,
+            "decode_iterations": iters,
+            "cache_bytes": cache["bytes_total"],
+        }
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+
+def metrics_snapshot() -> list:
+    """Per-engine gauges/counters in the metrics exporter's tuple format
+    (ray_tpu.metrics.render_prometheus); aggregated by the serve-layer
+    /metrics endpoint alongside the per-deployment request counters."""
+    with _registry_lock:
+        engines = dict(_ENGINES)
+    active, waiting, occ, gen, comp = {}, {}, {}, {}, {}
+    for name, eng in sorted(engines.items()):
+        st = eng.stats()
+        key = (("engine", name),)
+        active[key] = float(st["active_slots"])
+        waiting[key] = float(st["waiting_requests"])
+        occ[key] = float(st["batch_occupancy"])
+        gen[key] = float(st["generated_tokens"])
+        comp[key] = float(st["requests_completed"])
+    zero = {(("engine", "none"),): 0.0}
+    return [
+        ("ray_tpu_inference_active_slots", "gauge",
+         "Cache slots currently decoding, per engine", active or zero),
+        ("ray_tpu_inference_waiting_requests", "gauge",
+         "Requests queued for a free slot, per engine", waiting or zero),
+        ("ray_tpu_inference_batch_occupancy_ratio", "gauge",
+         "Mean active/max_slots per decode iteration", occ or zero),
+        ("ray_tpu_inference_generated_tokens_total", "counter",
+         "Tokens generated since engine start", gen or zero),
+        ("ray_tpu_inference_requests_completed_total", "counter",
+         "Generation requests completed since engine start", comp or zero),
+    ]

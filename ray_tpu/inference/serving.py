@@ -1,0 +1,185 @@
+"""Serve deployment for the inference engine: POST /v1/generate.
+
+Each replica owns one InferenceEngine (its own cache pool + decode
+loop); Serve's router spreads requests over replicas and the
+AutoscalingConfig grows/shrinks the replica set on per-replica in-flight
+load — which for this deployment IS the engine queue depth, since every
+in-flight request is either holding a decode slot or parked in the
+engine's admission queue.  `max_concurrent_queries` is set well above
+`max_slots` so the engine (not the router) does the queueing and the
+continuous-batching loop sees the real backlog.
+
+Request JSON (POST /v1/generate — any /v1/* path routes here, the
+deployment is named "v1"):
+
+    {"prompt": [1, 2, 3] | "text",     # token ids, or a string encoded
+                                       #   bytewise modulo the vocab
+     "max_tokens": 16,                 # default engine_cfg.default_max_new
+     "temperature": 0.0,               # 0 = greedy
+     "seed": 0,
+     "stream": false}
+
+Non-streaming replies {"tokens": [...], "n": n, "ttft_s": ..., ...};
+``stream: true`` returns a generator the asyncio proxy flushes as
+chunked transfer-encoding — one JSON document per chunk, each carrying
+one token, then a final ``{"done": true, ...}`` chunk.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence, Union
+
+import jax
+
+from ray_tpu.inference.engine import EngineConfig, InferenceEngine
+from ray_tpu.models import gpt
+from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.serve.deployment import (AutoscalingConfig, Deployment,
+                                      DeploymentOptions)
+
+DEFAULT_ROUTE = "v1"
+
+
+def encode_prompt(prompt: Union[str, Sequence[int]],
+                  vocab_size: int) -> list[int]:
+    """Token ids pass through; strings encode bytewise modulo the vocab
+    (the repo ships no tokenizer — this keeps the HTTP surface usable
+    end-to-end and is trivially reversible for vocab >= 256)."""
+    if isinstance(prompt, str):
+        if not prompt:
+            raise ValueError("empty prompt")
+        return [b % vocab_size for b in prompt.encode("utf-8")]
+    return [int(t) for t in prompt]
+
+
+class GPTServer:
+    """Replica body: one engine per replica.
+
+    Params are derived from ``seed`` at replica init (deterministic
+    across replicas, so any replica answers any request identically
+    under greedy decoding), or passed in directly for in-process use.
+    """
+
+    def __init__(self, cfg: Optional[GPTConfig] = None,
+                 engine_cfg: Optional[EngineConfig] = None,
+                 seed: int = 0, params=None,
+                 engine_name: Optional[str] = None):
+        self.cfg = cfg or GPTConfig.tiny()
+        if params is None:
+            params = gpt.init_params(self.cfg, jax.random.PRNGKey(seed))
+        self.engine = InferenceEngine(params, self.cfg,
+                                      engine_cfg or EngineConfig(),
+                                      name=engine_name)
+
+    def __call__(self, req):
+        if not isinstance(req, dict):
+            raise ValueError(
+                "expected a JSON object body, e.g. "
+                '{"prompt": [1, 2, 3], "max_tokens": 16}')
+        if "prompt" not in req:
+            raise ValueError('missing required field "prompt"')
+        prompt = encode_prompt(req["prompt"], self.cfg.vocab_size)
+        handle = self.engine.submit(
+            prompt,
+            max_new=req.get("max_tokens"),
+            temperature=float(req.get("temperature", 0.0)),
+            seed=int(req.get("seed", 0)))
+        if req.get("stream"):
+            return self._stream(handle)
+        try:
+            toks = handle.result(timeout=float(req.get("timeout", 120.0)))
+        except TimeoutError:
+            # shed the abandoned generation: nobody will read it, so it
+            # must not keep holding a decode slot against live requests
+            handle.cancel()
+            raise
+        return {
+            "tokens": toks,
+            "n": len(toks),
+            "ttft_s": (handle.first_token_s or 0) - handle.created_s,
+            "latency_s": (handle.finished_s or 0) - handle.created_s,
+        }
+
+    @staticmethod
+    def _stream(handle):
+        def gen():
+            i = 0
+            try:
+                for tok in handle.stream():
+                    yield {"token": int(tok), "index": i}
+                    i += 1
+                yield {"done": True, "n": i,
+                       "latency_s": (handle.finished_s or 0)
+                       - handle.created_s}
+            finally:
+                # client disconnect mid-stream closes the generator
+                # (GeneratorExit lands here): stop decoding for nobody
+                if not handle.done:
+                    handle.cancel()
+        return gen()
+
+    # surfaced for tests / the metrics endpoint via the engine registry
+    def engine_stats(self):
+        return self.engine.stats()
+
+    def health(self):
+        return True
+
+    def teardown(self):
+        """Replica teardown hook (DeploymentState.scale_to): stop the
+        engine loop so a scaled-down replica releases its KV pool and
+        thread instead of leaking them."""
+        self.engine.shutdown(timeout=2.0)
+
+    def __del__(self):   # best-effort: teardown() is the real path
+        try:
+            self.engine.shutdown(timeout=0.5)
+        except Exception:
+            pass
+
+
+def build_gpt_deployment(*, name: str = DEFAULT_ROUTE,
+                         cfg: Optional[GPTConfig] = None,
+                         engine_cfg: Optional[EngineConfig] = None,
+                         seed: int = 0,
+                         num_replicas: int = 1,
+                         max_concurrent_queries: int = 64,
+                         autoscaling: Optional[AutoscalingConfig] = None,
+                         params=None) -> Deployment:
+    """A ready-to-``serve.run`` deployment wrapping GPTServer.  Route is
+    /<name>/... — the default name "v1" makes POST /v1/generate work.
+
+    Pass ``autoscaling`` (e.g. AutoscalingConfig(min_replicas=1,
+    max_replicas=4, target_ongoing_requests=max_slots)) to scale the
+    replica set on queue depth; each new replica brings its own engine
+    and cache pool.
+    """
+    return Deployment(
+        GPTServer,
+        DeploymentOptions(name=name, num_replicas=num_replicas,
+                          max_concurrent_queries=max_concurrent_queries,
+                          autoscaling=autoscaling),
+        init_args=(),
+        init_kwargs=dict(cfg=cfg, engine_cfg=engine_cfg, seed=seed,
+                         params=params))
+
+
+def parse_stream_chunks(raw: bytes) -> list[dict]:
+    """Decode the chunked-transfer JSON documents a streamed /v1/generate
+    response carries (helper for clients and tests: one dict per chunk,
+    in arrival order)."""
+    out = []
+    rest = raw
+    while rest:
+        head, _, rest = rest.partition(b"\r\n")
+        if not head:
+            continue
+        n = int(head, 16)
+        if n == 0:
+            break
+        out.append(json.loads(rest[:n]))
+        rest = rest[n:]
+        if rest.startswith(b"\r\n"):
+            rest = rest[2:]
+    return out

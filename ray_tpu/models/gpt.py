@@ -324,9 +324,12 @@ def _moe_mlp(y, lp, cfg: GPTConfig, mesh: Optional[Mesh], rules: Rules):
 
 
 def _transformer_layer(x, lp, cfg: GPTConfig, mesh: Optional[Mesh],
-                       rules: Rules):
+                       rules: Rules, return_kv: bool = False):
     """One pre-LN transformer block; x [b, s, d], lp = one layer's params
-    (no leading layers dim).  Returns (x, moe aux loss — 0 when dense)."""
+    (no leading layers dim).  Returns (x, moe aux loss — 0 when dense);
+    with ``return_kv`` also the per-head K/V ([b, h, s, hd] each) so a
+    prefill pass can seed an incremental-decode cache
+    (ray_tpu.inference.decode)."""
     b, s, _ = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
 
@@ -338,7 +341,8 @@ def _transformer_layer(x, lp, cfg: GPTConfig, mesh: Optional[Mesh],
     def heads(t):  # [b, s, d] -> [b, h, s, hd]
         return t.reshape(b, s, h, hd).transpose(0, 2, 1, 3)
 
-    o = _attend(heads(q), heads(k), heads(v), cfg, mesh, rules)
+    kh, vh = heads(k), heads(v)
+    o = _attend(heads(q), kh, vh, cfg, mesh, rules)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
     o = jnp.einsum("bsd,de->bse", o, lp["wo"].astype(cfg.dtype)) \
         + lp["bo"].astype(cfg.dtype)
@@ -358,14 +362,22 @@ def _transformer_layer(x, lp, cfg: GPTConfig, mesh: Optional[Mesh],
         aux = jnp.zeros((), jnp.float32)
     x = x + dn
     x = _constrain(x, ("batch", "seq", "embed"), mesh, rules)
+    if return_kv:
+        return x, aux, (kh, vh)
     return x, aux
 
 
-def _layer_scan_body(cfg: GPTConfig, mesh, rules):
+def _layer_scan_body(cfg: GPTConfig, mesh, rules, return_kv: bool = False):
     """Scan body over a stacked layer dim, rematerialized per cfg.
-    Carry is (x, accumulated moe aux loss)."""
+    Carry is (x, accumulated moe aux loss); with ``return_kv`` each step
+    also emits that layer's K/V heads (stacked to [L, b, h, s, hd] by the
+    scan — the prefill cache layout)."""
     def layer(carry, lp):
         x, aux = carry
+        if return_kv:
+            x, a, kv = _transformer_layer(x, lp, cfg, mesh, rules,
+                                          return_kv=True)
+            return (x, aux + a), kv
         x, a = _transformer_layer(x, lp, cfg, mesh, rules)
         return (x, aux + a), None
 
@@ -406,7 +418,8 @@ def _head(params, x, cfg: GPTConfig, mesh, rules):
 
 
 def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
-            rules: Rules = DEFAULT_LLM_RULES, return_aux: bool = False):
+            rules: Rules = DEFAULT_LLM_RULES, return_aux: bool = False,
+            return_kv: bool = False):
     """tokens [b, s] int32 → logits [b, s, vocab] (f32).
 
     With a mesh, activations carry sharding constraints so pjit lays out
@@ -414,16 +427,26 @@ def forward(params, tokens, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
     an ordinary single-device jax function.  A mesh with pp > 1 runs the
     layer stack as a GPipe microbatch pipeline (parallel.pipeline).
     ``return_aux`` also returns the summed MoE load-balance loss.
+    ``return_kv`` additionally returns the per-layer attention K/V
+    ((k, v), each [L, b, h, s, hd]) — the prefill half of the
+    incremental-decode path (ray_tpu.inference); the SAME forward math
+    seeds the cache, so there is no separate prefill network to drift.
     """
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        if return_kv:
+            raise NotImplementedError(
+                "return_kv (inference prefill) is not supported on a "
+                "pp mesh; prefill with dp/tp sharding instead")
         return _forward_pipelined(params, tokens, cfg, mesh, rules,
                                   return_aux)
 
     x = _embed(params, tokens, cfg, mesh, rules)
-    (x, aux), _ = lax.scan(_layer_scan_body(cfg, mesh, rules),
-                           (x, jnp.zeros((), jnp.float32)),
-                           params["layers"])
+    (x, aux), kv = lax.scan(_layer_scan_body(cfg, mesh, rules, return_kv),
+                            (x, jnp.zeros((), jnp.float32)),
+                            params["layers"])
     logits = _head(params, x, cfg, mesh, rules)
+    if return_kv:
+        return ((logits, aux, kv) if return_aux else (logits, kv))
     return (logits, aux) if return_aux else logits
 
 
@@ -491,12 +514,36 @@ def loss_fn(params, batch, cfg: GPTConfig, *, mesh: Optional[Mesh] = None,
     return ce
 
 
+def sample_token(logits, *, temperature: float = 1.0,
+                 rng: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token sampling head shared by ``generate()`` (the
+    full-recompute correctness oracle) and the KV-cache engine
+    (ray_tpu.inference.engine) — one implementation so greedy decode is
+    token-identical across the two paths by construction.
+
+    logits [..., vocab] f32 → token ids [...] int32.  temperature == 0.0
+    is exact argmax (ties break to the lowest index); otherwise softmax
+    sampling at the given temperature (``rng`` required).
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        raise ValueError("temperature > 0 sampling requires an rng key")
+    return jax.random.categorical(
+        rng, logits.astype(jnp.float32) / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
 def generate(params, cfg: GPTConfig, prompt, max_new: int, *,
              rng: Optional[jax.Array] = None, temperature: float = 1.0):
     """Greedy/sampled decode via lax.scan (static shapes — the whole loop
     is one compiled program).  prompt [b, s0] int32, returns [b, s0+max_new].
-    Simple full-recompute decode (no kv cache yet — serve layer owns the
-    incremental-decode path)."""
+    Simple full-recompute decode (no kv cache — every step re-runs the
+    whole prefix).  The production incremental path lives in
+    ray_tpu.inference (prefill seeds a KV cache via ``forward(...,
+    return_kv=True)``, per-step decode reuses it); this path is kept as
+    the correctness oracle the engine's greedy output is asserted
+    token-identical against, and both share ``sample_token``."""
     b, s0 = prompt.shape
     total = s0 + max_new
     if total > cfg.max_seq:
@@ -508,11 +555,11 @@ def generate(params, cfg: GPTConfig, prompt, max_new: int, *,
         toks, rng = carry
         logits = forward(params, toks, cfg)[:, i - 1, :]
         if temperature == 0.0:
-            nxt = jnp.argmax(logits, axis=-1)
+            nxt = sample_token(logits, temperature=0.0)
         else:
             rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
-        toks = toks.at[:, i].set(nxt.astype(jnp.int32))
+            nxt = sample_token(logits, temperature=temperature, rng=sub)
+        toks = toks.at[:, i].set(nxt)
         return (toks, rng), None
 
     (toks, _), _ = lax.scan(step, (toks, rng), jnp.arange(s0, total))

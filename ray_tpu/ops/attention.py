@@ -21,11 +21,19 @@ def _scale_for(q, scale):
 
 def mha_reference(q, k, v, *, causal: bool = True,
                   scale: Optional[float] = None,
-                  mask: Optional[jax.Array] = None) -> jax.Array:
+                  mask: Optional[jax.Array] = None,
+                  kv_lengths: Optional[jax.Array] = None) -> jax.Array:
     """Plain softmax attention.  [b, h, s, d] layout.
 
     Kept in float32 logits regardless of input dtype — matches the flash
     kernel's accumulator precision so the two paths agree in bf16.
+
+    ``kv_lengths`` [b] int32 masks each batch row to its own valid kv
+    prefix (key position < kv_lengths[b]).  This is the slot-batched
+    decode shape (ray_tpu.inference): one fixed-width kv cache per slot,
+    every slot at a DIFFERENT sequence length, so the single global
+    (k_len - q_len) causal offset cannot express the mask.  Rows must
+    have at least one valid key (length >= 1) or the softmax is NaN.
     """
     s = _scale_for(q, scale)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -37,6 +45,10 @@ def mha_reference(q, k, v, *, causal: bool = True,
         idx_k = jnp.arange(k_len)[None, :]
         causal_mask = idx_q >= idx_k
         logits = jnp.where(causal_mask, logits, -jnp.inf)
+    if kv_lengths is not None:
+        valid = (jnp.arange(k.shape[-2])[None, :]
+                 < kv_lengths[:, None])                   # [b, k]
+        logits = jnp.where(valid[:, None, None, :], logits, -jnp.inf)
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -53,13 +65,16 @@ def _on_tpu() -> bool:
 def attention(q, k, v, *, causal: bool = True,
               scale: Optional[float] = None,
               mask: Optional[jax.Array] = None,
+              kv_lengths: Optional[jax.Array] = None,
               impl: Optional[str] = None,
               block_q: int = 512, block_k: int = 512) -> jax.Array:
     """Dispatching multi-head attention, [batch, heads, seq, head_dim].
 
     impl: "flash" (pallas TPU kernel), "reference", or None = auto
     (flash on TPU when shapes are tile-friendly and there is no custom
-    mask, reference otherwise).
+    mask or per-row kv_lengths, reference otherwise).  ``kv_lengths``
+    [b] limits each batch row to its own valid kv prefix (slot-batched
+    decode; see mha_reference).
     """
     from ray_tpu.ops.flash_attention import flash_attention
 
@@ -67,21 +82,24 @@ def attention(q, k, v, *, causal: bool = True,
         tile_ok = (q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
                    and q.shape[-1] in (64, 128, 256))
         impl = ("flash" if _on_tpu() and tile_ok and mask is None
+                and kv_lengths is None
                 else "reference")
     if impl == "flash":
-        if mask is not None:
+        if mask is not None or kv_lengths is not None:
             raise ValueError(
-                "flash impl has no custom-mask support; use "
+                "flash impl has no custom-mask / kv_lengths support; use "
                 "impl='reference' (causal masking is built in)")
         return flash_attention(q, k, v, causal=causal, scale=scale,
                                block_q=block_q, block_k=block_k)
     if impl == "reference":
-        return mha_reference(q, k, v, causal=causal, scale=scale, mask=mask)
+        return mha_reference(q, k, v, causal=causal, scale=scale, mask=mask,
+                             kv_lengths=kv_lengths)
     if impl == "xla_fused":
         # XLA's own fused attention path (jax.nn.dot_product_attention,
         # [b, s, h, d] layout)
-        if mask is not None:
-            raise ValueError("xla_fused impl has no custom-mask support")
+        if mask is not None or kv_lengths is not None:
+            raise ValueError("xla_fused impl has no custom-mask / "
+                             "kv_lengths support")
         out = jax.nn.dot_product_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), scale=scale, is_causal=causal)

@@ -99,7 +99,7 @@ def metrics_snapshot() -> list:
         reqs[key] = m["requests"]
         errs[key] = m["errors"]
         lat[key] = m["latency_sum_s"]
-    return [
+    out = [
         ("serve_requests_total", "counter",
          "Requests completed per deployment", reqs),
         ("serve_request_errors_total", "counter",
@@ -107,6 +107,16 @@ def metrics_snapshot() -> list:
         ("serve_request_latency_seconds_sum", "counter",
          "Summed request latency per deployment", lat),
     ]
+    # inference-engine gauges ride the same endpoint when any engine is
+    # live in this process (lazy: never pulls jax in for non-LLM serving)
+    import sys
+    inference = sys.modules.get("ray_tpu.inference")
+    if inference is not None:
+        try:
+            out += inference.metrics_snapshot()
+        except Exception:
+            pass
+    return out
 
 
 def start_metrics_exporter(port: int = 0):

@@ -58,10 +58,24 @@ class _Batcher:
         try:
             results = (self.fn(instance, items) if instance is not None
                        else self.fn(items))
+            # Strict fan-out contract: a dict / str / generator of the
+            # right *length* would zip apart into keys / characters /
+            # nothing and hand each caller silently-wrong results, so
+            # only an explicit sequence with one entry per request is
+            # accepted.  numpy arrays fan out along their first axis.
+            name = getattr(self.fn, "__qualname__", repr(self.fn))
+            if isinstance(results, (str, bytes, dict)) or not hasattr(
+                    results, "__len__"):
+                raise TypeError(
+                    f"@serve.batch function {name!r} must return a "
+                    f"list/tuple of {len(items)} results (one per "
+                    f"batched request), got {type(results).__name__}")
             if len(results) != len(items):
                 raise ValueError(
-                    f"@serve.batch function returned {len(results)} "
-                    f"results for {len(items)} requests")
+                    f"@serve.batch function {name!r} returned "
+                    f"{len(results)} results for a batch of "
+                    f"{len(items)} requests; each request must map to "
+                    "exactly one result, in order")
             for (_, fut), r in zip(batch, results):
                 fut.set_result(r)
         except BaseException as e:

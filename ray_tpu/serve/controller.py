@@ -39,6 +39,15 @@ class _InProcReplica:
     def health(self):
         return True
 
+    def close(self):
+        """Replica teardown: in-process replicas share the driver, so a
+        scale-down must give the user object a chance to release its
+        resources (e.g. an inference engine's KV pool + loop thread) —
+        actor replicas get process exit instead."""
+        teardown = getattr(self._user, "teardown", None)
+        if callable(teardown):
+            teardown()
+
 
 class _ActorReplicaShim:
     """The actor-side wrapper (reference: RayServeReplica
@@ -114,19 +123,25 @@ class DeploymentState:
     def scale_to(self, n: int) -> None:
         n = max(0, n)
         changed = False
+        removed: list[ReplicaHandle] = []
         with self._lock:
             while len(self.replicas) < n:
                 self.replicas.append(self._start_replica())
                 changed = True
             while len(self.replicas) > n:
-                r = self.replicas.pop()
+                removed.append(self.replicas.pop())
                 changed = True
+        # teardown outside the lock: a slow user teardown must not block
+        # routing (assign_replica) on the deployment lock
+        for r in removed:
+            try:
                 if r.is_actor:
                     import ray_tpu
-                    try:
-                        ray_tpu.kill(r.impl)
-                    except Exception:
-                        pass
+                    ray_tpu.kill(r.impl)
+                else:
+                    r.impl.close()
+            except Exception:
+                traceback.print_exc()
         if changed:
             self._membership_changed()
 

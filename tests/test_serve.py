@@ -111,6 +111,70 @@ def test_batching_collects():
     assert max(calls) > 1  # at least one real batch formed
 
 
+def test_batching_wrong_length_raises_clearly():
+    """A batched fn returning the wrong number of results must fail every
+    caller with an error naming the function and both lengths — never
+    fan out misaligned results."""
+
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+    def truncating(items):
+        return items[:-1]               # one result short
+
+    import threading
+    errs = []
+
+    def call(i):
+        try:
+            truncating(i)
+        except Exception as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(errs) == 4               # every caller fails, none hang
+    msg = str(errs[0])
+    assert isinstance(errs[0], ValueError)
+    assert "truncating" in msg and "3" in msg and "4" in msg
+
+
+def test_batching_non_sequence_result_raises_clearly():
+    """dict / str / generator results of the 'right length' would zip
+    apart into keys / characters / nothing — rejected with a TypeError
+    up front (this was the silent-mismatch fan-out gap)."""
+
+    for bad, typename in (
+            ({"a": 1, "b": 2}, "dict"),            # len matches batch!
+            ("ab", "str"),
+            ((i for i in range(2)), "generator")):
+
+        @serve.batch(max_batch_size=2, batch_wait_timeout_s=0.01)
+        def bad_fn(items, _bad=bad):
+            return _bad
+
+        import threading
+        errs = []
+
+        def call(i):
+            try:
+                bad_fn(i)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(errs) == 2, typename
+        assert isinstance(errs[0], TypeError), typename
+        assert typename in str(errs[0])
+        assert "bad_fn" in str(errs[0])
+
+
 def test_actor_replicas(rt_init):
     @serve.deployment(num_replicas=2)
     class PidEcho:
