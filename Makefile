@@ -5,7 +5,22 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-json test
+.PHONY: lint lint-json test native native-test native-tsan
+
+# build the native runtime pieces (shm store + frame codec) into
+# ray_tpu/native/*.so; tier-1 SKIPS the native tests when no compiler
+# is present, so a toolchain-less box still runs green on the
+# pure-Python fallbacks
+native:
+	$(MAKE) -C native all
+
+native-test:
+	$(MAKE) -C native test
+
+# ThreadSanitizer gates for the concurrent native pieces (shm store
+# race test + the frame codec's MPSC ready-ring stress)
+native-tsan:
+	$(MAKE) -C native tsan frames_tsan
 
 lint:
 	$(PYTHON) -m ray_tpu lint --baseline .lint-baseline.json

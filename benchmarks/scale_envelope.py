@@ -27,6 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import ray_tpu                                              # noqa: E402
 from ray_tpu._config import RayTpuConfig                    # noqa: E402
 from ray_tpu.cluster_utils import Cluster                   # noqa: E402
+from ray_tpu.perf import _loadavg                           # noqa: E402
 from ray_tpu.util.chaos import NodeKiller                   # noqa: E402
 
 
@@ -116,10 +117,56 @@ def bench_chaos(cluster, spare) -> dict:
             "completed_all": True, "seconds": round(dt, 1)}
 
 
+def _drain_phase(n_nodes: int, n_tasks: int, config: RayTpuConfig,
+                 native_frames: bool) -> dict:
+    """One bring-up → queued-task drain → teardown cycle with the
+    native frame codec armed or disarmed (same-run A/B arm for the
+    8-node drain bar; the env propagates to every worker the phase
+    spawns)."""
+    from ray_tpu.core import rt_frames as _rtf
+    prior_env = os.environ.get("RAY_TPU_NATIVE_FRAMES")
+    os.environ["RAY_TPU_NATIVE_FRAMES"] = "1" if native_frames else "0"
+    was_armed = _rtf.enabled()
+    if native_frames:
+        _rtf.enable()
+    else:
+        _rtf.disable()
+    # record what actually armed: on a toolchain-less box enable() is a
+    # no-op and the "native" arm really runs the pycodec
+    native_frames = _rtf.enabled()
+    c = Cluster(config=config)
+    try:
+        nodes = [c.add_node(num_cpus=2, resources={f"n{i}": 1})
+                 for i in range(n_nodes)]
+        c.wait_for_nodes(timeout=120)
+        ray_tpu.init(address=nodes[0].address)
+        try:
+            out = bench_tasks(n_tasks)
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        c.shutdown()
+        if prior_env is None:
+            os.environ.pop("RAY_TPU_NATIVE_FRAMES", None)
+        else:
+            os.environ["RAY_TPU_NATIVE_FRAMES"] = prior_env
+        # symmetric restore: a phase entered disarmed must exit
+        # disarmed, or later "pycodec" phases silently run native
+        if was_armed:
+            _rtf.enable()
+        else:
+            _rtf.disable()
+    out["native_frames"] = native_frames
+    out["loadavg_1m"] = _loadavg()
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=8)
     ap.add_argument("--tasks", type=int, default=10_000)
+    ap.add_argument("--no-ab", action="store_true",
+                    help="skip the pycodec (native-frames-off) drain arm")
     # actors are one PROCESS each (reference parity); this box has one
     # core, so interpreter startup (~0.9s CPU each, measured) bounds the
     # rate — the default keeps the phase ~10-15 min while still proving
@@ -145,7 +192,16 @@ def main() -> int:
     # 9 event loops + dozens of workers time-share ONE core here: a 3s
     # miss-your-heartbeat window would chaos-test implicitly under full
     # load.  Explicit kills still detect instantly via connection drop.
-    c = Cluster(config=RayTpuConfig({"node_death_timeout_ms": 60_000}))
+    config = RayTpuConfig({"node_death_timeout_ms": 60_000})
+    if not args.no_ab:
+        # same-run A/B arm FIRST (fresh box state for both arms is
+        # impossible; adjacency + recorded loadavg is the honest form):
+        # the 8-node drain with the native frame codec disarmed
+        print("== queued tasks (pycodec A/B arm) ==", flush=True)
+        result["tasks_pycodec"] = _drain_phase(
+            args.nodes, args.tasks, config, native_frames=False)
+        print(result["tasks_pycodec"], flush=True)
+    c = Cluster(config=config)
     t0 = time.time()
     nodes = [c.add_node(num_cpus=2, resources={f"n{i}": 1})
              for i in range(args.nodes)]
@@ -156,6 +212,9 @@ def main() -> int:
     try:
         print("== queued tasks ==", flush=True)
         result["tasks"] = bench_tasks(args.tasks)
+        from ray_tpu.core import rt_frames as _rtf
+        result["tasks"]["native_frames"] = _rtf.enabled()
+        result["tasks"]["loadavg_1m"] = _loadavg()
         print(result["tasks"], flush=True)
         print("== broadcast ==", flush=True)
         result["broadcast"] = bench_broadcast(args.broadcast_mb,

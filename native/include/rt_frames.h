@@ -1,0 +1,85 @@
+// Native dispatch-frame codec + MPSC ready-ring (C ABI, loaded from
+// Python via ctypes — see ray_tpu/native/frames.py).
+//
+// Two halves:
+//   * a zero-copy frame encoder/decoder for the control-plane wire
+//     frames (tag 0x03; byte-identical to the pure-Python reference in
+//     ray_tpu/core/rt_frames.py): length-prefixed framing, body
+//     encoding, and the flight-recorder timestamp fold happen in ONE
+//     call producing ONE buffer.  The Python-object adapter is only
+//     compiled when Python.h is available (RTF_NO_PYTHON excludes it
+//     for the pure-C++ unit tests).
+//   * a lock-light multi-producer single-consumer byte ring used as a
+//     send-combining buffer: producers reserve space with one atomic
+//     fetch_add and commit with a release store; the consumer drains
+//     every committed frame into one writev/sendall-sized buffer.
+#pragma once
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// -- growable frame buffer (low-level writer; also used by the codec) --
+
+typedef struct rtf_buf {
+  uint8_t *data;
+  uint64_t len;
+  uint64_t cap;
+} rtf_buf;
+
+int rtf_buf_init(rtf_buf *b, uint64_t initial_cap);
+void rtf_buf_free(rtf_buf *b);
+int rtf_buf_put(rtf_buf *b, const void *src, uint64_t n);
+int rtf_buf_put_u8(rtf_buf *b, uint8_t v);
+int rtf_buf_put_u32(rtf_buf *b, uint32_t v);
+int rtf_buf_put_u64(rtf_buf *b, uint64_t v);
+
+// writer helpers mirroring the wire grammar (docs: rt_frames.py)
+int rtf_w_none(rtf_buf *b);
+int rtf_w_bool(rtf_buf *b, int v);
+int rtf_w_i64(rtf_buf *b, int64_t v);
+int rtf_w_f64(rtf_buf *b, double v);
+int rtf_w_bytes(rtf_buf *b, const uint8_t *p, uint32_t n);
+int rtf_w_str(rtf_buf *b, const char *s, uint32_t n);
+int rtf_w_list(rtf_buf *b, uint32_t count);   // followed by count values
+int rtf_w_tuple(rtf_buf *b, uint32_t count);
+int rtf_w_map(rtf_buf *b, uint32_t count);    // followed by count (k,v)
+
+// Validate one tagged payload (0x03 byte included): structure, bounds,
+// nesting.  Returns 0 ok, negative error code otherwise.  This is the
+// decode-side hardening a corrupted peer frame hits before any Python
+// object is built.
+int rtf_validate(const uint8_t *payload, uint64_t len);
+
+// monotonic clock (CLOCK_MONOTONIC seconds) — the stamp source
+double rtf_monotonic(void);
+
+// -- MPSC ready-ring ---------------------------------------------------
+
+typedef struct rtf_ring rtf_ring;
+
+rtf_ring *rtf_ring_new(uint64_t capacity_bytes);
+void rtf_ring_free(rtf_ring *r);
+// Push one frame (or several pre-concatenated frames).  Returns 0 on
+// success, -1 when the ring lacks space (caller falls back to its
+// locked direct send).  Thread-safe for any number of producers.
+int rtf_ring_push(rtf_ring *r, const uint8_t *data, uint64_t len);
+// Drain every committed record into out (single consumer only).
+// Returns bytes copied; stops early at the first record that does not
+// fit in cap or is not yet committed.
+uint64_t rtf_ring_drain(rtf_ring *r, uint8_t *out, uint64_t cap);
+// Bytes currently reserved (committed or in flight) — cheap hint for
+// "anything to flush?" checks.
+uint64_t rtf_ring_pending(const rtf_ring *r);
+uint64_t rtf_ring_capacity(const rtf_ring *r);
+// Test/debug only: the raw slab, for asserting the zero-behind-tail
+// invariant (every byte the consumer released must read 0, or a
+// next-lap record start could expose stale bytes as a garbage header).
+const uint8_t *rtf_ring_slab(const rtf_ring *r);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif

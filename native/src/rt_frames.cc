@@ -1,0 +1,620 @@
+// Native dispatch-frame codec + MPSC ready-ring.  See rt_frames.h and
+// ray_tpu/core/rt_frames.py (the byte-identical pure-Python reference
+// — tests/test_rt_frames.py fuzzes the parity between the two).
+//
+// The Python-object adapter at the bottom is called through
+// ctypes.PyDLL (GIL held, real PyObject* arguments), so one call
+// encodes a whole message with no per-field ctypes overhead.  The
+// codec core and the ring are plain C++ so the unit tests
+// (tests/frames_test.cc, TSAN target) build without Python.
+
+#include "rt_frames.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <new>
+
+// ---------------------------------------------------------------------------
+// growable buffer
+
+int rtf_buf_init(rtf_buf *b, uint64_t initial_cap) {
+  if (initial_cap < 64) initial_cap = 64;
+  b->data = static_cast<uint8_t *>(std::malloc(initial_cap));
+  b->len = 0;
+  b->cap = b->data ? initial_cap : 0;
+  return b->data ? 0 : -1;
+}
+
+void rtf_buf_free(rtf_buf *b) {
+  std::free(b->data);
+  b->data = nullptr;
+  b->len = b->cap = 0;
+}
+
+static int buf_reserve(rtf_buf *b, uint64_t extra) {
+  if (b->len + extra <= b->cap) return 0;
+  uint64_t cap = b->cap ? b->cap : 64;
+  while (cap < b->len + extra) cap *= 2;
+  uint8_t *p = static_cast<uint8_t *>(std::realloc(b->data, cap));
+  if (!p) return -1;
+  b->data = p;
+  b->cap = cap;
+  return 0;
+}
+
+int rtf_buf_put(rtf_buf *b, const void *src, uint64_t n) {
+  if (buf_reserve(b, n) != 0) return -1;
+  std::memcpy(b->data + b->len, src, n);
+  b->len += n;
+  return 0;
+}
+
+int rtf_buf_put_u8(rtf_buf *b, uint8_t v) { return rtf_buf_put(b, &v, 1); }
+
+int rtf_buf_put_u32(rtf_buf *b, uint32_t v) {
+  uint8_t le[4] = {static_cast<uint8_t>(v), static_cast<uint8_t>(v >> 8),
+                   static_cast<uint8_t>(v >> 16),
+                   static_cast<uint8_t>(v >> 24)};
+  return rtf_buf_put(b, le, 4);
+}
+
+int rtf_buf_put_u64(rtf_buf *b, uint64_t v) {
+  uint8_t le[8];
+  for (int i = 0; i < 8; i++) le[i] = static_cast<uint8_t>(v >> (8 * i));
+  return rtf_buf_put(b, le, 8);
+}
+
+// ---------------------------------------------------------------------------
+// wire-grammar writers (tags documented in rt_frames.py)
+
+int rtf_w_none(rtf_buf *b) { return rtf_buf_put_u8(b, 'N'); }
+
+int rtf_w_bool(rtf_buf *b, int v) { return rtf_buf_put_u8(b, v ? 'T' : 'F'); }
+
+int rtf_w_i64(rtf_buf *b, int64_t v) {
+  if (rtf_buf_put_u8(b, 'I') != 0) return -1;
+  return rtf_buf_put_u64(b, static_cast<uint64_t>(v));
+}
+
+int rtf_w_f64(rtf_buf *b, double v) {
+  uint64_t bits;
+  std::memcpy(&bits, &v, 8);
+  if (rtf_buf_put_u8(b, 'D') != 0) return -1;
+  return rtf_buf_put_u64(b, bits);
+}
+
+int rtf_w_bytes(rtf_buf *b, const uint8_t *p, uint32_t n) {
+  if (rtf_buf_put_u8(b, 'B') != 0 || rtf_buf_put_u32(b, n) != 0) return -1;
+  return rtf_buf_put(b, p, n);
+}
+
+int rtf_w_str(rtf_buf *b, const char *s, uint32_t n) {
+  if (rtf_buf_put_u8(b, 'S') != 0 || rtf_buf_put_u32(b, n) != 0) return -1;
+  return rtf_buf_put(b, s, n);
+}
+
+int rtf_w_list(rtf_buf *b, uint32_t count) {
+  if (rtf_buf_put_u8(b, 'L') != 0) return -1;
+  return rtf_buf_put_u32(b, count);
+}
+
+int rtf_w_tuple(rtf_buf *b, uint32_t count) {
+  if (rtf_buf_put_u8(b, 'U') != 0) return -1;
+  return rtf_buf_put_u32(b, count);
+}
+
+int rtf_w_map(rtf_buf *b, uint32_t count) {
+  if (rtf_buf_put_u8(b, 'M') != 0) return -1;
+  return rtf_buf_put_u32(b, count);
+}
+
+double rtf_monotonic(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + 1e-9 * ts.tv_nsec;
+}
+
+// ---------------------------------------------------------------------------
+// structural validator (decode-side hardening; also the pure-C++ test
+// surface for the grammar)
+
+#define RTF_MAX_DEPTH 32
+
+static int64_t validate_value(const uint8_t *p, uint64_t len, uint64_t pos,
+                              int depth) {
+  if (pos >= len) return -1;
+  uint8_t tag = p[pos++];
+  switch (tag) {
+    case 'N':
+    case 'T':
+    case 'F':
+      return static_cast<int64_t>(pos);
+    case 'I':
+    case 'D':
+      return pos + 8 <= len ? static_cast<int64_t>(pos + 8) : -1;
+    case 'B':
+    case 'S': {
+      if (pos + 4 > len) return -1;
+      uint32_t n;
+      std::memcpy(&n, p + pos, 4);
+      pos += 4;
+      return pos + n <= len ? static_cast<int64_t>(pos + n) : -1;
+    }
+    case 'L':
+    case 'U':
+    case 'M': {
+      if (depth >= RTF_MAX_DEPTH) return -2;
+      if (pos + 4 > len) return -1;
+      uint32_t n;
+      std::memcpy(&n, p + pos, 4);
+      pos += 4;
+      uint32_t slots = (tag == 'M') ? 2 * n : n;
+      for (uint32_t i = 0; i < slots; i++) {
+        if (tag == 'M' && (i % 2) == 0) {
+          if (pos >= len || (p[pos] != 'S' && p[pos] != 'B')) return -3;
+        }
+        int64_t next = validate_value(p, len, pos, depth + 1);
+        if (next < 0) return next;
+        pos = static_cast<uint64_t>(next);
+      }
+      return static_cast<int64_t>(pos);
+    }
+    default:
+      return -4;
+  }
+}
+
+int rtf_validate(const uint8_t *payload, uint64_t len) {
+  if (len < 1 || payload[0] != 0x03) return -5;
+  if (len < 2 || payload[1] != 'M') return -6;  // top level must be a map
+  int64_t end = validate_value(payload, len, 1, 0);
+  if (end < 0) return static_cast<int>(end);
+  return static_cast<uint64_t>(end) == len ? 0 : -7;
+}
+
+// ---------------------------------------------------------------------------
+// MPSC ready-ring
+//
+// Byte slab with two monotonically increasing cursors.  A producer
+// reserves [head, head+size) with one CAS, writes payload, then
+// commits by storing the record length header with release semantics.
+// The single consumer (serialized externally — in ray_tpu the holder
+// of the Connection send lock) walks committed records in order and
+// stops at the first uncommitted one, so FIFO order is preserved even
+// when a slow producer is mid-write behind a fast one.  Record starts
+// are 4-byte aligned so the length header can be stored/loaded
+// atomically; a record never wraps (a PAD record fills the slab tail).
+
+static const uint32_t RTF_PAD = 0xFFFFFFFFu;
+
+struct rtf_ring {
+  uint8_t *slab;
+  uint64_t cap;
+  std::atomic<uint64_t> head;  // producer reservation cursor
+  std::atomic<uint64_t> tail;  // consumer release cursor
+};
+
+rtf_ring *rtf_ring_new(uint64_t capacity_bytes) {
+  if (capacity_bytes < 4096) capacity_bytes = 4096;
+  capacity_bytes = (capacity_bytes + 3) & ~uint64_t(3);
+  rtf_ring *r = new (std::nothrow) rtf_ring;
+  if (!r) return nullptr;
+  r->slab = static_cast<uint8_t *>(std::calloc(1, capacity_bytes));
+  if (!r->slab) {
+    delete r;
+    return nullptr;
+  }
+  r->cap = capacity_bytes;
+  r->head.store(0, std::memory_order_relaxed);
+  r->tail.store(0, std::memory_order_relaxed);
+  return r;
+}
+
+void rtf_ring_free(rtf_ring *r) {
+  if (!r) return;
+  std::free(r->slab);
+  delete r;
+}
+
+static inline void hdr_store(uint8_t *at, uint32_t v,
+                             std::memory_order order) {
+  reinterpret_cast<std::atomic<uint32_t> *>(at)->store(v, order);
+}
+
+static inline uint32_t hdr_load(const uint8_t *at, std::memory_order order) {
+  return reinterpret_cast<const std::atomic<uint32_t> *>(at)->load(order);
+}
+
+int rtf_ring_push(rtf_ring *r, const uint8_t *data, uint64_t len) {
+  if (len == 0 || len > r->cap / 2 || len > 0xFFFFFFFEull) return -1;
+  uint64_t rec = 4 + ((len + 3) & ~uint64_t(3));
+  for (;;) {
+    uint64_t h = r->head.load(std::memory_order_relaxed);
+    uint64_t off = h % r->cap;
+    uint64_t to_end = r->cap - off;
+    uint64_t need = (rec <= to_end) ? rec : to_end + rec;
+    if (h + need - r->tail.load(std::memory_order_acquire) > r->cap)
+      return -1;  // full (caller takes its locked direct-send path)
+    if (rec > to_end) {
+      // reserve the slab tail as a PAD record so this frame starts at 0
+      if (!r->head.compare_exchange_weak(h, h + to_end,
+                                         std::memory_order_acq_rel,
+                                         std::memory_order_relaxed))
+        continue;
+      if (to_end >= 4) hdr_store(r->slab + off, RTF_PAD,
+                                 std::memory_order_release);
+      // (< 4 dead bytes need no marker: the consumer skips short tails)
+      continue;
+    }
+    if (!r->head.compare_exchange_weak(h, h + rec,
+                                       std::memory_order_acq_rel,
+                                       std::memory_order_relaxed))
+      continue;
+    std::memcpy(r->slab + off + 4, data, len);
+    hdr_store(r->slab + off, static_cast<uint32_t>(len),
+              std::memory_order_release);
+    return 0;
+  }
+}
+
+uint64_t rtf_ring_drain(rtf_ring *r, uint8_t *out, uint64_t cap) {
+  uint64_t t = r->tail.load(std::memory_order_relaxed);
+  uint64_t h = r->head.load(std::memory_order_acquire);
+  uint64_t copied = 0;
+  while (t < h) {
+    uint64_t off = t % r->cap;
+    uint64_t to_end = r->cap - off;
+    if (to_end < 4) {  // unmarked dead tail
+      std::memset(r->slab + off, 0, to_end);
+      t += to_end;
+      r->tail.store(t, std::memory_order_release);
+      continue;
+    }
+    uint32_t len = hdr_load(r->slab + off, std::memory_order_acquire);
+    if (len == 0) break;  // reserved but uncommitted: stop (FIFO)
+    if (len == RTF_PAD) {
+      hdr_store(r->slab + off, 0, std::memory_order_relaxed);
+      if (to_end > 4) std::memset(r->slab + off + 4, 0, to_end - 4);
+      t += to_end;
+      r->tail.store(t, std::memory_order_release);
+      continue;
+    }
+    uint64_t rec = 4 + ((uint64_t(len) + 3) & ~uint64_t(3));
+    if (len > to_end - 4 || copied + len > cap)
+      break;  // corrupt-length guard / caller's buffer is full
+    std::memcpy(out + copied, r->slab + off + 4, len);
+    copied += len;
+    // Zero the WHOLE drained region — header AND payload — before
+    // releasing it.  Record boundaries shift between laps (sizes
+    // vary), so a byte that is record INTERIOR this lap can be a
+    // record START next lap: if only headers were zeroed, a consumer
+    // arriving at that next-lap record between its reservation and its
+    // commit would read stale payload bytes as a committed garbage
+    // length (found as rare corrupted frames -> wire desync under the
+    // 8-node broadcast load).  Every position behind tail being zero
+    // is the invariant that makes `len == 0` mean "uncommitted".
+    hdr_store(r->slab + off, 0, std::memory_order_relaxed);
+    std::memset(r->slab + off + 4, 0, rec - 4);
+    t += rec;
+    r->tail.store(t, std::memory_order_release);
+  }
+  return copied;
+}
+
+uint64_t rtf_ring_pending(const rtf_ring *r) {
+  return r->head.load(std::memory_order_acquire) -
+         r->tail.load(std::memory_order_acquire);
+}
+
+uint64_t rtf_ring_capacity(const rtf_ring *r) { return r->cap; }
+
+const uint8_t *rtf_ring_slab(const rtf_ring *r) { return r->slab; }
+
+extern "C" int rtf_abi_version(void) { return 1; }
+
+// ---------------------------------------------------------------------------
+// Python-object adapter (ctypes.PyDLL: the GIL is held across calls).
+// Excluded from the pure-C++ unit-test builds via RTF_NO_PYTHON.
+
+#ifndef RTF_NO_PYTHON
+#include <Python.h>
+
+struct stamp_ctx {
+  const char *stage;
+  uint32_t stage_len;
+  double now;
+  int done;
+};
+
+// 0 = ok, 1 = ineligible (caller falls back to pickle).  Allocation
+// failure is folded into "ineligible" — pickle then takes over.
+static int enc_value(rtf_buf *b, PyObject *v, int depth, stamp_ctx *sc);
+
+static int enc_list_stamped(rtf_buf *b, PyObject *list, int depth,
+                            stamp_ctx *sc) {
+  // the appended (stage, t) tuple sits one level below this list; the
+  // Python reference runs its container depth check on that tuple, so
+  // the fold must be ineligible at the same boundary or the two
+  // encoders diverge (and the frame would nest past what decoders
+  // accept)
+  if (depth + 1 >= RTF_MAX_DEPTH) return 1;
+  Py_ssize_t n = PyList_GET_SIZE(list);
+  if (n + 1 > 0xFFFFFFFELL) return 1;
+  if (rtf_w_list(b, static_cast<uint32_t>(n + 1)) != 0) return 1;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (enc_value(b, PyList_GET_ITEM(list, i), depth + 1, nullptr) != 0)
+      return 1;
+  }
+  if (rtf_w_tuple(b, 2) != 0) return 1;
+  if (rtf_w_str(b, sc->stage, sc->stage_len) != 0) return 1;
+  if (rtf_w_f64(b, sc->now) != 0) return 1;
+  return 0;
+}
+
+static int enc_value(rtf_buf *b, PyObject *v, int depth, stamp_ctx *sc) {
+  if (v == Py_None) return rtf_w_none(b) == 0 ? 0 : 1;
+  if (PyBool_Check(v)) return rtf_w_bool(b, v == Py_True) == 0 ? 0 : 1;
+  if (PyLong_CheckExact(v)) {
+    long long x = PyLong_AsLongLong(v);
+    if (x == -1 && PyErr_Occurred()) {
+      PyErr_Clear();
+      return 1;  // out of i64 range
+    }
+    return rtf_w_i64(b, x) == 0 ? 0 : 1;
+  }
+  if (PyFloat_CheckExact(v))
+    return rtf_w_f64(b, PyFloat_AS_DOUBLE(v)) == 0 ? 0 : 1;
+  if (PyBytes_CheckExact(v)) {
+    Py_ssize_t n = PyBytes_GET_SIZE(v);
+    if (n > 0xFFFFFFFFLL) return 1;
+    return rtf_w_bytes(
+               b,
+               reinterpret_cast<const uint8_t *>(PyBytes_AS_STRING(v)),
+               static_cast<uint32_t>(n)) == 0
+               ? 0
+               : 1;
+  }
+  if (PyUnicode_CheckExact(v)) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) {
+      PyErr_Clear();
+      return 1;  // unencodable (lone surrogates)
+    }
+    if (n > 0xFFFFFFFFLL) return 1;
+    return rtf_w_str(b, s, static_cast<uint32_t>(n)) == 0 ? 0 : 1;
+  }
+  if (depth >= RTF_MAX_DEPTH) return 1;
+  if (PyList_CheckExact(v) || PyTuple_CheckExact(v)) {
+    int is_list = PyList_CheckExact(v);
+    Py_ssize_t n = is_list ? PyList_GET_SIZE(v) : PyTuple_GET_SIZE(v);
+    if (n > 0xFFFFFFFFLL) return 1;
+    if ((is_list ? rtf_w_list(b, static_cast<uint32_t>(n))
+                 : rtf_w_tuple(b, static_cast<uint32_t>(n))) != 0)
+      return 1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *item =
+          is_list ? PyList_GET_ITEM(v, i) : PyTuple_GET_ITEM(v, i);
+      if (enc_value(b, item, depth + 1, sc) != 0) return 1;
+    }
+    return 0;
+  }
+  if (PyDict_CheckExact(v)) {
+    Py_ssize_t n = PyDict_GET_SIZE(v);
+    if (n > 0xFFFFFFFFLL) return 1;
+    if (rtf_w_map(b, static_cast<uint32_t>(n)) != 0) return 1;
+    PyObject *k, *val;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(v, &pos, &k, &val)) {
+      int k_is_str = PyUnicode_CheckExact(k);
+      if (!k_is_str && !PyBytes_CheckExact(k)) return 1;
+      if (enc_value(b, k, depth + 1, nullptr) != 0) return 1;
+      // flight-recorder stamp fold: first "fr" list in pre-order
+      if (sc && !sc->done && k_is_str && PyList_CheckExact(val)) {
+        Py_ssize_t kn;
+        const char *ks = PyUnicode_AsUTF8AndSize(k, &kn);
+        if (ks && kn == 2 && ks[0] == 'f' && ks[1] == 'r') {
+          sc->done = 1;
+          if (enc_list_stamped(b, val, depth + 1, sc) != 0) return 1;
+          continue;
+        }
+        if (!ks) PyErr_Clear();
+      }
+      if (enc_value(b, val, depth + 1, sc) != 0) return 1;
+    }
+    return 0;
+  }
+  return 1;  // outside the wire universe
+}
+
+// dict -> complete wire frame bytes (8-byte LE length prefix + 0x03 +
+// body), or None when the message is ineligible (caller pickles).
+// stage == NULL means no stamp; now < 0 reads CLOCK_MONOTONIC.
+extern "C" PyObject *rtf_encode_frame(PyObject *msg, const char *stage,
+                                      double now) {
+  if (!PyDict_CheckExact(msg)) Py_RETURN_NONE;
+  stamp_ctx sc_storage, *sc = nullptr;
+  if (stage) {
+    sc_storage.stage = stage;
+    sc_storage.stage_len = static_cast<uint32_t>(std::strlen(stage));
+    sc_storage.now = now < 0 ? rtf_monotonic() : now;
+    sc_storage.done = 0;
+    sc = &sc_storage;
+  }
+  rtf_buf b;
+  if (rtf_buf_init(&b, 512) != 0) Py_RETURN_NONE;
+  // length-prefix placeholder, patched below
+  if (rtf_buf_put_u64(&b, 0) != 0 || rtf_buf_put_u8(&b, 0x03) != 0 ||
+      enc_value(&b, msg, 0, sc) != 0) {
+    rtf_buf_free(&b);
+    Py_RETURN_NONE;
+  }
+  uint64_t payload_len = b.len - 8;
+  for (int i = 0; i < 8; i++)
+    b.data[i] = static_cast<uint8_t>(payload_len >> (8 * i));
+  PyObject *out = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(b.data), static_cast<Py_ssize_t>(b.len));
+  rtf_buf_free(&b);
+  if (!out) {
+    PyErr_Clear();
+    Py_RETURN_NONE;
+  }
+  return out;
+}
+
+// -- decoding ----------------------------------------------------------
+
+static PyObject *dec_value(const uint8_t *p, uint64_t len, uint64_t *pos,
+                           int depth) {
+  if (*pos >= len) {
+    PyErr_SetString(PyExc_ValueError, "rt_frames: truncated frame");
+    return nullptr;
+  }
+  uint8_t tag = p[(*pos)++];
+  switch (tag) {
+    case 'N':
+      Py_RETURN_NONE;
+    case 'T':
+      Py_RETURN_TRUE;
+    case 'F':
+      Py_RETURN_FALSE;
+    case 'I': {
+      if (*pos + 8 > len) break;
+      uint64_t bits = 0;
+      std::memcpy(&bits, p + *pos, 8);
+      *pos += 8;
+      return PyLong_FromLongLong(static_cast<int64_t>(bits));
+    }
+    case 'D': {
+      if (*pos + 8 > len) break;
+      double d;
+      std::memcpy(&d, p + *pos, 8);
+      *pos += 8;
+      return PyFloat_FromDouble(d);
+    }
+    case 'B':
+    case 'S': {
+      if (*pos + 4 > len) break;
+      uint32_t n;
+      std::memcpy(&n, p + *pos, 4);
+      *pos += 4;
+      if (*pos + n > len) break;
+      const char *s = reinterpret_cast<const char *>(p + *pos);
+      *pos += n;
+      if (tag == 'B') return PyBytes_FromStringAndSize(s, n);
+      PyObject *u = PyUnicode_DecodeUTF8(s, n, nullptr);
+      if (!u) {
+        PyErr_Clear();
+        PyErr_SetString(PyExc_ValueError, "rt_frames: bad utf-8");
+      }
+      return u;
+    }
+    case 'L':
+    case 'U': {
+      if (depth >= RTF_MAX_DEPTH || *pos + 4 > len) break;
+      uint32_t n;
+      std::memcpy(&n, p + *pos, 4);
+      *pos += 4;
+      // a corrupted count must not pre-allocate gigabytes: each item
+      // needs >= 1 byte of payload
+      if (n > len - (*pos < len ? *pos : len) && n > 0) break;
+      PyObject *seq = (tag == 'L') ? PyList_New(n) : PyTuple_New(n);
+      if (!seq) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        PyObject *item = dec_value(p, len, pos, depth + 1);
+        if (!item) {
+          Py_DECREF(seq);
+          return nullptr;
+        }
+        if (tag == 'L')
+          PyList_SET_ITEM(seq, i, item);
+        else
+          PyTuple_SET_ITEM(seq, i, item);
+      }
+      return seq;
+    }
+    case 'M': {
+      if (depth >= RTF_MAX_DEPTH || *pos + 4 > len) break;
+      uint32_t n;
+      std::memcpy(&n, p + *pos, 4);
+      *pos += 4;
+      PyObject *d = PyDict_New();
+      if (!d) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        if (*pos >= len || (p[*pos] != 'S' && p[*pos] != 'B')) {
+          Py_DECREF(d);
+          PyErr_SetString(PyExc_ValueError,
+                          "rt_frames: map key must be str or bytes");
+          return nullptr;
+        }
+        PyObject *k = dec_value(p, len, pos, depth + 1);
+        if (!k) {
+          Py_DECREF(d);
+          return nullptr;
+        }
+        PyObject *val = dec_value(p, len, pos, depth + 1);
+        if (!val || PyDict_SetItem(d, k, val) != 0) {
+          Py_XDECREF(val);
+          Py_DECREF(k);
+          Py_DECREF(d);
+          return nullptr;
+        }
+        Py_DECREF(k);
+        Py_DECREF(val);
+      }
+      return d;
+    }
+    default:
+      PyErr_Format(PyExc_ValueError, "rt_frames: unknown value tag 0x%02x",
+                   tag);
+      return nullptr;
+  }
+  PyErr_SetString(PyExc_ValueError, "rt_frames: truncated frame");
+  return nullptr;
+}
+
+// tagged payload (0x03 included) -> dict; raises ValueError on a
+// malformed frame.  Accepts any buffer-protocol object.
+extern "C" PyObject *rtf_decode_payload(PyObject *src) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(src, &view, PyBUF_SIMPLE) != 0) return nullptr;
+  const uint8_t *p = static_cast<const uint8_t *>(view.buf);
+  uint64_t len = static_cast<uint64_t>(view.len);
+  PyObject *out = nullptr;
+  if (len < 1 || p[0] != 0x03) {
+    PyErr_SetString(PyExc_ValueError, "rt_frames: not an rt-frames payload");
+  } else {
+    uint64_t pos = 1;
+    out = dec_value(p, len, &pos, 0);
+    if (out && pos != len) {
+      Py_CLEAR(out);
+      PyErr_SetString(PyExc_ValueError, "rt_frames: trailing bytes");
+    }
+    if (out && !PyDict_CheckExact(out)) {
+      Py_CLEAR(out);
+      PyErr_SetString(PyExc_ValueError,
+                      "rt_frames: top-level value must be a map");
+    }
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+// drain the ring into one fresh bytes object (may be empty)
+extern "C" PyObject *rtf_ring_drain_py(rtf_ring *r) {
+  uint64_t bound = rtf_ring_pending(r);
+  if (bound == 0) return PyBytes_FromStringAndSize(nullptr, 0);
+  PyObject *out = PyBytes_FromStringAndSize(nullptr,
+                                            static_cast<Py_ssize_t>(bound));
+  if (!out) return nullptr;
+  uint64_t n = rtf_ring_drain(
+      r, reinterpret_cast<uint8_t *>(PyBytes_AS_STRING(out)), bound);
+  if (n < bound &&
+      _PyBytes_Resize(&out, static_cast<Py_ssize_t>(n)) != 0)
+    return nullptr;
+  return out;
+}
+
+#endif  // RTF_NO_PYTHON

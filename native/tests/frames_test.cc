@@ -1,0 +1,268 @@
+// Unit tests for the frame codec core + MPSC ready-ring (no Python:
+// built with -DRTF_NO_PYTHON; the PyObject adapter is covered from
+// Python by tests/test_rt_frames.py's fuzz parity suite).
+//
+// Build/run:  make -C native frames_test
+// TSAN:       make -C native frames_tsan
+
+#include "rt_frames.h"
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+static int failures = 0;
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,      \
+                   #cond);                                              \
+      failures++;                                                       \
+    }                                                                   \
+  } while (0)
+
+// -- grammar writers + validator ---------------------------------------
+
+static void test_codec_roundtrip_shape() {
+  rtf_buf b;
+  CHECK(rtf_buf_init(&b, 16) == 0);
+  // payload for {"t": "task_done", "task_id": b"\x01\x02", "error":
+  //              None, "n": 7, "x": 1.5, "fr": [("submit", 0.25)],
+  //              "flags": (True, False)}
+  CHECK(rtf_buf_put_u8(&b, 0x03) == 0);
+  CHECK(rtf_w_map(&b, 7) == 0);
+  CHECK(rtf_w_str(&b, "t", 1) == 0);
+  CHECK(rtf_w_str(&b, "task_done", 9) == 0);
+  CHECK(rtf_w_str(&b, "task_id", 7) == 0);
+  const uint8_t tid[2] = {1, 2};
+  CHECK(rtf_w_bytes(&b, tid, 2) == 0);
+  CHECK(rtf_w_str(&b, "error", 5) == 0);
+  CHECK(rtf_w_none(&b) == 0);
+  CHECK(rtf_w_str(&b, "n", 1) == 0);
+  CHECK(rtf_w_i64(&b, 7) == 0);
+  CHECK(rtf_w_str(&b, "x", 1) == 0);
+  CHECK(rtf_w_f64(&b, 1.5) == 0);
+  CHECK(rtf_w_str(&b, "fr", 2) == 0);
+  CHECK(rtf_w_list(&b, 1) == 0);
+  CHECK(rtf_w_tuple(&b, 2) == 0);
+  CHECK(rtf_w_str(&b, "submit", 6) == 0);
+  CHECK(rtf_w_f64(&b, 0.25) == 0);
+  CHECK(rtf_w_str(&b, "flags", 5) == 0);
+  CHECK(rtf_w_tuple(&b, 2) == 0);
+  CHECK(rtf_w_bool(&b, 1) == 0);
+  CHECK(rtf_w_bool(&b, 0) == 0);
+  CHECK(rtf_validate(b.data, b.len) == 0);
+
+  // every truncation of a valid frame must be rejected, never read OOB
+  for (uint64_t cut = 0; cut < b.len; cut++)
+    CHECK(rtf_validate(b.data, cut) != 0);
+  // flipped tag byte -> not an rt-frames payload
+  b.data[0] = 0x00;
+  CHECK(rtf_validate(b.data, b.len) != 0);
+  b.data[0] = 0x03;
+  // non-map top level
+  const uint8_t not_map[2] = {0x03, 'N'};
+  CHECK(rtf_validate(not_map, 2) != 0);
+  // map key with a non-key tag
+  rtf_buf bad;
+  CHECK(rtf_buf_init(&bad, 16) == 0);
+  CHECK(rtf_buf_put_u8(&bad, 0x03) == 0);
+  CHECK(rtf_w_map(&bad, 1) == 0);
+  CHECK(rtf_w_i64(&bad, 3) == 0);
+  CHECK(rtf_w_none(&bad) == 0);
+  CHECK(rtf_validate(bad.data, bad.len) != 0);
+  rtf_buf_free(&bad);
+  rtf_buf_free(&b);
+}
+
+static void test_nesting_bound() {
+  // 40 levels of [[...]] exceeds RTF_MAX_DEPTH and must be rejected
+  rtf_buf b;
+  CHECK(rtf_buf_init(&b, 16) == 0);
+  CHECK(rtf_buf_put_u8(&b, 0x03) == 0);
+  CHECK(rtf_w_map(&b, 1) == 0);
+  CHECK(rtf_w_str(&b, "k", 1) == 0);
+  for (int i = 0; i < 40; i++) CHECK(rtf_w_list(&b, 1) == 0);
+  CHECK(rtf_w_none(&b) == 0);
+  CHECK(rtf_validate(b.data, b.len) != 0);
+  rtf_buf_free(&b);
+}
+
+static void test_buffer_growth() {
+  rtf_buf b;
+  CHECK(rtf_buf_init(&b, 16) == 0);
+  std::string big(100000, 'x');
+  CHECK(rtf_buf_put_u8(&b, 0x03) == 0);
+  CHECK(rtf_w_map(&b, 1) == 0);
+  CHECK(rtf_w_str(&b, "data", 4) == 0);
+  CHECK(rtf_w_bytes(&b, reinterpret_cast<const uint8_t *>(big.data()),
+                    static_cast<uint32_t>(big.size())) == 0);
+  CHECK(rtf_validate(b.data, b.len) == 0);
+  CHECK(b.len == 1 + 5 + (5 + 4) + (5 + big.size()));
+  rtf_buf_free(&b);
+}
+
+// -- ring --------------------------------------------------------------
+
+static void test_ring_basic() {
+  rtf_ring *r = rtf_ring_new(4096);
+  CHECK(r != nullptr);
+  CHECK(rtf_ring_pending(r) == 0);
+  CHECK(rtf_ring_push(r, reinterpret_cast<const uint8_t *>("hello"), 5) == 0);
+  CHECK(rtf_ring_push(r, reinterpret_cast<const uint8_t *>("world!"), 6) ==
+        0);
+  CHECK(rtf_ring_pending(r) > 0);
+  uint8_t out[64];
+  uint64_t n = rtf_ring_drain(r, out, sizeof(out));
+  CHECK(n == 11);
+  CHECK(std::memcmp(out, "helloworld!", 11) == 0);
+  CHECK(rtf_ring_pending(r) == 0);
+  // empty push is rejected, oversized push is rejected
+  CHECK(rtf_ring_push(r, out, 0) == -1);
+  std::vector<uint8_t> huge(4096, 7);
+  CHECK(rtf_ring_push(r, huge.data(), huge.size()) == -1);
+  rtf_ring_free(r);
+}
+
+static void test_ring_wraparound() {
+  rtf_ring *r = rtf_ring_new(4096);
+  uint8_t frame[97];  // deliberately unaligned record size
+  uint8_t out[4096];
+  uint64_t total = 0;
+  for (int lap = 0; lap < 500; lap++) {
+    for (int i = 0; i < 3; i++) {
+      std::memset(frame, lap % 251, sizeof(frame));
+      CHECK(rtf_ring_push(r, frame, sizeof(frame)) == 0);
+    }
+    uint64_t n = rtf_ring_drain(r, out, sizeof(out));
+    CHECK(n == 3 * sizeof(frame));
+    for (uint64_t j = 0; j < n; j++) CHECK(out[j] == lap % 251);
+    total += n;
+  }
+  CHECK(total == 500u * 3u * sizeof(frame));
+  rtf_ring_free(r);
+}
+
+static void test_ring_full_then_recovers() {
+  rtf_ring *r = rtf_ring_new(4096);
+  uint8_t frame[1000];
+  int pushed = 0;
+  while (rtf_ring_push(r, frame, sizeof(frame)) == 0) pushed++;
+  CHECK(pushed >= 3);  // 4 KiB ring holds at least 3 x 1 KiB records
+  uint8_t out[4096];
+  CHECK(rtf_ring_drain(r, out, sizeof(out)) ==
+        static_cast<uint64_t>(pushed) * sizeof(frame));
+  CHECK(rtf_ring_push(r, frame, sizeof(frame)) == 0);  // space came back
+  rtf_ring_free(r);
+}
+
+// Regression: the zero-behind-tail invariant.  Record boundaries shift
+// between laps (varied sizes + pads), so a position that was record
+// INTERIOR last lap can be a record START this lap; unless drain zeroes
+// the whole released region, a consumer at an uncommitted next-lap
+// record start reads stale payload bytes as a committed garbage length
+// (observed as rare corrupted frames under the broadcast bench).
+static void test_ring_zero_behind_tail_across_laps() {
+  rtf_ring *ring = rtf_ring_new(4096);
+  uint8_t frame[2048];
+  std::memset(frame, 0xAB, sizeof(frame));  // nonzero stale payload
+  uint8_t out[4096];
+  // varied sizes force boundary misalignment across laps
+  const uint64_t sizes[] = {97, 1000, 13, 512, 61, 2000, 5, 300};
+  for (int lap = 0; lap < 300; lap++) {
+    uint64_t n1 = sizes[lap % 8], n2 = sizes[(lap + 3) % 8];
+    CHECK(rtf_ring_push(ring, frame, n1) == 0);
+    CHECK(rtf_ring_push(ring, frame, n2) == 0);
+    CHECK(rtf_ring_drain(ring, out, sizeof(out)) == n1 + n2);
+    // invariant: with the ring empty, EVERY slab byte reads zero
+    const uint8_t *slab = rtf_ring_slab(ring);
+    for (uint64_t i = 0; i < rtf_ring_capacity(ring); i++)
+      if (slab[i] != 0) {
+        CHECK(slab[i] == 0);
+        break;
+      }
+  }
+  rtf_ring_free(ring);
+}
+
+// Concurrency stress: N producers push length-self-describing records,
+// one consumer drains until every record arrived intact and in a
+// per-producer FIFO order.  This is the TSAN target's main course.
+static void test_ring_mpsc_stress() {
+  rtf_ring *r = rtf_ring_new(1 << 16);
+  const int kProducers = 4;
+  const int kPerProducer = 20000;
+  std::atomic<int> total_pushed{0};
+  std::vector<std::thread> producers;
+  for (int p = 0; p < kProducers; p++) {
+    producers.emplace_back([&, p] {
+      uint8_t frame[32];
+      for (int i = 0; i < kPerProducer; i++) {
+        // record: [producer u8][seq u32][len u8][payload of len bytes]
+        uint8_t len = static_cast<uint8_t>(1 + (i * 7 + p) % 24);
+        frame[0] = static_cast<uint8_t>(p);
+        std::memcpy(frame + 1, &i, 4);
+        frame[5] = len;
+        for (int j = 0; j < len; j++)
+          frame[6 + j] = static_cast<uint8_t>(p * 31 + i + j);
+        while (rtf_ring_push(r, frame, 6u + len) != 0)
+          std::this_thread::yield();  // full: wait for the consumer
+        total_pushed.fetch_add(1, std::memory_order_relaxed);
+      }
+    });
+  }
+  std::vector<int> next_seq(kProducers, 0);
+  std::vector<uint8_t> out(1 << 16);
+  int received = 0;
+  int idle_spins = 0;
+  while (received < kProducers * kPerProducer) {
+    uint64_t n = rtf_ring_drain(r, out.data(), out.size());
+    if (n == 0) {
+      if (++idle_spins > 100000000) break;  // deadlock guard
+      std::this_thread::yield();
+      continue;
+    }
+    idle_spins = 0;
+    uint64_t pos = 0;
+    while (pos < n) {
+      CHECK(pos + 6 <= n);
+      int p = out[pos];
+      int seq;
+      std::memcpy(&seq, out.data() + pos + 1, 4);
+      uint8_t len = out[pos + 5];
+      CHECK(p >= 0 && p < kProducers);
+      CHECK(seq == next_seq[p]);  // per-producer FIFO survives
+      next_seq[p] = seq + 1;
+      CHECK(pos + 6 + len <= n);
+      for (int j = 0; j < len; j++)
+        CHECK(out[pos + 6 + j] == static_cast<uint8_t>(p * 31 + seq + j));
+      pos += 6u + len;
+      received++;
+    }
+  }
+  for (auto &t : producers) t.join();
+  CHECK(received == kProducers * kPerProducer);
+  CHECK(rtf_ring_pending(r) == 0);
+  rtf_ring_free(r);
+}
+
+int main() {
+  test_codec_roundtrip_shape();
+  test_nesting_bound();
+  test_buffer_growth();
+  test_ring_basic();
+  test_ring_wraparound();
+  test_ring_full_then_recovers();
+  test_ring_zero_behind_tail_across_laps();
+  test_ring_mpsc_stress();
+  if (failures) {
+    std::fprintf(stderr, "%d failure(s)\n", failures);
+    return 1;
+  }
+  std::printf("frames_test: all ok\n");
+  return 0;
+}

@@ -215,7 +215,8 @@ class CallGraph:
     def key(self, module_key: str, qualname: str) -> str:
         return f"{module_key}:{qualname}"
 
-    def resolve_self(self, cls: str, meth: str) -> Optional[str]:
+    def resolve_self(self, cls: str, meth: str,
+                     _downward: bool = True) -> Optional[str]:
         seen = set()
         queue = deque([cls])
         while queue:
@@ -228,6 +229,24 @@ class CallGraph:
                 return key
             _, bases = self.classes.get(c, ("", []))
             queue.extend(bases)
+        if not _downward:
+            return None
+        # Downward fallback — mixin composition: a stateless mixin's
+        # method calls a SIBLING mixin's method through self, and the
+        # definition lives in another base of the composed class (the
+        # node split: NodeSchedMixin._schedule -> self._maybe_spawn_
+        # worker in NodeWorkersMixin, composed by NodeService).  Resolve
+        # through classes that inherit `cls`, one level of composition,
+        # and only when every composition agrees on ONE definition —
+        # ambiguity is dropped, not guessed, like unique-name dispatch.
+        found = set()
+        for sub, (_, bases) in self.classes.items():
+            if cls in bases:
+                key = self.resolve_self(sub, meth, _downward=False)
+                if key is not None:
+                    found.add(key)
+        if len(found) == 1:
+            return found.pop()
         return None
 
     def edges(self, key: str) -> list:

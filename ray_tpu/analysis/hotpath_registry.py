@@ -36,16 +36,22 @@ HOT_GATES: dict = {
         },
     },
     "ray_tpu.core.protocol": {
-        "aliases": ("_fi",),
+        # _rtf is the native frame codec (core/rt_frames.py): same
+        # zero-overhead promise — disarmed, every frame takes the
+        # pre-existing pickle path after one load + is-None branch
+        "aliases": ("_fi", "_rtf"),
         # the chaos delay call sits inside the armed branch — it never
         # executes disabled, so the registry allows the deref by name
         "extra_attrs": ("apply_delay",),
         "functions": {
+            "Connection.enable_ring": "gate",
             "Connection.send": "gate",
             "Connection.send_blob": "gate",
             "Connection.send_batch": "gate",
             "Connection.recv": "gate",
             "_chaos_filter": "use",
+            "decode_payload": "gate",
+            "dumps_frame": "gate",
         },
     },
     "ray_tpu.core.local_lane": {
@@ -56,25 +62,43 @@ HOT_GATES: dict = {
             "LaneConnection._deliver": "gate",
         },
     },
+    # the node service is four modules since the round-12 split (node.py
+    # shell + workers/transfer/sched mixins); each module registers the
+    # hook sites it now hosts
     "ray_tpu.core.node": {
         "aliases": ("_fi", "_fr"),
         "functions": {
-            # flight-recorder lifecycle stamps (hot: every task)
-            "NodeService._admit_task": "gate",
-            "NodeService._forward_task": "gate",
-            "NodeService._make_runnable": "gate",
-            "NodeService._h_task_done": "gate",
-            "NodeService._dispatch_task": "gate",    # also _fi dispatch kill
-            "NodeService._h_submit_actor_task": "gate",
-            "NodeService._dispatch_actor_queue": "gate",
-            "NodeService._fr_finish": "gate",
             "NodeService._h_flight_recorder": "gate",
-            # colder paths that still honor the gate shape
-            "NodeService._hh_node_dead": "gate",
             "NodeService.on_client_drop": "gate",
-            "NodeService._spawn_worker_proc": "gate",  # _fi spawn verdict
             # arming/teardown — contract-exempt by design
             "NodeService.__init__": "cold",
+        },
+    },
+    "ray_tpu.core.node_sched": {
+        "aliases": ("_fi", "_fr", "_rtf"),
+        "functions": {
+            # flight-recorder lifecycle stamps (hot: every task); the
+            # dispatch sites also gate _rtf for the C-side stamp fold
+            "NodeSchedMixin._admit_task": "gate",
+            "NodeSchedMixin._forward_task": "gate",
+            "NodeSchedMixin._make_runnable": "gate",
+            "NodeSchedMixin._h_task_done": "gate",
+            "NodeSchedMixin._dispatch_task": "gate",  # also _fi kill
+            "NodeSchedMixin._h_submit_actor_task": "gate",
+            "NodeSchedMixin._dispatch_actor_queue": "gate",
+            "NodeSchedMixin._fr_finish": "gate",
+        },
+    },
+    "ray_tpu.core.node_transfer": {
+        "aliases": ("_fr",),
+        "functions": {
+            "NodeTransferMixin._hh_node_dead": "gate",
+        },
+    },
+    "ray_tpu.core.node_workers": {
+        "aliases": ("_fi",),
+        "functions": {
+            "NodeWorkersMixin._spawn_worker_proc": "gate",  # _fi spawn
         },
     },
     "ray_tpu.core.runtime": {

@@ -198,6 +198,13 @@ class NodeClient:
         self.node_id: str = info["node_id"]
         self.config_dict: dict = info["config"]
         self._retry_policy = RetryPolicy.from_config(self.config_dict)
+        if self._recv_thread is not None:
+            # socket channel (workers, remote drivers): arm the native
+            # send-combining ring so concurrent senders — actor executor
+            # threads on the done-return leg, driver threads mid-burst —
+            # batch their preassembled frames into one syscall.  No-op
+            # without the native codec (core/rt_frames.py).
+            self.conn.enable_ring()
         self.shm = make_shm_client(self.session,
                                    native=bool(info.get("native_store")),
                                    on_full=self._need_space)

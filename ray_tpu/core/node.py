@@ -1,33 +1,30 @@
 """Node service: the per-node daemon (raylet analogue).
 
-Local half (reference: src/ray/raylet/node_manager.cc
-HandleRequestWorkerLease:1822, worker_pool.h, local_task_manager.h):
+The node was ONE ~4,000-line module through round 10; it is now split
+along its three planes, with this file left as the service shell —
+composition, lifecycle, and the head channel:
 
-  * task scheduling + worker pool
-  * object directory + inline store + shm bookkeeping + spilling
-    (reference: core_worker memory_store.h, plasma store.h,
-    local_object_manager.h)
-  * actor execution management, per-actor queues, local restart
-  * placement-group bundle reservation (2PC participant)
+  * ``node_workers.py`` — worker pool / prefork / liveness / OOM
+    (reference: worker_pool.h, memory_monitor.h)
+  * ``node_transfer.py`` — object directory + transfer + relay + shm
+    bookkeeping + ownership/lineage recovery (reference:
+    object_manager.h, plasma store.h, object_recovery_manager.h)
+  * ``node_sched.py`` — task/actor/placement-group scheduling, parking,
+    spillover + rebalance (reference: local_task_manager.h,
+    cluster_task_manager.h)
 
-Cluster half (active when ``head_address`` is set; reference splits this
-between the raylet, the object manager, and the GCS client):
+State stays SINGLE-OWNER: every attribute is created in
+``NodeService.__init__`` here, and the mixins are stateless method
+bundles over that state (the event loop remains one thread, so no new
+synchronization appears with the split).  ``ray_tpu lint`` resolves
+cross-mixin ``self`` calls through this composed class — the protocol /
+blocking / hotpath / locks invariants that made the split safe keep
+gating all four modules.
 
-  * head channel: register, heartbeat, resource view sync
-    (reference: ray_syncer.h:30)
-  * task spillover / routing through the head when local resources
-    can't satisfy demand (reference: cluster_task_manager.h:33)
-  * chunked node-to-node object transfer over lazy peer connections
-    (reference: object_manager.h:117 Push/Pull, object_manager.proto:61)
-  * actor-task forwarding to the owning node, with head-side location
-    lookup + caching (reference: direct_actor_task_submitter.h)
-  * proxying of cluster-scope client requests (KV, pubsub, named actors,
-    placement groups, functions) so drivers/workers only ever talk to
-    their local node
-  * node-death recovery: resubmit forwarded tasks whose returns were
-    lost, fail in-flight calls to actors on dead nodes
-
-Without a head this service runs standalone exactly as in round 1: the
+Cluster half (active when ``head_address`` is set): head channel
+(register / heartbeat / view sync, reference: ray_syncer.h:30), task
+spillover routing, cluster-scope request proxying, and node-death
+recovery hooks.  Without a head this service runs standalone: the
 single-node control plane fused into one loop.  Runs as a thread inside
 the driver (default, ``ray_tpu.init()``) or standalone
 (``python -m ray_tpu.core.node``).
@@ -36,193 +33,40 @@ the driver (default, ``ray_tpu.init()``) or standalone
 from __future__ import annotations
 
 import os
-import signal
 import subprocess
 import sys
 import threading
 import time
 import traceback
-import pickle
 from collections import deque
-from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ray_tpu._config import RayTpuConfig
 from ray_tpu.core import fault_injection as _fi
 from ray_tpu.core import flight_recorder as _fr
 from ray_tpu.core import protocol
-from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID
-from ray_tpu.core.resources import bundle_total, covers
-from ray_tpu.core.object_store import (NativeObjectStoreCore, ObjectExists,
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID
+from ray_tpu.core.object_store import (NativeObjectStoreCore,
                                        make_object_store_core)
 from ray_tpu.core.service import (ClientRec, ClusterStoreMixin,
                                   EventLoopService)
+from ray_tpu.core.node_workers import (NodeWorkersMixin, _ForkedProc,
+                                       _PendingLaunch)
+from ray_tpu.core.node_transfer import (NodeTransferMixin, ObjInfo,
+                                        OwnedRec, _LOCAL_NODES_BY_HEX,
+                                        _gil_free_copy, _wire_spec)
+from ray_tpu.core.node_sched import (NodeSchedMixin, ActorRec, PGRec,
+                                     TaskRec)
 
-# ---------------------------------------------------------------------------
-# fork-server worker handle
-
-
-class _ForkedProc:
-    """Popen-shaped handle for a worker forked by the prefork template
-    (core/prefork.py).  The template reaps exits, so liveness is probed
-    with signal 0 rather than waitpid."""
-
-    def __init__(self, pid: int):
-        self.pid = pid
-        self._rc: Optional[int] = None
-
-    def poll(self) -> Optional[int]:
-        if self._rc is None:
-            try:
-                os.kill(self.pid, 0)
-            except (ProcessLookupError, PermissionError):
-                self._rc = 0
-        return self._rc
-
-    def wait(self, timeout: Optional[float] = None) -> int:
-        deadline = None if timeout is None else time.time() + timeout
-        while self.poll() is None:
-            if deadline is not None and time.time() > deadline:
-                raise subprocess.TimeoutExpired("forked-worker", timeout)
-            time.sleep(0.02)
-        return self._rc
-
-    def _signal(self, sig: int) -> None:
-        try:
-            os.kill(self.pid, sig)
-        except (ProcessLookupError, PermissionError):
-            pass
-
-    def terminate(self) -> None:
-        self._signal(signal.SIGTERM)
-
-    def kill(self) -> None:
-        self._signal(signal.SIGKILL)
+__all__ = [
+    "NodeService", "ObjInfo", "OwnedRec", "TaskRec", "ActorRec",
+    "PGRec", "_ForkedProc", "_PendingLaunch", "_LOCAL_NODES_BY_HEX",
+    "_gil_free_copy", "_wire_spec",
+]
 
 
-class _PendingLaunch:
-    """Popen-shaped placeholder guarding a container launch that has
-    been SCHEDULED but not yet exec'd (e.g. chaos slow-spawn).  poll()
-    reads in-flight until the register window expires, then done —
-    re-arming retries for a launch that silently died."""
-
-    def __init__(self, ttl_s: float):
-        self._deadline = time.monotonic() + ttl_s
-        self.pid = 0
-
-    def poll(self) -> Optional[int]:
-        return None if time.monotonic() < self._deadline else 0
-
-
-# ---------------------------------------------------------------------------
-# records
-
-
-@dataclass
-class ObjInfo:
-    state: str = "pending"       # pending | ready | error
-    loc: str = ""                # inline | shm | device
-    data: Optional[bytes] = None  # inline payload (SerializedObject wire bytes)
-    size: int = 0
-    owner: str = ""
-    is_error: bool = False
-    # device-resident entries: conn_id of the process holding the HBM
-    # buffers (core/device_objects.py); data holds the descriptor
-    owner_conn: Optional[int] = None
-    loc_reported: bool = False   # location pushed to the head
-    nested: tuple = ()           # ids this object's value embeds refs to
-    wait_waiters: list = field(default_factory=list)
-    # (node_hex, address) of the node that OWNS this object — the
-    # submitter's node is the location authority and lineage holder
-    # (reference: ownership model, core_worker.h / the owner_address
-    # every ObjectReference carries)
-    owner_node: tuple = ()
-
-
-@dataclass
-class OwnedRec:
-    """Owner-side directory entry for one owned object (reference:
-    ownership_based_object_directory.cc — the owner, not the GCS, is
-    authoritative for locations of objects it owns)."""
-    task_id: bytes = b""                       # producer (b"" for puts)
-    locations: dict = field(default_factory=dict)   # node_hex -> address
-    watchers: set = field(default_factory=set)      # (node_hex, address)
-
-
-@dataclass
-class TaskRec:
-    spec: dict
-    state: str = "pending"       # pending | running | forwarded | finished | failed
-    worker: Optional[int] = None
-    retries_left: int = 0
-    submitted_at: float = field(default_factory=time.time)
-    started_at: float = 0.0
-    finished_at: float = 0.0
-    error: str = ""
-
-
-@dataclass
-class ActorRec:
-    actor_id: ActorID
-    spec: dict                   # creation spec (reusable for restart)
-    state: str = "pending"       # pending | alive | restarting | dead
-    conn_id: Optional[int] = None
-    name: str = ""
-    namespace: str = ""
-    restarts_left: int = 0
-    seq: int = 0
-    queue: deque = field(default_factory=deque)   # pending method-call specs
-    running: dict = field(default_factory=dict)   # task_id -> in-flight spec
-    max_concurrency: int = 1
-    death_cause: str = ""
-
-    @property
-    def inflight(self) -> int:
-        return len(self.running)
-
-
-@dataclass
-class PGRec:
-    pg_id: PlacementGroupID
-    bundles: list                # list[dict resource->qty]
-    strategy: str
-    state: str = "created"       # single-node: reserve succeeds or raises
-
-
-def _wire_spec(spec: dict) -> dict:
-    """Spec copy safe to ship to another service (drop node-local keys)."""
-    return {k: v for k, v in spec.items()
-            if not k.startswith("_") and k != "submitter"}
-
-
-def _gil_free_copy(dst, src, size: int) -> None:
-    """memcpy that RELEASES the GIL (ctypes foreign calls drop it):
-    a multi-hundred-MiB memoryview slice-assign holds the GIL and
-    stalls every other event loop thread in the process for its whole
-    duration — broadcast copies serialized behind each other."""
-    import ctypes
-    try:
-        dst_c = (ctypes.c_char * size).from_buffer(dst)
-        src_mv = memoryview(src)
-        if src_mv.readonly:
-            src_c = bytes(src_mv[:size])    # rare: readonly source
-        else:
-            src_c = (ctypes.c_char * size).from_buffer(src_mv)
-        ctypes.memmove(dst_c, src_c, size)
-    except (TypeError, ValueError):
-        dst[:size] = src[:size]
-
-
-# Same-process node registry: virtual clusters (cluster_utils) run many
-# NodeServices as threads of one process.  Object pulls between them can
-# hand the bytes over with one memcpy instead of a socket stream — the
-# same-host semantics the reference gets from one shared plasma store
-# per machine (plasma store.h:55; workers on a host never stream to
-# each other).  Real multi-host peers are never in this registry.
-_LOCAL_NODES_BY_HEX: dict[str, "NodeService"] = {}
-
-
-class NodeService(ClusterStoreMixin, EventLoopService):
+class NodeService(NodeWorkersMixin, NodeTransferMixin, NodeSchedMixin,
+                  ClusterStoreMixin, EventLoopService):
     name = "node"
 
     def __init__(self, config: RayTpuConfig, session: str,
@@ -412,93 +256,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._memory_check()
         self._expire_parked_actor_waits()
         self._heartbeat()
-
-    def _expire_parked_actor_waits(self) -> None:
-        """Actor-bound tasks parked through a head failover fail once
-        the grace window runs out with the head still gone."""
-        if not self._actor_wait_parked or self.head_conn is not None:
-            return
-        grace = self.config.actor_locate_failover_grace_s
-        cutoff = time.monotonic() - grace
-        for ab, since in list(self._actor_wait_parked.items()):
-            if since < cutoff:
-                self._actor_wait_parked.pop(ab, None)
-                for spec in self._awaiting_actor.pop(ab, []):
-                    self._fail_task(
-                        spec, "Actor location unknown: head connection "
-                              f"lost and not recovered within {grace:.0f}s")
-
-    def _memory_check(self) -> None:
-        """OOM protection: when node memory crosses the threshold, kill
-        one running worker chosen by the group-by-owner policy; the task
-        retries or fails with OutOfMemoryError (reference:
-        memory_monitor.h:52, worker_killing_policy_group_by_owner.h:85)."""
-        mm = self.memory_monitor
-        if mm is None or not mm.due():
-            return
-        over = mm.over_threshold()
-        if over is None:
-            return
-        used, total = over
-        from ray_tpu.core.memory_monitor import pick_victim
-        cands = []
-        for rec in self.clients.values():
-            if (rec.kind != "worker" or rec.dedicated_actor is not None
-                    or rec.state != "busy" or rec.current_task is None
-                    or not rec.pid):
-                continue
-            tr = self.tasks.get(rec.current_task)
-            if tr is not None and tr.state == "running":
-                cands.append((rec, tr))
-        victim = pick_victim(cands)
-        if victim is None:
-            return
-        rec, tr = victim
-        detail = (f"task used node memory past the threshold "
-                  f"({used / (1 << 20):.0f}MiB / {total / (1 << 20):.0f}"
-                  f"MiB >= {mm.threshold:.2f}); worker pid={rec.pid} "
-                  f"killed to protect the node")
-        try:
-            os.kill(rec.pid, signal.SIGKILL)
-        except OSError:
-            return   # already gone: no kill happened, record nothing
-        self._oom_kills[rec.current_task] = detail
-        self.oom_kill_count += 1
-        self._record_event(tr.spec, "OOM_KILLED", worker=rec.conn_id)
-        sys.stderr.write(f"[node] OOM: killing worker pid={rec.pid} "
-                         f"(task {rec.current_task.hex()[:12]}, "
-                         f"{used}/{total} bytes)\n")
-
-    def _rebalance(self) -> None:
-        """Queued work meets new capacity: spillover decisions are made
-        at enqueue time, so when another node gains availability LATER
-        (autoscaler launch, task completion elsewhere), re-route queue
-        heads this node can't start now (reference: the cluster
-        scheduler re-evaluates pending queues on resource updates,
-        cluster_task_manager.cc ScheduleAndDispatchTasks)."""
-        if self.head_conn is None:
-            return
-        moved = 0
-        for q in (self.runnable_cpu, self.runnable_tpu):
-            while q and moved < 8:
-                spec = q[0]
-                if spec.get("placement_group"):
-                    break   # FIFO: don't reorder past an unmovable head
-                demand = self._demand(spec)
-                if all(self.available.get(k, 0.0) + 1e-9 >= v
-                       for k, v in demand.items()):
-                    break   # dispatches here as soon as a worker frees
-                if not self._cluster_has_capacity(spec):
-                    break
-                # _routed (head-parked) specs move too: during a burst
-                # the head parks work on saturated nodes; when capacity
-                # appears LATER (autoscaler launch, drain elsewhere) the
-                # parked backlog must chase it.  No ping-pong: we only
-                # re-forward when the view shows another node free NOW,
-                # and the head ranks available-now targets first.
-                self._queue_pop(q)
-                self._forward_task(spec)
-                moved += 1
 
     def _cleanup(self) -> None:
         from ray_tpu.core import local_lane
@@ -824,468 +581,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                 break   # one new worker hosts one actor
         self._schedule()
 
-    # -- objects
-
-    def _h_put_inline(self, rec, m):
-        oid = ObjectID(m["object_id"])
-        info = self.objects.setdefault(oid, ObjInfo())
-        info.state = "error" if m.get("is_error") else "ready"
-        info.loc = "inline"
-        info.data = m["data"]
-        info.size = len(m["data"])
-        # ownership set at submit time wins (the submitter owns task
-        # returns, even when an executor stores them)
-        info.owner = info.owner or m.get("owner", rec.worker_id)
-        info.is_error = bool(m.get("is_error"))
-        if self.head_conn is not None and not info.owner_node:
-            # first stored here with no prior claim: this node owns it
-            # (ray.put objects — the putter's node is the authority)
-            info.owner_node = (self.node_id.hex(), self.address)
-        self._track_nested(info, m.get("nested_refs"))
-        self._resolve_waiters(oid, info)
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _h_register_object(self, rec, m):
-        oid = ObjectID(m["object_id"])
-        info = self.objects.setdefault(oid, ObjInfo())
-        info.state = "ready"
-        info.loc = "shm"
-        info.size = m["size"]
-        info.owner = info.owner or m.get("owner", rec.worker_id)
-        if self.head_conn is not None and not info.owner_node:
-            info.owner_node = (self.node_id.hex(), self.address)
-        self._track_nested(info, m.get("nested_refs"))
-        self.store.register(oid, m["size"])
-        self._resolve_waiters(oid, info)
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _h_get_objects(self, rec, m):
-        """Batched blocking get: reply once ALL requested objects resolve."""
-        ids = [ObjectID(b) for b in m["object_ids"]]
-        for o in ids:
-            info = self.objects.setdefault(o, ObjInfo())
-            if (info.loc == "device" and info.state == "ready"
-                    and info.owner_conn != rec.conn_id):
-                # another process wants a device-resident object: ask the
-                # owner to spill it to the host store once (materialize-
-                # on-demand), then this get resolves like any other
-                self._request_materialize(o, info)
-        pending = [o for o in ids
-                   if self.objects[o].state == "pending"]
-        if not pending:
-            self._reply_batch(rec, m["reqid"], ids)
-            return
-        key = (rec.conn_id, m["reqid"])
-        self._multigets[key] = {"ids": ids, "remaining": set(pending)}
-        for o in pending:
-            self._mg_by_oid.setdefault(o, set()).add(key)
-        self._ensure_remote_watch([o for o in pending
-                                   if self.objects[o].loc != "device"])
-        if rec.state == "busy":
-            rec.state = "blocked"
-            self._release_task_cpu(rec)
-            self._schedule()
-
-    # -- device-resident objects (core/device_objects.py) -------------------
-
-    def _h_put_device(self, rec, m):
-        oid = ObjectID(m["object_id"])
-        info = self.objects.setdefault(oid, ObjInfo())
-        info.state = "ready"
-        info.loc = "device"
-        info.data = m["descriptor"]
-        info.size = m.get("size", 0)
-        info.owner = info.owner or m.get("owner", rec.worker_id)
-        info.owner_conn = rec.conn_id
-        if self.head_conn is not None and not info.owner_node:
-            info.owner_node = (self.node_id.hex(), self.address)
-        self._track_nested(info, m.get("nested_refs"))
-        self._resolve_waiters(oid, info)
-
-    def _h_materialize_failed(self, rec, m):
-        oid = ObjectID(m["object_id"])
-        info = self.objects.get(oid)
-        if (info is not None and info.state == "pending"
-                and info.loc == "device"):
-            self._seal_error_object(oid, RuntimeError(
-                f"device object materialization failed: {m.get('error')}"))
-
-    def _request_materialize(self, oid: ObjectID, info: ObjInfo) -> None:
-        owner = self.clients.get(info.owner_conn)
-        if owner is None:
-            self._device_owner_lost(oid, info)
-            return
-        info.state = "pending"
-        self._push(owner, {"t": "materialize_object",
-                           "object_id": oid.binary()})
-
-    def _device_owner_lost(self, oid: ObjectID, info: ObjInfo) -> None:
-        """The process holding a device entry's HBM buffers died: the
-        value is gone.  Reconstruction via lineage applies exactly as for
-        any lost object; without lineage the get errors."""
-        info.loc = ""
-        info.data = None
-        info.owner_conn = None
-        info.state = "pending"
-        if not self._try_reconstruct_device(oid):
-            self._seal_error_object(
-                oid, RuntimeError(
-                    "owner process of device-resident object died"))
-
-    def _try_reconstruct_device(self, oid: ObjectID) -> bool:
-        rec_ = self.owned.get(oid.binary())
-        if rec_ is not None and rec_.task_id:
-            return self._reconstruct(rec_.task_id)
-        return False
-
-    def _reply_batch(self, rec, reqid, ids):
-        results = []
-        for oid in ids:
-            info = self.objects[oid]
-            if info.loc == "device":
-                # only the owner reaches here with a device loc (others
-                # were routed through materialization in _h_get_objects)
-                results.append({"loc": "device_local", "data": info.data,
-                                "is_error": False})
-            elif info.loc == "shm":
-                # Pin FIRST, then restore: the pin must already protect
-                # the object when a later restore in this same batch (or
-                # restore's own capacity-balancing pass) evicts — the
-                # reply promises a mapped segment (reference: plasma pins
-                # objects for the duration of a Get).
-                self.store.pin(oid)
-                rec.held_pins.append((oid, time.monotonic()))
-                if self.store.is_spilled(oid):
-                    self.store.restore(oid)
-                self.store.touch(oid)
-                results.append({"loc": "shm", "size": info.size,
-                                "is_error": info.is_error})
-            else:
-                results.append({"loc": "inline", "data": info.data,
-                                "is_error": info.is_error})
-        self._reply(rec, reqid, results=results)
-
-    def _h_need_space(self, rec, m):
-        # A client's arena allocation failed: spill unpinned objects
-        # (reference: plasma create_request_queue.h queues client creates
-        # until eviction frees memory — here the client blocks on this
-        # request and retries).
-        freed = self.store.evict_for(int(m["nbytes"]))
-        self._reply(rec, m["reqid"], freed=freed)
-
-    def _h_release_pins(self, rec, m):
-        ids = {ObjectID(b) for b in m["object_ids"]}
-        kept = []
-        for oid, ts in rec.held_pins:
-            if oid in ids:
-                ids.discard(oid)
-                self.store.unpin(oid)
-            else:
-                kept.append((oid, ts))
-        rec.held_pins[:] = kept
-
-    def _expire_stale_pins(self) -> None:
-        """Get-replies whose ack never arrived (client timeout/death race)
-        must not pin objects forever."""
-        cutoff = time.monotonic() - 120.0
-        for rec in self.clients.values():
-            if not rec.held_pins:
-                continue
-            kept = []
-            for oid, ts in rec.held_pins:
-                if ts < cutoff:
-                    self.store.unpin(oid)
-                else:
-                    kept.append((oid, ts))
-            rec.held_pins[:] = kept
-
-    def _object_ready_hook(self, oid: ObjectID, info: ObjInfo) -> None:
-        """Cluster bookkeeping when an object becomes ready/error here."""
-        ob = oid.binary()
-        if info.loc != "device":
-            for conn_id, pm in self._device_pending_pulls.pop(ob, []):
-                peer = self.clients.get(conn_id)
-                if peer is not None:
-                    self._h_pull_object(peer, pm)
-        self._watched.discard(ob)
-        self._pull_attempts.pop(ob, None)
-        self._owner_watch.pop(ob, None)
-        if self.head_conn is not None and not info.loc_reported:
-            info.loc_reported = True
-            self._head_send({"t": "report_locations", "adds": [ob]})
-        if self.head_conn is not None and info.owner_node:
-            # tell the object's OWNER a copy lives here — the owner, not
-            # the head, serves location queries for owned objects
-            if info.owner_node[0] == self.node_id.hex():
-                self._owner_add_location(ob, self.node_id.hex(),
-                                         self.address)
-            elif info.loc == "inline" and info.data is not None:
-                # inline result of forwarded work: ship the VALUE to the
-                # owner directly — a location report would cost the owner
-                # a locate + pull round trip for ~bytes of payload
-                # (reference contrast: small returns ride the
-                # PushTaskReply inline, core_worker.cc:2528)
-                self._owner_push(
-                    info.owner_node[0], info.owner_node[1],
-                    {"t": "owner_object_value", "object_id": ob,
-                     "data": info.data, "is_error": info.is_error,
-                     "node": self.node_id.hex(), "address": self.address})
-            else:
-                self._owner_push(
-                    info.owner_node[0], info.owner_node[1],
-                    {"t": "owner_object_at", "object_id": ob,
-                     "node": self.node_id.hex(), "address": self.address})
-        tid = self._fwd_by_oid.pop(ob, None)
-        if tid is not None:
-            fw = self._fwd_tasks.get(tid)
-            if fw is not None and not any(
-                    b in self._fwd_by_oid for b in fw["spec"]["return_ids"]):
-                self._fwd_tasks.pop(tid, None)
-                tr = self.tasks.get(tid)
-                if tr is not None and tr.state == "forwarded":
-                    tr.state = "failed" if info.is_error else "finished"
-                    tr.finished_at = time.time()
-                    self._note_task_finished(tid)
-                    self._release_arg_blob(fw["spec"])
-
-    def _resolve_waiters(self, oid: ObjectID, info: ObjInfo) -> None:
-        self._object_ready_hook(oid, info)
-        for key in self._mg_by_oid.pop(oid, ()):
-            mg = self._multigets.get(key)
-            if mg is None:
-                continue
-            mg["remaining"].discard(oid)
-            if not mg["remaining"]:
-                del self._multigets[key]
-                w = self.clients.get(key[0])
-                if w is not None:
-                    if w.state == "blocked":
-                        w.state = "busy"
-                    self._reply_batch(w, key[1], mg["ids"])
-        for conn_id, reqid, ids, num_returns, deadline in list(info.wait_waiters):
-            self._try_finish_wait(conn_id, reqid, ids, num_returns, deadline)
-        info.wait_waiters.clear()
-        # release tasks waiting on this dependency
-        for spec in self.dep_waiting.pop(oid, ()):
-            spec["_ndeps"] -= 1
-            if spec["_ndeps"] == 0:
-                self._make_runnable(spec)
-        self._schedule()
-
-    def _h_wait(self, rec, m):
-        ids = [ObjectID(b) for b in m["object_ids"]]
-        self._ensure_remote_watch(
-            [o for o in ids
-             if self.objects.setdefault(o, ObjInfo()).state == "pending"])
-        self._try_finish_wait(rec.conn_id, m["reqid"], ids, m["num_returns"],
-                              time.time() + m["timeout"] if m.get("timeout")
-                              is not None else None, first=True)
-
-    def _try_finish_wait(self, conn_id, reqid, ids, num_returns, deadline,
-                         first=False):
-        rec = self.clients.get(conn_id)
-        if rec is None:
-            return
-        ready = [o for o in ids
-                 if self.objects.get(o) is not None
-                 and self.objects[o].state != "pending"]
-        timed_out = deadline is not None and time.time() >= deadline
-        if len(ready) >= num_returns or timed_out:
-            if not timed_out:
-                ready = ready[:num_returns]
-            self._reply(rec, reqid, ready=[o.binary() for o in ready])
-            return
-        if first:
-            for o in ids:
-                info = self.objects.setdefault(o, ObjInfo())
-                if info.state == "pending":
-                    info.wait_waiters.append((conn_id, reqid, ids, num_returns,
-                                              deadline))
-            if deadline is not None:
-                self.post_later(max(0.0, deadline - time.time()),
-                                lambda: self._try_finish_wait(
-                                    conn_id, reqid, ids, num_returns, deadline))
-
-    def _seal_error_object(self, oid: ObjectID, exc: BaseException) -> None:
-        """Make `oid` resolve to an error value and wake its waiters —
-        the single encoder of error objects on this node."""
-        from ray_tpu.core.serialization import SerializedObject
-        info = self.objects.setdefault(oid, ObjInfo())
-        info.state = "error"
-        info.loc = "inline"
-        info.data = SerializedObject(inband=pickle.dumps(exc)).to_bytes()
-        info.is_error = True
-        self._resolve_waiters(oid, info)
-
-    def _track_nested(self, info: ObjInfo, nested) -> None:
-        """Record ids embedded in this object's value so their storage
-        outlives the owner's release while the container exists."""
-        if not nested or info.nested:
-            return   # guard against double-count on a retried put
-        info.nested = tuple(nested)
-        for nb in info.nested:
-            self._nested_count[nb] = self._nested_count.get(nb, 0) + 1
-
-    def _release_owned(self, ob: bytes) -> None:
-        """Drop the ownership record and dereference its lineage entry
-        (freed objects need no reconstruction path)."""
-        orec = self.owned.pop(ob, None)
-        if orec is None or not orec.task_id:
-            return
-        lin = self.lineage.get(orec.task_id)
-        if lin is None:
-            return
-        lin["live"].discard(ob)
-        if not lin["live"]:
-            if lin["spec"] is not None:
-                self._lineage_bytes -= lin["cost"]
-            del self.lineage[orec.task_id]
-            # compact the eviction queue occasionally: entries for
-            # deleted lineage would otherwise accumulate forever
-            if len(self._lineage_order) > 256 \
-                    and len(self._lineage_order) > 4 * len(self.lineage):
-                self._lineage_order = deque(
-                    t for t in self._lineage_order if t in self.lineage)
-
-    def _forget_object(self, oid: ObjectID) -> None:
-        """Single removal point: drop the entry, its storage, and its
-        holds on nested ids."""
-        info = self.objects.pop(oid, None)
-        self.store.delete(oid)
-        ob = oid.binary()
-        self._bcast_tail.pop(ob, None)
-        if info is not None and info.owner_node \
-                and info.owner_node[0] == self.node_id.hex():
-            self._release_owned(ob)
-        else:
-            orec = self.owned.get(ob)
-            if orec is not None:
-                orec.locations.pop(self.node_id.hex(), None)
-        if info is not None and info.nested:
-            for nb in info.nested:
-                c = self._nested_count.get(nb, 0) - 1
-                if c > 0:
-                    self._nested_count[nb] = c
-                else:
-                    self._nested_count.pop(nb, None)
-
-    def _delete_local_object(self, oid: ObjectID) -> None:
-        info = self.objects.get(oid)
-        # capture BEFORE sealing: _seal_error_object rewrites loc to
-        # "inline", which would skip the owner's HBM release below
-        was_device = info is not None and info.loc == "device"
-        device_owner = info.owner_conn if was_device else None
-        if info is not None and (info.state == "pending"
-                                 or oid in self._mg_by_oid
-                                 or info.wait_waiters
-                                 or oid in self.dep_waiting):
-            # fail anyone blocked on it before it vanishes
-            self._seal_error_object(
-                oid, RuntimeError(f"Object {oid.hex()[:16]} was freed"))
-        if was_device:
-            # tell the owner process to release the HBM buffers
-            owner = self.clients.get(device_owner)
-            if owner is not None:
-                self._push(owner, {"t": "drop_device_object",
-                                   "object_id": oid.binary()})
-        self._forget_object(oid)
-
-    def _h_free_objects(self, rec, m):
-        for b in m["object_ids"]:
-            self._delete_local_object(ObjectID(b))
-        if self.head_conn is not None:
-            self._head_send({"t": "free_objects",
-                             "object_ids": list(m["object_ids"])})
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _h_object_stats(self, rec, m):
-        self._reply(rec, m["reqid"], stats=self.store.stats(),
-                    num_objects=len(self.objects))
-
-    # -- automatic object lifetime (owner-based release) --------------------
-
-    def _h_release_refs(self, rec, m):
-        """The owning process dropped its last local ref to these objects
-        — reclaim their storage once nothing on this node still needs
-        them (reference: reference_count.h owner-count-zero → delete;
-        borrower chains are out of scope, so non-owner releases are
-        ignored rather than trusted)."""
-        for b in m["object_ids"]:
-            oid = ObjectID(b)
-            info = self.objects.get(oid)
-            if info is None:
-                continue
-            if info.owner and info.owner != rec.worker_id:
-                continue
-            self._released_wait.add(oid)
-        self._sweep_released()
-
-    def _args_in_flight(self) -> set:
-        """Object ids still referenced as args by queued or running work
-        on this node — storage for these must survive the owner's
-        release until the work completes."""
-        s: set = set()
-        for q in (self.runnable_cpu, self.runnable_tpu,
-                  self.runnable_zero):
-            for spec in q:
-                s.update(spec.get("arg_ids", ()))
-        for specs in self.dep_waiting.values():
-            for spec in specs:
-                s.update(spec.get("arg_ids", ()))
-        for ar in self.actors.values():
-            for spec in ar.queue:
-                s.update(spec.get("arg_ids", ()))
-            for spec in ar.running.values():
-                s.update(spec.get("arg_ids", ()))
-        # running (non-actor) work hangs off busy workers — iterating
-        # clients is O(pool), where iterating self.tasks would be
-        # O(task history) per release sweep
-        for rec in self.clients.values():
-            if rec.current_task is not None:
-                tr = self.tasks.get(rec.current_task)
-                if tr is not None:
-                    s.update(tr.spec.get("arg_ids", ()))
-        # forwarded work: the destination node still has to PULL these
-        # args from us — our copy must outlive the forward
-        for fw in self._fwd_tasks.values():
-            s.update(fw["spec"].get("arg_ids", ()))
-        for specs in self._awaiting_actor.values():
-            for spec in specs:
-                s.update(spec.get("arg_ids", ()))
-        return s
-
-    def _sweep_released(self) -> None:
-        if not self._released_wait:
-            return
-        in_flight = self._args_in_flight()
-        freed: list[bytes] = []
-        for oid in list(self._released_wait):
-            info = self.objects.get(oid)
-            if info is None:
-                self._released_wait.discard(oid)
-                continue
-            if info.state == "pending":
-                continue   # producing task still running; re-checked later
-            if oid.binary() in in_flight:
-                continue
-            if oid in self._mg_by_oid or info.wait_waiters:
-                continue
-            if self._nested_count.get(oid.binary(), 0) > 0:
-                continue   # a stored container still embeds this ref
-            if info.loc == "shm":
-                e = self.store.entries.get(oid)
-                if e is not None and e.pin_count > 0:
-                    continue   # a get/transfer is mapping it right now
-            self._released_wait.discard(oid)
-            self._forget_object(oid)
-            freed.append(oid.binary())
-        if freed and self.head_conn is not None:
-            # replicas pulled to other nodes die with the owner's copy
-            self._head_send({"t": "free_objects", "object_ids": freed})
-
     # -- functions
 
     def _h_register_function(self, rec, m):
@@ -1320,1126 +615,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                                         error="function fetch failed: "
                                               f"{reply['error']}")
             self._head_rpc({"t": "fetch_function", "function_id": fid}, cb)
-
-    # -- tasks
-
-    def _h_submit_task(self, rec, m):
-        spec = m["spec"]
-        spec["submitter"] = rec.conn_id
-        self._admit_task(spec)
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _admit_task(self, spec: dict) -> None:
-        tr = TaskRec(spec=spec, retries_left=spec.get("max_retries", 0))
-        self.tasks[spec["task_id"]] = tr
-        if _fr._active is not None:
-            _fr._active.start_or_stamp(spec, "node_recv")
-        if self.head_conn is not None and not spec.get("owner_node"):
-            # first admission on the submitter's node: WE own the returns
-            spec["owner_node"] = (self.node_id.hex(), self.address)
-            if spec.get("max_retries", 0) != 0:
-                # retry-disabled tasks are not reconstructable, matching
-                # the reference (max_retries=0 -> ObjectLostError)
-                self._record_lineage(spec)
-        self._absorb_arg_owners(spec)
-        onode = tuple(spec.get("owner_node") or ())
-        for b in spec["return_ids"]:
-            info = self.objects.setdefault(ObjectID(b), ObjInfo())
-            info.owner = info.owner or spec.get("owner", "")
-            if onode and not info.owner_node:
-                info.owner_node = onode
-        self._record_event(spec, "PENDING")
-        self._enqueue_task(spec)
-
-    # -- ownership + lineage --------------------------------------------------
-
-    def _record_lineage(self, spec: dict) -> None:
-        """Retain the producer spec so lost returns can be re-executed
-        (reference: task_manager.h lineage pinning bounded by
-        max_lineage_bytes)."""
-        tid = spec["task_id"]
-        live = set(spec["return_ids"])
-        for b in live:
-            rec = self.owned.get(b)
-            if rec is None:
-                self.owned[b] = OwnedRec(task_id=tid)
-            else:
-                rec.task_id = rec.task_id or tid
-        if tid in self.lineage or not live:
-            return
-        wire = _wire_spec(spec)
-        # cheap size estimate: serialized args dominate a spec
-        cost = len(wire.get("args") or b"") + 256 * (1 + len(live))
-        self.lineage[tid] = {"spec": wire, "cost": cost, "live": live,
-                             "recons": 0}
-        self._lineage_order.append(tid)
-        self._lineage_bytes += cost
-        cap = self.config.max_lineage_bytes
-        while self._lineage_bytes > cap and self._lineage_order:
-            old = self._lineage_order.popleft()
-            lin = self.lineage.get(old)
-            if lin is not None and lin["spec"] is not None:
-                lin["spec"] = None
-                self._lineage_bytes -= lin["cost"]
-
-    def _absorb_arg_owners(self, spec: dict) -> None:
-        """Adopt the forwarding node's owner hints for arg objects so
-        location queries go to owners, not the head."""
-        for b, onode in (spec.get("arg_owners") or {}).items():
-            info = self.objects.setdefault(ObjectID(b), ObjInfo())
-            if not info.owner_node:
-                info.owner_node = tuple(onode)
-
-    def _attach_arg_owners(self, wire: dict, spec: dict) -> None:
-        """Stamp owner addresses onto a spec leaving this node (the
-        reference ships owner_address inside every ObjectReference)."""
-        owners = {}
-        ids = list(spec.get("arg_ids", ()))
-        for b in ids:
-            info = self.objects.get(ObjectID(b))
-            if info is None:
-                continue
-            if info.owner_node:
-                owners[b] = tuple(info.owner_node)
-            elif info.state != "pending":
-                # no owner recorded but we hold a copy: we can serve it
-                owners[b] = (self.node_id.hex(), self.address)
-        if owners:
-            wire["arg_owners"] = owners
-
-    def _projected_available(self) -> dict:
-        """Availability net of demand already sitting in the runnable
-        queues: resources are only acquired at dispatch, so raw
-        `available` over-promises (the reference's hybrid policy counts
-        committed resources the same way,
-        hybrid_scheduling_policy.h)."""
-        proj = dict(self.available)
-        for k, v in self._queued_demand.items():
-            proj[k] = proj.get(k, 0.0) - v
-        return {k: max(0.0, v) for k, v in proj.items()}
-
-    def _available_covers(self, spec: dict) -> bool:
-        proj = self._projected_available()
-        return all(proj.get(k, 0.0) + 1e-9 >= v
-                   for k, v in self._demand(spec).items())
-
-    def _cluster_has_capacity(self, spec: dict) -> bool:
-        demand = self._demand(spec)
-        me = self.node_id.hex()
-        for h, n in self.cluster_view.items():
-            if h == me or not n.get("alive"):
-                continue
-            if all(n["available"].get(k, 0.0) + 1e-9 >= v
-                   for k, v in demand.items()):
-                return True
-        return False
-
-    def _enqueue_task(self, spec: dict) -> None:
-        routed = spec.get("_routed")
-        pg = spec.get("placement_group")
-        clustered = self.head_conn is not None and not routed
-        if pg is not None:
-            if (pg[0], pg[1]) not in self.pg_available:
-                if clustered:
-                    # bundle lives on another node: the head routes it there
-                    self._forward_task(spec)
-                    return
-                if routed:
-                    # routed here for a bundle that was removed in the
-                    # meantime: fail fast — queueing would head-of-line
-                    # block every later task behind an unacquirable spec
-                    self._fail_task(
-                        spec, "Placement group bundle no longer exists "
-                              "on this node (group removed?)")
-                    return
-        elif not self._feasible(spec):
-            if clustered:
-                self._forward_task(spec)
-                return
-            self._fail_task(spec, "Infeasible resource demand: "
-                            f"{self._demand(spec)} on {self.total_resources}")
-            return
-        elif clustered and not self._available_covers(spec):
-            # spillover: we can't run it NOW — let the head place it.
-            # The head ranks by availability AND parked backlog, so this
-            # must not be gated on the view showing free capacity: the
-            # view's availability is optimistically debited to zero
-            # during any burst, and gating on it made a submitter keep
-            # ~95% of a 4000-task burst while seven nodes sat idle
-            # (reference: saturated tasks go to the cluster scheduler,
-            # cluster_task_manager.h — placement is ITS call, not the
-            # submitting raylet's)
-            self._forward_task(spec)
-            return
-        if spec.get("_routed") and not self._feasible(spec):
-            # routing race: the head's view was stale
-            self._fail_task(spec, "Infeasible resource demand after "
-                            f"routing: {self._demand(spec)} on "
-                            f"{self.total_resources}")
-            return
-        ndeps = 0
-        for b in spec.get("arg_ids", []):
-            oid = ObjectID(b)
-            info = self.objects.setdefault(oid, ObjInfo())
-            if info.state == "pending":
-                ndeps += 1
-                self.dep_waiting.setdefault(oid, []).append(spec)
-                self._ensure_remote_watch([oid])
-        spec["_ndeps"] = ndeps
-        if ndeps == 0:
-            self._make_runnable(spec)
-            self._schedule()
-
-    def _forward_task(self, spec: dict) -> None:
-        tid = spec["task_id"]
-        if _fr._active is not None:
-            # the interval ending at the DESTINATION's node_recv stamp
-            # is then the head-route + wire hop
-            _fr._active.stamp(spec, "forward")
-
-        def cb(reply):
-            if reply.get("error"):
-                self._fail_task(spec, reply["error"])
-                return
-            if reply.get("local"):
-                spec["_routed"] = True
-                self._enqueue_task(spec)
-                return
-            dst = reply["node"]
-            tr = self.tasks.get(tid)
-            if tr is not None:
-                tr.state = "forwarded"
-            self._fwd_tasks[tid] = {"spec": spec, "dst": dst,
-                                    "retries": spec.get("max_retries", 0)}
-            for b in spec["return_ids"]:
-                self._fwd_by_oid[b] = tid
-            self._ensure_remote_watch(
-                [ObjectID(b) for b in spec["return_ids"]])
-        wire = _wire_spec(spec)
-        self._attach_arg_owners(wire, spec)
-        self._head_rpc({"t": "cluster_submit", "spec": wire,
-                        "src_available": self._projected_available()}, cb)
-
-    def _hh_remote_submit(self, m: dict) -> None:
-        spec = m["spec"]
-        spec["_routed"] = True
-        self._admit_task(spec)
-
-    def _make_runnable(self, spec: dict) -> None:
-        if _fr._active is not None:
-            _fr._active.stamp(spec, "enqueue")
-        if spec.get("num_tpus"):
-            self.runnable_tpu.append(spec)
-        elif self._is_zero_demand(spec):
-            # zero-demand tasks (PlacementGroup.ready() pollers) get
-            # their own queue: they can always run, so they must not sit
-            # behind a resource-blocked FIFO head — and keeping them out
-            # of runnable_cpu keeps _schedule O(1), no per-event scans
-            self.runnable_zero.append(spec)
-        else:
-            self.runnable_cpu.append(spec)
-        if spec.get("placement_group"):
-            self._queued_pg += 1
-        else:
-            for k, v in self._demand(spec).items():
-                self._queued_demand[k] = self._queued_demand.get(k, 0.0) + v
-
-    def _queue_pop(self, q: deque) -> dict:
-        spec = q.popleft()
-        if spec.get("placement_group"):
-            self._queued_pg = max(0, self._queued_pg - 1)
-        else:
-            for k, v in self._demand(spec).items():
-                self._queued_demand[k] = self._queued_demand.get(k, 0.0) - v
-        if (not self.runnable_cpu and not self.runnable_tpu
-                and not self.runnable_zero):
-            # drain point: clear float drift
-            self._queued_demand.clear()
-            self._queued_pg = 0
-        return spec
-
-    def _h_task_done(self, rec, m):
-        tid = m["task_id"]
-        # the task outran its SIGKILL: it is not an OOM casualty (and a
-        # stale entry must not mislabel a later failure of this task id)
-        self._oom_kills.pop(tid, None)
-        tr = self.tasks.get(tid)
-        if tr is not None:
-            tr.state = "failed" if m.get("error") else "finished"
-            tr.finished_at = time.time()
-            tr.error = m.get("error", "")
-            self._note_task_finished(tid)
-            self._release_arg_blob(tr.spec)
-            if _fr._active is not None:
-                self._fr_finish(tr, m)
-            self._record_event(tr.spec, "FAILED" if m.get("error") else "FINISHED")
-        if rec.dedicated_actor is not None:
-            ar = self.actors.get(rec.dedicated_actor)
-            if ar is not None:
-                ar.running.pop(tid, None)
-                self._dispatch_actor_queue(ar)
-        else:
-            if rec.state in ("busy", "blocked"):
-                rec.state = "idle"
-            rec.current_task = None
-            if tr is not None and not tr.spec.get("_cpu_released"):
-                self._return_resources(tr.spec)
-        # unpin args
-        if tr is not None:
-            for b in tr.spec.get("arg_ids", []):
-                self.store.unpin(ObjectID(b))
-        self._schedule()
-
-    def _release_task_cpu(self, rec: ClientRec) -> None:
-        """Worker blocked on get: release its task's resources so the node
-        can keep making progress (reference: raylet releases CPU for
-        blocked workers)."""
-        if rec.current_task is None:
-            return
-        tr = self.tasks.get(rec.current_task)
-        if tr is not None and not tr.spec.get("_cpu_released"):
-            tr.spec["_cpu_released"] = True
-            self._return_resources(tr.spec)
-
-    def _demand(self, spec) -> dict:
-        d = dict(spec.get("resources") or {})
-        # Tasks default to 1 CPU; actors hold 0 CPU for their lifetime
-        # unless explicitly requested (reference: ray actor default
-        # num_cpus=0 after creation, ray_option_utils.py).
-        d.setdefault("CPU", 0.0 if spec.get("kind") == "actor_create" else 1.0)
-        if spec.get("num_tpus"):
-            d["TPU"] = float(spec["num_tpus"])
-        return d
-
-    def _try_acquire(self, spec) -> bool:
-        demand = self._demand(spec)
-        pg = spec.get("placement_group")
-        if pg is not None:
-            key = (pg[0], pg[1])
-            free = self.pg_available.get(key)
-            if free is None:
-                return False
-            if all(free.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
-                for k, v in demand.items():
-                    free[k] = free.get(k, 0.0) - v
-                return True
-            return False
-        if all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
-            for k, v in demand.items():
-                self.available[k] = self.available.get(k, 0.0) - v
-            return True
-        return False
-
-    def _return_resources(self, spec) -> None:
-        demand = self._demand(spec)
-        pg = spec.get("placement_group")
-        if pg is not None:
-            free = self.pg_available.get((pg[0], pg[1]))
-            if free is not None:
-                for k, v in demand.items():
-                    free[k] = free.get(k, 0.0) + v
-            return
-        for k, v in demand.items():
-            self.available[k] = self.available.get(k, 0.0) + v
-        if self._pending_local_pgs:
-            self._try_place_local_pgs()
-
-    def _feasible(self, spec) -> bool:
-        demand = self._demand(spec)
-        if spec.get("placement_group"):
-            return True
-        return all(self.total_resources.get(k, 0.0) + 1e-9 >= v
-                   for k, v in demand.items())
-
-    def _args_ready(self, spec) -> bool:
-        for b in spec.get("arg_ids", []):
-            info = self.objects.get(ObjectID(b))
-            if info is None or info.state == "pending":
-                return False
-        return True
-
-    def _schedule(self) -> None:
-        """FIFO dispatch from the runnable queues (reference:
-        LocalTaskManager::DispatchScheduledTasksToWorkers,
-        local_task_manager.cc:101).  O(1) amortized per event: stops at the
-        first queue head that cannot be placed."""
-        for q, tpu in ((self.runnable_cpu, False), (self.runnable_tpu, True),
-                       (self.runnable_zero, False)):
-            while q:
-                spec = q[0]
-                container = (spec.get("runtime_env") or {}).get("container")
-                if container and tpu:
-                    # the TPU executor lives in the driver process; a
-                    # containerized worker can never satisfy it — fail
-                    # fast instead of wedging the TPU queue head
-                    self._queue_pop(q)
-                    self._fail_task(
-                        spec, "runtime_env.container is not supported "
-                              "for TPU tasks (TPU work runs on the "
-                              "driver's in-process executor)")
-                    continue
-                w = self._find_idle_worker(
-                    tpu=tpu, env_hash=spec.get("env_hash"),
-                    container_image=(container or {}).get("image", ""))
-                if w is None:
-                    if container:
-                        self._maybe_spawn_container_worker(container)
-                    elif not tpu:
-                        self._maybe_spawn_worker()
-                    break
-                if not self._try_acquire(spec):
-                    break
-                self._queue_pop(q)
-                self._dispatch_task(w, spec)
-
-    def _is_zero_demand(self, spec: dict) -> bool:
-        """True for specs that take nothing from the pool (e.g.
-        PlacementGroup.ready() pollers) — they always deserve a worker
-        and ride their own queue, immune to CPU-FIFO head blocking."""
-        return (not spec.get("placement_group")
-                and not spec.get("num_tpus")
-                and all(v <= 0 for v in self._demand(spec).values()))
-
-    def _find_idle_worker(self, tpu: bool,
-                          env_hash: Optional[str] = None,
-                          container_image: str = ""
-                          ) -> Optional[ClientRec]:
-        best = None
-        for rec in self.clients.values():
-            if (rec.kind in ("worker", "tpu_executor") and rec.state == "idle"
-                    and rec.dedicated_actor is None and rec.tpu == tpu):
-                # container tasks only run inside a matching image;
-                # plain tasks never borrow a containerized worker (its
-                # filesystem is the image's, not the host's)
-                if rec.container_image != container_image:
-                    continue
-                if not env_hash:
-                    return rec
-                # prefer a worker that already materialized this env
-                # (reference: worker_pool.h:192 runtime-env-hash cache)
-                if env_hash in rec.seen_envs:
-                    return rec
-                if best is None:
-                    best = rec
-        return best
-
-    def _maybe_spawn_container_worker(self, container: dict) -> None:
-        """Launch a worker exec'd inside the requested image
-        (runtime_env.container — ROADMAP 5a).  One launch in flight per
-        image: container cold-starts are seconds, and every _schedule
-        pass would otherwise stampede podman.  A launcher that dies
-        before its worker registers re-arms on the next pass."""
-        image = container["image"]
-        prev = self._container_spawning.get(image)
-        if prev is not None and prev.poll() is None:
-            return
-        # arm the guard BEFORE the spawn call: a chaos-delayed spawn
-        # returns without a Popen, and every _schedule pass until the
-        # delay elapsed would otherwise queue another launch.  The
-        # placeholder expires after the register window so a silently
-        # failed launch re-arms; _do_spawn_worker overwrites it with
-        # the real proc.
-        self._container_spawning[image] = _PendingLaunch(
-            self.config.worker_register_timeout_s)
-        try:
-            self._spawn_worker_proc(container=dict(container))
-        except Exception as e:
-            self._container_spawning.pop(image, None)
-            # no container runtime / unlaunchable image: a spec that can
-            # never dispatch must not wedge the queue head forever —
-            # fail the demand with the real problem named
-            self._fail_container_demand(
-                image, f"containerized worker for image '{image}' "
-                       f"cannot launch: {e}")
-
-    def _fail_container_demand(self, image: str, error: str) -> None:
-        for q in (self.runnable_cpu, self.runnable_tpu,
-                  self.runnable_zero):
-            doomed = [s for s in q
-                      if (((s.get("runtime_env") or {}).get("container")
-                           or {}).get("image")) == image]
-            for spec in doomed:
-                q.remove(spec)
-                # mirror _queue_pop's aggregate accounting
-                if spec.get("placement_group"):
-                    self._queued_pg = max(0, self._queued_pg - 1)
-                else:
-                    for k, v in self._demand(spec).items():
-                        self._queued_demand[k] = \
-                            self._queued_demand.get(k, 0.0) - v
-                self._fail_task(spec, error)
-        if (not self.runnable_cpu and not self.runnable_tpu
-                and not self.runnable_zero):
-            self._queued_demand.clear()
-            self._queued_pg = 0
-        for ar in list(self.actors.values()):
-            if (ar.state in ("pending", "restarting")
-                    and ar.conn_id is None
-                    and (((ar.spec.get("runtime_env") or {})
-                          .get("container") or {}).get("image")) == image):
-                self._mark_actor_dead(ar, error)
-
-    def _dispatch_task(self, w: ClientRec, spec: dict) -> None:
-        tr = self.tasks[spec["task_id"]]
-        tr.state = "running"
-        tr.worker = w.conn_id
-        tr.started_at = time.time()
-        w.state = "busy"
-        w.current_task = spec["task_id"]
-        if spec.get("env_hash"):
-            w.seen_envs.add(spec["env_hash"])
-        for b in spec.get("arg_ids", []):
-            self.store.pin(ObjectID(b))
-        self._record_event(spec, "RUNNING", worker=w.conn_id)
-        if _fr._active is not None:
-            _fr._active.stamp(spec, "dispatch")
-        self._push(w, {"t": "execute", "spec": spec})
-        if _fi._active is not None:
-            # chaos plane: "kill the worker that got the K-th dispatch"
-            # — the task is in flight, so this exercises the
-            # worker-death retry/FAILED path deterministically
-            _fi._active.on_dispatch(self, w, spec)
-
-    def _release_arg_blob(self, spec: dict) -> None:
-        """Oversized (args, kwargs) tuples ride the store as a blob put
-        by the submitter purely to carry them (runtime._prepare_args);
-        no ObjectRef ever wraps the blob, so nothing releases it —
-        reclaim it on TERMINAL task completion (retries still need it)."""
-        b = spec.get("arg_blob")
-        if b:
-            self._released_wait.add(ObjectID(b))
-            self._sweep_released()
-
-    def _note_task_finished(self, tid: bytes) -> None:
-        """Bound the finished-task history (the live dict stays O(recent),
-        dupes are harmless — eviction re-checks state)."""
-        self._done_order.append(tid)
-        cap = max(1000, self.config.task_events_buffer_size // 5)
-        while len(self._done_order) > cap:
-            old = self._done_order.popleft()
-            tr = self.tasks.get(old)
-            if tr is not None and tr.state in ("finished", "failed"):
-                del self.tasks[old]
-
-    def _fail_task(self, spec: dict, error: str) -> None:
-        tr = self.tasks.get(spec["task_id"])
-        if tr is not None:
-            tr.state = "failed"
-            tr.error = error
-            tr.finished_at = time.time()
-            self._note_task_finished(spec["task_id"])
-        self._release_arg_blob(spec)
-        self._record_event(spec, "FAILED")
-        for b in spec["return_ids"]:
-            self._seal_error_object(ObjectID(b), RuntimeError(error))
-
-    def _audit_worker_pool(self) -> None:
-        """Self-heal the in-flight spawn counter against crashed spawns
-        and prune long-dead procs.  Runs on the periodic tick, NOT per
-        event: each liveness probe is a waitpid/kill syscall per proc,
-        and at thousands of events/s this scan alone was ~45% of the
-        node loop (sampled; the 5 ms throttle still admitted it every
-        few events)."""
-        alive = [p for p in self._worker_procs if p.poll() is None]
-        if len(self._worker_procs) - len(alive) > 32:
-            self._worker_procs = alive
-        registered = sum(1 for c in self.clients.values()
-                         if c.kind == "worker" and not c.tpu)
-        # on_tick runs _schedule() right after this, so just correct
-        # the counter here
-        self._spawning = max(0, len(alive) - registered)
-
-    def _maybe_spawn_worker(self, tpu: bool = False) -> None:
-        if tpu:
-            return  # TPU executors are registered by the driver, not spawned
-        # Throttle: this runs on EVERY submit/completion event.  Pool
-        # sizing only needs to be right within a few ms; the periodic
-        # tick re-audits (and self-heals `_spawning`) regardless.
-        now = time.monotonic()
-        if now - getattr(self, "_last_spawn_eval", 0.0) < 0.005:
-            # re-arm so a lone skipped event still gets its evaluation
-            # promptly instead of waiting for the next tick
-            if not getattr(self, "_spawn_eval_armed", False):
-                self._spawn_eval_armed = True
-
-                def rearm():
-                    self._spawn_eval_armed = False
-                    self._schedule()
-                self.post_later(0.006, rearm)
-            return
-        self._last_spawn_eval = now
-        registered = sum(1 for c in self.clients.values()
-                         if c.kind == "worker" and not c.tpu)
-        # Demand-driven pool growth (reference: worker_pool.h capped startup
-        # concurrency :192): one worker per waiting task/actor, capped.
-        n_actors_waiting = sum(
-            1 for a in self.actors.values()
-            if a.state in ("pending", "restarting") and a.conn_id is None
-            and not a.spec.get("num_tpus"))
-        # containerized workers don't count as spare capacity here: they
-        # can only take matching-image tasks, so an idle one must not
-        # mask the need for a host worker
-        idle = sum(1 for c in self.clients.values()
-                   if c.kind == "worker" and not c.tpu and c.state == "idle"
-                   and c.dedicated_actor is None and not c.container_image)
-        # Tasks can only run while CPU is available, so a pool larger than
-        # the free CPUs is waste; placement-group tasks draw on their
-        # bundle reservation, zero-cpu tasks (e.g. PlacementGroup.ready()
-        # pollers) run regardless of CPU pressure, and actors hold no CPU
-        # — all three always need a process.  Concurrent startups are
-        # capped (reference: worker_pool.h maximum_startup_concurrency
-        # :192,717).
-        n_pg = min(self._queued_pg, len(self.runnable_cpu))
-        n_zero = len(self.runnable_zero)
-        cpu_demand = min(len(self.runnable_cpu) - n_pg,
-                         max(0, int(self.available.get("CPU", 0.0))))
-        demand = cpu_demand + n_pg + n_zero + n_actors_waiting
-        # cold spawns compete for CPU, so their concurrency is capped at
-        # roughly core count; forks from the warm template cost ~ms and
-        # can ramp much harder (reference: worker_pool.h:192,717)
-        if self._prefork_conn is not None or self._prefork_ready():
-            max_concurrent_startup = 16
-        else:
-            max_concurrent_startup = max(2, os.cpu_count() or 1)
-        want = min(demand - idle - self._spawning,
-                   self.config.max_workers - registered - self._spawning,
-                   max_concurrent_startup - self._spawning)
-        for _ in range(max(0, want)):
-            self._spawning += 1
-            self._spawn_worker_proc()
-
-    def _spawn_worker_proc(self, container: Optional[dict] = None) -> None:
-        if _fi._active is not None:
-            # chaos plane: slow-spawn (the fork lands late) or a spawn
-            # that silently dies; _audit_worker_pool self-heals the
-            # in-flight counter either way, exactly as for a real
-            # crashed spawn
-            v = _fi._active.spawn_verdict(self)
-            if v == "fail":
-                return
-            if type(v) is tuple:
-                self.post_later(
-                    v[1], lambda: self._do_spawn_worker(container))
-                return
-        self._do_spawn_worker(container)
-
-    def _do_spawn_worker(self, container: Optional[dict] = None) -> None:
-        logdir = os.path.join(self.session_dir, "logs")
-        # monotone counter, NOT len(): pruning dead procs shrinks the
-        # list and len() would hand a live worker's log index to a new
-        # one (interleaved logs, wrong dashboard attribution)
-        self._worker_seq = getattr(self, "_worker_seq", 0) + 1
-        idx = self._worker_seq
-        outp = os.path.join(logdir, f"worker-{idx}.out")
-        errp = os.path.join(logdir, f"worker-{idx}.err")
-        # containerized workers (runtime_env.container) always bypass
-        # the prefork template: the child must be exec'd INSIDE the
-        # image, and a fork of this host's pre-imported interpreter is
-        # by definition not that (reference:
-        # _private/runtime_env/container.py worker command wrapping)
-        proc = None if container else self._fork_worker(outp, errp)
-        if proc is None:
-            env = self._worker_env()
-            worker_cmd = [sys.executable, "-m", "ray_tpu.core.worker",
-                          "--address", self.worker_address,
-                          "--session", self.session]
-            if container:
-                from ray_tpu.runtime_env import container_command
-                worker_cmd = container_command(container, worker_cmd,
-                                               self.session_dir)
-            out = open(outp, "ab", buffering=0)
-            err = open(errp, "ab", buffering=0)
-            proc = subprocess.Popen(
-                worker_cmd,
-                env=env, stdout=out, stderr=err, start_new_session=True)
-            if container:
-                self._container_spawning[container["image"]] = proc
-        self._worker_procs.append(proc)
-        # stack dumps / the dashboard log view need pid -> log mapping
-        self._worker_log_by_pid[proc.pid] = (outp, errp)
-
-    def _worker_env(self) -> dict:
-        env = dict(os.environ)
-        # Workers must not steal the TPU from the driver: force CPU jax —
-        # and skip ambient TPU-plugin registration entirely (site hooks
-        # keyed on this env cost ~2.4 s of pure import time per process
-        # and risk contending for the chip the driver owns).
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env.setdefault("XLA_FLAGS", "")
-        env["RAY_TPU_SESSION"] = self.session
-        # Propagate the driver's import path so functions/classes pickled
-        # by reference (module-level defs in driver-side scripts) resolve
-        # in workers — the minimal slice of the reference's runtime-env
-        # working_dir propagation (reference:
-        # python/ray/_private/runtime_env/working_dir.py capability).
-        env["PYTHONPATH"] = os.pathsep.join(
-            [p for p in sys.path if p] +
-            [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
-        return env
-
-    # -- fork-server template (core/prefork.py)
-
-    def _start_prefork_template(self) -> None:
-        """Spawn the pre-imported worker template.  Non-blocking: the
-        template warms up (~0.5 s) while the node finishes starting;
-        until its socket accepts, spawns fall back to cold Popen."""
-        logdir = os.path.join(self.session_dir, "logs")
-        os.makedirs(logdir, exist_ok=True)
-        self._prefork_path = os.path.join(self.session_dir, "prefork.sock")
-        out = open(os.path.join(logdir, "prefork.out"), "ab", buffering=0)
-        err = open(os.path.join(logdir, "prefork.err"), "ab", buffering=0)
-        self._prefork_proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.prefork",
-             "--socket", self._prefork_path],
-            env=self._worker_env(), stdout=out, stderr=err,
-            start_new_session=True)
-
-    def _prefork_ready(self) -> bool:
-        if self._prefork_conn is not None:
-            return True
-        if (self._prefork_proc is None
-                or self._prefork_proc.poll() is not None):
-            return False
-        import socket as _socket
-        s = _socket.socket(_socket.AF_UNIX)
-        s.settimeout(0.05)
-        try:
-            s.connect(self._prefork_path)
-        except OSError:
-            s.close()
-            return False
-        # short bound: this socket is read on the EVENT-LOOP thread, so
-        # a wedged template must not stall scheduling for long — on
-        # timeout we drop the template and cold-spawn instead
-        s.settimeout(2.0)
-        self._prefork_conn = s
-        self._prefork_buf = b""
-        return True
-
-    def _fork_worker(self, outp: str, errp: str):
-        """Request a forked worker from the template; None -> caller
-        should cold-spawn instead."""
-        if not self.config.prefork_workers or not self._prefork_ready():
-            return None
-        import json as _json
-        try:
-            req = {"address": self.worker_address,
-                   "stdout": outp, "stderr": errp,
-                   "env": {"RAY_TPU_SESSION": self.session}}
-            self._prefork_conn.sendall(_json.dumps(req).encode() + b"\n")
-            while b"\n" not in self._prefork_buf:
-                chunk = self._prefork_conn.recv(4096)
-                if not chunk:
-                    raise OSError("prefork template closed")
-                self._prefork_buf += chunk
-            line, self._prefork_buf = self._prefork_buf.split(b"\n", 1)
-            return _ForkedProc(_json.loads(line)["pid"])
-        except (OSError, ValueError):
-            try:
-                self._prefork_conn.close()
-            except OSError:
-                pass
-            self._prefork_conn = None
-            return None
-
-    # -- actors
-
-    def _h_create_actor(self, rec, m):
-        spec = m["spec"]
-        if self.head_conn is not None:
-            # head owns names, placement, and the cluster directory
-            reqid = m["reqid"]
-
-            def cb(reply):
-                w = self.clients.get(rec.conn_id)
-                if w is None:
-                    return
-                if reply.get("error"):
-                    self._reply(w, reqid, error=reply["error"])
-                else:
-                    self._reply(w, reqid, actor_id=reply["actor_id"],
-                                existing=reply.get("existing", False))
-            self._head_rpc({"t": "cluster_create_actor",
-                            "spec": _wire_spec(spec)}, cb)
-            return
-        actor_id = ActorID(spec["actor_id"])
-        name = spec.get("name") or ""
-        ns = spec.get("namespace") or "default"
-        if name:
-            key = (ns, name)
-            if key in self.named_actors and \
-                    self.actors[self.named_actors[key]].state != "dead":
-                if spec.get("get_if_exists"):
-                    self._reply(rec, m["reqid"],
-                                actor_id=self.named_actors[key].binary(),
-                                existing=True)
-                    return
-                self._reply(rec, m["reqid"],
-                            error=f"Actor name '{name}' already taken in "
-                                  f"namespace '{ns}'")
-                return
-            self.named_actors[key] = actor_id
-        if not self._feasible(spec):
-            self.named_actors.pop((ns, name), None) if name else None
-            self._reply(rec, m["reqid"],
-                        error=f"Infeasible actor resource demand: "
-                              f"{self._demand(spec)} on {self.total_resources}")
-            return
-        self._reply(rec, m["reqid"], actor_id=actor_id.binary())
-        self._admit_actor(spec)
-
-    def _admit_actor(self, spec: dict) -> ActorRec:
-        actor_id = ActorID(spec["actor_id"])
-        # named concurrency groups add their own in-flight budget on top
-        # of the default group's (reference: concurrency_group_manager.cc
-        # — per-group executors; the executor enforces per-group limits,
-        # the node only caps the total it pushes)
-        mc = spec.get("max_concurrency", 1) + \
-            sum((spec.get("concurrency_groups") or {}).values())
-        ar = ActorRec(actor_id=actor_id, spec=spec,
-                      name=spec.get("name") or "",
-                      namespace=spec.get("namespace") or "default",
-                      restarts_left=spec.get("max_restarts", 0),
-                      max_concurrency=mc)
-        self.actors[actor_id] = ar
-        self._place_actor(ar)
-        return ar
-
-    def _hh_place_actor(self, m: dict) -> None:
-        """Head chose this node to host the actor (fresh or node-death
-        re-place: the constructor re-runs; reference:
-        gcs_actor_manager.cc RestartActor)."""
-        spec = m["spec"]
-        old = self.actors.get(ActorID(spec["actor_id"]))
-        if old is not None and old.state not in ("dead",):
-            return  # duplicate placement push
-        self._admit_actor(spec)
-
-    def _place_actor(self, ar: ActorRec) -> None:
-        needs_tpu = bool(ar.spec.get("num_tpus"))
-        container = (ar.spec.get("runtime_env") or {}).get("container")
-        if container and needs_tpu:
-            self._mark_actor_dead(
-                ar, "runtime_env.container is not supported for TPU "
-                    "actors (TPU work runs on the driver's in-process "
-                    "executor)")
-            return
-        w = self._find_idle_worker(
-            tpu=needs_tpu,
-            container_image=(container or {}).get("image", ""))
-        if w is None:
-            if container:
-                self._maybe_spawn_container_worker(container)
-            else:
-                self._maybe_spawn_worker(tpu=needs_tpu)
-            # event-driven retry on the next worker registration (the
-            # 50 ms poll alone serialized bursts of actor creations)
-            self._actors_wanting_worker.append(ar)
-            self.post_later(0.05, lambda: self._place_actor_if_pending(ar))
-            return
-        if not self._try_acquire(ar.spec):
-            self.post_later(0.05, lambda: self._place_actor_if_pending(ar))
-            return
-        if not w.tpu:
-            # CPU actors get a dedicated worker process (reference: one
-            # worker per actor); the in-process TPU executor is shared —
-            # it hosts all TPU actors and tasks in the driver.
-            w.dedicated_actor = ar.actor_id
-            w.state = "busy"
-        ar.conn_id = w.conn_id
-        self._push(w, {"t": "create_actor_exec", "spec": ar.spec})
-
-    def _place_actor_if_pending(self, ar: ActorRec) -> None:
-        if ar.state in ("pending", "restarting") and ar.conn_id is None:
-            self._place_actor(ar)
-
-    def _report_actor_state(self, ar: ActorRec) -> None:
-        """State fan-out: via the head in cluster mode (it publishes and
-        resolves watchers), locally otherwise."""
-        if self.head_conn is not None:
-            self._head_send({"t": "actor_state_report",
-                             "actor_id": ar.actor_id.binary(),
-                             "state": ar.state,
-                             "death_cause": ar.death_cause})
-        else:
-            self._publish_local("actor_state",
-                                {"actor_id": ar.actor_id.hex(),
-                                 "state": ar.state})
-
-    def _h_actor_created(self, rec, m):
-        ar = self.actors.get(ActorID(m["actor_id"]))
-        if ar is None:
-            return
-        if m.get("error"):
-            ar.state = "dead"
-            ar.death_cause = m["error"]
-            self._fail_actor_queue(ar, m["error"])
-            if rec.dedicated_actor == ar.actor_id:
-                rec.dedicated_actor = None
-                rec.state = "idle"
-            ar.conn_id = None
-            self._return_resources(ar.spec)
-            self._report_actor_state(ar)
-        else:
-            ar.state = "alive"
-            self._report_actor_state(ar)
-            self._dispatch_actor_queue(ar)
-
-    def _h_submit_actor_task(self, rec, m):
-        spec = m["spec"]
-        actor_id = ActorID(spec["actor_id"])
-        ar = self.actors.get(actor_id)
-        if self.head_conn is not None and not spec.get("owner_node"):
-            # actor-task returns get the ownership directory but NOT
-            # lineage: re-running actor methods is not loss-transparent
-            # (reference: actor results -> ObjectLostError by default)
-            spec["owner_node"] = (self.node_id.hex(), self.address)
-        onode = tuple(spec.get("owner_node") or ())
-        for b in spec["return_ids"]:
-            info = self.objects.setdefault(ObjectID(b), ObjInfo())
-            info.owner = info.owner or spec.get("owner", "")
-            if onode and not info.owner_node:
-                info.owner_node = onode
-        self.tasks[spec["task_id"]] = TaskRec(spec=spec)
-        if _fr._active is not None:
-            _fr._active.start_or_stamp(spec, "node_recv")
-        self._record_event(spec, "PENDING")
-        if ar is not None:
-            if ar.state == "dead":
-                self._fail_task(spec, f"Actor is dead: {ar.death_cause}")
-                return
-            ar.queue.append(spec)
-            self._dispatch_actor_queue(ar)
-            return
-        if self.head_conn is None:
-            self._fail_task(spec, "Actor is dead: actor not found")
-            return
-        self._route_actor_task(spec)
-
-    # ---- cluster actor-task routing
-
-    def _route_actor_task(self, spec: dict) -> None:
-        ab = spec["actor_id"]
-        cached = self.actor_cache.get(ab)
-        if cached is not None:
-            # on forward failure: invalidate the cache and re-route via a
-            # fresh head lookup (the actor may have moved)
-            self._forward_actor_task(
-                spec, cached[0], cached[1],
-                on_fail=lambda: (self.actor_cache.pop(ab, None),
-                                 self._queue_actor_locate(spec)))
-            return
-        self._queue_actor_locate(spec)
-
-    def _queue_actor_locate(self, spec: dict) -> None:
-        ab = spec["actor_id"]
-        waiting = self._awaiting_actor.setdefault(ab, [])
-        waiting.append(spec)
-        if len(waiting) == 1:
-            self._head_rpc({"t": "locate_actor", "actor_id": ab},
-                           lambda reply: self._on_actor_located(ab, reply))
-
-    def _on_actor_located(self, ab: bytes, reply: dict) -> None:
-        state = reply.get("state")
-        if reply.get("error") and self.head_conn is None:
-            # transient: the head died mid-locate.  Keep the specs
-            # parked through the failover grace window — the rejoin
-            # path re-asks, on_tick expires the window.
-            self._actor_wait_parked.setdefault(ab, time.monotonic())
-            return
-        self._actor_wait_parked.pop(ab, None)   # the head answered
-        if reply.get("error") or state in ("dead", "unknown"):
-            cause = reply.get("death_cause") or reply.get("error") \
-                or "actor not found"
-            for spec in self._awaiting_actor.pop(ab, []):
-                self._fail_task(spec, f"Actor is dead: {cause}")
-            return
-        if state == "alive":
-            self.actor_cache[ab] = (reply["node"], reply["address"])
-            for spec in self._awaiting_actor.pop(ab, []):
-                self._forward_actor_task(
-                    spec, reply["node"], reply["address"],
-                    on_fail=lambda s=spec: self._fail_task(
-                        s, "Actor's node is unreachable"))
-            return
-        # pending/restarting: the head registered us as a watcher and will
-        # push actor_at when it settles — keep the specs queued
-
-    def _hh_actor_at(self, m: dict) -> None:
-        self._on_actor_located(m["actor_id"], m)
-
-    def _forward_actor_task(self, spec: dict, node_hex: str,
-                            address: str, on_fail) -> None:
-        def go(conn):
-            if conn is None:
-                on_fail()
-                return
-            wire = _wire_spec(spec)
-            wire["_routed"] = True
-            self._attach_arg_owners(wire, spec)
-            try:
-                conn.send({"t": "remote_actor_task", "spec": wire})
-            except protocol.ConnectionClosed:
-                self._drop_peer(node_hex)
-                on_fail()
-                return
-            tid = spec["task_id"]
-            tr = self.tasks.get(tid)
-            if tr is not None:
-                tr.state = "forwarded"
-            self._fwd_tasks[tid] = {"spec": spec, "dst": node_hex,
-                                    "retries": 0, "actor": True}
-            for b in spec["return_ids"]:
-                self._fwd_by_oid[b] = tid
-            self._ensure_remote_watch(
-                [ObjectID(b) for b in spec["return_ids"]])
-        self._peer_conn_async(node_hex, address, go)
-
-    def _h_remote_actor_task(self, rec, m):
-        """A peer node forwarded a method call for an actor hosted here."""
-        spec = m["spec"]
-        spec["_routed"] = True
-        actor_id = ActorID(spec["actor_id"])
-        self._absorb_arg_owners(spec)
-        onode = tuple(spec.get("owner_node") or ())
-        for b in spec["return_ids"]:
-            info = self.objects.setdefault(ObjectID(b), ObjInfo())
-            info.owner = info.owner or spec.get("owner", "")
-            if onode and not info.owner_node:
-                info.owner_node = onode
-        self.tasks[spec["task_id"]] = TaskRec(spec=spec)
-        self._record_event(spec, "PENDING")
-        ar = self.actors.get(actor_id)
-        if ar is None or ar.state == "dead":
-            cause = ar.death_cause if ar else "actor not on this node"
-            self._fail_task(spec, f"Actor is dead: {cause}")
-            return
-        ar.queue.append(spec)
-        self._dispatch_actor_queue(ar)
-
-    def _dispatch_actor_queue(self, ar: ActorRec) -> None:
-        if ar.state != "alive" or ar.conn_id is None:
-            return
-        w = self.clients.get(ar.conn_id)
-        if w is None:
-            return
-        while ar.queue and ar.inflight < ar.max_concurrency:
-            spec = ar.queue.popleft()
-            if not self._args_ready(spec):
-                # actors preserve submission order: put back and stop
-                ar.queue.appendleft(spec)
-                self._ensure_remote_watch(
-                    [ObjectID(b) for b in spec.get("arg_ids", [])
-                     if self.objects.setdefault(ObjectID(b),
-                                                ObjInfo()).state == "pending"])
-                self._wait_args_then(spec, lambda: self._dispatch_actor_queue(ar))
-                return
-            ar.running[spec["task_id"]] = spec
-            for b in spec.get("arg_ids", []):
-                self.store.pin(ObjectID(b))
-            tr = self.tasks.get(spec["task_id"])
-            if tr is not None:
-                tr.state = "running"
-                tr.started_at = time.time()
-                tr.worker = w.conn_id
-            self._record_event(spec, "RUNNING", worker=w.conn_id)
-            if _fr._active is not None:
-                _fr._active.stamp(spec, "dispatch")
-            self._push(w, {"t": "execute_actor", "spec": spec})
-
-    def _wait_args_then(self, spec, cb) -> None:
-        remaining = [ObjectID(b) for b in spec.get("arg_ids", [])
-                     if self.objects.get(ObjectID(b), ObjInfo()).state == "pending"]
-        if not remaining:
-            cb()
-            return
-        # Poll via the event loop until the dependency lands (v1; the
-        # reference stages deps through the DependencyManager).
-        self.post_later(0.02, lambda: self._wait_args_then(spec, cb))
-
-    def _fail_actor_queue(self, ar: ActorRec, error: str) -> None:
-        while ar.queue:
-            self._fail_task(ar.queue.popleft(), f"Actor died: {error}")
-
-    def _h_kill_actor(self, rec, m):
-        actor_id = ActorID(m["actor_id"])
-        ar = self.actors.get(actor_id)
-        if ar is None and self.head_conn is not None:
-            # actor lives elsewhere: the head routes the kill
-            reqid = m.get("reqid")
-
-            def cb(reply):
-                w = self.clients.get(rec.conn_id)
-                if reqid is not None and w is not None:
-                    self._reply(w, reqid, ok=bool(reply.get("ok")))
-            self._head_rpc({"t": "kill_actor", "actor_id": m["actor_id"],
-                            "no_restart": m.get("no_restart", True)}, cb)
-            return
-        if ar is None:
-            if "reqid" in m:
-                self._reply(rec, m["reqid"], ok=False)
-            return
-        self._kill_local_actor(ar, m.get("no_restart", True))
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
-
-    def _kill_local_actor(self, ar: ActorRec, no_restart: bool) -> None:
-        if no_restart:
-            ar.restarts_left = 0
-        w = self.clients.get(ar.conn_id) if ar.conn_id is not None else None
-        if w is not None and not w.tpu:
-            self._push(w, {"t": "exit"})
-        elif w is not None:
-            # shared in-process TPU executor: destroy only this actor's
-            # instance, keep the executor alive for other work
-            self._push(w, {"t": "destroy_actor",
-                           "actor_id": ar.actor_id.binary()})
-            self._mark_actor_dead(ar, "killed")
-        else:
-            self._mark_actor_dead(ar, "killed")
-
-    def _hh_kill_local_actor(self, m: dict) -> None:
-        ar = self.actors.get(ActorID(m["actor_id"]))
-        if ar is not None:
-            self._kill_local_actor(ar, m.get("no_restart", True))
-
-    def _mark_actor_dead(self, ar: ActorRec, cause: str) -> None:
-        if ar.state == "dead":
-            return
-        ar.state = "dead"
-        ar.death_cause = cause
-        ar.conn_id = None
-        for spec in list(ar.running.values()):
-            self._fail_task(spec, f"Actor died: {cause}")
-        ar.running.clear()
-        self._fail_actor_queue(ar, cause)
-        self._return_resources(ar.spec)
-        self._report_actor_state(ar)
-
-    def _h_get_named_actor(self, rec, m):
-        if self._cluster_scope(rec, m):
-            return
-        key = (m.get("namespace") or "default", m["name"])
-        aid = self.named_actors.get(key)
-        if aid is None or self.actors[aid].state == "dead":
-            self._reply(rec, m["reqid"], error="not found")
-        else:
-            ar = self.actors[aid]
-            self._reply(rec, m["reqid"], actor_id=aid.binary(), spec_meta={
-                "methods": ar.spec.get("methods", []),
-                "class_name": ar.spec.get("class_name", "")})
-
-    def _h_list_named_actors(self, rec, m):
-        if self._cluster_scope(rec, m):
-            return
-        out = [{"namespace": ns, "name": n}
-               for (ns, n), aid in self.named_actors.items()
-               if self.actors[aid].state != "dead"
-               and (m.get("all_namespaces") or ns == (m.get("namespace")
-                                                      or "default"))]
-        self._reply(rec, m["reqid"], actors=out)
 
     # -- head proxying ------------------------------------------------------
 
@@ -2480,69 +655,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             out = {k: v for k, v in reply.items() if k not in ("t", "reqid")}
             self._reply(w, reqid, **out)
         self._head_rpc(fwd, cb)
-
-    # -- placement groups
-
-    def _h_create_pg(self, rec, m):
-        if self._cluster_scope(rec, m):
-            return   # head (or failover error) ran the cross-node 2PC
-        bundles = m["bundles"]
-        total = bundle_total(bundles)
-        if not covers(self.total_resources, total):
-            # can NEVER fit on this node — fail creation synchronously
-            self._reply(rec, m["reqid"],
-                        error=f"Infeasible placement group {total}; "
-                              f"node total {self.total_resources}")
-            return
-        # creation is async: reply now, reserve when resources allow;
-        # PlacementGroup.ready() gates on pg_state == "created"
-        self._reply(rec, m["reqid"], ok=True, state="pending")
-        self._pending_local_pgs[m["pg_id"]] = {
-            "bundles": bundles, "strategy": m.get("strategy", "PACK")}
-        self._try_place_local_pgs()
-
-    def _try_place_local_pgs(self) -> None:
-        """Reserve queued single-node PGs once resources free up."""
-        for pgb, info in list(self._pending_local_pgs.items()):
-            total = bundle_total(info["bundles"])
-            if not covers(self.available, total):
-                continue
-            for k, v in total.items():
-                self.available[k] -= v
-            pg_id = PlacementGroupID(pgb)
-            self.pgs[pg_id] = PGRec(pg_id=pg_id, bundles=info["bundles"],
-                                    strategy=info["strategy"])
-            for i, b in enumerate(info["bundles"]):
-                self.pg_available[(pgb, i)] = dict(b)
-            del self._pending_local_pgs[pgb]
-            self._schedule()
-
-    def _h_pg_state(self, rec, m):
-        if self._cluster_scope(rec, m):
-            return
-        pg_id = PlacementGroupID(m["pg_id"])
-        if pg_id in self.pgs:
-            st = "created"
-        elif m["pg_id"] in self._pending_local_pgs:
-            st = "pending"
-        else:
-            st = "removed"
-        self._reply(rec, m["reqid"], ok=True, state=st)
-
-    def _h_remove_pg(self, rec, m):
-        if self._cluster_scope(rec, m):
-            return
-        pg_id = PlacementGroupID(m["pg_id"])
-        self._pending_local_pgs.pop(m["pg_id"], None)
-        pg = self.pgs.pop(pg_id, None)
-        if pg is not None:
-            for i, b in enumerate(pg.bundles):
-                self.pg_available.pop((pg_id.binary(), i), None)
-                for k, v in b.items():
-                    self.available[k] = self.available.get(k, 0.0) + v
-            self._try_place_local_pgs()
-        if "reqid" in m:
-            self._reply(rec, m["reqid"], ok=True)
 
     # 2PC participant handlers (pushed by the head over the head channel;
     # reference: gcs_placement_group_scheduler.h Prepare/Commit on raylets)
@@ -2589,41 +701,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         self._reply(rec, m["reqid"], ok=True, data=data,
                     session=getattr(self, "head_session", ""),
                     seq=getattr(self, "_head_replica_seq", 0))
-
-    def _hh_pg_prepare(self, m: dict) -> None:
-        bundle = m["bundle"]
-        ok = all(self.available.get(k, 0.0) + 1e-9 >= v
-                 for k, v in bundle.items())
-        if ok:
-            for k, v in bundle.items():
-                self.available[k] -= v
-            self._pg_prepared[(m["pg_id"], m["bundle_idx"])] = dict(bundle)
-        self._head_reply(m["reqid"], ok=ok)
-
-    def _hh_pg_commit(self, m: dict) -> None:
-        key = (m["pg_id"], m["bundle_idx"])
-        bundle = self._pg_prepared.pop(key, None)
-        if bundle is not None:
-            self.pg_available[key] = dict(bundle)
-            self._pg_bundles[key] = dict(bundle)   # original reservation
-
-    def _hh_pg_rollback(self, m: dict) -> None:
-        bundle = self._pg_prepared.pop((m["pg_id"], m["bundle_idx"]), None)
-        if bundle is not None:
-            for k, v in bundle.items():
-                self.available[k] = self.available.get(k, 0.0) + v
-
-    def _hh_pg_remove_local(self, m: dict) -> None:
-        key = (m["pg_id"], m["bundle_idx"])
-        free = self.pg_available.pop(key, None)
-        # hand the ORIGINAL bundle reservation back to the node; tasks
-        # still drawing on the bundle release into the void afterwards,
-        # same as the reference's bundle-return semantics
-        orig = self._pg_bundles.pop(key, None)
-        if orig is None and free is None:
-            return
-        for k, v in (orig or free).items():
-            self.available[k] = self.available.get(k, 0.0) + v
 
     # -- kv / pubsub
 
@@ -2672,961 +749,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
     def _hh_view_update(self, m: dict) -> None:
         self.cluster_view = m["view"]
 
-    # -- node-to-node object transfer ---------------------------------------
-
-    def _peer_conn_async(self, node_hex: str, address: str, cb) -> None:
-        """Hand `cb` a Connection to the peer (or None).  The TCP connect
-        runs on a helper thread — a blackholed peer must never stall the
-        event loop (heartbeats ride it, and a stalled loop gets this
-        healthy node declared dead)."""
-        conn = self._peer_conns.get(node_hex)
-        if conn is not None:
-            cb(conn)
-            return
-        waiters = self._peer_connecting.setdefault(node_hex, [])
-        waiters.append(cb)
-        if len(waiters) > 1:
-            return   # a connect is already in flight
-
-        def work():
-            c = None
-            try:
-                c = protocol.connect(
-                    address, timeout=5.0, remote=True,
-                    label=(f"node:{self.node_id.hex()[:8]}",
-                           f"node:{node_hex[:8]}"))
-                c.send({"t": "register", "kind": "peer", "reqid": 0,
-                        "node_hex": self.node_id.hex(),
-                        "worker_id": f"peer-{self.node_id.hex()[:12]}"})
-            except (OSError, protocol.ConnectionClosed):
-                if c is not None:
-                    try:
-                        c.close()
-                    except Exception:
-                        pass
-                c = None
-            self.post(lambda: self._peer_connected(node_hex, c))
-        threading.Thread(target=work, daemon=True,
-                         name=f"raytpu-connect-{node_hex[:8]}").start()
-
-    def _peer_connected(self, node_hex: str,
-                        conn: Optional[protocol.Connection]) -> None:
-        cbs = self._peer_connecting.pop(node_hex, [])
-        if conn is not None:
-            self._peer_conns[node_hex] = conn
-            from ray_tpu.core.local_lane import LaneConnection
-            if isinstance(conn, LaneConnection):
-                # same-process peer: deliver from its loop, no recv thread
-                conn.on_close = \
-                    lambda: self.post(lambda: self._drop_peer(node_hex))
-                conn.set_deliver(
-                    lambda m: self.post(
-                        lambda m=m: self._on_peer_msg(node_hex, m)))
-            else:
-                t = threading.Thread(target=self._peer_recv_loop,
-                                     args=(node_hex, conn), daemon=True,
-                                     name=f"raytpu-peer-{node_hex[:8]}")
-                t.start()
-        for cb in cbs:
-            try:
-                cb(conn)
-            except Exception:
-                sys.stderr.write("[node] peer-connect callback failed:\n"
-                                 + traceback.format_exc())
-
-    def _peer_recv_loop(self, node_hex: str,
-                        conn: protocol.Connection) -> None:
-        while not self._stop.is_set():
-            try:
-                msg = conn.recv()
-            except protocol.ConnectionClosed:
-                self.post(lambda: self._drop_peer(node_hex))
-                return
-            except Exception:
-                continue
-            self.post(lambda m=msg: self._on_peer_msg(node_hex, m))
-
-    def _drop_peer(self, node_hex: str) -> None:
-        conn = self._peer_conns.pop(node_hex, None)
-        if conn is not None:
-            try:
-                conn.close()
-            except Exception:
-                pass
-        # pulls in flight from that peer: retry through the head (it may
-        # know another location, or the producer will resubmit)
-        for ob, st in list(self._pulls.items()):
-            if st["src"] == node_hex:
-                self._pulls.pop(ob, None)
-                self._watched.discard(ob)
-                self.post_later(
-                    0.1, lambda o=ObjectID(ob): self._ensure_remote_watch([o]))
-
-    def _ensure_remote_watch(self, oids: list) -> None:
-        """Route pending objects to their location authority: the OWNER
-        node when known (reference: ownership_based_object_directory.cc),
-        the head only as fallback for objects with no owner hint.  Safe
-        to call repeatedly — each object is watched at most once."""
-        if self.head_conn is None:
-            return
-        me = self.node_id.hex()
-        head_want = []
-        by_owner: dict[tuple, list] = {}
-        for o in oids:
-            ob = o.binary()
-            if ob in self._watched or ob in self._pulls:
-                continue
-            info = self.objects.get(o)
-            if info is not None and info.state != "pending":
-                continue
-            onode = tuple(info.owner_node) if info is not None \
-                and info.owner_node else ()
-            if onode and onode[0] == me:
-                # owner-side resolution is idempotent and cheap — don't
-                # latch _watched, so demand arriving later re-resolves
-                self._owner_self_resolve(ob)
-            elif onode:
-                self._watched.add(ob)
-                by_owner.setdefault(onode, []).append(ob)
-            else:
-                self._watched.add(ob)
-                head_want.append(ob)
-        for onode, obs in by_owner.items():
-            self._owner_locate_send(onode, obs)
-        if head_want:
-            self._head_locate(head_want)
-
-    def _head_locate(self, obs: list, fatal_missing: bool = False) -> None:
-        """Fallback directory lookup through the head."""
-
-        def cb(reply):
-            if reply.get("error"):
-                return
-            locs = reply.get("locs", {})
-            for ob, (node_hex, addr) in locs.items():
-                self._request_pull(ObjectID(ob), node_hex, addr)
-            if fatal_missing:
-                from ray_tpu.core.client import ObjectLostError
-                for ob in obs:
-                    if ob in locs:
-                        continue
-                    oid = ObjectID(ob)
-                    info = self.objects.get(oid)
-                    if info is not None and info.state == "pending":
-                        self._seal_error_object(oid, ObjectLostError(
-                            f"Object {oid.hex()[:16]} was lost: its "
-                            "owner node died and no copy is known"))
-        self._head_rpc({"t": "locate_object", "object_ids": list(obs)}, cb)
-
-    # -- ownership directory protocol ----------------------------------------
-
-    def _owner_locate_send(self, onode: tuple, obs: list) -> None:
-        """Ask the owner node where these objects live; it replies with
-        object_at pushes (or owner_object_lost) and registers us as a
-        watcher until then."""
-        hexn, addr = onode
-
-        def go(conn):
-            if conn is None:
-                self._owner_unreachable(hexn, obs)
-                return
-            try:
-                conn.send({"t": "owner_locate", "object_ids": list(obs),
-                           "from_hex": self.node_id.hex(),
-                           "from_addr": self.address})
-                for ob in obs:
-                    self._owner_watch[ob] = hexn
-            except protocol.ConnectionClosed:
-                self._drop_peer(hexn)
-                self._owner_unreachable(hexn, obs)
-        self._peer_conn_async(hexn, addr, go)
-
-    def _owner_unreachable(self, owner_hex: str, obs: list) -> None:
-        """Owner node gone: fall back to the head directory; if it knows
-        no copy either, the object is lost for good."""
-        retry = []
-        for ob in obs:
-            self._owner_watch.pop(ob, None)
-            info = self.objects.get(ObjectID(ob))
-            if info is not None and info.state == "pending":
-                info.owner_node = ()
-                retry.append(ob)
-        if retry:
-            self._head_locate(retry, fatal_missing=True)
-
-    def _owner_push(self, node_hex: str, address: str, msg: dict) -> None:
-        def go(conn):
-            if conn is None:
-                return
-            # corked: one owner push per finished task — the batch flush
-            # turns a per-task send into one send per loop pass (a dead
-            # peer is noticed by its recv/on_close path)
-            self._conn_send(conn, msg)
-        self._peer_conn_async(node_hex, address, go)
-
-    def _owner_add_location(self, ob: bytes, node_hex: str,
-                            address: str) -> None:
-        """Owner-side: record that a copy of an owned object exists on
-        `node_hex`, notify watchers, feed our own pending consumers."""
-        orec = self.owned.get(ob)
-        if orec is None:
-            orec = self.owned[ob] = OwnedRec()
-        orec.locations[node_hex] = address
-        # a remote location report IS the completion signal for a task we
-        # forwarded — settle its record so node-death recovery treats the
-        # object as lost-but-reconstructable, not in-flight
-        tid = self._fwd_by_oid.pop(ob, None)
-        if tid is not None:
-            fw = self._fwd_tasks.get(tid)
-            if fw is not None and not any(b in self._fwd_by_oid
-                                          for b in fw["spec"]["return_ids"]):
-                self._fwd_tasks.pop(tid, None)
-                tr = self.tasks.get(tid)
-                if tr is not None and tr.state == "forwarded":
-                    tr.state = "finished"
-                    tr.finished_at = time.time()
-                    self._note_task_finished(tid)
-                    self._release_arg_blob(fw["spec"])
-        if orec.watchers:
-            watchers, orec.watchers = orec.watchers, set()
-            for whex, waddr in watchers:
-                if whex == node_hex:
-                    continue
-                self._owner_push(whex, waddr,
-                                 {"t": "object_at", "object_id": ob,
-                                  "node": node_hex, "address": address})
-        # demand-driven: pull our own copy only if something local waits
-        # on it (a get, a wait, or a queued task's dependency)
-        oid = ObjectID(ob)
-        info = self.objects.get(oid)
-        if info is not None and info.state == "pending" \
-                and node_hex != self.node_id.hex() \
-                and (oid in self._mg_by_oid or oid in self.dep_waiting
-                     or info.wait_waiters):
-            self._request_pull(oid, node_hex, address)
-
-    def _h_owner_object_at(self, rec, m):
-        """A node stored a copy of an object WE own."""
-        self._owner_add_location(m["object_id"], m["node"], m["address"])
-
-    def _h_owner_locate(self, rec, m):
-        """A consumer asks us (the owner) where our objects live."""
-        me = self.node_id.hex()
-        watcher = (m.get("from_hex", ""), m.get("from_addr", ""))
-        for ob in m["object_ids"]:
-            oid = ObjectID(ob)
-            info = self.objects.get(oid)
-            if info is not None and info.state != "pending":
-                self._push(rec, {"t": "object_at", "object_id": ob,
-                                 "node": me, "address": self.address})
-                continue
-            orec = self.owned.get(ob)
-            if orec is not None:
-                self._prune_dead_locations(orec)
-                loc = next(((h, a) for h, a in orec.locations.items()
-                            if h != me), None)
-                if loc is not None:
-                    self._push(rec, {"t": "object_at", "object_id": ob,
-                                     "node": loc[0], "address": loc[1]})
-                    continue
-            tid = (orec.task_id if orec is not None and orec.task_id
-                   else oid.task_id().binary())
-            if self._producer_in_flight(tid) or self._reconstruct(tid):
-                # result will arrive: register the asker for the
-                # object_at push that follows
-                if watcher[0]:
-                    orec = self.owned.get(ob)
-                    if orec is None:
-                        orec = self.owned[ob] = OwnedRec(task_id=tid)
-                    orec.watchers.add(watcher)
-                continue
-            self._push(rec, {"t": "owner_object_lost", "object_id": ob,
-                             "cause": "owner holds no copy and no lineage"})
-
-    def _h_object_at(self, rec, m):
-        """Location push from an owner node (same shape as the head's)."""
-        self._on_owner_object_at_push(m)
-
-    def _h_owner_object_value(self, rec, m):
-        """Inline VALUE pushed by the node that executed forwarded work
-        we own — seal it locally, skipping locate/pull round trips."""
-        ob = m["object_id"]
-        self._owner_watch.pop(ob, None)
-        self._watched.discard(ob)
-        oid = ObjectID(ob)
-        info = self.objects.setdefault(oid, ObjInfo())
-        if info.state != "pending":
-            return
-        info.state = "error" if m.get("is_error") else "ready"
-        info.loc = "inline"
-        info.data = m["data"]
-        info.is_error = bool(m.get("is_error"))
-        info.size = len(m["data"] or b"")
-        # the executing node still holds a replica — track it like an
-        # owner_object_at so release sweeps can reach it
-        self._owner_add_location(ob, m["node"], m["address"])
-        self._resolve_waiters(oid, info)
-
-    def _on_owner_object_at_push(self, m: dict) -> None:
-        self._owner_watch.pop(m["object_id"], None)
-        self._hh_object_at(m)
-
-    def _h_owner_object_lost(self, rec, m):
-        self._on_owner_object_lost_push(m)
-
-    def _on_owner_object_lost_push(self, m: dict) -> None:
-        ob = m["object_id"]
-        self._owner_watch.pop(ob, None)
-        oid = ObjectID(ob)
-        info = self.objects.get(oid)
-        if info is None or info.state != "pending":
-            return
-        from ray_tpu.core.client import ObjectLostError
-        self._seal_error_object(oid, ObjectLostError(
-            f"Object {oid.hex()[:16]} was lost: {m.get('cause', '')}"))
-
-    def _prune_dead_locations(self, orec: OwnedRec) -> None:
-        me = self.node_id.hex()
-        for h in list(orec.locations):
-            if h != me and h not in self.cluster_view:
-                orec.locations.pop(h)
-
-    def _producer_in_flight(self, tid: bytes) -> bool:
-        if tid in self._fwd_tasks:
-            return True
-        tr = self.tasks.get(tid)
-        return tr is not None and tr.state in ("pending", "running",
-                                               "forwarded")
-
-    def _owner_self_resolve(self, ob: bytes) -> None:
-        """We own this pending object: pull a known copy, wait on the
-        in-flight producer, or re-execute it from lineage (reference:
-        object_recovery_manager.h:41)."""
-        oid = ObjectID(ob)
-        info = self.objects.get(oid)
-        if info is None or info.state != "pending":
-            return
-        me = self.node_id.hex()
-        orec = self.owned.get(ob)
-        if orec is not None:
-            self._prune_dead_locations(orec)
-            loc = next(((h, a) for h, a in orec.locations.items()
-                        if h != me), None)
-            if loc is not None:
-                self._request_pull(oid, loc[0], loc[1])
-                return
-        # no live copy: wait on an in-flight producer (the owned rec may
-        # not exist yet — lineage-less tasks only get one when a
-        # location is first reported), reconstruct, or declare the loss
-        tid = (orec.task_id if orec is not None and orec.task_id
-               else oid.task_id().binary())
-        if self._producer_in_flight(tid):
-            return
-        if self._reconstruct(tid):
-            return
-        from ray_tpu.core.client import ObjectLostError
-        self._seal_error_object(oid, ObjectLostError(
-            f"Object {oid.hex()[:16]} was lost and cannot be "
-            "reconstructed (no live copy, no retained lineage)"))
-
-    def _reconstruct(self, tid: bytes) -> bool:
-        """Re-execute the producer of lost owned objects.  Deterministic
-        return ids mean the re-run recreates exactly the lost objects
-        (reference: object_recovery_manager.h ReconstructObject)."""
-        lin = self.lineage.get(tid)
-        if lin is None or lin.get("spec") is None:
-            return False
-        if lin["recons"] >= self.config.max_object_reconstructions:
-            return False
-        lin["recons"] += 1
-        spec = dict(lin["spec"])
-        # fresh flight-recorder record: the captured wire spec shares
-        # the original attempt's stamp list, and stamping into it would
-        # misattribute the whole loss-detection gap to node_recv
-        spec.pop("fr", None)
-        spec.pop("fr_w0", None)
-        spec.pop("fr_done", None)
-        sys.stderr.write(f"[node] reconstructing task "
-                         f"{tid.hex()[:12]} (attempt {lin['recons']})\n")
-        self._admit_task(spec)
-        return True
-
-    def _hh_object_at(self, m: dict) -> None:
-        oid = ObjectID(m["object_id"])
-        info = self.objects.get(oid)
-        if info is not None and info.state == "pending":
-            self._request_pull(oid, m["node"], m["address"])
-
-    def _hh_object_lost(self, m: dict) -> None:
-        ob = m["object_id"]
-        if ob in self._fwd_by_oid:
-            return  # our own forwarded task will be resubmitted on node_dead
-        oid = ObjectID(ob)
-        info = self.objects.get(oid)
-        if info is None or info.state != "pending":
-            return
-        if info.owner_node:
-            # the owner, not the head, decides whether this is fatal —
-            # it may hold another copy or reconstruct from lineage
-            if info.owner_node[0] == self.node_id.hex():
-                self._owner_self_resolve(ob)
-            elif ob not in self._owner_watch:
-                self._owner_locate_send(tuple(info.owner_node), [ob])
-            return
-        from ray_tpu.core.client import ObjectLostError
-        self._seal_error_object(oid, ObjectLostError(
-            f"Object {oid.hex()[:16]} was lost: "
-            f"{m.get('cause', 'node died')}"))
-
-    def _request_pull(self, oid: ObjectID, node_hex: str,
-                      address: str) -> None:
-        ob = oid.binary()
-        if ob in self._pulls:
-            return
-        info = self.objects.get(oid)
-        if info is None or info.state != "pending":
-            return
-        if self._try_local_pull(oid, ob, node_hex):
-            return
-        # reserve the pull slot BEFORE the async connect so concurrent
-        # object_at notifications don't start duplicate transfers
-        self._pulls[ob] = {"src": node_hex, "view": None, "size": None,
-                           "received": 0, "is_error": False}
-
-        def go(conn):
-            st = self._pulls.get(ob)
-            if st is None or st["src"] != node_hex:
-                return   # resolved or re-routed while connecting
-            if conn is None:
-                self._pulls.pop(ob, None)
-                self._watched.discard(ob)
-                self.post_later(0.2,
-                                lambda: self._ensure_remote_watch([oid]))
-                return
-            try:
-                conn.send({"t": "pull_object", "object_id": ob,
-                           # after any failed attempt, insist on a direct
-                           # stream — never bounce through a relay again
-                           "no_redirect":
-                               self._pull_attempts.get(ob, 0) > 0})
-            except protocol.ConnectionClosed:
-                self._pulls.pop(ob, None)
-                self._watched.discard(ob)
-                self._drop_peer(node_hex)
-                self.post_later(0.2,
-                                lambda: self._ensure_remote_watch([oid]))
-        self._peer_conn_async(node_hex, address, go)
-
-    # same-process fast path -------------------------------------------------
-
-    def _try_local_pull(self, oid: ObjectID, ob: bytes,
-                        node_hex: str) -> bool:
-        """Peer lives in THIS process (virtual cluster): hand the bytes
-        over with one memcpy.  Thread discipline: the source's loop pins
-        + maps, our loop copies into our arena, the source's loop
-        unpins.  Falls back to the socket path on any miss."""
-        if not self.config.same_host_object_fastpath:
-            return False
-        src = _LOCAL_NODES_BY_HEX.get(node_hex)
-        if src is None or src is self or src._stop.is_set():
-            return False
-        self._pulls[ob] = {"src": node_hex, "view": None, "size": None,
-                           "received": 0, "is_error": False, "local": True}
-
-        def replay_pulls(queued):
-            # socket peers that asked for the object mid-memcpy: serve
-            # them now (object present -> stream; absent -> pull_failed
-            # so they re-route)
-            for cid, pm in queued:
-                peer = self.clients.get(cid)
-                if peer is not None:
-                    self._h_pull_object(peer, pm)
-
-        def fallback():
-            st = self._pulls.get(ob)
-            if st is not None and st.get("local"):
-                self._pulls.pop(ob, None)
-                self._watched.discard(ob)
-                replay_pulls(st.get("replay_pulls", []))
-                self.post_later(0.1,
-                                lambda: self._ensure_remote_watch([oid]))
-
-        def on_src():
-            info = src.objects.get(oid)
-            if (info is None or info.state != "ready"
-                    or info.loc not in ("shm", "inline")):
-                self.post(fallback)
-                return
-            if info.loc == "inline":
-                data, is_err = info.data, info.is_error
-                self.post(lambda: self._local_pull_inline(
-                    oid, ob, data, is_err))
-                return
-            if src.store.is_spilled(oid):
-                src.store.restore(oid)
-            src.store.pin(oid)
-            try:
-                view = src.store._shm.map(oid)
-            except Exception:
-                src.store.unpin(oid)
-                self.post(fallback)
-                return
-            size = src.objects[oid].size
-
-            def on_dst():
-                try:
-                    try:
-                        buf = self.store._shm.create(oid, size)
-                        _gil_free_copy(buf, view, size)
-                        del buf
-                        self.store._shm.seal(oid)
-                    except ObjectExists:
-                        pass
-                    st = self._pulls.pop(ob, None)
-                    if st is None:
-                        return   # resolved another way meanwhile
-                    self.store.register(oid, size)
-                    info2 = self.objects.setdefault(oid, ObjInfo())
-                    info2.state = "ready"
-                    info2.loc = "shm"
-                    info2.size = size
-                    self._resolve_waiters(oid, info2)
-                    replay_pulls(st.get("replay_pulls", []))
-                except Exception:
-                    fallback()
-                finally:
-                    src.post(lambda: src.store.unpin(oid))
-            self.post(on_dst)
-
-        src.post(on_src)
-        # safety net: a wedged source loop must not hang the pull
-        self.post_later(10.0, fallback)
-        return True
-
-    def _local_pull_inline(self, oid: ObjectID, ob: bytes, data,
-                           is_err: bool) -> None:
-        st = self._pulls.pop(ob, None)
-        if st is None:
-            return
-        info = self.objects.setdefault(oid, ObjInfo())
-        if info.state != "pending":
-            return
-        info.state = "error" if is_err else "ready"
-        info.loc = "inline"
-        info.data = data
-        info.size = len(data or b"")
-        info.is_error = is_err
-        self._resolve_waiters(oid, info)
-        for cid, pm in st.get("replay_pulls", []):
-            peer = self.clients.get(cid)
-            if peer is not None:
-                self._h_pull_object(peer, pm)
-
-    # sender side -----------------------------------------------------------
-
-    def _h_pull_object(self, rec, m):
-        """A peer wants an object stored here: inline goes in one frame,
-        shm goes in windowed chunks (reference: object_manager.proto:61
-        Push with chunked ObjectChunk stream).
-
-        Broadcast shaping (reference: push_manager.h rate-limited
-        parallel pushes; here a relay CHAIN): if this node is itself
-        still RECEIVING the object, it serves the request as a relay —
-        forwarding chunks as they arrive — and if this node is the
-        source already streaming to someone, later requesters are
-        redirected to the most recent receiver, so an N-node broadcast
-        pipelines through the receivers instead of serializing N full
-        streams at the source."""
-        ob = m["object_id"]
-        oid = ObjectID(ob)
-        pst = self._pulls.get(ob)
-        if pst is not None:
-            if pst.get("local"):
-                # same-process fast path in flight: chunk relay state
-                # never materializes — replay this request when the
-                # memcpy lands (or fails) instead of parking it forever
-                pst.setdefault("replay_pulls", []).append(
-                    (rec.conn_id, dict(m)))
-                return
-            # mid-pull here: relay chunks to this requester as they land
-            self._relay_register(rec, ob, pst)
-            return
-        if not m.get("no_redirect"):
-            tail = self._bcast_tail.get(ob)
-            if tail is not None and tail[0] != rec.node_hex \
-                    and (rec.conn_id, ob) not in self._out_transfers:
-                active = any(o == ob for (_c, o) in self._out_transfers)
-                if active:
-                    # chain: newest requester fetches from the previous
-                    # one; we keep streaming only the first copy
-                    self._push(rec, {"t": "pull_redirect", "object_id": ob,
-                                     "node": tail[0], "address": tail[1]})
-                    self._note_bcast_tail(ob, rec)
-                    return
-        info = self.objects.get(oid)
-        if info is not None and info.loc == "device":
-            # device-resident: spill to host first, then serve the pull
-            # (the queued request replays when materialization lands)
-            self._device_pending_pulls.setdefault(ob, []).append(
-                (rec.conn_id, dict(m)))
-            if info.state == "ready":
-                self._request_materialize(oid, info)
-            return
-        if info is None or info.state == "pending":
-            self._push(rec, {"t": "pull_failed", "object_id": ob,
-                             "error": "object not found on this node"})
-            return
-        if info.loc == "inline":
-            self._push(rec, {"t": "obj_inline", "object_id": ob,
-                             "data": info.data, "is_error": info.is_error})
-            return
-        if self.store.is_spilled(oid):
-            self.store.restore(oid)
-        self.store.touch(oid)
-        self.store.pin(oid)
-        try:
-            view = self.store._shm.map(oid)
-        except Exception:
-            self.store.unpin(oid)
-            self._push(rec, {"t": "pull_failed", "object_id": ob,
-                             "error": "object vanished mid-pull"})
-            return
-        st = {"oid": oid, "view": view, "size": info.size, "next_off": 0,
-              "pinned": True}
-        self._out_transfers[(rec.conn_id, ob)] = st
-        self._note_bcast_tail(ob, rec)
-        for _ in range(self.config.object_transfer_window):
-            if not self._send_next_chunk(rec, st):
-                break
-
-    def _note_bcast_tail(self, ob: bytes, rec: ClientRec) -> None:
-        """Remember the most recent receiver as the chain tail for later
-        requesters (only peers with a known node identity qualify)."""
-        if rec.node_hex and rec.node_hex in self.cluster_view:
-            addr = self.cluster_view[rec.node_hex].get("address")
-            if addr:
-                self._bcast_tail[ob] = (rec.node_hex, addr)
-
-    def _send_next_chunk(self, rec: ClientRec, st: dict) -> bool:
-        off = st["next_off"]
-        limit = st["size"] if st.get("available") is None \
-            else min(st["size"], st["available"])
-        if off >= limit or st["view"] is None:
-            return False
-        n = min(self.config.object_transfer_chunk_size, limit - off)
-        st["next_off"] = off + n
-        # blob frame: the chunk bytes ride out-of-band of the pickle —
-        # one copy into the socket buffer instead of slice+pickle+buffer
-        self._push_blob(rec, {"t": "obj_chunk",
-                              "object_id": st["oid"].binary(),
-                              "offset": off, "total_size": st["size"]},
-                        st["view"][off:off + n])
-        if st["next_off"] >= st["size"]:
-            # final chunk queued: release our references now; remaining
-            # acks for this transfer are ignored
-            st["view"] = None
-            if st.get("pinned"):
-                self.store.unpin(st["oid"])
-            self._out_transfers.pop((rec.conn_id, st["oid"].binary()), None)
-        return True
-
-    def _h_obj_chunk_ack(self, rec, m):
-        st = self._out_transfers.get((rec.conn_id, m["object_id"]))
-        if st is not None:
-            st["outstanding"] = max(0, st.get("outstanding", 1) - 1)
-            if self._send_next_chunk(rec, st):
-                st["outstanding"] = st.get("outstanding", 0) + 1
-
-    # relay (chain broadcast) ------------------------------------------------
-
-    def _relay_register(self, rec, ob: bytes, pst: dict) -> None:
-        """Serve a pull for an object we are still receiving: forward
-        already-received bytes now, the rest as chunks arrive."""
-        oid = ObjectID(ob)
-        if pst.get("size") is None:
-            # no chunk yet: start the relay when the first one lands
-            pst.setdefault("relay_waiting", []).append(rec.conn_id)
-            return
-        st = {"oid": oid, "view": pst["view"], "size": pst["size"],
-              "next_off": 0, "available": pst["received"],
-              "outstanding": 0, "pinned": False, "relay": True}
-        self._out_transfers[(rec.conn_id, ob)] = st
-        pst.setdefault("relay_conns", []).append(rec.conn_id)
-        self._note_bcast_tail(ob, rec)
-        self._relay_advance(rec, st)
-
-    def _relay_advance(self, rec, st: dict) -> None:
-        window = self.config.object_transfer_window
-        while st.get("outstanding", 0) < window:
-            if not self._send_next_chunk(rec, st):
-                break
-            st["outstanding"] = st.get("outstanding", 0) + 1
-
-    def _relay_on_upstream_chunk(self, ob: bytes, pst: dict) -> None:
-        """Upstream bytes advanced: wake pending relays and push more."""
-        for cid in pst.pop("relay_waiting", []):
-            peer = self.clients.get(cid)
-            if peer is not None:
-                self._relay_register(peer, ob, pst)
-        for cid in list(pst.get("relay_conns", [])):
-            st = self._out_transfers.get((cid, ob))
-            peer = self.clients.get(cid)
-            if st is None or peer is None:
-                pst["relay_conns"].remove(cid)
-                continue
-            st["available"] = pst["received"]
-            self._relay_advance(peer, st)
-
-    def _relay_on_pull_done(self, oid: ObjectID, pst: dict) -> None:
-        """Our pull finished and the buffer was sealed: re-map (pinned)
-        for relays that still have bytes to send."""
-        ob = oid.binary()
-        for cid in pst.get("relay_conns", []):
-            st = self._out_transfers.get((cid, ob))
-            if st is None:
-                continue
-            st["available"] = st["size"]
-            try:
-                st["view"] = self.store._shm.map(oid)
-                self.store.pin(oid)
-                st["pinned"] = True
-            except Exception:
-                self._out_transfers.pop((cid, ob), None)
-                peer = self.clients.get(cid)
-                if peer is not None:
-                    self._push(peer, {"t": "pull_failed", "object_id": ob,
-                                      "error": "relay source lost the "
-                                               "object mid-stream"})
-                continue
-            peer = self.clients.get(cid)
-            if peer is not None:
-                self._relay_advance(peer, st)
-
-    # receiver side ----------------------------------------------------------
-
-    def _on_peer_msg(self, node_hex: str, m: dict) -> None:
-        t = m.get("t")
-        try:
-            if t == "obj_chunk":
-                self._on_obj_chunk(node_hex, m)
-            elif t == "obj_inline":
-                self._on_obj_inline(m)
-            elif t == "pull_redirect":
-                self._on_pull_redirect(m)
-            elif t == "pull_failed":
-                self._on_pull_failed(m)
-            elif t == "object_at":
-                # owner's reply to our owner_locate rides this conn
-                self._on_owner_object_at_push(m)
-            elif t == "owner_object_lost":
-                self._on_owner_object_lost_push(m)
-            elif t == "owner_object_at":
-                # a holder may report on a conn WE opened to it earlier
-                self._owner_add_location(m["object_id"], m["node"],
-                                         m["address"])
-            elif t == "shutdown":
-                self._drop_peer(node_hex)
-            # replies (e.g. to our peer register) are ignored
-        except Exception:
-            sys.stderr.write(f"[node] peer message {t} failed:\n"
-                             + traceback.format_exc())
-
-    def _on_obj_chunk(self, node_hex: str, m: dict) -> None:
-        ob = m["object_id"]
-        st = self._pulls.get(ob)
-        if st is None:
-            return  # stale transfer (object resolved another way)
-        oid = ObjectID(ob)
-        if st["view"] is None:
-            st["size"] = m["total_size"]
-            try:
-                st["view"] = self.store._shm.create(oid, st["size"])
-            except Exception as e:
-                # arena full beyond eviction (or segment clash): fail pull
-                self._pulls.pop(ob, None)
-                self._fail_pull(oid, f"store create failed during "
-                                     f"transfer: {type(e).__name__}: {e}")
-                return
-        data = m["data"]
-        off = m["offset"]
-        st["view"][off:off + len(data)] = data
-        st["received"] += len(data)
-        conn = self._peer_conns.get(node_hex)
-        if conn is not None:
-            try:
-                conn.send({"t": "obj_chunk_ack", "object_id": ob})
-            except protocol.ConnectionClosed:
-                pass
-        if st.get("relay_waiting") or st.get("relay_conns"):
-            # chain broadcast: forward the new bytes downstream
-            self._relay_on_upstream_chunk(ob, st)
-        if st["received"] >= st["size"]:
-            st["view"] = None   # release buffer before seal/register
-            self.store._shm.seal(oid)
-            self._pulls.pop(ob, None)
-            self.store.register(oid, st["size"])
-            info = self.objects.setdefault(oid, ObjInfo())
-            info.state = "ready"
-            info.loc = "shm"
-            info.size = st["size"]
-            if st.get("relay_conns"):
-                self._relay_on_pull_done(oid, st)
-            self._resolve_waiters(oid, info)
-
-    def _on_pull_redirect(self, m: dict) -> None:
-        """The source is busy broadcasting: fetch from the chain tail it
-        named instead.  Ignored once bytes started flowing; a failed
-        relay fetch falls back through the normal re-watch path (which
-        sets no_redirect, so the source then serves directly)."""
-        ob = m["object_id"]
-        st = self._pulls.get(ob)
-        if st is None or st.get("size") is not None:
-            return
-        self._pulls.pop(ob, None)
-        self._watched.discard(ob)
-        # a redirect counts as an attempt: if the relay fetch fails, the
-        # re-watch retries the source with no_redirect set (direct serve)
-        self._pull_attempts[ob] = self._pull_attempts.get(ob, 0) + 1
-        self._request_pull(ObjectID(ob), m["node"], m["address"])
-
-    def _on_obj_inline(self, m: dict) -> None:
-        ob = m["object_id"]
-        self._pulls.pop(ob, None)
-        oid = ObjectID(ob)
-        info = self.objects.setdefault(oid, ObjInfo())
-        if info.state != "pending":
-            return
-        info.state = "error" if m.get("is_error") else "ready"
-        info.loc = "inline"
-        info.data = m["data"]
-        info.size = len(m["data"])
-        info.is_error = bool(m.get("is_error"))
-        self._resolve_waiters(oid, info)
-
-    def _on_pull_failed(self, m: dict) -> None:
-        ob = m["object_id"]
-        st = self._pulls.pop(ob, None)
-        src = st["src"] if st else None
-        self._watched.discard(ob)
-        oid = ObjectID(ob)
-        # a failed source is no longer a valid location for objects we own
-        orec = self.owned.get(ob)
-        if orec is not None and src:
-            orec.locations.pop(src, None)
-        attempts = self._pull_attempts.get(ob, 0) + 1
-        self._pull_attempts[ob] = attempts
-        if attempts <= 5:
-            # the location may be stale (freed/evicted+deleted); re-locate
-            self.post_later(0.2, lambda: self._ensure_remote_watch([oid]))
-        else:
-            self._fail_pull(oid, m.get("error", "pull failed"), src=src)
-
-    def _fail_pull(self, oid: ObjectID, cause: str,
-                   src: Optional[str] = None) -> None:
-        info = self.objects.get(oid)
-        if info is None or info.state != "pending":
-            return
-        ob = oid.binary()
-        if info.owner_node and info.owner_node[0] == self.node_id.hex():
-            orec = self.owned.get(ob)
-            if orec is not None and src:
-                orec.locations.pop(src, None)
-            self._pull_attempts.pop(ob, None)
-            # may pull another copy, wait on the producer, reconstruct,
-            # or seal the loss itself
-            self._owner_self_resolve(ob)
-            return
-        from ray_tpu.core.client import ObjectLostError
-        self._seal_error_object(oid, ObjectLostError(
-            f"Object {oid.hex()[:16]} could not be fetched: {cause}"))
-
-    def _hh_delete_object(self, m: dict) -> None:
-        self._delete_local_object(ObjectID(m["object_id"]))
-
-    # -- node death recovery -------------------------------------------------
-
-    def _hh_node_dead(self, m: dict) -> None:
-        node_hex = m["node"]
-        self._drop_peer(node_hex)
-        self.actor_cache = {k: v for k, v in self.actor_cache.items()
-                            if v[0] != node_hex}
-        # owned objects whose only copies died: re-resolve (pull another
-        # copy / reconstruct) for any object someone is waiting on
-        me = self.node_id.hex()
-        for ob, orec in list(self.owned.items()):
-            if orec.locations.pop(node_hex, None) is None:
-                continue
-            if orec.locations and any(h == me or h in self.cluster_view
-                                      for h in orec.locations):
-                continue
-            oid = ObjectID(ob)
-            info = self.objects.get(oid)
-            needed = (orec.watchers
-                      or oid in self._mg_by_oid
-                      or oid in self.dep_waiting
-                      or (info is not None and info.wait_waiters))
-            if needed and info is not None and info.state == "pending":
-                self._watched.discard(ob)
-                self._owner_self_resolve(ob)
-        # consumers whose owner-directory authority died: fall back to
-        # the head for anything we were watching through that owner
-        stale = [ob for ob, h in self._owner_watch.items()
-                 if h == node_hex]
-        if stale:
-            self._owner_unreachable(node_hex, stale)
-            for ob in stale:
-                self._watched.discard(ob)
-        for tid, fw in list(self._fwd_tasks.items()):
-            if fw["dst"] != node_hex:
-                continue
-            self._fwd_tasks.pop(tid, None)
-            spec = fw["spec"]
-            for b in spec["return_ids"]:
-                self._fwd_by_oid.pop(b, None)
-            if fw.get("actor"):
-                # the actor may restart elsewhere, but this call's
-                # execution state died with the node
-                self._fail_task(spec, f"Actor's node {node_hex[:8]} died "
-                                      "while the method was in flight")
-            elif fw["retries"] > 0:
-                # lineage-lite: deterministic return ids mean a re-run
-                # re-creates exactly the lost objects (reference:
-                # object_recovery_manager.h reconstruction)
-                spec = dict(spec)
-                spec["max_retries"] = fw["retries"] - 1
-                if _fr._active is not None:
-                    _fr._active.stamp(spec, "retry")
-                self._forward_task(spec)
-            else:
-                self._fail_task(spec, f"Node {node_hex[:8]} died while "
-                                      "running forwarded task")
-
-    # -- state API
-
-    def _fr_finish(self, tr: TaskRec, m: dict) -> None:
-        """Fold a completed task's lifecycle stamps into the flight
-        recorder.  The worker ships its stamps back inside task_done
-        (socket workers executed a COPY of the spec); lane executors
-        appended to the shared list, in which case both sides are the
-        same object and the merge is a no-op."""
-        spec = tr.spec
-        if spec.get("fr_done"):
-            # already folded: a duplicated task_done (chaos dup) must
-            # not re-install the message's stamps and count twice
-            return
-        wfr = m.get("fr")
-        nfr = spec.get("fr")
-        if wfr is not None and wfr is not nfr \
-                and (nfr is None or len(wfr) >= len(nfr)):
-            spec["fr"] = wfr
-        if spec.get("fr") is not None:
-            rec = _fr._active
-            if rec is not None:
-                rec.stamp(spec, "done")
-                rec.finish(spec, worker=tr.worker)
-            spec["fr"] = None
-            spec["fr_done"] = True
-
     def _h_flight_recorder(self, rec, m):
         """Observer query: completed lifecycle records + chaos events +
         the per-stage summary (the `ray_tpu timeline` source)."""
@@ -3640,19 +762,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
                         limit=int(m.get("limit", 2000))),
                     faults=fr.export_faults(),
                     stages=fr.stage_summary())
-
-    def _record_event(self, spec: dict, state: str,
-                      worker: Optional[int] = None) -> None:
-        self.task_events.append({
-            "task_id": spec["task_id"].hex() if isinstance(spec["task_id"], bytes)
-            else spec["task_id"],
-            "name": spec.get("name", ""),
-            "state": state,
-            "actor_id": spec.get("actor_id", b"").hex()
-            if spec.get("actor_id") else None,
-            "worker": worker,
-            "time": time.time(),
-        })
 
     def _h_state(self, rec, m):
         what = m["what"]
@@ -3700,133 +809,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
         else:
             out = []
         self._reply(rec, m["reqid"], data=out)
-
-    def _h_worker_logs(self, rec, m):
-        """List this node's worker log files, or tail one (reference:
-        the dashboard's per-worker log viewer, dashboard/modules/log/)."""
-        logdir = os.path.join(self.session_dir, "logs")
-        name = m.get("name")
-        if not name:
-            files = []
-            try:
-                for f in sorted(os.listdir(logdir)):
-                    full = os.path.join(logdir, f)
-                    files.append({"name": f,
-                                  "size": os.path.getsize(full)})
-            except OSError:
-                pass
-            self._reply(rec, m["reqid"], files=files)
-            return
-        # basename only — no path escape out of the log dir
-        path = os.path.join(logdir, os.path.basename(str(name)))
-        nbytes = int(m.get("nbytes", 64 * 1024))
-        try:
-            with open(path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(max(0, size - nbytes))
-                data = f.read()
-            self._reply(rec, m["reqid"],
-                        data=data.decode("utf-8", "replace"), size=size)
-        except OSError as e:
-            self._reply(rec, m["reqid"], error=str(e))
-
-    def _h_profile_worker(self, rec, m):
-        """Sampling-profile a live worker (reference: dashboard
-        profile_manager.py py-spy wrapper): route the request to the
-        worker's executor, which samples its own interpreter and pushes
-        folded stacks back."""
-        pid = int(m["pid"])
-        target = next((c for c in self.clients.values()
-                       if c.kind in ("worker", "tpu_executor")
-                       and c.pid == pid), None)
-        if target is None:
-            self._reply(rec, m["reqid"],
-                        error=f"no live worker with pid {pid}")
-            return
-        self._profile_seq = getattr(self, "_profile_seq", 0) + 1
-        prof_id = self._profile_seq
-        self._profile_pending = getattr(self, "_profile_pending", {})
-        self._profile_pending[prof_id] = (rec.conn_id, m["reqid"])
-        duration = float(m.get("duration", 2.0))
-        self._push(target, {"t": "profile", "prof_id": prof_id,
-                            "duration": duration,
-                            "hz": float(m.get("hz", 99.0))})
-
-        def expire():
-            pend = self._profile_pending.pop(prof_id, None)
-            if pend is not None:
-                w = self.clients.get(pend[0])
-                if w is not None:
-                    self._reply(w, pend[1],
-                                error="profile timed out (worker busy "
-                                      "outside its message loop?)")
-        self.post_later(duration + 30.0, expire)
-
-    def _h_profile_result(self, rec, m):
-        pend = getattr(self, "_profile_pending", {}).pop(
-            m.get("prof_id"), None)
-        if pend is None:
-            return
-        w = self.clients.get(pend[0])
-        if w is None:
-            return
-        if m.get("error"):
-            self._reply(w, pend[1], error=m["error"])
-        else:
-            self._reply(w, pend[1], folded=m.get("folded", ""))
-
-    def _h_stack_dump(self, rec, m):
-        """Dump a live worker's thread stacks (reference: `ray stack`,
-        scripts.py:1767 / profile_manager.py): SIGUSR1 triggers the
-        worker's faulthandler into its .err log; reply with the fresh
-        tail."""
-        pid = int(m["pid"])
-        target = next((c for c in self.clients.values()
-                       if c.kind == "worker" and c.pid == pid), None)
-        logs = self._worker_log_by_pid.get(pid)
-        if target is None or logs is None:
-            self._reply(rec, m["reqid"],
-                        error=f"no live spawned worker with pid {pid}")
-            return
-        err_path = logs[1]
-        try:
-            start = os.path.getsize(err_path)
-        except OSError:
-            start = 0
-        try:
-            os.kill(pid, signal.SIGUSR1)
-        except OSError as e:
-            self._reply(rec, m["reqid"], error=str(e))
-            return
-
-        def collect(attempt: int = 0, last: int = -1):
-            # The dump is async — poll THIS worker's own .err for growth
-            # (other workers' stderr chatter must not be misattributed),
-            # then wait until it QUIESCES: faulthandler writes the
-            # threads one at a time with the CURRENT thread (the one
-            # executing the task) LAST, so replying on first growth
-            # captured a partial dump missing exactly the frames the
-            # caller wants (`ray stack` showed only the recv thread).
-            try:
-                size = os.path.getsize(err_path)
-            except OSError:
-                size = start
-            if attempt < 40 and (size <= start or size != last):
-                self.post_later(0.05, lambda: collect(attempt + 1, size))
-                return
-            if size <= start:
-                self._reply(rec, m["reqid"],
-                            error="worker produced no stack dump "
-                                  "(faulthandler unavailable?)")
-                return
-            with open(err_path, "rb") as f:
-                f.seek(start)
-                data = f.read()
-            self._reply(rec, m["reqid"], pid=pid,
-                        data=data.decode("utf-8", "replace"),
-                        log=os.path.basename(err_path))
-        collect()
 
     def _h_ping(self, rec, m):
         self._reply(rec, m["reqid"], ok=True, time=time.time())
@@ -3950,7 +932,6 @@ class NodeService(ClusterStoreMixin, EventLoopService):
             # owning driver gone → shut down
             self._stop.set()
         self._schedule()
-
 
 def main() -> None:
     import argparse

@@ -1,0 +1,1074 @@
+"""Scheduling half of the node service (split out of core/node.py).
+
+Task admission → two-queue dispatch → completion, and everything that
+decides WHERE work runs: spillover forwarding through the head,
+re-routing parked backlogs when remote capacity appears (_rebalance),
+incremental queued-demand aggregates, actor placement / per-actor
+ordered queues / restart bookkeeping, cluster actor-task routing with
+location caching, and placement-group reservation (local queue + 2PC
+participant).  Reference: local_task_manager.h, cluster_task_manager.h,
+gcs_actor_manager.cc, gcs_placement_group_scheduler.h.
+
+``NodeSchedMixin`` holds no state; ``NodeService.__init__``
+(core/node.py) owns every attribute.  Record types shared with the
+object plane (``ObjInfo``, ``_wire_spec``) are imported from
+node_transfer — that module is the shared lower layer, keeping the
+import graph acyclic.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.core import fault_injection as _fi
+from ray_tpu.core import flight_recorder as _fr
+from ray_tpu.core import rt_frames as _rtf
+from ray_tpu.core import protocol
+from ray_tpu.core.ids import ActorID, ObjectID, PlacementGroupID
+from ray_tpu.core.node_transfer import ObjInfo, _wire_spec
+from ray_tpu.core.resources import bundle_total, covers
+from ray_tpu.core.service import ClientRec
+
+
+@dataclass
+class TaskRec:
+    spec: dict
+    state: str = "pending"       # pending | running | forwarded | finished | failed
+    worker: Optional[int] = None
+    retries_left: int = 0
+    submitted_at: float = field(default_factory=time.time)
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    error: str = ""
+
+
+@dataclass
+class ActorRec:
+    actor_id: ActorID
+    spec: dict                   # creation spec (reusable for restart)
+    state: str = "pending"       # pending | alive | restarting | dead
+    conn_id: Optional[int] = None
+    name: str = ""
+    namespace: str = ""
+    restarts_left: int = 0
+    seq: int = 0
+    queue: deque = field(default_factory=deque)   # pending method-call specs
+    running: dict = field(default_factory=dict)   # task_id -> in-flight spec
+    max_concurrency: int = 1
+    death_cause: str = ""
+
+    @property
+    def inflight(self) -> int:
+        return len(self.running)
+
+
+@dataclass
+class PGRec:
+    pg_id: PlacementGroupID
+    bundles: list                # list[dict resource->qty]
+    strategy: str
+    state: str = "created"       # single-node: reserve succeeds or raises
+
+class NodeSchedMixin:
+    """Scheduling / parking / rebalance + actors + placement groups
+    (mixed into NodeService)."""
+
+    def _expire_parked_actor_waits(self) -> None:
+        """Actor-bound tasks parked through a head failover fail once
+        the grace window runs out with the head still gone."""
+        if not self._actor_wait_parked or self.head_conn is not None:
+            return
+        grace = self.config.actor_locate_failover_grace_s
+        cutoff = time.monotonic() - grace
+        for ab, since in list(self._actor_wait_parked.items()):
+            if since < cutoff:
+                self._actor_wait_parked.pop(ab, None)
+                for spec in self._awaiting_actor.pop(ab, []):
+                    self._fail_task(
+                        spec, "Actor location unknown: head connection "
+                              f"lost and not recovered within {grace:.0f}s")
+
+    def _rebalance(self) -> None:
+        """Queued work meets new capacity: spillover decisions are made
+        at enqueue time, so when another node gains availability LATER
+        (autoscaler launch, task completion elsewhere), re-route queue
+        heads this node can't start now (reference: the cluster
+        scheduler re-evaluates pending queues on resource updates,
+        cluster_task_manager.cc ScheduleAndDispatchTasks)."""
+        if self.head_conn is None:
+            return
+        moved = 0
+        for q in (self.runnable_cpu, self.runnable_tpu):
+            while q and moved < 8:
+                spec = q[0]
+                if spec.get("placement_group"):
+                    break   # FIFO: don't reorder past an unmovable head
+                demand = self._demand(spec)
+                if all(self.available.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items()):
+                    break   # dispatches here as soon as a worker frees
+                if not self._cluster_has_capacity(spec):
+                    break
+                # _routed (head-parked) specs move too: during a burst
+                # the head parks work on saturated nodes; when capacity
+                # appears LATER (autoscaler launch, drain elsewhere) the
+                # parked backlog must chase it.  No ping-pong: we only
+                # re-forward when the view shows another node free NOW,
+                # and the head ranks available-now targets first.
+                self._queue_pop(q)
+                self._forward_task(spec)
+                moved += 1
+
+    # -- tasks
+
+    def _h_submit_task(self, rec, m):
+        spec = m["spec"]
+        spec["submitter"] = rec.conn_id
+        self._admit_task(spec)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _admit_task(self, spec: dict) -> None:
+        tr = TaskRec(spec=spec, retries_left=spec.get("max_retries", 0))
+        self.tasks[spec["task_id"]] = tr
+        if _fr._active is not None:
+            _fr._active.start_or_stamp(spec, "node_recv")
+        if self.head_conn is not None and not spec.get("owner_node"):
+            # first admission on the submitter's node: WE own the returns
+            spec["owner_node"] = (self.node_id.hex(), self.address)
+            if spec.get("max_retries", 0) != 0:
+                # retry-disabled tasks are not reconstructable, matching
+                # the reference (max_retries=0 -> ObjectLostError)
+                self._record_lineage(spec)
+        self._absorb_arg_owners(spec)
+        onode = tuple(spec.get("owner_node") or ())
+        for b in spec["return_ids"]:
+            info = self.objects.setdefault(ObjectID(b), ObjInfo())
+            info.owner = info.owner or spec.get("owner", "")
+            if onode and not info.owner_node:
+                info.owner_node = onode
+        self._record_event(spec, "PENDING")
+        self._enqueue_task(spec)
+
+    def _projected_available(self) -> dict:
+        """Availability net of demand already sitting in the runnable
+        queues: resources are only acquired at dispatch, so raw
+        `available` over-promises (the reference's hybrid policy counts
+        committed resources the same way,
+        hybrid_scheduling_policy.h)."""
+        proj = dict(self.available)
+        for k, v in self._queued_demand.items():
+            proj[k] = proj.get(k, 0.0) - v
+        return {k: max(0.0, v) for k, v in proj.items()}
+
+    def _available_covers(self, spec: dict) -> bool:
+        proj = self._projected_available()
+        return all(proj.get(k, 0.0) + 1e-9 >= v
+                   for k, v in self._demand(spec).items())
+
+    def _cluster_has_capacity(self, spec: dict) -> bool:
+        demand = self._demand(spec)
+        me = self.node_id.hex()
+        for h, n in self.cluster_view.items():
+            if h == me or not n.get("alive"):
+                continue
+            if all(n["available"].get(k, 0.0) + 1e-9 >= v
+                   for k, v in demand.items()):
+                return True
+        return False
+
+    def _enqueue_task(self, spec: dict) -> None:
+        routed = spec.get("_routed")
+        pg = spec.get("placement_group")
+        clustered = self.head_conn is not None and not routed
+        if pg is not None:
+            if (pg[0], pg[1]) not in self.pg_available:
+                if clustered:
+                    # bundle lives on another node: the head routes it there
+                    self._forward_task(spec)
+                    return
+                if routed:
+                    # routed here for a bundle that was removed in the
+                    # meantime: fail fast — queueing would head-of-line
+                    # block every later task behind an unacquirable spec
+                    self._fail_task(
+                        spec, "Placement group bundle no longer exists "
+                              "on this node (group removed?)")
+                    return
+        elif not self._feasible(spec):
+            if clustered:
+                self._forward_task(spec)
+                return
+            self._fail_task(spec, "Infeasible resource demand: "
+                            f"{self._demand(spec)} on {self.total_resources}")
+            return
+        elif clustered and not self._available_covers(spec):
+            # spillover: we can't run it NOW — let the head place it.
+            # The head ranks by availability AND parked backlog, so this
+            # must not be gated on the view showing free capacity: the
+            # view's availability is optimistically debited to zero
+            # during any burst, and gating on it made a submitter keep
+            # ~95% of a 4000-task burst while seven nodes sat idle
+            # (reference: saturated tasks go to the cluster scheduler,
+            # cluster_task_manager.h — placement is ITS call, not the
+            # submitting raylet's)
+            self._forward_task(spec)
+            return
+        if spec.get("_routed") and not self._feasible(spec):
+            # routing race: the head's view was stale
+            self._fail_task(spec, "Infeasible resource demand after "
+                            f"routing: {self._demand(spec)} on "
+                            f"{self.total_resources}")
+            return
+        ndeps = 0
+        for b in spec.get("arg_ids", []):
+            oid = ObjectID(b)
+            info = self.objects.setdefault(oid, ObjInfo())
+            if info.state == "pending":
+                ndeps += 1
+                self.dep_waiting.setdefault(oid, []).append(spec)
+                self._ensure_remote_watch([oid])
+        spec["_ndeps"] = ndeps
+        if ndeps == 0:
+            self._make_runnable(spec)
+            self._schedule()
+
+    def _forward_task(self, spec: dict) -> None:
+        tid = spec["task_id"]
+        if _fr._active is not None:
+            # the interval ending at the DESTINATION's node_recv stamp
+            # is then the head-route + wire hop
+            _fr._active.stamp(spec, "forward")
+
+        def cb(reply):
+            if reply.get("error"):
+                self._fail_task(spec, reply["error"])
+                return
+            if reply.get("local"):
+                spec["_routed"] = True
+                self._enqueue_task(spec)
+                return
+            dst = reply["node"]
+            tr = self.tasks.get(tid)
+            if tr is not None:
+                tr.state = "forwarded"
+            self._fwd_tasks[tid] = {"spec": spec, "dst": dst,
+                                    "retries": spec.get("max_retries", 0)}
+            for b in spec["return_ids"]:
+                self._fwd_by_oid[b] = tid
+            self._ensure_remote_watch(
+                [ObjectID(b) for b in spec["return_ids"]])
+        wire = _wire_spec(spec)
+        self._attach_arg_owners(wire, spec)
+        self._head_rpc({"t": "cluster_submit", "spec": wire,
+                        "src_available": self._projected_available()}, cb)
+
+    def _hh_remote_submit(self, m: dict) -> None:
+        spec = m["spec"]
+        spec["_routed"] = True
+        self._admit_task(spec)
+
+    def _make_runnable(self, spec: dict) -> None:
+        if _fr._active is not None:
+            _fr._active.stamp(spec, "enqueue")
+        if spec.get("num_tpus"):
+            self.runnable_tpu.append(spec)
+        elif self._is_zero_demand(spec):
+            # zero-demand tasks (PlacementGroup.ready() pollers) get
+            # their own queue: they can always run, so they must not sit
+            # behind a resource-blocked FIFO head — and keeping them out
+            # of runnable_cpu keeps _schedule O(1), no per-event scans
+            self.runnable_zero.append(spec)
+        else:
+            self.runnable_cpu.append(spec)
+        if spec.get("placement_group"):
+            self._queued_pg += 1
+        else:
+            for k, v in self._demand(spec).items():
+                self._queued_demand[k] = self._queued_demand.get(k, 0.0) + v
+
+    def _queue_pop(self, q: deque) -> dict:
+        spec = q.popleft()
+        if spec.get("placement_group"):
+            self._queued_pg = max(0, self._queued_pg - 1)
+        else:
+            for k, v in self._demand(spec).items():
+                self._queued_demand[k] = self._queued_demand.get(k, 0.0) - v
+        if (not self.runnable_cpu and not self.runnable_tpu
+                and not self.runnable_zero):
+            # drain point: clear float drift
+            self._queued_demand.clear()
+            self._queued_pg = 0
+        return spec
+
+    def _h_task_done(self, rec, m):
+        tid = m["task_id"]
+        # the task outran its SIGKILL: it is not an OOM casualty (and a
+        # stale entry must not mislabel a later failure of this task id)
+        self._oom_kills.pop(tid, None)
+        tr = self.tasks.get(tid)
+        if tr is not None:
+            tr.state = "failed" if m.get("error") else "finished"
+            tr.finished_at = time.time()
+            tr.error = m.get("error", "")
+            self._note_task_finished(tid)
+            self._release_arg_blob(tr.spec)
+            if _fr._active is not None:
+                self._fr_finish(tr, m)
+            self._record_event(tr.spec, "FAILED" if m.get("error") else "FINISHED")
+        if rec.dedicated_actor is not None:
+            ar = self.actors.get(rec.dedicated_actor)
+            if ar is not None:
+                ar.running.pop(tid, None)
+                self._dispatch_actor_queue(ar)
+        else:
+            if rec.state in ("busy", "blocked"):
+                rec.state = "idle"
+            rec.current_task = None
+            if tr is not None and not tr.spec.get("_cpu_released"):
+                self._return_resources(tr.spec)
+        # unpin args
+        if tr is not None:
+            for b in tr.spec.get("arg_ids", []):
+                self.store.unpin(ObjectID(b))
+        self._schedule()
+
+    def _release_task_cpu(self, rec: ClientRec) -> None:
+        """Worker blocked on get: release its task's resources so the node
+        can keep making progress (reference: raylet releases CPU for
+        blocked workers)."""
+        if rec.current_task is None:
+            return
+        tr = self.tasks.get(rec.current_task)
+        if tr is not None and not tr.spec.get("_cpu_released"):
+            tr.spec["_cpu_released"] = True
+            self._return_resources(tr.spec)
+
+    def _demand(self, spec) -> dict:
+        d = dict(spec.get("resources") or {})
+        # Tasks default to 1 CPU; actors hold 0 CPU for their lifetime
+        # unless explicitly requested (reference: ray actor default
+        # num_cpus=0 after creation, ray_option_utils.py).
+        d.setdefault("CPU", 0.0 if spec.get("kind") == "actor_create" else 1.0)
+        if spec.get("num_tpus"):
+            d["TPU"] = float(spec["num_tpus"])
+        return d
+
+    def _try_acquire(self, spec) -> bool:
+        demand = self._demand(spec)
+        pg = spec.get("placement_group")
+        if pg is not None:
+            key = (pg[0], pg[1])
+            free = self.pg_available.get(key)
+            if free is None:
+                return False
+            if all(free.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+                for k, v in demand.items():
+                    free[k] = free.get(k, 0.0) - v
+                return True
+            return False
+        if all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+            return True
+        return False
+
+    def _return_resources(self, spec) -> None:
+        demand = self._demand(spec)
+        pg = spec.get("placement_group")
+        if pg is not None:
+            free = self.pg_available.get((pg[0], pg[1]))
+            if free is not None:
+                for k, v in demand.items():
+                    free[k] = free.get(k, 0.0) + v
+            return
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+        if self._pending_local_pgs:
+            self._try_place_local_pgs()
+
+    def _feasible(self, spec) -> bool:
+        demand = self._demand(spec)
+        if spec.get("placement_group"):
+            return True
+        return all(self.total_resources.get(k, 0.0) + 1e-9 >= v
+                   for k, v in demand.items())
+
+    def _args_ready(self, spec) -> bool:
+        for b in spec.get("arg_ids", []):
+            info = self.objects.get(ObjectID(b))
+            if info is None or info.state == "pending":
+                return False
+        return True
+
+    def _schedule(self) -> None:
+        """FIFO dispatch from the runnable queues (reference:
+        LocalTaskManager::DispatchScheduledTasksToWorkers,
+        local_task_manager.cc:101).  O(1) amortized per event: stops at the
+        first queue head that cannot be placed."""
+        for q, tpu in ((self.runnable_cpu, False), (self.runnable_tpu, True),
+                       (self.runnable_zero, False)):
+            while q:
+                spec = q[0]
+                container = (spec.get("runtime_env") or {}).get("container")
+                if container and tpu:
+                    # the TPU executor lives in the driver process; a
+                    # containerized worker can never satisfy it — fail
+                    # fast instead of wedging the TPU queue head
+                    self._queue_pop(q)
+                    self._fail_task(
+                        spec, "runtime_env.container is not supported "
+                              "for TPU tasks (TPU work runs on the "
+                              "driver's in-process executor)")
+                    continue
+                w = self._find_idle_worker(
+                    tpu=tpu, env_hash=spec.get("env_hash"),
+                    container_image=(container or {}).get("image", ""))
+                if w is None:
+                    if container:
+                        self._maybe_spawn_container_worker(container)
+                    elif not tpu:
+                        self._maybe_spawn_worker()
+                    break
+                if not self._try_acquire(spec):
+                    break
+                self._queue_pop(q)
+                self._dispatch_task(w, spec)
+
+    def _is_zero_demand(self, spec: dict) -> bool:
+        """True for specs that take nothing from the pool (e.g.
+        PlacementGroup.ready() pollers) — they always deserve a worker
+        and ride their own queue, immune to CPU-FIFO head blocking."""
+        return (not spec.get("placement_group")
+                and not spec.get("num_tpus")
+                and all(v <= 0 for v in self._demand(spec).values()))
+
+    def _find_idle_worker(self, tpu: bool,
+                          env_hash: Optional[str] = None,
+                          container_image: str = ""
+                          ) -> Optional[ClientRec]:
+        best = None
+        for rec in self.clients.values():
+            if (rec.kind in ("worker", "tpu_executor") and rec.state == "idle"
+                    and rec.dedicated_actor is None and rec.tpu == tpu):
+                # container tasks only run inside a matching image;
+                # plain tasks never borrow a containerized worker (its
+                # filesystem is the image's, not the host's)
+                if rec.container_image != container_image:
+                    continue
+                if not env_hash:
+                    return rec
+                # prefer a worker that already materialized this env
+                # (reference: worker_pool.h:192 runtime-env-hash cache)
+                if env_hash in rec.seen_envs:
+                    return rec
+                if best is None:
+                    best = rec
+        return best
+
+    def _dispatch_task(self, w: ClientRec, spec: dict) -> None:
+        tr = self.tasks[spec["task_id"]]
+        tr.state = "running"
+        tr.worker = w.conn_id
+        tr.started_at = time.time()
+        w.state = "busy"
+        w.current_task = spec["task_id"]
+        if spec.get("env_hash"):
+            w.seen_envs.add(spec["env_hash"])
+        for b in spec.get("arg_ids", []):
+            self.store.pin(ObjectID(b))
+        self._record_event(spec, "RUNNING", worker=w.conn_id)
+        stamp = None
+        if _fr._active is not None:
+            if w.lane is None and spec.get("fr") is not None \
+                    and _rtf._active is not None:
+                # socket worker: the dispatch stamp folds into the wire
+                # frame inside the native encoder (C-side monotonic
+                # read, no Python tuple/append) — the worker's decoded
+                # spec carries it and ships it back in task_done, which
+                # is the copy the node's flight-recorder fold prefers
+                stamp = "dispatch"
+            else:
+                _fr._active.stamp(spec, "dispatch")
+        self._push(w, {"t": "execute", "spec": spec}, stamp=stamp)
+        if _fi._active is not None:
+            # chaos plane: "kill the worker that got the K-th dispatch"
+            # — the task is in flight, so this exercises the
+            # worker-death retry/FAILED path deterministically
+            _fi._active.on_dispatch(self, w, spec)
+
+    def _release_arg_blob(self, spec: dict) -> None:
+        """Oversized (args, kwargs) tuples ride the store as a blob put
+        by the submitter purely to carry them (runtime._prepare_args);
+        no ObjectRef ever wraps the blob, so nothing releases it —
+        reclaim it on TERMINAL task completion (retries still need it)."""
+        b = spec.get("arg_blob")
+        if b:
+            self._released_wait.add(ObjectID(b))
+            self._sweep_released()
+
+    def _note_task_finished(self, tid: bytes) -> None:
+        """Bound the finished-task history (the live dict stays O(recent),
+        dupes are harmless — eviction re-checks state)."""
+        self._done_order.append(tid)
+        cap = max(1000, self.config.task_events_buffer_size // 5)
+        while len(self._done_order) > cap:
+            old = self._done_order.popleft()
+            tr = self.tasks.get(old)
+            if tr is not None and tr.state in ("finished", "failed"):
+                del self.tasks[old]
+
+    def _fail_task(self, spec: dict, error: str) -> None:
+        tr = self.tasks.get(spec["task_id"])
+        if tr is not None:
+            tr.state = "failed"
+            tr.error = error
+            tr.finished_at = time.time()
+            self._note_task_finished(spec["task_id"])
+        self._release_arg_blob(spec)
+        self._record_event(spec, "FAILED")
+        for b in spec["return_ids"]:
+            self._seal_error_object(ObjectID(b), RuntimeError(error))
+
+    # -- actors
+
+    def _h_create_actor(self, rec, m):
+        spec = m["spec"]
+        if self.head_conn is not None:
+            # head owns names, placement, and the cluster directory
+            reqid = m["reqid"]
+
+            def cb(reply):
+                w = self.clients.get(rec.conn_id)
+                if w is None:
+                    return
+                if reply.get("error"):
+                    self._reply(w, reqid, error=reply["error"])
+                else:
+                    self._reply(w, reqid, actor_id=reply["actor_id"],
+                                existing=reply.get("existing", False))
+            self._head_rpc({"t": "cluster_create_actor",
+                            "spec": _wire_spec(spec)}, cb)
+            return
+        actor_id = ActorID(spec["actor_id"])
+        name = spec.get("name") or ""
+        ns = spec.get("namespace") or "default"
+        if name:
+            key = (ns, name)
+            if key in self.named_actors and \
+                    self.actors[self.named_actors[key]].state != "dead":
+                if spec.get("get_if_exists"):
+                    self._reply(rec, m["reqid"],
+                                actor_id=self.named_actors[key].binary(),
+                                existing=True)
+                    return
+                self._reply(rec, m["reqid"],
+                            error=f"Actor name '{name}' already taken in "
+                                  f"namespace '{ns}'")
+                return
+            self.named_actors[key] = actor_id
+        if not self._feasible(spec):
+            self.named_actors.pop((ns, name), None) if name else None
+            self._reply(rec, m["reqid"],
+                        error=f"Infeasible actor resource demand: "
+                              f"{self._demand(spec)} on {self.total_resources}")
+            return
+        self._reply(rec, m["reqid"], actor_id=actor_id.binary())
+        self._admit_actor(spec)
+
+    def _admit_actor(self, spec: dict) -> ActorRec:
+        actor_id = ActorID(spec["actor_id"])
+        # named concurrency groups add their own in-flight budget on top
+        # of the default group's (reference: concurrency_group_manager.cc
+        # — per-group executors; the executor enforces per-group limits,
+        # the node only caps the total it pushes)
+        mc = spec.get("max_concurrency", 1) + \
+            sum((spec.get("concurrency_groups") or {}).values())
+        ar = ActorRec(actor_id=actor_id, spec=spec,
+                      name=spec.get("name") or "",
+                      namespace=spec.get("namespace") or "default",
+                      restarts_left=spec.get("max_restarts", 0),
+                      max_concurrency=mc)
+        self.actors[actor_id] = ar
+        self._place_actor(ar)
+        return ar
+
+    def _hh_place_actor(self, m: dict) -> None:
+        """Head chose this node to host the actor (fresh or node-death
+        re-place: the constructor re-runs; reference:
+        gcs_actor_manager.cc RestartActor)."""
+        spec = m["spec"]
+        old = self.actors.get(ActorID(spec["actor_id"]))
+        if old is not None and old.state not in ("dead",):
+            return  # duplicate placement push
+        self._admit_actor(spec)
+
+    def _place_actor(self, ar: ActorRec) -> None:
+        needs_tpu = bool(ar.spec.get("num_tpus"))
+        container = (ar.spec.get("runtime_env") or {}).get("container")
+        if container and needs_tpu:
+            self._mark_actor_dead(
+                ar, "runtime_env.container is not supported for TPU "
+                    "actors (TPU work runs on the driver's in-process "
+                    "executor)")
+            return
+        w = self._find_idle_worker(
+            tpu=needs_tpu,
+            container_image=(container or {}).get("image", ""))
+        if w is None:
+            if container:
+                self._maybe_spawn_container_worker(container)
+            else:
+                self._maybe_spawn_worker(tpu=needs_tpu)
+            # event-driven retry on the next worker registration (the
+            # 50 ms poll alone serialized bursts of actor creations)
+            self._actors_wanting_worker.append(ar)
+            self.post_later(0.05, lambda: self._place_actor_if_pending(ar))
+            return
+        if not self._try_acquire(ar.spec):
+            self.post_later(0.05, lambda: self._place_actor_if_pending(ar))
+            return
+        if not w.tpu:
+            # CPU actors get a dedicated worker process (reference: one
+            # worker per actor); the in-process TPU executor is shared —
+            # it hosts all TPU actors and tasks in the driver.
+            w.dedicated_actor = ar.actor_id
+            w.state = "busy"
+        ar.conn_id = w.conn_id
+        self._push(w, {"t": "create_actor_exec", "spec": ar.spec})
+
+    def _place_actor_if_pending(self, ar: ActorRec) -> None:
+        if ar.state in ("pending", "restarting") and ar.conn_id is None:
+            self._place_actor(ar)
+
+    def _report_actor_state(self, ar: ActorRec) -> None:
+        """State fan-out: via the head in cluster mode (it publishes and
+        resolves watchers), locally otherwise."""
+        if self.head_conn is not None:
+            self._head_send({"t": "actor_state_report",
+                             "actor_id": ar.actor_id.binary(),
+                             "state": ar.state,
+                             "death_cause": ar.death_cause})
+        else:
+            self._publish_local("actor_state",
+                                {"actor_id": ar.actor_id.hex(),
+                                 "state": ar.state})
+
+    def _h_actor_created(self, rec, m):
+        ar = self.actors.get(ActorID(m["actor_id"]))
+        if ar is None:
+            return
+        if m.get("error"):
+            ar.state = "dead"
+            ar.death_cause = m["error"]
+            self._fail_actor_queue(ar, m["error"])
+            if rec.dedicated_actor == ar.actor_id:
+                rec.dedicated_actor = None
+                rec.state = "idle"
+            ar.conn_id = None
+            self._return_resources(ar.spec)
+            self._report_actor_state(ar)
+        else:
+            ar.state = "alive"
+            self._report_actor_state(ar)
+            self._dispatch_actor_queue(ar)
+
+    def _h_submit_actor_task(self, rec, m):
+        spec = m["spec"]
+        actor_id = ActorID(spec["actor_id"])
+        ar = self.actors.get(actor_id)
+        if self.head_conn is not None and not spec.get("owner_node"):
+            # actor-task returns get the ownership directory but NOT
+            # lineage: re-running actor methods is not loss-transparent
+            # (reference: actor results -> ObjectLostError by default)
+            spec["owner_node"] = (self.node_id.hex(), self.address)
+        onode = tuple(spec.get("owner_node") or ())
+        for b in spec["return_ids"]:
+            info = self.objects.setdefault(ObjectID(b), ObjInfo())
+            info.owner = info.owner or spec.get("owner", "")
+            if onode and not info.owner_node:
+                info.owner_node = onode
+        self.tasks[spec["task_id"]] = TaskRec(spec=spec)
+        if _fr._active is not None:
+            _fr._active.start_or_stamp(spec, "node_recv")
+        self._record_event(spec, "PENDING")
+        if ar is not None:
+            if ar.state == "dead":
+                self._fail_task(spec, f"Actor is dead: {ar.death_cause}")
+                return
+            ar.queue.append(spec)
+            self._dispatch_actor_queue(ar)
+            return
+        if self.head_conn is None:
+            self._fail_task(spec, "Actor is dead: actor not found")
+            return
+        self._route_actor_task(spec)
+
+    # ---- cluster actor-task routing
+
+    def _route_actor_task(self, spec: dict) -> None:
+        ab = spec["actor_id"]
+        cached = self.actor_cache.get(ab)
+        if cached is not None:
+            # on forward failure: invalidate the cache and re-route via a
+            # fresh head lookup (the actor may have moved)
+            self._forward_actor_task(
+                spec, cached[0], cached[1],
+                on_fail=lambda: (self.actor_cache.pop(ab, None),
+                                 self._queue_actor_locate(spec)))
+            return
+        self._queue_actor_locate(spec)
+
+    def _queue_actor_locate(self, spec: dict) -> None:
+        ab = spec["actor_id"]
+        waiting = self._awaiting_actor.setdefault(ab, [])
+        waiting.append(spec)
+        if len(waiting) == 1:
+            self._head_rpc({"t": "locate_actor", "actor_id": ab},
+                           lambda reply: self._on_actor_located(ab, reply))
+
+    def _on_actor_located(self, ab: bytes, reply: dict) -> None:
+        state = reply.get("state")
+        if reply.get("error") and self.head_conn is None:
+            # transient: the head died mid-locate.  Keep the specs
+            # parked through the failover grace window — the rejoin
+            # path re-asks, on_tick expires the window.
+            self._actor_wait_parked.setdefault(ab, time.monotonic())
+            return
+        self._actor_wait_parked.pop(ab, None)   # the head answered
+        if reply.get("error") or state in ("dead", "unknown"):
+            cause = reply.get("death_cause") or reply.get("error") \
+                or "actor not found"
+            for spec in self._awaiting_actor.pop(ab, []):
+                self._fail_task(spec, f"Actor is dead: {cause}")
+            return
+        if state == "alive":
+            self.actor_cache[ab] = (reply["node"], reply["address"])
+            for spec in self._awaiting_actor.pop(ab, []):
+                self._forward_actor_task(
+                    spec, reply["node"], reply["address"],
+                    on_fail=lambda s=spec: self._fail_task(
+                        s, "Actor's node is unreachable"))
+            return
+        # pending/restarting: the head registered us as a watcher and will
+        # push actor_at when it settles — keep the specs queued
+
+    def _hh_actor_at(self, m: dict) -> None:
+        self._on_actor_located(m["actor_id"], m)
+
+    def _forward_actor_task(self, spec: dict, node_hex: str,
+                            address: str, on_fail) -> None:
+        def go(conn):
+            if conn is None:
+                on_fail()
+                return
+            wire = _wire_spec(spec)
+            wire["_routed"] = True
+            self._attach_arg_owners(wire, spec)
+            try:
+                conn.send({"t": "remote_actor_task", "spec": wire})
+            except protocol.ConnectionClosed:
+                self._drop_peer(node_hex)
+                on_fail()
+                return
+            tid = spec["task_id"]
+            tr = self.tasks.get(tid)
+            if tr is not None:
+                tr.state = "forwarded"
+            self._fwd_tasks[tid] = {"spec": spec, "dst": node_hex,
+                                    "retries": 0, "actor": True}
+            for b in spec["return_ids"]:
+                self._fwd_by_oid[b] = tid
+            self._ensure_remote_watch(
+                [ObjectID(b) for b in spec["return_ids"]])
+        self._peer_conn_async(node_hex, address, go)
+
+    def _h_remote_actor_task(self, rec, m):
+        """A peer node forwarded a method call for an actor hosted here."""
+        spec = m["spec"]
+        spec["_routed"] = True
+        actor_id = ActorID(spec["actor_id"])
+        self._absorb_arg_owners(spec)
+        onode = tuple(spec.get("owner_node") or ())
+        for b in spec["return_ids"]:
+            info = self.objects.setdefault(ObjectID(b), ObjInfo())
+            info.owner = info.owner or spec.get("owner", "")
+            if onode and not info.owner_node:
+                info.owner_node = onode
+        self.tasks[spec["task_id"]] = TaskRec(spec=spec)
+        self._record_event(spec, "PENDING")
+        ar = self.actors.get(actor_id)
+        if ar is None or ar.state == "dead":
+            cause = ar.death_cause if ar else "actor not on this node"
+            self._fail_task(spec, f"Actor is dead: {cause}")
+            return
+        ar.queue.append(spec)
+        self._dispatch_actor_queue(ar)
+
+    def _dispatch_actor_queue(self, ar: ActorRec) -> None:
+        if ar.state != "alive" or ar.conn_id is None:
+            return
+        w = self.clients.get(ar.conn_id)
+        if w is None:
+            return
+        while ar.queue and ar.inflight < ar.max_concurrency:
+            spec = ar.queue.popleft()
+            if not self._args_ready(spec):
+                # actors preserve submission order: put back and stop
+                ar.queue.appendleft(spec)
+                self._ensure_remote_watch(
+                    [ObjectID(b) for b in spec.get("arg_ids", [])
+                     if self.objects.setdefault(ObjectID(b),
+                                                ObjInfo()).state == "pending"])
+                self._wait_args_then(spec, lambda: self._dispatch_actor_queue(ar))
+                return
+            ar.running[spec["task_id"]] = spec
+            for b in spec.get("arg_ids", []):
+                self.store.pin(ObjectID(b))
+            tr = self.tasks.get(spec["task_id"])
+            if tr is not None:
+                tr.state = "running"
+                tr.started_at = time.time()
+                tr.worker = w.conn_id
+            self._record_event(spec, "RUNNING", worker=w.conn_id)
+            stamp = None
+            if _fr._active is not None:
+                if w.lane is None and spec.get("fr") is not None \
+                        and _rtf._active is not None:
+                    stamp = "dispatch"   # folded by the native encoder
+                else:
+                    _fr._active.stamp(spec, "dispatch")
+            self._push(w, {"t": "execute_actor", "spec": spec},
+                       stamp=stamp)
+
+    def _wait_args_then(self, spec, cb) -> None:
+        remaining = [ObjectID(b) for b in spec.get("arg_ids", [])
+                     if self.objects.get(ObjectID(b), ObjInfo()).state == "pending"]
+        if not remaining:
+            cb()
+            return
+        # Poll via the event loop until the dependency lands (v1; the
+        # reference stages deps through the DependencyManager).
+        self.post_later(0.02, lambda: self._wait_args_then(spec, cb))
+
+    def _fail_actor_queue(self, ar: ActorRec, error: str) -> None:
+        while ar.queue:
+            self._fail_task(ar.queue.popleft(), f"Actor died: {error}")
+
+    def _h_kill_actor(self, rec, m):
+        actor_id = ActorID(m["actor_id"])
+        ar = self.actors.get(actor_id)
+        if ar is None and self.head_conn is not None:
+            # actor lives elsewhere: the head routes the kill
+            reqid = m.get("reqid")
+
+            def cb(reply):
+                w = self.clients.get(rec.conn_id)
+                if reqid is not None and w is not None:
+                    self._reply(w, reqid, ok=bool(reply.get("ok")))
+            self._head_rpc({"t": "kill_actor", "actor_id": m["actor_id"],
+                            "no_restart": m.get("no_restart", True)}, cb)
+            return
+        if ar is None:
+            if "reqid" in m:
+                self._reply(rec, m["reqid"], ok=False)
+            return
+        self._kill_local_actor(ar, m.get("no_restart", True))
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _kill_local_actor(self, ar: ActorRec, no_restart: bool) -> None:
+        if no_restart:
+            ar.restarts_left = 0
+        w = self.clients.get(ar.conn_id) if ar.conn_id is not None else None
+        if w is not None and not w.tpu:
+            self._push(w, {"t": "exit"})
+        elif w is not None:
+            # shared in-process TPU executor: destroy only this actor's
+            # instance, keep the executor alive for other work
+            self._push(w, {"t": "destroy_actor",
+                           "actor_id": ar.actor_id.binary()})
+            self._mark_actor_dead(ar, "killed")
+        else:
+            self._mark_actor_dead(ar, "killed")
+
+    def _hh_kill_local_actor(self, m: dict) -> None:
+        ar = self.actors.get(ActorID(m["actor_id"]))
+        if ar is not None:
+            self._kill_local_actor(ar, m.get("no_restart", True))
+
+    def _mark_actor_dead(self, ar: ActorRec, cause: str) -> None:
+        if ar.state == "dead":
+            return
+        ar.state = "dead"
+        ar.death_cause = cause
+        ar.conn_id = None
+        for spec in list(ar.running.values()):
+            self._fail_task(spec, f"Actor died: {cause}")
+        ar.running.clear()
+        self._fail_actor_queue(ar, cause)
+        self._return_resources(ar.spec)
+        self._report_actor_state(ar)
+
+    def _h_get_named_actor(self, rec, m):
+        if self._cluster_scope(rec, m):
+            return
+        key = (m.get("namespace") or "default", m["name"])
+        aid = self.named_actors.get(key)
+        if aid is None or self.actors[aid].state == "dead":
+            self._reply(rec, m["reqid"], error="not found")
+        else:
+            ar = self.actors[aid]
+            self._reply(rec, m["reqid"], actor_id=aid.binary(), spec_meta={
+                "methods": ar.spec.get("methods", []),
+                "class_name": ar.spec.get("class_name", "")})
+
+    def _h_list_named_actors(self, rec, m):
+        if self._cluster_scope(rec, m):
+            return
+        out = [{"namespace": ns, "name": n}
+               for (ns, n), aid in self.named_actors.items()
+               if self.actors[aid].state != "dead"
+               and (m.get("all_namespaces") or ns == (m.get("namespace")
+                                                      or "default"))]
+        self._reply(rec, m["reqid"], actors=out)
+
+    # -- placement groups
+
+    def _h_create_pg(self, rec, m):
+        if self._cluster_scope(rec, m):
+            return   # head (or failover error) ran the cross-node 2PC
+        bundles = m["bundles"]
+        total = bundle_total(bundles)
+        if not covers(self.total_resources, total):
+            # can NEVER fit on this node — fail creation synchronously
+            self._reply(rec, m["reqid"],
+                        error=f"Infeasible placement group {total}; "
+                              f"node total {self.total_resources}")
+            return
+        # creation is async: reply now, reserve when resources allow;
+        # PlacementGroup.ready() gates on pg_state == "created"
+        self._reply(rec, m["reqid"], ok=True, state="pending")
+        self._pending_local_pgs[m["pg_id"]] = {
+            "bundles": bundles, "strategy": m.get("strategy", "PACK")}
+        self._try_place_local_pgs()
+
+    def _try_place_local_pgs(self) -> None:
+        """Reserve queued single-node PGs once resources free up."""
+        for pgb, info in list(self._pending_local_pgs.items()):
+            total = bundle_total(info["bundles"])
+            if not covers(self.available, total):
+                continue
+            for k, v in total.items():
+                self.available[k] -= v
+            pg_id = PlacementGroupID(pgb)
+            self.pgs[pg_id] = PGRec(pg_id=pg_id, bundles=info["bundles"],
+                                    strategy=info["strategy"])
+            for i, b in enumerate(info["bundles"]):
+                self.pg_available[(pgb, i)] = dict(b)
+            del self._pending_local_pgs[pgb]
+            self._schedule()
+
+    def _h_pg_state(self, rec, m):
+        if self._cluster_scope(rec, m):
+            return
+        pg_id = PlacementGroupID(m["pg_id"])
+        if pg_id in self.pgs:
+            st = "created"
+        elif m["pg_id"] in self._pending_local_pgs:
+            st = "pending"
+        else:
+            st = "removed"
+        self._reply(rec, m["reqid"], ok=True, state=st)
+
+    def _h_remove_pg(self, rec, m):
+        if self._cluster_scope(rec, m):
+            return
+        pg_id = PlacementGroupID(m["pg_id"])
+        self._pending_local_pgs.pop(m["pg_id"], None)
+        pg = self.pgs.pop(pg_id, None)
+        if pg is not None:
+            for i, b in enumerate(pg.bundles):
+                self.pg_available.pop((pg_id.binary(), i), None)
+                for k, v in b.items():
+                    self.available[k] = self.available.get(k, 0.0) + v
+            self._try_place_local_pgs()
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _hh_pg_prepare(self, m: dict) -> None:
+        bundle = m["bundle"]
+        ok = all(self.available.get(k, 0.0) + 1e-9 >= v
+                 for k, v in bundle.items())
+        if ok:
+            for k, v in bundle.items():
+                self.available[k] -= v
+            self._pg_prepared[(m["pg_id"], m["bundle_idx"])] = dict(bundle)
+        self._head_reply(m["reqid"], ok=ok)
+
+    def _hh_pg_commit(self, m: dict) -> None:
+        key = (m["pg_id"], m["bundle_idx"])
+        bundle = self._pg_prepared.pop(key, None)
+        if bundle is not None:
+            self.pg_available[key] = dict(bundle)
+            self._pg_bundles[key] = dict(bundle)   # original reservation
+
+    def _hh_pg_rollback(self, m: dict) -> None:
+        bundle = self._pg_prepared.pop((m["pg_id"], m["bundle_idx"]), None)
+        if bundle is not None:
+            for k, v in bundle.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+
+    def _hh_pg_remove_local(self, m: dict) -> None:
+        key = (m["pg_id"], m["bundle_idx"])
+        free = self.pg_available.pop(key, None)
+        # hand the ORIGINAL bundle reservation back to the node; tasks
+        # still drawing on the bundle release into the void afterwards,
+        # same as the reference's bundle-return semantics
+        orig = self._pg_bundles.pop(key, None)
+        if orig is None and free is None:
+            return
+        for k, v in (orig or free).items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    # -- state API
+
+    def _fr_finish(self, tr: TaskRec, m: dict) -> None:
+        """Fold a completed task's lifecycle stamps into the flight
+        recorder.  The worker ships its stamps back inside task_done
+        (socket workers executed a COPY of the spec); lane executors
+        appended to the shared list, in which case both sides are the
+        same object and the merge is a no-op."""
+        spec = tr.spec
+        if spec.get("fr_done"):
+            # already folded: a duplicated task_done (chaos dup) must
+            # not re-install the message's stamps and count twice
+            return
+        wfr = m.get("fr")
+        nfr = spec.get("fr")
+        if wfr is not None and wfr is not nfr \
+                and (nfr is None or len(wfr) >= len(nfr)):
+            spec["fr"] = wfr
+        if spec.get("fr") is not None:
+            rec = _fr._active
+            if rec is not None:
+                rec.stamp(spec, "done")
+                rec.finish(spec, worker=tr.worker)
+            spec["fr"] = None
+            spec["fr_done"] = True
+
+    def _record_event(self, spec: dict, state: str,
+                      worker: Optional[int] = None) -> None:
+        self.task_events.append({
+            "task_id": spec["task_id"].hex() if isinstance(spec["task_id"], bytes)
+            else spec["task_id"],
+            "name": spec.get("name", ""),
+            "state": state,
+            "actor_id": spec.get("actor_id", b"").hex()
+            if spec.get("actor_id") else None,
+            "worker": worker,
+            "time": time.time(),
+        })

@@ -1,0 +1,1553 @@
+"""Object-plane half of the node service (split out of core/node.py).
+
+Everything that moves or accounts for object BYTES on a node: the local
+object directory (``ObjInfo``), inline/shm/device locations, pins,
+waiter resolution, owner-based release sweeps, lineage-backed
+reconstruction, the ownership directory protocol (owner nodes — not the
+head — serve location queries for objects they own), chunked node-to-
+node transfer with relay-chain broadcast, the same-process memcpy fast
+path, and node-death recovery for owned objects and forwarded tasks.
+Reference: object_manager.h Push/Pull, plasma store.h,
+ownership_based_object_directory.cc, object_recovery_manager.h.
+
+``NodeTransferMixin`` holds no state; ``NodeService.__init__``
+(core/node.py) owns every attribute.  This module also hosts the record
+types and helpers shared by the other node modules (``ObjInfo``,
+``OwnedRec``, ``_wire_spec``, ``_gil_free_copy``,
+``_LOCAL_NODES_BY_HEX``) so the import graph stays acyclic:
+node_sched imports from here, never the reverse.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.core import flight_recorder as _fr
+from ray_tpu.core import protocol
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ObjectExists
+from ray_tpu.core.service import ClientRec
+
+
+@dataclass
+class ObjInfo:
+    state: str = "pending"       # pending | ready | error
+    loc: str = ""                # inline | shm | device
+    data: Optional[bytes] = None  # inline payload (SerializedObject wire bytes)
+    size: int = 0
+    owner: str = ""
+    is_error: bool = False
+    # device-resident entries: conn_id of the process holding the HBM
+    # buffers (core/device_objects.py); data holds the descriptor
+    owner_conn: Optional[int] = None
+    loc_reported: bool = False   # location pushed to the head
+    nested: tuple = ()           # ids this object's value embeds refs to
+    wait_waiters: list = field(default_factory=list)
+    # (node_hex, address) of the node that OWNS this object — the
+    # submitter's node is the location authority and lineage holder
+    # (reference: ownership model, core_worker.h / the owner_address
+    # every ObjectReference carries)
+    owner_node: tuple = ()
+
+
+@dataclass
+class OwnedRec:
+    """Owner-side directory entry for one owned object (reference:
+    ownership_based_object_directory.cc — the owner, not the GCS, is
+    authoritative for locations of objects it owns)."""
+    task_id: bytes = b""                       # producer (b"" for puts)
+    locations: dict = field(default_factory=dict)   # node_hex -> address
+    watchers: set = field(default_factory=set)      # (node_hex, address)
+
+
+def _wire_spec(spec: dict) -> dict:
+    """Spec copy safe to ship to another service (drop node-local keys)."""
+    return {k: v for k, v in spec.items()
+            if not k.startswith("_") and k != "submitter"}
+
+
+def _gil_free_copy(dst, src, size: int) -> None:
+    """memcpy that RELEASES the GIL (ctypes foreign calls drop it):
+    a multi-hundred-MiB memoryview slice-assign holds the GIL and
+    stalls every other event loop thread in the process for its whole
+    duration — broadcast copies serialized behind each other."""
+    import ctypes
+    try:
+        dst_c = (ctypes.c_char * size).from_buffer(dst)
+        src_mv = memoryview(src)
+        if src_mv.readonly:
+            src_c = bytes(src_mv[:size])    # rare: readonly source
+        else:
+            src_c = (ctypes.c_char * size).from_buffer(src_mv)
+        ctypes.memmove(dst_c, src_c, size)
+    except (TypeError, ValueError):
+        dst[:size] = src[:size]
+
+
+# Same-process node registry: virtual clusters (cluster_utils) run many
+# NodeServices as threads of one process.  Object pulls between them can
+# hand the bytes over with one memcpy instead of a socket stream — the
+# same-host semantics the reference gets from one shared plasma store
+# per machine (plasma store.h:55; workers on a host never stream to
+# each other).  Real multi-host peers are never in this registry.
+# (string annotation: the composed class lives in core/node.py)
+_LOCAL_NODES_BY_HEX: dict[str, "NodeService"] = {}  # noqa: F821
+
+
+class NodeTransferMixin:
+    """Object transfer + relay + shm bookkeeping (mixed into
+    NodeService)."""
+
+    # -- objects
+
+    def _h_put_inline(self, rec, m):
+        oid = ObjectID(m["object_id"])
+        info = self.objects.setdefault(oid, ObjInfo())
+        info.state = "error" if m.get("is_error") else "ready"
+        info.loc = "inline"
+        info.data = m["data"]
+        info.size = len(m["data"])
+        # ownership set at submit time wins (the submitter owns task
+        # returns, even when an executor stores them)
+        info.owner = info.owner or m.get("owner", rec.worker_id)
+        info.is_error = bool(m.get("is_error"))
+        if self.head_conn is not None and not info.owner_node:
+            # first stored here with no prior claim: this node owns it
+            # (ray.put objects — the putter's node is the authority)
+            info.owner_node = (self.node_id.hex(), self.address)
+        self._track_nested(info, m.get("nested_refs"))
+        self._resolve_waiters(oid, info)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_register_object(self, rec, m):
+        oid = ObjectID(m["object_id"])
+        info = self.objects.setdefault(oid, ObjInfo())
+        info.state = "ready"
+        info.loc = "shm"
+        info.size = m["size"]
+        info.owner = info.owner or m.get("owner", rec.worker_id)
+        if self.head_conn is not None and not info.owner_node:
+            info.owner_node = (self.node_id.hex(), self.address)
+        self._track_nested(info, m.get("nested_refs"))
+        self.store.register(oid, m["size"])
+        self._resolve_waiters(oid, info)
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_get_objects(self, rec, m):
+        """Batched blocking get: reply once ALL requested objects resolve."""
+        ids = [ObjectID(b) for b in m["object_ids"]]
+        for o in ids:
+            info = self.objects.setdefault(o, ObjInfo())
+            if (info.loc == "device" and info.state == "ready"
+                    and info.owner_conn != rec.conn_id):
+                # another process wants a device-resident object: ask the
+                # owner to spill it to the host store once (materialize-
+                # on-demand), then this get resolves like any other
+                self._request_materialize(o, info)
+        pending = [o for o in ids
+                   if self.objects[o].state == "pending"]
+        if not pending:
+            self._reply_batch(rec, m["reqid"], ids)
+            return
+        key = (rec.conn_id, m["reqid"])
+        self._multigets[key] = {"ids": ids, "remaining": set(pending)}
+        for o in pending:
+            self._mg_by_oid.setdefault(o, set()).add(key)
+        self._ensure_remote_watch([o for o in pending
+                                   if self.objects[o].loc != "device"])
+        if rec.state == "busy":
+            rec.state = "blocked"
+            self._release_task_cpu(rec)
+            self._schedule()
+
+    # -- device-resident objects (core/device_objects.py) -------------------
+
+    def _h_put_device(self, rec, m):
+        oid = ObjectID(m["object_id"])
+        info = self.objects.setdefault(oid, ObjInfo())
+        info.state = "ready"
+        info.loc = "device"
+        info.data = m["descriptor"]
+        info.size = m.get("size", 0)
+        info.owner = info.owner or m.get("owner", rec.worker_id)
+        info.owner_conn = rec.conn_id
+        if self.head_conn is not None and not info.owner_node:
+            info.owner_node = (self.node_id.hex(), self.address)
+        self._track_nested(info, m.get("nested_refs"))
+        self._resolve_waiters(oid, info)
+
+    def _h_materialize_failed(self, rec, m):
+        oid = ObjectID(m["object_id"])
+        info = self.objects.get(oid)
+        if (info is not None and info.state == "pending"
+                and info.loc == "device"):
+            self._seal_error_object(oid, RuntimeError(
+                f"device object materialization failed: {m.get('error')}"))
+
+    def _request_materialize(self, oid: ObjectID, info: ObjInfo) -> None:
+        owner = self.clients.get(info.owner_conn)
+        if owner is None:
+            self._device_owner_lost(oid, info)
+            return
+        info.state = "pending"
+        self._push(owner, {"t": "materialize_object",
+                           "object_id": oid.binary()})
+
+    def _device_owner_lost(self, oid: ObjectID, info: ObjInfo) -> None:
+        """The process holding a device entry's HBM buffers died: the
+        value is gone.  Reconstruction via lineage applies exactly as for
+        any lost object; without lineage the get errors."""
+        info.loc = ""
+        info.data = None
+        info.owner_conn = None
+        info.state = "pending"
+        if not self._try_reconstruct_device(oid):
+            self._seal_error_object(
+                oid, RuntimeError(
+                    "owner process of device-resident object died"))
+
+    def _try_reconstruct_device(self, oid: ObjectID) -> bool:
+        rec_ = self.owned.get(oid.binary())
+        if rec_ is not None and rec_.task_id:
+            return self._reconstruct(rec_.task_id)
+        return False
+
+    def _reply_batch(self, rec, reqid, ids):
+        results = []
+        for oid in ids:
+            info = self.objects[oid]
+            if info.loc == "device":
+                # only the owner reaches here with a device loc (others
+                # were routed through materialization in _h_get_objects)
+                results.append({"loc": "device_local", "data": info.data,
+                                "is_error": False})
+            elif info.loc == "shm":
+                # Pin FIRST, then restore: the pin must already protect
+                # the object when a later restore in this same batch (or
+                # restore's own capacity-balancing pass) evicts — the
+                # reply promises a mapped segment (reference: plasma pins
+                # objects for the duration of a Get).
+                self.store.pin(oid)
+                rec.held_pins.append((oid, time.monotonic()))
+                if self.store.is_spilled(oid):
+                    self.store.restore(oid)
+                self.store.touch(oid)
+                results.append({"loc": "shm", "size": info.size,
+                                "is_error": info.is_error})
+            else:
+                results.append({"loc": "inline", "data": info.data,
+                                "is_error": info.is_error})
+        self._reply(rec, reqid, results=results)
+
+    def _h_need_space(self, rec, m):
+        # A client's arena allocation failed: spill unpinned objects
+        # (reference: plasma create_request_queue.h queues client creates
+        # until eviction frees memory — here the client blocks on this
+        # request and retries).
+        freed = self.store.evict_for(int(m["nbytes"]))
+        self._reply(rec, m["reqid"], freed=freed)
+
+    def _h_release_pins(self, rec, m):
+        ids = {ObjectID(b) for b in m["object_ids"]}
+        kept = []
+        for oid, ts in rec.held_pins:
+            if oid in ids:
+                ids.discard(oid)
+                self.store.unpin(oid)
+            else:
+                kept.append((oid, ts))
+        rec.held_pins[:] = kept
+
+    def _expire_stale_pins(self) -> None:
+        """Get-replies whose ack never arrived (client timeout/death race)
+        must not pin objects forever."""
+        cutoff = time.monotonic() - 120.0
+        for rec in self.clients.values():
+            if not rec.held_pins:
+                continue
+            kept = []
+            for oid, ts in rec.held_pins:
+                if ts < cutoff:
+                    self.store.unpin(oid)
+                else:
+                    kept.append((oid, ts))
+            rec.held_pins[:] = kept
+
+    def _object_ready_hook(self, oid: ObjectID, info: ObjInfo) -> None:
+        """Cluster bookkeeping when an object becomes ready/error here."""
+        ob = oid.binary()
+        if info.loc != "device":
+            for conn_id, pm in self._device_pending_pulls.pop(ob, []):
+                peer = self.clients.get(conn_id)
+                if peer is not None:
+                    self._h_pull_object(peer, pm)
+        self._watched.discard(ob)
+        self._pull_attempts.pop(ob, None)
+        self._owner_watch.pop(ob, None)
+        if self.head_conn is not None and not info.loc_reported:
+            info.loc_reported = True
+            self._head_send({"t": "report_locations", "adds": [ob]})
+        if self.head_conn is not None and info.owner_node:
+            # tell the object's OWNER a copy lives here — the owner, not
+            # the head, serves location queries for owned objects
+            if info.owner_node[0] == self.node_id.hex():
+                self._owner_add_location(ob, self.node_id.hex(),
+                                         self.address)
+            elif info.loc == "inline" and info.data is not None:
+                # inline result of forwarded work: ship the VALUE to the
+                # owner directly — a location report would cost the owner
+                # a locate + pull round trip for ~bytes of payload
+                # (reference contrast: small returns ride the
+                # PushTaskReply inline, core_worker.cc:2528)
+                self._owner_push(
+                    info.owner_node[0], info.owner_node[1],
+                    {"t": "owner_object_value", "object_id": ob,
+                     "data": info.data, "is_error": info.is_error,
+                     "node": self.node_id.hex(), "address": self.address})
+            else:
+                self._owner_push(
+                    info.owner_node[0], info.owner_node[1],
+                    {"t": "owner_object_at", "object_id": ob,
+                     "node": self.node_id.hex(), "address": self.address})
+        tid = self._fwd_by_oid.pop(ob, None)
+        if tid is not None:
+            fw = self._fwd_tasks.get(tid)
+            if fw is not None and not any(
+                    b in self._fwd_by_oid for b in fw["spec"]["return_ids"]):
+                self._fwd_tasks.pop(tid, None)
+                tr = self.tasks.get(tid)
+                if tr is not None and tr.state == "forwarded":
+                    tr.state = "failed" if info.is_error else "finished"
+                    tr.finished_at = time.time()
+                    self._note_task_finished(tid)
+                    self._release_arg_blob(fw["spec"])
+
+    def _resolve_waiters(self, oid: ObjectID, info: ObjInfo) -> None:
+        self._object_ready_hook(oid, info)
+        for key in self._mg_by_oid.pop(oid, ()):
+            mg = self._multigets.get(key)
+            if mg is None:
+                continue
+            mg["remaining"].discard(oid)
+            if not mg["remaining"]:
+                del self._multigets[key]
+                w = self.clients.get(key[0])
+                if w is not None:
+                    if w.state == "blocked":
+                        w.state = "busy"
+                    self._reply_batch(w, key[1], mg["ids"])
+        for conn_id, reqid, ids, num_returns, deadline in list(info.wait_waiters):
+            self._try_finish_wait(conn_id, reqid, ids, num_returns, deadline)
+        info.wait_waiters.clear()
+        # release tasks waiting on this dependency
+        for spec in self.dep_waiting.pop(oid, ()):
+            spec["_ndeps"] -= 1
+            if spec["_ndeps"] == 0:
+                self._make_runnable(spec)
+        self._schedule()
+
+    def _h_wait(self, rec, m):
+        ids = [ObjectID(b) for b in m["object_ids"]]
+        self._ensure_remote_watch(
+            [o for o in ids
+             if self.objects.setdefault(o, ObjInfo()).state == "pending"])
+        self._try_finish_wait(rec.conn_id, m["reqid"], ids, m["num_returns"],
+                              time.time() + m["timeout"] if m.get("timeout")
+                              is not None else None, first=True)
+
+    def _try_finish_wait(self, conn_id, reqid, ids, num_returns, deadline,
+                         first=False):
+        rec = self.clients.get(conn_id)
+        if rec is None:
+            return
+        ready = [o for o in ids
+                 if self.objects.get(o) is not None
+                 and self.objects[o].state != "pending"]
+        timed_out = deadline is not None and time.time() >= deadline
+        if len(ready) >= num_returns or timed_out:
+            if not timed_out:
+                ready = ready[:num_returns]
+            self._reply(rec, reqid, ready=[o.binary() for o in ready])
+            return
+        if first:
+            for o in ids:
+                info = self.objects.setdefault(o, ObjInfo())
+                if info.state == "pending":
+                    info.wait_waiters.append((conn_id, reqid, ids, num_returns,
+                                              deadline))
+            if deadline is not None:
+                self.post_later(max(0.0, deadline - time.time()),
+                                lambda: self._try_finish_wait(
+                                    conn_id, reqid, ids, num_returns, deadline))
+
+    def _seal_error_object(self, oid: ObjectID, exc: BaseException) -> None:
+        """Make `oid` resolve to an error value and wake its waiters —
+        the single encoder of error objects on this node."""
+        from ray_tpu.core.serialization import SerializedObject
+        info = self.objects.setdefault(oid, ObjInfo())
+        info.state = "error"
+        info.loc = "inline"
+        info.data = SerializedObject(inband=pickle.dumps(exc)).to_bytes()
+        info.is_error = True
+        self._resolve_waiters(oid, info)
+
+    def _track_nested(self, info: ObjInfo, nested) -> None:
+        """Record ids embedded in this object's value so their storage
+        outlives the owner's release while the container exists."""
+        if not nested or info.nested:
+            return   # guard against double-count on a retried put
+        info.nested = tuple(nested)
+        for nb in info.nested:
+            self._nested_count[nb] = self._nested_count.get(nb, 0) + 1
+
+    def _release_owned(self, ob: bytes) -> None:
+        """Drop the ownership record and dereference its lineage entry
+        (freed objects need no reconstruction path)."""
+        orec = self.owned.pop(ob, None)
+        if orec is None or not orec.task_id:
+            return
+        lin = self.lineage.get(orec.task_id)
+        if lin is None:
+            return
+        lin["live"].discard(ob)
+        if not lin["live"]:
+            if lin["spec"] is not None:
+                self._lineage_bytes -= lin["cost"]
+            del self.lineage[orec.task_id]
+            # compact the eviction queue occasionally: entries for
+            # deleted lineage would otherwise accumulate forever
+            if len(self._lineage_order) > 256 \
+                    and len(self._lineage_order) > 4 * len(self.lineage):
+                self._lineage_order = deque(
+                    t for t in self._lineage_order if t in self.lineage)
+
+    def _forget_object(self, oid: ObjectID) -> None:
+        """Single removal point: drop the entry, its storage, and its
+        holds on nested ids."""
+        info = self.objects.pop(oid, None)
+        self.store.delete(oid)
+        ob = oid.binary()
+        self._bcast_tail.pop(ob, None)
+        if info is not None and info.owner_node \
+                and info.owner_node[0] == self.node_id.hex():
+            self._release_owned(ob)
+        else:
+            orec = self.owned.get(ob)
+            if orec is not None:
+                orec.locations.pop(self.node_id.hex(), None)
+        if info is not None and info.nested:
+            for nb in info.nested:
+                c = self._nested_count.get(nb, 0) - 1
+                if c > 0:
+                    self._nested_count[nb] = c
+                else:
+                    self._nested_count.pop(nb, None)
+
+    def _delete_local_object(self, oid: ObjectID) -> None:
+        info = self.objects.get(oid)
+        # capture BEFORE sealing: _seal_error_object rewrites loc to
+        # "inline", which would skip the owner's HBM release below
+        was_device = info is not None and info.loc == "device"
+        device_owner = info.owner_conn if was_device else None
+        if info is not None and (info.state == "pending"
+                                 or oid in self._mg_by_oid
+                                 or info.wait_waiters
+                                 or oid in self.dep_waiting):
+            # fail anyone blocked on it before it vanishes
+            self._seal_error_object(
+                oid, RuntimeError(f"Object {oid.hex()[:16]} was freed"))
+        if was_device:
+            # tell the owner process to release the HBM buffers
+            owner = self.clients.get(device_owner)
+            if owner is not None:
+                self._push(owner, {"t": "drop_device_object",
+                                   "object_id": oid.binary()})
+        self._forget_object(oid)
+
+    def _h_free_objects(self, rec, m):
+        for b in m["object_ids"]:
+            self._delete_local_object(ObjectID(b))
+        if self.head_conn is not None:
+            self._head_send({"t": "free_objects",
+                             "object_ids": list(m["object_ids"])})
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+
+    def _h_object_stats(self, rec, m):
+        self._reply(rec, m["reqid"], stats=self.store.stats(),
+                    num_objects=len(self.objects))
+
+    # -- automatic object lifetime (owner-based release) --------------------
+
+    def _h_release_refs(self, rec, m):
+        """The owning process dropped its last local ref to these objects
+        — reclaim their storage once nothing on this node still needs
+        them (reference: reference_count.h owner-count-zero → delete;
+        borrower chains are out of scope, so non-owner releases are
+        ignored rather than trusted)."""
+        for b in m["object_ids"]:
+            oid = ObjectID(b)
+            info = self.objects.get(oid)
+            if info is None:
+                continue
+            if info.owner and info.owner != rec.worker_id:
+                continue
+            self._released_wait.add(oid)
+        self._sweep_released()
+
+    def _args_in_flight(self) -> set:
+        """Object ids still referenced as args by queued or running work
+        on this node — storage for these must survive the owner's
+        release until the work completes."""
+        s: set = set()
+        for q in (self.runnable_cpu, self.runnable_tpu,
+                  self.runnable_zero):
+            for spec in q:
+                s.update(spec.get("arg_ids", ()))
+        for specs in self.dep_waiting.values():
+            for spec in specs:
+                s.update(spec.get("arg_ids", ()))
+        for ar in self.actors.values():
+            for spec in ar.queue:
+                s.update(spec.get("arg_ids", ()))
+            for spec in ar.running.values():
+                s.update(spec.get("arg_ids", ()))
+        # running (non-actor) work hangs off busy workers — iterating
+        # clients is O(pool), where iterating self.tasks would be
+        # O(task history) per release sweep
+        for rec in self.clients.values():
+            if rec.current_task is not None:
+                tr = self.tasks.get(rec.current_task)
+                if tr is not None:
+                    s.update(tr.spec.get("arg_ids", ()))
+        # forwarded work: the destination node still has to PULL these
+        # args from us — our copy must outlive the forward
+        for fw in self._fwd_tasks.values():
+            s.update(fw["spec"].get("arg_ids", ()))
+        for specs in self._awaiting_actor.values():
+            for spec in specs:
+                s.update(spec.get("arg_ids", ()))
+        return s
+
+    def _sweep_released(self) -> None:
+        if not self._released_wait:
+            return
+        in_flight = self._args_in_flight()
+        freed: list[bytes] = []
+        for oid in list(self._released_wait):
+            info = self.objects.get(oid)
+            if info is None:
+                self._released_wait.discard(oid)
+                continue
+            if info.state == "pending":
+                continue   # producing task still running; re-checked later
+            if oid.binary() in in_flight:
+                continue
+            if oid in self._mg_by_oid or info.wait_waiters:
+                continue
+            if self._nested_count.get(oid.binary(), 0) > 0:
+                continue   # a stored container still embeds this ref
+            if info.loc == "shm":
+                e = self.store.entries.get(oid)
+                if e is not None and e.pin_count > 0:
+                    continue   # a get/transfer is mapping it right now
+            self._released_wait.discard(oid)
+            self._forget_object(oid)
+            freed.append(oid.binary())
+        if freed and self.head_conn is not None:
+            # replicas pulled to other nodes die with the owner's copy
+            self._head_send({"t": "free_objects", "object_ids": freed})
+
+    # -- ownership + lineage --------------------------------------------------
+
+    def _record_lineage(self, spec: dict) -> None:
+        """Retain the producer spec so lost returns can be re-executed
+        (reference: task_manager.h lineage pinning bounded by
+        max_lineage_bytes)."""
+        tid = spec["task_id"]
+        live = set(spec["return_ids"])
+        for b in live:
+            rec = self.owned.get(b)
+            if rec is None:
+                self.owned[b] = OwnedRec(task_id=tid)
+            else:
+                rec.task_id = rec.task_id or tid
+        if tid in self.lineage or not live:
+            return
+        wire = _wire_spec(spec)
+        # cheap size estimate: serialized args dominate a spec
+        cost = len(wire.get("args") or b"") + 256 * (1 + len(live))
+        self.lineage[tid] = {"spec": wire, "cost": cost, "live": live,
+                             "recons": 0}
+        self._lineage_order.append(tid)
+        self._lineage_bytes += cost
+        cap = self.config.max_lineage_bytes
+        while self._lineage_bytes > cap and self._lineage_order:
+            old = self._lineage_order.popleft()
+            lin = self.lineage.get(old)
+            if lin is not None and lin["spec"] is not None:
+                lin["spec"] = None
+                self._lineage_bytes -= lin["cost"]
+
+    def _absorb_arg_owners(self, spec: dict) -> None:
+        """Adopt the forwarding node's owner hints for arg objects so
+        location queries go to owners, not the head."""
+        for b, onode in (spec.get("arg_owners") or {}).items():
+            info = self.objects.setdefault(ObjectID(b), ObjInfo())
+            if not info.owner_node:
+                info.owner_node = tuple(onode)
+
+    def _attach_arg_owners(self, wire: dict, spec: dict) -> None:
+        """Stamp owner addresses onto a spec leaving this node (the
+        reference ships owner_address inside every ObjectReference)."""
+        owners = {}
+        ids = list(spec.get("arg_ids", ()))
+        for b in ids:
+            info = self.objects.get(ObjectID(b))
+            if info is None:
+                continue
+            if info.owner_node:
+                owners[b] = tuple(info.owner_node)
+            elif info.state != "pending":
+                # no owner recorded but we hold a copy: we can serve it
+                owners[b] = (self.node_id.hex(), self.address)
+        if owners:
+            wire["arg_owners"] = owners
+
+    # -- node-to-node object transfer ---------------------------------------
+
+    def _peer_conn_async(self, node_hex: str, address: str, cb) -> None:
+        """Hand `cb` a Connection to the peer (or None).  The TCP connect
+        runs on a helper thread — a blackholed peer must never stall the
+        event loop (heartbeats ride it, and a stalled loop gets this
+        healthy node declared dead)."""
+        conn = self._peer_conns.get(node_hex)
+        if conn is not None:
+            cb(conn)
+            return
+        waiters = self._peer_connecting.setdefault(node_hex, [])
+        waiters.append(cb)
+        if len(waiters) > 1:
+            return   # a connect is already in flight
+
+        def work():
+            c = None
+            try:
+                c = protocol.connect(
+                    address, timeout=5.0, remote=True,
+                    label=(f"node:{self.node_id.hex()[:8]}",
+                           f"node:{node_hex[:8]}"))
+                c.send({"t": "register", "kind": "peer", "reqid": 0,
+                        "node_hex": self.node_id.hex(),
+                        "worker_id": f"peer-{self.node_id.hex()[:12]}"})
+            except (OSError, protocol.ConnectionClosed):
+                if c is not None:
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
+                c = None
+            self.post(lambda: self._peer_connected(node_hex, c))
+        threading.Thread(target=work, daemon=True,
+                         name=f"raytpu-connect-{node_hex[:8]}").start()
+
+    def _peer_connected(self, node_hex: str,
+                        conn: Optional[protocol.Connection]) -> None:
+        cbs = self._peer_connecting.pop(node_hex, [])
+        if conn is not None:
+            self._peer_conns[node_hex] = conn
+            from ray_tpu.core.local_lane import LaneConnection
+            if isinstance(conn, LaneConnection):
+                # same-process peer: deliver from its loop, no recv thread
+                conn.on_close = \
+                    lambda: self.post(lambda: self._drop_peer(node_hex))
+                conn.set_deliver(
+                    lambda m: self.post(
+                        lambda m=m: self._on_peer_msg(node_hex, m)))
+            else:
+                t = threading.Thread(target=self._peer_recv_loop,
+                                     args=(node_hex, conn), daemon=True,
+                                     name=f"raytpu-peer-{node_hex[:8]}")
+                t.start()
+        for cb in cbs:
+            try:
+                cb(conn)
+            except Exception:
+                sys.stderr.write("[node] peer-connect callback failed:\n"
+                                 + traceback.format_exc())
+
+    def _peer_recv_loop(self, node_hex: str,
+                        conn: protocol.Connection) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = conn.recv()
+            except protocol.ConnectionClosed:
+                self.post(lambda: self._drop_peer(node_hex))
+                return
+            except Exception:
+                continue
+            self.post(lambda m=msg: self._on_peer_msg(node_hex, m))
+
+    def _drop_peer(self, node_hex: str) -> None:
+        conn = self._peer_conns.pop(node_hex, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        # pulls in flight from that peer: retry through the head (it may
+        # know another location, or the producer will resubmit)
+        for ob, st in list(self._pulls.items()):
+            if st["src"] == node_hex:
+                self._pulls.pop(ob, None)
+                self._watched.discard(ob)
+                self.post_later(
+                    0.1, lambda o=ObjectID(ob): self._ensure_remote_watch([o]))
+
+    def _ensure_remote_watch(self, oids: list) -> None:
+        """Route pending objects to their location authority: the OWNER
+        node when known (reference: ownership_based_object_directory.cc),
+        the head only as fallback for objects with no owner hint.  Safe
+        to call repeatedly — each object is watched at most once."""
+        if self.head_conn is None:
+            return
+        me = self.node_id.hex()
+        head_want = []
+        by_owner: dict[tuple, list] = {}
+        for o in oids:
+            ob = o.binary()
+            if ob in self._watched or ob in self._pulls:
+                continue
+            info = self.objects.get(o)
+            if info is not None and info.state != "pending":
+                continue
+            onode = tuple(info.owner_node) if info is not None \
+                and info.owner_node else ()
+            if onode and onode[0] == me:
+                # owner-side resolution is idempotent and cheap — don't
+                # latch _watched, so demand arriving later re-resolves
+                self._owner_self_resolve(ob)
+            elif onode:
+                self._watched.add(ob)
+                by_owner.setdefault(onode, []).append(ob)
+            else:
+                self._watched.add(ob)
+                head_want.append(ob)
+        for onode, obs in by_owner.items():
+            self._owner_locate_send(onode, obs)
+        if head_want:
+            self._head_locate(head_want)
+
+    def _head_locate(self, obs: list, fatal_missing: bool = False) -> None:
+        """Fallback directory lookup through the head."""
+
+        def cb(reply):
+            if reply.get("error"):
+                return
+            locs = reply.get("locs", {})
+            for ob, (node_hex, addr) in locs.items():
+                self._request_pull(ObjectID(ob), node_hex, addr)
+            if fatal_missing:
+                from ray_tpu.core.client import ObjectLostError
+                for ob in obs:
+                    if ob in locs:
+                        continue
+                    oid = ObjectID(ob)
+                    info = self.objects.get(oid)
+                    if info is not None and info.state == "pending":
+                        self._seal_error_object(oid, ObjectLostError(
+                            f"Object {oid.hex()[:16]} was lost: its "
+                            "owner node died and no copy is known"))
+        self._head_rpc({"t": "locate_object", "object_ids": list(obs)}, cb)
+
+    # -- ownership directory protocol ----------------------------------------
+
+    def _owner_locate_send(self, onode: tuple, obs: list) -> None:
+        """Ask the owner node where these objects live; it replies with
+        object_at pushes (or owner_object_lost) and registers us as a
+        watcher until then."""
+        hexn, addr = onode
+
+        def go(conn):
+            if conn is None:
+                self._owner_unreachable(hexn, obs)
+                return
+            try:
+                conn.send({"t": "owner_locate", "object_ids": list(obs),
+                           "from_hex": self.node_id.hex(),
+                           "from_addr": self.address})
+                for ob in obs:
+                    self._owner_watch[ob] = hexn
+            except protocol.ConnectionClosed:
+                self._drop_peer(hexn)
+                self._owner_unreachable(hexn, obs)
+        self._peer_conn_async(hexn, addr, go)
+
+    def _owner_unreachable(self, owner_hex: str, obs: list) -> None:
+        """Owner node gone: fall back to the head directory; if it knows
+        no copy either, the object is lost for good."""
+        retry = []
+        for ob in obs:
+            self._owner_watch.pop(ob, None)
+            info = self.objects.get(ObjectID(ob))
+            if info is not None and info.state == "pending":
+                info.owner_node = ()
+                retry.append(ob)
+        if retry:
+            self._head_locate(retry, fatal_missing=True)
+
+    def _owner_push(self, node_hex: str, address: str, msg: dict) -> None:
+        def go(conn):
+            if conn is None:
+                return
+            # corked: one owner push per finished task — the batch flush
+            # turns a per-task send into one send per loop pass (a dead
+            # peer is noticed by its recv/on_close path)
+            self._conn_send(conn, msg)
+        self._peer_conn_async(node_hex, address, go)
+
+    def _owner_add_location(self, ob: bytes, node_hex: str,
+                            address: str) -> None:
+        """Owner-side: record that a copy of an owned object exists on
+        `node_hex`, notify watchers, feed our own pending consumers."""
+        orec = self.owned.get(ob)
+        if orec is None:
+            orec = self.owned[ob] = OwnedRec()
+        orec.locations[node_hex] = address
+        # a remote location report IS the completion signal for a task we
+        # forwarded — settle its record so node-death recovery treats the
+        # object as lost-but-reconstructable, not in-flight
+        tid = self._fwd_by_oid.pop(ob, None)
+        if tid is not None:
+            fw = self._fwd_tasks.get(tid)
+            if fw is not None and not any(b in self._fwd_by_oid
+                                          for b in fw["spec"]["return_ids"]):
+                self._fwd_tasks.pop(tid, None)
+                tr = self.tasks.get(tid)
+                if tr is not None and tr.state == "forwarded":
+                    tr.state = "finished"
+                    tr.finished_at = time.time()
+                    self._note_task_finished(tid)
+                    self._release_arg_blob(fw["spec"])
+        if orec.watchers:
+            watchers, orec.watchers = orec.watchers, set()
+            for whex, waddr in watchers:
+                if whex == node_hex:
+                    continue
+                self._owner_push(whex, waddr,
+                                 {"t": "object_at", "object_id": ob,
+                                  "node": node_hex, "address": address})
+        # demand-driven: pull our own copy only if something local waits
+        # on it (a get, a wait, or a queued task's dependency)
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is not None and info.state == "pending" \
+                and node_hex != self.node_id.hex() \
+                and (oid in self._mg_by_oid or oid in self.dep_waiting
+                     or info.wait_waiters):
+            self._request_pull(oid, node_hex, address)
+
+    def _h_owner_object_at(self, rec, m):
+        """A node stored a copy of an object WE own."""
+        self._owner_add_location(m["object_id"], m["node"], m["address"])
+
+    def _h_owner_locate(self, rec, m):
+        """A consumer asks us (the owner) where our objects live."""
+        me = self.node_id.hex()
+        watcher = (m.get("from_hex", ""), m.get("from_addr", ""))
+        for ob in m["object_ids"]:
+            oid = ObjectID(ob)
+            info = self.objects.get(oid)
+            if info is not None and info.state != "pending":
+                self._push(rec, {"t": "object_at", "object_id": ob,
+                                 "node": me, "address": self.address})
+                continue
+            orec = self.owned.get(ob)
+            if orec is not None:
+                self._prune_dead_locations(orec)
+                loc = next(((h, a) for h, a in orec.locations.items()
+                            if h != me), None)
+                if loc is not None:
+                    self._push(rec, {"t": "object_at", "object_id": ob,
+                                     "node": loc[0], "address": loc[1]})
+                    continue
+            tid = (orec.task_id if orec is not None and orec.task_id
+                   else oid.task_id().binary())
+            if self._producer_in_flight(tid) or self._reconstruct(tid):
+                # result will arrive: register the asker for the
+                # object_at push that follows
+                if watcher[0]:
+                    orec = self.owned.get(ob)
+                    if orec is None:
+                        orec = self.owned[ob] = OwnedRec(task_id=tid)
+                    orec.watchers.add(watcher)
+                continue
+            self._push(rec, {"t": "owner_object_lost", "object_id": ob,
+                             "cause": "owner holds no copy and no lineage"})
+
+    def _h_object_at(self, rec, m):
+        """Location push from an owner node (same shape as the head's)."""
+        self._on_owner_object_at_push(m)
+
+    def _h_owner_object_value(self, rec, m):
+        """Inline VALUE pushed by the node that executed forwarded work
+        we own — seal it locally, skipping locate/pull round trips."""
+        ob = m["object_id"]
+        self._owner_watch.pop(ob, None)
+        self._watched.discard(ob)
+        oid = ObjectID(ob)
+        info = self.objects.setdefault(oid, ObjInfo())
+        if info.state != "pending":
+            return
+        info.state = "error" if m.get("is_error") else "ready"
+        info.loc = "inline"
+        info.data = m["data"]
+        info.is_error = bool(m.get("is_error"))
+        info.size = len(m["data"] or b"")
+        # the executing node still holds a replica — track it like an
+        # owner_object_at so release sweeps can reach it
+        self._owner_add_location(ob, m["node"], m["address"])
+        self._resolve_waiters(oid, info)
+
+    def _on_owner_object_at_push(self, m: dict) -> None:
+        self._owner_watch.pop(m["object_id"], None)
+        self._hh_object_at(m)
+
+    def _h_owner_object_lost(self, rec, m):
+        self._on_owner_object_lost_push(m)
+
+    def _on_owner_object_lost_push(self, m: dict) -> None:
+        ob = m["object_id"]
+        self._owner_watch.pop(ob, None)
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        from ray_tpu.core.client import ObjectLostError
+        self._seal_error_object(oid, ObjectLostError(
+            f"Object {oid.hex()[:16]} was lost: {m.get('cause', '')}"))
+
+    def _prune_dead_locations(self, orec: OwnedRec) -> None:
+        me = self.node_id.hex()
+        for h in list(orec.locations):
+            if h != me and h not in self.cluster_view:
+                orec.locations.pop(h)
+
+    def _producer_in_flight(self, tid: bytes) -> bool:
+        if tid in self._fwd_tasks:
+            return True
+        tr = self.tasks.get(tid)
+        return tr is not None and tr.state in ("pending", "running",
+                                               "forwarded")
+
+    def _owner_self_resolve(self, ob: bytes) -> None:
+        """We own this pending object: pull a known copy, wait on the
+        in-flight producer, or re-execute it from lineage (reference:
+        object_recovery_manager.h:41)."""
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        me = self.node_id.hex()
+        orec = self.owned.get(ob)
+        if orec is not None:
+            self._prune_dead_locations(orec)
+            loc = next(((h, a) for h, a in orec.locations.items()
+                        if h != me), None)
+            if loc is not None:
+                self._request_pull(oid, loc[0], loc[1])
+                return
+        # no live copy: wait on an in-flight producer (the owned rec may
+        # not exist yet — lineage-less tasks only get one when a
+        # location is first reported), reconstruct, or declare the loss
+        tid = (orec.task_id if orec is not None and orec.task_id
+               else oid.task_id().binary())
+        if self._producer_in_flight(tid):
+            return
+        if self._reconstruct(tid):
+            return
+        from ray_tpu.core.client import ObjectLostError
+        self._seal_error_object(oid, ObjectLostError(
+            f"Object {oid.hex()[:16]} was lost and cannot be "
+            "reconstructed (no live copy, no retained lineage)"))
+
+    def _reconstruct(self, tid: bytes) -> bool:
+        """Re-execute the producer of lost owned objects.  Deterministic
+        return ids mean the re-run recreates exactly the lost objects
+        (reference: object_recovery_manager.h ReconstructObject)."""
+        lin = self.lineage.get(tid)
+        if lin is None or lin.get("spec") is None:
+            return False
+        if lin["recons"] >= self.config.max_object_reconstructions:
+            return False
+        lin["recons"] += 1
+        spec = dict(lin["spec"])
+        # fresh flight-recorder record: the captured wire spec shares
+        # the original attempt's stamp list, and stamping into it would
+        # misattribute the whole loss-detection gap to node_recv
+        spec.pop("fr", None)
+        spec.pop("fr_w0", None)
+        spec.pop("fr_done", None)
+        sys.stderr.write(f"[node] reconstructing task "
+                         f"{tid.hex()[:12]} (attempt {lin['recons']})\n")
+        self._admit_task(spec)
+        return True
+
+    def _hh_object_at(self, m: dict) -> None:
+        oid = ObjectID(m["object_id"])
+        info = self.objects.get(oid)
+        if info is not None and info.state == "pending":
+            self._request_pull(oid, m["node"], m["address"])
+
+    def _hh_object_lost(self, m: dict) -> None:
+        ob = m["object_id"]
+        if ob in self._fwd_by_oid:
+            return  # our own forwarded task will be resubmitted on node_dead
+        oid = ObjectID(ob)
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        if info.owner_node:
+            # the owner, not the head, decides whether this is fatal —
+            # it may hold another copy or reconstruct from lineage
+            if info.owner_node[0] == self.node_id.hex():
+                self._owner_self_resolve(ob)
+            elif ob not in self._owner_watch:
+                self._owner_locate_send(tuple(info.owner_node), [ob])
+            return
+        from ray_tpu.core.client import ObjectLostError
+        self._seal_error_object(oid, ObjectLostError(
+            f"Object {oid.hex()[:16]} was lost: "
+            f"{m.get('cause', 'node died')}"))
+
+    def _request_pull(self, oid: ObjectID, node_hex: str,
+                      address: str) -> None:
+        ob = oid.binary()
+        if ob in self._pulls:
+            return
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        if self._try_local_pull(oid, ob, node_hex):
+            return
+        # reserve the pull slot BEFORE the async connect so concurrent
+        # object_at notifications don't start duplicate transfers
+        self._pulls[ob] = {"src": node_hex, "view": None, "size": None,
+                           "received": 0, "is_error": False}
+
+        def go(conn):
+            st = self._pulls.get(ob)
+            if st is None or st["src"] != node_hex:
+                return   # resolved or re-routed while connecting
+            if conn is None:
+                self._pulls.pop(ob, None)
+                self._watched.discard(ob)
+                self.post_later(0.2,
+                                lambda: self._ensure_remote_watch([oid]))
+                return
+            try:
+                conn.send({"t": "pull_object", "object_id": ob,
+                           # after any failed attempt, insist on a direct
+                           # stream — never bounce through a relay again
+                           "no_redirect":
+                               self._pull_attempts.get(ob, 0) > 0})
+            except protocol.ConnectionClosed:
+                self._pulls.pop(ob, None)
+                self._watched.discard(ob)
+                self._drop_peer(node_hex)
+                self.post_later(0.2,
+                                lambda: self._ensure_remote_watch([oid]))
+        self._peer_conn_async(node_hex, address, go)
+
+    # same-process fast path -------------------------------------------------
+
+    def _try_local_pull(self, oid: ObjectID, ob: bytes,
+                        node_hex: str) -> bool:
+        """Peer lives in THIS process (virtual cluster): hand the bytes
+        over with one memcpy.  Thread discipline: the source's loop pins
+        + maps, our loop copies into our arena, the source's loop
+        unpins.  Falls back to the socket path on any miss."""
+        if not self.config.same_host_object_fastpath:
+            return False
+        src = _LOCAL_NODES_BY_HEX.get(node_hex)
+        if src is None or src is self or src._stop.is_set():
+            return False
+        self._pulls[ob] = {"src": node_hex, "view": None, "size": None,
+                           "received": 0, "is_error": False, "local": True}
+
+        def replay_pulls(queued):
+            # socket peers that asked for the object mid-memcpy: serve
+            # them now (object present -> stream; absent -> pull_failed
+            # so they re-route)
+            for cid, pm in queued:
+                peer = self.clients.get(cid)
+                if peer is not None:
+                    self._h_pull_object(peer, pm)
+
+        def fallback():
+            st = self._pulls.get(ob)
+            if st is not None and st.get("local"):
+                self._pulls.pop(ob, None)
+                self._watched.discard(ob)
+                replay_pulls(st.get("replay_pulls", []))
+                self.post_later(0.1,
+                                lambda: self._ensure_remote_watch([oid]))
+
+        def on_src():
+            info = src.objects.get(oid)
+            if (info is None or info.state != "ready"
+                    or info.loc not in ("shm", "inline")):
+                self.post(fallback)
+                return
+            if info.loc == "inline":
+                data, is_err = info.data, info.is_error
+                self.post(lambda: self._local_pull_inline(
+                    oid, ob, data, is_err))
+                return
+            if src.store.is_spilled(oid):
+                src.store.restore(oid)
+            src.store.pin(oid)
+            try:
+                view = src.store._shm.map(oid)
+            except Exception:
+                src.store.unpin(oid)
+                self.post(fallback)
+                return
+            size = src.objects[oid].size
+
+            def on_dst():
+                try:
+                    try:
+                        buf = self.store._shm.create(oid, size)
+                        _gil_free_copy(buf, view, size)
+                        del buf
+                        self.store._shm.seal(oid)
+                    except ObjectExists:
+                        pass
+                    st = self._pulls.pop(ob, None)
+                    if st is None:
+                        return   # resolved another way meanwhile
+                    self.store.register(oid, size)
+                    info2 = self.objects.setdefault(oid, ObjInfo())
+                    info2.state = "ready"
+                    info2.loc = "shm"
+                    info2.size = size
+                    self._resolve_waiters(oid, info2)
+                    replay_pulls(st.get("replay_pulls", []))
+                except Exception:
+                    fallback()
+                finally:
+                    src.post(lambda: src.store.unpin(oid))
+            self.post(on_dst)
+
+        src.post(on_src)
+        # safety net: a wedged source loop must not hang the pull
+        self.post_later(10.0, fallback)
+        return True
+
+    def _local_pull_inline(self, oid: ObjectID, ob: bytes, data,
+                           is_err: bool) -> None:
+        st = self._pulls.pop(ob, None)
+        if st is None:
+            return
+        info = self.objects.setdefault(oid, ObjInfo())
+        if info.state != "pending":
+            return
+        info.state = "error" if is_err else "ready"
+        info.loc = "inline"
+        info.data = data
+        info.size = len(data or b"")
+        info.is_error = is_err
+        self._resolve_waiters(oid, info)
+        for cid, pm in st.get("replay_pulls", []):
+            peer = self.clients.get(cid)
+            if peer is not None:
+                self._h_pull_object(peer, pm)
+
+    # sender side -----------------------------------------------------------
+
+    def _h_pull_object(self, rec, m):
+        """A peer wants an object stored here: inline goes in one frame,
+        shm goes in windowed chunks (reference: object_manager.proto:61
+        Push with chunked ObjectChunk stream).
+
+        Broadcast shaping (reference: push_manager.h rate-limited
+        parallel pushes; here a relay CHAIN): if this node is itself
+        still RECEIVING the object, it serves the request as a relay —
+        forwarding chunks as they arrive — and if this node is the
+        source already streaming to someone, later requesters are
+        redirected to the most recent receiver, so an N-node broadcast
+        pipelines through the receivers instead of serializing N full
+        streams at the source."""
+        ob = m["object_id"]
+        oid = ObjectID(ob)
+        pst = self._pulls.get(ob)
+        if pst is not None:
+            if pst.get("local"):
+                # same-process fast path in flight: chunk relay state
+                # never materializes — replay this request when the
+                # memcpy lands (or fails) instead of parking it forever
+                pst.setdefault("replay_pulls", []).append(
+                    (rec.conn_id, dict(m)))
+                return
+            # mid-pull here: relay chunks to this requester as they land
+            self._relay_register(rec, ob, pst)
+            return
+        if not m.get("no_redirect"):
+            tail = self._bcast_tail.get(ob)
+            if tail is not None and tail[0] != rec.node_hex \
+                    and (rec.conn_id, ob) not in self._out_transfers:
+                active = any(o == ob for (_c, o) in self._out_transfers)
+                if active:
+                    # chain: newest requester fetches from the previous
+                    # one; we keep streaming only the first copy
+                    self._push(rec, {"t": "pull_redirect", "object_id": ob,
+                                     "node": tail[0], "address": tail[1]})
+                    self._note_bcast_tail(ob, rec)
+                    return
+        info = self.objects.get(oid)
+        if info is not None and info.loc == "device":
+            # device-resident: spill to host first, then serve the pull
+            # (the queued request replays when materialization lands)
+            self._device_pending_pulls.setdefault(ob, []).append(
+                (rec.conn_id, dict(m)))
+            if info.state == "ready":
+                self._request_materialize(oid, info)
+            return
+        if info is None or info.state == "pending":
+            self._push(rec, {"t": "pull_failed", "object_id": ob,
+                             "error": "object not found on this node"})
+            return
+        if info.loc == "inline":
+            self._push(rec, {"t": "obj_inline", "object_id": ob,
+                             "data": info.data, "is_error": info.is_error})
+            return
+        if self.store.is_spilled(oid):
+            self.store.restore(oid)
+        self.store.touch(oid)
+        self.store.pin(oid)
+        try:
+            view = self.store._shm.map(oid)
+        except Exception:
+            self.store.unpin(oid)
+            self._push(rec, {"t": "pull_failed", "object_id": ob,
+                             "error": "object vanished mid-pull"})
+            return
+        st = {"oid": oid, "view": view, "size": info.size, "next_off": 0,
+              "pinned": True}
+        self._out_transfers[(rec.conn_id, ob)] = st
+        self._note_bcast_tail(ob, rec)
+        for _ in range(self.config.object_transfer_window):
+            if not self._send_next_chunk(rec, st):
+                break
+
+    def _note_bcast_tail(self, ob: bytes, rec: ClientRec) -> None:
+        """Remember the most recent receiver as the chain tail for later
+        requesters (only peers with a known node identity qualify)."""
+        if rec.node_hex and rec.node_hex in self.cluster_view:
+            addr = self.cluster_view[rec.node_hex].get("address")
+            if addr:
+                self._bcast_tail[ob] = (rec.node_hex, addr)
+
+    def _send_next_chunk(self, rec: ClientRec, st: dict) -> bool:
+        off = st["next_off"]
+        limit = st["size"] if st.get("available") is None \
+            else min(st["size"], st["available"])
+        if off >= limit or st["view"] is None:
+            return False
+        n = min(self.config.object_transfer_chunk_size, limit - off)
+        st["next_off"] = off + n
+        # blob frame: the chunk bytes ride out-of-band of the pickle —
+        # one copy into the socket buffer instead of slice+pickle+buffer
+        self._push_blob(rec, {"t": "obj_chunk",
+                              "object_id": st["oid"].binary(),
+                              "offset": off, "total_size": st["size"]},
+                        st["view"][off:off + n])
+        if st["next_off"] >= st["size"]:
+            # final chunk queued: release our references now; remaining
+            # acks for this transfer are ignored
+            st["view"] = None
+            if st.get("pinned"):
+                self.store.unpin(st["oid"])
+            self._out_transfers.pop((rec.conn_id, st["oid"].binary()), None)
+        return True
+
+    def _h_obj_chunk_ack(self, rec, m):
+        st = self._out_transfers.get((rec.conn_id, m["object_id"]))
+        if st is not None:
+            st["outstanding"] = max(0, st.get("outstanding", 1) - 1)
+            if self._send_next_chunk(rec, st):
+                st["outstanding"] = st.get("outstanding", 0) + 1
+
+    # relay (chain broadcast) ------------------------------------------------
+
+    def _relay_register(self, rec, ob: bytes, pst: dict) -> None:
+        """Serve a pull for an object we are still receiving: forward
+        already-received bytes now, the rest as chunks arrive."""
+        oid = ObjectID(ob)
+        if pst.get("size") is None:
+            # no chunk yet: start the relay when the first one lands
+            pst.setdefault("relay_waiting", []).append(rec.conn_id)
+            return
+        st = {"oid": oid, "view": pst["view"], "size": pst["size"],
+              "next_off": 0, "available": pst["received"],
+              "outstanding": 0, "pinned": False, "relay": True}
+        self._out_transfers[(rec.conn_id, ob)] = st
+        pst.setdefault("relay_conns", []).append(rec.conn_id)
+        self._note_bcast_tail(ob, rec)
+        self._relay_advance(rec, st)
+
+    def _relay_advance(self, rec, st: dict) -> None:
+        window = self.config.object_transfer_window
+        while st.get("outstanding", 0) < window:
+            if not self._send_next_chunk(rec, st):
+                break
+            st["outstanding"] = st.get("outstanding", 0) + 1
+
+    def _relay_on_upstream_chunk(self, ob: bytes, pst: dict) -> None:
+        """Upstream bytes advanced: wake pending relays and push more."""
+        for cid in pst.pop("relay_waiting", []):
+            peer = self.clients.get(cid)
+            if peer is not None:
+                self._relay_register(peer, ob, pst)
+        for cid in list(pst.get("relay_conns", [])):
+            st = self._out_transfers.get((cid, ob))
+            peer = self.clients.get(cid)
+            if st is None or peer is None:
+                pst["relay_conns"].remove(cid)
+                continue
+            st["available"] = pst["received"]
+            self._relay_advance(peer, st)
+
+    def _relay_on_pull_done(self, oid: ObjectID, pst: dict) -> None:
+        """Our pull finished and the buffer was sealed: re-map (pinned)
+        for relays that still have bytes to send."""
+        ob = oid.binary()
+        for cid in pst.get("relay_conns", []):
+            st = self._out_transfers.get((cid, ob))
+            if st is None:
+                continue
+            st["available"] = st["size"]
+            try:
+                st["view"] = self.store._shm.map(oid)
+                self.store.pin(oid)
+                st["pinned"] = True
+            except Exception:
+                self._out_transfers.pop((cid, ob), None)
+                peer = self.clients.get(cid)
+                if peer is not None:
+                    self._push(peer, {"t": "pull_failed", "object_id": ob,
+                                      "error": "relay source lost the "
+                                               "object mid-stream"})
+                continue
+            peer = self.clients.get(cid)
+            if peer is not None:
+                self._relay_advance(peer, st)
+
+    # receiver side ----------------------------------------------------------
+
+    def _on_peer_msg(self, node_hex: str, m: dict) -> None:
+        t = m.get("t")
+        try:
+            if t == "obj_chunk":
+                self._on_obj_chunk(node_hex, m)
+            elif t == "obj_inline":
+                self._on_obj_inline(m)
+            elif t == "pull_redirect":
+                self._on_pull_redirect(m)
+            elif t == "pull_failed":
+                self._on_pull_failed(m)
+            elif t == "object_at":
+                # owner's reply to our owner_locate rides this conn
+                self._on_owner_object_at_push(m)
+            elif t == "owner_object_lost":
+                self._on_owner_object_lost_push(m)
+            elif t == "owner_object_at":
+                # a holder may report on a conn WE opened to it earlier
+                self._owner_add_location(m["object_id"], m["node"],
+                                         m["address"])
+            elif t == "shutdown":
+                self._drop_peer(node_hex)
+            # replies (e.g. to our peer register) are ignored
+        except Exception:
+            sys.stderr.write(f"[node] peer message {t} failed:\n"
+                             + traceback.format_exc())
+
+    def _on_obj_chunk(self, node_hex: str, m: dict) -> None:
+        ob = m["object_id"]
+        st = self._pulls.get(ob)
+        if st is None:
+            return  # stale transfer (object resolved another way)
+        oid = ObjectID(ob)
+        if st["view"] is None:
+            st["size"] = m["total_size"]
+            try:
+                st["view"] = self.store._shm.create(oid, st["size"])
+            except Exception as e:
+                # arena full beyond eviction (or segment clash): fail pull
+                self._pulls.pop(ob, None)
+                self._fail_pull(oid, f"store create failed during "
+                                     f"transfer: {type(e).__name__}: {e}")
+                return
+        data = m["data"]
+        off = m["offset"]
+        st["view"][off:off + len(data)] = data
+        st["received"] += len(data)
+        conn = self._peer_conns.get(node_hex)
+        if conn is not None:
+            try:
+                conn.send({"t": "obj_chunk_ack", "object_id": ob})
+            except protocol.ConnectionClosed:
+                pass
+        if st.get("relay_waiting") or st.get("relay_conns"):
+            # chain broadcast: forward the new bytes downstream
+            self._relay_on_upstream_chunk(ob, st)
+        if st["received"] >= st["size"]:
+            st["view"] = None   # release buffer before seal/register
+            self.store._shm.seal(oid)
+            self._pulls.pop(ob, None)
+            self.store.register(oid, st["size"])
+            info = self.objects.setdefault(oid, ObjInfo())
+            info.state = "ready"
+            info.loc = "shm"
+            info.size = st["size"]
+            if st.get("relay_conns"):
+                self._relay_on_pull_done(oid, st)
+            self._resolve_waiters(oid, info)
+
+    def _on_pull_redirect(self, m: dict) -> None:
+        """The source is busy broadcasting: fetch from the chain tail it
+        named instead.  Ignored once bytes started flowing; a failed
+        relay fetch falls back through the normal re-watch path (which
+        sets no_redirect, so the source then serves directly)."""
+        ob = m["object_id"]
+        st = self._pulls.get(ob)
+        if st is None or st.get("size") is not None:
+            return
+        self._pulls.pop(ob, None)
+        self._watched.discard(ob)
+        # a redirect counts as an attempt: if the relay fetch fails, the
+        # re-watch retries the source with no_redirect set (direct serve)
+        self._pull_attempts[ob] = self._pull_attempts.get(ob, 0) + 1
+        self._request_pull(ObjectID(ob), m["node"], m["address"])
+
+    def _on_obj_inline(self, m: dict) -> None:
+        ob = m["object_id"]
+        self._pulls.pop(ob, None)
+        oid = ObjectID(ob)
+        info = self.objects.setdefault(oid, ObjInfo())
+        if info.state != "pending":
+            return
+        info.state = "error" if m.get("is_error") else "ready"
+        info.loc = "inline"
+        info.data = m["data"]
+        info.size = len(m["data"])
+        info.is_error = bool(m.get("is_error"))
+        self._resolve_waiters(oid, info)
+
+    def _on_pull_failed(self, m: dict) -> None:
+        ob = m["object_id"]
+        st = self._pulls.pop(ob, None)
+        src = st["src"] if st else None
+        self._watched.discard(ob)
+        oid = ObjectID(ob)
+        # a failed source is no longer a valid location for objects we own
+        orec = self.owned.get(ob)
+        if orec is not None and src:
+            orec.locations.pop(src, None)
+        attempts = self._pull_attempts.get(ob, 0) + 1
+        self._pull_attempts[ob] = attempts
+        if attempts <= 5:
+            # the location may be stale (freed/evicted+deleted); re-locate
+            self.post_later(0.2, lambda: self._ensure_remote_watch([oid]))
+        else:
+            self._fail_pull(oid, m.get("error", "pull failed"), src=src)
+
+    def _fail_pull(self, oid: ObjectID, cause: str,
+                   src: Optional[str] = None) -> None:
+        info = self.objects.get(oid)
+        if info is None or info.state != "pending":
+            return
+        ob = oid.binary()
+        if info.owner_node and info.owner_node[0] == self.node_id.hex():
+            orec = self.owned.get(ob)
+            if orec is not None and src:
+                orec.locations.pop(src, None)
+            self._pull_attempts.pop(ob, None)
+            # may pull another copy, wait on the producer, reconstruct,
+            # or seal the loss itself
+            self._owner_self_resolve(ob)
+            return
+        from ray_tpu.core.client import ObjectLostError
+        self._seal_error_object(oid, ObjectLostError(
+            f"Object {oid.hex()[:16]} could not be fetched: {cause}"))
+
+    def _hh_delete_object(self, m: dict) -> None:
+        self._delete_local_object(ObjectID(m["object_id"]))
+
+    # -- node death recovery -------------------------------------------------
+
+    def _hh_node_dead(self, m: dict) -> None:
+        node_hex = m["node"]
+        self._drop_peer(node_hex)
+        self.actor_cache = {k: v for k, v in self.actor_cache.items()
+                            if v[0] != node_hex}
+        # owned objects whose only copies died: re-resolve (pull another
+        # copy / reconstruct) for any object someone is waiting on
+        me = self.node_id.hex()
+        for ob, orec in list(self.owned.items()):
+            if orec.locations.pop(node_hex, None) is None:
+                continue
+            if orec.locations and any(h == me or h in self.cluster_view
+                                      for h in orec.locations):
+                continue
+            oid = ObjectID(ob)
+            info = self.objects.get(oid)
+            needed = (orec.watchers
+                      or oid in self._mg_by_oid
+                      or oid in self.dep_waiting
+                      or (info is not None and info.wait_waiters))
+            if needed and info is not None and info.state == "pending":
+                self._watched.discard(ob)
+                self._owner_self_resolve(ob)
+        # consumers whose owner-directory authority died: fall back to
+        # the head for anything we were watching through that owner
+        stale = [ob for ob, h in self._owner_watch.items()
+                 if h == node_hex]
+        if stale:
+            self._owner_unreachable(node_hex, stale)
+            for ob in stale:
+                self._watched.discard(ob)
+        for tid, fw in list(self._fwd_tasks.items()):
+            if fw["dst"] != node_hex:
+                continue
+            self._fwd_tasks.pop(tid, None)
+            spec = fw["spec"]
+            for b in spec["return_ids"]:
+                self._fwd_by_oid.pop(b, None)
+            if fw.get("actor"):
+                # the actor may restart elsewhere, but this call's
+                # execution state died with the node
+                self._fail_task(spec, f"Actor's node {node_hex[:8]} died "
+                                      "while the method was in flight")
+            elif fw["retries"] > 0:
+                # lineage-lite: deterministic return ids mean a re-run
+                # re-creates exactly the lost objects (reference:
+                # object_recovery_manager.h reconstruction)
+                spec = dict(spec)
+                spec["max_retries"] = fw["retries"] - 1
+                if _fr._active is not None:
+                    _fr._active.stamp(spec, "retry")
+                self._forward_task(spec)
+            else:
+                self._fail_task(spec, f"Node {node_hex[:8]} died while "
+                                      "running forwarded task")

@@ -1,0 +1,310 @@
+"""Native dispatch-frame codec: arming surface + pure-Python reference.
+
+The control-plane hot loop frames small dict messages thousands of
+times per second (submit → execute → put_inline → task_done).  The
+pickle path is already C-speed, but every frame still pays Python-level
+envelope assembly: ``encode_payload`` + header pack + bytes concat per
+hop, and a ``time.monotonic()`` + tuple + list-append per
+flight-recorder stamp.  This module moves the whole frame — length
+prefix, tag, body, and the stamp fold — into one C call
+(``native/src/rt_frames.cc``, loaded via ctypes like the shm store),
+with THIS file as the byte-identical pure-Python reference
+implementation and fallback decoder.
+
+Wire format (frame payload tag 0x03, after the 8-byte LE length
+prefix shared with every other encoding in ``core/protocol.py``)::
+
+    payload := 0x03 value           # top-level value must be a map
+    value   := 'N' | 'T' | 'F'                    # None / True / False
+             | 'I' i64-LE                         # int
+             | 'D' f64-LE                         # float
+             | 'B' u32-LE len bytes               # bytes
+             | 'S' u32-LE len utf8                # str
+             | 'L' u32-LE count value*            # list
+             | 'U' u32-LE count value*            # tuple
+             | 'M' u32-LE count (key value)*      # dict; key is 'S'|'B'
+
+Only exact builtin types are eligible (``type(v) is dict`` — a dict
+subclass must survive a round trip as its own type, which only pickle
+can do).  Anything else makes the whole message fall back to pickle;
+frames are self-describing so mixed encodings coexist on one socket.
+
+Stamp fold: ``encode(msg, stamp="dispatch")`` appends one
+``(stage, t_monotonic)`` tuple to the FIRST ``"fr"`` list found in
+pre-order traversal while writing it — the flight-recorder timestamp
+lands in the encoded frame without mutating the caller's dict and
+without a Python-level ``time.monotonic()`` call on the native path.
+
+Arming contract (same discipline as ``fault_injection`` /
+``flight_recorder``, verified by ``ray_tpu lint``'s hotpath pass):
+``_active`` is the armed native codec or None; hot call sites may only
+load ``_rtf._active`` and branch on ``is None``.  With no ``.so`` (or
+``RAY_TPU_NATIVE_FRAMES=0``) the codec stays disarmed and every caller
+takes the identical pre-existing pickle path.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Any, Optional
+
+TAG = b"\x03"
+_HDR = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+
+MAX_DEPTH = 32
+
+# The armed native codec (ray_tpu.native.frames.NativeFrameCodec) or
+# None.  Hot paths read this module attribute directly.
+_active: Optional[Any] = None
+
+
+def enable() -> bool:
+    """Arm the native codec in this process (idempotent).  Returns
+    False — leaving the pickle path untouched — when the shared library
+    is absent or unloadable."""
+    global _active
+    if _active is not None:
+        return True
+    try:
+        from ray_tpu.native.frames import NativeFrameCodec
+        _active = NativeFrameCodec()
+        return True
+    except Exception:
+        return False
+
+
+def disable() -> None:
+    global _active
+    _active = None
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def autoenable_from_env() -> None:
+    """Default-on: arm unless RAY_TPU_NATIVE_FRAMES disables it.  A
+    missing .so leaves the codec disarmed with identical behavior."""
+    if os.environ.get("RAY_TPU_NATIVE_FRAMES", "1").lower() \
+            not in ("0", "false", "no"):
+        enable()
+
+
+# ---------------------------------------------------------------------------
+# pure-Python reference codec (must stay byte-identical to rt_frames.cc;
+# tests/test_rt_frames.py fuzzes the parity)
+
+
+class _Ineligible(Exception):
+    """Internal: a value outside the wire universe — fall back to pickle."""
+
+
+def _py_encode_value(out: list, v, depth: int,
+                     stamp: Optional[tuple]) -> None:
+    if v is None:
+        out.append(b"N")
+        return
+    t = type(v)
+    if t is bool:
+        out.append(b"T" if v else b"F")
+        return
+    if t is int:
+        try:
+            out.append(b"I" + _I64.pack(v))
+        except struct.error:
+            raise _Ineligible from None
+        return
+    if t is float:
+        out.append(b"D" + _F64.pack(v))
+        return
+    if t is bytes:
+        if len(v) > 0xFFFFFFFF:
+            raise _Ineligible
+        out.append(b"B" + _U32.pack(len(v)))
+        out.append(v)
+        return
+    if t is str:
+        try:
+            b = v.encode("utf-8")
+        except UnicodeEncodeError:
+            raise _Ineligible from None
+        if len(b) > 0xFFFFFFFF:
+            raise _Ineligible
+        out.append(b"S" + _U32.pack(len(b)))
+        out.append(b)
+        return
+    if depth >= MAX_DEPTH:
+        raise _Ineligible
+    if t is list or t is tuple:
+        out.append((b"L" if t is list else b"U") + _U32.pack(len(v)))
+        for item in v:
+            _py_encode_value(out, item, depth + 1, stamp)
+        return
+    if t is dict:
+        entries = list(v.items())
+        out.append(b"M" + _U32.pack(len(entries)))
+        for k, val in entries:
+            kt = type(k)
+            if kt is not str and kt is not bytes:
+                raise _Ineligible
+            _py_encode_value(out, k, depth + 1, None)
+            if (stamp is not None and not stamp[2] and k == "fr"
+                    and type(val) is list):
+                # fold the stage stamp into the encoded list (first
+                # "fr" in pre-order only, matching the C encoder)
+                stamp[2] = True
+                out.append(b"L" + _U32.pack(len(val) + 1))
+                for item in val:
+                    _py_encode_value(out, item, depth + 2, None)
+                _py_encode_value(out, (stamp[0], stamp[1]), depth + 2,
+                                 None)
+            else:
+                _py_encode_value(out, val, depth + 1, stamp)
+        return
+    raise _Ineligible
+
+
+def py_encode_payload(msg: dict, stamp: Optional[str] = None,
+                      now: Optional[float] = None) -> Optional[bytes]:
+    """dict → tagged frame payload, or None when any value falls
+    outside the wire universe (caller then pickles as before)."""
+    if type(msg) is not dict:
+        return None
+    st = None
+    if stamp is not None:
+        st = [stamp, time.monotonic() if now is None else now, False]
+    out = [TAG]
+    try:
+        _py_encode_value(out, msg, 0, st)
+    except _Ineligible:
+        return None
+    return b"".join(out)
+
+
+def py_encode_frame(msg: dict, stamp: Optional[str] = None,
+                    now: Optional[float] = None) -> Optional[bytes]:
+    """Complete wire frame: 8-byte length prefix + tagged payload."""
+    payload = py_encode_payload(msg, stamp, now)
+    if payload is None:
+        return None
+    return _HDR.pack(len(payload)) + payload
+
+
+class FrameError(ValueError):
+    """Malformed 0x03 frame (truncated, bad tag, bad nesting)."""
+
+
+def _py_decode_value(mv: memoryview, pos: int, depth: int):
+    if pos >= len(mv):
+        raise FrameError("truncated frame")
+    tag = mv[pos]
+    pos += 1
+    if tag == 0x4E:          # 'N'
+        return None, pos
+    if tag == 0x54:          # 'T'
+        return True, pos
+    if tag == 0x46:          # 'F'
+        return False, pos
+    if tag == 0x49:          # 'I'
+        if pos + 8 > len(mv):
+            raise FrameError("truncated int")
+        return _I64.unpack_from(mv, pos)[0], pos + 8
+    if tag == 0x44:          # 'D'
+        if pos + 8 > len(mv):
+            raise FrameError("truncated float")
+        return _F64.unpack_from(mv, pos)[0], pos + 8
+    if tag in (0x42, 0x53):  # 'B' / 'S'
+        if pos + 4 > len(mv):
+            raise FrameError("truncated length")
+        (n,) = _U32.unpack_from(mv, pos)
+        pos += 4
+        if pos + n > len(mv):
+            raise FrameError("truncated body")
+        raw = bytes(mv[pos:pos + n])
+        pos += n
+        if tag == 0x53:
+            try:
+                return raw.decode("utf-8"), pos
+            except UnicodeDecodeError as e:
+                raise FrameError(f"bad utf-8: {e}") from None
+        return raw, pos
+    if depth >= MAX_DEPTH:
+        raise FrameError("frame nests too deep")
+    if tag in (0x4C, 0x55):  # 'L' / 'U'
+        if pos + 4 > len(mv):
+            raise FrameError("truncated count")
+        (n,) = _U32.unpack_from(mv, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = _py_decode_value(mv, pos, depth + 1)
+            items.append(item)
+        return (items if tag == 0x4C else tuple(items)), pos
+    if tag == 0x4D:          # 'M'
+        if pos + 4 > len(mv):
+            raise FrameError("truncated count")
+        (n,) = _U32.unpack_from(mv, pos)
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = _py_decode_value(mv, pos, depth + 1)
+            if type(k) is not str and type(k) is not bytes:
+                raise FrameError("map key must be str or bytes")
+            d[k], pos = _py_decode_value(mv, pos, depth + 1)
+        return d, pos
+    raise FrameError(f"unknown value tag {tag:#x}")
+
+
+def py_decode_payload(data) -> dict:
+    """Tagged frame payload (0x03 byte included) → dict.  Always
+    available: a peer with the native codec armed must interoperate
+    with a process running the pure-Python fallback."""
+    mv = memoryview(data)
+    if len(mv) < 1 or mv[0] != 0x03:
+        raise FrameError("not an rt-frames payload")
+    obj, pos = _py_decode_value(mv, 1, 0)
+    if pos != len(mv):
+        raise FrameError(f"{len(mv) - pos} trailing bytes")
+    if type(obj) is not dict:
+        raise FrameError("top-level value must be a map")
+    return obj
+
+
+def _stamp_walk(v, entry: tuple, depth: int) -> bool:
+    """EXACT mirror of the encoders' stamp-fold traversal: pre-order
+    over dict entries in insertion order, descending into dict/list/
+    tuple VALUES before later keys, stamping the first str-keyed
+    ``"fr"`` whose value is an exact list."""
+    if depth >= MAX_DEPTH:
+        return False
+    t = type(v)
+    if t is dict:
+        for k, val in v.items():
+            if k == "fr" and type(k) is str and type(val) is list:
+                val.append(entry)
+                return True
+            if _stamp_walk(val, entry, depth + 1):
+                return True
+        return False
+    if t is list or t is tuple:
+        return any(_stamp_walk(item, entry, depth + 1) for item in v)
+    return False
+
+
+def py_stamp(msg: dict, stage: str, now: Optional[float] = None) -> None:
+    """Python-side mirror of the encoder's stamp fold: append
+    ``(stage, t)`` to the same ``"fr"`` list the native/py encoders
+    would have stamped (first match in their pre-order walk).  Used
+    when a stamped encode falls back to pickle so the stamp is neither
+    lost nor lands on a different list than the native path's."""
+    if type(msg) is dict:
+        _stamp_walk(msg, (stage, time.monotonic() if now is None else now),
+                    0)
+
+
+autoenable_from_env()

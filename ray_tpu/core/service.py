@@ -426,13 +426,17 @@ class EventLoopService:
         else:
             self._write_out(rec)
 
-    def _push(self, rec: ClientRec, msg: dict) -> None:
+    def _push(self, rec: ClientRec, msg: dict,
+              stamp: Optional[str] = None) -> None:
         if rec.closed:
             return
         if rec.lane is not None:
+            if stamp is not None:
+                from ray_tpu.core.rt_frames import py_stamp
+                py_stamp(msg, stamp)
             rec.lane._deliver(msg)
             return
-        rec.wbuf += dumps_frame(msg, rec.encoding)
+        rec.wbuf += dumps_frame(msg, rec.encoding, stamp)
         if threading.current_thread() is self._thread:
             # loop thread: defer the syscall; _flush_corked sends the
             # whole batch right before the next select

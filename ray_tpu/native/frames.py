@@ -1,0 +1,153 @@
+"""ctypes binding for the native frame codec (native/src/rt_frames.cc).
+
+Two handles to one shared library:
+
+* ``ctypes.PyDLL`` for the codec entry points — they take and return
+  real ``PyObject*``, so the GIL must stay held and one call encodes a
+  whole message with no per-field ctypes overhead (the same in-process
+  trick the shm store uses for its C ABI, minus the GIL release).
+* ``ctypes.CDLL`` for the MPSC ring's push/pending — plain C pointers,
+  so ctypes drops the GIL around the memcpy like any foreign call.
+
+Import of this module must stay side-effect free on failure: the codec
+arming surface (``core/rt_frames.py``) treats any exception here as
+"stay on the pickle path".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+_LIB_NAME = "librt_frames.so"
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+_lock = threading.Lock()
+_libs: Optional[tuple] = None
+
+
+def load_libraries() -> tuple:
+    """(PyDLL, CDLL) over librt_frames.so; raises when absent.
+
+    Unlike the store loader this never builds on demand: arming happens
+    at import time on every process, and a missing .so must mean "use
+    the pure-Python pickle path", not "run the compiler" (the
+    forced-fallback tests depend on exactly that)."""
+    global _libs
+    with _lock:
+        if _libs is not None:
+            return _libs
+        # RAY_TPU_FRAMES_LIB: test hook — point the loader somewhere
+        # else (e.g. a nonexistent path) to exercise the exact
+        # missing-.so fallback without touching the committed library
+        path = os.environ.get("RAY_TPU_FRAMES_LIB") \
+            or os.path.join(_PKG_DIR, _LIB_NAME)
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        pylib = ctypes.PyDLL(path)
+        clib = ctypes.CDLL(path)
+        if clib.rtf_abi_version() != 1:
+            raise RuntimeError("librt_frames.so ABI mismatch")
+        _declare(pylib, clib)
+        _libs = (pylib, clib)
+        return _libs
+
+
+def available() -> bool:
+    try:
+        load_libraries()
+        return True
+    except Exception:
+        return False
+
+
+def _declare(pylib: ctypes.PyDLL, clib: ctypes.CDLL) -> None:
+    pylib.rtf_encode_frame.restype = ctypes.py_object
+    pylib.rtf_encode_frame.argtypes = [ctypes.py_object, ctypes.c_char_p,
+                                       ctypes.c_double]
+    pylib.rtf_decode_payload.restype = ctypes.py_object
+    pylib.rtf_decode_payload.argtypes = [ctypes.py_object]
+    pylib.rtf_ring_drain_py.restype = ctypes.py_object
+    pylib.rtf_ring_drain_py.argtypes = [ctypes.c_void_p]
+
+    clib.rtf_ring_new.restype = ctypes.c_void_p
+    clib.rtf_ring_new.argtypes = [ctypes.c_uint64]
+    clib.rtf_ring_free.argtypes = [ctypes.c_void_p]
+    clib.rtf_ring_push.restype = ctypes.c_int
+    clib.rtf_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64]
+    clib.rtf_ring_pending.restype = ctypes.c_uint64
+    clib.rtf_ring_pending.argtypes = [ctypes.c_void_p]
+    clib.rtf_validate.restype = ctypes.c_int
+    clib.rtf_validate.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+
+
+class NativeRing:
+    """Send-combining MPSC ring: any thread pushes completed frames;
+    whoever holds the owning connection's send lock drains them in one
+    buffer.  Push returns False when the ring is full — the caller then
+    takes its ordinary locked send path (after draining, for order)."""
+
+    def __init__(self, pylib, clib, capacity: int):
+        self._pylib = pylib
+        self._clib = clib
+        self._h = clib.rtf_ring_new(capacity)
+        if not self._h:
+            raise MemoryError("rtf_ring_new failed")
+
+    def push(self, frame: bytes) -> bool:
+        return self._clib.rtf_ring_push(self._h, frame, len(frame)) == 0
+
+    def pending(self) -> int:
+        return self._clib.rtf_ring_pending(self._h)
+
+    def drain(self) -> bytes:
+        return self._pylib.rtf_ring_drain_py(self._h)
+
+    def close(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._clib.rtf_ring_free(h)
+
+    def __del__(self):  # best-effort; close() is the real path
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeFrameCodec:
+    """The armed object behind ``rt_frames._active``."""
+
+    def __init__(self):
+        self._pylib, self._clib = load_libraries()
+        self._enc = self._pylib.rtf_encode_frame
+        self._dec = self._pylib.rtf_decode_payload
+
+    def encode_frame(self, msg: dict, stamp: Optional[str] = None,
+                     now: float = -1.0) -> Optional[bytes]:
+        """dict → complete wire frame (8-byte header + 0x03 payload) in
+        one C call, or None when the message needs pickle.  ``stamp``
+        folds a flight-recorder ``(stage, t_monotonic)`` entry into the
+        first ``"fr"`` list while encoding; ``now < 0`` reads
+        CLOCK_MONOTONIC in C (tests pass a fixed value for parity)."""
+        return self._enc(msg,
+                         stamp.encode() if stamp is not None else None,
+                         now)
+
+    def decode_payload(self, data) -> dict:
+        """Tagged 0x03 payload → dict (raises ValueError when
+        malformed)."""
+        if type(data) is memoryview:
+            # PyBUF_SIMPLE needs C-contiguity; recv buffers always are
+            return self._dec(data)
+        return self._dec(bytes(data) if not isinstance(data, bytes)
+                         else data)
+
+    def validate(self, payload: bytes) -> int:
+        return self._clib.rtf_validate(payload, len(payload))
+
+    def make_ring(self, capacity: int = 1 << 20) -> NativeRing:
+        return NativeRing(self._pylib, self._clib, capacity)

@@ -160,11 +160,13 @@ def test_dispatch_gate_is_single_is_none_check():
     findings = hotpath_pass.run()
     assert findings == [], "\n".join(f.render() for f in findings)
 
-    # the registry really covers what the old test covered...
-    node = HOT_GATES["ray_tpu.core.node"]["functions"]
-    for fn in ("NodeService._dispatch_task", "NodeService._make_runnable",
-               "NodeService._admit_task"):
-        assert node[fn] == "gate", fn
+    # the registry really covers what the old test covered... (the
+    # dispatch path lives in the sched mixin since the round-12 node split)
+    sched = HOT_GATES["ray_tpu.core.node_sched"]["functions"]
+    for fn in ("NodeSchedMixin._dispatch_task",
+               "NodeSchedMixin._make_runnable",
+               "NodeSchedMixin._admit_task"):
+        assert sched[fn] == "gate", fn
     # ...and the fault-injection choke points the old test missed
     assert HOT_GATES["ray_tpu.core.protocol"]["functions"][
         "Connection.send"] == "gate"

@@ -74,7 +74,8 @@ def _edit(root, relfile, old, new):
 _DROPS = [
     ("ray_tpu/core/service.py", "_h_publish", "publish"),
     ("ray_tpu/core/head.py", "_h_heartbeat", "heartbeat"),
-    ("ray_tpu/core/node.py", "_h_task_done", "task_done"),
+    # _h_task_done lives in the sched mixin since the round-12 node split
+    ("ray_tpu/core/node_sched.py", "_h_task_done", "task_done"),
 ]
 
 
@@ -114,7 +115,9 @@ def test_observer_module_is_cross_referenced(real_report, mutated_report):
     # the four protocol modules all contribute handler-side entries
     files = report.handler_files()
     for mod in ("ray_tpu/core/service.py", "ray_tpu/core/head.py",
-                "ray_tpu/core/node.py", "ray_tpu/core/observer.py"):
+                "ray_tpu/core/node.py", "ray_tpu/core/node_sched.py",
+                "ray_tpu/core/node_transfer.py",
+                "ray_tpu/core/observer.py"):
         assert mod in files, mod
     assert any(t == "obs_only" and f == "ray_tpu/core/observer.py"
                for t, f, _ in mutated_report.dead)
@@ -410,3 +413,81 @@ def test_cli_nonzero_on_fixtures_zero_on_repo():
         capture_output=True, text=True, env=env,
         cwd=analysis.repo_root(), timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- round 12: native-codec gating + mixin-split resolution --------------------
+
+def _rtf_module_from(src: str) -> types.ModuleType:
+    mod = types.ModuleType("lint_fix_rtf_mod")
+    mod._rtf = types.SimpleNamespace(_active=None)
+    exec(compile(src, "<lint-fixture>", "exec"), mod.__dict__)
+    return mod
+
+
+# an ungated native-codec call site: crashes (and would silently force
+# every frame through a None deref) the moment the .so is absent
+RTF_UNGATED = """
+def send(msg):
+    return _rtf._active.encode_frame(msg)
+"""
+
+RTF_GATED = """
+def send(msg):
+    codec = _rtf._active
+    if codec is not None:
+        frame = codec.encode_frame(msg)
+        if frame is not None:
+            return frame
+    return None
+"""
+
+
+def test_ungated_native_codec_site_is_a_finding():
+    """The satellite contract for the native dispatch codec: a call
+    site that touches ``_rtf._active`` without the ``is None`` gate is
+    reported exactly like an ungated flight-recorder hook — the pure-
+    Python fallback (missing .so) is only identical behavior if every
+    native entry point stays behind the gate."""
+    bad = hotpath_pass.check_module(
+        "fix.rtf", ("_rtf",), {"send": "gate"},
+        mod=_rtf_module_from(RTF_UNGATED))
+    assert any(f.rule == "fat-disabled-path" for f in bad), \
+        [f.render() for f in bad]
+    good = hotpath_pass.check_module(
+        "fix.rtf", ("_rtf",), {"send": "gate"},
+        mod=_rtf_module_from(RTF_GATED))
+    assert good == [], [f.render() for f in good]
+
+
+def test_real_native_codec_sites_are_registered_and_clean():
+    """The live protocol/node_sched hook sites the codec added are in
+    the registry (so hotpath_pass covers them) and currently clean."""
+    from ray_tpu.analysis.hotpath_registry import HOT_GATES
+    proto = HOT_GATES["ray_tpu.core.protocol"]
+    assert "_rtf" in proto["aliases"]
+    for fn in ("dumps_frame", "decode_payload", "Connection.enable_ring"):
+        assert proto["functions"][fn] == "gate", fn
+    sched = HOT_GATES["ray_tpu.core.node_sched"]
+    assert "_rtf" in sched["aliases"]
+    findings = hotpath_pass.check_module(
+        "ray_tpu.core.protocol", tuple(proto["aliases"]),
+        dict(proto["functions"]),
+        extra_attrs=tuple(proto.get("extra_attrs", ())))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_blocking_pass_resolves_cross_mixin_self_calls():
+    """The node split's safety net: NodeService is composed from
+    stateless mixins, and a sched-mixin method reaching a workers-mixin
+    method through ``self`` must keep resolving (downward fallback
+    through the composed class) — otherwise the split would silently
+    blind the blocking pass to the prefork sendall it has always
+    tracked."""
+    findings = blocking_pass.run()
+    hits = [f for f in findings
+            if f.ident == "blocking:ray_tpu/core/node_workers.py"
+                          ":NodeWorkersMixin._fork_worker:sendall"]
+    assert hits, [f.ident for f in findings]
+    # the chain crosses at least two mixin modules via self dispatch
+    assert "NodeSchedMixin." in hits[0].message
+    assert "NodeWorkersMixin." in hits[0].message
