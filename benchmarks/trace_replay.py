@@ -1,0 +1,490 @@
+"""Trace-replay load harness → SERVE_r13.json.
+
+Replays bursty / diurnal arrival processes against the fleet serving
+layer (admission + occupancy router + autoscaler, serve/fleet/) and
+records the degradation curve — p99 vs offered load — plus the
+autoscaling trace and a full request accounting.  The acceptance
+contract (ISSUE 13):
+
+  * >= 64 total decode slots across replicas at peak under the
+    replayed bursty load (autoscaler must actually fan the fleet out);
+  * an autoscaling trace: replica count responding to occupancy;
+  * p99 for ADMITTED interactive requests held under the declared SLO
+    at nominal load;
+  * zero silently-dropped requests: every offered request ends in
+    exactly one of {completed, shed (429), clean error} — client-side
+    and fleet-side counts must both add up;
+  * same-run A/B vs the r10 single-engine path (one replica, no
+    fleet): the same nominal trace replayed against both, plus the
+    overload level where the unprotected path degrades unboundedly
+    while the fleet sheds to hold p99.
+
+Arrival processes are non-homogeneous Poisson (thinning): ``bursty``
+(square-wave rate: quiet base / duty-cycle peaks) and ``diurnal``
+(sinusoidal day curve compressed to seconds).  Request mix: 70%
+interactive / 30% batch priority classes, 15% on a second model
+variant (exercises multiplexed routing).
+
+loadavg is recorded per phase (PERF.md box-variance caveat: only the
+in-run A/B ratio is portable across days, never the absolutes).
+
+Run:  JAX_PLATFORMS=cpu python benchmarks/trace_replay.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SLO_INTERACTIVE_P99_S = 3.0      # declared: admitted interactive, nominal
+
+
+def _pct(xs, p):
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    i = min(len(xs) - 1, max(0, int(round(p / 100 * (len(xs) - 1)))))
+    return xs[i]
+
+
+# ------------------------------------------------------------- arrivals
+
+
+def bursty_arrivals(rng, *, base, peak, period, duty, duration):
+    """Square-wave rate: ``peak`` for the first ``duty`` fraction of
+    every ``period``, ``base`` otherwise (thinned Poisson)."""
+    def rate(t):
+        return peak if (t % period) < duty * period else base
+    return _thin(rng, rate, max(base, peak), duration)
+
+
+def diurnal_arrivals(rng, *, trough, peak, period, duration):
+    """Sinusoidal "day" compressed to seconds."""
+    def rate(t):
+        return trough + (peak - trough) * 0.5 * (
+            1 - math.cos(2 * math.pi * t / period))
+    return _thin(rng, rate, peak, duration)
+
+
+def _thin(rng, rate_fn, rate_max, duration):
+    out, t = [], 0.0
+    while True:
+        t += rng.exponential(1.0 / rate_max)
+        if t >= duration:
+            return out
+        if rng.random() < rate_fn(t) / rate_max:
+            out.append(t)
+
+
+# --------------------------------------------------------------- driving
+
+
+def _post(addr, payload, timeout):
+    rq = urllib.request.Request(
+        addr + "/v1/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(rq, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def replay(addr, arrivals, reqs, *, timeout=60.0, pool=None):
+    """Fire each request at its arrival offset (pre-spawned worker
+    pool, so arrival pacing never stalls on thread creation); returns
+    (outcomes, wall, pacing_lag_s) — every offered request is accounted
+    exactly once, and the recorded lag proves the client actually
+    offered the intended rate."""
+    from concurrent.futures import ThreadPoolExecutor
+    outcomes = [None] * len(arrivals)
+
+    def fire(i, payload):
+        t0 = time.perf_counter()
+        rec = {"class": payload.get("priority", "batch"),
+               "model": payload.get("model")}
+        try:
+            out = _post(addr, payload, timeout)["result"]
+            rec.update(outcome="completed", latency_s=time.perf_counter()
+                       - t0, n_tokens=out["n"])
+        except urllib.error.HTTPError as e:
+            body = e.read().decode("utf-8", "replace")
+            if e.code == 429:
+                rec.update(outcome="shed",
+                           retry_after=e.headers.get("Retry-After"))
+            else:
+                rec.update(outcome="error", code=e.code,
+                           detail=body[:120])
+        except Exception as e:   # noqa: BLE001 — clean client error
+            rec.update(outcome="error", detail=str(e)[:120])
+        outcomes[i] = rec
+
+    own_pool = pool is None
+    if own_pool:
+        pool = ThreadPoolExecutor(max_workers=512)
+    lag = 0.0
+    try:
+        futs = []
+        t_start = time.perf_counter()
+        for i, (at, payload) in enumerate(zip(arrivals, reqs)):
+            delay = t_start + at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                lag = max(lag, -delay)
+            futs.append(pool.submit(fire, i, payload))
+        for f in futs:
+            f.result(timeout=timeout + 30)
+        wall = time.perf_counter() - t_start
+    finally:
+        if own_pool:
+            pool.shutdown(wait=False)
+    assert all(o is not None for o in outcomes), "silently dropped!"
+    return outcomes, wall, lag
+
+
+def summarize(outcomes, wall, lag=0.0):
+    lat_all = [o["latency_s"] for o in outcomes
+               if o["outcome"] == "completed"]
+    lat_int = [o["latency_s"] for o in outcomes
+               if o["outcome"] == "completed"
+               and o["class"] == "interactive"]
+    counts = {}
+    for o in outcomes:
+        counts[o["outcome"]] = counts.get(o["outcome"], 0) + 1
+    return {
+        "offered": len(outcomes),
+        "completed": counts.get("completed", 0),
+        "shed": counts.get("shed", 0),
+        "errors": counts.get("error", 0),
+        "wall_s": round(wall, 2),
+        "goodput_req_s": round(counts.get("completed", 0) / wall, 2),
+        "p50_s": round(_pct(lat_all, 50), 4),
+        "p99_s": round(_pct(lat_all, 99), 4),
+        "interactive_p99_s": round(_pct(lat_int, 99), 4),
+        "shed_fraction": round(counts.get("shed", 0)
+                               / max(1, len(outcomes)), 3),
+        "pacing_lag_s": round(lag, 3),
+    }
+
+
+def make_requests(rng, n, *, vocab, interactive_frac=0.7,
+                  alt_model_frac=0.15):
+    reqs = []
+    for _ in range(n):
+        pl = int(rng.integers(6, 13))
+        req = {"prompt": rng.integers(0, vocab, pl).tolist(),
+               "max_tokens": int(rng.integers(12, 25)),
+               "priority": ("interactive"
+                            if rng.random() < interactive_frac
+                            else "batch")}
+        if rng.random() < alt_model_frac:
+            req["model"] = "alt"
+        else:
+            req["model"] = "base"
+        reqs.append(req)
+    return reqs
+
+
+class FleetSampler(threading.Thread):
+    """The autoscaling trace: replica count / slots / occupancy /
+    ingress queue sampled on a fixed cadence while traffic replays."""
+
+    def __init__(self, fleet, state, period=0.25):
+        super().__init__(daemon=True)
+        self.fleet, self.state, self.period = fleet, state, period
+        self.rows = []
+        self._halt = threading.Event()   # NB: Thread owns _stop
+        self._t0 = time.perf_counter()
+        self.marks = []      # (t, label) phase boundaries
+
+    def mark(self, label):
+        self.marks.append((round(time.perf_counter() - self._t0, 2),
+                           label))
+
+    def run(self):
+        while not self._halt.wait(self.period):
+            snap = self.fleet.fleet_snapshot()
+            self.rows.append({
+                "t": round(time.perf_counter() - self._t0, 2),
+                "replicas": snap["replicas"],
+                "total_slots": snap["total_slots"],
+                "occupancy": round(snap["occupancy"], 3),
+                "ingress_queued": snap["ingress_queued"],
+                "engine_waiting": snap["engine_waiting"],
+            })
+
+    def stop(self):
+        self._halt.set()
+
+
+# ------------------------------------------------------------------ main
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--events-out", default=None,
+                    help="Fleet.dump_events JSON (feed to `ray_tpu "
+                         "timeline --serve-events`)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu.perf as perf
+    from ray_tpu import serve
+    from ray_tpu.inference import EngineConfig, build_gpt_deployment
+    from ray_tpu.models import gpt
+    from ray_tpu.serve import fleet as fleet_mod
+    from ray_tpu.serve.deployment import AutoscalingConfig
+
+    out_path = args.out or f"SERVE_r{perf.ROUND}.json"
+    # the serve_bench (r10) model size: big enough that the ENGINE, not
+    # the HTTP stack, is the bottleneck — otherwise offered load never
+    # reaches the admission/occupancy machinery under test
+    cfg = gpt.GPTConfig(vocab_size=512, max_seq=64, d_model=128,
+                        n_heads=4, n_layers=4, d_ff=512, remat=False,
+                        dtype=jnp.float32)
+    slots = 16
+    max_replicas = 6
+    rng = np.random.default_rng(13)
+    dur = 6.0 if args.quick else 12.0
+
+    def loadavg():
+        return round(os.getloadavg()[0], 2)
+
+    phases = {}
+
+    # ---- phase 0: the r10 single-engine path (baseline A arm) ----------
+    # one replica, NO fleet layer: round-robin handle + unbounded-ish
+    # engine queue — exactly what PR 5 shipped.
+    load0 = loadavg()
+    dep = build_gpt_deployment(
+        cfg=cfg, engine_cfg=EngineConfig(max_slots=slots), seed=0,
+        num_replicas=1, warm_on_init=True,
+        variants={"base": 0, "alt": 1}, multiplex_capacity=2)
+    serve.run(dep, use_actors=False, http=True)
+    addr = serve.proxy_address()
+
+    # calibrate: closed-loop burst for the single-engine capacity
+    cal_reqs = make_requests(rng, 48, vocab=cfg.vocab_size)
+    done, lock = [], threading.Lock()
+
+    def closed_worker(it):
+        while True:
+            with lock:
+                try:
+                    payload = next(it)
+                except StopIteration:
+                    return
+            t0 = time.perf_counter()
+            try:
+                _post(addr, payload, 60)
+                with lock:
+                    done.append(time.perf_counter() - t0)
+            except Exception:
+                pass
+
+    _post(addr, {"prompt": [1, 2], "max_tokens": 2, "model": "base"}, 60)
+    _post(addr, {"prompt": [1, 2], "max_tokens": 2, "model": "alt"}, 60)
+    it = iter(cal_reqs)
+    t0 = time.perf_counter()
+    ws = [threading.Thread(target=closed_worker, args=(it,))
+          for _ in range(16)]
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    cal_wall = time.perf_counter() - t0
+    capacity = len(done) / cal_wall
+    # nominal ("1x") arrival rate: just under one engine's capacity,
+    # capped so the client pool can hold 4x's in-flight population —
+    # the ADMISSION layer, not the client, must be what says no
+    nominal = max(4.0, min(capacity * 0.8, 25.0))
+    print(f"calibrated single-engine capacity ~{capacity:.1f} req/s "
+          f"-> nominal offered rate {nominal:.1f}/s")
+
+    def bursty_trace(level, seed):
+        r = np.random.default_rng(seed)
+        lam = nominal * level
+        arr = bursty_arrivals(r, base=lam * 0.4, peak=lam * 1.6,
+                              period=4.0, duty=0.5, duration=dur)
+        return arr, make_requests(r, len(arr), vocab=cfg.vocab_size)
+
+    # baseline replays: nominal + overload (same traces the fleet gets)
+    base_phases = {}
+    for level in (1.0, 4.0):
+        arr, reqs = bursty_trace(level, seed=int(level * 100))
+        outcomes, wall, lag = replay(addr, arr, reqs, timeout=60)
+        base_phases[f"{level}x"] = summarize(outcomes, wall, lag)
+        print(f"baseline {level}x: {base_phases[f'{level}x']}")
+    serve.shutdown()
+    load1 = loadavg()
+    phases["baseline_single_engine"] = {
+        "calibration_req_s": round(capacity, 2),
+        "levels": base_phases,
+        "loadavg_1m": [load0, load1],
+        "note": "r10 path: 1 replica, no fleet layer, round-robin "
+                "handle, engine-side queueing only",
+    }
+
+    # ---- phase 1: the fleet (B arm) ------------------------------------
+    load2 = loadavg()
+    dep = build_gpt_deployment(
+        cfg=cfg, engine_cfg=EngineConfig(max_slots=slots), seed=0,
+        num_replicas=1, warm_on_init=True,
+        variants={"base": 0, "alt": 1}, multiplex_capacity=2,
+        max_concurrent_queries=4 * slots,
+        autoscaling=AutoscalingConfig(min_replicas=1,
+                                      max_replicas=max_replicas,
+                                      target_ongoing_requests=6.0))
+    serve.run(dep, use_actors=False, http=True)
+    addr = serve.proxy_address()
+    # admission contract: 2x nominal sustained (the fleet scales to
+    # carry it), one nominal-second of burst absorbed, a bounded queue
+    # — anything past that sheds EXPLICITLY instead of queueing
+    f = fleet_mod.enable("v1", fleet_mod.FleetConfig(
+        rate=nominal * 2.0, burst=nominal,
+        max_queue_depth=int(nominal * 1.5),
+        interactive_wait_s=2.0, batch_wait_s=8.0, seed=13))
+    st = serve.get_handle("v1")._state
+    _post(addr, {"prompt": [1, 2], "max_tokens": 2, "model": "base"}, 60)
+
+    sampler = FleetSampler(f, st)
+    sampler.start()
+    fleet_phases = {}
+    for level in (0.5, 1.0, 2.0, 4.0):
+        sampler.mark(f"level_{level}x")
+        arr, reqs = bursty_trace(level, seed=int(level * 100))
+        outcomes, wall, lag = replay(addr, arr, reqs, timeout=60)
+        fleet_phases[f"{level}x"] = summarize(outcomes, wall, lag)
+        print(f"fleet {level}x: {fleet_phases[f'{level}x']}")
+    # diurnal tail: rate sweeps trough->peak->trough (scale up AND down)
+    sampler.mark("diurnal")
+    r = np.random.default_rng(7)
+    arr = diurnal_arrivals(r, trough=nominal * 0.2, peak=nominal * 2.0,
+                           period=dur, duration=dur)
+    reqs = make_requests(r, len(arr), vocab=cfg.vocab_size)
+    outcomes, wall, lag = replay(addr, arr, reqs, timeout=60)
+    fleet_phases["diurnal"] = summarize(outcomes, wall, lag)
+    print(f"fleet diurnal: {fleet_phases['diurnal']}")
+    sampler.mark("end")
+    time.sleep(1.0)
+    sampler.stop()
+    sampler.join(timeout=5)
+
+    snap = f.fleet_snapshot()
+    events = f.events()
+    if args.events_out:
+        f.dump_events(args.events_out)
+    event_kinds = {}
+    for e in events:
+        event_kinds[e["kind"]] = event_kinds.get(e["kind"], 0) + 1
+    serve.shutdown()
+    load3 = loadavg()
+
+    # ---- assemble + acceptance gates -----------------------------------
+    peak_slots = max((row["total_slots"] for row in sampler.rows),
+                     default=0)
+    peak_replicas = max((row["replicas"] for row in sampler.rows),
+                       default=0)
+    scale_events = [e for e in events if e["kind"] == "scale"]
+    offered_total = sum(p["offered"] for p in fleet_phases.values())
+    accounted = sum(p["completed"] + p["shed"] + p["errors"]
+                    for p in fleet_phases.values())
+    # fleet-side cross-check: everything admitted finished one way
+    fleet_accounted = (snap["admitted"]
+                       == snap["completed"] + snap["errored"]
+                       + snap["cancelled"])
+    nominal_p99 = fleet_phases["1.0x"]["interactive_p99_s"]
+    gates = {
+        "total_slots_ge_64": peak_slots >= 64,
+        "autoscaled": peak_replicas >= 4 and len(scale_events) >= 2,
+        "interactive_p99_slo_met_at_nominal":
+            nominal_p99 <= SLO_INTERACTIVE_P99_S,
+        "zero_silently_dropped": offered_total == accounted,
+        "fleet_accounting_consistent": fleet_accounted,
+    }
+    artifact = {
+        "round": perf.ROUND,
+        "quick": bool(args.quick),
+        "_conditions": {
+            "loadavg_1m": {"baseline": [load0, load1],
+                           "fleet": [load2, load3]},
+            "backend": jax.default_backend(),
+            "physical_cores": os.cpu_count(),
+            "note": "same-run A/B; only ratios are portable across "
+                    "days (PERF.md box-variance caveat)",
+        },
+        "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                  "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
+                  "vocab": cfg.vocab_size, "max_seq": cfg.max_seq},
+        "fleet_config": {
+            "slots_per_replica": slots, "max_replicas": max_replicas,
+            "admission_rate_req_s": round(nominal * 2.0, 1),
+            "queue_depth": int(nominal * 1.5),
+            "variants": ["base", "alt"], "multiplex_capacity": 2,
+            "declared_slo": {"interactive_p99_s": SLO_INTERACTIVE_P99_S,
+                             "at_level": "1.0x"},
+        },
+        "arrival_processes": {
+            "bursty": "square wave, 4s period, 50% duty, peak=1.6x "
+                      "mean, base=0.4x mean",
+            "diurnal": "sinusoid trough 0.2x -> peak 2x nominal over "
+                       f"{dur}s",
+            "nominal_rate_req_s": round(nominal, 1),
+        },
+        "baseline_single_engine": phases["baseline_single_engine"],
+        "fleet": {
+            "degradation_curve": fleet_phases,
+            "peak_total_slots": peak_slots,
+            "peak_replicas": peak_replicas,
+            "scale_events": len(scale_events),
+            "counters": snap,
+            "ingress_event_counts": event_kinds,
+        },
+        "autoscale_trace": {"marks": sampler.marks,
+                            "rows": sampler.rows},
+        "ab_nominal": {
+            "baseline_p99_s": base_phases["1.0x"]["p99_s"],
+            "fleet_p99_s": fleet_phases["1.0x"]["p99_s"],
+            "baseline_goodput": base_phases["1.0x"]["goodput_req_s"],
+            "fleet_goodput": fleet_phases["1.0x"]["goodput_req_s"],
+        },
+        "ab_overload_4x": {
+            "baseline_p99_s": base_phases["4.0x"]["p99_s"],
+            "fleet_p99_s": fleet_phases["4.0x"]["p99_s"],
+            "baseline_goodput": base_phases["4.0x"]["goodput_req_s"],
+            "fleet_goodput": fleet_phases["4.0x"]["goodput_req_s"],
+            "baseline_shed_fraction":
+                base_phases["4.0x"]["shed_fraction"],
+            "fleet_shed_fraction": fleet_phases["4.0x"]["shed_fraction"],
+            "note": "overload: the unprotected path absorbs everything "
+                    "into queueing latency; the fleet sheds the excess "
+                    "(429 + Retry-After) and holds p99 for what it "
+                    "admits",
+        },
+        "acceptance": gates,
+    }
+    out = json.dumps(artifact, indent=1)
+    print(out)
+    with open(out_path, "w") as fo:
+        fo.write(out + "\n")
+    ok = all(gates.values())
+    print("\nacceptance: " + ", ".join(
+        f"{k}={'PASS' if v else 'FAIL'}" for k, v in gates.items()))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,4 +116,17 @@ HOT_GATES: dict = {
             "HeadService.__init__": "cold",
         },
     },
+    # serve fleet ingress: chaos hooks (serve_route / per-stream-chunk
+    # serve_stream) and flight-recorder event notes sit on the serving
+    # request path — same zero-overhead promise as the control plane:
+    # disarmed, each site is one global load + is-None branch.  Both
+    # hooks are concentrated in two helper methods so every other fleet
+    # function stays alias-free.
+    "ray_tpu.serve.fleet.ingress": {
+        "aliases": ("_fi", "_fr"),
+        "functions": {
+            "Fleet.note": "gate",          # _fr event copy when armed
+            "Fleet._chaos": "gate",        # _fi serve_* trigger points
+        },
+    },
 }

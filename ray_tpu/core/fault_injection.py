@@ -399,6 +399,29 @@ class FaultPlan:
                 r.fn(svc, rec, msg)
         return drop
 
+    def on_serve(self, point: str, ctx: dict) -> None:
+        """Scripted triggers in the serve fleet path (points:
+        ``serve_route`` — after the router picks a replica;
+        ``serve_stream`` — per streamed chunk).  ``ctx`` carries
+        {"fleet", "replica", ...}; a scripted ``fn(ctx)`` can e.g. kill
+        the routed replica mid-stream (fleet.kill_replica) to prove the
+        request resumes elsewhere or fails cleanly — never hangs."""
+        fire = []
+        with self._lock:
+            for r in self.rules:
+                if r.point != point:
+                    continue
+                if r.where is not None and not r.where(point, ctx):
+                    continue
+                if not r.decide(self, point, ctx):
+                    continue
+                self._note(point, r.action,
+                           getattr(ctx.get("replica"), "tag", None))
+                fire.append(r)
+        for r in fire:   # outside the lock: fn may re-enter hooks
+            if r.fn is not None:
+                r.fn(ctx)
+
     def on_service_tick(self, svc) -> None:
         fire = []
         with self._lock:

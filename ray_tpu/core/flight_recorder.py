@@ -125,7 +125,8 @@ class FlightRecorder:
 
     def __init__(self, keep_records: int = 4096,
                  keep_samples: int = 20_000,
-                 keep_faults: int = 4096):
+                 keep_faults: int = 4096,
+                 keep_ingress: int = 8192):
         self.anchor_wall = time.time()
         self.anchor_mono = time.monotonic()
         self._lock = threading.Lock()
@@ -133,6 +134,7 @@ class FlightRecorder:
         self.samples: Dict[str, deque] = {}
         self.records: deque = deque(maxlen=keep_records)
         self.faults: deque = deque(maxlen=keep_faults)
+        self.ingress: deque = deque(maxlen=keep_ingress)
         self._keep_samples = keep_samples
 
     # ------------------------------------------------------------ stamping
@@ -205,6 +207,14 @@ class FlightRecorder:
             self.faults.append({"t": time.time(), "point": point,
                                 "action": action, "detail": repr(detail)})
 
+    def note_ingress(self, event: dict) -> None:
+        """Serve-fleet ingress event (admit/shed/route/resume/scale —
+        serve/fleet/ingress.py) for the merged timeline, so admission
+        decisions show up next to the task stages and chaos events they
+        interleave with."""
+        with self._lock:
+            self.ingress.append(dict(event))
+
     def reset(self) -> None:
         """Drop aggregates (between benchmark phases)."""
         with self._lock:
@@ -212,6 +222,7 @@ class FlightRecorder:
             self.samples.clear()
             self.records.clear()
             self.faults.clear()
+            self.ingress.clear()
 
     # ------------------------------------------------------------- reading
 
@@ -241,6 +252,10 @@ class FlightRecorder:
     def export_faults(self) -> list:
         with self._lock:   # note_fault appends from other threads
             return list(self.faults)
+
+    def export_ingress(self) -> list:
+        with self._lock:   # note_ingress appends from serving threads
+            return list(self.ingress)
 
     def metrics_snapshot(self) -> Dict[tuple, dict]:
         """{((label_key, label_val),): histogram_snapshot} for the

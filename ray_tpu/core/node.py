@@ -751,16 +751,18 @@ class NodeService(NodeWorkersMixin, NodeTransferMixin, NodeSchedMixin,
 
     def _h_flight_recorder(self, rec, m):
         """Observer query: completed lifecycle records + chaos events +
-        the per-stage summary (the `ray_tpu timeline` source)."""
+        serve-ingress events + the per-stage summary (the `ray_tpu
+        timeline` source)."""
         fr = _fr._active
         if fr is None:
             self._reply(rec, m["reqid"], enabled=False, records=[],
-                        faults=[], stages={})
+                        faults=[], ingress=[], stages={})
             return
         self._reply(rec, m["reqid"], enabled=True,
                     records=fr.export_records(
                         limit=int(m.get("limit", 2000))),
                     faults=fr.export_faults(),
+                    ingress=fr.export_ingress(),
                     stages=fr.stage_summary())
 
     def _h_state(self, rec, m):

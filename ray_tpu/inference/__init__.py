@@ -32,14 +32,17 @@ from __future__ import annotations
 
 from ray_tpu.inference.cache import KVCacheManager
 from ray_tpu.inference.decode import make_decode_step, make_prefill_fn
-from ray_tpu.inference.engine import (EngineConfig, GenerationRequest,
-                                      InferenceEngine, metrics_snapshot)
+from ray_tpu.inference.engine import (PRIORITY_BATCH, PRIORITY_INTERACTIVE,
+                                      EngineConfig, EngineStoppedError,
+                                      GenerationRequest, InferenceEngine,
+                                      metrics_snapshot)
 from ray_tpu.inference.serving import (GPTServer, build_gpt_deployment,
                                        encode_prompt, parse_stream_chunks)
 
 __all__ = [
     "KVCacheManager", "make_decode_step", "make_prefill_fn",
-    "EngineConfig", "GenerationRequest", "InferenceEngine",
+    "EngineConfig", "EngineStoppedError", "GenerationRequest",
+    "InferenceEngine", "PRIORITY_BATCH", "PRIORITY_INTERACTIVE",
     "metrics_snapshot", "GPTServer", "build_gpt_deployment",
     "encode_prompt", "parse_stream_chunks",
 ]

@@ -1,7 +1,10 @@
 """Incremental (KV-cache) decode for the GPT: prefill + one-token step.
 
 Two compiled programs, both with STATIC shapes so each compiles exactly
-once per engine regardless of request mix:
+once regardless of request mix — and (no-mesh path) once per (config,
+rules) across ALL engines, so a fleet scaling out replicas or
+multiplexing model variants reuses the compiled pair instead of paying
+a per-engine recompile:
 
   * prefill — the ordinary training forward with ``return_kv=True``
     (models/gpt.py) over the prompt padded to the cache width.  Same
@@ -36,6 +39,25 @@ from ray_tpu.models.gpt import GPTConfig
 from ray_tpu.ops.attention import attention
 from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
 
+# engines with the same (cfg, rules) on the default (no-mesh) path share
+# ONE jitted prefill/step pair: the compiled programs are stateless
+# (params/cache are arguments; donation is per-call), and a fleet of N
+# replicas x M model variants would otherwise pay N*M identical
+# compilations — a multi-second head-of-line stall every time the
+# autoscaler grows or the multiplexer loads a variant.  Meshed engines
+# skip the cache (mesh identity isn't a safe dict key across tests).
+_FN_CACHE: dict = {}
+
+
+def _cached(kind: str, cfg: GPTConfig, mesh, rules, build):
+    if mesh is not None:
+        return build()
+    key = (kind, cfg, rules if isinstance(rules, tuple) else id(rules))
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        fn = _FN_CACHE[key] = build()
+    return fn
+
 
 def make_prefill_fn(cfg: GPTConfig, *, mesh=None,
                     rules: Rules = DEFAULT_LLM_RULES):
@@ -46,13 +68,15 @@ def make_prefill_fn(cfg: GPTConfig, *, mesh=None,
             "the inference engine has no MoE decode path yet "
             "(expert dispatch per cached token)")
 
-    @jax.jit
-    def prefill(params, tokens):
-        logits, (k, v) = gpt.forward(params, tokens, cfg, mesh=mesh,
-                                     rules=rules, return_kv=True)
-        return logits, k, v
+    def build():
+        @jax.jit
+        def prefill(params, tokens):
+            logits, (k, v) = gpt.forward(params, tokens, cfg, mesh=mesh,
+                                         rules=rules, return_kv=True)
+            return logits, k, v
+        return prefill
 
-    return prefill
+    return _cached("prefill", cfg, mesh, rules, build)
 
 
 def make_decode_step(cfg: GPTConfig, *, mesh=None,
@@ -74,6 +98,13 @@ def make_decode_step(cfg: GPTConfig, *, mesh=None,
             "(expert dispatch per cached token)")
     h, hd = cfg.n_heads, cfg.head_dim
 
+    def build():
+        return _make_step(cfg, mesh, rules, h, hd)
+
+    return _cached("step", cfg, mesh, rules, build)
+
+
+def _make_step(cfg, mesh, rules, h, hd):
     @partial(jax.jit, donate_argnums=(1, 2))
     def step(params, k_cache, v_cache, tokens, positions, active):
         b = tokens.shape[0]
@@ -120,3 +151,9 @@ def make_decode_step(cfg: GPTConfig, *, mesh=None,
         return logits, k_cache, v_cache
 
     return step
+
+
+def clear_fn_cache() -> None:
+    """Drop the shared compiled-function cache (tests / benchmarks that
+    want cold-compile timings)."""
+    _FN_CACHE.clear()

@@ -58,16 +58,34 @@ class EngineConfig:
     idle_wait_s: float = 0.05            # loop park interval when empty
 
 
+# priority classes + the replica-death base error live in the jax-free
+# serve.qos module (the fleet's generic machinery imports them from
+# there); re-exported here for the engine's own API surface.
+from ray_tpu.serve.qos import (PRIORITY_BATCH,           # noqa: F401
+                               PRIORITY_INTERACTIVE, ReplicaDeadError,
+                               parse_priority)
+
+
+class EngineStoppedError(ReplicaDeadError):
+    """The engine was shut down (replica teardown / chaos kill) with
+    this request queued or mid-decode.  A typed subclass so the fleet
+    layer can tell a dead replica (retry elsewhere — the generation is
+    deterministic from the request) from a request-specific failure
+    (do not retry)."""
+
+
 class GenerationRequest:
     """One in-flight generation: a mailbox the engine appends tokens to
     and consumers drain via ``stream()`` / ``result()``."""
 
     def __init__(self, req_id: int, prompt: np.ndarray, max_new: int,
-                 temperature: float, rng: Optional[jax.Array]):
+                 temperature: float, rng: Optional[jax.Array],
+                 priority: int = PRIORITY_BATCH):
         self.id = req_id
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
+        self.priority = priority
         self._rng = rng
         self.tokens: list[int] = []
         self.done = False
@@ -195,8 +213,13 @@ class InferenceEngine:
     def __init__(self, params, cfg: GPTConfig,
                  engine_cfg: Optional[EngineConfig] = None, *,
                  mesh=None, rules: Rules = DEFAULT_LLM_RULES,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 labels: Optional[dict] = None):
         self.cfg = cfg
+        # extra label pairs on this engine's /metrics series (the serve
+        # layer sets deployment/replica/model so multi-replica fleets
+        # don't collapse into one ambiguous series)
+        self.labels = dict(labels) if labels else {}
         self.engine_cfg = engine_cfg or EngineConfig()
         ec = self.engine_cfg
         self.params = params
@@ -239,9 +262,12 @@ class InferenceEngine:
     def submit(self, prompt: Sequence[int], *,
                max_new: Optional[int] = None,
                temperature: float = 0.0,
-               seed: int = 0) -> GenerationRequest:
+               seed: int = 0,
+               priority: int = PRIORITY_BATCH) -> GenerationRequest:
         """Queue a generation; returns immediately with the request
-        mailbox.  Admission happens at the next prefill boundary."""
+        mailbox.  Admission happens at the next prefill boundary, in
+        (priority, arrival) order — an interactive waiter takes a freed
+        slot ahead of batch waiters that arrived earlier."""
         ec = self.engine_cfg
         prompt = np.asarray(list(prompt), np.int32)
         max_new = int(max_new if max_new is not None else ec.default_max_new)
@@ -259,10 +285,11 @@ class InferenceEngine:
                 f"exceeds the cache width {self.cache.max_seq}")
         rng = (jax.random.PRNGKey(seed) if temperature > 0.0 else None)
         req = GenerationRequest(next(self._req_seq), prompt, max_new,
-                                float(temperature), rng)
+                                float(temperature), rng,
+                                priority=int(priority))
         with self._cond:
             if self._stopped:
-                raise RuntimeError("engine is shut down")
+                raise EngineStoppedError("engine is shut down")
             if len(self._waiting) >= ec.max_waiting:
                 raise RuntimeError(
                     f"engine admission queue full ({ec.max_waiting})")
@@ -307,6 +334,11 @@ class InferenceEngine:
                     live.append(r)
             self._waiting = live
             admits = []
+            if self._waiting and self.cache.n_free > 0:
+                # prefill-boundary preemption: freed slots go to the
+                # most urgent class first (stable within a class — the
+                # sort key is (priority, submit id))
+                self._waiting.sort(key=lambda r: (r.priority, r.id))
             while self._waiting and self.cache.n_free > 0:
                 req = self._waiting.pop(0)
                 admits.append((self.cache.alloc(), req))
@@ -335,7 +367,7 @@ class InferenceEngine:
             pending = list(self._slot_req.values()) + self._waiting
             self._slot_req.clear()
             self._waiting.clear()
-        err = RuntimeError("engine shut down")
+        err = EngineStoppedError("engine shut down")
         for r in pending:
             if not r.done:
                 r._finish(err)
@@ -434,6 +466,9 @@ class InferenceEngine:
     def stats(self) -> dict:
         with self._cond:
             waiting = len(self._waiting)
+            interactive = sum(1 for r in self._waiting
+                              if r.priority <= PRIORITY_INTERACTIVE)
+            stopped = self._stopped
         with self._mlock:
             iters = self._decode_iterations
             occ = (self._occupancy_sum / iters) if iters else 0.0
@@ -445,6 +480,8 @@ class InferenceEngine:
             "free_slots": cache["free_slots"],
             "max_slots": self.engine_cfg.max_slots,
             "waiting_requests": waiting,
+            "waiting_interactive": interactive,
+            "stopped": stopped,
             "batch_occupancy": occ,
             "generated_tokens": generated,
             "requests_completed": completed,
@@ -468,7 +505,10 @@ def metrics_snapshot() -> list:
     active, waiting, occ, gen, comp = {}, {}, {}, {}, {}
     for name, eng in sorted(engines.items()):
         st = eng.stats()
-        key = (("engine", name),)
+        # per-replica/per-model labels (serve fleet sets them) keep a
+        # multi-replica fleet from collapsing into one ambiguous series
+        key = ((("engine", name),)
+               + tuple(sorted(eng.labels.items())))
         active[key] = float(st["active_slots"])
         waiting[key] = float(st["waiting_requests"])
         occ[key] = float(st["batch_occupancy"])

@@ -17,7 +17,10 @@ deployment is named "v1"):
      "max_tokens": 16,                 # default engine_cfg.default_max_new
      "temperature": 0.0,               # 0 = greedy
      "seed": 0,
-     "stream": false}
+     "stream": false,
+     "priority": "interactive",        # or "batch" (default): engine
+                                       #   admission + ingress queue class
+     "model": "variant-id"}            # multiplexed deployments only
 
 Non-streaming replies {"tokens": [...], "n": n, "ttft_s": ..., ...};
 ``stream: true`` returns a generator the asyncio proxy flushes as
@@ -32,7 +35,8 @@ from typing import Optional, Sequence, Union
 
 import jax
 
-from ray_tpu.inference.engine import EngineConfig, InferenceEngine
+from ray_tpu.inference.engine import (EngineConfig, EngineStoppedError,
+                                      InferenceEngine, parse_priority)
 from ray_tpu.models import gpt
 from ray_tpu.models.gpt import GPTConfig
 from ray_tpu.serve.deployment import (AutoscalingConfig, Deployment,
@@ -54,23 +58,88 @@ def encode_prompt(prompt: Union[str, Sequence[int]],
 
 
 class GPTServer:
-    """Replica body: one engine per replica.
+    """Replica body: one engine per replica — or, with ``variants``, an
+    LRU of per-variant engines (model multiplexing behind one
+    deployment).
 
     Params are derived from ``seed`` at replica init (deterministic
     across replicas, so any replica answers any request identically
-    under greedy decoding), or passed in directly for in-process use.
+    under greedy decoding — the property the fleet's
+    resume-on-replica-death replay relies on), or passed in directly
+    for in-process use.  When built under the serve controller the
+    replica tag names the engine(s) and labels their /metrics series.
     """
 
     def __init__(self, cfg: Optional[GPTConfig] = None,
                  engine_cfg: Optional[EngineConfig] = None,
                  seed: int = 0, params=None,
-                 engine_name: Optional[str] = None):
+                 engine_name: Optional[str] = None,
+                 variants: Optional[dict] = None,
+                 multiplex_capacity: int = 2,
+                 warm_on_init: bool = False):
         self.cfg = cfg or GPTConfig.tiny()
+        self.engine_cfg = engine_cfg or EngineConfig()
+        self._warm = warm_on_init
+        self._closed = False
+        from ray_tpu.serve.controller import get_replica_context
+        ctx = get_replica_context()
+        self.replica_tag = (ctx.replica_tag if ctx is not None
+                            else (engine_name or ""))
+        self._labels = ({"deployment": ctx.deployment,
+                         "replica": ctx.replica_tag}
+                        if ctx is not None else {})
+        self._mux = None
+        self.engine = None
+        if variants and params is not None:
+            raise ValueError(
+                "params and variants are mutually exclusive: each "
+                "variant derives its own params from its catalog seed")
+        if variants:
+            # model multiplexing: model_id -> seed (each variant is an
+            # independently seeded param set + engine/KV pool);
+            # LRU-resident per replica, the fleet router prefers
+            # replicas already holding the requested variant
+            from ray_tpu.serve.fleet.multiplex import ModelMultiplexer
+            self._mux = ModelMultiplexer(
+                variants,
+                lambda mid, spec: self._build_engine(mid, int(spec)),
+                lambda eng: eng.shutdown(timeout=2.0),
+                capacity=multiplex_capacity)
+            # default variant resident from birth; a WARM replica
+            # preloads a full working set so scale-up cost stays in the
+            # controller, not head-of-line on the first requests
+            preload = (list(variants)[:multiplex_capacity]
+                       if warm_on_init else [None])
+            for mid in preload:
+                self._mux.get(mid)
+        else:
+            self.engine = self._build_engine(None, seed, params=params,
+                                             name_override=engine_name)
+
+    def _build_engine(self, model_id: Optional[str], seed: int,
+                      params=None, name_override=None) -> InferenceEngine:
         if params is None:
             params = gpt.init_params(self.cfg, jax.random.PRNGKey(seed))
-        self.engine = InferenceEngine(params, self.cfg,
-                                      engine_cfg or EngineConfig(),
-                                      name=engine_name)
+        name = name_override
+        if name is None and self.replica_tag:
+            name = self.replica_tag + (f":{model_id}" if model_id else "")
+        labels = dict(self._labels)
+        if model_id:
+            labels["model"] = model_id
+        eng = InferenceEngine(params, self.cfg, self.engine_cfg,
+                              name=name, labels=labels)
+        if self._warm:
+            # compile prefill+decode off the request path, so a freshly
+            # scaled-up replica doesn't serve its first requests cold
+            eng.generate([1], max_new=2, timeout=300)
+        return eng
+
+    def _engine_for(self, req: dict) -> InferenceEngine:
+        if self._closed:
+            raise EngineStoppedError("replica closed")
+        if self._mux is None:
+            return self.engine
+        return self._mux.get(req.get("model"))
 
     def __call__(self, req):
         if not isinstance(req, dict):
@@ -80,11 +149,12 @@ class GPTServer:
         if "prompt" not in req:
             raise ValueError('missing required field "prompt"')
         prompt = encode_prompt(req["prompt"], self.cfg.vocab_size)
-        handle = self.engine.submit(
+        handle = self._engine_for(req).submit(
             prompt,
             max_new=req.get("max_tokens"),
             temperature=float(req.get("temperature", 0.0)),
-            seed=int(req.get("seed", 0)))
+            seed=int(req.get("seed", 0)),
+            priority=parse_priority(req.get("priority")))
         if req.get("stream"):
             return self._stream(handle)
         try:
@@ -119,22 +189,59 @@ class GPTServer:
                     handle.cancel()
         return gen()
 
+    def _engines(self) -> list:
+        if self._mux is not None:
+            return self._mux.loaded_bodies()
+        return [self.engine] if self.engine is not None else []
+
     # surfaced for tests / the metrics endpoint via the engine registry
     def engine_stats(self):
+        if self._mux is not None:
+            raise RuntimeError("multiplexed replica: use fleet_stats()")
         return self.engine.stats()
 
+    def fleet_stats(self) -> dict:
+        """The router's probe surface: engine load + loaded variants.
+        Multiplexed replicas aggregate over resident engines (total
+        slots grow with residency — the router sees real capacity)."""
+        engines = self._engines()
+        stats = [e.stats() for e in engines]
+        return {
+            "max_slots": sum(s["max_slots"] for s in stats),
+            "active_slots": sum(s["active_slots"] for s in stats),
+            "waiting_requests": sum(s["waiting_requests"] for s in stats),
+            "waiting_interactive": sum(s["waiting_interactive"]
+                                       for s in stats),
+            "models": (self._mux.loaded_models()
+                       if self._mux is not None else []),
+            "stopped": self._closed or not engines
+            or all(s["stopped"] for s in stats),
+        }
+
+    def loaded_variants(self) -> list:
+        return self._mux.loaded_models() if self._mux is not None else []
+
+    def multiplex_stats(self) -> Optional[dict]:
+        return self._mux.stats() if self._mux is not None else None
+
     def health(self):
-        return True
+        st = self.fleet_stats()
+        return not st["stopped"]
 
     def teardown(self):
         """Replica teardown hook (DeploymentState.scale_to): stop the
-        engine loop so a scaled-down replica releases its KV pool and
-        thread instead of leaking them."""
-        self.engine.shutdown(timeout=2.0)
+        engine loop(s) so a scaled-down replica releases its KV pool
+        and thread instead of leaking them."""
+        self._closed = True
+        if self._mux is not None:
+            self._mux.unload_all()
+        elif self.engine is not None:
+            self.engine.shutdown(timeout=2.0)
 
     def __del__(self):   # best-effort: teardown() is the real path
         try:
-            self.engine.shutdown(timeout=0.5)
+            for eng in self._engines():
+                eng.shutdown(timeout=0.5)
         except Exception:
             pass
 
@@ -146,14 +253,21 @@ def build_gpt_deployment(*, name: str = DEFAULT_ROUTE,
                          num_replicas: int = 1,
                          max_concurrent_queries: int = 64,
                          autoscaling: Optional[AutoscalingConfig] = None,
-                         params=None) -> Deployment:
+                         params=None,
+                         variants: Optional[dict] = None,
+                         multiplex_capacity: int = 2,
+                         warm_on_init: bool = False) -> Deployment:
     """A ready-to-``serve.run`` deployment wrapping GPTServer.  Route is
     /<name>/... — the default name "v1" makes POST /v1/generate work.
 
     Pass ``autoscaling`` (e.g. AutoscalingConfig(min_replicas=1,
     max_replicas=4, target_ongoing_requests=max_slots)) to scale the
     replica set on queue depth; each new replica brings its own engine
-    and cache pool.
+    and cache pool.  ``variants`` ({model_id: seed}) turns each replica
+    into a model-multiplexed server: at most ``multiplex_capacity``
+    variants resident per replica, LRU-evicted; requests pick one with
+    the ``model`` field.  ``warm_on_init`` compiles prefill+decode at
+    replica construction so scale-ups don't serve cold.
     """
     return Deployment(
         GPTServer,
@@ -162,7 +276,9 @@ def build_gpt_deployment(*, name: str = DEFAULT_ROUTE,
                           autoscaling=autoscaling),
         init_args=(),
         init_kwargs=dict(cfg=cfg, engine_cfg=engine_cfg, seed=seed,
-                         params=params))
+                         params=params, variants=variants,
+                         multiplex_capacity=multiplex_capacity,
+                         warm_on_init=warm_on_init))
 
 
 def parse_stream_chunks(raw: bytes) -> list[dict]:

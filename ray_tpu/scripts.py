@@ -155,18 +155,30 @@ def cmd_timeline(args) -> int:
     if trace_dir:
         from ray_tpu.util.tracing import collect_spans
         spans = collect_spans(trace_dir)
+    # serve-fleet ingress events: from the armed flight recorder, plus
+    # any Fleet.dump_events file (ingress processes that ran without a
+    # recorder — e.g. the trace-replay harness)
+    ingress = list(fr.get("ingress", []))
+    serve_events = getattr(args, "serve_events", None)
+    if serve_events:
+        with open(serve_events) as f:
+            ingress += json.load(f)
     from ray_tpu.util.timeline import build_trace
     trace = build_trace(task_events=events,
                         records=fr.get("records", []),
                         spans=spans,
-                        faults=fr.get("faults", []))
+                        faults=fr.get("faults", []),
+                        ingress=ingress)
     out = args.output or f"timeline-{int(time.time())}.json"
     with open(out, "w") as f:
         json.dump(trace, f)
     n = len(trace["traceEvents"])
     lifecycle = sum(1 for e in trace["traceEvents"]
                     if e.get("cat") == "lifecycle")
-    print(f"wrote {n} events ({lifecycle} lifecycle stage slices) to "
+    n_ingress = sum(1 for e in trace["traceEvents"]
+                    if e.get("cat") == "ingress")
+    print(f"wrote {n} events ({lifecycle} lifecycle stage slices, "
+          f"{n_ingress} ingress events) to "
           f"{out} (open in chrome://tracing or ui.perfetto.dev)"
           + ("" if fr.get("enabled") else
              "; flight recorder disabled — set "
@@ -466,11 +478,15 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("timeline",
                        help="merged Perfetto trace: task events + "
-                            "flight-recorder stages + spans + chaos")
+                            "flight-recorder stages + spans + chaos + "
+                            "serve-fleet ingress events")
     p.add_argument("--address", required=True)
     p.add_argument("-o", "--output", default=None)
     p.add_argument("--trace-dir", default=None,
                    help="RAY_TPU_TRACE_DIR to merge span files from")
+    p.add_argument("--serve-events", default=None,
+                   help="Fleet.dump_events JSON to merge ingress "
+                        "admission/shed/route events from")
     p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("stack", help="dump live worker thread stacks "

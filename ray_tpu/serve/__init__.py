@@ -7,7 +7,8 @@ from typing import Optional, Union
 
 from ray_tpu.serve.asgi import ingress
 from ray_tpu.serve.batching import batch
-from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.controller import (ReplicaContext, ServeController,
+                                      get_replica_context)
 from ray_tpu.serve.deployment import (AutoscalingConfig, Deployment,
                                       DeploymentOptions, deployment)
 from ray_tpu.serve.handle import (DeploymentHandle, RemoteDeploymentHandle,
@@ -116,6 +117,14 @@ def metrics_snapshot() -> list:
             out += inference.metrics_snapshot()
         except Exception:
             pass
+    # fleet ingress counters, one series per fleet-enabled deployment
+    # (same laziness: only when the fleet layer has been imported)
+    fleet_mod = sys.modules.get("ray_tpu.serve.fleet")
+    if fleet_mod is not None:
+        try:
+            out += fleet_mod.metrics_snapshot()
+        except Exception:
+            pass
     return out
 
 
@@ -140,7 +149,8 @@ def shutdown() -> None:
 __all__ = [
     "deployment", "Deployment", "DeploymentOptions", "AutoscalingConfig",
     "DeploymentHandle", "RemoteDeploymentHandle", "ServeResponse",
-    "ServeController", "HttpProxy", "ingress", "batch", "run",
+    "ServeController", "ReplicaContext", "get_replica_context",
+    "HttpProxy", "ingress", "batch", "run",
     "get_handle", "delete", "shutdown", "status", "proxy_address",
 ]
 

@@ -84,12 +84,24 @@ def ingress(asgi_app, *, name: Optional[str] = None,
     return dep
 
 
+def _shed_retry_after(e: BaseException):
+    """Seconds from a fleet ShedError (duck-typed so this module never
+    imports the fleet/inference stack), else None."""
+    ra = getattr(e, "retry_after_s", None)
+    try:
+        return float(ra) if ra is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
 class AsyncHttpProxy:
     """Concurrent HTTP/1.1 ingress on an asyncio loop thread.
 
     Each connection is an asyncio task; replica calls run on the default
     executor so slow handlers never stall the accept loop.  Iterator /
-    generator results stream as chunked transfer-encoding."""
+    generator results stream as chunked transfer-encoding.  Fleet-shed
+    requests (admission refusal) come back as ``429`` with a
+    ``Retry-After`` header instead of a generic 500."""
 
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
         self.controller = controller
@@ -102,10 +114,14 @@ class AsyncHttpProxy:
         self._thread: Optional[threading.Thread] = None
         # dedicated, sized pool for blocking replica calls: the loop's
         # default executor is shared and small, which would head-of-line
-        # block unrelated requests behind slow handlers
+        # block unrelated requests behind slow handlers.  Sized for
+        # fleet-scale ingress: each in-flight request holds one worker
+        # for its full latency, and admission (not this pool) must be
+        # what says no — a too-small pool is an invisible unbounded
+        # queue in FRONT of the admission controller
         from concurrent.futures import ThreadPoolExecutor
         self._executor = ThreadPoolExecutor(
-            max_workers=128, thread_name_prefix="raytpu-serve-call")
+            max_workers=256, thread_name_prefix="raytpu-serve-call")
         # long-polled route table: never touch controller state per
         # request (reference: proxy LongPollClient on route updates)
         self._routes: set[str] = set(controller.deployments.keys())
@@ -263,6 +279,15 @@ class AsyncHttpProxy:
             arg = json.loads(body) if body else None
         except json.JSONDecodeError:
             arg = body.decode("utf-8", "replace")
+        if isinstance(arg, dict) and getattr(state, "fleet", None) \
+                is not None:
+            # fleet envelope fields may ride headers (curl-friendly);
+            # the JSON body wins when both are present
+            for header, field in (("x-priority", "priority"),
+                                  ("x-model", "model")):
+                v = headers.get(header)
+                if v is not None:
+                    arg.setdefault(field, v)
         from ray_tpu.serve.handle import DeploymentHandle
         handle = DeploymentHandle(state)
         try:
@@ -270,6 +295,17 @@ class AsyncHttpProxy:
                 self._executor,
                 lambda: handle.remote(arg).result(timeout=120))
         except Exception as e:
+            retry_after = _shed_retry_after(e)
+            if retry_after is not None:
+                # admission refusal: explicit load shedding, not a
+                # server fault — tell the client when to come back
+                import math
+                await self._respond_json(
+                    writer, 429, {"error": str(e),
+                                  "retry_after_s": retry_after},
+                    extra_headers=[("Retry-After",
+                                    str(max(1, math.ceil(retry_after))))])
+                return True
             await self._respond_json(writer, 500, {"error": str(e)})
             return True
         if hasattr(out, "__next__") or hasattr(out, "__anext__"):
@@ -282,16 +318,34 @@ class AsyncHttpProxy:
                 # corrupt the chunked framing, so close WITHOUT the
                 # terminating 0-chunk — truncation is the error signal
                 pass
+            finally:
+                # ALWAYS close the result generator: an abandoned
+                # consumer (client disconnect mid-stream) must propagate
+                # GeneratorExit into the replica body so the engine
+                # request is cancelled and its slot freed — GC timing is
+                # not a cancellation policy.  Async generators expose
+                # aclose(), not close().
+                aclose = getattr(out, "aclose", None)
+                close = getattr(out, "close", None)
+                try:
+                    if aclose is not None:
+                        await aclose()
+                    elif close is not None:
+                        await loop.run_in_executor(self._executor, close)
+                except Exception:
+                    pass
             return False   # chunked stream ends the connection
         await self._respond_json(writer, 200, {"result": _jsonable(out)})
         return True
 
     # ------------------------------------------------------------ responses
 
-    async def _respond_json(self, writer, status: int, payload) -> None:
+    async def _respond_json(self, writer, status: int, payload,
+                            extra_headers=()) -> None:
         body = json.dumps(payload).encode()
         await self._respond_raw(
-            writer, status, [("Content-Type", "application/json")], body)
+            writer, status,
+            [("Content-Type", "application/json"), *extra_headers], body)
 
     async def _respond_raw(self, writer, status: int, headers, body: bytes):
         lines = [f"HTTP/1.1 {status} X".encode()]

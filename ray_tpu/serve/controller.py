@@ -25,9 +25,31 @@ from typing import Any, Optional
 from ray_tpu.serve.deployment import Deployment
 
 
+@dataclass
+class ReplicaContext:
+    """Identity of the replica currently being constructed/run
+    (reference: serve.get_replica_context()).  The inference layer uses
+    it to give each replica's engine a distinct name + metric labels."""
+    deployment: str
+    replica_tag: str
+
+
+_replica_ctx = threading.local()
+
+
+def get_replica_context() -> Optional[ReplicaContext]:
+    """The ReplicaContext while a replica body is being constructed on
+    this thread (None outside replica construction)."""
+    return getattr(_replica_ctx, "ctx", None)
+
+
 class _InProcReplica:
-    def __init__(self, deployment: Deployment):
-        self._user = deployment.build_replica()
+    def __init__(self, deployment: Deployment, tag: str = ""):
+        _replica_ctx.ctx = ReplicaContext(deployment.name, tag)
+        try:
+            self._user = deployment.build_replica()
+        finally:
+            _replica_ctx.ctx = None
 
     def handle_request(self, method: str, args, kwargs):
         target = (self._user if method == "__call__"
@@ -37,6 +59,15 @@ class _InProcReplica:
         return target(*args, **kwargs)
 
     def health(self):
+        # a user body that defines health() (e.g. an inference replica
+        # whose engine can die) overrides the optimistic default — the
+        # fleet self-heal path depends on a dead engine reading False
+        probe = getattr(self._user, "health", None)
+        if callable(probe):
+            try:
+                return bool(probe())
+            except Exception:
+                return False
         return True
 
     def close(self):
@@ -53,10 +84,14 @@ class _ActorReplicaShim:
     """The actor-side wrapper (reference: RayServeReplica
     _private/replica.py:260)."""
 
-    def __init__(self, deployment_bytes: bytes):
+    def __init__(self, deployment_bytes: bytes, tag: str = ""):
         import cloudpickle
         self._dep: Deployment = cloudpickle.loads(deployment_bytes)
-        self._user = self._dep.build_replica()
+        _replica_ctx.ctx = ReplicaContext(self._dep.name, tag)
+        try:
+            self._user = self._dep.build_replica()
+        finally:
+            _replica_ctx.ctx = None
 
     def handle_request(self, method: str, args, kwargs):
         target = (self._user if method == "__call__"
@@ -66,6 +101,15 @@ class _ActorReplicaShim:
         return target(*args, **kwargs)
 
     def health(self):
+        # same contract as the in-proc replica: a body that can die
+        # in place (engine stopped, actor process still up) must read
+        # unhealthy so restart_dead replaces it
+        probe = getattr(self._user, "health", None)
+        if callable(probe):
+            try:
+                return bool(probe())
+            except Exception:
+                return False
         return True
 
 
@@ -73,6 +117,7 @@ class _ActorReplicaShim:
 class ReplicaHandle:
     impl: Any                      # _InProcReplica or actor handle
     is_actor: bool
+    tag: str = ""                  # stable identity ("<deployment>#<n>")
     ongoing: int = 0               # in-flight queries (router-side count)
 
 
@@ -87,8 +132,13 @@ class DeploymentState:
         self.use_actors = use_actors
         self.replicas: list[ReplicaHandle] = []
         self._rr = itertools.count()
+        self._replica_seq = itertools.count()
         self._lock = threading.Lock()
         self._on_membership_change = on_membership_change
+        # serve.fleet.enable() installs the fleet layer here: routing
+        # moves to the occupancy router and autoscale_tick switches from
+        # router-side ongoing counts to the fleet's engine-load signal
+        self.fleet = None
         # request counters for /metrics + status (reference: serve's
         # per-deployment autoscaling/QPS metrics, autoscaling_metrics.py)
         self.request_metrics = {"requests": 0, "errors": 0,
@@ -112,25 +162,36 @@ class DeploymentState:
     # -- replica lifecycle -------------------------------------------------
 
     def _start_replica(self) -> ReplicaHandle:
+        tag = f"{self.deployment.name}#{next(self._replica_seq)}"
         if self.use_actors:
             import cloudpickle
             import ray_tpu
             Actor = ray_tpu.remote(_ActorReplicaShim)
-            h = Actor.remote(cloudpickle.dumps(self.deployment))
-            return ReplicaHandle(h, True)
-        return ReplicaHandle(_InProcReplica(self.deployment), False)
+            h = Actor.remote(cloudpickle.dumps(self.deployment), tag)
+            return ReplicaHandle(h, True, tag)
+        return ReplicaHandle(_InProcReplica(self.deployment, tag),
+                             False, tag)
 
     def scale_to(self, n: int) -> None:
         n = max(0, n)
         changed = False
         removed: list[ReplicaHandle] = []
         with self._lock:
-            while len(self.replicas) < n:
-                self.replicas.append(self._start_replica())
-                changed = True
             while len(self.replicas) > n:
                 removed.append(self.replicas.pop())
                 changed = True
+            missing = n - len(self.replicas)
+        # replica construction runs OUTSIDE the lock: building can be
+        # expensive (model load, engine warmup) and must not block
+        # routing (assign_replica) on the deployment lock meanwhile
+        for _ in range(max(0, missing)):
+            r = self._start_replica()
+            with self._lock:
+                if len(self.replicas) < n:
+                    self.replicas.append(r)
+                    changed = True
+                else:           # concurrent scale-down won the race
+                    removed.append(r)
         # teardown outside the lock: a slow user teardown must not block
         # routing (assign_replica) on the deployment lock
         for r in removed:
@@ -147,20 +208,44 @@ class DeploymentState:
 
     def restart_dead(self) -> int:
         """Health-check replicas; replace dead ones (reference:
-        deployment_state reconciliation of FAILED replicas)."""
-        replaced = 0
+        deployment_state reconciliation of FAILED replicas).  In-proc
+        replicas are probed too: an inference replica whose engine was
+        killed reads unhealthy and gets replaced — the fleet's
+        self-heal path after a chaos kill."""
+        dead: list[int] = []
         with self._lock:
-            for i, r in enumerate(self.replicas):
-                ok = True
-                if r.is_actor:
-                    import ray_tpu
-                    try:
-                        ok = ray_tpu.get(r.impl.health.remote(), timeout=30)
-                    except Exception:
-                        ok = False
-                if not ok:
-                    self.replicas[i] = self._start_replica()
+            snapshot = list(enumerate(self.replicas))
+        for i, r in snapshot:
+            ok = True
+            if r.is_actor:
+                import ray_tpu
+                try:
+                    ok = ray_tpu.get(r.impl.health.remote(), timeout=30)
+                except Exception:
+                    ok = False
+            else:
+                ok = r.impl.health()
+            if not ok:
+                dead.append(i)
+        replaced = 0
+        for i in dead:
+            fresh = self._start_replica()   # outside the lock (slow)
+            installed = False
+            with self._lock:
+                if i < len(self.replicas) \
+                        and self.replicas[i] is snapshot[i][1]:
+                    self.replicas[i] = fresh
+                    installed = True
                     replaced += 1
+            if not installed:   # membership moved under us; release it
+                try:
+                    if fresh.is_actor:
+                        import ray_tpu
+                        ray_tpu.kill(fresh.impl)
+                    else:
+                        fresh.impl.close()
+                except Exception:
+                    traceback.print_exc()
         if replaced:
             self._membership_changed()
         return replaced
@@ -207,14 +292,39 @@ class DeploymentState:
         auto = self.deployment.options.autoscaling
         if auto is None:
             return
-        load = self.ongoing_per_replica()
-        desired = len(self.replicas)
-        if load > auto.target_ongoing_requests:
-            desired += 1
-        elif load < auto.target_ongoing_requests / 2:
-            desired -= 1
+        cur = len(self.replicas)
+        fleet = self.fleet
+        if fleet is not None:
+            # occupancy-driven scaling: the fleet's load signal is the
+            # REAL per-deployment demand — engine-held slots + engine
+            # queue depth + requests parked at the ingress — instead of
+            # the router-side ongoing count (which undercounts streams
+            # and queued work).  Proportional step (reference:
+            # calculate_desired_num_replicas), capped at doubling per
+            # tick, with shrink hysteresis at half the target.
+            total = fleet.total_load()
+            import math
+            desired = max(1, math.ceil(
+                total / max(auto.target_ongoing_requests, 1e-9)))
+            if desired > cur:
+                desired = min(desired, max(cur + 1, cur * 2))
+            elif desired < cur:
+                per = total / cur if cur else 0.0
+                if per >= auto.target_ongoing_requests / 2:
+                    desired = cur          # not idle enough to shrink
+                else:
+                    desired = cur - 1      # shrink gently
+        else:
+            load = self.ongoing_per_replica()
+            desired = cur
+            if load > auto.target_ongoing_requests:
+                desired += 1
+            elif load < auto.target_ongoing_requests / 2:
+                desired -= 1
         desired = min(max(desired, auto.min_replicas), auto.max_replicas)
-        if desired != len(self.replicas):
+        if desired != cur:
+            if fleet is not None:
+                fleet.note("scale", replicas_from=cur, replicas_to=desired)
             self.scale_to(desired)
 
 
@@ -299,11 +409,35 @@ class ServeController:
         if self._autoscale_thread is not None:
             return
 
+        def heal(st: DeploymentState) -> None:
+            try:
+                st.restart_dead()
+            except Exception:
+                traceback.print_exc()
+            finally:
+                st._healing = False
+
         def tick():
             while not self._stop.wait(0.25):
                 for st in list(self.deployments.values()):
                     try:
                         st.autoscale_tick()
+                        # fleet deployments self-heal: a replica whose
+                        # engine died (chaos kill, crash) is replaced
+                        # so routing capacity recovers without operator
+                        # action.  Gated on fleet (plain actor
+                        # deployments don't pay a health RPC per
+                        # replica per tick) and run OFF the tick thread
+                        # — a wedged actor's 30 s health timeout must
+                        # not freeze autoscaling for every deployment —
+                        # with at most one heal pass in flight per
+                        # deployment.
+                        if st.fleet is not None \
+                                and not getattr(st, "_healing", False):
+                            st._healing = True
+                            threading.Thread(
+                                target=heal, args=(st,), daemon=True,
+                                name="raytpu-serve-heal").start()
                     except Exception:
                         traceback.print_exc()
 

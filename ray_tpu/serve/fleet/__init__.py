@@ -1,0 +1,118 @@
+"""ray_tpu.serve.fleet: the production ingress-and-fleet layer on top
+of Serve + the continuous-batching inference engine.
+
+Three pieces, composable per deployment (ROADMAP items 1d and 5):
+
+  * admission.py — token-bucket admission, bounded priority wait queue
+    with deadlines, explicit load shedding (429 + Retry-After).
+  * router.py    — occupancy-aware replica routing: power-of-two-
+    choices on the per-engine gauges (active slots + queue depth), the
+    real signal the round-robin router can't see.
+  * multiplex.py — N model variants behind one deployment, LRU-loaded
+    per replica; routing prefers replicas already holding the variant.
+  * ingress.py   — the ``Fleet`` composition: admit → route → call,
+    resume-on-replica-death for streams, ingress event trail for the
+    merged timeline, occupancy signal for the autoscaler.
+
+Quick start::
+
+    from ray_tpu import serve
+    from ray_tpu.serve import fleet
+    from ray_tpu.inference import build_gpt_deployment
+
+    dep = build_gpt_deployment(
+        num_replicas=2,
+        autoscaling=serve.AutoscalingConfig(min_replicas=1,
+                                            max_replicas=4,
+                                            target_ongoing_requests=12))
+    serve.run(dep, use_actors=False, http=True)
+    fleet.enable("v1", fleet.FleetConfig(rate=200, burst=64))
+    # POST /v1/generate now goes admission -> occupancy router -> engine
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from ray_tpu.serve.fleet.admission import (AdmissionController, ShedError,
+                                           TokenBucket, parse_priority)
+from ray_tpu.serve.fleet.ingress import Fleet, FleetConfig
+from ray_tpu.serve.fleet.multiplex import ModelMultiplexer, UnknownModelError
+from ray_tpu.serve.fleet.router import NoReplicaError, OccupancyRouter
+
+
+def enable(deployment: Union[str, object],
+           config: Optional[FleetConfig] = None) -> Fleet:
+    """Install the fleet layer on a deployment (by name or
+    DeploymentState).  Handle + HTTP traffic immediately starts flowing
+    through admission + the occupancy router, and ``autoscale_tick``
+    switches to the fleet's engine-load signal."""
+    state = deployment
+    if isinstance(deployment, str):
+        from ray_tpu import serve as _serve
+        state = _serve._get_controller().get(deployment)
+    f = Fleet(state, config)
+    state.fleet = f
+    return f
+
+
+def disable(deployment: Union[str, object]) -> None:
+    """Remove the fleet layer (traffic reverts to round-robin)."""
+    state = deployment
+    if isinstance(deployment, str):
+        from ray_tpu import serve as _serve
+        state = _serve._get_controller().get(deployment)
+    state.fleet = None
+
+
+def get(deployment_name: str) -> Optional[Fleet]:
+    from ray_tpu import serve as _serve
+    return getattr(_serve._get_controller().get(deployment_name),
+                   "fleet", None)
+
+
+def metrics_snapshot() -> list:
+    """Fleet ingress gauges/counters in the exporter's tuple format,
+    one labeled series per fleet-enabled deployment."""
+    from ray_tpu import serve as _serve
+    ctrl = _serve._controller
+    if ctrl is None:
+        return []
+    admitted, shed, resumed, queued, replicas, slots = \
+        {}, {}, {}, {}, {}, {}
+    for name, st in list(ctrl.deployments.items()):
+        f = getattr(st, "fleet", None)
+        if f is None:
+            continue
+        key = (("deployment", name),)
+        snap = f.fleet_snapshot()
+        admitted[key] = float(snap["admitted"])
+        shed[key] = float(snap["shed"])
+        resumed[key] = float(snap["resumed"])
+        queued[key] = float(snap["ingress_queued"])
+        replicas[key] = float(snap["replicas"])
+        slots[key] = float(snap["total_slots"])
+    if not admitted:
+        return []
+    return [
+        ("serve_fleet_admitted_total", "counter",
+         "Requests admitted through the fleet ingress", admitted),
+        ("serve_fleet_shed_total", "counter",
+         "Requests shed (429) at the fleet ingress", shed),
+        ("serve_fleet_resumed_total", "counter",
+         "Requests re-routed after a replica death", resumed),
+        ("serve_fleet_ingress_queue_depth", "gauge",
+         "Requests parked in the admission queue", queued),
+        ("serve_fleet_replicas", "gauge",
+         "Live replicas behind the fleet router", replicas),
+        ("serve_fleet_total_slots", "gauge",
+         "Total decode slots across live replicas", slots),
+    ]
+
+
+__all__ = [
+    "AdmissionController", "Fleet", "FleetConfig", "ModelMultiplexer",
+    "NoReplicaError", "OccupancyRouter", "ShedError", "TokenBucket",
+    "UnknownModelError", "enable", "disable", "get", "metrics_snapshot",
+    "parse_priority",
+]

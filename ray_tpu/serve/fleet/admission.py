@@ -1,0 +1,190 @@
+"""Ingress admission control: token buckets, a bounded priority wait
+queue with deadlines, and explicit load shedding.
+
+Reference capability: graceful overload for a serving fleet — instead
+of unbounded queueing (every request eventually times out, the slowest
+way to say no), the ingress admits what the fleet can absorb, parks a
+BOUNDED amount of burst in a priority queue, and sheds the rest with
+``429 Too Many Requests`` + ``Retry-After`` so clients back off instead
+of piling on.
+
+Mechanics:
+
+  * ``TokenBucket`` — classic leaky-bucket rate limit: ``rate``
+    tokens/s refill up to ``burst``.  Lazy refill on ``take()`` (no
+    refill thread).
+  * ``AdmissionController.acquire(priority)`` — take a token or park in
+    the wait queue.  The queue is priority-ordered (interactive ahead
+    of batch regardless of arrival order) and doubly bounded: by depth
+    (``max_queue_depth`` — full queue sheds immediately) and by wait
+    deadline per class (a parked request sheds when its deadline
+    passes, so the queue can never hide unbounded latency).
+  * ``ShedError`` carries ``retry_after_s`` — the ingress maps it to a
+    429 with a ``Retry-After`` header.
+
+All waits are bounded condition waits (the control-plane lint's
+blocking rules are the house style even off the node event loop).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ray_tpu.serve.qos import (PRIORITY_BATCH, PRIORITY_INTERACTIVE,
+                               parse_priority)
+
+
+class ShedError(RuntimeError):
+    """The ingress refused this request (bucket dry + queue full, or
+    the queue deadline passed).  ``retry_after_s`` is the ingress's
+    estimate of when capacity frees up — the HTTP layer renders it as
+    ``429`` + ``Retry-After``."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(
+            f"request shed ({reason}); retry after "
+            f"{retry_after_s:.1f}s")
+        self.reason = reason
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class TokenBucket:
+    """Lazy-refill token bucket.  Not thread-safe on its own — the
+    AdmissionController serializes access under its condition lock."""
+
+    def __init__(self, rate: float, burst: float):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._tokens = self.burst
+        self._stamp = time.monotonic()
+
+    def _refill(self, now: float) -> None:
+        elapsed = max(0.0, now - self._stamp)   # never drain on a
+        self._tokens = min(self.burst,          # backwards clock
+                           self._tokens + elapsed * self.rate)
+        self._stamp = now
+
+    def take(self, now: float) -> bool:
+        self._refill(now)
+        if self._tokens >= 1.0 - 1e-9:      # float-robust boundary
+            self._tokens = max(0.0, self._tokens - 1.0)
+            return True
+        return False
+
+    def time_to_token(self, now: float, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will be available."""
+        self._refill(now)
+        deficit = n - self._tokens
+        return max(0.0, deficit / self.rate)
+
+
+@dataclass
+class AdmissionStats:
+    admitted: int = 0
+    admitted_queued: int = 0          # admitted after waiting in queue
+    shed_queue_full: int = 0
+    shed_deadline: int = 0
+    queue_wait_sum_s: float = 0.0
+    by_class: dict = field(default_factory=dict)   # priority -> admitted
+
+
+class AdmissionController:
+    """Token bucket + bounded priority wait queue, one per deployment.
+
+    ``acquire`` returns the seconds spent queued (0.0 on the fast
+    path); raises ShedError on refusal.  Queue order is (priority,
+    arrival) — an interactive request entering a full-but-not-shedding
+    queue is served before batch requests that arrived earlier.
+    """
+
+    def __init__(self, *, rate: float, burst: float,
+                 max_queue_depth: int = 64,
+                 max_queue_wait_s: dict | float = 5.0):
+        self._cond = threading.Condition()
+        self._bucket = TokenBucket(rate, burst)
+        self._depth = int(max_queue_depth)
+        if not isinstance(max_queue_wait_s, dict):
+            max_queue_wait_s = {PRIORITY_INTERACTIVE: max_queue_wait_s,
+                                PRIORITY_BATCH: max_queue_wait_s}
+        self._max_wait = dict(max_queue_wait_s)
+        self._heap: list[tuple[int, int]] = []   # (priority, seq)
+        self._parked: set[int] = set()            # live seqs in heap
+        self._seq = itertools.count()
+        self.stats = AdmissionStats()
+
+    # ------------------------------------------------------------ internals
+
+    def _head(self) -> int | None:
+        """Seq of the live queue head (pops stale heap entries)."""
+        while self._heap and self._heap[0][1] not in self._parked:
+            heapq.heappop(self._heap)
+        return self._heap[0][1] if self._heap else None
+
+    def _retry_after(self, now: float) -> float:
+        """Back-off estimate for a shed request: time for the bucket to
+        clear everything already parked plus one."""
+        return self._bucket.time_to_token(now, n=len(self._parked) + 1)
+
+    def _admitted(self, priority: int, waited: float) -> None:
+        st = self.stats
+        st.admitted += 1
+        if waited > 0:
+            st.admitted_queued += 1
+            st.queue_wait_sum_s += waited
+        st.by_class[priority] = st.by_class.get(priority, 0) + 1
+
+    # -------------------------------------------------------------- public
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._parked)
+
+    def acquire(self, priority: int = PRIORITY_BATCH, *,
+                deadline_s: float | None = None) -> float:
+        """Admit or shed.  Returns seconds spent queued; raises
+        ShedError when refused."""
+        t0 = time.monotonic()
+        limit = (deadline_s if deadline_s is not None
+                 else self._max_wait.get(priority, 5.0))
+        deadline = t0 + max(0.0, float(limit))
+        with self._cond:
+            # fast path: nobody parked ahead and a token is ready
+            if not self._parked and self._bucket.take(t0):
+                self._admitted(priority, 0.0)
+                return 0.0
+            if len(self._parked) >= self._depth:
+                self.stats.shed_queue_full += 1
+                raise ShedError("queue full", self._retry_after(t0))
+            seq = next(self._seq)
+            heapq.heappush(self._heap, (priority, seq))
+            self._parked.add(seq)
+            try:
+                while True:
+                    now = time.monotonic()
+                    if self._head() == seq and self._bucket.take(now):
+                        self._parked.discard(seq)
+                        self._cond.notify_all()
+                        waited = now - t0
+                        self._admitted(priority, waited)
+                        return waited
+                    if now >= deadline:
+                        self.stats.shed_deadline += 1
+                        raise ShedError("queue deadline",
+                                        self._retry_after(now))
+                    # bounded park: head waits for its token, others
+                    # wait for a notify (with a poll floor so a missed
+                    # notify can't strand anyone)
+                    wait = min(0.05, deadline - now)
+                    if self._head() == seq:
+                        wait = min(max(self._bucket.time_to_token(now),
+                                       0.001), wait)
+                    self._cond.wait(wait)
+            finally:
+                self._parked.discard(seq)
+                self._cond.notify_all()

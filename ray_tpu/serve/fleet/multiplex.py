@@ -1,0 +1,155 @@
+"""Model multiplexing: N model variants behind one deployment,
+LRU-loaded per replica.
+
+Reference capability: multiplexed model serving (the PAPER.md L7 Serve
+survey) — a deployment fronts a CATALOG of model variants, each replica
+holds at most ``capacity`` of them resident (an inference engine +
+KV pool each), and a request names its variant.  The fleet router
+prefers replicas that already hold the variant (no load latency, warm
+cache); a miss LRU-loads on the routed replica, evicting the
+least-recently-used variant when at capacity (its engine shuts down,
+releasing the pool).
+
+The multiplexer is generic over a ``loader(model_id) -> body`` /
+``unloader(body)`` pair so non-LLM deployments can multiplex too; the
+inference layer wires it to per-variant InferenceEngines
+(``serving.GPTServer`` with ``variants=...``).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+from ray_tpu.serve.qos import ReplicaDeadError
+
+
+class UnknownModelError(ValueError):
+    """Request named a variant that is not in the deployment catalog."""
+
+
+class ModelMultiplexer:
+    """Per-replica LRU of loaded model variants.
+
+    ``get(model_id)`` returns the loaded body, loading/evicting as
+    needed.  The LOAD itself runs OUTSIDE the lock behind a per-model
+    future: concurrent misses for the same variant share one load (two
+    engines for one variant would double the pool), while hits,
+    ``loaded_models()``/``loaded_bodies()`` (the router's probe
+    surface) and health checks never block behind a multi-second model
+    load — a load stalls only requests that need the loading variant.
+    """
+
+    # bound on a follower waiting for another request's in-flight load
+    # (params init + compile is seconds; a wedged loader must fail
+    # followers cleanly, not strand pool threads)
+    LOAD_TIMEOUT_S = 120.0
+
+    def __init__(self, catalog: dict,
+                 loader: Callable[[str, Any], Any],
+                 unloader: Optional[Callable[[Any], None]] = None,
+                 capacity: int = 2):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if not catalog:
+            raise ValueError("empty model catalog")
+        self.catalog = dict(catalog)       # model_id -> loader spec
+        self.capacity = int(capacity)
+        self._loader = loader
+        self._unloader = unloader
+        self._lock = threading.Lock()
+        self._loaded: "OrderedDict[str, Any]" = OrderedDict()
+        self._loading: dict = {}           # model_id -> Future
+        self._down = False
+        self.loads = 0
+        self.evictions = 0
+
+    def default_model(self) -> str:
+        return next(iter(self.catalog))
+
+    def loaded_models(self) -> list[str]:
+        with self._lock:
+            return list(self._loaded)
+
+    def loaded_bodies(self) -> list:
+        with self._lock:
+            return list(self._loaded.values())
+
+    def get(self, model_id: Optional[str]) -> Any:
+        """Resident body for ``model_id`` (None = catalog default),
+        loading/evicting as needed."""
+        from concurrent.futures import Future
+        if model_id is None:
+            model_id = self.default_model()
+        if model_id not in self.catalog:
+            raise UnknownModelError(
+                f"unknown model {model_id!r} (catalog: "
+                f"{sorted(self.catalog)})")
+        with self._lock:
+            if self._down:
+                raise ReplicaDeadError("multiplexer is shut down")
+            body = self._loaded.get(model_id)
+            if body is not None:
+                self._loaded.move_to_end(model_id)
+                return body
+            fut = self._loading.get(model_id)
+            if fut is not None:
+                leader = False
+            else:
+                fut = self._loading[model_id] = Future()
+                leader = True
+        if not leader:
+            # share the in-flight load — BOUNDED (house style: no
+            # unbounded waits): a wedged loader fails followers with a
+            # clean timeout instead of leaking pool threads forever
+            return fut.result(timeout=self.LOAD_TIMEOUT_S)
+        try:
+            body = self._loader(model_id, self.catalog[model_id])
+        except BaseException as e:
+            with self._lock:
+                self._loading.pop(model_id, None)
+            fut.set_exception(e)
+            raise
+        evicted = None
+        unload_now = False
+        with self._lock:
+            self._loading.pop(model_id, None)
+            if self._down:             # lost the race with unload_all
+                unload_now = True
+            else:
+                if len(self._loaded) >= self.capacity:
+                    _, evicted = self._loaded.popitem(last=False)
+                    self.evictions += 1
+                self._loaded[model_id] = body
+                self.loads += 1
+        if unload_now:
+            if self._unloader is not None:
+                self._unloader(body)
+            err = ReplicaDeadError("multiplexer is shut down")
+            fut.set_exception(err)
+            raise err
+        fut.set_result(body)
+        if evicted is not None and self._unloader is not None:
+            self._unloader(evicted)    # outside the lock: may be slow
+        return body
+
+    def unload_all(self) -> None:
+        with self._lock:
+            self._down = True
+            bodies = list(self._loaded.values())
+            self._loaded.clear()
+        if self._unloader is not None:
+            for b in bodies:
+                self._unloader(b)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "catalog": sorted(self.catalog),
+                "loaded": list(self._loaded),
+                "loading": list(self._loading),
+                "capacity": self.capacity,
+                "loads": self.loads,
+                "evictions": self.evictions,
+            }

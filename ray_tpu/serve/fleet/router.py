@@ -1,0 +1,227 @@
+"""Occupancy-aware replica routing: power-of-two-choices on the
+per-engine gauges.
+
+Reference capability: replica-aware routing for model serving — the
+round-robin router (serve/controller.py ``assign_replica``) spreads
+REQUEST COUNTS evenly, but continuous-batching replicas are not equal:
+one may have a deep admission queue while another sits half-empty, and
+streaming responses release the router-side ``ongoing`` count long
+before the engine slot frees.  This router scores replicas by what the
+engine actually reports — ``active_slots + waiting_requests`` over
+``max_slots`` (the same gauges PR 5 exports at /metrics) — and picks
+the less-loaded of two random choices (power-of-two-choices: near-
+optimal balance at O(1) probes, no global scan race).
+
+Model multiplexing hooks in at candidate selection: when the request
+names a model variant, replicas already holding it are preferred (no
+load penalty), falling back to the full live set (the chosen replica
+then LRU-loads the variant).
+
+Probes are method calls for in-process replicas and RPCs (TTL-cached)
+for actor replicas; a probe that fails or reports ``stopped`` marks
+the replica dead — it is skipped until the controller's self-heal tick
+replaces it.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Optional
+
+from ray_tpu.serve.controller import DeploymentState, ReplicaHandle
+
+
+class NoReplicaError(RuntimeError):
+    """No live replica could take the request within the timeout."""
+
+
+def _probe_inproc(replica: ReplicaHandle) -> Optional[dict]:
+    """Stats from an in-process replica body; None when the body has no
+    fleet surface (plain deployments fall back to ongoing counts)."""
+    user = getattr(replica.impl, "_user", None)
+    probe = getattr(user, "fleet_stats", None)
+    if not callable(probe):
+        return None
+    return probe()
+
+
+class OccupancyRouter:
+    """Power-of-two-choices router over one deployment's replicas."""
+
+    PROBE_TTL_S = 0.25       # actor-replica stats cache (in-proc: fresh)
+    # dead-marks EXPIRE: a mark is a short-circuit around probing a
+    # corpse, not a tombstone.  A replica that died for one request
+    # (e.g. a multiplex LRU eviction failed its in-flight stream) but
+    # is otherwise healthy must come back; a genuinely dead one
+    # re-marks itself on the next probe.  The controller's self-heal
+    # tick replaces real corpses well within one TTL.
+    DEAD_TTL_S = 5.0
+
+    # in-proc probes are near-free but not free (every engine's stat
+    # locks); a short cache bounds probe traffic per replica regardless
+    # of QPS — p2c tolerates 50 ms-stale scores
+    INPROC_TTL_S = 0.05
+
+    def __init__(self, state: DeploymentState, *, seed: int = 0):
+        self._state = state
+        self._rng = random.Random(seed)
+        # guards _dead + _probe_cache: both are written from every
+        # fleet-pool thread (mark_dead on call failure, cache fills),
+        # and the pruning pass rebuilds them wholesale — an unlocked
+        # rebuild could silently drop a concurrent dead-mark
+        self._mlock = threading.Lock()
+        self._probe_cache: dict[str, tuple[float, Optional[dict]]] = {}
+        self._dead: dict[str, float] = {}     # tag -> mark time
+
+    # ------------------------------------------------------------- probing
+
+    def probe(self, replica: ReplicaHandle) -> Optional[dict]:
+        """Engine-load stats for one replica (None = no fleet surface).
+        Raises on a dead replica probe (actor gone)."""
+        now = time.monotonic()
+        ttl = (self.INPROC_TTL_S if not replica.is_actor
+               else self.PROBE_TTL_S)
+        with self._mlock:
+            hit = self._probe_cache.get(replica.tag)
+        if hit is not None and now - hit[0] < ttl:
+            return hit[1]
+        if not replica.is_actor:
+            st = _probe_inproc(replica)
+        else:
+            import ray_tpu
+            try:
+                st = ray_tpu.get(
+                    replica.impl.handle_request.remote("fleet_stats",
+                                                       (), {}),
+                    timeout=5)
+            except Exception:
+                st = {"stopped": True}
+        with self._mlock:
+            self._probe_cache[replica.tag] = (now, st)
+        return st
+
+    def _score(self, replica: ReplicaHandle,
+               maxq: int) -> Optional[tuple]:
+        """(load, waiting, jitter) — lower routes first; None = not a
+        candidate (dead or saturated)."""
+        try:
+            st = self.probe(replica)
+        except Exception:
+            st = {"stopped": True}
+        if st is not None and st.get("stopped"):
+            with self._mlock:
+                self._dead[replica.tag] = time.monotonic()
+            return None
+        if replica.ongoing >= maxq:
+            return None
+        if st is None:   # plain deployment: router-side count is all we have
+            return (replica.ongoing / max(1, maxq), 0,
+                    self._rng.random())
+        slots = max(1, int(st.get("max_slots", 1)))
+        load = (float(st.get("active_slots", 0))
+                + float(st.get("waiting_requests", 0))) / slots
+        return (load, int(st.get("waiting_requests", 0)),
+                self._rng.random())
+
+    # ------------------------------------------------------------- routing
+
+    def live_replicas(self) -> list[ReplicaHandle]:
+        with self._state._lock:
+            reps = list(self._state.replicas)
+        # dead-marks and probe-cache entries for replicas no longer in
+        # the membership are stale (controller replaced them — tags are
+        # never reused), and surviving marks expire after DEAD_TTL_S —
+        # prune both so they stay bounded over weeks of churn
+        tags = {r.tag for r in reps}
+        now = time.monotonic()
+        with self._mlock:
+            for t in [t for t, s in self._dead.items()
+                      if t not in tags or now - s >= self.DEAD_TTL_S]:
+                del self._dead[t]
+            for t in [t for t in self._probe_cache if t not in tags]:
+                del self._probe_cache[t]
+            dead = set(self._dead)
+        live = [r for r in reps if r.tag not in dead]
+        if not live and reps:
+            # every known replica was marked dead — retry them rather
+            # than refusing forever (a stale dead-mark must not wedge
+            # routing when the body healed in place)
+            with self._mlock:
+                self._dead.clear()
+            live = reps
+        return live
+
+    def holders(self, replicas: list[ReplicaHandle],
+                model: str) -> list[ReplicaHandle]:
+        """Replicas whose body already has ``model`` loaded."""
+        out = []
+        for r in replicas:
+            try:
+                st = self.probe(r)
+            except Exception:
+                continue
+            if st is not None and model in (st.get("models") or ()):
+                out.append(r)
+        return out
+
+    def assign(self, model: Optional[str] = None, *,
+               timeout: float = 30.0,
+               exclude: tuple = ()) -> ReplicaHandle:
+        """Pick a replica (p2c on occupancy), increment its ongoing
+        count.  ``exclude`` skips tags (retry-after-failure path)."""
+        maxq = self._state.deployment.options.max_concurrent_queries
+        deadline = time.monotonic() + timeout
+        while True:
+            live = [r for r in self.live_replicas()
+                    if r.tag not in exclude]
+            cands = live
+            if model is not None and live:
+                held = self.holders(live, model)
+                if held:
+                    cands = held
+            pick = self._pick(cands, maxq)
+            if pick is not None:
+                with self._state._lock:
+                    pick.ongoing += 1
+                return pick
+            if time.monotonic() > deadline:
+                raise NoReplicaError(
+                    f"deployment {self._state.deployment.name!r}: no "
+                    f"live replica available within {timeout}s "
+                    f"({len(live)} live, exclude={list(exclude)})")
+            # saturated: park briefly rather than hammering the engine
+            # stat locks 200x/s per waiting thread
+            time.sleep(0.02)
+
+    def _pick(self, cands: list[ReplicaHandle],
+              maxq: int) -> Optional[ReplicaHandle]:
+        """Sample TWO candidates, then probe only those (the p2c
+        contract: O(1) probes per pick); fall back to a full scan only
+        when both sampled replicas are dead or saturated."""
+        if len(cands) > 2:
+            pick = self._pick_scored(self._rng.sample(cands, 2), maxq)
+            if pick is not None:
+                return pick
+        return self._pick_scored(cands, maxq)
+
+    def _pick_scored(self, cands: list[ReplicaHandle],
+                     maxq: int) -> Optional[ReplicaHandle]:
+        scored = [(s, r) for r in cands
+                  if (s := self._score(r, maxq)) is not None]
+        if not scored:
+            return None
+        return min(scored, key=lambda t: t[0])[1]
+
+    def release(self, replica: ReplicaHandle) -> None:
+        with self._state._lock:
+            replica.ongoing = max(0, replica.ongoing - 1)
+
+    def mark_dead(self, replica: ReplicaHandle) -> None:
+        """Route-time death report (call failed with a dead-replica
+        error): skip this replica until the controller replaces it or
+        the mark expires (DEAD_TTL_S — one failed request must not
+        permanently exclude an otherwise-healthy replica)."""
+        with self._mlock:
+            self._dead[replica.tag] = time.monotonic()

@@ -94,9 +94,16 @@ class DeploymentHandle:
             pass
         return self._state
 
-    def remote(self, *args, **kwargs) -> ServeResponse:
+    def remote(self, *args, **kwargs):
         import time as _time
         state, method = self._current_state(), self._method
+        fleet = getattr(state, "fleet", None)
+        if fleet is not None and method == "__call__":
+            # fleet-enabled deployment: admission (may raise ShedError
+            # — backpressure is synchronous by design) + occupancy
+            # routing + resume-on-replica-death, instead of the
+            # round-robin assign below
+            return fleet.remote(args, kwargs)
         replica = state.assign_replica()
         t0 = _time.perf_counter()
         if replica.is_actor:

@@ -49,11 +49,13 @@ class HttpProxy:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _reply(self, code: int, payload):
+            def _reply(self, code: int, payload, extra_headers=()):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in extra_headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -80,7 +82,16 @@ class HttpProxy:
                     out = handle.remote(arg).result(timeout=120)
                     self._reply(200, {"result": _jsonable(out)})
                 except Exception as e:
-                    self._reply(500, {"error": str(e)})
+                    from ray_tpu.serve.asgi import _shed_retry_after
+                    ra = _shed_retry_after(e)
+                    if ra is not None:   # fleet shed: 429, not a fault
+                        import math
+                        self._reply(429, {"error": str(e),
+                                          "retry_after_s": ra},
+                                    [("Retry-After",
+                                      str(max(1, math.ceil(ra))))])
+                    else:
+                        self._reply(500, {"error": str(e)})
 
             do_GET = _route
             do_POST = _route

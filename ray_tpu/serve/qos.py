@@ -1,0 +1,45 @@
+"""Request quality-of-service vocabulary shared by the serve fleet and
+the inference engine.
+
+Lives at the serve layer (jax-free) so the generic fleet machinery —
+admission control, routing, multiplexing — never has to import the
+inference stack (which pulls in jax) just for two priority ints and an
+exception class; the engine imports FROM here and re-exports for
+compatibility.
+"""
+
+from __future__ import annotations
+
+# priority classes: lower admits first.  Interactive requests preempt
+# batch ones wherever a queue is drained — the ingress admission queue
+# and the engine's prefill-boundary admission both order by
+# (priority, arrival).
+PRIORITY_INTERACTIVE = 0
+PRIORITY_BATCH = 1
+
+_PRIORITY_NAMES = {"interactive": PRIORITY_INTERACTIVE,
+                   "batch": PRIORITY_BATCH}
+
+
+def parse_priority(value) -> int:
+    """"interactive"/"batch"/int → priority class.  Unknown strings
+    raise so a typo'd class is a clean client error, not a silently-
+    batch request."""
+    if value is None:
+        return PRIORITY_BATCH
+    if isinstance(value, str):
+        try:
+            return _PRIORITY_NAMES[value.lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown priority {value!r} (expected one of "
+                f"{sorted(_PRIORITY_NAMES)})") from None
+    return int(value)
+
+
+class ReplicaDeadError(RuntimeError):
+    """The serving replica died with this request queued or in flight.
+    The fleet layer treats it as retriable: the request had no
+    observable side effects, so it re-routes to another replica
+    (streams replay and skip the already-delivered prefix).  The
+    engine's EngineStoppedError subclasses this."""

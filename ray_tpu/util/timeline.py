@@ -10,6 +10,10 @@ python/ray/experimental/state + _private/profiling.py):
   * tracing spans            → ``X`` slices grouped by emitting pid
   * chaos (fault-injection)  → ``i`` instant events, so injected faults
     show up attributed in the same view as the latency they caused
+  * serve-fleet ingress      → admission/shed/route/resume/scale events
+    (serve/fleet): queued admissions render as ``X`` slices (the queue
+    wait is visible time), everything else as ``i`` instants, one track
+    per event kind
 
 Output loads in chrome://tracing and ui.perfetto.dev (both accept the
 ``{"traceEvents": [...]}`` object form and string pid/tid values).
@@ -21,7 +25,8 @@ from typing import Iterable
 
 
 def build_trace(task_events: Iterable = (), records: Iterable = (),
-                spans: Iterable = (), faults: Iterable = ()) -> dict:
+                spans: Iterable = (), faults: Iterable = (),
+                ingress: Iterable = ()) -> dict:
     """Merge all sources into one Perfetto-loadable trace dict."""
     from ray_tpu.util.state import events_to_trace
 
@@ -69,6 +74,29 @@ def build_trace(task_events: Iterable = (), records: Iterable = (),
             "ts": float(f.get("t", 0.0)) * 1e6,
             "pid": "chaos", "tid": f.get("point", "?"),
             "args": {"detail": f.get("detail")},
+        })
+
+    for g in ingress:
+        # g: fleet ingress event — {"t", "kind", "deployment", ...}
+        # (serve/fleet/ingress.py Fleet.note); an admit that waited in
+        # the admission queue becomes a slice ENDING at the admit stamp
+        # so the queueing delay is visible time, everything else an
+        # instant on its kind's track
+        kind = g.get("kind", "?")
+        ts = float(g.get("t", 0.0)) * 1e6
+        args = {k: v for k, v in g.items() if k not in ("t", "kind")}
+        queued = float(g.get("queued_s") or 0.0)
+        if kind == "admit" and queued > 0:
+            ev.append({
+                "name": "ingress:queued", "cat": "ingress", "ph": "X",
+                "ts": ts - queued * 1e6, "dur": queued * 1e6,
+                "pid": "ingress", "tid": "admit", "args": args,
+            })
+            continue
+        ev.append({
+            "name": f"ingress:{kind}", "cat": "ingress", "ph": "i",
+            "s": "g", "ts": ts, "pid": "ingress", "tid": kind,
+            "args": args,
         })
 
     ev.sort(key=lambda e: e.get("ts", 0.0))

@@ -46,3 +46,8 @@ def pytest_configure(config):
                    "(core/fault_injection.py); quick deterministic ones "
                    "run in tier-1, long kill-a-host flows are also "
                    "marked slow")
+    config.addinivalue_line(
+        "markers", "serve_fleet: fleet serving-layer tests "
+                   "(serve/fleet/); quick deterministic ones run in "
+                   "tier-1, trace-replay load runs are also marked "
+                   "slow so tier-1 skips them")

@@ -1,0 +1,177 @@
+"""Chaos during serving (ROADMAP 5d): kill a replica mid-stream /
+mid-request through the fault-injection plane and prove the request
+either resumes on another replica (token-exact — generation is
+deterministic from the request) or fails promptly with a clean error —
+never hangs silently."""
+
+import json
+import socket
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu import serve
+from ray_tpu.core import fault_injection
+from ray_tpu.inference import (EngineConfig, build_gpt_deployment,
+                               parse_stream_chunks)
+from ray_tpu.models import gpt
+from ray_tpu.serve import fleet
+from ray_tpu.serve.fleet import FleetConfig
+
+pytestmark = [pytest.mark.serve_fleet, pytest.mark.chaos]
+
+CFG = gpt.GPTConfig.tiny(dtype=jnp.float32, max_seq=64)
+SEED = 0
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    fault_injection.uninstall()
+    serve.shutdown()
+
+
+def _ref_tokens(prompt, max_new):
+    params = gpt.init_params(CFG, jax.random.PRNGKey(SEED))
+    out = gpt.generate(params, CFG, jnp.asarray([prompt], jnp.int32),
+                       max_new=max_new, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _run_fleet(num_replicas, fleet_cfg=None):
+    dep = build_gpt_deployment(
+        cfg=CFG, engine_cfg=EngineConfig(max_slots=4), seed=SEED,
+        num_replicas=num_replicas)
+    handle = serve.run(dep, use_actors=False, http=True)
+    f = fleet.enable("v1", fleet_cfg
+                     or FleetConfig(rate=500, burst=64))
+    return handle, f
+
+
+def _kill_routed_replica(ctx):
+    ctx["fleet"].kill_replica(ctx["replica"])
+
+
+def _stream_over_socket(addr, payload, timeout=120):
+    """Drive a streamed /v1/generate over a raw socket; returns
+    (chunks, closed_cleanly) where closed_cleanly means the terminal
+    0-chunk arrived.  Bounded by the socket timeout — a hang fails the
+    test instead of wedging it."""
+    host, port = addr[len("http://"):].split(":")
+    body = json.dumps(payload).encode()
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout) as s:
+        s.sendall(b"POST /v1/generate HTTP/1.1\r\n"
+                  b"Host: x\r\nContent-Type: application/json\r\n"
+                  + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                  + body)
+        s.settimeout(timeout)
+        buf = b""
+        while True:
+            data = s.recv(4096)
+            if not data:
+                break
+            buf += data
+            if b"0\r\n\r\n" in buf:
+                break
+    payload_bytes = buf.split(b"\r\n\r\n", 1)[-1]
+    return parse_stream_chunks(payload_bytes), b"0\r\n\r\n" in buf
+
+
+def test_replica_killed_mid_stream_resumes_on_another():
+    """The tentpole chaos e2e: the serving replica dies AFTER tokens
+    hit the wire; the fleet re-routes and replays, and the client sees
+    one seamless, token-exact stream."""
+    handle, f = _run_fleet(num_replicas=2)
+    addr = serve.proxy_address()
+    prompt, max_tokens = [9, 2, 6], 24
+    plan = fault_injection.FaultPlan(seed=0)
+    # 4th streamed chunk on this process: kill the replica serving it
+    plan.script(_kill_routed_replica, point="serve_stream", nth=4)
+    with fault_injection.injected(plan):
+        chunks, clean = _stream_over_socket(
+            addr, {"prompt": prompt, "max_tokens": max_tokens,
+                   "stream": True})
+    assert clean, "stream did not finish with the terminal chunk"
+    toks = [c["token"] for c in chunks if "token" in c]
+    assert toks == _ref_tokens(prompt, max_tokens)
+    assert chunks[-1]["done"] is True and chunks[-1]["n"] == max_tokens
+    # indexes must be a seamless 0..n-1 (no replayed duplicates)
+    assert [c["index"] for c in chunks if "token" in c] \
+        == list(range(max_tokens))
+    snap = f.fleet_snapshot()
+    assert snap["resumed"] >= 1
+    kinds = [e["kind"] for e in f.events()]
+    assert "chaos_kill" in kinds and "resume" in kinds
+    # the chaos plane logged the scripted fire (attributed, not silent)
+    assert any(p == "serve_stream" for p, _, _ in plan.log)
+    # accounting: the request ended in exactly one bucket
+    assert snap["admitted"] == snap["completed"] + snap["errored"] \
+        + snap["shed"]
+
+
+def test_replica_killed_mid_stream_no_retry_fails_promptly():
+    """With resume disabled (or nowhere to go), the stream must fail
+    PROMPTLY and CLEANLY: truncated chunked framing (no terminal
+    0-chunk), connection closed — not a silent hang."""
+    handle, f = _run_fleet(
+        num_replicas=1,
+        fleet_cfg=FleetConfig(rate=500, burst=64,
+                              retry_on_replica_failure=False))
+    addr = serve.proxy_address()
+    plan = fault_injection.FaultPlan(seed=0)
+    plan.script(_kill_routed_replica, point="serve_stream", nth=2)
+    t0 = time.monotonic()
+    with fault_injection.injected(plan):
+        chunks, clean = _stream_over_socket(
+            addr, {"prompt": [1, 2], "max_tokens": 48, "stream": True},
+            timeout=60)
+    elapsed = time.monotonic() - t0
+    assert not clean, "killed stream claimed clean completion"
+    assert not any(c.get("done") for c in chunks)
+    assert elapsed < 30, f"failure took {elapsed:.1f}s — near-hang"
+    snap = f.fleet_snapshot()
+    assert snap["errored"] >= 1 and snap["resumed"] == 0
+    assert snap["admitted"] == snap["completed"] + snap["errored"]
+
+
+def test_replica_killed_at_route_retries_nonstream():
+    """A replica that dies between routing and the call: the typed
+    EngineStoppedError re-routes the (not-yet-started) request, which
+    completes on the surviving replica."""
+    handle, f = _run_fleet(num_replicas=2)
+    plan = fault_injection.FaultPlan(seed=0)
+    plan.script(_kill_routed_replica, point="serve_route", nth=1)
+    with fault_injection.injected(plan):
+        out = handle.remote({"prompt": [3, 1, 4],
+                             "max_tokens": 6}).result(timeout=120)
+    assert out["tokens"] == _ref_tokens([3, 1, 4], 6)
+    snap = f.fleet_snapshot()
+    assert snap["resumed"] == 1 and snap["completed"] == 1
+
+
+def test_controller_self_heals_killed_replica():
+    """After a chaos kill the autoscale tick's restart_dead replaces
+    the corpse: capacity returns without operator action."""
+    handle, f = _run_fleet(num_replicas=2)
+    st = serve.get_handle("v1")._state
+    victim = st.replicas[0]
+    f.kill_replica(victim)
+    assert not victim.impl.health()
+    deadline = time.monotonic() + 30
+    healed = False
+    while time.monotonic() < deadline:
+        with st._lock:
+            tags = [r.tag for r in st.replicas]
+        if victim.tag not in tags and len(tags) == 2:
+            healed = True
+            break
+        time.sleep(0.05)
+    assert healed, f"dead replica never replaced: {tags}"
+    # the fleet serves across the healed membership
+    out = handle.remote({"prompt": [5, 5], "max_tokens": 3}).result(
+        timeout=120)
+    assert out["tokens"] == _ref_tokens([5, 5], 3)
