@@ -1,10 +1,14 @@
-"""Trace-replay load harness → SERVE_r13.json.
+"""Trace-replay load harness → SERVE_r14.json.
 
 Replays bursty / diurnal arrival processes against the fleet serving
 layer (admission + occupancy router + autoscaler, serve/fleet/) and
 records the degradation curve — p99 vs offered load — plus the
-autoscaling trace and a full request accounting.  The acceptance
-contract (ISSUE 13):
+autoscaling trace and a full request accounting.  Round 14 adds the
+**scale-down storm A/B** (ISSUE 14): the same streaming trace replayed
+against periodic replica removals done the r13 way (kill + resume) and
+the drain-aware way (ACTIVE -> DRAINING -> teardown) — zero masked
+resumes, replayed-token count and scale-down-window p99 compared in the
+same run.  The r13 acceptance contract (kept):
 
   * >= 64 total decode slots across replicas at peak under the
     replayed bursty load (autoscaler must actually fan the fleet out);
@@ -95,6 +99,129 @@ def _post(addr, payload, timeout):
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(rq, timeout=timeout) as resp:
         return json.loads(resp.read())
+
+
+def _post_stream(addr, payload, timeout):
+    """Streamed /v1/generate: returns (n_tokens, clean).  urllib strips
+    the chunked framing, so the body is concatenated JSON documents —
+    decode them in sequence; ``clean`` means the terminal done-chunk
+    arrived (a mid-stream replica kill without resume truncates)."""
+    rq = urllib.request.Request(
+        addr + "/v1/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(rq, timeout=timeout) as resp:
+        raw = resp.read().decode("utf-8", "replace")
+    dec = json.JSONDecoder()
+    i, n, clean = 0, 0, False
+    while i < len(raw):
+        while i < len(raw) and raw[i] in " \r\n":
+            i += 1
+        if i >= len(raw):
+            break
+        obj, i = dec.raw_decode(raw, i)
+        if "token" in obj:
+            n += 1
+        if obj.get("done"):
+            clean = True
+    return n, clean
+
+
+def replay_streams(addr, arrivals, reqs, *, timeout=60.0, pool=None):
+    """Like replay() but over STREAMING requests: latency is measured
+    to the END of the stream, and each completion records its wall
+    offset so tail latency can be windowed around scale-down events."""
+    from concurrent.futures import ThreadPoolExecutor
+    outcomes = [None] * len(arrivals)
+    t_start = [0.0]
+
+    def fire(i, payload):
+        t0 = time.perf_counter()
+        rec = {"class": payload.get("priority", "batch")}
+        try:
+            n, clean = _post_stream(addr, payload, timeout)
+            rec.update(outcome="completed" if clean else "truncated",
+                       latency_s=time.perf_counter() - t0,
+                       done_at_s=time.perf_counter() - t_start[0],
+                       n_tokens=n)
+        except urllib.error.HTTPError as e:
+            e.read()
+            rec.update(outcome="shed" if e.code == 429 else "error",
+                       code=e.code)
+        except Exception as e:   # noqa: BLE001 — clean client error
+            rec.update(outcome="error", detail=str(e)[:120])
+        outcomes[i] = rec
+
+    own_pool = pool is None
+    if own_pool:
+        pool = ThreadPoolExecutor(max_workers=512)
+    lag = 0.0
+    try:
+        futs = []
+        t_start[0] = time.perf_counter()
+        for i, (at, payload) in enumerate(zip(arrivals, reqs)):
+            delay = t_start[0] + at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                lag = max(lag, -delay)
+            futs.append(pool.submit(fire, i, payload))
+        for fu in futs:
+            fu.result(timeout=timeout + 30)
+        wall = time.perf_counter() - t_start[0]
+    finally:
+        if own_pool:
+            pool.shutdown(wait=False)
+    assert all(o is not None for o in outcomes), "silently dropped!"
+    return outcomes, wall, lag, t_start[0]
+
+
+class ScaleDownStorm(threading.Thread):
+    """Periodic replica removal while traffic replays: the r14 A/B
+    lever.  ``drain=True`` goes through the drain protocol (ACTIVE ->
+    DRAINING -> teardown once idle / at the deadline); ``drain=False``
+    is the r13 path — scale_to kills a replica with requests in
+    flight.  Each pulse restores the fleet to ``n`` replicas so every
+    pulse starts from the same shape."""
+
+    def __init__(self, state, drain: bool, *, period: float,
+                 deadline_s: float, n: int, t0: float):
+        super().__init__(daemon=True)
+        self.st, self.drain = state, drain
+        self.period, self.deadline_s, self.n = period, deadline_s, n
+        self.t0 = t0
+        self.pulses = []          # wall offsets of each scale-down
+        self._halt = threading.Event()
+
+    def run(self):
+        while not self._halt.wait(self.period):
+            self.pulses.append(round(time.perf_counter() - self.t0, 2))
+            if self.drain:
+                self.st.drain_replicas(1, self.deadline_s)
+            else:
+                with self.st._lock:
+                    cur = len(self.st.replicas)
+                self.st.scale_to(max(1, cur - 1))
+            if self._halt.is_set():
+                return
+            # surge replacement IMMEDIATELY in both arms (the rolling-
+            # restart shape): capacity dips identically — only the
+            # treatment of the removed replica's in-flight work differs,
+            # which is exactly what the A/B measures.  The drained
+            # victim finishes in the background; drain_tick retires it.
+            self.st.scale_to(self.n)
+
+    def stop(self):
+        self._halt.set()
+
+
+def window_p99(outcomes, pulses, window_s=3.0):
+    """p99 stream latency over completions landing within ``window_s``
+    after any scale-down pulse — the tail the removal actually hurt."""
+    lat = [o["latency_s"] for o in outcomes
+           if o.get("outcome") == "completed"
+           and any(p <= o.get("done_at_s", -1) <= p + window_s
+                   for p in pulses)]
+    return _pct(lat, 99), len(lat)
 
 
 def replay(addr, arrivals, reqs, *, timeout=60.0, pool=None):
@@ -393,6 +520,103 @@ def main():
     serve.shutdown()
     load3 = loadavg()
 
+    # ---- phase 2: scale-down storm A/B (ISSUE 14 drain acceptance) -----
+    # the SAME steady streaming trace replayed against periodic replica
+    # removals, once the r13 way (kill + resume) and once drain-aware —
+    # same run, so replayed-token count and scale-down-window p99 are
+    # directly comparable.
+    storm_replicas = 3
+    storm_deadline = 8.0
+    storm_dur = max(dur, 8.0)
+    storm_period = storm_dur / 4.0
+    # storm load targets MODERATE occupancy: busy slots, shallow
+    # queues.  Too idle (the degradation-phase ``nominal``) and a
+    # replica removal is free — the A/B measures scheduler noise; at
+    # saturation BOTH arms drown in queueing and the dips dominate.
+    # In between, a kill catches a replica's worth of mid-decode
+    # streams whose replays are the visible tail — exactly the r13
+    # damage the drain exists to avoid.
+    storm_rate = min(nominal * 2.0, capacity * 0.6)
+
+    def storm_requests(r, n):
+        # LONG streams (vs the degradation-curve mix): a mid-stream
+        # kill then costs a real replay — prefill plus up to ~45 tokens
+        # — which is exactly the tail the drain protocol exists to
+        # avoid; short streams would bury the A/B in scheduler noise
+        reqs = []
+        for _ in range(n):
+            pl = int(r.integers(6, 13))
+            reqs.append({"prompt": r.integers(0, cfg.vocab_size,
+                                              pl).tolist(),
+                         "max_tokens": int(r.integers(32, 50)),
+                         "stream": True,
+                         "priority": ("interactive"
+                                      if r.random() < 0.7 else "batch")})
+        return reqs
+
+    def storm_arm(drain: bool):
+        la = loadavg()
+        dep2 = build_gpt_deployment(
+            cfg=cfg, engine_cfg=EngineConfig(max_slots=slots), seed=0,
+            num_replicas=storm_replicas, warm_on_init=True,
+            max_concurrent_queries=4 * slots)
+        serve.run(dep2, use_actors=False, http=True)
+        addr2 = serve.proxy_address()
+        f2 = fleet_mod.enable("v1", fleet_mod.FleetConfig(
+            rate=storm_rate * 2.0, burst=storm_rate,
+            max_queue_depth=int(storm_rate * 1.5),
+            interactive_wait_s=4.0, batch_wait_s=10.0, seed=14,
+            drain_deadline_s=storm_deadline))
+        st2 = serve.get_handle("v1")._state
+        _post(addr2, {"prompt": [1, 2], "max_tokens": 2}, 60)
+        r = np.random.default_rng(1400)           # SAME trace both arms
+        arr = _thin(r, lambda t: storm_rate, storm_rate, storm_dur)
+        reqs = storm_requests(r, len(arr))
+        t0 = time.perf_counter()
+        storm = ScaleDownStorm(st2, drain, period=storm_period,
+                               deadline_s=storm_deadline,
+                               n=storm_replicas, t0=t0)
+        storm.start()
+        outcomes, wall, lag, _ = replay_streams(addr2, arr, reqs,
+                                                timeout=60)
+        storm.stop()
+        storm.join(timeout=storm_deadline + 10)
+        # settle any drain still open before reading the counters
+        deadline = time.time() + storm_deadline + 5
+        while st2.draining and time.time() < deadline:
+            time.sleep(0.05)
+        snap2 = f2.fleet_snapshot()
+        wp99, wn = window_p99(outcomes, storm.pulses)
+        counts = {}
+        for o in outcomes:
+            counts[o["outcome"]] = counts.get(o["outcome"], 0) + 1
+        lat = [o["latency_s"] for o in outcomes
+               if o["outcome"] == "completed"]
+        serve.shutdown()
+        return {
+            "mode": "drain" if drain else "kill_resume",
+            "offered": len(outcomes),
+            "completed": counts.get("completed", 0),
+            "truncated": counts.get("truncated", 0),
+            "shed": counts.get("shed", 0),
+            "errors": counts.get("error", 0),
+            "wall_s": round(wall, 2),
+            "pacing_lag_s": round(lag, 3),
+            "scale_down_pulses": storm.pulses,
+            "p50_s": round(_pct(lat, 50), 4),
+            "p99_s": round(_pct(lat, 99), 4),
+            "scale_down_window_p99_s": round(wp99, 4),
+            "scale_down_window_n": wn,
+            "counters": {k: v for k, v in snap2.items()
+                         if isinstance(v, int)},
+            "loadavg_1m": [la, loadavg()],
+        }
+
+    storm_kill = storm_arm(drain=False)
+    print(f"storm kill+resume: {storm_kill}")
+    storm_drain = storm_arm(drain=True)
+    print(f"storm drain: {storm_drain}")
+
     # ---- assemble + acceptance gates -----------------------------------
     peak_slots = max((row["total_slots"] for row in sampler.rows),
                      default=0)
@@ -407,6 +631,8 @@ def main():
                        == snap["completed"] + snap["errored"]
                        + snap["cancelled"])
     nominal_p99 = fleet_phases["1.0x"]["interactive_p99_s"]
+    kc, dc = storm_kill["counters"], storm_drain["counters"]
+    n_pulses_drain = len(storm_drain["scale_down_pulses"])
     gates = {
         "total_slots_ge_64": peak_slots >= 64,
         "autoscaled": peak_replicas >= 4 and len(scale_events) >= 2,
@@ -414,6 +640,25 @@ def main():
             nominal_p99 <= SLO_INTERACTIVE_P99_S,
         "zero_silently_dropped": offered_total == accounted,
         "fleet_accounting_consistent": fleet_accounted,
+        # r14 drain acceptance: every scale-down accounted (drained /
+        # drain_timeout / resumed_scale_down), failure-resumes ZERO in
+        # both arms (no chaos ran), replay cost and scale-down-window
+        # tail both improved by draining — same-run A/B
+        "storm_zero_masked_resumes": (
+            kc["resumed_failure"] == 0 and dc["resumed_failure"] == 0
+            and dc["drained"] + dc["drain_timeout"] >= n_pulses_drain),
+        "storm_replayed_tokens_improved":
+            dc["replayed_tokens"] <= kc["replayed_tokens"],
+        # both windows must actually contain completions: _pct([]) is
+        # 0.0, and an empty window would pass (or fail) this vacuously
+        "storm_window_p99_improved": (
+            storm_kill["scale_down_window_n"] > 0
+            and storm_drain["scale_down_window_n"] > 0
+            and storm_drain["scale_down_window_p99_s"]
+            <= storm_kill["scale_down_window_p99_s"]),
+        "storm_no_truncated_streams":
+            storm_kill["truncated"] == 0
+            and storm_drain["truncated"] == 0,
     }
     artifact = {
         "round": perf.ROUND,
@@ -460,6 +705,34 @@ def main():
             "fleet_p99_s": fleet_phases["1.0x"]["p99_s"],
             "baseline_goodput": base_phases["1.0x"]["goodput_req_s"],
             "fleet_goodput": fleet_phases["1.0x"]["goodput_req_s"],
+        },
+        "scale_down_storm": {
+            "config": {"replicas": storm_replicas,
+                       "drain_deadline_s": storm_deadline,
+                       "pulse_period_s": round(storm_period, 2),
+                       "offered_rate_req_s": round(storm_rate, 1),
+                       "trace": "steady Poisson, all streaming, "
+                                "identical seed both arms"},
+            "kill_resume": storm_kill,
+            "drain": storm_drain,
+            "ab": {
+                "replayed_tokens": {
+                    "kill_resume": kc["replayed_tokens"],
+                    "drain": dc["replayed_tokens"]},
+                "scale_down_window_p99_s": {
+                    "kill_resume":
+                        storm_kill["scale_down_window_p99_s"],
+                    "drain": storm_drain["scale_down_window_p99_s"]},
+                "resumes": {
+                    "kill_resume": {
+                        "scale_down": kc["resumed_scale_down"],
+                        "failure": kc["resumed_failure"]},
+                    "drain": {
+                        "scale_down": dc["resumed_scale_down"],
+                        "failure": dc["resumed_failure"],
+                        "drained": dc["drained"],
+                        "drain_timeout": dc["drain_timeout"]}},
+            },
         },
         "ab_overload_4x": {
             "baseline_p99_s": base_phases["4.0x"]["p99_s"],

@@ -68,6 +68,21 @@ def nodes():
     return rt.client.request({"t": "state", "what": "nodes"})["data"]
 
 
+def drain_node(node_id: str, deadline_s: float = 30.0):
+    """Gracefully decommission a cluster node (reference: the
+    autoscaler's DrainNode request before terminating an instance).
+    The node goes ACTIVE -> DRAINING -> TERMINATED: no new task or
+    actor placements, queued specs re-park to the head, running tasks
+    get ``deadline_s`` to finish, then owned objects and ownership
+    records hand off to a survivor and the node exits — a planned
+    removal, never something peers mistake for a crash.  Past the
+    deadline the node exits anyway and the remaining recovery runs the
+    normal (lineage) failure path, explicitly."""
+    rt = get_runtime()
+    return rt.client.request({"t": "drain_node", "node_id": node_id,
+                              "deadline_s": float(deadline_s)})
+
+
 def timeline(filename=None):
     """Chrome-trace task timeline (reference: ray.timeline)."""
     from ray_tpu.util.state import timeline as _timeline
@@ -92,5 +107,5 @@ __all__ = [
     "ObjectLostError", "OutOfMemoryError", "RetryPolicy",
     "placement_group", "remove_placement_group", "PlacementGroup",
     "PlacementGroupSchedulingStrategy", "available_resources",
-    "cluster_resources", "nodes", "timeline",
+    "cluster_resources", "drain_node", "nodes", "timeline",
 ]

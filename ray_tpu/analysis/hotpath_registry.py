@@ -70,6 +70,10 @@ HOT_GATES: dict = {
         "functions": {
             "NodeService._h_flight_recorder": "gate",
             "NodeService.on_client_drop": "gate",
+            # decommission entry point: _fi on_drain trigger at the
+            # node_drain push (cold-rate, but the gate discipline is
+            # uniform across every hook site)
+            "NodeService._hh_node_drain": "gate",
             # arming/teardown — contract-exempt by design
             "NodeService.__init__": "cold",
         },
@@ -90,9 +94,12 @@ HOT_GATES: dict = {
         },
     },
     "ray_tpu.core.node_transfer": {
-        "aliases": ("_fr",),
+        "aliases": ("_fr", "_fi"),
         "functions": {
             "NodeTransferMixin._hh_node_dead": "gate",
+            # decommission handoff: _fi on_drain choke point just
+            # before the owned-object migration ships
+            "NodeTransferMixin._drain_handoff": "gate",
         },
     },
     "ray_tpu.core.node_workers": {
@@ -127,6 +134,15 @@ HOT_GATES: dict = {
         "functions": {
             "Fleet.note": "gate",          # _fr event copy when armed
             "Fleet._chaos": "gate",        # _fi serve_* trigger points
+        },
+    },
+    # serve controller: the drain state machine's chaos hook
+    # (replica_drain / replica_drain_timeout choke points) — one helper
+    # so every other controller function stays alias-free
+    "ray_tpu.serve.controller": {
+        "aliases": ("_fi",),
+        "functions": {
+            "DeploymentState._drain_chaos": "gate",
         },
     },
 }

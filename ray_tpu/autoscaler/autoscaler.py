@@ -5,9 +5,13 @@ Reference capability: StandardAutoscaler
 reconciliation of demand vs supply) driven by the head's load view:
 nodes report queued (unplaceable-now) resource demand in heartbeats, and
 the head aggregates it in the state API.  Scale-up launches provider
-nodes while queued demand persists; scale-down terminates nodes that
-have been idle (nothing running, nothing queued) past the timeout —
-never below min_workers, never above max_workers.
+nodes while queued demand persists; scale-down DRAINS nodes that have
+been idle (nothing running, nothing queued) past the timeout — the head
+flips them to DRAINING (no new placements), they hand off owned state
+and exit via drain_done, and only THEN does the provider instance
+terminate (with a drain-deadline backstop so a wedged node still goes
+away) — never below min_workers, never above max_workers.  Planned
+removal must never masquerade as node failure.
 
 Runs as a thread against a live HeadService (in-process mode) or
 standalone against a node/head address via an observer connection
@@ -31,6 +35,10 @@ class AutoscalerConfig:
     min_workers: int = 0
     max_workers: int = 4
     idle_timeout_s: float = 30.0
+    # graceful scale-down: how long a draining node gets to finish its
+    # running work + hand off owned objects before the provider
+    # instance is terminated regardless
+    drain_deadline_s: float = 30.0
     # how long queued demand must persist before launching (debounce —
     # a burst the current nodes will drain in one tick shouldn't scale)
     upscale_delay_s: float = 1.0
@@ -56,8 +64,13 @@ class Autoscaler:
         # (nodes self-identify via the provider_node_id label, so the
         # mapping is exact, never join-order guesswork)
         self._launched: set[str] = set()
+        # provider id -> {"hex", "deadline"}: nodes mid-drain; the
+        # instance terminates once the node leaves the membership (it
+        # exited via drain_done) or at the deadline backstop
+        self._draining: dict[str, dict] = {}
         self.num_launches = 0
         self.num_terminations = 0
+        self.num_drains = 0
 
     # -- cluster view -------------------------------------------------------
 
@@ -118,9 +131,29 @@ class Autoscaler:
             self._launch()
             managed += 1
 
-        # ---- scale down: managed nodes idle past the timeout
-        remaining = len(managed_nodes)
+        # ---- finish in-flight drains: terminate the provider instance
+        # once the node has LEFT the membership (it exited cleanly via
+        # drain_done) or its deadline backstop passed
+        for pid, d in list(self._draining.items()):
+            n = managed_nodes.get(pid)
+            gone = n is None or not n.get("alive", True)
+            if gone or now >= d["deadline"]:
+                del self._draining[pid]
+                self.num_terminations += 1
+                try:
+                    self.provider.terminate_node(pid)
+                except Exception:
+                    traceback.print_exc()
+
+        # ---- scale down: managed nodes idle past the timeout DRAIN
+        # first (graceful decommission through the head), terminate
+        # only after the node exits — planned removal, not a kill that
+        # peers mistake for a crash
+        remaining = len(managed_nodes) - len(
+            set(managed_nodes) & set(self._draining))
         for pid, n in managed_nodes.items():
+            if pid in self._draining:
+                continue
             h = n["node_id"]
             busy = (sum(n["queued"].values()) > 0
                     or any(n["available"].get(k, 0.0) + 1e-9
@@ -134,9 +167,14 @@ class Autoscaler:
                     and remaining > cfg.min_workers):
                 self._idle_since.pop(h, None)
                 remaining -= 1
-                self.num_terminations += 1
+                self.num_drains += 1
+                self._draining[pid] = {
+                    "hex": h,
+                    # node deadline + margin for the handoff/exit ticks
+                    "deadline": now + cfg.drain_deadline_s + 15.0,
+                }
                 try:
-                    self.provider.terminate_node(pid)
+                    self.head.request_drain(h, cfg.drain_deadline_s)
                 except Exception:
                     traceback.print_exc()
 

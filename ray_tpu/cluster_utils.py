@@ -125,6 +125,27 @@ class Cluster:
         """Hard-stop a node (loop + workers) so the head detects death."""
         node.stop()
 
+    def drain_node(self, node: NodeService,
+                   deadline_s: float = 30.0) -> None:
+        """Gracefully decommission a node: the head flips it to
+        DRAINING (no new placements) and pushes node_drain; the node
+        re-parks its queue, finishes running work under the deadline,
+        hands owned objects to a survivor, and exits via drain_done."""
+        self.head.request_drain(node.node_id.hex(), deadline_s)
+
+    def wait_node_gone(self, node: NodeService,
+                       timeout: float = 60.0) -> None:
+        """Block until the head no longer counts ``node`` alive (drain
+        complete or death detected)."""
+        deadline = time.time() + timeout
+        h = node.node_id.hex()
+        while time.time() < deadline:
+            rec = self.head.nodes.get(h)
+            if rec is not None and not rec.alive:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"node {h[:12]} still alive after {timeout}s")
+
     def shutdown(self) -> None:
         for n in self.nodes:
             try:

@@ -399,13 +399,11 @@ class FaultPlan:
                 r.fn(svc, rec, msg)
         return drop
 
-    def on_serve(self, point: str, ctx: dict) -> None:
-        """Scripted triggers in the serve fleet path (points:
-        ``serve_route`` — after the router picks a replica;
-        ``serve_stream`` — per streamed chunk).  ``ctx`` carries
-        {"fleet", "replica", ...}; a scripted ``fn(ctx)`` can e.g. kill
-        the routed replica mid-stream (fleet.kill_replica) to prove the
-        request resumes elsewhere or fails cleanly — never hangs."""
+    def _scripted_ctx_rules(self, point: str, ctx: dict,
+                            detail) -> None:
+        """Shared matcher for the ctx-dict trigger hooks (on_serve /
+        on_drain): fire every rule on ``point``, noting ``detail``;
+        scripted fns run OUTSIDE the lock (they may re-enter hooks)."""
         fire = []
         with self._lock:
             for r in self.rules:
@@ -415,12 +413,43 @@ class FaultPlan:
                     continue
                 if not r.decide(self, point, ctx):
                     continue
-                self._note(point, r.action,
-                           getattr(ctx.get("replica"), "tag", None))
+                self._note(point, r.action, detail)
                 fire.append(r)
-        for r in fire:   # outside the lock: fn may re-enter hooks
+        for r in fire:
             if r.fn is not None:
                 r.fn(ctx)
+
+    def on_serve(self, point: str, ctx: dict) -> None:
+        """Scripted triggers in the serve fleet path (points:
+        ``serve_route`` — after the router picks a replica;
+        ``serve_stream`` — per streamed chunk).  ``ctx`` carries
+        {"fleet", "replica", ...}; a scripted ``fn(ctx)`` can e.g. kill
+        the routed replica mid-stream (fleet.kill_replica) to prove the
+        request resumes elsewhere or fails cleanly — never hangs."""
+        self._scripted_ctx_rules(
+            point, ctx, getattr(ctx.get("replica"), "tag", None))
+
+    def on_drain(self, point: str, ctx: dict) -> None:
+        """Scripted triggers at drain/decommission choke points (the
+        graceful-removal state machine, chaos-provable like everything
+        else).  Points:
+
+          * ``replica_drain``          — serve controller moved a
+            replica ACTIVE -> DRAINING (ctx: {"state", "replica"})
+          * ``replica_drain_timeout``  — a drain hit its deadline and
+            fell back to the explicit kill+resume path
+          * ``node_drain``             — a node received the
+            decommission request (ctx: {"node"})
+          * ``node_drain_handoff``     — just before the node ships its
+            owned-object/ownership handoff to a survivor
+
+        A scripted ``fn(ctx)`` can e.g. hard-kill the node mid-handoff
+        to prove lineage reconstruction still covers what the handoff
+        didn't (tests/test_drain_chaos.py)."""
+        self._scripted_ctx_rules(
+            point, ctx,
+            getattr(ctx.get("replica"), "tag", None)
+            or getattr(ctx.get("node"), "address", None))
 
     def on_service_tick(self, svc) -> None:
         fire = []

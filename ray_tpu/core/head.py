@@ -57,6 +57,12 @@ class NodeRec:
     labels: dict = field(default_factory=dict)   # e.g. provider_node_id
     last_beat: float = field(default_factory=time.monotonic)
     alive: bool = True
+    # graceful decommission (ACTIVE -> DRAINING -> TERMINATED): a
+    # draining node takes no NEW placements but keeps heartbeating and
+    # finishing what it holds until drain_done (or the forced deadline)
+    draining: bool = False
+    drain_deadline: float = 0.0   # monotonic; forced-removal backstop
+    death_cause: str = ""         # why the node left the membership
 
 
 @dataclass
@@ -352,13 +358,15 @@ class HeadService(ClusterStoreMixin, EventLoopService):
 
     def _view(self) -> dict:
         return {h: {"address": n.address, "total": n.total,
-                    "available": n.available, "alive": n.alive}
+                    "available": n.available, "alive": n.alive,
+                    "draining": n.draining}
                 for h, n in self.nodes.items() if n.alive}
 
     def _choose_node(self, demand: dict,
                      prefer: Optional[str] = None,
                      spread_by_actor_count: bool = False,
-                     arg_ids: tuple = ()) -> Optional[str]:
+                     arg_ids: tuple = (),
+                     include_draining: bool = False) -> Optional[str]:
         """The hybrid scheduling policy (reference:
         raylet/scheduling/policy/hybrid_scheduling_policy.cc +
         locality-aware lease targeting, core_worker/lease_policy.h:56).
@@ -388,6 +396,11 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         best_key, pool = None, []
         for h, n in self.nodes.items():
             if not n.alive:
+                continue
+            if n.draining and not include_draining:
+                # a draining node takes no new placements — unless it is
+                # the ONLY feasible host (the fallback pass below): a
+                # drain should delay work, never fail it
                 continue
             if not all(n.total.get(k, 0.0) + 1e-9 >= v
                        for k, v in demand.items()):
@@ -419,6 +432,16 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             elif key == best_key:
                 pool.append(h)
         if not pool:
+            # TASK fallback only: a task routed to a draining node still
+            # finishes (drain waits for running work), but an ACTOR
+            # placed there would just die at decommission — actors fail
+            # placement explicitly instead
+            if not include_draining and not spread_by_actor_count \
+                    and any(n.alive and n.draining
+                            for n in self.nodes.values()):
+                return self._choose_node(
+                    demand, prefer=prefer,
+                    arg_ids=arg_ids, include_draining=True)
             return None
         return pool[self._sched_rng.randrange(len(pool))]
 
@@ -482,6 +505,16 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         for h, n in list(self.nodes.items()):
             if n.alive and n.last_beat < cutoff:
                 self._node_dead(h, "heartbeat timeout")
+        # decommission backstop: a node that never reported drain_done
+        # (wedged mid-handoff, lost its head channel) is force-removed
+        # at its deadline — the EXPLICIT timeout path; peers then run
+        # the normal lineage recovery for whatever the drain didn't ship
+        now = time.monotonic()
+        for h, n in list(self.nodes.items()):
+            if n.alive and n.draining and n.drain_deadline \
+                    and now >= n.drain_deadline:
+                self._node_dead(h, "decommissioned (drain deadline "
+                                   "forced)")
         # backstop for a 2PC whose participant is alive but never replies
         # (node death mid-2PC is handled eagerly in _node_dead)
         stuck = time.monotonic() - max(10.0, 3 * timeout)
@@ -528,6 +561,7 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         if n is None or not n.alive:
             return
         n.alive = False
+        n.death_cause = cause    # planned removals say "decommissioned"
         # tell everyone first so source nodes can start recovery
         for other in self.nodes.values():
             if other.alive:
@@ -594,6 +628,62 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                 self._push(c, {"t": "actor_at", "actor_id": ad.actor_id,
                                "state": "dead", "death_cause": cause})
         ad.watchers.clear()
+
+    # -------------------------------------------------- graceful drain
+
+    def _begin_node_drain(self, node_hex: str,
+                          deadline_s: float) -> Optional[str]:
+        """Start decommissioning ``node_hex``; returns an error string
+        or None.  The node goes ACTIVE -> DRAINING here (no new
+        placements the moment the flag is set), gets the ``node_drain``
+        push, and leaves the membership only via drain_done — or the
+        forced on_tick backstop at deadline + grace."""
+        n = self.nodes.get(node_hex)
+        if n is None or not n.alive:
+            return f"no alive node {node_hex[:12]}"
+        deadline_s = max(0.0, float(deadline_s))
+        if not n.draining:
+            n.draining = True
+            # the node enforces deadline_s itself and then hands off;
+            # the head's forced backstop waits a grace on top so a
+            # healthy handoff is never raced by its own supervisor
+            n.drain_deadline = time.monotonic() + deadline_s + 10.0
+            c = self.clients.get(n.conn_id)
+            if c is not None:
+                self._push(c, {"t": "node_drain",
+                               "deadline_s": deadline_s})
+            self._publish("node_state", {"node_id": node_hex,
+                                         "state": "draining"})
+            self._broadcast_view()
+        return None
+
+    def request_drain(self, node_hex: str,
+                      deadline_s: float = 30.0) -> None:
+        """Thread-safe drain entry point (the autoscaler's scale-down
+        path calls this from its own thread)."""
+        self.post(lambda: self._begin_node_drain(node_hex, deadline_s))
+
+    def _h_drain_node(self, rec: ClientRec, m: dict) -> None:
+        err = self._begin_node_drain(m["node_id"],
+                                     m.get("deadline_s", 30.0))
+        if "reqid" in m:
+            if err is not None:
+                self._reply(rec, m["reqid"], error=err)
+            else:
+                self._reply(rec, m["reqid"], ok=True, draining=True)
+
+    def _h_drain_done(self, rec: ClientRec, m: dict) -> None:
+        """The draining node finished (tasks done or its deadline hit,
+        handoff shipped): retire it as a PLANNED removal.  The node_dead
+        fan-out still runs — it is the safety net that lets lineage
+        reconstruction cover anything the handoff didn't."""
+        h = m.get("node_id") or rec.node_hex
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True)
+        cause = ("decommissioned (drain deadline, explicit fallback)"
+                 if m.get("timed_out")
+                 else "decommissioned (drain complete)")
+        self._node_dead(h, cause)
 
     # ------------------------------------------------------------ routing
 
@@ -1008,7 +1098,8 @@ class HeadService(ClusterStoreMixin, EventLoopService):
         ``idle=True`` — against an idle cluster's totals (the exact
         feasibility oracle: a PG is worth queueing iff a plan exists on
         the idle cluster)."""
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values()
+                 if n.alive and not n.draining]
         cap = (lambda n: n.total) if idle else (lambda n: n.available)
         if not alive:
             return None
@@ -1072,7 +1163,8 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                          "resources": dict(n.total),
                          "available": dict(n.available),
                          "queued": dict(n.queued),
-                         "labels": dict(n.labels), "alive": n.alive}
+                         "labels": dict(n.labels), "alive": n.alive,
+                         "draining": n.draining}
                         for h, n in list(self.nodes.items())]
             except RuntimeError:   # dict changed size during iteration
                 if attempt == 3:

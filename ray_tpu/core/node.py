@@ -223,6 +223,18 @@ class NodeService(NodeWorkersMixin, NodeTransferMixin, NodeSchedMixin,
         self._head_out: list = []
         self._peer_out: dict[int, tuple] = {}   # id(conn) -> (conn, [msgs])
 
+        # ---- graceful decommission (ACTIVE -> DRAINING -> TERMINATED):
+        # armed by the head's node_drain push.  While draining: no new
+        # work is queued here (specs forward to the head unless the head
+        # explicitly routed them back), running tasks finish under the
+        # deadline, then owned objects / ownership records hand off to a
+        # survivor and the node exits via drain_done.
+        self._draining = False
+        self._drain_deadline = 0.0
+        self._drain_state = ""           # "" | waiting | handoff | done
+        self._drain_timed_out = False
+        self._drain_acks_pending: set[str] = set()   # survivor node hexes
+
         self._last_hb = 0.0
         self._hb_period = config.heartbeat_period_ms / 1000.0
         # ticks must run at least as often as heartbeats are due
@@ -255,6 +267,8 @@ class NodeService(NodeWorkersMixin, NodeTransferMixin, NodeSchedMixin,
         self._sweep_released()
         self._memory_check()
         self._expire_parked_actor_waits()
+        if self._draining:
+            self._drain_check()
         self._heartbeat()
 
     def _cleanup(self) -> None:
@@ -834,6 +848,93 @@ class NodeService(NodeWorkersMixin, NodeTransferMixin, NodeSchedMixin,
                 self._reply(w, reqid, ok=True,
                             replicated=bool(reply.get("replicated")))
         self._head_rpc({"t": "snapshot_now"}, cb)
+
+    # ------------------------------------------------- graceful drain
+
+    def _h_drain_node(self, rec, m):
+        """Client entry point for decommissioning a cluster node: the
+        request proxies to the head (which owns membership and flips the
+        target to DRAINING).  Standalone nodes have nowhere to drain
+        to."""
+        if self._cluster_scope(rec, m):
+            return
+        self._reply(rec, m["reqid"],
+                    error="standalone node: nothing to drain to "
+                          "(drain_node needs a cluster)")
+
+    def _hh_node_drain(self, m: dict) -> None:
+        """Head push: decommission this node gracefully.  From here on
+        the lifecycle is DRAINING: queued specs re-park to the head,
+        new local submissions forward, running tasks get ``deadline_s``
+        to finish, then the owned-object handoff ships and the node
+        exits via drain_done (node.py hosts the state machine; the
+        handoff itself lives in node_transfer)."""
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_state = "waiting"
+        self._drain_deadline = (time.monotonic()
+                                + float(m.get("deadline_s", 30.0)))
+        sys.stderr.write("[node] draining for decommission "
+                         f"(deadline {m.get('deadline_s', 30.0)}s)\n")
+        fi = _fi._active
+        if fi is not None:
+            fi.on_drain("node_drain", {"node": self})
+        self._repark_queued_to_head()
+        self._drain_check()
+
+    def _drain_busy(self) -> bool:
+        """Work the drain must wait for — everything that will still
+        EXECUTE here: tasks running on workers, actor method calls in
+        flight OR queued (an actor can't move, so its queue drains
+        here), specs still in the runnable queues (only PG-bound and
+        head-routed-back specs remain there during a drain — both run
+        here by design), and dep-waiting specs (they either forward on
+        resolution or run here; either way exiting under them drops
+        work).  Conservative signals are safe: the deadline caps the
+        wait, and past it the EXPLICIT timeout path runs."""
+        for rec in self.clients.values():
+            if rec.current_task is not None:
+                return True
+        for ar in self.actors.values():
+            # an actor whose CREATION is still in flight (worker
+            # spawning) must reach alive before the drain can judge its
+            # queue — exiting under it strands calls parked at their
+            # submitters awaiting the locate
+            if ar.state in ("pending", "restarting"):
+                return True
+            if ar.state != "dead" and (ar.running or ar.queue):
+                return True
+        if self.runnable_cpu or self.runnable_tpu or self.runnable_zero:
+            return True
+        if self.dep_waiting:
+            return True
+        return False
+
+    def _drain_check(self) -> None:
+        if self._drain_state != "waiting":
+            return
+        timed_out = time.monotonic() >= self._drain_deadline
+        if self._drain_busy() and not timed_out:
+            return
+        self._drain_timed_out = timed_out and self._drain_busy()
+        self._drain_state = "handoff"
+        self._drain_handoff()
+
+    def _drain_finish(self) -> None:
+        """Handoff shipped (and acked, or the ack window closed): tell
+        the head this removal is COMPLETE — never a surprise — then
+        stop.  The head's node_dead fan-out still runs as the safety
+        net for anything the handoff didn't cover."""
+        if self._drain_state == "done":
+            return
+        self._drain_state = "done"
+        self._head_rpc({"t": "drain_done",
+                        "node_id": self.node_id.hex(),
+                        "timed_out": self._drain_timed_out},
+                       lambda reply: self._stop.set())
+        # backstop: head unreachable / reply lost — exit anyway
+        self.post_later(5.0, self._stop.set)
 
     def _h_stop_node(self, rec, m):
         """Hard-stop this node on request — the chaos-testing kill switch

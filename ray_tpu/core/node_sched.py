@@ -122,6 +122,35 @@ class NodeSchedMixin:
                 self._forward_task(spec)
                 moved += 1
 
+    def _repark_queued_to_head(self) -> None:
+        """Drain begin: queued-but-unstarted specs leave for the head so
+        the decommission never waits on a backlog (and the backlog never
+        dies with the node).  Placement-group specs stay — their bundle
+        reservation lives on this node and cannot move.  The head gets
+        a fresh placement choice for everything else; if this node is
+        truly the only feasible host it routes the spec straight back
+        (reply local=True) and the drain waits for it like any running
+        work."""
+        if self.head_conn is None:
+            return
+        moved = 0
+        for q in (self.runnable_cpu, self.runnable_tpu,
+                  self.runnable_zero):
+            keep: list = []
+            while q:
+                spec = self._queue_pop(q)
+                if spec.get("placement_group"):
+                    keep.append(spec)
+                    continue
+                self._forward_task(spec)
+                moved += 1
+            for spec in keep:
+                self._make_runnable(spec)
+        if moved:
+            import sys as _sys
+            _sys.stderr.write(f"[node] drain re-parked {moved} queued "
+                              "spec(s) to the head\n")
+
     # -- tasks
 
     def _h_submit_task(self, rec, m):
@@ -173,7 +202,7 @@ class NodeSchedMixin:
         demand = self._demand(spec)
         me = self.node_id.hex()
         for h, n in self.cluster_view.items():
-            if h == me or not n.get("alive"):
+            if h == me or not n.get("alive") or n.get("draining"):
                 continue
             if all(n["available"].get(k, 0.0) + 1e-9 >= v
                    for k, v in demand.items()):
@@ -184,6 +213,15 @@ class NodeSchedMixin:
         routed = spec.get("_routed")
         pg = spec.get("placement_group")
         clustered = self.head_conn is not None and not routed
+        if self._draining and clustered and pg is None:
+            # DRAINING: nothing new starts here.  Un-routed specs leave
+            # for the head, which places them on a survivor; specs the
+            # head explicitly routed BACK (this node is the only
+            # feasible host) fall through and run — a drain delays
+            # work, never bounces it forever.  PG specs stay: their
+            # bundle lives here.
+            self._forward_task(spec)
+            return
         if pg is not None:
             if (pg[0], pg[1]) not in self.pg_available:
                 if clustered:
@@ -272,6 +310,15 @@ class NodeSchedMixin:
         self._admit_task(spec)
 
     def _make_runnable(self, spec: dict) -> None:
+        if self._draining and self.head_conn is not None \
+                and not spec.get("_routed") \
+                and not spec.get("placement_group"):
+            # a dep-waiting spec resolved MID-drain: forward instead of
+            # queueing (the drain-begin re-park only saw the runnable
+            # queues).  _routed specs are terminal here — the head
+            # already chose this node — so no forward ping-pong.
+            self._forward_task(spec)
+            return
         if _fr._active is not None:
             _fr._active.stamp(spec, "enqueue")
         if spec.get("num_tpus"):

@@ -29,6 +29,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ray_tpu.core import fault_injection as _fi
 from ray_tpu.core import flight_recorder as _fr
 from ray_tpu.core import protocol
 from ray_tpu.core.ids import ObjectID
@@ -1375,6 +1376,10 @@ class NodeTransferMixin:
                 # a holder may report on a conn WE opened to it earlier
                 self._owner_add_location(m["object_id"], m["node"],
                                          m["address"])
+            elif t == "owner_handoff_ack":
+                # decommission handoff landed on the survivor: the
+                # drain can finish (and this node can exit) safely
+                self._drain_ack(node_hex)
             elif t == "shutdown":
                 self._drop_peer(node_hex)
             # replies (e.g. to our peer register) are ignored
@@ -1493,6 +1498,143 @@ class NodeTransferMixin:
 
     def _hh_delete_object(self, m: dict) -> None:
         self._delete_local_object(ObjectID(m["object_id"]))
+
+    # -- decommission handoff ------------------------------------------------
+
+    def _drain_handoff(self) -> None:
+        """The object-plane half of a graceful decommission: before this
+        node exits, (a) objects it OWNS migrate — bytes when held here,
+        plus the ownership record (locations, producer task id, retained
+        lineage spec) — to one survivor, which becomes their new
+        location authority; (b) objects owned ELSEWHERE whose possibly-
+        only copy lives here have their VALUE pushed to the owner, so
+        the owner never needs lineage re-execution for a PLANNED
+        removal.  Consumers holding stale owner hints fall back through
+        the head directory (owner-unreachable path), which knows the
+        survivor's copies.  Lineage reconstruction remains the safety
+        net for anything this handoff didn't ship (chaos-proven by
+        killing the node mid-handoff)."""
+        fi = _fi._active
+        if fi is not None:
+            fi.on_drain("node_drain_handoff", {"node": self})
+        if self._stop.is_set():
+            return      # chaos killed us mid-decommission: no handoff
+        me = self.node_id.hex()
+        survivor = None
+        for h, n in self.cluster_view.items():
+            if h != me and n.get("alive") and not n.get("draining"):
+                survivor = (h, n.get("address"))
+                break
+        owned_entries: list[dict] = []
+        for oid, info in list(self.objects.items()):
+            if info.state not in ("ready", "error") \
+                    and not (info.state == "pending" and info.owner_node
+                             and info.owner_node[0] == me):
+                continue
+            if info.loc == "device":
+                continue    # HBM buffers die with their process
+            ob = oid.binary()
+            data = None
+            if info.loc == "inline":
+                data = info.data
+            elif info.loc == "shm":
+                try:
+                    if self.store.is_spilled(oid):
+                        self.store.restore(oid)
+                    data = bytes(self.store._shm.map(oid))
+                except Exception:
+                    data = None
+            if info.owner_node and info.owner_node[0] == me:
+                # owned here: full record (+ bytes when we hold them)
+                orec = self.owned.get(ob)
+                lin = None
+                if orec is not None and orec.task_id:
+                    entry = self.lineage.get(orec.task_id)
+                    if entry is not None:
+                        lin = entry.get("spec")
+                owned_entries.append({
+                    "object_id": ob, "data": data,
+                    "is_error": info.is_error,
+                    "task_id": orec.task_id if orec else b"",
+                    "locations": dict(orec.locations) if orec else {},
+                    "lineage": lin,
+                })
+            elif data is not None and info.owner_node:
+                # owner elsewhere, bytes here (maybe the only copy):
+                # ship the VALUE straight to the owner — the existing
+                # forwarded-inline-result push, reused verbatim
+                self._owner_push(
+                    info.owner_node[0], info.owner_node[1],
+                    {"t": "owner_object_value", "object_id": ob,
+                     "data": data, "is_error": info.is_error,
+                     "node": me, "address": self.address})
+        if survivor is not None and owned_entries:
+            hexn, addr = survivor
+            self._drain_acks_pending.add(hexn)
+
+            def go(conn, hexn=hexn):
+                if conn is None:
+                    self.post(lambda: self._drain_ack(hexn))
+                    return
+                try:
+                    conn.send({"t": "owner_handoff",
+                               "from_hex": me,
+                               "from_addr": self.address,
+                               "objects": owned_entries})
+                except protocol.ConnectionClosed:
+                    self.post(lambda: self._drain_ack(hexn))
+            self._peer_conn_async(hexn, addr, go)
+            sys.stderr.write(f"[node] drain handoff: {len(owned_entries)}"
+                             f" owned object(s) -> node {hexn[:8]}\n")
+            # bounded ack wait: a wedged survivor must not hold the
+            # decommission open forever
+            self.post_later(5.0, self._drain_finish)
+        else:
+            self._drain_finish()
+
+    def _drain_ack(self, node_hex: str) -> None:
+        self._drain_acks_pending.discard(node_hex)
+        if not self._drain_acks_pending:
+            self._drain_finish()
+
+    def _h_owner_handoff(self, rec, m):
+        """A draining peer hands us its owned objects: store the bytes,
+        ADOPT the ownership records (this node becomes the location
+        authority: locations, producer task ids, retained lineage), and
+        report the new copies so head-directory fallback finds them the
+        moment the drained node exits."""
+        from_hex = m.get("from_hex", "")
+        adopted = 0
+        for ent in m.get("objects", ()):
+            ob = ent["object_id"]
+            oid = ObjectID(ob)
+            info = self.objects.setdefault(oid, ObjInfo())
+            lin = ent.get("lineage")
+            if lin is not None:
+                # install the producer spec FIRST: _record_lineage also
+                # creates the OwnedRec entries for its return ids
+                self._record_lineage(lin)
+            orec = self.owned.get(ob)
+            if orec is None:
+                orec = self.owned[ob] = OwnedRec()
+            orec.task_id = orec.task_id or ent.get("task_id", b"")
+            for h, a in (ent.get("locations") or {}).items():
+                if h != from_hex:
+                    orec.locations[h] = a
+            info.owner_node = (self.node_id.hex(), self.address)
+            data = ent.get("data")
+            if data is not None and info.state == "pending":
+                info.state = "error" if ent.get("is_error") else "ready"
+                info.loc = "inline"
+                info.data = data
+                info.size = len(data)
+                info.is_error = bool(ent.get("is_error"))
+                self._resolve_waiters(oid, info)
+            adopted += 1
+        sys.stderr.write(f"[node] adopted {adopted} owned object(s) "
+                         f"from draining node {from_hex[:8]}\n")
+        self._push(rec, {"t": "owner_handoff_ack",
+                         "node_hex": self.node_id.hex()})
 
     # -- node death recovery -------------------------------------------------
 

@@ -33,7 +33,8 @@ from __future__ import annotations
 from ray_tpu.inference.cache import KVCacheManager
 from ray_tpu.inference.decode import make_decode_step, make_prefill_fn
 from ray_tpu.inference.engine import (PRIORITY_BATCH, PRIORITY_INTERACTIVE,
-                                      EngineConfig, EngineStoppedError,
+                                      EngineConfig, EngineDrainingError,
+                                      EngineStoppedError,
                                       GenerationRequest, InferenceEngine,
                                       metrics_snapshot)
 from ray_tpu.inference.serving import (GPTServer, build_gpt_deployment,
@@ -41,7 +42,8 @@ from ray_tpu.inference.serving import (GPTServer, build_gpt_deployment,
 
 __all__ = [
     "KVCacheManager", "make_decode_step", "make_prefill_fn",
-    "EngineConfig", "EngineStoppedError", "GenerationRequest",
+    "EngineConfig", "EngineDrainingError", "EngineStoppedError",
+    "GenerationRequest",
     "InferenceEngine", "PRIORITY_BATCH", "PRIORITY_INTERACTIVE",
     "metrics_snapshot", "GPTServer", "build_gpt_deployment",
     "encode_prompt", "parse_stream_chunks",

@@ -58,12 +58,12 @@ class EngineConfig:
     idle_wait_s: float = 0.05            # loop park interval when empty
 
 
-# priority classes + the replica-death base error live in the jax-free
-# serve.qos module (the fleet's generic machinery imports them from
-# there); re-exported here for the engine's own API surface.
+# priority classes + the replica-death/draining errors live in the
+# jax-free serve.qos module (the fleet's generic machinery imports them
+# from there); re-exported here for the engine's own API surface.
 from ray_tpu.serve.qos import (PRIORITY_BATCH,           # noqa: F401
-                               PRIORITY_INTERACTIVE, ReplicaDeadError,
-                               parse_priority)
+                               PRIORITY_INTERACTIVE, EngineDrainingError,
+                               ReplicaDeadError, parse_priority)
 
 
 class EngineStoppedError(ReplicaDeadError):
@@ -236,6 +236,7 @@ class InferenceEngine:
         self._req_seq = itertools.count()
         self._cond = threading.Condition()
         self._stopped = False
+        self._draining = False
 
         # metrics (guarded by _cond's lock via _mlock simplicity: own lock)
         self._mlock = threading.Lock()
@@ -290,6 +291,9 @@ class InferenceEngine:
         with self._cond:
             if self._stopped:
                 raise EngineStoppedError("engine is shut down")
+            if self._draining:
+                raise EngineDrainingError(
+                    "engine is draining (planned scale-down)")
             if len(self._waiting) >= ec.max_waiting:
                 raise RuntimeError(
                     f"engine admission queue full ({ec.max_waiting})")
@@ -463,12 +467,33 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- admin
 
+    def drain(self) -> None:
+        """Begin a graceful drain (planned scale-down): admit nothing
+        new — ``submit()`` raises the typed EngineDrainingError so the
+        fleet re-routes instead of 500ing — hand already-QUEUED waiters
+        back for re-routing the same way, and let the in-flight slots
+        decode to completion.  The engine reads drained once
+        ``active_slots == 0``; the controller then tears it down.
+        Idempotent; a no-op on a stopped engine."""
+        with self._cond:
+            if self._stopped or self._draining:
+                return
+            self._draining = True
+            waiting, self._waiting = self._waiting, []
+            self._cond.notify_all()
+        err = EngineDrainingError(
+            "engine is draining (planned scale-down)")
+        for r in waiting:
+            if not r.done:
+                r._finish(err)
+
     def stats(self) -> dict:
         with self._cond:
             waiting = len(self._waiting)
             interactive = sum(1 for r in self._waiting
                               if r.priority <= PRIORITY_INTERACTIVE)
             stopped = self._stopped
+            draining = self._draining
         with self._mlock:
             iters = self._decode_iterations
             occ = (self._occupancy_sum / iters) if iters else 0.0
@@ -482,6 +507,7 @@ class InferenceEngine:
             "waiting_requests": waiting,
             "waiting_interactive": interactive,
             "stopped": stopped,
+            "draining": draining,
             "batch_occupancy": occ,
             "generated_tokens": generated,
             "requests_completed": completed,

@@ -35,8 +35,9 @@ from typing import Optional, Sequence, Union
 
 import jax
 
-from ray_tpu.inference.engine import (EngineConfig, EngineStoppedError,
-                                      InferenceEngine, parse_priority)
+from ray_tpu.inference.engine import (EngineConfig, EngineDrainingError,
+                                      EngineStoppedError, InferenceEngine,
+                                      parse_priority)
 from ray_tpu.models import gpt
 from ray_tpu.models.gpt import GPTConfig
 from ray_tpu.serve.deployment import (AutoscalingConfig, Deployment,
@@ -81,6 +82,7 @@ class GPTServer:
         self.engine_cfg = engine_cfg or EngineConfig()
         self._warm = warm_on_init
         self._closed = False
+        self._draining = False
         from ray_tpu.serve.controller import get_replica_context
         ctx = get_replica_context()
         self.replica_tag = (ctx.replica_tag if ctx is not None
@@ -137,6 +139,11 @@ class GPTServer:
     def _engine_for(self, req: dict) -> InferenceEngine:
         if self._closed:
             raise EngineStoppedError("replica closed")
+        if self._draining:
+            # the route/drain race window: the router picked this
+            # replica just as the controller marked it DRAINING — the
+            # typed error re-routes (never a 500, never a failure count)
+            raise EngineDrainingError("replica is draining (scale-down)")
         if self._mux is None:
             return self.engine
         return self._mux.get(req.get("model"))
@@ -216,6 +223,13 @@ class GPTServer:
                        if self._mux is not None else []),
             "stopped": self._closed or not engines
             or all(s["stopped"] for s in stats),
+            # replica-LEVEL drain flag: the router skips draining
+            # replicas as candidates without dead-marking them (they are
+            # alive — just not accepting new work).  Deliberately NOT
+            # derived from the engines' own draining flags: an engine
+            # drained out-of-band is the route/drain race, and the typed
+            # EngineDrainingError out of submit() is what covers it.
+            "draining": self._draining,
         }
 
     def loaded_variants(self) -> list:
@@ -223,6 +237,17 @@ class GPTServer:
 
     def multiplex_stats(self) -> Optional[dict]:
         return self._mux.stats() if self._mux is not None else None
+
+    def drain(self) -> None:
+        """Replica drain hook (DeploymentState.drain_replicas): stop
+        admitting — queued engine waiters are handed back as
+        EngineDrainingError for re-routing — while in-flight slots
+        decode to completion.  The controller polls ``fleet_stats``
+        until active_slots reaches 0 (or the drain deadline) before
+        tearing the replica down."""
+        self._draining = True
+        for eng in self._engines():
+            eng.drain()
 
     def health(self):
         st = self.fleet_stats()

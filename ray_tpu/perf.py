@@ -20,7 +20,7 @@ import numpy as np
 
 # bumped every growth round so committed evidence files (PERF_rNN.json)
 # are self-identifying; scale_envelope.py shares this stamp
-ROUND = 13
+ROUND = 14
 
 
 def _loadavg() -> float:

@@ -22,7 +22,19 @@ import traceback
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu.core import fault_injection as _fi
 from ray_tpu.serve.deployment import Deployment
+
+# replica lifecycle (the drain state machine): every PLANNED removal
+# goes ACTIVE -> DRAINING -> STOPPED instead of ACTIVE -> killed.  A
+# DRAINING replica is out of the routable membership (router, admission
+# and the published snapshot all stop seeing it) but keeps finishing
+# its in-flight work until the controller's drain tick observes it idle
+# — or its deadline expires, at which point the EXPLICIT fallback is
+# the kill+resume path, counted as drain_timeout, never masked.
+LIFECYCLE_ACTIVE = "active"
+LIFECYCLE_DRAINING = "draining"
+LIFECYCLE_STOPPED = "stopped"
 
 
 @dataclass
@@ -119,6 +131,8 @@ class ReplicaHandle:
     is_actor: bool
     tag: str = ""                  # stable identity ("<deployment>#<n>")
     ongoing: int = 0               # in-flight queries (router-side count)
+    lifecycle: str = LIFECYCLE_ACTIVE
+    drain_deadline: float = 0.0    # monotonic; set when DRAINING
 
 
 class DeploymentState:
@@ -131,6 +145,12 @@ class DeploymentState:
         self.deployment = deployment
         self.use_actors = use_actors
         self.replicas: list[ReplicaHandle] = []
+        # replicas mid-drain: OUT of the routable membership (router /
+        # assign_replica / the published snapshot only see
+        # self.replicas) but not yet torn down — drain_tick() settles
+        # them to STOPPED, and restart_dead never sees them, so
+        # self-heal cannot resurrect a deliberate drain
+        self.draining: list[ReplicaHandle] = []
         self._rr = itertools.count()
         self._replica_seq = itertools.count()
         self._lock = threading.Lock()
@@ -173,12 +193,24 @@ class DeploymentState:
                              False, tag)
 
     def scale_to(self, n: int) -> None:
+        """Immediate (non-draining) reconciliation to ``n`` replicas.
+        Excess replicas are KILLED in place — the kill+resume path.  The
+        autoscaler's shrink uses drain_replicas instead; this path
+        remains for deploy/delete/explicit scaling, and marks its
+        victims STOPPED first so any in-flight request that dies with
+        them is classified as a scale-down resume, never a failure."""
         n = max(0, n)
         changed = False
         removed: list[ReplicaHandle] = []
         with self._lock:
             while len(self.replicas) > n:
                 removed.append(self.replicas.pop())
+                changed = True
+            if n == 0 and self.draining:
+                # scaling to zero (delete/redeploy) pre-empts any drain
+                # in progress: tear the draining replicas down too
+                removed.extend(self.draining)
+                self.draining.clear()
                 changed = True
             missing = n - len(self.replicas)
         # replica construction runs OUTSIDE the lock: building can be
@@ -195,16 +227,139 @@ class DeploymentState:
         # teardown outside the lock: a slow user teardown must not block
         # routing (assign_replica) on the deployment lock
         for r in removed:
-            try:
-                if r.is_actor:
-                    import ray_tpu
-                    ray_tpu.kill(r.impl)
-                else:
-                    r.impl.close()
-            except Exception:
-                traceback.print_exc()
+            self._teardown_replica(r)
         if changed:
             self._membership_changed()
+
+    def _teardown_replica(self, r: ReplicaHandle) -> None:
+        """Kill a replica's body.  Marking it STOPPED first lets the
+        fleet's resume path classify the death of anything still in
+        flight as ``resumed_scale_down`` (a deliberate removal), not
+        ``resumed_failure`` — the r13 masking bug inverted."""
+        r.lifecycle = LIFECYCLE_STOPPED
+        try:
+            if r.is_actor:
+                import ray_tpu
+                ray_tpu.kill(r.impl)
+            else:
+                r.impl.close()
+        except Exception:
+            traceback.print_exc()
+
+    # -- graceful drain (planned scale-down) -------------------------------
+
+    def drain_replicas(self, n: int, deadline_s: float = 30.0, *,
+                       reason: str = "scale_down",
+                       replicas: Optional[list] = None
+                       ) -> list[ReplicaHandle]:
+        """Move ``n`` replicas ACTIVE -> DRAINING: out of the routable
+        membership immediately, bodies told to stop admitting
+        (``drain()`` hook), teardown deferred to drain_tick() — which
+        waits for in-flight work to finish or the deadline to pass.
+        ``replicas`` targets specific handles (tests / operator
+        maintenance); default picks from the tail."""
+        deadline = time.monotonic() + max(0.0, float(deadline_s))
+        moved: list[ReplicaHandle] = []
+        with self._lock:
+            pool = (list(replicas) if replicas is not None
+                    else list(reversed(self.replicas)))
+            for r in pool:
+                if len(moved) >= n or r not in self.replicas:
+                    continue
+                self.replicas.remove(r)
+                r.lifecycle = LIFECYCLE_DRAINING
+                r.drain_deadline = deadline
+                self.draining.append(r)
+                moved.append(r)
+        for r in moved:
+            self._begin_body_drain(r)
+            if self.fleet is not None:
+                self.fleet.note("drain_begin", replica=r.tag,
+                                reason=reason,
+                                deadline_s=round(float(deadline_s), 3))
+            self._drain_chaos("replica_drain", replica=r)
+        if moved:
+            self._membership_changed()
+        return moved
+
+    def _drain_chaos(self, point: str, **ctx) -> None:
+        """Fault-plane hook on the drain path (points: replica_drain /
+        replica_drain_timeout): zero-overhead gate when disarmed."""
+        fi = _fi._active
+        if fi is None:
+            return
+        ctx["state"] = self
+        fi.on_drain(point, ctx)
+
+    def _begin_body_drain(self, r: ReplicaHandle) -> None:
+        """Tell the replica body to stop admitting (best-effort: bodies
+        without a drain() hook simply finish their in-flight calls —
+        r.ongoing is the signal drain_tick waits on for those)."""
+        try:
+            if r.is_actor:
+                r.impl.handle_request.remote("drain", (), {})
+            else:
+                drain = getattr(getattr(r.impl, "_user", None), "drain",
+                                None)
+                if callable(drain):
+                    drain()
+        except Exception:
+            traceback.print_exc()
+
+    def _replica_drained(self, r: ReplicaHandle) -> bool:
+        """True once nothing is left in flight on a draining replica:
+        router-held calls released AND (when the body exposes engine
+        gauges) no active slots or queued engine work."""
+        if r.ongoing > 0:
+            return False
+        try:
+            if r.is_actor:
+                import ray_tpu
+                st = ray_tpu.get(
+                    r.impl.handle_request.remote("fleet_stats", (), {}),
+                    timeout=5)
+            else:
+                user = getattr(r.impl, "_user", None)
+                probe = getattr(user, "fleet_stats", None)
+                st = probe() if callable(probe) else None
+        except Exception:
+            return True     # body already dead: nothing left to wait for
+        if not st or st.get("stopped"):
+            return True
+        return (int(st.get("active_slots", 0)) == 0
+                and int(st.get("waiting_requests", 0)) == 0)
+
+    def drain_tick(self) -> None:
+        """Settle DRAINING replicas: drained -> teardown (counted
+        ``drained``); deadline passed -> EXPLICIT fallback to the
+        kill+resume path (counted ``drain_timeout`` — in-flight streams
+        die with the typed replica-death error and resume elsewhere,
+        classified as scale-down resumes, never masked)."""
+        with self._lock:
+            snapshot = list(self.draining)
+        if not snapshot:
+            return
+        now = time.monotonic()
+        for r in snapshot:
+            done = self._replica_drained(r)
+            timed_out = not done and now >= r.drain_deadline
+            if not done and not timed_out:
+                continue
+            with self._lock:
+                if r not in self.draining:
+                    continue    # a concurrent settle won the race
+                self.draining.remove(r)
+            fleet = self.fleet
+            if timed_out:
+                if fleet is not None:
+                    fleet._count("drain_timeout")
+                    fleet.note("drain_timeout", replica=r.tag,
+                               in_flight=r.ongoing)
+                self._drain_chaos("replica_drain_timeout", replica=r)
+            elif fleet is not None:
+                fleet._count("drained")
+                fleet.note("drain_complete", replica=r.tag)
+            self._teardown_replica(r)
 
     def restart_dead(self) -> int:
         """Health-check replicas; replace dead ones (reference:
@@ -216,6 +371,11 @@ class DeploymentState:
         with self._lock:
             snapshot = list(enumerate(self.replicas))
         for i, r in snapshot:
+            if r.lifecycle != LIFECYCLE_ACTIVE:
+                # lifecycle, not just probe health: a DRAINING replica
+                # reads "unhealthy-ish" the moment its engines wind down
+                # — self-heal must never resurrect a deliberate drain
+                continue
             ok = True
             if r.is_actor:
                 import ray_tpu
@@ -325,6 +485,15 @@ class DeploymentState:
         if desired != cur:
             if fleet is not None:
                 fleet.note("scale", replicas_from=cur, replicas_to=desired)
+                if desired < cur:
+                    # planned scale-down DRAINS (ACTIVE -> DRAINING ->
+                    # teardown once idle / at the deadline) instead of
+                    # killing replicas with requests in flight — the
+                    # r13 trace showed the kill path masking 27 resumes
+                    self.drain_replicas(
+                        cur - desired,
+                        getattr(fleet.cfg, "drain_deadline_s", 30.0))
+                    return
             self.scale_to(desired)
 
 
@@ -422,6 +591,7 @@ class ServeController:
                 for st in list(self.deployments.values()):
                     try:
                         st.autoscale_tick()
+                        st.drain_tick()
                         # fleet deployments self-heal: a replica whose
                         # engine died (chaos kill, crash) is replaced
                         # so routing capacity recovers without operator

@@ -78,8 +78,8 @@ def metrics_snapshot() -> list:
     ctrl = _serve._controller
     if ctrl is None:
         return []
-    admitted, shed, resumed, queued, replicas, slots = \
-        {}, {}, {}, {}, {}, {}
+    admitted, shed, queued, replicas, slots = {}, {}, {}, {}, {}
+    resumed_fail, resumed_scale, drained, drain_to = {}, {}, {}, {}
     for name, st in list(ctrl.deployments.items()):
         f = getattr(st, "fleet", None)
         if f is None:
@@ -88,7 +88,10 @@ def metrics_snapshot() -> list:
         snap = f.fleet_snapshot()
         admitted[key] = float(snap["admitted"])
         shed[key] = float(snap["shed"])
-        resumed[key] = float(snap["resumed"])
+        resumed_fail[key] = float(snap["resumed_failure"])
+        resumed_scale[key] = float(snap["resumed_scale_down"])
+        drained[key] = float(snap["drained"])
+        drain_to[key] = float(snap["drain_timeout"])
         queued[key] = float(snap["ingress_queued"])
         replicas[key] = float(snap["replicas"])
         slots[key] = float(snap["total_slots"])
@@ -99,8 +102,16 @@ def metrics_snapshot() -> list:
          "Requests admitted through the fleet ingress", admitted),
         ("serve_fleet_shed_total", "counter",
          "Requests shed (429) at the fleet ingress", shed),
-        ("serve_fleet_resumed_total", "counter",
-         "Requests re-routed after a replica death", resumed),
+        ("serve_fleet_resumed_failure_total", "counter",
+         "Requests re-routed after a replica CRASH", resumed_fail),
+        ("serve_fleet_resumed_scale_down_total", "counter",
+         "Requests re-routed off a planned replica removal",
+         resumed_scale),
+        ("serve_fleet_drained_total", "counter",
+         "Replicas retired empty via graceful drain", drained),
+        ("serve_fleet_drain_timeout_total", "counter",
+         "Drains that hit the deadline and fell back to kill+resume",
+         drain_to),
         ("serve_fleet_ingress_queue_depth", "gauge",
          "Requests parked in the admission queue", queued),
         ("serve_fleet_replicas", "gauge",

@@ -42,7 +42,8 @@ from ray_tpu.core import flight_recorder as _fr
 from ray_tpu.serve.fleet.admission import (AdmissionController, ShedError,
                                            parse_priority)
 from ray_tpu.serve.fleet.router import NoReplicaError, OccupancyRouter
-from ray_tpu.serve.qos import PRIORITY_BATCH, ReplicaDeadError
+from ray_tpu.serve.qos import (PRIORITY_BATCH, EngineDrainingError,
+                               ReplicaDeadError)
 
 
 def _is_replica_death(e: BaseException, replica) -> bool:
@@ -64,6 +65,23 @@ def _is_replica_death(e: BaseException, replica) -> bool:
     return False
 
 
+def _resume_kind(e: BaseException, replica) -> str:
+    """Classify a replica-death re-route: planned removal (drain race /
+    drain-timeout kill / explicit scale_to kill — the replica's
+    lifecycle already left "active", or the typed draining error) vs a
+    genuine failure.  Splitting the counter is what makes the r13
+    masking bug impossible to reintroduce silently: a scale-down that
+    eats resumes now shows up under ``resumed_scale_down``, and
+    ``resumed_failure`` staying 0 without chaos is an assertable
+    invariant."""
+    if isinstance(e, EngineDrainingError):
+        return "resumed_scale_down"
+    if replica is not None \
+            and getattr(replica, "lifecycle", "active") != "active":
+        return "resumed_scale_down"
+    return "resumed_failure"
+
+
 @dataclass
 class FleetConfig:
     """Ingress knobs for one deployment's fleet layer."""
@@ -75,19 +93,29 @@ class FleetConfig:
     batch_wait_s: float = 10.0
     retry_on_replica_failure: bool = True
     max_resume_attempts: int = 2         # re-routes after a replica death
+    drain_deadline_s: float = 30.0       # DRAINING -> forced kill+resume
     seed: int = 0                        # router's p2c rng
     keep_events: int = 8192
 
 
 @dataclass
 class FleetCounters:
+    """Request accounting.  Identity (asserted in tests): every admitted
+    request ends in exactly one of completed/errored/cancelled, and
+    every replica-death re-route is classified — there is deliberately
+    NO aggregate ``resumed`` field, so a new death path MUST pick a
+    class (``fleet_snapshot`` derives the sum for compatibility)."""
     admitted: int = 0
     shed: int = 0
     rejected: int = 0                    # malformed envelope (client bug)
     completed: int = 0
     errored: int = 0
     cancelled: int = 0                   # consumer abandoned the stream
-    resumed: int = 0                     # replica-death re-routes
+    resumed_failure: int = 0             # re-route after a CRASH
+    resumed_scale_down: int = 0          # re-route off a planned removal
+    drained: int = 0                     # replicas retired empty
+    drain_timeout: int = 0               # drains that fell back to kill
+    replayed_tokens: int = 0             # resume-replay cost (skipped)
 
 
 class Fleet:
@@ -181,6 +209,9 @@ class Fleet:
                 waiting += int(st.get("waiting_requests", 0))
         with self._clock:
             counters = dict(self.counters.__dict__)
+        # compatibility aggregate (the split fields are authoritative)
+        counters["resumed"] = (counters["resumed_failure"]
+                               + counters["resumed_scale_down"])
         return {
             "replicas": len(reps),
             "total_slots": slots,
@@ -310,9 +341,10 @@ class _FleetResponse:
                     exclude.append(replica.tag)
                     if attempt >= attempts:
                         raise
-                    fleet._count("resumed")
+                    kind = _resume_kind(e, replica)
+                    fleet._count(kind)
                     fleet.note("resume", from_replica=replica.tag,
-                               attempt=attempt + 1)
+                               resume_kind=kind, attempt=attempt + 1)
                     continue
                 if hasattr(out, "__next__"):
                     # stream: the wrapper owns release + resume +
@@ -409,7 +441,11 @@ def fleet_stream(fleet: Fleet, gen: Iterator, replica, args, kwargs,
                     if isinstance(chunk, dict):
                         idx = chunk.get("index")
                         if idx is not None and idx < emitted:
-                            continue      # resume replay: already sent
+                            # resume replay: already sent — counted, so
+                            # the replay COST of every resume path is a
+                            # visible number, not free-looking work
+                            fleet._count("replayed_tokens")
+                            continue
                     fleet._chaos("serve_stream", replica=held,
                                  index=emitted)
                     yield chunk
@@ -424,6 +460,7 @@ def fleet_stream(fleet: Fleet, gen: Iterator, replica, args, kwargs,
                 if held is None or not _is_replica_death(e, held):
                     raise
                 dead_tag = held.tag
+                kind = _resume_kind(e, held)
                 fleet.router.mark_dead(held)
                 fleet.router.release(held)
                 held = None
@@ -432,9 +469,10 @@ def fleet_stream(fleet: Fleet, gen: Iterator, replica, args, kwargs,
                     if attempts_left <= 0:
                         raise
                     attempts_left -= 1
-                    fleet._count("resumed")
+                    fleet._count(kind)
                     fleet.note("resume", from_replica=dead_tag,
-                               mid_stream=True, emitted=emitted)
+                               resume_kind=kind, mid_stream=True,
+                               emitted=emitted)
                     # re-route (NoReplicaError here fails the request
                     # promptly — a clean error, never a hang), replay
                     held = fleet.router.assign(model,
@@ -451,6 +489,7 @@ def fleet_stream(fleet: Fleet, gen: Iterator, replica, args, kwargs,
                         if not _is_replica_death(e2, held):
                             raise
                         dead_tag = held.tag
+                        kind = _resume_kind(e2, held)
                         fleet.router.mark_dead(held)
                         fleet.router.release(held)
                         held = None

@@ -106,6 +106,13 @@ class OccupancyRouter:
                maxq: int) -> Optional[tuple]:
         """(load, waiting, jitter) — lower routes first; None = not a
         candidate (dead or saturated)."""
+        if replica.lifecycle != "active":
+            # lifecycle outranks probe health: a DRAINING replica is
+            # alive (it still finishes in-flight streams) but must not
+            # take new work — and it is NOT dead-marked, because a
+            # dead-mark expires (DEAD_TTL_S) and expiry must never
+            # resurrect a deliberate drain
+            return None
         try:
             st = self.probe(replica)
         except Exception:
@@ -113,6 +120,10 @@ class OccupancyRouter:
         if st is not None and st.get("stopped"):
             with self._mlock:
                 self._dead[replica.tag] = time.monotonic()
+            return None
+        if st is not None and st.get("draining"):
+            # the body began draining before the controller's membership
+            # move landed: skip as a candidate without dead-marking
             return None
         if replica.ongoing >= maxq:
             return None
@@ -129,7 +140,11 @@ class OccupancyRouter:
 
     def live_replicas(self) -> list[ReplicaHandle]:
         with self._state._lock:
-            reps = list(self._state.replicas)
+            # DRAINING replicas live in state.draining, not here — but
+            # filter on lifecycle anyway so any transitional window
+            # (drain marked, membership move racing) stays unroutable
+            reps = [r for r in self._state.replicas
+                    if r.lifecycle == "active"]
         # dead-marks and probe-cache entries for replicas no longer in
         # the membership are stale (controller replaced them — tags are
         # never reused), and surviving marks expire after DEAD_TTL_S —

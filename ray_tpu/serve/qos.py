@@ -43,3 +43,13 @@ class ReplicaDeadError(RuntimeError):
     observable side effects, so it re-routes to another replica
     (streams replay and skip the already-delivered prefix).  The
     engine's EngineStoppedError subclasses this."""
+
+
+class EngineDrainingError(ReplicaDeadError):
+    """The serving replica is DRAINING (planned scale-down): it finishes
+    what it already holds but admits nothing new.  A typed subclass so
+    the ingress maps it to a re-route — like a replica death, the
+    request had no observable side effects — but ACCOUNTS it as
+    ``resumed_scale_down``, never as a failure resume, and never as a
+    500.  Lives here (jax-free) so the generic fleet machinery can
+    classify without importing the inference stack."""

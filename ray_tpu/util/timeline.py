@@ -76,6 +76,11 @@ def build_trace(task_events: Iterable = (), records: Iterable = (),
             "args": {"detail": f.get("detail")},
         })
 
+    # drain lifecycle pairing: a drain_begin and its settling
+    # drain_complete / drain_timeout (same replica) render as ONE slice
+    # so the drain DURATION is visible time; unpaired events fall back
+    # to instants below
+    drain_open: dict = {}    # replica tag -> begin event
     for g in ingress:
         # g: fleet ingress event — {"t", "kind", "deployment", ...}
         # (serve/fleet/ingress.py Fleet.note); an admit that waited in
@@ -93,10 +98,35 @@ def build_trace(task_events: Iterable = (), records: Iterable = (),
                 "pid": "ingress", "tid": "admit", "args": args,
             })
             continue
+        if kind == "drain_begin" and g.get("replica") is not None:
+            drain_open[g["replica"]] = g
+            continue
+        if kind in ("drain_complete", "drain_timeout") \
+                and g.get("replica") in drain_open:
+            begin = drain_open.pop(g["replica"])
+            t0 = float(begin.get("t", 0.0)) * 1e6
+            args["outcome"] = kind
+            args["reason"] = begin.get("reason")
+            ev.append({
+                "name": f"ingress:drain:{g['replica']}",
+                "cat": "ingress", "ph": "X",
+                "ts": t0, "dur": max(0.0, ts - t0),
+                "pid": "ingress", "tid": "drain", "args": args,
+            })
+            continue
         ev.append({
             "name": f"ingress:{kind}", "cat": "ingress", "ph": "i",
             "s": "g", "ts": ts, "pid": "ingress", "tid": kind,
             "args": args,
+        })
+    for tag, begin in drain_open.items():
+        # drain still in progress at export time: show the begin
+        ev.append({
+            "name": "ingress:drain_begin", "cat": "ingress", "ph": "i",
+            "s": "g", "ts": float(begin.get("t", 0.0)) * 1e6,
+            "pid": "ingress", "tid": "drain",
+            "args": {k: v for k, v in begin.items()
+                     if k not in ("t", "kind")},
         })
 
     ev.sort(key=lambda e: e.get("ts", 0.0))

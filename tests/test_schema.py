@@ -112,6 +112,28 @@ class TestMessages:
                        "reqid": 3})
         assert m["t"] == "need_space" and m["nbytes"] == 1 << 20
 
+    def test_drain_protocol_messages_roundtrip(self):
+        """The decommission wire vocabulary (drain_node / node_drain /
+        drain_done / owner_handoff) rides the typed Raw envelope —
+        pinned here so the shapes can't drift silently."""
+        m = roundtrip({"t": "drain_node", "node_id": "ab" * 16,
+                       "deadline_s": 12.5, "reqid": 7})
+        assert m["t"] == "drain_node" and m["deadline_s"] == 12.5
+        m = roundtrip({"t": "node_drain", "deadline_s": 30.0})
+        assert m["t"] == "node_drain" and "reqid" not in m
+        m = roundtrip({"t": "drain_done", "node_id": "cd" * 16,
+                       "timed_out": False, "reqid": 9})
+        assert m["t"] == "drain_done" and m["timed_out"] is False
+        m = roundtrip({"t": "owner_handoff", "from_hex": "ef" * 16,
+                       "from_addr": "127.0.0.1:1",
+                       "objects": [{"object_id": b"\x01" * 20,
+                                    "data": b"bytes", "is_error": False,
+                                    "task_id": b"\x02" * 14,
+                                    "locations": {"aa": "x:1"},
+                                    "lineage": None}]})
+        assert m["objects"][0]["data"] == b"bytes"
+        assert m["objects"][0]["locations"] == {"aa": "x:1"}
+
     def test_empty_oneof_arm_selected(self):
         # an all-defaults message must still carry its type
         m = roundtrip({"t": "get_objects", "object_ids": []})
