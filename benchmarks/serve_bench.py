@@ -1,25 +1,32 @@
-"""Inference serving benchmark → SERVE_r10.json.
+"""Inference serving benchmark → SERVE_r15.json.
 
-The acceptance A/B for the continuous-batching engine: same box, same
-run, same model size —
+Same-box, same-run A/B receipts for the inference engine, round 15:
+the PAGED KV cache (block pool + radix prefix reuse + chunked prefill)
+against the r10/r14 SLOT engine (``EngineConfig(paged=False)`` — the
+exact baseline that shipped), plus the original continuous-vs-
+sequential ratio the r10 acceptance pinned.
 
-  * baseline_sequential    — naive one-request-at-a-time serving: an
-    engine with max_slots=1, requests submitted strictly back-to-back
-    (each waits for the previous to finish).  This is what serving looks
-    like without iteration-level scheduling: the decode batch is always
-    width 1.
-  * continuous_batching    — the real engine (max_slots=8), the same
-    request set offered concurrently; admissions interleave with decode
-    so the batch stays full.
+Arms:
 
-Both halves run the SAME compiled decode path and the SAME request mix
-(prompt/max_new per request are seeded identically), so the ratio
-isolates continuous batching itself.  A third section drives the full
-HTTP path (asyncio ingress → replica → engine) at a fixed offered load
-for p50/p99 wall latency.
+  * continuous_batching   — r10's gate on the paged engine: the same
+    request set sequential (max_slots=1) vs concurrent (max_slots=8);
+    ratio >= 2.0.
+  * shared_prefix         — N requests over K distinct prompt HEADS
+    (the system-prompt shape): slot engine re-prefills every prompt in
+    full; the paged engine adopts the cached head blocks by refcount
+    and prefills only the divergent tail.  Gate: paged/slot req/s
+    ratio >= 1.5 at equal pool bytes.
+  * mixed_storm           — long-prompt storm over a mixed-length
+    request set at EQUAL POOL BYTES: the slot engine's worst-case
+    stripes cap it at pool_tokens/max_seq concurrent requests; the
+    paged engine admits by actual block usage (and chunked prefill
+    keeps short requests' first tokens flowing while long prompts
+    prefill).  Gates: strictly higher peak concurrent requests, zero
+    silently-dropped requests in BOTH arms.
 
-loadavg is recorded per the box-variance caveat in PERF.md: only the
-in-run A/B ratio is comparable across days, never the absolutes.
+Both halves of every arm run in the same process minutes apart, so
+only in-run ratios are portable (PERF.md box-variance caveat); loadavg
+is stamped per phase.
 
 Run:  JAX_PLATFORMS=cpu python benchmarks/serve_bench.py [--quick]
 """
@@ -30,11 +37,12 @@ import argparse
 import json
 import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+ROUND = 15
 
 
 def _pct(xs, p):
@@ -56,164 +64,251 @@ def make_requests(n, *, seed, vocab, prompt_len, max_new):
     return out
 
 
-def run_engine_side(params, cfg, reqs, *, max_slots, concurrent):
+def make_shared_prefix_requests(n, *, seed, vocab, heads, head_len,
+                                tail_len, max_new):
+    """N requests over K distinct prompt heads (shared system prompts),
+    each with a divergent random tail."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    head_toks = [rng.integers(0, vocab, head_len).tolist()
+                 for _ in range(heads)]
+    out = []
+    for i in range(n):
+        head = head_toks[i % heads]
+        tail = rng.integers(0, vocab, tail_len).tolist()
+        out.append((head + tail, max_new))
+    return out
+
+
+def make_mixed_requests(*, seed, vocab, n_short, n_long, short_len,
+                        long_len, short_new, long_new):
+    """Short interactive requests interleaved with long-prompt storms."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    out = []
+    longs = set(np.linspace(0, n_short + n_long - 1, n_long).astype(int))
+    for i in range(n_short + n_long):
+        if i in longs:
+            pl = int(rng.integers(long_len // 2, long_len + 1))
+            out.append((rng.integers(0, vocab, pl).tolist(), long_new))
+        else:
+            pl = int(rng.integers(short_len // 2, short_len + 1))
+            out.append((rng.integers(0, vocab, pl).tolist(), short_new))
+    return out
+
+
+def run_engine_arm(params, cfg, reqs, engine_cfg, *, concurrent=True):
     """Drive one engine over the request set; returns throughput +
-    latency stats.  ``concurrent=False`` = strict one-at-a-time."""
-    from ray_tpu.inference import EngineConfig, InferenceEngine
-    eng = InferenceEngine(params, cfg, EngineConfig(
-        max_slots=max_slots, max_seq=cfg.max_seq))
-    # warm both compiled programs (prefill + step) off the clock
-    eng.generate(reqs[0][0], max_new=2, timeout=300)
-    lat, toks = [], 0
+    latency + capacity stats.  ``concurrent=False`` = strict
+    one-at-a-time (the sequential baseline)."""
+    from ray_tpu.inference import InferenceEngine
+    eng = InferenceEngine(params, cfg, engine_cfg)
+    # warm ALL compiled programs off the clock with a dedicated prompt
+    # (NOT from the request set, so the timed region's prefix hits are
+    # earned, not inherited from warmup): the first run takes the cold
+    # full-width prefill, the second hits the prefix cache and takes
+    # the chunked path; both compile the decode step
+    wp = [(i % 7) + 1 for i in range(int(cfg.max_seq) * 3 // 4)]
+    eng.generate(wp, max_new=2, timeout=600)
+    eng.generate(wp, max_new=2, timeout=600)
+    lat, ttft, toks, errors = [], [], 0, 0
     t0 = time.perf_counter()
     if concurrent:
         handles = [eng.submit(p, max_new=m) for p, m in reqs]
         for h in handles:
-            out = h.result(timeout=600)
+            try:
+                out = h.result(timeout=900)
+            except Exception:
+                errors += 1
+                continue
             lat.append(h.finished_s - h.created_s)
+            ttft.append(h.first_token_s - h.created_s)
             toks += len(out)
     else:
         for p, m in reqs:
             h = eng.submit(p, max_new=m)
-            out = h.result(timeout=600)
+            try:
+                out = h.result(timeout=900)
+            except Exception:
+                errors += 1
+                continue
             lat.append(h.finished_s - h.created_s)
+            ttft.append(h.first_token_s - h.created_s)
             toks += len(out)
     wall = time.perf_counter() - t0
     st = eng.stats()
     eng.shutdown()
-    return {
+    out = {
         "requests": len(reqs),
+        "completed": len(lat),
+        "errors": errors,
+        "dropped": len(reqs) - len(lat) - errors,   # MUST be 0
         "wall_s": round(wall, 3),
-        "req_s": round(len(reqs) / wall, 2),
+        "req_s": round(len(lat) / wall, 2),
         "tokens_s": round(toks / wall, 1),
         "p50_s": round(_pct(lat, 50), 4),
         "p99_s": round(_pct(lat, 99), 4),
+        "ttft_p50_s": round(_pct(ttft, 50), 4),
+        "ttft_p99_s": round(_pct(ttft, 99), 4),
         "batch_occupancy": round(st["batch_occupancy"], 3),
-        "max_slots": max_slots,
+        "max_slots": st["max_slots"],
+        "peak_active_requests": st["peak_active_requests"],
+        "cache_bytes": st["cache_bytes"],
+        "paged": st["paged"],
     }
+    if st["paged"]:
+        out.update({
+            "pool_tokens": st["blocks_total"] * st["block_size"],
+            "prefix_hit_rate": round(st["prefix_hit_rate"], 4),
+            "prefix_hit_tokens": st["prefix_hit_tokens"],
+            "preemptions": st["preemptions"],
+        })
+    else:
+        out["pool_tokens"] = st["max_slots"] * engine_cfg_max_seq(
+            engine_cfg, cfg)
+    return out
 
 
-def run_http_side(cfg, reqs, *, max_slots, offered_concurrency):
-    """Fixed offered load through the asyncio HTTP ingress."""
-    import urllib.request
-
-    from ray_tpu import serve
-    from ray_tpu.inference import EngineConfig, build_gpt_deployment
-    serve.run(build_gpt_deployment(
-        cfg=cfg, engine_cfg=EngineConfig(max_slots=max_slots), seed=0),
-        use_actors=False, http=True)
-    addr = serve.proxy_address()
-
-    def post(payload):
-        rq = urllib.request.Request(
-            addr + "/v1/generate", data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(rq, timeout=600) as resp:
-            return json.loads(resp.read())
-
-    post({"prompt": reqs[0][0], "max_tokens": 2})   # warm
-    lat, errs, toks = [], [], 0
-    lock = threading.Lock()
-    it = iter(reqs)
-
-    def worker():
-        nonlocal toks
-        while True:
-            with lock:
-                try:
-                    p, m = next(it)
-                except StopIteration:
-                    return
-            t0 = time.perf_counter()
-            try:
-                out = post({"prompt": p, "max_tokens": m})["result"]
-                with lock:
-                    lat.append(time.perf_counter() - t0)
-                    toks += out["n"]
-            except Exception as e:   # noqa: BLE001
-                with lock:
-                    errs.append(str(e))
-
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=worker)
-               for _ in range(offered_concurrency)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
-    serve.shutdown()
-    return {
-        "requests": len(lat),
-        "errors": len(errs),
-        "offered_concurrency": offered_concurrency,
-        "wall_s": round(wall, 3),
-        "sustained_req_s": round(len(lat) / wall, 2),
-        "tokens_s": round(toks / wall, 1),
-        "p50_s": round(_pct(lat, 50), 4),
-        "p99_s": round(_pct(lat, 99), 4),
-    }
+def engine_cfg_max_seq(ecfg, cfg):
+    return int(ecfg.max_seq or cfg.max_seq)
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--out", default="SERVE_r10.json")
-    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--out", default="SERVE_r15.json")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
 
+    from ray_tpu.inference import EngineConfig
     from ray_tpu.models import gpt
 
-    cfg = gpt.GPTConfig(vocab_size=512, max_seq=128, d_model=128,
-                        n_heads=4, n_layers=4, d_ff=512, remat=False,
+    # big enough that compute (not per-call dispatch) dominates — the
+    # prefill/decode cost ratios then resemble the real serving shape
+    cfg = gpt.GPTConfig(vocab_size=512, max_seq=256, d_model=256,
+                        n_heads=8, n_layers=6, d_ff=1024, remat=False,
                         dtype=jnp.float32)
     params = gpt.init_params(cfg, jax.random.PRNGKey(0))
-    n_req = args.requests or (8 if args.quick else 32)
-    reqs = make_requests(n_req, seed=7, vocab=cfg.vocab_size,
-                         prompt_len=16, max_new=24 if args.quick else 32)
+    q = args.quick
 
-    load0 = os.getloadavg()[0]
-    base = run_engine_side(params, cfg, reqs, max_slots=1,
-                           concurrent=False)
-    cont = run_engine_side(params, cfg, reqs, max_slots=8,
-                           concurrent=True)
-    http = run_http_side(cfg, reqs, max_slots=8,
-                         offered_concurrency=8)
-    load1 = os.getloadavg()[0]
+    phases = {}
+
+    def phase(name, fn):
+        l0 = os.getloadavg()[0]
+        t0 = time.perf_counter()
+        result = fn()
+        phases[name] = {
+            "loadavg_1m_before": round(l0, 2),
+            "loadavg_1m_after": round(os.getloadavg()[0], 2),
+            "phase_wall_s": round(time.perf_counter() - t0, 1),
+        }
+        return result
+
+    # ---- arm 0: the r10 acceptance, now on the paged engine ------------
+    reqs0 = make_requests(8 if q else 24, seed=7, vocab=cfg.vocab_size,
+                          prompt_len=16, max_new=16 if q else 24)
+    seq_base = phase("sequential", lambda: run_engine_arm(
+        params, cfg, reqs0, EngineConfig(max_slots=1), concurrent=False))
+    cont = phase("continuous", lambda: run_engine_arm(
+        params, cfg, reqs0, EngineConfig(max_slots=8)))
+
+    # ---- arm 1: shared-prefix (N requests over K prompt heads — the
+    # shared-system-prompt shape: long head, short divergent tail,
+    # short completion).  Equal pool bytes: slot 8 x 256 stripes ==
+    # paged 128 x 16 blocks.
+    reqs1 = make_shared_prefix_requests(
+        12 if q else 24, seed=11, vocab=cfg.vocab_size, heads=4,
+        head_len=192, tail_len=8, max_new=4)
+    sp_slot = phase("shared_prefix_slot", lambda: run_engine_arm(
+        params, cfg, reqs1, EngineConfig(max_slots=8, paged=False)))
+    sp_paged = phase("shared_prefix_paged", lambda: run_engine_arm(
+        params, cfg, reqs1, EngineConfig(max_slots=8, kv_block_size=16,
+                                         prefill_chunk=16)))
+
+    # ---- arm 2: long-prompt storm over a mixed-length set at EQUAL
+    # pool bytes: slot worst-case stripes allow 4 concurrent (4 x 256);
+    # the paged engine spends the same 1024 tokens by actual usage over
+    # 12 decode rows, chunk-prefilling the long prompts
+    reqs2 = make_mixed_requests(
+        seed=13, vocab=cfg.vocab_size,
+        n_short=8 if q else 18, n_long=3 if q else 6,
+        short_len=16, long_len=200, short_new=8, long_new=8)
+    ms_slot = phase("mixed_storm_slot", lambda: run_engine_arm(
+        params, cfg, reqs2, EngineConfig(max_slots=4, paged=False)))
+    ms_paged = phase("mixed_storm_paged", lambda: run_engine_arm(
+        params, cfg, reqs2, EngineConfig(max_slots=12, kv_block_size=16,
+                                         n_blocks=64, prefill_chunk=16)))
+
+    ratio_cont = round(cont["req_s"] / seq_base["req_s"], 2)
+    ratio_prefix = round(sp_paged["req_s"] / sp_slot["req_s"], 2)
+    gates = {
+        "continuous_ratio_ge_2": ratio_cont >= 2.0,
+        "shared_prefix_ratio_ge_1.5": ratio_prefix >= 1.5,
+        "storm_peak_concurrency_strictly_higher":
+            ms_paged["peak_active_requests"] > ms_slot["peak_active_requests"],
+        "storm_equal_pool_tokens":
+            ms_paged["pool_tokens"] == ms_slot["pool_tokens"],
+        "zero_dropped": all(
+            a["dropped"] == 0 and a["errors"] == 0
+            for a in (seq_base, cont, sp_slot, sp_paged, ms_slot,
+                      ms_paged)),
+    }
 
     artifact = {
-        "round": 10,
-        "quick": bool(args.quick),
+        "round": ROUND,
+        "quick": bool(q),
         "_conditions": {
-            "loadavg_1m_before": round(load0, 2),
-            "loadavg_1m_after": round(load1, 2),
+            "phases": phases,
             "backend": jax.default_backend(),
             "physical_cores": os.cpu_count(),
-            "note": "same-run A/B; only the ratio is portable across "
-                    "days (PERF.md box-variance caveat)",
+            "note": "same-run A/B; only in-run ratios are portable "
+                    "across days (PERF.md box-variance caveat)",
         },
         "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
                   "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
                   "vocab": cfg.vocab_size, "max_seq": cfg.max_seq,
                   "dtype": "float32"},
-        "request_mix": {"n": n_req, "prompt_len": "8..16",
-                        "max_new": "12..24" if args.quick else "16..32"},
-        "baseline_sequential": base,
+        "baseline_sequential": seq_base,
         "continuous_batching": cont,
-        "ratio_req_s": round(cont["req_s"] / base["req_s"], 2),
-        "ratio_tokens_s": round(cont["tokens_s"] / base["tokens_s"], 2),
-        "http_ingress": http,
+        "ratio_req_s": ratio_cont,
+        "shared_prefix": {
+            "workload": {"n": len(reqs1), "heads": 4, "head_len": 192,
+                         "tail_len": 8, "max_new": 4},
+            "slot_engine_r14": sp_slot,
+            "paged_prefix_engine": sp_paged,
+            "ratio_req_s": ratio_prefix,
+        },
+        "mixed_storm": {
+            "workload": {"n": len(reqs2),
+                         "short": "8..16 tok prompts, 8 new",
+                         "long": "100..200 tok prompts, 8 new"},
+            "slot_engine_r14": ms_slot,
+            "paged_prefix_engine": ms_paged,
+            "peak_concurrent": {
+                "slot": ms_slot["peak_active_requests"],
+                "paged": ms_paged["peak_active_requests"],
+            },
+            "ttft_p99_short_biased": {
+                "slot": ms_slot["ttft_p99_s"],
+                "paged": ms_paged["ttft_p99_s"],
+            },
+        },
+        "gates": gates,
     }
     out = json.dumps(artifact, indent=1)
     print(out)
     with open(args.out, "w") as f:
         f.write(out + "\n")
-    ok = artifact["ratio_req_s"] >= 2.0
-    print(f"\ncontinuous/sequential req/s ratio: "
-          f"{artifact['ratio_req_s']} ({'PASS' if ok else 'FAIL'} >= 2.0)")
+    ok = all(gates.values())
+    for g, passed in gates.items():
+        print(f"  gate {g}: {'PASS' if passed else 'FAIL'}")
+    print(f"continuous/sequential {ratio_cont}x | shared-prefix "
+          f"paged/slot {ratio_prefix}x | peak "
+          f"{ms_slot['peak_active_requests']} -> "
+          f"{ms_paged['peak_active_requests']} "
+          f"({'PASS' if ok else 'FAIL'})")
     return 0 if ok else 1
 
 

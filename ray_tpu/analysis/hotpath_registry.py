@@ -136,6 +136,17 @@ HOT_GATES: dict = {
             "Fleet._chaos": "gate",        # _fi serve_* trigger points
         },
     },
+    # inference engine: the paged-cache chaos hook (infer_admit /
+    # infer_block_alloc choke points) — one helper so every other
+    # engine function stays alias-free; same zero-overhead promise as
+    # the control plane (the decode loop runs it per admission / per
+    # block grant)
+    "ray_tpu.inference.engine": {
+        "aliases": ("_fi",),
+        "functions": {
+            "InferenceEngine._chaos": "gate",
+        },
+    },
     # serve controller: the drain state machine's chaos hook
     # (replica_drain / replica_drain_timeout choke points) — one helper
     # so every other controller function stays alias-free

@@ -451,6 +451,23 @@ class FaultPlan:
             getattr(ctx.get("replica"), "tag", None)
             or getattr(ctx.get("node"), "address", None))
 
+    def on_infer(self, point: str, ctx: dict) -> None:
+        """Scripted triggers in the inference engine's paged-cache path
+        (gated through ``InferenceEngine._chaos``).  Points:
+
+          * ``infer_admit``       — a request was granted rows/blocks at
+            a prefill boundary (ctx: {"engine", "req", "need",
+            "hit_tokens"})
+          * ``infer_block_alloc`` — decode-time block growth (a row
+            crossed a block boundary; ctx: {"engine", "row"})
+
+        A scripted ``fn(ctx)`` can raise to inject a pool failure at
+        the exact choke point — the engine's recovery path (fail
+        in-flight, clear the prefix index, reallocate the donated pool)
+        is chaos-provable like everything else
+        (tests/test_paged_cache.py)."""
+        self._scripted_ctx_rules(point, ctx, ctx.get("engine"))
+
     def on_service_tick(self, svc) -> None:
         fire = []
         with self._lock:

@@ -3,18 +3,22 @@
 The "millions of users" leg of the north star (ROADMAP item 2): an
 end-to-end inference product over the sharded GPT —
 
-  * decode.py  — KV-cache'd incremental decode: prefill seeds the cache
-                 through the ordinary training forward
-                 (``gpt.forward(return_kv=True)``), a compiled-once
-                 fixed-width step decodes one token for every slot.
-  * cache.py   — KVCacheManager: preallocated slot pool, bounded memory
-                 regardless of request mix (vLLM's pool discipline in
-                 static-shape jax form).
-  * engine.py  — the Orca-style iteration-level scheduler: admits new
-                 requests at prefill boundaries mid-decode, evicts on
-                 EOS/max-tokens, streams tokens per request.
+  * decode.py  — compiled decode programs: block-table paged decode
+                 step + chunked prefill (production), slot step + full
+                 prefill via the ordinary training forward
+                 (``gpt.forward(return_kv=True)`` — also the paged
+                 cold-start path), all compiled once per geometry.
+  * cache.py   — BlockPool (refcounted token blocks, copy-on-write
+                 tails, scratch-block scatter discipline) + RadixIndex
+                 (prefix reuse trie, LRU eviction); KVCacheManager is
+                 the legacy slot pool (A/B baseline).
+  * engine.py  — the Orca-style iteration-level scheduler over the
+                 paged cache: block-budget admission with prefix-hit
+                 credit, occupancy-aware chunked prefill, block-
+                 pressure preemption, streams tokens per request.
   * serving.py — the Serve deployment (POST /v1/generate, JSON +
-                 chunked token streaming, replica autoscaling).
+                 chunked token streaming, replica autoscaling, block/
+                 prefix gauges for the fleet router).
 
 Quick start::
 
@@ -24,14 +28,19 @@ Quick start::
     # curl -d '{"prompt": [1,2,3], "max_tokens": 8}' \
     #      http://127.0.0.1:<port>/v1/generate
 
-Benchmark receipt: benchmarks/serve_bench.py → SERVE_r10.json
-(continuous batching vs naive sequential A/B on the same box/run).
+Benchmark receipt: benchmarks/serve_bench.py → SERVE_r15.json
+(paged+prefix vs the r14 slot engine AND continuous batching vs naive
+sequential, all same-box same-run A/B).
 """
 
 from __future__ import annotations
 
-from ray_tpu.inference.cache import KVCacheManager
-from ray_tpu.inference.decode import make_decode_step, make_prefill_fn
+from ray_tpu.inference.cache import BlockPool, KVCacheManager, RadixIndex
+from ray_tpu.inference.decode import (MoEDecodeUnsupported,
+                                      make_chunk_prefill_fn,
+                                      make_decode_step,
+                                      make_paged_decode_step,
+                                      make_prefill_fn)
 from ray_tpu.inference.engine import (PRIORITY_BATCH, PRIORITY_INTERACTIVE,
                                       EngineConfig, EngineDrainingError,
                                       EngineStoppedError,
@@ -41,7 +50,9 @@ from ray_tpu.inference.serving import (GPTServer, build_gpt_deployment,
                                        encode_prompt, parse_stream_chunks)
 
 __all__ = [
-    "KVCacheManager", "make_decode_step", "make_prefill_fn",
+    "BlockPool", "KVCacheManager", "RadixIndex",
+    "MoEDecodeUnsupported", "make_chunk_prefill_fn", "make_decode_step",
+    "make_paged_decode_step", "make_prefill_fn",
     "EngineConfig", "EngineDrainingError", "EngineStoppedError",
     "GenerationRequest",
     "InferenceEngine", "PRIORITY_BATCH", "PRIORITY_INTERACTIVE",

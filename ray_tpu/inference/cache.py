@@ -1,24 +1,37 @@
-"""KV-cache pool: preallocated, slot-granular, bounded.
+"""KV-cache pools: the paged block pool (production) and the legacy
+slot pool (A/B baseline).
 
-vLLM's insight (PagedAttention) is that serving memory must be bounded by
-a PREALLOCATED pool handed out in fixed-size units and reclaimed on
-sequence exit — never grown per request.  Under jax/pjit the unit has to
-keep the decode step's shapes static so it compiles exactly once, so the
-unit here is a SLOT: one `[max_seq]` stripe of the cache per admitted
-sequence (the block-granular refinement would trade the static shape for
-a gather per step; see ARCHITECTURE.md "Inference engine" for the
-trade).  The pool is two arrays
+vLLM's insight (PagedAttention) is that serving memory must be bounded
+by a PREALLOCATED pool handed out in fixed-size units and reclaimed on
+sequence exit — never grown per request.  Two unit granularities live
+here:
 
-    k, v : [n_layers, n_slots, n_heads, max_seq, head_dim]
+  * ``BlockPool`` — the paged pool: fixed-size TOKEN BLOCKS
+    (``[n_layers, n_blocks, n_heads, block_size, head_dim]`` ×2), a
+    per-request BLOCK TABLE mapping sequence positions to blocks, and
+    per-block REFCOUNTS so blocks are shared across requests (prefix
+    reuse) with copy-on-write on a shared partially-filled tail.  The
+    decode step stays compiled-once because the table width and batch
+    width are static; the price is one gather per step (the trade the
+    slot design deferred — now paid, because block granularity lets
+    long and short sequences share one pool with near-zero waste).
+    Block id 0 is a reserved SCRATCH block: masked rows and
+    out-of-range writes are redirected there so the compiled step never
+    needs a conditional scatter.
+  * ``KVCacheManager`` — the round-10 slot pool (one ``[max_seq]``
+    stripe per sequence).  Kept as the ``paged=False`` engine mode so
+    the serving benchmark can A/B the paged path against the exact
+    engine that shipped in SERVE_r10/r14.
 
-allocated once at engine construction.  `alloc()` hands a slot out,
-`free()` returns it; when every slot is out new requests queue in the
-engine instead of growing memory — HBM use is a constant of the engine
-config regardless of request mix, which is the property the continuous
-batching loop needs to admit mid-decode without OOM risk.
+``RadixIndex`` is the prefix cache over the block pool: a trie keyed on
+block-sized token chunks (plus partial-tail leaves), so a new request
+whose prompt head matches a cached prefix ADOPTS those blocks by
+refcount instead of re-running prefill (SGLang's RadixAttention shape).
+Unreferenced cached prefixes are LRU-evicted under pool pressure.
 
-Array updates go through jitted helpers (slot write / pool swap) so the
-engine loop never materializes a second full pool on the host.
+Array updates go through jitted helpers (slot/block write, block copy,
+pool swap) so the engine loop never materializes a second full pool on
+the host.
 """
 
 from __future__ import annotations
@@ -146,3 +159,364 @@ class KVCacheManager:
             "max_seq": self.max_seq,
             "bytes_total": self.bytes_total(),
         }
+
+
+# ---------------------------------------------------------------------------
+# paged pool
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _copy_block(pool: jax.Array, src: jax.Array, dst: jax.Array):
+    """pool [L, N, h, bs, hd] <- pool[:, src] at dst (copy-on-write)."""
+    return pool.at[:, dst].set(pool[:, src])
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _write_blocks(pool: jax.Array, table: jax.Array, new: jax.Array):
+    """pool [L, N, h, bs, hd] <- new [L, h, T*bs, hd] scattered through
+    table [T] (position p lands at (table[p//bs], p%bs)).  Duplicate
+    scratch entries collide harmlessly — their content is masked."""
+    L, _, h, bs, hd = pool.shape
+    T = table.shape[0]
+    n = new.reshape(L, h, T, bs, hd).transpose(0, 2, 1, 3, 4)
+    return pool.at[:, table].set(n.astype(pool.dtype))
+
+
+class BlockPool:
+    """Refcounted fixed-size token-block pool (the paged KV cache).
+
+    Arrays are ``[n_layers, n_blocks + 1, n_heads, block_size,
+    head_dim]`` ×2 — index 0 is the reserved scratch block (never
+    allocated; inactive/out-of-range writes in the compiled step are
+    redirected there), usable blocks are ids ``1..n_blocks``.
+
+    Reference rules: ``alloc()`` returns a block with refcount 1;
+    every additional holder (a sharing request, the prefix trie)
+    ``incref``s; ``decref`` frees the block back to the pool when the
+    count reaches 0.  A holder about to WRITE a block must own it
+    exclusively (refcount 1) — otherwise copy-on-write first
+    (``copy_block`` into a fresh block, drop the shared reference).
+
+    Thread contract mirrors KVCacheManager: alloc/incref/decref/array
+    swaps happen on the engine loop thread; ``stats()`` may be read
+    from any thread (the lock only guards the free list + refcounts).
+    """
+
+    def __init__(self, cfg: GPTConfig, n_blocks: int, block_size: int,
+                 max_seq: Optional[int] = None, dtype=None):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.max_seq = int(max_seq or cfg.max_seq)
+        if self.max_seq > cfg.max_seq:
+            raise ValueError(
+                f"cache max_seq {self.max_seq} exceeds model max_seq "
+                f"{cfg.max_seq} (wpe table bound)")
+        # block-table width: enough blocks to cover one max_seq sequence
+        self.blocks_per_seq = -(-self.max_seq // self.block_size)
+        if n_blocks < self.blocks_per_seq:
+            raise ValueError(
+                f"n_blocks {n_blocks} cannot hold one max_seq={self.max_seq} "
+                f"sequence ({self.blocks_per_seq} blocks of {block_size})")
+        self.n_blocks = int(n_blocks)             # usable (excludes scratch)
+        self.dtype = dtype or cfg.dtype
+        shape = (cfg.n_layers, self.n_blocks + 1, cfg.n_heads,
+                 self.block_size, cfg.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self._lock = threading.Lock()
+        # pop() -> block 1 first; id 0 (scratch) is never in the list
+        self._free = list(range(self.n_blocks, 0, -1))
+        self._rc = [0] * (self.n_blocks + 1)
+
+    # ------------------------------------------------------------- blocks
+
+    def alloc(self) -> Optional[int]:
+        """Hand out a block (refcount 1), or None when the pool is dry
+        (caller evicts cached prefixes, preempts, or queues)."""
+        with self._lock:
+            if not self._free:
+                return None
+            bid = self._free.pop()
+            self._rc[bid] = 1
+            return bid
+
+    def incref(self, bid: int) -> None:
+        with self._lock:
+            if self._rc[bid] < 1:
+                raise ValueError(f"block {bid} is not allocated")
+            self._rc[bid] += 1
+
+    def decref(self, bid: int) -> int:
+        """Drop one reference; frees the block at zero.  Returns the
+        remaining count."""
+        with self._lock:
+            if self._rc[bid] < 1:
+                raise ValueError(f"block {bid} is not allocated "
+                                 "(double free or never alloc'd)")
+            self._rc[bid] -= 1
+            rc = self._rc[bid]
+            if rc == 0:
+                self._free.append(bid)
+            return rc
+
+    def refcount(self, bid: int) -> int:
+        with self._lock:
+            return self._rc[bid]
+
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        with self._lock:
+            return self.n_blocks - len(self._free)
+
+    # ------------------------------------------------------------- arrays
+
+    def copy_block(self, src: int, dst: int) -> None:
+        """Copy-on-write: duplicate src's K/V into dst (both pools)."""
+        s, d = jnp.int32(src), jnp.int32(dst)
+        self.k = _copy_block(self.k, s, d)
+        self.v = _copy_block(self.v, s, d)
+
+    def write_prefill(self, table, k_new: jax.Array,
+                      v_new: jax.Array) -> None:
+        """Seed a request's blocks from a FULL prefill ([L, h, S, hd]
+        each — the r10 training-forward prefill): the whole padded
+        sequence scatters through the block table in one jitted call.
+        S may be shorter than the table span (zero-padded right);
+        unowned table entries point at the scratch block, whose garbage
+        the kv-length masks hide."""
+        span = self.blocks_per_seq * self.block_size
+        s = k_new.shape[2]
+        if s < span:
+            pad = [(0, 0), (0, 0), (0, span - s), (0, 0)]
+            k_new = jnp.pad(k_new, pad)
+            v_new = jnp.pad(v_new, pad)
+        t = jnp.asarray(table, jnp.int32)
+        self.k = _write_blocks(self.k, t, k_new)
+        self.v = _write_blocks(self.v, t, v_new)
+
+    def swap(self, k: jax.Array, v: jax.Array) -> None:
+        """Install the compiled step's updated pool arrays."""
+        self.k, self.v = k, v
+
+    def reset(self) -> None:
+        """Reallocate the pool and drop every reference.  Needed after a
+        FAILED compiled step: chunk-prefill and decode both donate the
+        pool buffers, so an exception mid-step can leave self.k/v
+        pointing at invalidated storage.  The caller fails all in-flight
+        requests AND clears the prefix index (cached prefixes would
+        otherwise point at zeroed blocks — silently wrong KV)."""
+        shape = self.k.shape
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        with self._lock:
+            self._free = list(range(self.n_blocks, 0, -1))
+            self._rc = [0] * (self.n_blocks + 1)
+
+    # ------------------------------------------------------------- stats
+
+    def bytes_total(self) -> int:
+        itemsize = np.dtype(jnp.zeros((), self.dtype).dtype).itemsize
+        return 2 * int(np.prod(self.k.shape)) * itemsize
+
+    def stats(self) -> dict:
+        with self._lock:
+            free = len(self._free)
+        return {
+            "block_size": self.block_size,
+            "blocks_total": self.n_blocks,
+            "blocks_free": free,
+            "blocks_used": self.n_blocks - free,
+            "max_seq": self.max_seq,
+            "bytes_total": self.bytes_total(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# radix prefix index
+
+
+class _TrieNode:
+    __slots__ = ("key", "block", "n_valid", "children", "parent", "lru")
+
+    def __init__(self, key, block, n_valid, parent):
+        self.key = key            # tuple of tokens (len == block_size for
+        #                           interior/full nodes, < for tail leaves)
+        self.block = block        # pool block id holding these tokens' KV
+        self.n_valid = n_valid    # valid token count in the block
+        self.children: dict = {}
+        self.parent = parent
+        self.lru = 0
+
+
+class RadixIndex:
+    """Trie over cached prompt prefixes, keyed on block-sized token
+    chunks; holds one pool reference per cached block.
+
+    * ``insert(tokens, block_ids)`` — cache a finished/preempted
+      request's prefix chain: full blocks become interior nodes, a
+      partial tail becomes a leaf (matched only when its whole content
+      is a prefix of a later prompt — the shared-prompt-head case).
+      Already-cached chunks dedupe to the existing node (the caller's
+      duplicate block is simply not retained).
+    * ``match(prompt)`` — longest cached chain that is a prefix of the
+      prompt, CAPPED at ``len(prompt) - 1`` tokens so at least one
+      prompt token always runs prefill (its logits produce the first
+      sampled token).  Matched blocks are increfed for the caller.
+    * ``evict(n)`` — LRU eviction of UNREFERENCED leaves (pool refcount
+      1, i.e. only the trie holds the block); interior nodes become
+      evictable once their subtree is gone.
+
+    Single-threaded by design: called only from the engine loop thread
+    (stats excepted, guarded by the pool's lock via refcounts).
+    """
+
+    def __init__(self, pool: BlockPool):
+        self.pool = pool
+        self.bs = pool.block_size
+        self.root = _TrieNode((), 0, 0, None)
+        self._clock = 0
+        self._nodes = 0
+        # cumulative token counters (engine folds into stats)
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.evicted_blocks = 0
+
+    def _touch(self, node: _TrieNode) -> None:
+        self._clock += 1
+        while node is not None and node is not self.root:
+            node.lru = self._clock
+            node = node.parent
+
+    @property
+    def cached_blocks(self) -> int:
+        return self._nodes
+
+    # -------------------------------------------------------------- match
+
+    def match(self, prompt: np.ndarray) -> tuple:
+        """(block_ids, n_tokens): the adopted chain, blocks increfed.
+        Caller must decref each id when done (release or CoW)."""
+        bs = self.bs
+        n = len(prompt)
+        self.lookup_tokens += n
+        node, ids, matched = self.root, [], 0
+        while matched + bs < n:        # full block AND >= 1 token left over
+            key = tuple(int(t) for t in prompt[matched:matched + bs])
+            child = node.children.get(key)
+            if child is None or child.n_valid != bs:
+                break
+            ids.append(child.block)
+            matched += bs
+            node = child
+        # partial tail leaves: longest one whose WHOLE content prefixes
+        # the remaining prompt (still leaving >= 1 token for prefill)
+        best = None
+        for key, child in node.children.items():
+            m = len(key)
+            if m >= bs or m >= n - matched:
+                continue
+            if tuple(int(t) for t in prompt[matched:matched + m]) != key:
+                continue
+            if best is None or m > len(best.key):
+                best = child
+        if best is not None:
+            ids.append(best.block)
+            matched += len(best.key)
+            node = best
+        for bid in ids:
+            self.pool.incref(bid)
+        if node is not self.root:
+            self._touch(node)
+        self.hit_tokens += matched
+        return ids, matched
+
+    # ------------------------------------------------------------- insert
+
+    def insert(self, tokens: np.ndarray, block_ids: list) -> None:
+        """Cache the chain for ``tokens`` (the request's clean KV prefix)
+        backed by ``block_ids`` (the request's table, in order).  Kept
+        blocks gain a trie reference; chunks already cached dedupe to
+        the existing node and the caller's copy is not retained."""
+        bs = self.bs
+        n = len(tokens)
+        node = self.root
+        for i in range(n // bs):
+            key = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                bid = block_ids[i]
+                child = _TrieNode(key, bid, bs, node)
+                node.children[key] = child
+                self.pool.incref(bid)
+                self._nodes += 1
+            node = child
+        j = n % bs
+        if j:
+            key = tuple(int(t) for t in tokens[n - j:])
+            if key not in node.children:
+                bid = block_ids[n // bs]
+                leaf = _TrieNode(key, bid, j, node)
+                node.children[key] = leaf
+                self.pool.incref(bid)
+                self._nodes += 1
+                node = leaf
+        self._touch(node)
+
+    # ------------------------------------------------------------ evict
+
+    def _leaves(self) -> list:
+        out, stack = [], [self.root]
+        while stack:
+            node = stack.pop()
+            kids = list(node.children.values())
+            if not kids and node is not self.root:
+                out.append(node)
+            stack.extend(kids)
+        return out
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` blocks by dropping unreferenced cached
+        prefixes, LRU-first, leaves-up.  Returns blocks actually freed
+        (may be < n when everything left is referenced by a request).
+
+        ONE trie walk per call seeds an LRU heap of evictable leaves;
+        evicting a leaf pushes its parent when that exposes it — so a
+        multi-block eviction is O(nodes + freed·log) instead of one
+        full walk (plus a refcount lock round-trip per node) per freed
+        block, which mattered: admission/growth pressure calls this
+        from the decode hot path."""
+        import heapq
+        freed = 0
+        heap = [(leaf.lru, id(leaf), leaf) for leaf in self._leaves()
+                if self.pool.refcount(leaf.block) == 1]
+        heapq.heapify(heap)
+        while heap and freed < n:
+            _, _, node = heapq.heappop(heap)
+            # a heap entry may be stale (re-referenced since the walk)
+            if (node.children
+                    or node.parent.children.get(node.key) is not node
+                    or self.pool.refcount(node.block) != 1):
+                continue
+            del node.parent.children[node.key]
+            self.pool.decref(node.block)
+            self._nodes -= 1
+            freed += 1
+            self.evicted_blocks += 1
+            p = node.parent
+            if (p is not self.root and not p.children
+                    and self.pool.refcount(p.block) == 1):
+                heapq.heappush(heap, (p.lru, id(p), p))
+        return freed
+
+    def clear(self) -> None:
+        """Drop the whole index WITHOUT touching pool refcounts — used
+        only after BlockPool.reset() (which already zeroed them)."""
+        self.root = _TrieNode((), 0, 0, None)
+        self._nodes = 0

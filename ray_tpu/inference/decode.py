@@ -1,28 +1,38 @@
-"""Incremental (KV-cache) decode for the GPT: prefill + one-token step.
+"""Incremental (KV-cache) decode for the GPT: paged decode + chunked
+prefill (production), slot decode + full prefill (legacy baseline).
 
-Two compiled programs, both with STATIC shapes so each compiles exactly
-once regardless of request mix — and (no-mesh path) once per (config,
-rules) across ALL engines, so a fleet scaling out replicas or
-multiplexing model variants reuses the compiled pair instead of paying
-a per-engine recompile:
+All programs have STATIC shapes so each compiles exactly once
+regardless of request mix — and (no-mesh path) once per (config,
+rules, geometry) across ALL engines, so a fleet scaling out replicas
+or multiplexing model variants reuses the compiled set instead of
+paying a per-engine recompile.
+
+Paged path (cache.BlockPool):
+
+  * chunk_prefill — a fixed-width window of the prompt ([C] tokens at
+    positions start..start+C) runs one forward layer-by-layer against
+    the BLOCK POOL: each layer writes the window's K/V through the
+    block table, then attends over the gathered table (earlier chunks'
+    K/V included), each query row masked to its OWN causal horizon.
+    Long prompts therefore prefill as a sequence of bounded-cost steps
+    the engine interleaves with decode iterations — a long prompt
+    stops stalling neighbors' token cadence.
+  * paged_decode_step — one token for EVERY row at once; the cache
+    write is a per-row (block, offset) scatter into the pool (inactive
+    rows redirected to the scratch block), attention gathers each
+    row's block table and masks to its valid prefix
+    (ops/attention.paged_attention).
+
+Legacy slot path (cache.KVCacheManager, engine ``paged=False``):
 
   * prefill — the ordinary training forward with ``return_kv=True``
-    (models/gpt.py) over the prompt padded to the cache width.  Same
-    math, same code path: the K/V that seed the cache cannot drift from
-    the oracle.  Causality makes right-padding free — positions beyond
-    the prompt produce garbage K/V that the per-slot kv_lengths mask
-    hides and later decode steps overwrite.
-  * decode_step — one token for EVERY slot at once ([n_slots] batch).
-    Each slot sits at its own sequence position, so the cache write is a
-    one-hot scatter on the position axis and attention masks each row to
-    its own valid prefix (ops/attention.py kv_lengths).  Inactive slots
-    ride along masked — the batch width never changes, which is what
-    lets the engine admit/evict between steps without recompilation
-    (Orca's iteration-level scheduling in pjit form).
+    (models/gpt.py) over the prompt padded to the cache width.
+  * decode_step — one-hot scatter on the position axis of the
+    ``[L, n_slots, h, S, hd]`` cache, per-row kv_lengths masking.
 
-The step mirrors gpt._transformer_layer's einsums exactly (dense MLP
-path); greedy token-parity with full-recompute ``generate()`` is pinned
-by tests/test_inference.py.
+All step bodies mirror gpt._transformer_layer's einsums exactly (dense
+MLP path); greedy token-parity with full-recompute ``generate()`` is
+pinned by tests/test_inference.py + tests/test_paged_cache.py.
 """
 
 from __future__ import annotations
@@ -38,6 +48,21 @@ from ray_tpu.models import gpt
 from ray_tpu.models.gpt import GPTConfig
 from ray_tpu.ops.attention import attention
 from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
+
+
+class MoEDecodeUnsupported(NotImplementedError):
+    """The inference engine has no MoE decode path (expert dispatch per
+    cached token — ROADMAP 1c).  Typed so the gap fails EARLY and
+    clearly — at engine construction / admission time, never mid-decode
+    with slots already held — and so callers can distinguish the known
+    capability gap from a generic failure."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__(
+            f"the inference engine has no MoE decode path yet "
+            f"(n_experts={cfg.n_experts}: expert dispatch per cached "
+            f"token is unimplemented — ROADMAP 1c); serve this config "
+            f"with a dense MLP (n_experts=0) or the training forward")
 
 # engines with the same (cfg, rules) on the default (no-mesh) path share
 # ONE jitted prefill/step pair: the compiled programs are stateless
@@ -64,9 +89,7 @@ def make_prefill_fn(cfg: GPTConfig, *, mesh=None,
     """jitted (params, tokens [b, S]) -> (logits [b, S, V], k, v
     [L, b, h, S, hd] each)."""
     if cfg.n_experts:
-        raise NotImplementedError(
-            "the inference engine has no MoE decode path yet "
-            "(expert dispatch per cached token)")
+        raise MoEDecodeUnsupported(cfg)
 
     def build():
         @jax.jit
@@ -93,9 +116,7 @@ def make_decode_step(cfg: GPTConfig, *, mesh=None,
     positions [0, positions[slot]] and returns next-token logits.
     """
     if cfg.n_experts:
-        raise NotImplementedError(
-            "the inference engine has no MoE decode path yet "
-            "(expert dispatch per cached token)")
+        raise MoEDecodeUnsupported(cfg)
     h, hd = cfg.n_heads, cfg.head_dim
 
     def build():
@@ -151,6 +172,218 @@ def _make_step(cfg, mesh, rules, h, hd):
         return logits, k_cache, v_cache
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# paged path
+
+
+def make_paged_decode_step(cfg: GPTConfig, *, block_size: int,
+                           n_table: int, mesh=None,
+                           rules: Rules = DEFAULT_LLM_RULES):
+    """jitted one-token step over the whole row batch, block-pool cache.
+
+    (params, k_pool, v_pool [L, N, h, bs, hd], tables [b, T] int32,
+     tokens [b] int32, positions [b] int32, active [b] bool)
+        -> (logits [b, vocab] f32, k_pool, v_pool)
+
+    Each row's current token K/V scatters into the pool at
+    ``(tables[row, pos // bs], pos % bs)`` — inactive rows are
+    redirected to the scratch block (id 0) so the scatter needs no
+    conditional — and attention gathers the row's table, masked to its
+    valid prefix (ops/attention.paged_attention).  Tail blocks are
+    per-row exclusive (the engine copy-on-writes shared tails before
+    the step), so active rows never collide in the scatter.
+    """
+    if cfg.n_experts:
+        raise MoEDecodeUnsupported(cfg)
+    h, hd, bs = cfg.n_heads, cfg.head_dim, int(block_size)
+
+    def build():
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, k_pool, v_pool, tables, tokens, positions,
+                 active):
+            b = tokens.shape[0]
+            L = k_pool.shape[0]
+            T = tables.shape[1]
+            x = (params["wte"][tokens] + params["wpe"][positions])
+            x = x[:, None, :].astype(cfg.dtype)               # [b, 1, d]
+            rows = jnp.arange(b)
+            bidx = jnp.where(active, tables[rows, positions // bs], 0)
+            off = jnp.where(active, positions % bs, 0)
+            kv_len = jnp.where(active, positions + 1, 1)      # >=1: no NaN
+
+            # the pools are CLOSED OVER by the scan body and read with a
+            # per-layer dynamic slice + table gather; the new K/V come
+            # back as stacked scan outputs and land in ONE donated
+            # scatter after the scan.  (Carrying the pools through the
+            # scan as xs/ys — the obvious formulation — copies the
+            # ENTIRE pool every call, a fixed ~2x-pool-bytes tax per
+            # decode step that dwarfs the actual compute.)
+            def layer(x, xs):
+                lp, li = xs
+                ck, cv = k_pool[li], v_pool[li]    # [N, h, bs, hd]
+                y = gpt._layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+                qkv = jnp.einsum("bsd,de->bse", y,
+                                 lp["wqkv"].astype(cfg.dtype))
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+
+                def heads(t):                      # [b,1,d]->[b,h,1,hd]
+                    return t.reshape(b, 1, h, hd).transpose(0, 2, 1, 3)
+
+                def gather(pool):                  # -> [b, h, S, hd]
+                    g = pool[tables]               # [b, T, h, bs, hd]
+                    return g.transpose(0, 2, 1, 3, 4).reshape(
+                        b, h, T * bs, hd)
+
+                kh = k.reshape(b, h, hd)
+                vh = v.reshape(b, h, hd)
+                # insert the current token's K/V at its own position in
+                # the gathered context — key ORDER stays position-major,
+                # so the masked softmax is numerically identical to the
+                # write-then-gather formulation (and to the slot step)
+                ctx_k = gather(ck).at[rows, :, positions, :].set(
+                    kh.astype(ck.dtype))
+                ctx_v = gather(cv).at[rows, :, positions, :].set(
+                    vh.astype(cv.dtype))
+                o = attention(heads(q), ctx_k, ctx_v, causal=False,
+                              kv_lengths=kv_len, impl="reference")
+                o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+                o = jnp.einsum("bsd,de->bse", o,
+                               lp["wo"].astype(cfg.dtype)) \
+                    + lp["bo"].astype(cfg.dtype)
+                x = x + o
+                y = gpt._layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+                u = jnp.einsum("bsd,df->bsf", y,
+                               lp["w_up"].astype(cfg.dtype)) \
+                    + lp["b_up"].astype(cfg.dtype)
+                u = jax.nn.gelu(u)
+                dn = jnp.einsum("bsf,fd->bsd", u,
+                                lp["w_down"].astype(cfg.dtype)) \
+                    + lp["b_down"].astype(cfg.dtype)
+                return x + dn, (kh, vh)
+
+            x, (ks, vs) = lax.scan(
+                layer, x, (params["layers"], jnp.arange(L)))
+            # ks/vs [L, b, h, hd] -> one in-place scatter on the donated
+            # pools at each row's (block, offset); inactive rows hit the
+            # scratch block
+            k_pool = k_pool.at[:, bidx, :, off, :].set(
+                ks.transpose(1, 0, 2, 3).astype(k_pool.dtype))
+            v_pool = v_pool.at[:, bidx, :, off, :].set(
+                vs.transpose(1, 0, 2, 3).astype(v_pool.dtype))
+            logits = gpt._head(params, x, cfg, mesh, rules)[:, 0, :]
+            return logits, k_pool, v_pool
+
+        return step
+
+    return _cached(("paged_step", bs, int(n_table)), cfg, mesh, rules,
+                   build)
+
+
+def make_chunk_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int,
+                          n_table: int, mesh=None,
+                          rules: Rules = DEFAULT_LLM_RULES):
+    """jitted fixed-width prefill chunk against the block pool.
+
+    (params, k_pool, v_pool [L, N, h, bs, hd], table [T] int32,
+     tokens [C] int32, start int32)
+        -> (logits [C, vocab] f32, k_pool, v_pool)
+
+    Processes prompt positions ``start .. start+C``: each layer writes
+    the window's K/V through the block table (rows past the table's
+    span are redirected to the scratch block), then attends over the
+    gathered table with each query row masked to its OWN causal horizon
+    (key position <= query position) — so earlier chunks' cached K/V,
+    including an adopted prefix from the radix index, participates
+    exactly as in a full forward.  Pad rows past the prompt compute
+    garbage that lands in masked positions and is overwritten by
+    decode; the caller reads only the rows it needs.  The engine
+    interleaves one chunk per scheduler pass with decode iterations
+    (chunked prefill: bounded prefill cost per token cadence).
+    """
+    if cfg.n_experts:
+        raise MoEDecodeUnsupported(cfg)
+    h, hd = cfg.n_heads, cfg.head_dim
+    bs, C, T = int(block_size), int(chunk), int(n_table)
+    S = T * bs
+
+    def build():
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def chunk_fn(params, k_pool, v_pool, table, tokens, start):
+            L = k_pool.shape[0]
+            pos = start + jnp.arange(C, dtype=jnp.int32)       # [C]
+            oob = pos >= S
+            wpe_pos = jnp.clip(pos, 0, cfg.max_seq - 1)
+            x = (params["wte"][tokens] + params["wpe"][wpe_pos])
+            x = x[None, :, :].astype(cfg.dtype)                # [1, C, d]
+            safe = jnp.where(oob, 0, pos)
+            bidx = jnp.where(oob, 0, table[safe // bs])
+            off = jnp.where(oob, 0, pos % bs)
+            # out-of-range rows write to a DUMMY context column (S) so
+            # they cannot corrupt position 0 of the in-flight context;
+            # each query row's mask is its own causal horizon, which
+            # also excludes the dummy column for every real row
+            wcol = jnp.where(oob, S, pos)
+            mask = (jnp.arange(S + 1)[None, :] <= pos[:, None])  # [C, S+1]
+
+            # pools are closed over, read per layer (slice + gather);
+            # the chunk's K/V return as scan outputs and land in one
+            # donated scatter — NOT carried through the scan, which
+            # would copy the whole pool per chunk (see the step above)
+            def layer(x, xs):
+                lp, li = xs
+                ck, cv = k_pool[li], v_pool[li]    # [N, h, bs, hd]
+                y = gpt._layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+                qkv = jnp.einsum("bsd,de->bse", y,
+                                 lp["wqkv"].astype(cfg.dtype))
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+
+                def heads(t):                      # [1,C,d]->[1,h,C,hd]
+                    return t.reshape(1, C, h, hd).transpose(0, 2, 1, 3)
+
+                def gather(pool):                  # -> [1, h, S+1, hd]
+                    g = pool[table]                # [T, h, bs, hd]
+                    g = g.transpose(1, 0, 2, 3).reshape(h, S, hd)
+                    return jnp.pad(g, [(0, 0), (0, 1), (0, 0)])[None]
+
+                kh = k.reshape(C, h, hd).transpose(1, 0, 2)   # [h, C, hd]
+                vh = v.reshape(C, h, hd).transpose(1, 0, 2)
+                ctx_k = gather(ck).at[:, :, wcol, :].set(
+                    kh.astype(ck.dtype))
+                ctx_v = gather(cv).at[:, :, wcol, :].set(
+                    vh.astype(cv.dtype))
+                o = attention(heads(q), ctx_k, ctx_v, causal=False,
+                              mask=mask[None, None], impl="reference")
+                o = o.transpose(0, 2, 1, 3).reshape(1, C, cfg.d_model)
+                o = jnp.einsum("bsd,de->bse", o,
+                               lp["wo"].astype(cfg.dtype)) \
+                    + lp["bo"].astype(cfg.dtype)
+                x = x + o
+                y = gpt._layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+                u = jnp.einsum("bsd,df->bsf", y,
+                               lp["w_up"].astype(cfg.dtype)) \
+                    + lp["b_up"].astype(cfg.dtype)
+                u = jax.nn.gelu(u)
+                dn = jnp.einsum("bsf,fd->bsd", u,
+                                lp["w_down"].astype(cfg.dtype)) \
+                    + lp["b_down"].astype(cfg.dtype)
+                return x + dn, (kh, vh)
+
+            x, (ks, vs) = lax.scan(
+                layer, x, (params["layers"], jnp.arange(L)))
+            # ks/vs [L, h, C, hd] -> [C, L, h, hd] scatter through the
+            # table (oob rows land in the scratch block)
+            k_pool = k_pool.at[:, bidx, :, off, :].set(
+                ks.transpose(2, 0, 1, 3).astype(k_pool.dtype))
+            v_pool = v_pool.at[:, bidx, :, off, :].set(
+                vs.transpose(2, 0, 1, 3).astype(v_pool.dtype))
+            logits = gpt._head(params, x, cfg, mesh, rules)[0]  # [C, V]
+            return logits, k_pool, v_pool
+
+        return chunk_fn
+
+    return _cached(("chunk_prefill", bs, T, C), cfg, mesh, rules, build)
 
 
 def clear_fn_cache() -> None:

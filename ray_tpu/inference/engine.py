@@ -1,16 +1,42 @@
 """Continuous-batching inference engine (Orca-style iteration-level
-scheduling over a fixed decode-batch width).
+scheduling over a fixed decode-batch width) with a PAGED KV cache.
 
 One background loop owns the model state and runs one compiled decode
-step per iteration over ALL slots at once.  Between steps — the prefill
-boundary — it admits waiting requests into free cache slots (each
-admission is one prefill forward that seeds the slot's K/V and produces
-the request's first token) and evicts finished ones (EOS / max-tokens),
-returning their slots to the pool.  Requests therefore join and leave
-MID-DECODE of their neighbors: a long generation never blocks a short
-one behind it, and the decode batch stays as full as the offered load
-allows — the throughput lever the naive sequential baseline lacks
+step per iteration over ALL rows at once.  Between steps — the prefill
+boundary — it admits waiting requests, advances prefills, and evicts
+finished requests (EOS / max-tokens).  Requests therefore join and
+leave MID-DECODE of their neighbors: a long generation never blocks a
+short one behind it, and the decode batch stays as full as the offered
+load allows — the throughput lever the naive sequential baseline lacks
 (benchmarks/serve_bench.py is the A/B receipt).
+
+The default cache is the paged BlockPool (``EngineConfig.paged``):
+
+  * admission is BLOCK-BUDGET accounting, not slot counting — a request
+    is admitted when a decode row is free AND the pool can cover its
+    prompt after prefix-hit credit (LRU-evicting unreferenced cached
+    prefixes under pressure), so short and long sequences share one
+    pool with near-zero waste and peak concurrency is bounded by real
+    token usage, not worst-case stripes.
+  * a radix prefix index (cache.RadixIndex) lets a request whose prompt
+    head matches a cached prefix ADOPT those blocks by refcount instead
+    of re-running prefill; finished/preempted requests donate their
+    clean KV chains back to the index.
+  * prefill runs in fixed-width CHUNKS interleaved with decode
+    iterations, occupancy-aware (one chunk per pass at healthy decode
+    occupancy — bounded stall; batch-fill below it) and shortest-
+    remaining-first — a long prompt no longer stalls neighbors' token
+    cadence for its whole prefill, and cold duplicates of a shared
+    head serialize so one representative publishes for the rest.
+  * decode-time block growth that finds the pool dry first evicts
+    cached prefixes, then PREEMPTS the youngest lowest-priority request
+    (its blocks are donated to the prefix index and it re-queues; on
+    re-admission its prompt includes every token already emitted, so
+    the stream continues exactly — deterministic for greedy, and
+    temperature sampling's rng state lives host-side in the request).
+
+``paged=False`` keeps the round-10/14 slot engine (one ``[max_seq]``
+stripe per request) as the same-run A/B baseline.
 
 Tokens stream out per request as they are sampled: GenerationRequest is
 a tiny condition-variable mailbox whose ``stream()`` generator the serve
@@ -39,8 +65,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.inference.cache import KVCacheManager
-from ray_tpu.inference.decode import make_decode_step, make_prefill_fn
+from ray_tpu.core import fault_injection as _fi
+from ray_tpu.inference.cache import BlockPool, KVCacheManager, RadixIndex
+from ray_tpu.inference.decode import (MoEDecodeUnsupported,
+                                      make_chunk_prefill_fn,
+                                      make_decode_step,
+                                      make_paged_decode_step,
+                                      make_prefill_fn)
 from ray_tpu.models import gpt
 from ray_tpu.models.gpt import GPTConfig
 from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
@@ -48,14 +79,25 @@ from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
 
 @dataclass
 class EngineConfig:
-    """Engine knobs.  max_slots is the decode-batch width AND the cache
-    pool size — the engine's entire memory footprint is fixed by it."""
+    """Engine knobs.  ``max_slots`` is the decode-batch width (the
+    concurrency cap); memory is ``n_blocks`` × ``kv_block_size`` tokens
+    when paged (decoupled from the row count — the mixed-length sharing
+    win), or ``max_slots`` × ``max_seq`` tokens in slot mode."""
     max_slots: int = 8
     max_seq: Optional[int] = None        # cache width; None = model max_seq
     eos_token: Optional[int] = None      # None = never stop early
     default_max_new: int = 64
     max_waiting: int = 1024              # admission-queue bound (backpressure)
     idle_wait_s: float = 0.05            # loop park interval when empty
+    # ---- paged cache (the production path; False = r14 slot engine,
+    # kept in-tree as the benchmark's same-run A/B baseline)
+    paged: bool = True
+    kv_block_size: int = 16              # tokens per block
+    n_blocks: Optional[int] = None       # usable blocks; None = max_slots
+    #                                      * ceil(max_seq/block) (same
+    #                                      bytes as the slot pool)
+    prefill_chunk: int = 32              # chunked-prefill window width
+    prefix_cache: bool = True            # radix prefix reuse on/off
 
 
 # priority classes + the replica-death/draining errors live in the
@@ -87,6 +129,10 @@ class GenerationRequest:
         self.temperature = temperature
         self.priority = priority
         self._rng = rng
+        # emitted tokens already folded into ``prompt`` by a preemption
+        # (block-pressure requeue): on re-admission the prefill covers
+        # prompt+emitted and the stream continues exactly where it was
+        self._consumed = 0
         self.tokens: list[int] = []
         self.done = False
         self.cancelled = False
@@ -216,6 +262,11 @@ class InferenceEngine:
                  name: Optional[str] = None,
                  labels: Optional[dict] = None):
         self.cfg = cfg
+        if cfg.n_experts:
+            # the typed capability gap, raised at engine ADMISSION time
+            # (construction precedes any submit) — never mid-decode with
+            # slots already held (ROADMAP 1c)
+            raise MoEDecodeUnsupported(cfg)
         # extra label pairs on this engine's /metrics series (the serve
         # layer sets deployment/replica/model so multi-replica fleets
         # don't collapse into one ambiguous series)
@@ -223,11 +274,39 @@ class InferenceEngine:
         self.engine_cfg = engine_cfg or EngineConfig()
         ec = self.engine_cfg
         self.params = params
-        self.cache = KVCacheManager(cfg, ec.max_slots, max_seq=ec.max_seq)
-        self._prefill = make_prefill_fn(cfg, mesh=mesh, rules=rules)
-        self._step = make_decode_step(cfg, mesh=mesh, rules=rules)
-
         n = ec.max_slots
+        self._paged = bool(ec.paged)
+        if self._paged:
+            bs = ec.kv_block_size
+            per_seq = -(-int(ec.max_seq or cfg.max_seq) // bs)
+            n_blocks = ec.n_blocks if ec.n_blocks is not None else n * per_seq
+            self.pool = BlockPool(cfg, n_blocks, bs, max_seq=ec.max_seq)
+            self.cache = None
+            self.max_seq = self.pool.max_seq
+            self.trie = (RadixIndex(self.pool) if ec.prefix_cache else None)
+            # the full-width prefill stays: a COLD prompt on an idle
+            # engine seeds all its blocks from one training-forward call
+            # (chunking pays a full-table gather per chunk — it earns
+            # its keep on prefix hits and under load, not cold+idle)
+            self._prefill = make_prefill_fn(cfg, mesh=mesh, rules=rules)
+            self._step = make_paged_decode_step(
+                cfg, block_size=bs, n_table=self.pool.blocks_per_seq,
+                mesh=mesh, rules=rules)
+            self._chunk = make_chunk_prefill_fn(
+                cfg, chunk=ec.prefill_chunk, block_size=bs,
+                n_table=self.pool.blocks_per_seq, mesh=mesh, rules=rules)
+            self._tables = np.zeros((n, self.pool.blocks_per_seq), np.int32)
+            self._row_blocks: dict[int, list[int]] = {}
+            self._free_rows = list(range(n - 1, -1, -1))
+            self._prefilling: dict[int, int] = {}   # row -> next prefill pos
+        else:
+            self.pool = None
+            self.trie = None
+            self.cache = KVCacheManager(cfg, n, max_seq=ec.max_seq)
+            self.max_seq = self.cache.max_seq
+            self._prefill = make_prefill_fn(cfg, mesh=mesh, rules=rules)
+            self._step = make_decode_step(cfg, mesh=mesh, rules=rules)
+
         self._slot_req: dict[int, GenerationRequest] = {}
         self._tokens = np.zeros(n, np.int32)      # current input token
         self._positions = np.zeros(n, np.int32)   # where it will be written
@@ -244,6 +323,10 @@ class InferenceEngine:
         self._requests_completed = 0
         self._decode_iterations = 0
         self._occupancy_sum = 0.0      # Σ active/max_slots per iteration
+        self._prefix_hit_tokens = 0
+        self._prefix_lookup_tokens = 0
+        self._preemptions = 0
+        self._peak_active = 0
 
         with _registry_lock:
             self.name = name or f"engine-{next(_engine_seq)}"
@@ -280,10 +363,13 @@ class InferenceEngine:
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         total = int(prompt.size) + max_new
-        if total > self.cache.max_seq:
+        if total > self.max_seq:
+            # this also bounds the paged block budget: BlockPool
+            # guarantees n_blocks >= ceil(max_seq / block_size), so any
+            # request within the cache width can eventually fit
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new ({max_new}) = {total} "
-                f"exceeds the cache width {self.cache.max_seq}")
+                f"exceeds the cache width {self.max_seq}")
         rng = (jax.random.PRNGKey(seed) if temperature > 0.0 else None)
         req = GenerationRequest(next(self._req_seq), prompt, max_new,
                                 float(temperature), rng,
@@ -317,12 +403,14 @@ class InferenceEngine:
         without shutdown() is still collectable."""
         with self._cond:
             # park unless there is work a pass can make progress
-            # on: an active slot to decode, or a waiting request
-            # AND a free slot to admit it into (waiting alone
-            # must not spin when the pool is fully handed out)
+            # on: an active row to decode, a prefill to advance, or a
+            # waiting request AND a free slot/row to admit it into
+            # (waiting alone must not spin when the pool is handed out;
+            # paged admission retries at the idle tick because block
+            # availability also depends on evictable cached prefixes)
             while (not self._stopped and not self._active.any()
-                   and not (self._waiting
-                            and self.cache.n_free > 0)):
+                   and not (self._paged and self._prefilling)
+                   and not (self._waiting and self._admission_possible())):
                 self._cond.wait(self.engine_cfg.idle_wait_s)
             if self._stopped:
                 return False
@@ -338,14 +426,17 @@ class InferenceEngine:
                     live.append(r)
             self._waiting = live
             admits = []
-            if self._waiting and self.cache.n_free > 0:
-                # prefill-boundary preemption: freed slots go to the
-                # most urgent class first (stable within a class — the
-                # sort key is (priority, submit id))
-                self._waiting.sort(key=lambda r: (r.priority, r.id))
-            while self._waiting and self.cache.n_free > 0:
-                req = self._waiting.pop(0)
-                admits.append((self.cache.alloc(), req))
+            if self._paged:
+                self._paged_admit_locked()
+            else:
+                if self._waiting and self.cache.n_free > 0:
+                    # prefill-boundary preemption: freed slots go to the
+                    # most urgent class first (stable within a class —
+                    # the sort key is (priority, submit id))
+                    self._waiting.sort(key=lambda r: (r.priority, r.id))
+                while self._waiting and self.cache.n_free > 0:
+                    req = self._waiting.pop(0)
+                    admits.append((self.cache.alloc(), req))
         for slot, req in admits:
             # per-admit isolation: one bad prefill fails ONE
             # request and returns its slot; neighbors proceed
@@ -358,11 +449,28 @@ class InferenceEngine:
                     pass
                 req._finish(e)
         try:
-            if self._active.any():
+            if self._paged:
+                if self._prefilling:
+                    # at most ONE chunk per pass: prefill progress is
+                    # interleaved with decode so a long prompt cannot
+                    # stall its neighbors' token cadence
+                    self._prefill_chunk_pass()
+                if self._active.any():
+                    self._paged_decode_iteration()
+            elif self._active.any():
                 self._decode_iteration()
         except Exception as e:                # step failure: fail the
             self._fail_all(e)                 # in-flight requests, keep serving
         return True
+
+    def _admission_possible(self) -> bool:
+        """Cheap park-predicate check; the real budget decision happens
+        in the admission pass."""
+        if not self._paged:
+            return self.cache.n_free > 0
+        return bool(self._free_rows) and (
+            self.pool.n_free > 0
+            or (self.trie is not None and self.trie.cached_blocks > 0))
 
     def _drain_pending(self) -> None:
         """Terminal cleanup: fail everything still queued or in-flight."""
@@ -401,6 +509,378 @@ class InferenceEngine:
         self._tokens[slot] = tok
         self._positions[slot] = n
         self._active[slot] = True
+        with self._mlock:
+            self._peak_active = max(self._peak_active,
+                                    self.cache.n_active)
+
+    # ----------------------------------------------------------- paged path
+
+    def _chaos(self, point: str, **ctx) -> None:
+        """Fault-plane hook (infer_admit / infer_block_alloc):
+        zero-overhead gate when no plan is installed."""
+        fi = _fi._active
+        if fi is None:
+            return
+        ctx["engine"] = self.name
+        fi.on_infer(point, ctx)
+
+    def _paged_admit_locked(self) -> None:
+        """Block-budget admission (called under ``_cond``): admit while
+        a decode row is free AND the pool covers the prompt after
+        prefix-hit credit.  Head-of-line within (priority, arrival)
+        order — a large request that does not fit yet is not overtaken
+        (no starvation)."""
+        if not (self._waiting and self._free_rows):
+            return
+        self._waiting.sort(key=lambda r: (r.priority, r.id))
+        while self._waiting and self._free_rows:
+            req = self._waiting[0]
+            try:
+                if not self._try_admit_paged(req):
+                    break
+            except Exception as e:
+                self._waiting.pop(0)
+                req._finish(e)
+                continue
+            self._waiting.pop(0)
+
+    def _try_admit_paged(self, req: GenerationRequest) -> bool:
+        bs = self.pool.block_size
+        prompt = req.prompt
+        n_prompt = int(prompt.size)
+        p_blocks = -(-n_prompt // bs)
+        ids, hit = (self.trie.match(prompt) if self.trie is not None
+                    else ([], 0))
+        need = p_blocks - len(ids)
+        if self.pool.n_free < need and self.trie is not None:
+            # pressure: evict unreferenced cached prefixes, LRU-first
+            # (the just-matched chain is protected by its new refcount)
+            self.trie.evict(need - self.pool.n_free)
+        if self.pool.n_free < need:
+            for bid in ids:
+                self.pool.decref(bid)
+            return False
+        try:
+            self._chaos("infer_admit", req=req.id, need=need,
+                        hit_tokens=hit)
+        except BaseException:
+            for bid in ids:
+                self.pool.decref(bid)
+            raise
+        row = self._free_rows.pop()
+        blocks = list(ids)
+        for _ in range(need):
+            blocks.append(self.pool.alloc())
+        self._tables[row, :] = 0
+        self._tables[row, :len(blocks)] = blocks
+        self._row_blocks[row] = blocks
+        self._slot_req[row] = req
+        self._prefilling[row] = hit          # prefill resumes past the hit
+        occupied = self.engine_cfg.max_slots - len(self._free_rows)
+        with self._mlock:
+            self._prefix_hit_tokens += hit
+            self._prefix_lookup_tokens += n_prompt
+            self._peak_active = max(self._peak_active, occupied)
+        return True
+
+    def _take_block(self, row: int) -> Optional[int]:
+        """A fresh block for ``row``: free list, else LRU prefix
+        eviction, else preempt the youngest lowest-priority occupied
+        row (``row`` itself last).  Returns None when ``row`` was the
+        preemption victim — the caller must stop touching it."""
+        while True:
+            self._chaos("infer_block_alloc", row=row)
+            bid = self.pool.alloc()
+            if bid is not None:
+                return bid
+            if self.trie is not None and self.trie.evict(1):
+                continue
+            victim = self._pick_victim()
+            if victim is None:
+                return None
+            self._preempt_row(victim)
+            if victim == row:
+                return None
+
+    def _pick_victim(self) -> Optional[int]:
+        """Preemption victim: the youngest request of the least urgent
+        class among occupied rows (prefilling or decoding)."""
+        occupied = list(self._slot_req)
+        if not occupied:
+            return None
+        return max(occupied,
+                   key=lambda r: (self._slot_req[r].priority,
+                                  self._slot_req[r].id))
+
+    def _preempt_row(self, row: int) -> None:
+        """Block-pressure preemption: donate the row's clean KV chain to
+        the prefix index (re-admission will adopt it back if it survives
+        eviction), release the blocks, and requeue the request with its
+        emitted tokens folded into the prompt — the stream continues
+        exactly where it left off."""
+        req = self._slot_req[row]
+        valid = (int(self._positions[row]) if self._active[row]
+                 else self._prefilling.get(row, 0))
+        seq = np.concatenate(
+            [req.prompt,
+             np.asarray(req.tokens[req._consumed:], np.int32)])
+        self._insert_prefix(row, seq[:valid])
+        self._release_row(row)
+        req.prompt = seq
+        req._consumed = len(req.tokens)
+        with self._mlock:
+            self._preemptions += 1
+        with self._cond:
+            stopped = self._stopped
+            if not stopped:
+                self._waiting.append(req)
+            self._cond.notify_all()
+        if stopped:       # raced with shutdown: never leave it hanging
+            req._finish(EngineStoppedError("engine shut down"))
+
+    def _insert_prefix(self, row: int, seq: np.ndarray) -> None:
+        if self.trie is None or len(seq) == 0:
+            return
+        self.trie.insert(seq, self._row_blocks[row])
+
+    def _release_row(self, row: int) -> None:
+        """Drop the row's references (blocks survive only if the prefix
+        index kept them) and return the row to the free list."""
+        self._slot_req.pop(row, None)
+        self._active[row] = False
+        self._prefilling.pop(row, None)
+        for bid in self._row_blocks.pop(row, []):
+            self.pool.decref(bid)
+        self._tables[row, :] = 0
+        with self._cond:
+            self._free_rows.append(row)
+            self._cond.notify_all()
+
+    def _cow_block(self, row: int, bidx: int) -> bool:
+        """Copy-on-write: make table entry ``bidx`` exclusively owned
+        before a write touches it (the shared case is an adopted
+        partially-filled tail).  False = ``row`` was preempted while
+        hunting for the copy's block."""
+        bid = self._row_blocks[row][bidx]
+        if self.pool.refcount(bid) == 1:
+            return True
+        nb = self._take_block(row)
+        if nb is None:
+            return False
+        self.pool.copy_block(bid, nb)
+        self.pool.decref(bid)
+        self._row_blocks[row][bidx] = nb
+        self._tables[row, bidx] = nb
+        return True
+
+    def _prefill_chunk_pass(self) -> None:
+        """Advance prefills, occupancy-aware.  At healthy decode
+        occupancy (>= half the rows active), ONE chunk per pass — that
+        bounds the active streams' per-iteration stall (the point of
+        chunking).  Below it, a decode iteration costs nearly the same
+        almost-empty as full, so filling rows dominates: run as many
+        chunks as there are prefilling rows before the next iteration
+        (each picked shortest-remaining-first, so the cheapest prefill
+        usually FINISHES within the pass rather than every row
+        advancing one step)."""
+        n = self.engine_cfg.max_slots
+        if 2 * int(self._active.sum()) >= n:
+            self._prefill_one_chunk()
+            return
+        for _ in range(len(self._prefilling)):
+            if (not self._prefilling
+                    or 2 * int(self._active.sum()) >= n):
+                break
+            self._prefill_one_chunk()
+
+    def _prefill_one_chunk(self) -> None:
+        """Advance ONE prefilling request, shortest-remaining-first
+        (ties by arrival).  SRF activates the cheapest prefill soonest
+        (occupancy), and — critically for shared prefixes — SERIALIZES
+        cold duplicates of the same head: one representative finishes
+        and publishes the chain, the rest re-match and jump instead of
+        each paying the whole train.  (Round-robin interleaves the
+        duplicates so none publishes until nearly everyone has paid.)
+        On prompt completion the last real row's logits sample the
+        request's first token and the row turns active."""
+        row = min(self._prefilling,
+                  key=lambda r: (int(self._slot_req[r].prompt.size)
+                                 - self._prefilling[r],
+                                 self._slot_req[r].id))
+        req = self._slot_req[row]
+        if req.cancelled:                  # abandoned mid-prefill
+            self._release_row(row)
+            req._finish()
+            self._note_done()
+            return
+        pos = self._prefilling[row]
+        bs = self.pool.block_size
+        C = self.engine_cfg.prefill_chunk
+        prompt = req.prompt
+        n = int(prompt.size)
+        if self.trie is not None:
+            # re-match EVERY advance: a sibling admitted in the same
+            # burst publishes the shared head at its own prefill
+            # completion, and a colder copy of that head may be
+            # mid-chunk-train right here — adopting the published chain
+            # jumps its position forward and hands the replaced fresh
+            # blocks back (concurrent shared-prefix requests would
+            # otherwise each pay the full prefill).  A host-side token
+            # walk per chunk is noise next to the chunk itself.
+            ids2, hit2 = self.trie.match(prompt)
+            if hit2 > pos:
+                blocks = self._row_blocks[row]
+                for i, nb in enumerate(ids2):
+                    self.pool.decref(blocks[i])
+                    blocks[i] = nb
+                    self._tables[row, i] = nb
+                with self._mlock:
+                    # the prompt was counted at admission; fold in only
+                    # the INCREMENTAL tokens the re-match won
+                    self._prefix_hit_tokens += hit2 - pos
+                pos = self._prefilling[row] = hit2
+            else:
+                for bid in ids2:
+                    self.pool.decref(bid)
+        if (pos == 0 and 2 * n > self.max_seq
+                and 2 * int(self._active.sum())
+                < self.engine_cfg.max_slots):
+            # cold LONG prompt at low decode occupancy: ONE full-width
+            # forward (the r10 prefill — gpt.forward with return_kv)
+            # seeds every block at once through the table scatter — a
+            # long chunk train pays a full-table gather per chunk, and
+            # there is little decode cadence to protect.  Under real
+            # load (occupancy >= half) long prompts take the chunked
+            # path — bounded stall wins; short prompts always chunk
+            # (one cheap window beats an S-wide forward).  (pos == 0
+            # also means no adopted blocks — the table is exclusive.)
+            padded = np.zeros((1, self.max_seq), np.int32)
+            padded[0, :n] = prompt
+            logits, k_new, v_new = self._prefill(self.params, padded)
+            self.pool.write_prefill(self._tables[row], k_new[:, 0],
+                                    v_new[:, 0])
+            self._finish_prefill(row, req, logits[0, n - 1])
+            return
+        # the write window [pos, pos+C) must only touch exclusively
+        # owned blocks — only the FIRST can be shared (an adopted
+        # partial tail), but the scan is cheap
+        first = pos // bs
+        last = min(-(-(pos + C) // bs), len(self._row_blocks[row]))
+        for bidx in range(first, last):
+            if not self._cow_block(row, bidx):
+                return                     # row preempted under pressure
+        n_q = min(C, n - pos)
+        chunk_toks = np.zeros(C, np.int32)
+        chunk_toks[:n_q] = prompt[pos:pos + n_q]
+        logits, k, v = self._chunk(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(self._tables[row]), jnp.asarray(chunk_toks),
+            jnp.int32(pos))
+        self.pool.swap(k, v)
+        new_pos = pos + n_q
+        if new_pos < n:
+            self._prefilling[row] = new_pos
+            return
+        self._finish_prefill(row, req, logits[n_q - 1])
+
+    def _finish_prefill(self, row: int, req: GenerationRequest,
+                        last_logits) -> None:
+        """Prompt fully in cache: sample the first token from the last
+        prompt position's logits; the row turns active (or evicts
+        immediately on EOS / max_new == 1)."""
+        del self._prefilling[row]
+        if self.trie is not None:
+            # publish the prompt's full blocks NOW (not at finish):
+            # concurrent requests sharing this head re-match at their
+            # first chunk and skip the whole head prefill.  Full blocks
+            # only — decode writes the partial tail, and sharing it here
+            # would force copy-on-write against ourselves.
+            full = (int(req.prompt.size) // self.pool.block_size) \
+                * self.pool.block_size
+            if full > 0:
+                self._insert_prefix(row, req.prompt[:full])
+        tok = int(gpt.sample_token(last_logits,
+                                   temperature=req.temperature,
+                                   rng=req._next_rng()))
+        req._emit(tok)
+        if self._request_finished(req, tok):
+            self._paged_evict(row)
+            return
+        self._tokens[row] = tok
+        self._positions[row] = int(req.prompt.size)
+        self._active[row] = True
+
+    def _grow_row(self, row: int) -> bool:
+        """Pre-step: make the row's write-target block exist and be
+        exclusively owned (decode crossed a block boundary, or the tail
+        is still shared).  False = ``row`` was preempted."""
+        pos = int(self._positions[row])
+        bidx = pos // self.pool.block_size
+        blocks = self._row_blocks[row]
+        if bidx < len(blocks):
+            return self._cow_block(row, bidx)
+        nb = self._take_block(row)
+        if nb is None:
+            return False
+        blocks.append(nb)
+        self._tables[row, bidx] = nb
+        return True
+
+    def _paged_decode_iteration(self) -> None:
+        for row in [r for r in list(self._slot_req) if self._active[r]]:
+            req = self._slot_req.get(row)
+            if req is None or not self._active[row]:
+                continue                  # preempted by an earlier row's
+            #                               block hunt this very pass
+            if req.cancelled:             # abandoned: free for live work
+                self._paged_evict(row, cache_prefix=False)
+                continue
+            self._grow_row(row)           # False = row preempted; skip
+        if not self._active.any():
+            return
+        logits, k, v = self._step(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(self._tables), jnp.asarray(self._tokens),
+            jnp.asarray(self._positions), jnp.asarray(self._active))
+        self.pool.swap(k, v)
+        logits = np.asarray(logits)
+        with self._mlock:
+            self._decode_iterations += 1
+            self._occupancy_sum += (float(self._active.sum())
+                                    / self.engine_cfg.max_slots)
+        greedy = np.asarray(gpt.sample_token(logits, temperature=0.0))
+        for row in list(self._slot_req):
+            if not self._active[row]:     # prefilling rows ride along
+                continue
+            req = self._slot_req[row]
+            if req.temperature == 0.0:
+                tok = int(greedy[row])
+            else:
+                tok = int(gpt.sample_token(logits[row],
+                                           temperature=req.temperature,
+                                           rng=req._next_rng()))
+            req._emit(tok)
+            self._positions[row] += 1
+            self._tokens[row] = tok
+            if self._request_finished(req, tok):
+                self._paged_evict(row)
+
+    def _paged_evict(self, row: int, cache_prefix: bool = True) -> None:
+        """Natural eviction (EOS / max-tokens / cancel): donate the
+        clean KV chain to the prefix index, then release the row."""
+        req = self._slot_req[row]
+        if cache_prefix and not req.cancelled:
+            valid = (int(self._positions[row]) if self._active[row]
+                     else self._prefilling.get(row, 0))
+            seq = np.concatenate(
+                [req.prompt,
+                 np.asarray(req.tokens[req._consumed:], np.int32)])
+            self._insert_prefix(row, seq[:valid])
+        self._release_row(row)
+        req._finish()
+        self._note_done()
+
+    # ------------------------------------------------------------ slot path
 
     def _decode_iteration(self) -> None:
         logits, k, v = self._step(
@@ -455,6 +935,32 @@ class InferenceEngine:
             self._requests_completed += 1
 
     def _fail_all(self, e: BaseException) -> None:
+        if self._paged:
+            # a failed chunk/step may have invalidated the DONATED pool
+            # buffers; reallocate the pool, drop every reference, and —
+            # critically — clear the prefix index: cached prefixes would
+            # otherwise point at zeroed blocks and silently corrupt
+            # every later prefix hit (the r10 recovery rule generalized
+            # to blocks)
+            failed = [self._slot_req.pop(row)
+                      for row in list(self._slot_req)]
+            self._active[:] = False
+            self._prefilling.clear()
+            self._row_blocks.clear()
+            self._tables[:, :] = 0
+            if self.trie is not None:
+                self.trie.clear()
+            self.pool.reset()
+            with self._cond:
+                self._free_rows = list(
+                    range(self.engine_cfg.max_slots - 1, -1, -1))
+                self._cond.notify_all()
+            # unblock the waiters only AFTER the pool/index are
+            # consistent again, so a result() caller reading stats sees
+            # the recovered state, not the mid-teardown one
+            for req in failed:
+                req._finish(e)
+            return
         for slot in list(self._slot_req):
             req = self._slot_req.pop(slot)
             self._active[slot] = False
@@ -494,15 +1000,18 @@ class InferenceEngine:
                               if r.priority <= PRIORITY_INTERACTIVE)
             stopped = self._stopped
             draining = self._draining
+            occupied = (self.engine_cfg.max_slots - len(self._free_rows)
+                        if self._paged else None)
         with self._mlock:
             iters = self._decode_iterations
             occ = (self._occupancy_sum / iters) if iters else 0.0
             generated = self._generated_tokens
             completed = self._requests_completed
-        cache = self.cache.stats()
-        return {
-            "active_slots": cache["active_slots"],
-            "free_slots": cache["free_slots"],
+            hit_toks = self._prefix_hit_tokens
+            lookup_toks = self._prefix_lookup_tokens
+            preemptions = self._preemptions
+            peak = self._peak_active
+        out = {
             "max_slots": self.engine_cfg.max_slots,
             "waiting_requests": waiting,
             "waiting_interactive": interactive,
@@ -512,8 +1021,40 @@ class InferenceEngine:
             "generated_tokens": generated,
             "requests_completed": completed,
             "decode_iterations": iters,
-            "cache_bytes": cache["bytes_total"],
+            "paged": self._paged,
         }
+        if self._paged:
+            pool = self.pool.stats()
+            total = pool["blocks_total"]
+            out.update({
+                # occupied rows (decoding + prefilling): the same
+                # concurrency meaning the slot engine reported
+                "active_slots": occupied,
+                "free_slots": self.engine_cfg.max_slots - occupied,
+                "cache_bytes": pool["bytes_total"],
+                "block_size": pool["block_size"],
+                "blocks_total": total,
+                "blocks_free": pool["blocks_free"],
+                "block_utilization": (pool["blocks_used"] / total
+                                      if total else 0.0),
+                "prefix_cached_blocks": (self.trie.cached_blocks
+                                         if self.trie is not None else 0),
+                "prefix_hit_tokens": hit_toks,
+                "prefix_lookup_tokens": lookup_toks,
+                "prefix_hit_rate": (hit_toks / lookup_toks
+                                    if lookup_toks else 0.0),
+                "preemptions": preemptions,
+                "peak_active_requests": peak,
+            })
+        else:
+            cache = self.cache.stats()
+            out.update({
+                "active_slots": cache["active_slots"],
+                "free_slots": cache["free_slots"],
+                "cache_bytes": cache["bytes_total"],
+                "peak_active_requests": peak,
+            })
+        return out
 
     def shutdown(self, timeout: float = 5.0) -> None:
         with self._cond:
@@ -529,6 +1070,7 @@ def metrics_snapshot() -> list:
     with _registry_lock:
         engines = dict(_ENGINES)
     active, waiting, occ, gen, comp = {}, {}, {}, {}, {}
+    butil, phit, pcached, preempt = {}, {}, {}, {}
     for name, eng in sorted(engines.items()):
         st = eng.stats()
         # per-replica/per-model labels (serve fleet sets them) keep a
@@ -540,6 +1082,13 @@ def metrics_snapshot() -> list:
         occ[key] = float(st["batch_occupancy"])
         gen[key] = float(st["generated_tokens"])
         comp[key] = float(st["requests_completed"])
+        # paged-cache capacity signal (slot engines report 0): the
+        # router/autoscaler read these through fleet_stats, operators
+        # through /metrics
+        butil[key] = float(st.get("block_utilization", 0.0))
+        phit[key] = float(st.get("prefix_hit_rate", 0.0))
+        pcached[key] = float(st.get("prefix_cached_blocks", 0))
+        preempt[key] = float(st.get("preemptions", 0))
     zero = {(("engine", "none"),): 0.0}
     return [
         ("ray_tpu_inference_active_slots", "gauge",
@@ -552,4 +1101,13 @@ def metrics_snapshot() -> list:
          "Tokens generated since engine start", gen or zero),
         ("ray_tpu_inference_requests_completed_total", "counter",
          "Generation requests completed since engine start", comp or zero),
+        ("ray_tpu_inference_block_utilization_ratio", "gauge",
+         "Paged KV pool blocks in use / usable blocks", butil or zero),
+        ("ray_tpu_inference_prefix_hit_rate", "gauge",
+         "Prompt tokens adopted from the radix prefix cache / prompt "
+         "tokens seen", phit or zero),
+        ("ray_tpu_inference_prefix_cached_blocks", "gauge",
+         "Blocks held by the radix prefix index", pcached or zero),
+        ("ray_tpu_inference_preemptions_total", "counter",
+         "Requests requeued by block-pressure preemption", preempt or zero),
     ]

@@ -213,12 +213,28 @@ class GPTServer:
         slots grow with residency — the router sees real capacity)."""
         engines = self._engines()
         stats = [e.stats() for e in engines]
+        blocks_total = sum(s.get("blocks_total", 0) for s in stats)
+        blocks_free = sum(s.get("blocks_free", 0) for s in stats)
+        hit = sum(s.get("prefix_hit_tokens", 0) for s in stats)
+        lookup = sum(s.get("prefix_lookup_tokens", 0) for s in stats)
         return {
             "max_slots": sum(s["max_slots"] for s in stats),
             "active_slots": sum(s["active_slots"] for s in stats),
             "waiting_requests": sum(s["waiting_requests"] for s in stats),
             "waiting_interactive": sum(s["waiting_interactive"]
                                        for s in stats),
+            # paged-cache capacity signal: the occupancy router and the
+            # autoscaler see BLOCK pressure, not just row counts — a
+            # replica whose rows are free but whose pool is nearly full
+            # is not actually spare capacity (0s when every engine runs
+            # the legacy slot pool)
+            "blocks_total": blocks_total,
+            "blocks_free": blocks_free,
+            "block_utilization": ((blocks_total - blocks_free)
+                                  / blocks_total if blocks_total else 0.0),
+            "prefix_hit_tokens": hit,
+            "prefix_lookup_tokens": lookup,
+            "prefix_hit_rate": (hit / lookup) if lookup else 0.0,
             "models": (self._mux.loaded_models()
                        if self._mux is not None else []),
             "stopped": self._closed or not engines

@@ -55,6 +55,46 @@ def mha_reference(q, k, v, *, causal: bool = True,
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
+def paged_attention(q, k_pool, v_pool, block_tables, *,
+                    kv_lengths: Optional[jax.Array] = None,
+                    mask: Optional[jax.Array] = None,
+                    scale: Optional[float] = None) -> jax.Array:
+    """Block-table-indexed attention over a paged KV pool (one layer).
+
+    q            [b, h, q_len, hd]
+    k_pool/v_pool [n_blocks, h, block_size, hd] — ONE layer's pool slice
+    block_tables [b, n_table] int32 — per-row block ids, in sequence
+                 order; unused entries point at the scratch block (id 0)
+                 whose garbage the masks hide.
+
+    Gathers each row's blocks into a contiguous virtual sequence
+    ``[b, h, n_table * block_size, hd]`` (position p lands at gather
+    index p — tables are position-ordered) and runs the reference
+    masked attention: ``kv_lengths`` [b] masks each row to its own
+    valid prefix (the paged decode shape), ``mask`` is the explicit
+    [b, 1|h, q_len, S] variant (chunked prefill, where each query row
+    has its OWN causal horizon).  This is the gather-per-step cost the
+    slot-granular design deferred; block granularity buys pool sharing
+    across mixed-length sequences in exchange.
+
+    This is the REFERENCE formulation; the compiled step bodies in
+    inference/decode.py inline the same gather so they can insert the
+    current window's K/V into the gathered context before attending
+    (and scatter it back to the pool once, outside the layer scan).
+    """
+    b = q.shape[0]
+    n_tab = block_tables.shape[1]
+    bs = k_pool.shape[2]
+    h, hd = k_pool.shape[1], k_pool.shape[3]
+
+    def gather(pool):
+        g = pool[block_tables]                       # [b, T, h, bs, hd]
+        return g.transpose(0, 2, 1, 3, 4).reshape(b, h, n_tab * bs, hd)
+
+    return mha_reference(q, gather(k_pool), gather(v_pool), causal=False,
+                         scale=scale, mask=mask, kv_lengths=kv_lengths)
+
+
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"

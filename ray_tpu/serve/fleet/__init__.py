@@ -80,6 +80,7 @@ def metrics_snapshot() -> list:
         return []
     admitted, shed, queued, replicas, slots = {}, {}, {}, {}, {}
     resumed_fail, resumed_scale, drained, drain_to = {}, {}, {}, {}
+    blocks, butil, phit = {}, {}, {}
     for name, st in list(ctrl.deployments.items()):
         f = getattr(st, "fleet", None)
         if f is None:
@@ -95,6 +96,9 @@ def metrics_snapshot() -> list:
         queued[key] = float(snap["ingress_queued"])
         replicas[key] = float(snap["replicas"])
         slots[key] = float(snap["total_slots"])
+        blocks[key] = float(snap.get("total_blocks", 0))
+        butil[key] = float(snap.get("block_utilization", 0.0))
+        phit[key] = float(snap.get("prefix_hit_rate", 0.0))
     if not admitted:
         return []
     return [
@@ -118,6 +122,14 @@ def metrics_snapshot() -> list:
          "Live replicas behind the fleet router", replicas),
         ("serve_fleet_total_slots", "gauge",
          "Total decode slots across live replicas", slots),
+        ("serve_fleet_total_blocks", "gauge",
+         "Total paged-KV blocks across live replicas (0 = slot pools)",
+         blocks),
+        ("serve_fleet_block_utilization", "gauge",
+         "Fleet-wide paged-KV blocks in use / usable", butil),
+        ("serve_fleet_prefix_hit_rate", "gauge",
+         "Fleet-wide prompt tokens served from the radix prefix cache",
+         phit),
     ]
 
 

@@ -198,6 +198,7 @@ class Fleet:
         """Point-in-time fleet state (the trace-replay sampler's row)."""
         reps = self.router.live_replicas()
         slots = active = waiting = 0
+        blocks_total = blocks_free = hit_toks = lookup_toks = 0
         for r in reps:
             try:
                 st = self.router.probe(r)
@@ -207,6 +208,10 @@ class Fleet:
                 slots += int(st.get("max_slots", 0))
                 active += int(st.get("active_slots", 0))
                 waiting += int(st.get("waiting_requests", 0))
+                blocks_total += int(st.get("blocks_total", 0))
+                blocks_free += int(st.get("blocks_free", 0))
+                hit_toks += int(st.get("prefix_hit_tokens", 0))
+                lookup_toks += int(st.get("prefix_lookup_tokens", 0))
         with self._clock:
             counters = dict(self.counters.__dict__)
         # compatibility aggregate (the split fields are authoritative)
@@ -219,6 +224,15 @@ class Fleet:
             "engine_waiting": waiting,
             "ingress_queued": self.admission.queue_depth(),
             "occupancy": (active / slots) if slots else 0.0,
+            # paged-cache capacity across the fleet (0s when replicas
+            # run the legacy slot pool): the REAL memory signal behind
+            # the row counts, exported at /metrics for the autoscaler's
+            # operators and dashboards
+            "total_blocks": blocks_total,
+            "block_utilization": ((blocks_total - blocks_free)
+                                  / blocks_total if blocks_total else 0.0),
+            "prefix_hit_rate": (hit_toks / lookup_toks
+                                if lookup_toks else 0.0),
             **counters,
         }
 

@@ -133,6 +133,13 @@ class OccupancyRouter:
         slots = max(1, int(st.get("max_slots", 1)))
         load = (float(st.get("active_slots", 0))
                 + float(st.get("waiting_requests", 0))) / slots
+        # paged engines also report BLOCK pressure: a replica with free
+        # decode rows but a nearly-full pool will queue/preempt, so the
+        # binding constraint (rows or blocks) is the real load signal
+        blocks = float(st.get("blocks_total", 0))
+        if blocks:
+            load = max(load,
+                       (blocks - float(st.get("blocks_free", 0))) / blocks)
         return (load, int(st.get("waiting_requests", 0)),
                 self._rng.random())
 

@@ -247,9 +247,12 @@ def test_cancel_waiting_and_active_frees_slots(params, cfg):
 
 
 def test_admit_failure_isolated_no_slot_leak(params, cfg):
-    """A prefill failure fails ONE request, returns its slot, and the
-    engine keeps serving (no pool shrinkage, no busy-spin)."""
-    eng = InferenceEngine(params, cfg, EngineConfig(max_slots=2))
+    """Slot mode: a prefill failure fails ONE request, returns its slot,
+    and the engine keeps serving (no pool shrinkage, no busy-spin).
+    (The paged path's prefill DONATES the pool, so its failure semantics
+    are recovery, not isolation — test_paged_cache.py covers that.)"""
+    eng = InferenceEngine(params, cfg,
+                          EngineConfig(max_slots=2, paged=False))
     try:
         real_prefill = eng._prefill
         boom = {"armed": True}
@@ -266,6 +269,22 @@ def test_admit_failure_isolated_no_slot_leak(params, cfg):
         assert eng.stats()["free_slots"] == 2      # slot came back
         out = eng.generate([3, 4], max_new=4, timeout=120)
         assert out == _ref_tokens(params, cfg, [3, 4], 4)
+    finally:
+        eng.shutdown()
+
+
+def test_slot_mode_parity_and_reuse(params, cfg):
+    """The legacy slot engine (paged=False — the serving benchmark's
+    same-run A/B baseline) keeps oracle parity and slot recycling."""
+    eng = InferenceEngine(params, cfg,
+                          EngineConfig(max_slots=2, paged=False))
+    try:
+        assert eng.stats()["paged"] is False
+        prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [11, 12]]
+        reqs = [eng.submit(p, max_new=6) for p in prompts]
+        for p, r in zip(prompts, reqs):
+            assert r.result(timeout=120) == _ref_tokens(params, cfg, p, 6)
+        assert eng.stats()["free_slots"] == 2
     finally:
         eng.shutdown()
 
