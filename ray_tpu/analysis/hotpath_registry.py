@@ -137,14 +137,16 @@ HOT_GATES: dict = {
         },
     },
     # inference engine: the paged-cache chaos hook (infer_admit /
-    # infer_block_alloc choke points) — one helper so every other
-    # engine function stays alias-free; same zero-overhead promise as
-    # the control plane (the decode loop runs it per admission / per
-    # block grant)
+    # infer_block_alloc / infer_speculate choke points) and the
+    # flight-recorder request-slice note — one helper each so every
+    # other engine function stays alias-free; same zero-overhead
+    # promise as the control plane (the decode loop runs them per
+    # admission / per block grant / per completed request)
     "ray_tpu.inference.engine": {
-        "aliases": ("_fi",),
+        "aliases": ("_fi", "_fr"),
         "functions": {
             "InferenceEngine._chaos": "gate",
+            "InferenceEngine._fr_note": "gate",
         },
     },
     # serve controller: the drain state machine's chaos hook

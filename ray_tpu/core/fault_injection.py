@@ -460,6 +460,13 @@ class FaultPlan:
             "hit_tokens"})
           * ``infer_block_alloc`` — decode-time block growth (a row
             crossed a block boundary; ctx: {"engine", "row"})
+          * ``infer_speculate``   — a speculative pass is about to
+            verify its drafts (ctx: {"engine", "rows", "drafted"}).
+            A scripted ``fn(ctx)`` may set ``ctx["reject_all"] = True``
+            to force full draft rejection (verify still runs, every
+            draft is discarded, the block-charge rollback path is
+            exercised, and output stays token-exact); raising instead
+            injects a verify-step failure into the recovery path
 
         A scripted ``fn(ctx)`` can raise to inject a pool failure at
         the exact choke point — the engine's recovery path (fail

@@ -7,7 +7,9 @@ end-to-end inference product over the sharded GPT —
                  step + chunked prefill (production), slot step + full
                  prefill via the ordinary training forward
                  (``gpt.forward(return_kv=True)`` — also the paged
-                 cold-start path), all compiled once per geometry.
+                 cold-start path), speculative-decoding bodies (widened
+                 verify step, truncated-layer draft step, host-side
+                 n-gram drafter), all compiled once per geometry.
   * cache.py   — BlockPool (refcounted token blocks, copy-on-write
                  tails, scratch-block scatter discipline) + RadixIndex
                  (prefix reuse trie, LRU eviction); KVCacheManager is
@@ -37,10 +39,14 @@ from __future__ import annotations
 
 from ray_tpu.inference.cache import BlockPool, KVCacheManager, RadixIndex
 from ray_tpu.inference.decode import (MoEDecodeUnsupported,
+                                      SpeculationUnsupported,
                                       make_chunk_prefill_fn,
                                       make_decode_step,
                                       make_paged_decode_step,
-                                      make_prefill_fn)
+                                      make_paged_draft_step,
+                                      make_prefill_fn,
+                                      make_spec_verify_step,
+                                      ngram_propose)
 from ray_tpu.inference.engine import (PRIORITY_BATCH, PRIORITY_INTERACTIVE,
                                       EngineConfig, EngineDrainingError,
                                       EngineStoppedError,
@@ -51,8 +57,10 @@ from ray_tpu.inference.serving import (GPTServer, build_gpt_deployment,
 
 __all__ = [
     "BlockPool", "KVCacheManager", "RadixIndex",
-    "MoEDecodeUnsupported", "make_chunk_prefill_fn", "make_decode_step",
-    "make_paged_decode_step", "make_prefill_fn",
+    "MoEDecodeUnsupported", "SpeculationUnsupported",
+    "make_chunk_prefill_fn", "make_decode_step",
+    "make_paged_decode_step", "make_paged_draft_step", "make_prefill_fn",
+    "make_spec_verify_step", "ngram_propose",
     "EngineConfig", "EngineDrainingError", "EngineStoppedError",
     "GenerationRequest",
     "InferenceEngine", "PRIORITY_BATCH", "PRIORITY_INTERACTIVE",

@@ -265,6 +265,23 @@ class BlockPool:
         with self._lock:
             return self._rc[bid]
 
+    def release_tail(self, blocks: list, keep: int) -> int:
+        """Multi-token ROLLBACK (speculative decode): drop and decref
+        the chain's blocks past the first ``keep`` — the refund of a
+        block charge taken up front for drafted tokens the verify pass
+        rejected.  ``blocks`` is truncated in place (the caller's
+        row-chain list stays the single source of truth, so a
+        preemption racing in later still releases exactly what the row
+        holds).  Rolled-back blocks may contain rejected lanes' K/V —
+        garbage beyond the row's committed length, masked everywhere
+        and freed here, never leaked.  Returns the number released."""
+        keep = max(int(keep), 0)
+        dropped = 0
+        while len(blocks) > keep:
+            self.decref(blocks.pop())
+            dropped += 1
+        return dropped
+
     @property
     def n_free(self) -> int:
         with self._lock:

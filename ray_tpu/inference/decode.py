@@ -1,5 +1,6 @@
 """Incremental (KV-cache) decode for the GPT: paged decode + chunked
-prefill (production), slot decode + full prefill (legacy baseline).
+prefill + speculative verify (production), slot decode + full prefill
+(legacy baseline).
 
 All programs have STATIC shapes so each compiles exactly once
 regardless of request mix — and (no-mesh path) once per (config,
@@ -22,6 +23,30 @@ Paged path (cache.BlockPool):
     rows redirected to the scratch block), attention gathers each
     row's block table and masks to its valid prefix
     (ops/attention.paged_attention).
+  * spec_verify_step — the decode step widened to a [b, W] token
+    window (W = speculate_k + 1): column 0 is each row's current input
+    token, columns 1.. are DRAFTED continuations.  One call scores all
+    W positions per row (each query masked to its own causal horizon,
+    exactly the chunk-prefill formulation batched over rows) and lands
+    every position's K/V in ONE donated scatter — draft-then-verify
+    speculation's verify pass (Leviathan et al. 2023).  Lanes past a
+    row's real draft count are redirected to the scratch block / dummy
+    context column so a short draft can ride a fixed-width program.
+  * paged_draft_step — the truncated-layer self-draft BURST: k
+    autoregressive draft tokens in one compiled call (a lax.scan over
+    draft positions, each scanning only the FIRST ``draft_layers``
+    layers straight into the head, argmax feeding the next step — zero
+    extra weights).  K/V for layers < draft_layers are
+    bit-identical to what the full model writes at those layers (layer
+    l only depends on layers < l), so drafting through the real pool
+    corrupts nothing, and the verify pass overwrites every drafted
+    position at all layers anyway.
+
+The host-side n-gram drafter (``ngram_propose`` — prompt-lookup
+decoding, Saxena 2023) lives here too: it proposes the continuation
+that followed the most recent earlier occurrence of the sequence's
+trailing n-gram.  Zero weights, zero device work — repetitive
+generations (and shared-prefix serving mixes) accept most of it.
 
 Legacy slot path (cache.KVCacheManager, engine ``paged=False``):
 
@@ -42,6 +67,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ray_tpu.models import gpt
@@ -63,6 +89,19 @@ class MoEDecodeUnsupported(NotImplementedError):
             f"(n_experts={cfg.n_experts}: expert dispatch per cached "
             f"token is unimplemented — ROADMAP 1c); serve this config "
             f"with a dense MLP (n_experts=0) or the training forward")
+
+class SpeculationUnsupported(ValueError):
+    """Speculative decoding was requested for a configuration that has
+    no speculation path.  Typed and raised at engine CONSTRUCTION time
+    (like MoEDecodeUnsupported) so the gap fails early and callers can
+    tell the known capability boundary from a generic failure.  The
+    supported surface: the PAGED engine only (the slot engine is the
+    frozen A/B baseline), and the self-drafter needs
+    ``1 <= draft_layers < n_layers`` (a full-depth draft is just the
+    model twice).  ``temperature > 0`` requests are NOT an error — they
+    transparently fall back to non-speculative decode per row (see
+    InferenceEngine.submit)."""
+
 
 # engines with the same (cfg, rules) on the default (no-mesh) path share
 # ONE jitted prefill/step pair: the compiled programs are stateless
@@ -384,6 +423,325 @@ def make_chunk_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int,
         return chunk_fn
 
     return _cached(("chunk_prefill", bs, T, C), cfg, mesh, rules, build)
+
+
+def make_spec_verify_step(cfg: GPTConfig, *, width: int, block_size: int,
+                          n_table: int, mesh=None,
+                          rules: Rules = DEFAULT_LLM_RULES):
+    """jitted speculative VERIFY step: the paged decode step widened to
+    score W = ``width`` positions per row in one call.
+
+    (params, k_pool, v_pool [L, N, h, bs, hd], tables [b, T] int32,
+     tokens [b, W] int32, positions [b] int32, active [b] bool,
+     n_tokens [b] int32)
+        -> (logits [b, W, vocab] f32, k_pool, v_pool)
+
+    ``tokens[row, 0]`` is the row's current input token (sitting at
+    ``positions[row]`` — exactly the plain step's input); columns 1..
+    are drafted continuations at positions+1, +2, ...  ``n_tokens`` in
+    [1, W] says how many leading columns are real; lanes past it (and
+    all lanes of inactive rows) write to the scratch block / dummy
+    context column and attend key 0 only, so their logits are garbage
+    the caller ignores — never NaN, never corruption.
+
+    Each real lane j's K/V is inserted into the gathered context at its
+    own position and its query masked to keys <= positions[row]+j (the
+    chunk-prefill causal-horizon mask batched over rows), so lane 0's
+    logits are the plain decode step's logits and lane j's are exact
+    next-token logits GIVEN the drafted prefix — greedy accept/reject
+    on the host is therefore token-identical to non-speculative decode
+    by construction.  All W positions land in ONE donated scatter;
+    rejected lanes leave garbage K/V beyond the row's committed length,
+    which the kv-length masks hide until decode overwrites it (same
+    rule as prefill padding).
+    """
+    if cfg.n_experts:
+        raise MoEDecodeUnsupported(cfg)
+    h, hd = cfg.n_heads, cfg.head_dim
+    bs, W, T = int(block_size), int(width), int(n_table)
+    S = T * bs
+
+    def build():
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def verify(params, k_pool, v_pool, tables, tokens, positions,
+                   active, n_tokens):
+            b = tokens.shape[0]
+            L = k_pool.shape[0]
+            rows = jnp.arange(b)
+            pos = positions[:, None] + jnp.arange(W, dtype=jnp.int32)  # [b,W]
+            live = ((jnp.arange(W)[None, :] < n_tokens[:, None])
+                    & active[:, None] & (pos < S))        # real lanes
+            wpe_pos = jnp.clip(pos, 0, cfg.max_seq - 1)
+            x = (params["wte"][tokens] + params["wpe"][wpe_pos])
+            x = x.astype(cfg.dtype)                       # [b, W, d]
+            safe = jnp.where(live, pos, 0)
+            bidx = jnp.where(live, tables[rows[:, None], safe // bs], 0)
+            off = jnp.where(live, pos % bs, 0)
+            # dead lanes write a dummy context column (S — the first
+            # slot of the appended SCRATCH-block table entry below);
+            # every real query's causal horizon (<= S-1) excludes the
+            # whole scratch region.  Appending a table column instead
+            # of jnp.pad-ing the gathered context avoids a full-context
+            # copy per layer per pool — the pad was ~half the verify
+            # step's fixed cost.
+            wcol = jnp.where(live, pos, S)
+            hor = jnp.where(live, pos, 0)                 # >=1 key: no NaN
+            tbl = jnp.concatenate(
+                [tables, jnp.zeros((b, 1), tables.dtype)], axis=1)
+            mask = (jnp.arange(S + bs)[None, None, :]
+                    <= hor[:, :, None])[:, None]          # [b, 1, W, S+bs]
+
+            # pools closed over, read per layer; the window's K/V come
+            # back as scan outputs and land in one donated scatter (see
+            # the plain step above for why they are not scan carries)
+            def layer(x, xs):
+                lp, li = xs
+                ck, cv = k_pool[li], v_pool[li]    # [N, h, bs, hd]
+                y = gpt._layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
+                qkv = jnp.einsum("bsd,de->bse", y,
+                                 lp["wqkv"].astype(cfg.dtype))
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+
+                def heads(t):                      # [b,W,d]->[b,h,W,hd]
+                    return t.reshape(b, W, h, hd).transpose(0, 2, 1, 3)
+
+                def gather(pool):                  # -> [b, h, S+bs, hd]
+                    g = pool[tbl]                  # [b, T+1, h, bs, hd]
+                    return g.transpose(0, 2, 1, 3, 4).reshape(
+                        b, h, S + bs, hd)
+
+                kh = k.reshape(b, W, h, hd)
+                vh = v.reshape(b, W, h, hd)
+                # insert the window's K/V at their own positions in the
+                # gathered context (position-major key order preserved;
+                # dead lanes collide harmlessly in the dummy column)
+                ctx_k = gather(ck).at[rows[:, None], :, wcol, :].set(
+                    kh.astype(ck.dtype))
+                ctx_v = gather(cv).at[rows[:, None], :, wcol, :].set(
+                    vh.astype(cv.dtype))
+                o = attention(heads(q), ctx_k, ctx_v, causal=False,
+                              mask=mask, impl="reference")
+                o = o.transpose(0, 2, 1, 3).reshape(b, W, cfg.d_model)
+                o = jnp.einsum("bsd,de->bse", o,
+                               lp["wo"].astype(cfg.dtype)) \
+                    + lp["bo"].astype(cfg.dtype)
+                x = x + o
+                y = gpt._layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
+                u = jnp.einsum("bsd,df->bsf", y,
+                               lp["w_up"].astype(cfg.dtype)) \
+                    + lp["b_up"].astype(cfg.dtype)
+                u = jax.nn.gelu(u)
+                dn = jnp.einsum("bsf,fd->bsd", u,
+                                lp["w_down"].astype(cfg.dtype)) \
+                    + lp["b_down"].astype(cfg.dtype)
+                return x + dn, (kh, vh)
+
+            x, (ks, vs) = lax.scan(
+                layer, x, (params["layers"], jnp.arange(L)))
+            # ks/vs [L, b, W, h, hd] -> [b, W, L, h, hd]: ONE scatter
+            # commits every lane's K/V through the table (dead lanes
+            # hit the scratch block)
+            k_pool = k_pool.at[:, bidx, :, off, :].set(
+                ks.transpose(1, 2, 0, 3, 4).astype(k_pool.dtype))
+            v_pool = v_pool.at[:, bidx, :, off, :].set(
+                vs.transpose(1, 2, 0, 3, 4).astype(v_pool.dtype))
+            logits = gpt._head(params, x, cfg, mesh, rules)  # [b, W, V]
+            return logits, k_pool, v_pool
+
+        return verify
+
+    return _cached(("spec_verify", bs, T, W), cfg, mesh, rules, build)
+
+
+def make_paged_draft_step(cfg: GPTConfig, *, draft_layers: int, k: int,
+                          block_size: int, n_table: int, mesh=None,
+                          rules: Rules = DEFAULT_LLM_RULES):
+    """jitted truncated-layer SELF-DRAFT burst: ``k`` autoregressive
+    draft tokens per row in ONE compiled call — a ``lax.scan`` over
+    draft positions, each scanning only the first ``draft_layers``
+    layers, then the head and a greedy argmax feeding the next step.
+
+    (params, k_pool, v_pool [L, N, h, bs, hd], tables [b, T] int32,
+     tokens [b] int32, positions [b] int32, want [b] int32)
+        -> (drafts [b, k] int32, k_pool, v_pool)
+
+    Row r drafts ``want[r]`` tokens (0 = the row sits the burst out);
+    columns past ``want[r]`` are garbage the caller ignores.  Fusing
+    the whole burst kills the k host round-trips of a step-at-a-time
+    loop — on small models the dispatch + logits transfer per step
+    costs as much as the truncated forward itself.
+
+    The burst's K/V cannot go through the pool between steps (one
+    donated scatter at the end, same discipline as every other step
+    body), so step j's attention reads earlier burst tokens from a
+    carried side-buffer inserted into the gathered context at their
+    true positions — the verify step's scratch-column trick, batched
+    over the burst window.  Only layers < draft_layers land in the
+    pool, and those K/V are bit-identical to the full model's at the
+    same (layer, position) because layer l depends only on layers
+    below it, so drafting straight through the REAL pool is safe:
+    committed positions are unchanged, and the verify pass rewrites
+    every drafted position at all layers regardless of the accept
+    outcome.  Cost per draft token ~ draft_layers / n_layers of a full
+    step, with zero extra weights.
+    """
+    if cfg.n_experts:
+        raise MoEDecodeUnsupported(cfg)
+    h, hd, bs = cfg.n_heads, cfg.head_dim, int(block_size)
+    D, K, T = int(draft_layers), int(k), int(n_table)
+    S = T * bs
+    if not (1 <= D < cfg.n_layers):
+        raise SpeculationUnsupported(
+            f"draft_layers must be in [1, n_layers) = [1, "
+            f"{cfg.n_layers}), got {D}")
+    if K < 1:
+        raise SpeculationUnsupported(f"draft burst k must be >= 1, "
+                                     f"got {K}")
+
+    def build():
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def draft(params, k_pool, v_pool, tables, tokens, positions,
+                  want):
+            b = tokens.shape[0]
+            rows = jnp.arange(b)
+            lanes = jnp.arange(K, dtype=jnp.int32)
+            # one scratch table column (id 0 = the pool's scratch
+            # block): dead lanes write context column S, which every
+            # live query's kv-length horizon (<= S) can include only
+            # as its own position — see wcol below
+            tbl = jnp.concatenate(
+                [tables, jnp.zeros((b, 1), tables.dtype)], axis=1)
+
+            def step(carry, j):
+                cur, pos, bk, bv = carry          # bk/bv [D, b, K, h, hd]
+                live = (want > j) & (pos < S)
+                x = (params["wte"][cur]
+                     + params["wpe"][jnp.clip(pos, 0, cfg.max_seq - 1)])
+                x = x[:, None, :].astype(cfg.dtype)           # [b, 1, d]
+                # burst columns: token i of the burst sits at
+                # positions0 + i; steps not yet drafted (i >= j) and
+                # dead rows land in the scratch column S
+                bpos = (pos - j)[:, None] + lanes[None, :]    # [b, K]
+                bvalid = (lanes[None, :] <= j) & live[:, None] \
+                    & (bpos < S)
+                wcol = jnp.where(bvalid, bpos, S)
+                kv_len = jnp.where(live, pos + 1, 1)
+
+                def layer(x, xs):
+                    lp, li, bk_l, bv_l = xs
+                    ck, cv = k_pool[li], v_pool[li]
+                    y = gpt._layer_norm(x, lp["ln1_scale"],
+                                        lp["ln1_bias"])
+                    qkv = jnp.einsum("bsd,de->bse", y,
+                                     lp["wqkv"].astype(cfg.dtype))
+                    q, kk, v = jnp.split(qkv, 3, axis=-1)
+
+                    def heads(t):                  # [b,1,d]->[b,h,1,hd]
+                        return t.reshape(b, 1, h, hd).transpose(
+                            0, 2, 1, 3)
+
+                    def gather(pool):              # -> [b, h, S+bs, hd]
+                        g = pool[tbl]              # [b, T+1, h, bs, hd]
+                        return g.transpose(0, 2, 1, 3, 4).reshape(
+                            b, h, S + bs, hd)
+
+                    kh = kk.reshape(b, h, hd)
+                    vh = v.reshape(b, h, hd)
+                    # current token joins the burst buffer, then the
+                    # whole window is inserted at its true positions —
+                    # steps < j come from the carry, the pool knows
+                    # nothing of the burst yet
+                    bk_l = bk_l.at[:, j].set(kh.astype(bk_l.dtype))
+                    bv_l = bv_l.at[:, j].set(vh.astype(bv_l.dtype))
+                    ctx_k = gather(ck).at[rows[:, None], :, wcol, :] \
+                        .set(bk_l)
+                    ctx_v = gather(cv).at[rows[:, None], :, wcol, :] \
+                        .set(bv_l)
+                    o = attention(heads(q), ctx_k, ctx_v, causal=False,
+                                  kv_lengths=kv_len, impl="reference")
+                    o = o.transpose(0, 2, 1, 3).reshape(
+                        b, 1, cfg.d_model)
+                    o = jnp.einsum("bsd,de->bse", o,
+                                   lp["wo"].astype(cfg.dtype)) \
+                        + lp["bo"].astype(cfg.dtype)
+                    x = x + o
+                    y = gpt._layer_norm(x, lp["ln2_scale"],
+                                        lp["ln2_bias"])
+                    u = jnp.einsum("bsd,df->bsf", y,
+                                   lp["w_up"].astype(cfg.dtype)) \
+                        + lp["b_up"].astype(cfg.dtype)
+                    u = jax.nn.gelu(u)
+                    dn = jnp.einsum("bsf,fd->bsd", u,
+                                    lp["w_down"].astype(cfg.dtype)) \
+                        + lp["b_down"].astype(cfg.dtype)
+                    return x + dn, (bk_l, bv_l)
+
+                trunk = jax.tree_util.tree_map(lambda a: a[:D],
+                                               params["layers"])
+                x, (bk, bv) = lax.scan(
+                    layer, x, (trunk, jnp.arange(D), bk, bv))
+                logits = gpt._head(params, x, cfg, mesh, rules)[:, 0, :]
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                cur = jnp.where(live, nxt, cur)
+                pos = pos + live.astype(jnp.int32)
+                return (cur, pos, bk, bv), nxt
+
+            bk0 = jnp.zeros((D, b, K, h, hd), cfg.dtype)
+            (_, _, bk, bv), toks = lax.scan(
+                step, (tokens, positions, bk0, bk0), jnp.arange(K))
+            # ONE donated scatter commits the whole burst's K/V for
+            # layers < D (dead lanes collide harmlessly in the scratch
+            # block); layers >= D keep their committed content
+            bpos = positions[:, None] + lanes[None, :]        # [b, K]
+            valid = (lanes[None, :] < want[:, None]) & (bpos < S)
+            safe = jnp.where(valid, bpos, 0)
+            bidx = jnp.where(valid, tbl[rows[:, None], safe // bs], 0)
+            off = jnp.where(valid, safe % bs, 0)
+            # update layout [b*K, D, h, hd]: the two advanced indices
+            # (block, offset) are separated by sliced dims, so their
+            # broadcast axis leads
+            flat = lambda a: a.transpose(1, 2, 0, 3, 4).reshape(
+                b * K, D, h, hd)
+            k_pool = k_pool.at[:D, bidx.reshape(-1), :,
+                               off.reshape(-1), :].set(
+                flat(bk).astype(k_pool.dtype))
+            v_pool = v_pool.at[:D, bidx.reshape(-1), :,
+                               off.reshape(-1), :].set(
+                flat(bv).astype(v_pool.dtype))
+            return toks.T, k_pool, v_pool     # drafts [b, K]
+
+        return draft
+
+    return _cached(("draft_burst", bs, T, D, K), cfg, mesh,
+                   rules, build)
+
+
+def ngram_propose(context: np.ndarray, k: int,
+                  max_ngram: int = 3) -> np.ndarray:
+    """Prompt-lookup draft proposal (Saxena 2023): find the most recent
+    EARLIER occurrence of the context's trailing n-gram (longest n
+    first, n <= max_ngram) and propose up to ``k`` of the tokens that
+    followed it.  Host-side, zero weights — the drafter for workloads
+    whose generations echo their own prompt/history (shared-prefix
+    serving, repetitive greedy tails).  Returns an empty array when
+    nothing matches; the engine then decodes that row plainly."""
+    n = int(len(context))
+    if n < 2 or k < 1:
+        return np.empty(0, np.int32)
+    context = np.asarray(context, np.int32)
+    for m in range(min(int(max_ngram), n - 1), 0, -1):
+        pat = context[n - m:]
+        # candidate starts s in [0, n-m-1]: the trailing n-gram itself
+        # (s = n-m) is excluded, and every match has >= 1 follower
+        win = np.stack([context[i:n - m + i] for i in range(m)], axis=1)
+        hits = np.flatnonzero((win == pat).all(axis=1))
+        if hits.size == 0:
+            continue
+        s = int(hits[-1])                 # most recent occurrence
+        prop = context[s + m:s + m + k]
+        if prop.size:
+            return prop.astype(np.int32)
+    return np.empty(0, np.int32)
 
 
 def clear_fn_cache() -> None:

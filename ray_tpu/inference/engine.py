@@ -35,6 +35,19 @@ The default cache is the paged BlockPool (``EngineConfig.paged``):
     the stream continues exactly — deterministic for greedy, and
     temperature sampling's rng state lives host-side in the request).
 
+  * SPECULATIVE DECODING (``EngineConfig.speculate``): a drafter
+    proposes up to ``speculate_k`` tokens per greedy row per pass — the
+    host-side n-gram/prompt-lookup drafter ("ngram") or the
+    truncated-layer self-drafter ("self") — and ONE widened verify step
+    scores every row's window at once (decode.make_spec_verify_step).
+    Greedy accept/reject against the verify argmaxes is token-EXACT, so
+    the full-recompute oracle gates it like plain decode; the block
+    budget is charged up front for drafted positions (alloc/prefix-
+    evict only — hoped-for tokens never preempt a neighbor) and the
+    rejected tail's charge rolls back after the pass.  A preempted row
+    refunds any speculative charge automatically: granted blocks live
+    in the row chain, and preemption releases the chain.
+
 ``paged=False`` keeps the round-10/14 slot engine (one ``[max_seq]``
 stripe per request) as the same-run A/B baseline.
 
@@ -66,12 +79,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.core import fault_injection as _fi
+from ray_tpu.core import flight_recorder as _fr
 from ray_tpu.inference.cache import BlockPool, KVCacheManager, RadixIndex
 from ray_tpu.inference.decode import (MoEDecodeUnsupported,
+                                      SpeculationUnsupported,
                                       make_chunk_prefill_fn,
                                       make_decode_step,
                                       make_paged_decode_step,
-                                      make_prefill_fn)
+                                      make_paged_draft_step,
+                                      make_prefill_fn,
+                                      make_spec_verify_step,
+                                      ngram_propose)
 from ray_tpu.models import gpt
 from ray_tpu.models.gpt import GPTConfig
 from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
@@ -98,6 +116,17 @@ class EngineConfig:
     #                                      bytes as the slot pool)
     prefill_chunk: int = 32              # chunked-prefill window width
     prefix_cache: bool = True            # radix prefix reuse on/off
+    # ---- speculative decoding (draft-then-verify; paged engine only).
+    # None = off (the same-run A/B baseline); "ngram" = host-side
+    # prompt-lookup drafting against the request's own prompt+history;
+    # "self" = truncated-layer self-draft (the first ``draft_layers``
+    # layers straight into the head).  Greedy requests emit the EXACT
+    # non-speculative token stream (accept/reject is argmax-checked per
+    # drafted position); temperature > 0 requests transparently fall
+    # back to one token per step — never a silent parity break.
+    speculate: Optional[str] = None      # None | "ngram" | "self"
+    speculate_k: int = 4                 # drafted tokens per verify pass
+    draft_layers: int = 1                # self-drafter depth ("self" mode)
 
 
 # priority classes + the replica-death/draining errors live in the
@@ -139,15 +168,24 @@ class GenerationRequest:
         self.error: Optional[BaseException] = None
         self._cond = threading.Condition()
         self.created_s = time.perf_counter()
+        self.created_wall = time.time()   # timeline slices need wall time
         self.first_token_s: Optional[float] = None
         self.finished_s: Optional[float] = None
+        # per-token arrival stamps (perf_counter): consecutive diffs are
+        # the request's ITLs — the latency series speculation moves
+        self.token_times: list[float] = []
+        # per-request speculation accounting (accept-rate per stream)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     # ---- engine side -----------------------------------------------------
 
     def _emit(self, token: int) -> None:
         with self._cond:
+            now = time.perf_counter()
             if self.first_token_s is None:
-                self.first_token_s = time.perf_counter()
+                self.first_token_s = now
+            self.token_times.append(now)
             self.tokens.append(int(token))
             self._cond.notify_all()
 
@@ -276,6 +314,23 @@ class InferenceEngine:
         self.params = params
         n = ec.max_slots
         self._paged = bool(ec.paged)
+        self._spec = ec.speculate
+        if self._spec is not None:
+            # the typed capability boundary, at CONSTRUCTION time like
+            # MoEDecodeUnsupported: the slot engine is the frozen A/B
+            # baseline and grows no speculation path
+            if self._spec not in ("ngram", "self"):
+                raise ValueError(
+                    f"speculate must be None, 'ngram' or 'self', got "
+                    f"{self._spec!r}")
+            if not self._paged:
+                raise SpeculationUnsupported(
+                    "speculative decoding needs the paged engine "
+                    "(EngineConfig.paged=True); the slot engine is the "
+                    "non-speculative A/B baseline")
+            if ec.speculate_k < 1:
+                raise ValueError(
+                    f"speculate_k must be >= 1, got {ec.speculate_k}")
         if self._paged:
             bs = ec.kv_block_size
             per_seq = -(-int(ec.max_seq or cfg.max_seq) // bs)
@@ -295,6 +350,19 @@ class InferenceEngine:
             self._chunk = make_chunk_prefill_fn(
                 cfg, chunk=ec.prefill_chunk, block_size=bs,
                 n_table=self.pool.blocks_per_seq, mesh=mesh, rules=rules)
+            if self._spec is not None:
+                self._verify = make_spec_verify_step(
+                    cfg, width=ec.speculate_k + 1, block_size=bs,
+                    n_table=self.pool.blocks_per_seq, mesh=mesh,
+                    rules=rules)
+                # "self" additionally compiles the truncated-layer
+                # drafter (raises SpeculationUnsupported on a bad
+                # draft_layers — still construction time)
+                self._draft = (make_paged_draft_step(
+                    cfg, draft_layers=ec.draft_layers,
+                    k=ec.speculate_k, block_size=bs,
+                    n_table=self.pool.blocks_per_seq, mesh=mesh,
+                    rules=rules) if self._spec == "self" else None)
             self._tables = np.zeros((n, self.pool.blocks_per_seq), np.int32)
             self._row_blocks: dict[int, list[int]] = {}
             self._free_rows = list(range(n - 1, -1, -1))
@@ -327,6 +395,15 @@ class InferenceEngine:
         self._prefix_lookup_tokens = 0
         self._preemptions = 0
         self._peak_active = 0
+        self._spec_drafted = 0         # drafted tokens offered to verify
+        self._spec_accepted = 0        # drafted tokens accepted
+        self._spec_passes = 0          # verify passes run
+        # per-ROW step accounting: tokens_per_step = row_tokens /
+        # row_steps is exactly 1.0 for plain decode by construction,
+        # and 1 + accepted-per-row-pass under speculation — the batch
+        # width cancels out, so the gauge isolates speculation's win
+        self._row_steps = 0            # (row, compiled-call) pairs
+        self._row_tokens = 0           # tokens those pairs emitted
 
         with _registry_lock:
             self.name = name or f"engine-{next(_engine_seq)}"
@@ -351,7 +428,19 @@ class InferenceEngine:
         """Queue a generation; returns immediately with the request
         mailbox.  Admission happens at the next prefill boundary, in
         (priority, arrival) order — an interactive waiter takes a freed
-        slot ahead of batch waiters that arrived earlier."""
+        slot ahead of batch waiters that arrived earlier.
+
+        Speculation interplay (``EngineConfig.speculate``): greedy
+        requests (``temperature == 0``) ride the draft-then-verify path
+        and emit the EXACT token stream non-speculative decode would.
+        ``temperature > 0`` requests are accepted and transparently
+        decode one token per step — never drafted, never a silent
+        parity break (the decided alternative to a typed rejection:
+        mixed batches are the serving norm, and a sampled request on a
+        speculating engine is valid work, not an error).  The typed
+        ``SpeculationUnsupported`` is reserved for configurations with
+        no speculation path at all (slot engine, bad draft depth) and
+        raised at engine construction."""
         ec = self.engine_cfg
         prompt = np.asarray(list(prompt), np.int32)
         max_new = int(max_new if max_new is not None else ec.default_max_new)
@@ -503,7 +592,7 @@ class InferenceEngine:
         if self._request_finished(req, tok):
             self.cache.free(slot)
             req._finish()
-            self._note_done()
+            self._note_done(req)
             return
         self._slot_req[slot] = req
         self._tokens[slot] = tok
@@ -515,14 +604,36 @@ class InferenceEngine:
 
     # ----------------------------------------------------------- paged path
 
-    def _chaos(self, point: str, **ctx) -> None:
-        """Fault-plane hook (infer_admit / infer_block_alloc):
-        zero-overhead gate when no plan is installed."""
+    def _chaos(self, point: str, **ctx) -> Optional[dict]:
+        """Fault-plane hook (infer_admit / infer_block_alloc /
+        infer_speculate): zero-overhead gate when no plan is installed.
+        Returns the ctx dict when a plan ran — a scripted fn may have
+        mutated it (e.g. ``ctx["reject_all"] = True`` forces the
+        speculative pass to discard every draft), and the caller reads
+        the verdict from it."""
         fi = _fi._active
         if fi is None:
-            return
+            return None
         ctx["engine"] = self.name
         fi.on_infer(point, ctx)
+        return ctx
+
+    def _fr_note(self, req: GenerationRequest) -> None:
+        """Flight-recorder copy of a finished request (armed only):
+        an ``engine_request`` event the merged ``ray_tpu timeline``
+        renders as one engine slice per request, accept/reject counts
+        in its args."""
+        rec = _fr._active
+        if rec is None:
+            return
+        rec.note_ingress({
+            "t": time.time(), "kind": "engine_request",
+            "engine": self.name, "req": req.id,
+            "start_t": req.created_wall,
+            "tokens": len(req.tokens),
+            "spec_accepted": req.spec_accepted,
+            "spec_rejected": req.spec_drafted - req.spec_accepted,
+        })
 
     def _paged_admit_locked(self) -> None:
         """Block-budget admission (called under ``_cond``): admit while
@@ -711,7 +822,7 @@ class InferenceEngine:
         if req.cancelled:                  # abandoned mid-prefill
             self._release_row(row)
             req._finish()
-            self._note_done()
+            self._note_done(req)
             return
         pos = self._prefilling[row]
         bs = self.pool.block_size
@@ -826,6 +937,220 @@ class InferenceEngine:
         self._tables[row, bidx] = nb
         return True
 
+    # ------------------------------------------------- speculative decode
+
+    def _spec_cover(self, row: int, upto: int) -> int:
+        """Charge the block budget for speculative positions UP FRONT:
+        best-effort growth of the row's chain to cover positions
+        through ``upto`` (the write-target block at ``positions[row]``
+        already exists and is exclusive — _grow_row ran).  Allocation
+        and prefix-LRU eviction only — speculation never PREEMPTS a
+        neighbor for tokens that are merely hoped for.  Every granted
+        block is appended to ``_row_blocks[row]`` immediately, so a
+        later preemption of this row refunds the speculative charge
+        with the rest of the chain (_release_row decrefs what the row
+        holds, no separate ledger to forget).  Returns the last
+        position actually covered; the caller caps the draft length."""
+        bs = self.pool.block_size
+        pos = int(self._positions[row])
+        blocks = self._row_blocks[row]
+        for bidx in range(pos // bs + 1, upto // bs + 1):
+            if bidx < len(blocks):
+                continue     # already covered (defensive: the chain is
+            #                  trimmed to the write block after a pass)
+            bid = self.pool.alloc()
+            if bid is None and self.trie is not None \
+                    and self.trie.evict(1):
+                bid = self.pool.alloc()
+            if bid is None:
+                return bidx * bs - 1      # covered through prior block
+            blocks.append(bid)
+            self._tables[row, bidx] = bid
+        return upto
+
+    def _spec_rollback(self, row: int) -> None:
+        """Refund the rejected part of the speculative block charge:
+        drop chain blocks past the row's next write position (that
+        block is KEPT — freeing it would thrash against _grow_row on
+        the very next pass).  Rejected lanes' K/V beyond the committed
+        length is garbage in owned blocks — masked now, overwritten by
+        later decode — so rollback is pure budget accounting."""
+        keep = int(self._positions[row]) // self.pool.block_size + 1
+        blocks = self._row_blocks[row]
+        old = len(blocks)
+        if self.pool.release_tail(blocks, keep):
+            self._tables[row, len(blocks):old] = 0
+
+    def _spec_propose(self) -> tuple:
+        """Per-row draft proposals for this pass.  Returns
+        ``(drafts [n, k] int32, want [n] int32)``: row r offers
+        ``want[r]`` draft tokens (0 = ride the verify pass as a plain
+        one-token lane).  Sampled-temperature rows and rows at their
+        max_new boundary never draft; block coverage is charged here
+        (_spec_cover) and caps a draft the pool cannot hold."""
+        ec = self.engine_cfg
+        n, k = ec.max_slots, ec.speculate_k
+        drafts = np.zeros((n, k), np.int32)
+        want = np.zeros(n, np.int32)
+        props = {}
+        active_rows = 0
+        for row in list(self._slot_req):
+            if not self._active[row]:
+                continue
+            active_rows += 1
+            req = self._slot_req[row]
+            if req.temperature != 0.0:
+                continue      # documented per-row fallback (submit())
+            w = min(k, req.max_new - len(req.tokens) - 1)
+            if w <= 0:
+                continue
+            if self._spec == "ngram":
+                hist = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.tokens[req._consumed:], np.int32)])
+                prop = ngram_propose(hist, w)
+                if prop.size == 0:
+                    continue
+                props[row] = prop
+                w = min(w, int(prop.size))
+            want[row] = w
+        # batch-coverage gate: the widened verify prices EVERY active
+        # row at W lanes, so a pass where only a few rows draft costs
+        # more than the plain step saves on the rest of the batch —
+        # speculate only when at least half the batch drafts.  Decided
+        # BEFORE blocks are charged or draft steps run, so a skipped
+        # pass pays nothing.
+        if int((want > 0).sum()) * 2 < active_rows:
+            want[:] = 0
+            return drafts, want
+        for row in np.nonzero(want)[0]:
+            pos = int(self._positions[row])
+            w = min(int(want[row]),
+                    self._spec_cover(row, pos + int(want[row])) - pos)
+            if w <= 0:                         # pool cannot hold a draft
+                want[row] = 0
+                continue
+            want[row] = w
+            if self._spec == "ngram":
+                drafts[row, :w] = props[row][:w]
+        if self._spec == "self" and want.any():
+            self._spec_self_draft(drafts, want)
+        return drafts, want
+
+    def _spec_self_draft(self, drafts: np.ndarray,
+                         want: np.ndarray) -> None:
+        """Fill ``drafts`` with ONE fused draft-burst call: the whole
+        k-step autoregressive truncated-layer loop runs on device
+        (argmax feeding the next step), so the host pays a single
+        dispatch instead of k round-trips.  Rows draft ``want[row]``
+        tokens; dead rows sit out via the burst's lane mask.  The
+        drafted K/V for layers < draft_layers lands in the REAL pool —
+        identical to what the full model writes there, and the verify
+        pass rewrites all drafted positions at all layers anyway."""
+        w = np.where(self._active, want, 0).astype(np.int32)
+        toks, kp, vp = self._draft(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(self._tables), jnp.asarray(self._tokens),
+            jnp.asarray(self._positions), jnp.asarray(w))
+        self.pool.swap(kp, vp)
+        toks = np.asarray(toks)
+        m = np.arange(toks.shape[1])[None, :] < w[:, None]
+        drafts[:, :toks.shape[1]][m] = toks[m]
+
+    def _speculative_iteration(self) -> bool:
+        """One draft-then-verify pass over the whole batch; False = no
+        drafts this pass (caller falls back to the plain step).  The
+        accept rule is greedy and token-exact: lane j's verify logits
+        are the model's next-token logits GIVEN the drafted prefix, so
+        walking lanes while ``argmax == draft`` — and emitting the
+        argmax CORRECTION at the first mismatch — reproduces the
+        non-speculative greedy stream exactly (>= 1 token per pass).
+        Committed lanes' K/V is already in the pool from the verify
+        scatter; the rejected tail's block charge is rolled back."""
+        drafts, want = self._spec_propose()
+        if not want.any():
+            return False
+        force_reject = False
+        ctx = self._chaos("infer_speculate",
+                          rows=int((want > 0).sum()),
+                          drafted=int(want.sum()))
+        if ctx is not None and ctx.get("reject_all"):
+            # forced FULL rejection (chaos): the verify pass still
+            # runs and every draft is discarded — exercising the whole
+            # charge -> verify -> reject -> rollback path with parity
+            # intact (the correction token is the plain step's token)
+            force_reject = True
+        n = self.engine_cfg.max_slots
+        W = self.engine_cfg.speculate_k + 1
+        tok_mat = np.zeros((n, W), np.int32)
+        tok_mat[:, 0] = self._tokens
+        tok_mat[:, 1:] = drafts
+        n_tok = np.where(self._active, want + 1, 1).astype(np.int32)
+        logits, k, v = self._verify(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(self._tables), jnp.asarray(tok_mat),
+            jnp.asarray(self._positions), jnp.asarray(self._active),
+            jnp.asarray(n_tok))
+        self.pool.swap(k, v)
+        logits = np.asarray(logits)               # [n, W, V]
+        with self._mlock:
+            self._decode_iterations += 1
+            self._spec_passes += 1
+            self._occupancy_sum += (float(self._active.sum())
+                                    / self.engine_cfg.max_slots)
+        greedy = np.asarray(gpt.sample_token(
+            logits.reshape(n * W, -1), temperature=0.0)).reshape(n, W)
+        stepped = emitted = 0
+        for row in list(self._slot_req):
+            if not self._active[row]:     # prefilling rows ride along
+                continue
+            req = self._slot_req[row]
+            w = int(want[row])
+            if req.temperature != 0.0:
+                # sampled lane 0 == the plain step's logits: one token,
+                # per-request rng — byte-identical to the fallback path
+                tok = int(gpt.sample_token(logits[row, 0],
+                                           temperature=req.temperature,
+                                           rng=req._next_rng()))
+                req._emit(tok)
+                stepped += 1
+                emitted += 1
+                self._positions[row] += 1
+                self._tokens[row] = tok
+                if self._request_finished(req, tok):
+                    self._paged_evict(row)
+                continue
+            accepted = 0
+            finished = False
+            for j in range(w + 1):
+                tok = int(greedy[row, j])
+                req._emit(tok)
+                emitted += 1
+                self._positions[row] += 1
+                self._tokens[row] = tok
+                if self._request_finished(req, tok):
+                    finished = True       # EOS / max_new mid-burst
+                    break
+                if j < w and not force_reject \
+                        and int(drafts[row, j]) == tok:
+                    accepted += 1         # lane j+1's input was right
+                    continue
+                break                     # first mismatch: corrected
+            stepped += 1
+            req.spec_drafted += w
+            req.spec_accepted += accepted
+            with self._mlock:
+                self._spec_drafted += w
+                self._spec_accepted += accepted
+            if finished:
+                self._paged_evict(row)    # releases the whole chain
+            else:
+                self._spec_rollback(row)
+        with self._mlock:
+            self._row_steps += stepped
+            self._row_tokens += emitted
+        return True
+
     def _paged_decode_iteration(self) -> None:
         for row in [r for r in list(self._slot_req) if self._active[r]]:
             req = self._slot_req.get(row)
@@ -838,6 +1163,23 @@ class InferenceEngine:
             self._grow_row(row)           # False = row preempted; skip
         if not self._active.any():
             return
+        # draft-then-verify when configured; False = no row produced a
+        # draft this pass (nothing to verify) — the plain one-token
+        # step below is the fallback, so an all-sampled or draft-dry
+        # batch pays zero speculation overhead.  A speculative pass
+        # spans the wall time of ~3 plain steps, and the loop normally
+        # advances one prefill chunk per pass — so after a wide pass,
+        # run the extra chunks the interleave missed.  Without the
+        # compensation, speculation cuts chunk cadence (= TTFT of
+        # admitting requests) by the pass width; with it, admission
+        # latency stays flat and decode-only passes pay nothing.
+        if (self._spec is not None
+                and self._speculative_iteration()):
+            for _ in range(2):
+                if not self._prefilling:
+                    break
+                self._prefill_one_chunk()
+            return
         logits, k, v = self._step(
             self.params, self.pool.k, self.pool.v,
             jnp.asarray(self._tables), jnp.asarray(self._tokens),
@@ -849,6 +1191,7 @@ class InferenceEngine:
             self._occupancy_sum += (float(self._active.sum())
                                     / self.engine_cfg.max_slots)
         greedy = np.asarray(gpt.sample_token(logits, temperature=0.0))
+        stepped = 0
         for row in list(self._slot_req):
             if not self._active[row]:     # prefilling rows ride along
                 continue
@@ -860,10 +1203,14 @@ class InferenceEngine:
                                            temperature=req.temperature,
                                            rng=req._next_rng()))
             req._emit(tok)
+            stepped += 1
             self._positions[row] += 1
             self._tokens[row] = tok
             if self._request_finished(req, tok):
                 self._paged_evict(row)
+        with self._mlock:
+            self._row_steps += stepped
+            self._row_tokens += stepped
 
     def _paged_evict(self, row: int, cache_prefix: bool = True) -> None:
         """Natural eviction (EOS / max-tokens / cancel): donate the
@@ -878,7 +1225,7 @@ class InferenceEngine:
             self._insert_prefix(row, seq[:valid])
         self._release_row(row)
         req._finish()
-        self._note_done()
+        self._note_done(req)
 
     # ------------------------------------------------------------ slot path
 
@@ -897,6 +1244,7 @@ class InferenceEngine:
         # path: one argmax over [n_slots, vocab], not one dispatch per
         # slot); temperature rows keep their per-request rng
         greedy = np.asarray(gpt.sample_token(logits, temperature=0.0))
+        stepped = 0
         for slot in list(self._slot_req):
             req = self._slot_req[slot]
             if req.cancelled:             # abandoned (timeout/disconnect):
@@ -909,10 +1257,14 @@ class InferenceEngine:
                                            temperature=req.temperature,
                                            rng=req._next_rng()))
             req._emit(tok)
+            stepped += 1
             self._positions[slot] += 1
             self._tokens[slot] = tok
             if self._request_finished(req, tok):
                 self._evict(slot)
+        with self._mlock:
+            self._row_steps += stepped
+            self._row_tokens += stepped
 
     def _request_finished(self, req: GenerationRequest, tok: int) -> bool:
         with self._mlock:
@@ -926,13 +1278,14 @@ class InferenceEngine:
         self._active[slot] = False
         self.cache.free(slot)
         req._finish()
-        self._note_done()
+        self._note_done(req)
         with self._cond:
             self._cond.notify_all()   # wake loop in case admits are waiting
 
-    def _note_done(self) -> None:
+    def _note_done(self, req: GenerationRequest) -> None:
         with self._mlock:
             self._requests_completed += 1
+        self._fr_note(req)
 
     def _fail_all(self, e: BaseException) -> None:
         if self._paged:
@@ -1011,6 +1364,11 @@ class InferenceEngine:
             lookup_toks = self._prefix_lookup_tokens
             preemptions = self._preemptions
             peak = self._peak_active
+            drafted = self._spec_drafted
+            accepted = self._spec_accepted
+            spec_passes = self._spec_passes
+            row_steps = self._row_steps
+            row_tokens = self._row_tokens
         out = {
             "max_slots": self.engine_cfg.max_slots,
             "waiting_requests": waiting,
@@ -1021,7 +1379,23 @@ class InferenceEngine:
             "generated_tokens": generated,
             "requests_completed": completed,
             "decode_iterations": iters,
+            # tokens emitted per (row, compiled call) pair: exactly 1.0
+            # for plain decode by construction, 1 + accepted-per-pass
+            # under speculation — batch width cancels out
+            "tokens_per_step": (row_tokens / row_steps) if row_steps
+                               else 0.0,
+            # raw counters behind tokens_per_step so fleet aggregation
+            # can reduce exactly instead of averaging averages
+            "row_steps": row_steps,
+            "row_tokens": row_tokens,
             "paged": self._paged,
+            # ---- speculative decoding (zeros when speculate=None /
+            # slot engine — the same-run baselines stay comparable)
+            "speculate": self._spec,
+            "spec_drafted_tokens": drafted,
+            "spec_accepted_tokens": accepted,
+            "spec_accept_rate": (accepted / drafted) if drafted else 0.0,
+            "spec_passes": spec_passes,
         }
         if self._paged:
             pool = self.pool.stats()
@@ -1071,6 +1445,7 @@ def metrics_snapshot() -> list:
         engines = dict(_ENGINES)
     active, waiting, occ, gen, comp = {}, {}, {}, {}, {}
     butil, phit, pcached, preempt = {}, {}, {}, {}
+    tps, arate, saccept = {}, {}, {}
     for name, eng in sorted(engines.items()):
         st = eng.stats()
         # per-replica/per-model labels (serve fleet sets them) keep a
@@ -1089,6 +1464,11 @@ def metrics_snapshot() -> list:
         phit[key] = float(st.get("prefix_hit_rate", 0.0))
         pcached[key] = float(st.get("prefix_cached_blocks", 0))
         preempt[key] = float(st.get("preemptions", 0))
+        # speculation signal, per replica: accept-rate is the drafter's
+        # quality gauge, tokens/step the latency win it buys
+        tps[key] = float(st.get("tokens_per_step", 0.0))
+        arate[key] = float(st.get("spec_accept_rate", 0.0))
+        saccept[key] = float(st.get("spec_accepted_tokens", 0))
     zero = {(("engine", "none"),): 0.0}
     return [
         ("ray_tpu_inference_active_slots", "gauge",
@@ -1110,4 +1490,12 @@ def metrics_snapshot() -> list:
          "Blocks held by the radix prefix index", pcached or zero),
         ("ray_tpu_inference_preemptions_total", "counter",
          "Requests requeued by block-pressure preemption", preempt or zero),
+        ("ray_tpu_inference_tokens_per_step", "gauge",
+         "Tokens emitted per compiled decode/verify call (speculative "
+         "decoding pushes this above 1)", tps or zero),
+        ("ray_tpu_inference_spec_accept_rate", "gauge",
+         "Drafted tokens accepted by the verify pass / drafted tokens "
+         "offered", arate or zero),
+        ("ray_tpu_inference_spec_accepted_tokens_total", "counter",
+         "Drafted tokens accepted since engine start", saccept or zero),
     ]

@@ -217,6 +217,10 @@ class GPTServer:
         blocks_free = sum(s.get("blocks_free", 0) for s in stats)
         hit = sum(s.get("prefix_hit_tokens", 0) for s in stats)
         lookup = sum(s.get("prefix_lookup_tokens", 0) for s in stats)
+        drafted = sum(s.get("spec_drafted_tokens", 0) for s in stats)
+        s_accept = sum(s.get("spec_accepted_tokens", 0) for s in stats)
+        row_steps = sum(s.get("row_steps", 0) for s in stats)
+        row_tokens = sum(s.get("row_tokens", 0) for s in stats)
         return {
             "max_slots": sum(s["max_slots"] for s in stats),
             "active_slots": sum(s["active_slots"] for s in stats),
@@ -235,6 +239,14 @@ class GPTServer:
             "prefix_hit_tokens": hit,
             "prefix_lookup_tokens": lookup,
             "prefix_hit_rate": (hit / lookup) if lookup else 0.0,
+            # speculative decoding: the router and autoscaler see the
+            # replica's accept-rate and per-row decode throughput (1.0
+            # without speculation — same-run baselines stay comparable)
+            "spec_drafted_tokens": drafted,
+            "spec_accepted_tokens": s_accept,
+            "spec_accept_rate": (s_accept / drafted) if drafted else 0.0,
+            "tokens_per_step": (row_tokens / row_steps) if row_steps
+                               else 0.0,
             "models": (self._mux.loaded_models()
                        if self._mux is not None else []),
             "stopped": self._closed or not engines

@@ -80,7 +80,7 @@ def metrics_snapshot() -> list:
         return []
     admitted, shed, queued, replicas, slots = {}, {}, {}, {}, {}
     resumed_fail, resumed_scale, drained, drain_to = {}, {}, {}, {}
-    blocks, butil, phit = {}, {}, {}
+    blocks, butil, phit, saccept = {}, {}, {}, {}
     for name, st in list(ctrl.deployments.items()):
         f = getattr(st, "fleet", None)
         if f is None:
@@ -99,6 +99,7 @@ def metrics_snapshot() -> list:
         blocks[key] = float(snap.get("total_blocks", 0))
         butil[key] = float(snap.get("block_utilization", 0.0))
         phit[key] = float(snap.get("prefix_hit_rate", 0.0))
+        saccept[key] = float(snap.get("spec_accept_rate", 0.0))
     if not admitted:
         return []
     return [
@@ -130,6 +131,9 @@ def metrics_snapshot() -> list:
         ("serve_fleet_prefix_hit_rate", "gauge",
          "Fleet-wide prompt tokens served from the radix prefix cache",
          phit),
+        ("serve_fleet_spec_accept_rate", "gauge",
+         "Fleet-wide speculative-draft acceptance (0 = not speculating)",
+         saccept),
     ]
 
 

@@ -199,6 +199,7 @@ class Fleet:
         reps = self.router.live_replicas()
         slots = active = waiting = 0
         blocks_total = blocks_free = hit_toks = lookup_toks = 0
+        drafted = accepted = 0
         for r in reps:
             try:
                 st = self.router.probe(r)
@@ -212,6 +213,8 @@ class Fleet:
                 blocks_free += int(st.get("blocks_free", 0))
                 hit_toks += int(st.get("prefix_hit_tokens", 0))
                 lookup_toks += int(st.get("prefix_lookup_tokens", 0))
+                drafted += int(st.get("spec_drafted_tokens", 0))
+                accepted += int(st.get("spec_accepted_tokens", 0))
         with self._clock:
             counters = dict(self.counters.__dict__)
         # compatibility aggregate (the split fields are authoritative)
@@ -233,6 +236,11 @@ class Fleet:
                                   / blocks_total if blocks_total else 0.0),
             "prefix_hit_rate": (hit_toks / lookup_toks
                                 if lookup_toks else 0.0),
+            # speculative decoding across the fleet (0.0 when no replica
+            # speculates — plain arms report nothing, not a fake zero%)
+            "spec_drafted_tokens": drafted,
+            "spec_accepted_tokens": accepted,
+            "spec_accept_rate": (accepted / drafted) if drafted else 0.0,
             **counters,
         }
 

@@ -14,6 +14,10 @@ python/ray/experimental/state + _private/profiling.py):
     (serve/fleet): queued admissions render as ``X`` slices (the queue
     wait is visible time), everything else as ``i`` instants, one track
     per event kind
+  * inference-engine request slices → one ``X`` per completed request
+    (pid "engine", tid = engine name) spanning submit→finish, with
+    speculative-decoding accept/reject counts merged into the slice
+    args (engine_request events from InferenceEngine._fr_note)
 
 Output loads in chrome://tracing and ui.perfetto.dev (both accept the
 ``{"traceEvents": [...]}`` object form and string pid/tid values).
@@ -91,6 +95,20 @@ def build_trace(task_events: Iterable = (), records: Iterable = (),
         ts = float(g.get("t", 0.0)) * 1e6
         args = {k: v for k, v in g.items() if k not in ("t", "kind")}
         queued = float(g.get("queued_s") or 0.0)
+        if kind == "engine_request":
+            # inference-engine request slice (engine._fr_note): one X
+            # per completed request on the engine's own track, carrying
+            # speculative accept/reject counts in args so "why was this
+            # stream fast/slow" reads straight off the trace
+            t0 = float(g.get("start_t", g.get("t", 0.0))) * 1e6
+            ev.append({
+                "name": f"engine:{g.get('req', '?')}",
+                "cat": "engine", "ph": "X",
+                "ts": t0, "dur": max(0.0, ts - t0),
+                "pid": "engine", "tid": g.get("engine", "?"),
+                "args": args,
+            })
+            continue
         if kind == "admit" and queued > 0:
             ev.append({
                 "name": "ingress:queued", "cat": "ingress", "ph": "X",
