@@ -1,9 +1,9 @@
-"""Inference serving benchmark → SERVE_r16.json.
+"""Inference serving benchmark → SERVE_r17.json.
 
-Same-box, same-run A/B receipts for the inference engine, round 16:
-the r15 arms (paged KV cache vs the r10/r14 slot engine) plus
-SPECULATIVE DECODING (draft-then-verify, greedy token-exact) against
-the identical non-speculative paged engine.
+Same-box, same-run A/B receipts for the inference engine, round 17:
+the r16 arms (paged KV cache vs the r10/r14 slot engine, speculative
+decoding) plus TENSOR-PARALLEL SHARDED DECODE: the same request set on
+the paged engine unmeshed vs on a tp=2 mesh, in one process.
 
 Arms:
 
@@ -29,6 +29,21 @@ Arms:
     at least one speculative arm, and that arm's TTFT p99 AND ITL p99
     beat the non-speculative baseline.  Output is token-exact by the
     greedy accept rule, so this is pure latency, not quality trade.
+  * sharded_decode        — the same shared-prefix request set on the
+    paged engine unmeshed vs sharded over a tp=2 mesh (heads-sharded
+    block pools, replicated tables, one collective per layer).  On
+    this box the "mesh" is virtual CPU devices carved from one host
+    (``--xla_force_host_platform_device_count``), so the sharded arm
+    is SLOWER — there is no extra silicon, only added collectives.
+    The gate is therefore token EXACTNESS plus the per-device
+    accounting (bytes_per_device == total/tp), not speed; the speed
+    story needs real chips and is ROADMAP item 1's next receipt.
+    BOTH halves run inside one ``--shard-child`` subprocess: the
+    parent's backend initializes on one device, and forcing 8 virtual
+    devices process-wide measurably shifts the OTHER arms' in-run
+    ratios (the spec baseline sped up ~30% under it), so the device
+    split is confined to the child while the A/B itself stays
+    same-process.
 
 Every arm now records ITL (inter-token latency) p50/p99 alongside
 TTFT.  ITL here is the normalized per-request definition (NVIDIA
@@ -58,7 +73,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-ROUND = 16
+ROUND = 17
 
 
 def _pct(xs, p):
@@ -218,16 +233,10 @@ def engine_cfg_max_seq(ecfg, cfg):
     return int(ecfg.max_seq or cfg.max_seq)
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--quick", action="store_true")
-    ap.add_argument("--out", default="SERVE_r16.json")
-    args = ap.parse_args()
-
+def _bench_model():
     import jax
     import jax.numpy as jnp
 
-    from ray_tpu.inference import EngineConfig
     from ray_tpu.models import gpt
 
     # big enough that compute (not per-call dispatch) dominates — the
@@ -235,11 +244,10 @@ def main():
     cfg = gpt.GPTConfig(vocab_size=512, max_seq=256, d_model=256,
                         n_heads=8, n_layers=6, d_ff=1024, remat=False,
                         dtype=jnp.float32)
-    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
-    q = args.quick
+    return cfg, gpt.init_params(cfg, jax.random.PRNGKey(0))
 
-    phases = {}
 
+def _make_phase(phases):
     def phase(name, fn):
         l0 = os.getloadavg()[0]
         t0 = time.perf_counter()
@@ -250,6 +258,103 @@ def main():
             "phase_wall_s": round(time.perf_counter() - t0, 1),
         }
         return result
+    return phase
+
+
+def run_exact_arm(params, cfg, reqs, engine_cfg, *, mesh=None):
+    """Drive one engine over the request set and keep every output
+    token: the sharded A/B gate is exactness, so the tokens ARE the
+    measurement.  Returns (stats, list-of-token-lists)."""
+    from ray_tpu.inference import InferenceEngine
+    eng = InferenceEngine(params, cfg, engine_cfg, mesh=mesh)
+    wp = [(i % 7) + 1 for i in range(int(cfg.max_seq) * 3 // 4)]
+    eng.generate(wp, max_new=2, timeout=600)   # compile off the clock
+    eng.generate(wp, max_new=2, timeout=600)   # chunked-path compile
+    t0 = time.perf_counter()
+    handles = [eng.submit(p, max_new=m) for p, m in reqs]
+    outs = [list(h.result(timeout=900)) for h in handles]
+    wall = time.perf_counter() - t0
+    st = eng.stats()
+    eng.shutdown()
+    stats = {
+        "requests": len(reqs),
+        "wall_s": round(wall, 3),
+        "req_s": round(len(reqs) / wall, 2),
+        "tokens_s": round(sum(len(o) for o in outs) / wall, 1),
+        "mesh_devices": st.get("mesh_devices", 1),
+        "tp_shards": st.get("tp_shards", 1),
+        "blocks_total": st["blocks_total"],
+        "blocks_per_device": st.get("blocks_per_device"),
+        "cache_bytes": st["cache_bytes"],
+        "cache_bytes_per_device": st.get("cache_bytes_per_device"),
+        "prefix_hit_tokens": st["prefix_hit_tokens"],
+    }
+    return stats, outs
+
+
+def run_sharded_ab(q, phase):
+    """Arm 4, both halves — runs inside the ``--shard-child``
+    subprocess, whose backend was forced onto 8 virtual CPU devices
+    before init (the parent's stays on one)."""
+    import jax
+
+    from ray_tpu.inference import EngineConfig
+    from ray_tpu.parallel.mesh import create_mesh
+
+    assert jax.device_count() >= 2, \
+        "shard child must run under a forced multi-device backend"
+    cfg, params = _bench_model()
+    tp_mesh = create_mesh({"tp": 2}, devices=jax.devices()[:2])
+    reqs = make_shared_prefix_requests(
+        6 if q else 12, seed=29, vocab=cfg.vocab_size, heads=3,
+        head_len=96, tail_len=8, max_new=8)
+    shard_cfg = EngineConfig(max_slots=4, kv_block_size=16,
+                             prefill_chunk=16)
+    sh_single, out_a = phase("sharded_single", lambda: run_exact_arm(
+        params, cfg, reqs, shard_cfg))
+    sh_tp2, out_b = phase("sharded_tp2", lambda: run_exact_arm(
+        params, cfg, reqs, shard_cfg, mesh=tp_mesh))
+    return {
+        "workload": {"n": len(reqs), "heads": 3, "head_len": 96,
+                     "tail_len": 8, "max_new": 8},
+        "note": "tp=2 over virtual CPU devices on ONE host: no "
+                "extra silicon, collectives are pure overhead — "
+                "gates pin exactness + per-device accounting, "
+                "not speed (real-chip receipt is ROADMAP item 1)",
+        "single_device": sh_single,
+        "tp2": sh_tp2,
+        "token_exact": out_a == out_b,
+    }
+
+
+_CHILD_MARK = "SHARD_CHILD_JSON:"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default="SERVE_r17.json")
+    ap.add_argument("--shard-child", action="store_true",
+                    help="internal: run only the sharded A/B and emit "
+                         "its section as marked JSON on stdout")
+    args = ap.parse_args()
+    q = args.quick
+
+    if args.shard_child:
+        child_phases = {}
+        section = run_sharded_ab(q, _make_phase(child_phases))
+        print(_CHILD_MARK + json.dumps({"section": section,
+                                        "phases": child_phases}))
+        return 0
+
+    import jax
+
+    from ray_tpu.inference import EngineConfig
+
+    cfg, params = _bench_model()
+
+    phases = {}
+    phase = _make_phase(phases)
 
     # ---- arm 0: the r10 acceptance, now on the paged engine ------------
     reqs0 = make_requests(8 if q else 24, seed=7, vocab=cfg.vocab_size,
@@ -334,6 +439,35 @@ def main():
                      max((spec_ngram, spec_self),
                          key=lambda a: a["tokens_per_step"]))
 
+    # ---- arm 4: tensor-parallel sharded decode A/B — the same
+    # shared-prefix request set on the paged engine unmeshed vs on a
+    # tp=2 mesh.  Runs in ONE child process whose backend is forced
+    # onto 8 virtual CPU devices (__graft_entry__._cpu_env) — the
+    # parent initialized on one device, and forcing the split here
+    # would perturb every arm above (module docstring).  Both halves
+    # share the child, so the A/B comparison stays same-process.
+    import subprocess
+
+    from __graft_entry__ import _cpu_env
+    cmd = [sys.executable, os.path.abspath(__file__), "--shard-child"]
+    if q:
+        cmd.append("--quick")
+    proc = phase("sharded_ab_child", lambda: subprocess.run(
+        cmd, env=_cpu_env(8), capture_output=True, text=True,
+        timeout=1200))
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise RuntimeError("sharded A/B child failed")
+    payload = next(ln[len(_CHILD_MARK):]
+                   for ln in proc.stdout.splitlines()
+                   if ln.startswith(_CHILD_MARK))
+    child = json.loads(payload)
+    # child-side phases carry the loadavg stamps for the two halves;
+    # the parent's sharded_ab_child phase bounds the whole subprocess
+    phases.update(child["phases"])
+    sharded = child["section"]
+    sh_single, sh_tp2 = sharded["single_device"], sharded["tp2"]
+
     ratio_cont = round(cont["req_s"] / seq_base["req_s"], 2)
     ratio_prefix = round(sp_paged["req_s"] / sp_slot["req_s"], 2)
     gates = {
@@ -353,6 +487,12 @@ def main():
             spec_best["ttft_p99_s"] < spec_off["ttft_p99_s"],
         "spec_itl_p99_improves":
             spec_best["itl_p99_s"] < spec_off["itl_p99_s"],
+        "sharded_token_exact": sharded["token_exact"],
+        "sharded_mesh_really_used":
+            sh_tp2["mesh_devices"] == 2 and sh_tp2["tp_shards"] == 2,
+        "sharded_bytes_per_device_halved":
+            sh_tp2["cache_bytes_per_device"] * 2 == sh_tp2["cache_bytes"]
+            and sh_tp2["cache_bytes"] == sh_single["cache_bytes"],
     }
 
     artifact = {
@@ -415,6 +555,7 @@ def main():
                                 "ngram": spec_ngram["tokens_per_step"],
                                 "self": spec_self["tokens_per_step"]},
         },
+        "sharded_decode": sharded,
         "gates": gates,
     }
     out = json.dumps(artifact, indent=1)
@@ -431,7 +572,8 @@ def main():
           f"tok/step {spec_off['tokens_per_step']} -> "
           f"{spec_best['tokens_per_step']} ({spec_best.get('speculate')}), "
           f"itl p99 {spec_off['itl_p99_s']}s -> "
-          f"{spec_best['itl_p99_s']}s "
+          f"{spec_best['itl_p99_s']}s | tp2 "
+          f"{'exact' if gates['sharded_token_exact'] else 'DIVERGED'} "
           f"({'PASS' if ok else 'FAIL'})")
     return 0 if ok else 1
 

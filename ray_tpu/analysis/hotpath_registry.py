@@ -137,7 +137,9 @@ HOT_GATES: dict = {
         },
     },
     # inference engine: the paged-cache chaos hook (infer_admit /
-    # infer_block_alloc / infer_speculate choke points) and the
+    # infer_block_alloc / infer_speculate / infer_shard_commit choke
+    # points — the last fires after a meshed decode iteration installs
+    # the sharded pool arrays) and the
     # flight-recorder request-slice note — one helper each so every
     # other engine function stays alias-free; same zero-overhead
     # promise as the control plane (the decode loop runs them per

@@ -9,7 +9,11 @@ end-to-end inference product over the sharded GPT —
                  (``gpt.forward(return_kv=True)`` — also the paged
                  cold-start path), speculative-decoding bodies (widened
                  verify step, truncated-layer draft step, host-side
-                 n-gram drafter), all compiled once per geometry.
+                 n-gram drafter), all compiled once per geometry.  With
+                 a mesh the paged bodies run tensor-parallel (pools
+                 heads-sharded, one collective per layer) and MoE
+                 configs decode via the training forward's expert
+                 dispatch.
   * cache.py   — BlockPool (refcounted token blocks, copy-on-write
                  tails, scratch-block scatter discipline) + RadixIndex
                  (prefix reuse trie, LRU eviction); KVCacheManager is
@@ -30,9 +34,10 @@ Quick start::
     # curl -d '{"prompt": [1,2,3], "max_tokens": 8}' \
     #      http://127.0.0.1:<port>/v1/generate
 
-Benchmark receipt: benchmarks/serve_bench.py → SERVE_r15.json
-(paged+prefix vs the r14 slot engine AND continuous batching vs naive
-sequential, all same-box same-run A/B).
+Benchmark receipt: benchmarks/serve_bench.py → SERVE_r17.json
+(paged+prefix vs the r14 slot engine, continuous batching vs naive
+sequential, AND tp-sharded vs single-device decode, all same-box
+same-run A/B).
 """
 
 from __future__ import annotations

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models.gpt import GPTConfig
+from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules, spec_for
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -200,13 +201,24 @@ class BlockPool:
     Thread contract mirrors KVCacheManager: alloc/incref/decref/array
     swaps happen on the engine loop thread; ``stats()`` may be read
     from any thread (the lock only guards the free list + refcounts).
+
+    With a ``mesh``, the pool arrays are sharded over the HEADS dim
+    (decode.POOL_AXES — Megatron-style tensor parallelism): every
+    device holds all ``n_blocks + 1`` blocks with ``n_heads / tp`` of
+    each block's heads, so block ids, tables, refcounts, the radix trie
+    and copy-on-write are shard-oblivious and ``n_blocks`` is both the
+    global admission budget AND the per-device block count (per-device
+    bytes are ``bytes_total() / tp``).
     """
 
     def __init__(self, cfg: GPTConfig, n_blocks: int, block_size: int,
-                 max_seq: Optional[int] = None, dtype=None):
+                 max_seq: Optional[int] = None, dtype=None, mesh=None,
+                 rules: Rules = DEFAULT_LLM_RULES):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.cfg = cfg
+        self.mesh = mesh
+        self.rules = rules
         self.block_size = int(block_size)
         self.max_seq = int(max_seq or cfg.max_seq)
         if self.max_seq > cfg.max_seq:
@@ -221,14 +233,54 @@ class BlockPool:
                 f"sequence ({self.blocks_per_seq} blocks of {block_size})")
         self.n_blocks = int(n_blocks)             # usable (excludes scratch)
         self.dtype = dtype or cfg.dtype
-        shape = (cfg.n_layers, self.n_blocks + 1, cfg.n_heads,
-                 self.block_size, cfg.head_dim)
-        self.k = jnp.zeros(shape, self.dtype)
-        self.v = jnp.zeros(shape, self.dtype)
+        self._shape = (cfg.n_layers, self.n_blocks + 1, cfg.n_heads,
+                       self.block_size, cfg.head_dim)
+        shards = self.heads_shards
+        if cfg.n_heads % shards:
+            raise ValueError(
+                f"n_heads {cfg.n_heads} is not divisible by the heads "
+                f"(tp) shard count {shards} of mesh "
+                f"{dict(zip(mesh.axis_names, mesh.devices.shape))} — "
+                f"the pool shards the heads dim evenly per device")
+        self.k = self._zeros()
+        self.v = self._zeros()
         self._lock = threading.Lock()
         # pop() -> block 1 first; id 0 (scratch) is never in the list
         self._free = list(range(self.n_blocks, 0, -1))
         self._rc = [0] * (self.n_blocks + 1)
+
+    @property
+    def heads_shards(self) -> int:
+        """Number of shards the pool's heads dim is split into (1 when
+        unmeshed) — the ``tp`` degree of the serving hot path."""
+        if self.mesh is None:
+            return 1
+        spec = self._pool_spec()[2]
+        if spec is None:
+            return 1
+        axes = (spec,) if isinstance(spec, str) else spec
+        n = 1
+        for a in axes:
+            n *= dict(zip(self.mesh.axis_names,
+                          self.mesh.devices.shape))[a]
+        return n
+
+    def _pool_spec(self):
+        from ray_tpu.inference.decode import POOL_AXES
+        return spec_for(POOL_AXES, self.rules, self.mesh)
+
+    def _zeros(self) -> jax.Array:
+        """Allocate one zeroed pool array — heads-sharded across the
+        mesh when there is one (allocated shard-local via out_shardings,
+        never materialized unsharded), plain jnp.zeros otherwise.  Used
+        by __init__ AND reset() so donated-pool recovery reallocates
+        every device's shard, not just the addressable default."""
+        if self.mesh is None:
+            return jnp.zeros(self._shape, self.dtype)
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(self.mesh, self._pool_spec())
+        return jax.jit(partial(jnp.zeros, self._shape, self.dtype),
+                       out_shardings=sh)()
 
     # ------------------------------------------------------------- blocks
 
@@ -328,10 +380,12 @@ class BlockPool:
         pool buffers, so an exception mid-step can leave self.k/v
         pointing at invalidated storage.  The caller fails all in-flight
         requests AND clears the prefix index (cached prefixes would
-        otherwise point at zeroed blocks — silently wrong KV)."""
-        shape = self.k.shape
-        self.k = jnp.zeros(shape, self.dtype)
-        self.v = jnp.zeros(shape, self.dtype)
+        otherwise point at zeroed blocks — silently wrong KV).  With a
+        mesh, _zeros reallocates the pool SHARDED, every device's shard
+        included — recovery must restore the same layout the compiled
+        steps donate-commit into."""
+        self.k = self._zeros()
+        self.v = self._zeros()
         with self._lock:
             self._free = list(range(self.n_blocks, 0, -1))
             self._rc = [0] * (self.n_blocks + 1)
@@ -345,13 +399,22 @@ class BlockPool:
     def stats(self) -> dict:
         with self._lock:
             free = len(self._free)
+        shards = self.heads_shards
         return {
             "block_size": self.block_size,
+            # blocks are replicated in COUNT across tp shards (heads are
+            # what's split), so blocks_total is simultaneously the
+            # global admission budget and the per-device block count —
+            # both reported so no consumer has to guess which one a
+            # gauge means
             "blocks_total": self.n_blocks,
+            "blocks_per_device": self.n_blocks,
             "blocks_free": free,
             "blocks_used": self.n_blocks - free,
             "max_seq": self.max_seq,
             "bytes_total": self.bytes_total(),
+            "bytes_per_device": self.bytes_total() // shards,
+            "tp_shards": shards,
         }
 
 

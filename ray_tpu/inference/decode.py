@@ -55,9 +55,16 @@ Legacy slot path (cache.KVCacheManager, engine ``paged=False``):
   * decode_step — one-hot scatter on the position axis of the
     ``[L, n_slots, h, S, hd]`` cache, per-row kv_lengths masking.
 
-All step bodies mirror gpt._transformer_layer's einsums exactly (dense
-MLP path); greedy token-parity with full-recompute ``generate()`` is
-pinned by tests/test_inference.py + tests/test_paged_cache.py.
+All step bodies mirror gpt._transformer_layer's einsums exactly; MoE
+configs dispatch through gpt._moe_mlp per token window (paged path
+only — the slot path stays the frozen dense baseline).  With a mesh the
+paged bodies are sharding-annotated for Megatron-style tensor
+parallelism: pools heads-sharded per POOL_AXES, per-device attention
+over local heads, one collective at the output projection, the donated
+one-scatter commit preserved per shard.  Greedy token-parity with
+full-recompute ``generate()`` is pinned by tests/test_inference.py +
+tests/test_paged_cache.py (mesh=None) and tests/test_sharded_decode.py
+(multi-device CPU meshes).
 """
 
 from __future__ import annotations
@@ -77,18 +84,19 @@ from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
 
 
 class MoEDecodeUnsupported(NotImplementedError):
-    """The inference engine has no MoE decode path (expert dispatch per
-    cached token — ROADMAP 1c).  Typed so the gap fails EARLY and
-    clearly — at engine construction / admission time, never mid-decode
-    with slots already held — and so callers can distinguish the known
-    capability gap from a generic failure."""
+    """The legacy SLOT decode path has no MoE support (it is the frozen
+    dense A/B baseline; the paged engine serves MoE via gpt._moe_mlp).
+    Typed so the gap fails EARLY and clearly — at step construction
+    time, never mid-decode with slots already held — and so callers can
+    distinguish the known capability gap from a generic failure."""
 
     def __init__(self, cfg: GPTConfig):
         super().__init__(
-            f"the inference engine has no MoE decode path yet "
-            f"(n_experts={cfg.n_experts}: expert dispatch per cached "
-            f"token is unimplemented — ROADMAP 1c); serve this config "
-            f"with a dense MLP (n_experts=0) or the training forward")
+            f"the legacy slot decode path has no MoE support "
+            f"(n_experts={cfg.n_experts}); serve this config with the "
+            f"paged engine (EngineConfig.paged=True — it dispatches "
+            f"experts per token window via gpt._moe_mlp), or with a "
+            f"dense MLP (n_experts=0), or the training forward")
 
 class SpeculationUnsupported(ValueError):
     """Speculative decoding was requested for a configuration that has
@@ -103,32 +111,69 @@ class SpeculationUnsupported(ValueError):
     InferenceEngine.submit)."""
 
 
-# engines with the same (cfg, rules) on the default (no-mesh) path share
-# ONE jitted prefill/step pair: the compiled programs are stateless
-# (params/cache are arguments; donation is per-call), and a fleet of N
-# replicas x M model variants would otherwise pay N*M identical
-# compilations — a multi-second head-of-line stall every time the
-# autoscaler grows or the multiplexer loads a variant.  Meshed engines
-# skip the cache (mesh identity isn't a safe dict key across tests).
+# engines with the same (cfg, rules, mesh) share ONE jitted
+# prefill/step pair: the compiled programs are stateless (params/cache
+# are arguments; donation is per-call), and a fleet of N replicas x M
+# model variants would otherwise pay N*M identical compilations — a
+# multi-second head-of-line stall every time the autoscaler grows or
+# the multiplexer loads a variant.  Meshed engines key on the mesh's
+# IDENTITY plus its axis shape: a Mesh is not hashable-by-value across
+# tests, but the same mesh object reused by every replica of a sharded
+# fleet must hit the cache (the exact regression the no-mesh path fixed
+# once already).  The shape tuple bounds the blast radius of id() reuse
+# after GC: a recycled id only collides with a mesh of identical axes.
 _FN_CACHE: dict = {}
 
 
 def _cached(kind: str, cfg: GPTConfig, mesh, rules, build):
-    if mesh is not None:
-        return build()
-    key = (kind, cfg, rules if isinstance(rules, tuple) else id(rules))
+    mesh_key = (None if mesh is None
+                else (id(mesh), tuple(mesh.shape.items())))
+    key = (kind, cfg, mesh_key,
+           rules if isinstance(rules, tuple) else id(rules))
     fn = _FN_CACHE.get(key)
     if fn is None:
         fn = _FN_CACHE[key] = build()
     return fn
 
 
+# logical axes of the paged pool arrays [L, N+1, heads, bs, hd]: the
+# HEADS dim is the sharded one (Megatron-style tensor parallelism —
+# every device holds ALL blocks with h/tp of each block's heads, so the
+# host-side table/refcount/CoW logic is shard-oblivious).  The layers
+# dim is deliberately NOT "layers": the pool must never shard over pp
+# (the scan body dynamic-slices it per layer).
+POOL_AXES = (None, None, "heads", None, "kv")
+
+
+def _mlp_block(y, lp, cfg, mesh, rules):
+    """The step bodies' MLP: the dense einsums mirroring
+    gpt._transformer_layer, or — when the config is MoE — the training
+    forward's expert dispatch (gpt._moe_mlp) applied to the step's
+    token window, the load-balance aux loss discarded (inference).
+    Per-token routing is position-independent, so incremental windows
+    route exactly like the full forward; expert CAPACITY is per window
+    (C = ceil(cf·k·s_window/E)), so token-exact parity with the
+    full-sequence oracle holds whenever capacity never binds
+    (capacity_factor >= n_experts / expert_top_k guarantees it; a
+    single-token decode window can never drop regardless).
+    y [b, s, d] -> [b, s, d]."""
+    if cfg.n_experts:
+        dn, _ = gpt._moe_mlp(y, lp, cfg, mesh, rules)
+        return dn
+    u = jnp.einsum("bsd,df->bsf", y, lp["w_up"].astype(cfg.dtype)) \
+        + lp["b_up"].astype(cfg.dtype)
+    u = gpt._constrain(u, ("batch", "seq", "mlp"), mesh, rules)
+    u = jax.nn.gelu(u)
+    return jnp.einsum("bsf,fd->bsd", u, lp["w_down"].astype(cfg.dtype)) \
+        + lp["b_down"].astype(cfg.dtype)
+
+
 def make_prefill_fn(cfg: GPTConfig, *, mesh=None,
                     rules: Rules = DEFAULT_LLM_RULES):
     """jitted (params, tokens [b, S]) -> (logits [b, S, V], k, v
-    [L, b, h, S, hd] each)."""
-    if cfg.n_experts:
-        raise MoEDecodeUnsupported(cfg)
+    [L, b, h, S, hd] each).  MoE configs ride gpt.forward's own expert
+    dispatch; with a mesh the K/V come back heads-sharded, matching the
+    pool layout (POOL_AXES)."""
 
     def build():
         @jax.jit
@@ -233,9 +278,15 @@ def make_paged_decode_step(cfg: GPTConfig, *, block_size: int,
     valid prefix (ops/attention.paged_attention).  Tail blocks are
     per-row exclusive (the engine copy-on-writes shared tails before
     the step), so active rows never collide in the scatter.
+
+    With a mesh, the pools are heads-sharded (POOL_AXES) and the body
+    carries sharding constraints mirroring gpt._transformer_layer:
+    qkv projection, gathered context, and attention run per-device
+    over local heads with ONE collective at the output/head projection
+    (Megatron TP); the donated one-scatter commit stays per-shard
+    (the scatter's advanced axes — block, offset — are unsharded).
+    MoE configs dispatch through gpt._moe_mlp per decode window.
     """
-    if cfg.n_experts:
-        raise MoEDecodeUnsupported(cfg)
     h, hd, bs = cfg.n_heads, cfg.head_dim, int(block_size)
 
     def build():
@@ -245,6 +296,8 @@ def make_paged_decode_step(cfg: GPTConfig, *, block_size: int,
             b = tokens.shape[0]
             L = k_pool.shape[0]
             T = tables.shape[1]
+            k_pool = gpt._constrain(k_pool, POOL_AXES, mesh, rules)
+            v_pool = gpt._constrain(v_pool, POOL_AXES, mesh, rules)
             x = (params["wte"][tokens] + params["wpe"][positions])
             x = x[:, None, :].astype(cfg.dtype)               # [b, 1, d]
             rows = jnp.arange(b)
@@ -265,6 +318,8 @@ def make_paged_decode_step(cfg: GPTConfig, *, block_size: int,
                 y = gpt._layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
                 qkv = jnp.einsum("bsd,de->bse", y,
                                  lp["wqkv"].astype(cfg.dtype))
+                qkv = gpt._constrain(qkv, ("batch", "seq", "qkv"),
+                                     mesh, rules)
                 q, k, v = jnp.split(qkv, 3, axis=-1)
 
                 def heads(t):                      # [b,1,d]->[b,h,1,hd]
@@ -285,6 +340,10 @@ def make_paged_decode_step(cfg: GPTConfig, *, block_size: int,
                     kh.astype(ck.dtype))
                 ctx_v = gather(cv).at[rows, :, positions, :].set(
                     vh.astype(cv.dtype))
+                ctx_k = gpt._constrain(
+                    ctx_k, ("batch", "heads", None, "kv"), mesh, rules)
+                ctx_v = gpt._constrain(
+                    ctx_v, ("batch", "heads", None, "kv"), mesh, rules)
                 o = attention(heads(q), ctx_k, ctx_v, causal=False,
                               kv_lengths=kv_len, impl="reference")
                 o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
@@ -292,14 +351,10 @@ def make_paged_decode_step(cfg: GPTConfig, *, block_size: int,
                                lp["wo"].astype(cfg.dtype)) \
                     + lp["bo"].astype(cfg.dtype)
                 x = x + o
+                x = gpt._constrain(x, ("batch", "seq", "embed"),
+                                   mesh, rules)
                 y = gpt._layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
-                u = jnp.einsum("bsd,df->bsf", y,
-                               lp["w_up"].astype(cfg.dtype)) \
-                    + lp["b_up"].astype(cfg.dtype)
-                u = jax.nn.gelu(u)
-                dn = jnp.einsum("bsf,fd->bsd", u,
-                                lp["w_down"].astype(cfg.dtype)) \
-                    + lp["b_down"].astype(cfg.dtype)
+                dn = _mlp_block(y, lp, cfg, mesh, rules)
                 return x + dn, (kh, vh)
 
             x, (ks, vs) = lax.scan(
@@ -311,6 +366,8 @@ def make_paged_decode_step(cfg: GPTConfig, *, block_size: int,
                 ks.transpose(1, 0, 2, 3).astype(k_pool.dtype))
             v_pool = v_pool.at[:, bidx, :, off, :].set(
                 vs.transpose(1, 0, 2, 3).astype(v_pool.dtype))
+            k_pool = gpt._constrain(k_pool, POOL_AXES, mesh, rules)
+            v_pool = gpt._constrain(v_pool, POOL_AXES, mesh, rules)
             logits = gpt._head(params, x, cfg, mesh, rules)[:, 0, :]
             return logits, k_pool, v_pool
 
@@ -340,9 +397,11 @@ def make_chunk_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int,
     decode; the caller reads only the rows it needs.  The engine
     interleaves one chunk per scheduler pass with decode iterations
     (chunked prefill: bounded prefill cost per token cadence).
+
+    Sharding and MoE follow the decode step: heads-sharded pools +
+    per-device attention with one collective at the output projection,
+    and gpt._moe_mlp expert dispatch over the chunk window.
     """
-    if cfg.n_experts:
-        raise MoEDecodeUnsupported(cfg)
     h, hd = cfg.n_heads, cfg.head_dim
     bs, C, T = int(block_size), int(chunk), int(n_table)
     S = T * bs
@@ -351,6 +410,8 @@ def make_chunk_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int,
         @partial(jax.jit, donate_argnums=(1, 2))
         def chunk_fn(params, k_pool, v_pool, table, tokens, start):
             L = k_pool.shape[0]
+            k_pool = gpt._constrain(k_pool, POOL_AXES, mesh, rules)
+            v_pool = gpt._constrain(v_pool, POOL_AXES, mesh, rules)
             pos = start + jnp.arange(C, dtype=jnp.int32)       # [C]
             oob = pos >= S
             wpe_pos = jnp.clip(pos, 0, cfg.max_seq - 1)
@@ -376,6 +437,8 @@ def make_chunk_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int,
                 y = gpt._layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
                 qkv = jnp.einsum("bsd,de->bse", y,
                                  lp["wqkv"].astype(cfg.dtype))
+                qkv = gpt._constrain(qkv, ("batch", "seq", "qkv"),
+                                     mesh, rules)
                 q, k, v = jnp.split(qkv, 3, axis=-1)
 
                 def heads(t):                      # [1,C,d]->[1,h,C,hd]
@@ -392,6 +455,10 @@ def make_chunk_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int,
                     kh.astype(ck.dtype))
                 ctx_v = gather(cv).at[:, :, wcol, :].set(
                     vh.astype(cv.dtype))
+                ctx_k = gpt._constrain(
+                    ctx_k, ("batch", "heads", None, "kv"), mesh, rules)
+                ctx_v = gpt._constrain(
+                    ctx_v, ("batch", "heads", None, "kv"), mesh, rules)
                 o = attention(heads(q), ctx_k, ctx_v, causal=False,
                               mask=mask[None, None], impl="reference")
                 o = o.transpose(0, 2, 1, 3).reshape(1, C, cfg.d_model)
@@ -399,14 +466,10 @@ def make_chunk_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int,
                                lp["wo"].astype(cfg.dtype)) \
                     + lp["bo"].astype(cfg.dtype)
                 x = x + o
+                x = gpt._constrain(x, ("batch", "seq", "embed"),
+                                   mesh, rules)
                 y = gpt._layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
-                u = jnp.einsum("bsd,df->bsf", y,
-                               lp["w_up"].astype(cfg.dtype)) \
-                    + lp["b_up"].astype(cfg.dtype)
-                u = jax.nn.gelu(u)
-                dn = jnp.einsum("bsf,fd->bsd", u,
-                                lp["w_down"].astype(cfg.dtype)) \
-                    + lp["b_down"].astype(cfg.dtype)
+                dn = _mlp_block(y, lp, cfg, mesh, rules)
                 return x + dn, (kh, vh)
 
             x, (ks, vs) = lax.scan(
@@ -417,6 +480,8 @@ def make_chunk_prefill_fn(cfg: GPTConfig, *, chunk: int, block_size: int,
                 ks.transpose(2, 0, 1, 3).astype(k_pool.dtype))
             v_pool = v_pool.at[:, bidx, :, off, :].set(
                 vs.transpose(2, 0, 1, 3).astype(v_pool.dtype))
+            k_pool = gpt._constrain(k_pool, POOL_AXES, mesh, rules)
+            v_pool = gpt._constrain(v_pool, POOL_AXES, mesh, rules)
             logits = gpt._head(params, x, cfg, mesh, rules)[0]  # [C, V]
             return logits, k_pool, v_pool
 
@@ -454,9 +519,11 @@ def make_spec_verify_step(cfg: GPTConfig, *, width: int, block_size: int,
     rejected lanes leave garbage K/V beyond the row's committed length,
     which the kv-length masks hide until decode overwrites it (same
     rule as prefill padding).
+
+    Sharding and MoE follow the decode step: heads-sharded pools +
+    per-device attention with one collective at the output projection,
+    and gpt._moe_mlp expert dispatch over the W-lane window.
     """
-    if cfg.n_experts:
-        raise MoEDecodeUnsupported(cfg)
     h, hd = cfg.n_heads, cfg.head_dim
     bs, W, T = int(block_size), int(width), int(n_table)
     S = T * bs
@@ -467,6 +534,8 @@ def make_spec_verify_step(cfg: GPTConfig, *, width: int, block_size: int,
                    active, n_tokens):
             b = tokens.shape[0]
             L = k_pool.shape[0]
+            k_pool = gpt._constrain(k_pool, POOL_AXES, mesh, rules)
+            v_pool = gpt._constrain(v_pool, POOL_AXES, mesh, rules)
             rows = jnp.arange(b)
             pos = positions[:, None] + jnp.arange(W, dtype=jnp.int32)  # [b,W]
             live = ((jnp.arange(W)[None, :] < n_tokens[:, None])
@@ -500,6 +569,8 @@ def make_spec_verify_step(cfg: GPTConfig, *, width: int, block_size: int,
                 y = gpt._layer_norm(x, lp["ln1_scale"], lp["ln1_bias"])
                 qkv = jnp.einsum("bsd,de->bse", y,
                                  lp["wqkv"].astype(cfg.dtype))
+                qkv = gpt._constrain(qkv, ("batch", "seq", "qkv"),
+                                     mesh, rules)
                 q, k, v = jnp.split(qkv, 3, axis=-1)
 
                 def heads(t):                      # [b,W,d]->[b,h,W,hd]
@@ -519,6 +590,10 @@ def make_spec_verify_step(cfg: GPTConfig, *, width: int, block_size: int,
                     kh.astype(ck.dtype))
                 ctx_v = gather(cv).at[rows[:, None], :, wcol, :].set(
                     vh.astype(cv.dtype))
+                ctx_k = gpt._constrain(
+                    ctx_k, ("batch", "heads", None, "kv"), mesh, rules)
+                ctx_v = gpt._constrain(
+                    ctx_v, ("batch", "heads", None, "kv"), mesh, rules)
                 o = attention(heads(q), ctx_k, ctx_v, causal=False,
                               mask=mask, impl="reference")
                 o = o.transpose(0, 2, 1, 3).reshape(b, W, cfg.d_model)
@@ -526,14 +601,10 @@ def make_spec_verify_step(cfg: GPTConfig, *, width: int, block_size: int,
                                lp["wo"].astype(cfg.dtype)) \
                     + lp["bo"].astype(cfg.dtype)
                 x = x + o
+                x = gpt._constrain(x, ("batch", "seq", "embed"),
+                                   mesh, rules)
                 y = gpt._layer_norm(x, lp["ln2_scale"], lp["ln2_bias"])
-                u = jnp.einsum("bsd,df->bsf", y,
-                               lp["w_up"].astype(cfg.dtype)) \
-                    + lp["b_up"].astype(cfg.dtype)
-                u = jax.nn.gelu(u)
-                dn = jnp.einsum("bsf,fd->bsd", u,
-                                lp["w_down"].astype(cfg.dtype)) \
-                    + lp["b_down"].astype(cfg.dtype)
+                dn = _mlp_block(y, lp, cfg, mesh, rules)
                 return x + dn, (kh, vh)
 
             x, (ks, vs) = lax.scan(
@@ -545,6 +616,8 @@ def make_spec_verify_step(cfg: GPTConfig, *, width: int, block_size: int,
                 ks.transpose(1, 2, 0, 3, 4).astype(k_pool.dtype))
             v_pool = v_pool.at[:, bidx, :, off, :].set(
                 vs.transpose(1, 2, 0, 3, 4).astype(v_pool.dtype))
+            k_pool = gpt._constrain(k_pool, POOL_AXES, mesh, rules)
+            v_pool = gpt._constrain(v_pool, POOL_AXES, mesh, rules)
             logits = gpt._head(params, x, cfg, mesh, rules)  # [b, W, V]
             return logits, k_pool, v_pool
 
@@ -584,9 +657,12 @@ def make_paged_draft_step(cfg: GPTConfig, *, draft_layers: int, k: int,
     every drafted position at all layers regardless of the accept
     outcome.  Cost per draft token ~ draft_layers / n_layers of a full
     step, with zero extra weights.
+
+    Sharding and MoE follow the decode step (heads-sharded pools,
+    gpt._moe_mlp dispatch per draft token); the truncated-layer trunk
+    slice composes with MoE leaves because tree_map slices every
+    per-layer leaf, expert weights included.
     """
-    if cfg.n_experts:
-        raise MoEDecodeUnsupported(cfg)
     h, hd, bs = cfg.n_heads, cfg.head_dim, int(block_size)
     D, K, T = int(draft_layers), int(k), int(n_table)
     S = T * bs
@@ -603,6 +679,8 @@ def make_paged_draft_step(cfg: GPTConfig, *, draft_layers: int, k: int,
         def draft(params, k_pool, v_pool, tables, tokens, positions,
                   want):
             b = tokens.shape[0]
+            k_pool = gpt._constrain(k_pool, POOL_AXES, mesh, rules)
+            v_pool = gpt._constrain(v_pool, POOL_AXES, mesh, rules)
             rows = jnp.arange(b)
             lanes = jnp.arange(K, dtype=jnp.int32)
             # one scratch table column (id 0 = the pool's scratch
@@ -634,6 +712,8 @@ def make_paged_draft_step(cfg: GPTConfig, *, draft_layers: int, k: int,
                                         lp["ln1_bias"])
                     qkv = jnp.einsum("bsd,de->bse", y,
                                      lp["wqkv"].astype(cfg.dtype))
+                    qkv = gpt._constrain(qkv, ("batch", "seq", "qkv"),
+                                         mesh, rules)
                     q, kk, v = jnp.split(qkv, 3, axis=-1)
 
                     def heads(t):                  # [b,1,d]->[b,h,1,hd]
@@ -657,6 +737,12 @@ def make_paged_draft_step(cfg: GPTConfig, *, draft_layers: int, k: int,
                         .set(bk_l)
                     ctx_v = gather(cv).at[rows[:, None], :, wcol, :] \
                         .set(bv_l)
+                    ctx_k = gpt._constrain(
+                        ctx_k, ("batch", "heads", None, "kv"),
+                        mesh, rules)
+                    ctx_v = gpt._constrain(
+                        ctx_v, ("batch", "heads", None, "kv"),
+                        mesh, rules)
                     o = attention(heads(q), ctx_k, ctx_v, causal=False,
                                   kv_lengths=kv_len, impl="reference")
                     o = o.transpose(0, 2, 1, 3).reshape(
@@ -665,15 +751,11 @@ def make_paged_draft_step(cfg: GPTConfig, *, draft_layers: int, k: int,
                                    lp["wo"].astype(cfg.dtype)) \
                         + lp["bo"].astype(cfg.dtype)
                     x = x + o
+                    x = gpt._constrain(x, ("batch", "seq", "embed"),
+                                       mesh, rules)
                     y = gpt._layer_norm(x, lp["ln2_scale"],
                                         lp["ln2_bias"])
-                    u = jnp.einsum("bsd,df->bsf", y,
-                                   lp["w_up"].astype(cfg.dtype)) \
-                        + lp["b_up"].astype(cfg.dtype)
-                    u = jax.nn.gelu(u)
-                    dn = jnp.einsum("bsf,fd->bsd", u,
-                                    lp["w_down"].astype(cfg.dtype)) \
-                        + lp["b_down"].astype(cfg.dtype)
+                    dn = _mlp_block(y, lp, cfg, mesh, rules)
                     return x + dn, (bk_l, bv_l)
 
                 trunk = jax.tree_util.tree_map(lambda a: a[:D],
@@ -708,6 +790,8 @@ def make_paged_draft_step(cfg: GPTConfig, *, draft_layers: int, k: int,
             v_pool = v_pool.at[:D, bidx.reshape(-1), :,
                                off.reshape(-1), :].set(
                 flat(bv).astype(v_pool.dtype))
+            k_pool = gpt._constrain(k_pool, POOL_AXES, mesh, rules)
+            v_pool = gpt._constrain(v_pool, POOL_AXES, mesh, rules)
             return toks.T, k_pool, v_pool     # drafts [b, K]
 
         return draft

@@ -81,8 +81,7 @@ import numpy as np
 from ray_tpu.core import fault_injection as _fi
 from ray_tpu.core import flight_recorder as _fr
 from ray_tpu.inference.cache import BlockPool, KVCacheManager, RadixIndex
-from ray_tpu.inference.decode import (MoEDecodeUnsupported,
-                                      SpeculationUnsupported,
+from ray_tpu.inference.decode import (SpeculationUnsupported,
                                       make_chunk_prefill_fn,
                                       make_decode_step,
                                       make_paged_decode_step,
@@ -92,7 +91,8 @@ from ray_tpu.inference.decode import (MoEDecodeUnsupported,
                                       ngram_propose)
 from ray_tpu.models import gpt
 from ray_tpu.models.gpt import GPTConfig
-from ray_tpu.parallel.sharding import DEFAULT_LLM_RULES, Rules
+from ray_tpu.parallel.sharding import (DEFAULT_LLM_RULES, Rules,
+                                       tree_shardings)
 
 
 @dataclass
@@ -300,18 +300,23 @@ class InferenceEngine:
                  name: Optional[str] = None,
                  labels: Optional[dict] = None):
         self.cfg = cfg
-        if cfg.n_experts:
-            # the typed capability gap, raised at engine ADMISSION time
-            # (construction precedes any submit) — never mid-decode with
-            # slots already held (ROADMAP 1c)
-            raise MoEDecodeUnsupported(cfg)
         # extra label pairs on this engine's /metrics series (the serve
         # layer sets deployment/replica/model so multi-replica fleets
         # don't collapse into one ambiguous series)
         self.labels = dict(labels) if labels else {}
         self.engine_cfg = engine_cfg or EngineConfig()
         ec = self.engine_cfg
-        self.params = params
+        self._mesh = mesh
+        self._rules = rules
+        if mesh is not None:
+            # shard the weights to match the annotated step bodies
+            # (heads/mlp/qkv/vocab over tp per the rules) so the first
+            # compiled call doesn't start from fully-replicated params
+            self.params = jax.device_put(
+                params, tree_shardings(gpt.param_logical_axes(cfg),
+                                       rules, mesh))
+        else:
+            self.params = params
         n = ec.max_slots
         self._paged = bool(ec.paged)
         self._spec = ec.speculate
@@ -335,7 +340,8 @@ class InferenceEngine:
             bs = ec.kv_block_size
             per_seq = -(-int(ec.max_seq or cfg.max_seq) // bs)
             n_blocks = ec.n_blocks if ec.n_blocks is not None else n * per_seq
-            self.pool = BlockPool(cfg, n_blocks, bs, max_seq=ec.max_seq)
+            self.pool = BlockPool(cfg, n_blocks, bs, max_seq=ec.max_seq,
+                                  mesh=mesh, rules=rules)
             self.cache = None
             self.max_seq = self.pool.max_seq
             self.trie = (RadixIndex(self.pool) if ec.prefix_cache else None)
@@ -606,7 +612,10 @@ class InferenceEngine:
 
     def _chaos(self, point: str, **ctx) -> Optional[dict]:
         """Fault-plane hook (infer_admit / infer_block_alloc /
-        infer_speculate): zero-overhead gate when no plan is installed.
+        infer_speculate / infer_shard_commit — the last fires after a
+        meshed decode iteration installs the sharded pool arrays, the
+        spot a multi-host commit could straggle or die):
+        zero-overhead gate when no plan is installed.
         Returns the ctx dict when a plan ran — a scripted fn may have
         mutated it (e.g. ``ctx["reject_all"] = True`` forces the
         speculative pass to discard every draft), and the caller reads
@@ -626,14 +635,22 @@ class InferenceEngine:
         rec = _fr._active
         if rec is None:
             return
-        rec.note_ingress({
+        ev = {
             "t": time.time(), "kind": "engine_request",
             "engine": self.name, "req": req.id,
             "start_t": req.created_wall,
             "tokens": len(req.tokens),
             "spec_accepted": req.spec_accepted,
             "spec_rejected": req.spec_drafted - req.spec_accepted,
-        })
+        }
+        if self._mesh is not None:
+            # timeline slices carry the serving geometry so a trace of
+            # a sharded fleet says WHICH mesh served each request
+            ev["mesh_devices"] = int(np.prod(
+                list(self._mesh.devices.shape)))
+            ev["tp_shards"] = (self.pool.heads_shards
+                               if self.pool is not None else 1)
+        rec.note_ingress(ev)
 
     def _paged_admit_locked(self) -> None:
         """Block-budget admission (called under ``_cond``): admit while
@@ -1185,6 +1202,12 @@ class InferenceEngine:
             jnp.asarray(self._tables), jnp.asarray(self._tokens),
             jnp.asarray(self._positions), jnp.asarray(self._active))
         self.pool.swap(k, v)
+        if self._mesh is not None:
+            # every shard just committed its slice of the donated
+            # scatter — the point where a multi-host straggler or
+            # mid-commit death would bite, so it is chaos-testable
+            self._chaos("infer_shard_commit",
+                        tp_shards=self.pool.heads_shards)
         logits = np.asarray(logits)
         with self._mlock:
             self._decode_iterations += 1
@@ -1396,6 +1419,15 @@ class InferenceEngine:
             "spec_accepted_tokens": accepted,
             "spec_accept_rate": (accepted / drafted) if drafted else 0.0,
             "spec_passes": spec_passes,
+            # ---- serving geometry (mesh_devices=1 when unmeshed so
+            # fleet aggregation can sum/compare without None checks)
+            "mesh_devices": (int(np.prod(list(self._mesh.devices.shape)))
+                             if self._mesh is not None else 1),
+            "mesh_axes": (dict(zip(self._mesh.axis_names,
+                                   self._mesh.devices.shape))
+                          if self._mesh is not None else {}),
+            "tp_shards": (self.pool.heads_shards
+                          if self._paged and self.pool is not None else 1),
         }
         if self._paged:
             pool = self.pool.stats()
@@ -1406,8 +1438,14 @@ class InferenceEngine:
                 "active_slots": occupied,
                 "free_slots": self.engine_cfg.max_slots - occupied,
                 "cache_bytes": pool["bytes_total"],
+                "cache_bytes_per_device": pool["bytes_per_device"],
                 "block_size": pool["block_size"],
+                # block COUNTS are replicated across tp shards (heads
+                # are what's split): blocks_total is the global
+                # admission budget AND the per-device count — both
+                # keys reported so neither meaning is silently guessed
                 "blocks_total": total,
+                "blocks_per_device": pool["blocks_per_device"],
                 "blocks_free": pool["blocks_free"],
                 "block_utilization": (pool["blocks_used"] / total
                                       if total else 0.0),
@@ -1446,6 +1484,7 @@ def metrics_snapshot() -> list:
     active, waiting, occ, gen, comp = {}, {}, {}, {}, {}
     butil, phit, pcached, preempt = {}, {}, {}, {}
     tps, arate, saccept = {}, {}, {}
+    meshdev, tpsh = {}, {}
     for name, eng in sorted(engines.items()):
         st = eng.stats()
         # per-replica/per-model labels (serve fleet sets them) keep a
@@ -1469,6 +1508,10 @@ def metrics_snapshot() -> list:
         tps[key] = float(st.get("tokens_per_step", 0.0))
         arate[key] = float(st.get("spec_accept_rate", 0.0))
         saccept[key] = float(st.get("spec_accepted_tokens", 0))
+        # serving geometry: 1/1 for unmeshed engines so the series
+        # always exists and a sharded rollout shows up as a step change
+        meshdev[key] = float(st.get("mesh_devices", 1))
+        tpsh[key] = float(st.get("tp_shards", 1))
     zero = {(("engine", "none"),): 0.0}
     return [
         ("ray_tpu_inference_active_slots", "gauge",
@@ -1498,4 +1541,11 @@ def metrics_snapshot() -> list:
          "offered", arate or zero),
         ("ray_tpu_inference_spec_accepted_tokens_total", "counter",
          "Drafted tokens accepted since engine start", saccept or zero),
+        ("ray_tpu_inference_mesh_devices", "gauge",
+         "Devices in the engine's mesh (1 = unmeshed single device)",
+         meshdev or zero),
+        ("ray_tpu_inference_tp_shards", "gauge",
+         "Tensor-parallel shards of the paged KV pool's heads dim "
+         "(block counts are per-device AND global — heads are what's "
+         "split)", tpsh or zero),
     ]

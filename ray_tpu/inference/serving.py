@@ -77,9 +77,15 @@ class GPTServer:
                  engine_name: Optional[str] = None,
                  variants: Optional[dict] = None,
                  multiplex_capacity: int = 2,
-                 warm_on_init: bool = False):
+                 warm_on_init: bool = False,
+                 mesh=None, rules=None):
         self.cfg = cfg or GPTConfig.tiny()
         self.engine_cfg = engine_cfg or EngineConfig()
+        # tensor-parallel serving: every engine this replica builds
+        # (multiplexed variants included) shares the one mesh — pools
+        # heads-sharded, tables/radix replicated (see inference.decode)
+        self.mesh = mesh
+        self.rules = rules
         self._warm = warm_on_init
         self._closed = False
         self._draining = False
@@ -128,8 +134,13 @@ class GPTServer:
         labels = dict(self._labels)
         if model_id:
             labels["model"] = model_id
+        kw = {}
+        if self.mesh is not None:
+            kw["mesh"] = self.mesh
+            if self.rules is not None:
+                kw["rules"] = self.rules
         eng = InferenceEngine(params, self.cfg, self.engine_cfg,
-                              name=name, labels=labels)
+                              name=name, labels=labels, **kw)
         if self._warm:
             # compile prefill+decode off the request path, so a freshly
             # scaled-up replica doesn't serve its first requests cold
@@ -232,10 +243,19 @@ class GPTServer:
             # replica whose rows are free but whose pool is nearly full
             # is not actually spare capacity (0s when every engine runs
             # the legacy slot pool)
+            # block counts are GLOBAL admission budgets (replicated in
+            # count across tp shards — heads are what's split), so
+            # summing across engines needs no per-shard correction
             "blocks_total": blocks_total,
             "blocks_free": blocks_free,
             "block_utilization": ((blocks_total - blocks_free)
                                   / blocks_total if blocks_total else 0.0),
+            # serving geometry: devices under this replica's engines
+            # (max, not sum — multiplexed engines share the one mesh)
+            "mesh_devices": max((s.get("mesh_devices", 1)
+                                 for s in stats), default=1),
+            "tp_shards": max((s.get("tp_shards", 1)
+                              for s in stats), default=1),
             "prefix_hit_tokens": hit,
             "prefix_lookup_tokens": lookup,
             "prefix_hit_rate": (hit / lookup) if lookup else 0.0,
@@ -309,7 +329,8 @@ def build_gpt_deployment(*, name: str = DEFAULT_ROUTE,
                          params=None,
                          variants: Optional[dict] = None,
                          multiplex_capacity: int = 2,
-                         warm_on_init: bool = False) -> Deployment:
+                         warm_on_init: bool = False,
+                         mesh=None, rules=None) -> Deployment:
     """A ready-to-``serve.run`` deployment wrapping GPTServer.  Route is
     /<name>/... — the default name "v1" makes POST /v1/generate work.
 
@@ -320,7 +341,10 @@ def build_gpt_deployment(*, name: str = DEFAULT_ROUTE,
     into a model-multiplexed server: at most ``multiplex_capacity``
     variants resident per replica, LRU-evicted; requests pick one with
     the ``model`` field.  ``warm_on_init`` compiles prefill+decode at
-    replica construction so scale-ups don't serve cold.
+    replica construction so scale-ups don't serve cold.  ``mesh`` (+
+    optional ``rules``) serves every replica tensor-parallel: params
+    and KV pools heads-sharded over the mesh's ``tp`` axis, one decode
+    program shared across replicas of the same geometry.
     """
     return Deployment(
         GPTServer,
@@ -331,7 +355,8 @@ def build_gpt_deployment(*, name: str = DEFAULT_ROUTE,
         init_kwargs=dict(cfg=cfg, engine_cfg=engine_cfg, seed=seed,
                          params=params, variants=variants,
                          multiplex_capacity=multiplex_capacity,
-                         warm_on_init=warm_on_init))
+                         warm_on_init=warm_on_init,
+                         mesh=mesh, rules=rules))
 
 
 def parse_stream_chunks(raw: bytes) -> list[dict]:

@@ -81,6 +81,7 @@ def metrics_snapshot() -> list:
     admitted, shed, queued, replicas, slots = {}, {}, {}, {}, {}
     resumed_fail, resumed_scale, drained, drain_to = {}, {}, {}, {}
     blocks, butil, phit, saccept = {}, {}, {}, {}
+    meshdev, tpsh = {}, {}
     for name, st in list(ctrl.deployments.items()):
         f = getattr(st, "fleet", None)
         if f is None:
@@ -100,6 +101,8 @@ def metrics_snapshot() -> list:
         butil[key] = float(snap.get("block_utilization", 0.0))
         phit[key] = float(snap.get("prefix_hit_rate", 0.0))
         saccept[key] = float(snap.get("spec_accept_rate", 0.0))
+        meshdev[key] = float(snap.get("mesh_devices", 1))
+        tpsh[key] = float(snap.get("tp_shards", 1))
     if not admitted:
         return []
     return [
@@ -124,7 +127,9 @@ def metrics_snapshot() -> list:
         ("serve_fleet_total_slots", "gauge",
          "Total decode slots across live replicas", slots),
         ("serve_fleet_total_blocks", "gauge",
-         "Total paged-KV blocks across live replicas (0 = slot pools)",
+         "Total paged-KV blocks across live replicas (0 = slot pools); "
+         "global admission budgets, never per-tp-shard counts — block "
+         "counts replicate across shards, heads are what's split",
          blocks),
         ("serve_fleet_block_utilization", "gauge",
          "Fleet-wide paged-KV blocks in use / usable", butil),
@@ -134,6 +139,12 @@ def metrics_snapshot() -> list:
         ("serve_fleet_spec_accept_rate", "gauge",
          "Fleet-wide speculative-draft acceptance (0 = not speculating)",
          saccept),
+        ("serve_fleet_mesh_devices", "gauge",
+         "Widest engine mesh across live replicas (1 = unmeshed)",
+         meshdev),
+        ("serve_fleet_tp_shards", "gauge",
+         "Widest tensor-parallel shard count across live replicas",
+         tpsh),
     ]
 
 

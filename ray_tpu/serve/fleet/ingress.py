@@ -200,6 +200,7 @@ class Fleet:
         slots = active = waiting = 0
         blocks_total = blocks_free = hit_toks = lookup_toks = 0
         drafted = accepted = 0
+        mesh_devices = tp_shards = 1
         for r in reps:
             try:
                 st = self.router.probe(r)
@@ -209,12 +210,20 @@ class Fleet:
                 slots += int(st.get("max_slots", 0))
                 active += int(st.get("active_slots", 0))
                 waiting += int(st.get("waiting_requests", 0))
+                # engine blocks_total is the GLOBAL admission budget
+                # (block counts replicate across tp shards; heads are
+                # what's split) — summing replicas needs no per-shard
+                # correction, and total_blocks never silently reports
+                # per-shard numbers
                 blocks_total += int(st.get("blocks_total", 0))
                 blocks_free += int(st.get("blocks_free", 0))
                 hit_toks += int(st.get("prefix_hit_tokens", 0))
                 lookup_toks += int(st.get("prefix_lookup_tokens", 0))
                 drafted += int(st.get("spec_drafted_tokens", 0))
                 accepted += int(st.get("spec_accepted_tokens", 0))
+                mesh_devices = max(mesh_devices,
+                                   int(st.get("mesh_devices", 1)))
+                tp_shards = max(tp_shards, int(st.get("tp_shards", 1)))
         with self._clock:
             counters = dict(self.counters.__dict__)
         # compatibility aggregate (the split fields are authoritative)
@@ -234,6 +243,10 @@ class Fleet:
             "total_blocks": blocks_total,
             "block_utilization": ((blocks_total - blocks_free)
                                   / blocks_total if blocks_total else 0.0),
+            # serving geometry (1/1 = unmeshed): max across replicas —
+            # a mixed rollout shows its widest mesh, not a bogus sum
+            "mesh_devices": mesh_devices,
+            "tp_shards": tp_shards,
             "prefix_hit_rate": (hit_toks / lookup_toks
                                 if lookup_toks else 0.0),
             # speculative decoding across the fleet (0.0 when no replica

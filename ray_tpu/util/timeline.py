@@ -16,8 +16,10 @@ python/ray/experimental/state + _private/profiling.py):
     per event kind
   * inference-engine request slices → one ``X`` per completed request
     (pid "engine", tid = engine name) spanning submit→finish, with
-    speculative-decoding accept/reject counts merged into the slice
-    args (engine_request events from InferenceEngine._fr_note)
+    speculative-decoding accept/reject counts — and, for meshed
+    engines, the serving geometry (mesh_devices / tp_shards) — merged
+    into the slice args (engine_request events from
+    InferenceEngine._fr_note)
 
 Output loads in chrome://tracing and ui.perfetto.dev (both accept the
 ``{"traceEvents": [...]}`` object form and string pid/tid values).

@@ -66,6 +66,17 @@ def test_oom_kill_retries_without_losing_node(local_rt, tmp_path):
         time.sleep(0.05)
     assert marker.exists(), "hog never started"
     first_pid = int(marker.read_text().split()[0])
+    # relax the INSTANT the kill is counted: pressure left on past this
+    # point raced the retry — the monitor could kill the re-executed hog
+    # too, burn the max_retries=2 budget, and the get() below surfaced
+    # OutOfMemoryError under suite load.  The kill just counted still
+    # has to land on first_pid, so relaxing here forfeits nothing the
+    # later assertions need.
+    deadline = time.time() + 300
+    while time.time() < deadline and svc.oom_kill_count < 1:
+        time.sleep(0.05)
+    assert svc.oom_kill_count >= 1, "monitor never killed the hog"
+    _relax(svc)
     deadline = time.time() + 300
     while time.time() < deadline:
         try:
@@ -74,9 +85,7 @@ def test_oom_kill_retries_without_losing_node(local_rt, tmp_path):
             break                    # the hog's worker is gone
         time.sleep(0.05)
     else:
-        raise AssertionError("monitor never killed the hog's worker")
-    assert svc.oom_kill_count >= 1
-    _relax(svc)
+        raise AssertionError("killed worker process never exited")
     stop.write_text("go")            # let the retried execution finish
 
     assert ray_tpu.get(ref, timeout=300) == "done"

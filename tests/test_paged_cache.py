@@ -373,28 +373,45 @@ def test_block_budget_concurrency_beats_slot_count(params, cfg):
         eng.shutdown()
 
 
-# -------------------------------------------------------------- MoE gap
+# -------------------------------------------------------------- MoE decode
 
-def test_moe_engine_fails_early_and_typed(cfg):
-    """MoE decode is a KNOWN gap (ROADMAP 1c): constructing an engine
-    over an MoE config raises the typed error naming it — at admission
-    time, never mid-decode with slots already held."""
+def test_moe_paged_decode_parity():
+    """The MoE wall is down: a paged engine over an MoE config
+    constructs and its greedy tokens match the training-forward oracle
+    (gpt.generate runs the same expert dispatch).  capacity_factor=4.0
+    = n_experts/top_k·2, so expert capacity never binds — the regime
+    where incremental windows and the full-sequence oracle route
+    identically (see decode._mlp_block)."""
+    moe_cfg = gpt.GPTConfig.tiny_moe(capacity_factor=4.0)
+    moe_params = gpt.init_params(moe_cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(moe_params, moe_cfg, EngineConfig(
+        max_slots=2, kv_block_size=8, prefill_chunk=16))
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        got = eng.generate(prompt, max_new=8, timeout=300)
+        assert got == _ref_tokens(moe_params, moe_cfg, prompt, 8)
+    finally:
+        eng.shutdown()
+
+
+def test_moe_slot_path_still_fails_early_and_typed():
+    """The legacy SLOT path stays the frozen dense A/B baseline: a slot
+    engine over an MoE config still fails with the typed error at
+    CONSTRUCTION time (make_decode_step raises before any submit), and
+    the error points at the paged engine."""
     moe_cfg = gpt.GPTConfig.tiny_moe()
     moe_params = gpt.init_params(moe_cfg, jax.random.PRNGKey(0))
     with pytest.raises(MoEDecodeUnsupported) as ei:
-        InferenceEngine(moe_params, moe_cfg, EngineConfig(max_slots=2))
+        InferenceEngine(moe_params, moe_cfg,
+                        EngineConfig(max_slots=2, paged=False))
     msg = str(ei.value)
-    assert "MoE" in msg or "expert" in msg
-    assert "ROADMAP 1c" in msg
-    # the typed error is still a NotImplementedError (compat) and the
-    # compiled-fn builders raise it too
+    assert "slot" in msg and "paged" in msg
+    # the typed error is still a NotImplementedError (compat), and the
+    # slot step builder is the raising site
     assert issubclass(MoEDecodeUnsupported, NotImplementedError)
-    from ray_tpu.inference.decode import (make_chunk_prefill_fn,
-                                          make_paged_decode_step)
+    from ray_tpu.inference.decode import make_decode_step
     with pytest.raises(MoEDecodeUnsupported):
-        make_paged_decode_step(moe_cfg, block_size=8, n_table=8)
-    with pytest.raises(MoEDecodeUnsupported):
-        make_chunk_prefill_fn(moe_cfg, chunk=16, block_size=8, n_table=8)
+        make_decode_step(moe_cfg)
 
 
 # -------------------------------------------------------------- metrics
