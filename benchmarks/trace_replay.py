@@ -32,7 +32,17 @@ variant (exercises multiplexed routing).
 loadavg is recorded per phase (PERF.md box-variance caveat: only the
 in-run A/B ratio is portable across days, never the absolutes).
 
+Round 18 adds ``--prefix-cluster`` → SERVE_r18.json: the cluster
+prefix plane's proof harness.  Same-run A/B (cluster_prefix on vs
+off): a COLD replica joins mid-storm while traffic sharing long prompt
+prefixes replays — with the plane on it adopts the holders' published
+blocks and its first-token latency lands within 1.3x of a warm
+replica's; with the plane off it pays full prefill.  A chaos pass then
+kills one holder and drains another mid-fetch: every request still
+completes token-exact against the full-recompute oracle.
+
 Run:  JAX_PLATFORMS=cpu python benchmarks/trace_replay.py [--quick]
+      JAX_PLATFORMS=cpu python benchmarks/trace_replay.py --prefix-cluster
 """
 
 from __future__ import annotations
@@ -352,6 +362,378 @@ class FleetSampler(threading.Thread):
         self._halt.set()
 
 
+# ----------------------------------------------- prefix-cluster arm (r18)
+
+
+class PrefixStorm(threading.Thread):
+    """Background prefix-sharing traffic: the storm the cold replica
+    joins into.  Fires fleet.remote at a steady Poisson rate until
+    stopped; every outcome is accounted (completed or recorded error)."""
+
+    def __init__(self, f, prefixes, mk_req, *, rate, seed):
+        super().__init__(daemon=True)
+        self.f, self.prefixes, self.mk_req = f, prefixes, mk_req
+        self.rate, self.seed = rate, seed
+        self.offered = 0
+        self.completed = 0
+        self.errors = []
+        self._lock = threading.Lock()
+        self._halt = threading.Event()
+
+    def _fire(self, req):
+        try:
+            self.f.remote((req,), {}).result(timeout=120)
+            with self._lock:
+                self.completed += 1
+        except Exception as e:   # noqa: BLE001 — accounted, not raised
+            with self._lock:
+                self.errors.append(str(e)[:120])
+
+    def run(self):
+        import numpy as np
+        from concurrent.futures import ThreadPoolExecutor
+        r = np.random.default_rng(self.seed)
+        pool = ThreadPoolExecutor(max_workers=64)
+        futs = []
+        try:
+            while not self._halt.wait(float(r.exponential(
+                    1.0 / self.rate))):
+                pfx = self.prefixes[int(r.integers(0, len(self.prefixes)))]
+                with self._lock:
+                    self.offered += 1
+                futs.append(pool.submit(self._fire, self.mk_req(r, pfx)))
+            for fu in futs:
+                fu.result(timeout=150)
+        finally:
+            pool.shutdown(wait=False)
+
+    def stop(self):
+        self._halt.set()
+
+
+def _leak_audit(f):
+    """Blocks-vs-trie audit over every LIVE engine: with nothing in
+    flight, a used block unaccounted to the radix trie is a refcount
+    leaked by some fetch/install/fallback path."""
+    out = []
+    for rep in list(f.state.replicas):
+        try:
+            eng = rep.impl._user.engine
+        except Exception:
+            continue
+        if getattr(eng, "_stopped", False):
+            continue
+        stats = eng.pool.stats()
+        if stats["blocks_used"] != eng.trie.cached_blocks:
+            out.append(f"{rep.tag}: used={stats['blocks_used']} "
+                       f"trie={eng.trie.cached_blocks}")
+    return out
+
+
+def prefix_cluster_main(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu import serve
+    from ray_tpu.core import fault_injection as fi
+    from ray_tpu.inference import EngineConfig, build_gpt_deployment
+    from ray_tpu.models import gpt
+    from ray_tpu.serve import fleet as fleet_mod
+
+    out_path = args.out or "SERVE_r18.json"
+    # long-prefix regime: prefill is the cost a cold replica pays, so
+    # prompts carry a 448-token shared prefix (28 blocks of 16) and a
+    # short random suffix — adoption moves the 28 blocks, the suffix
+    # still prefills locally on every replica.  The model is decode-
+    # heavy on purpose (wide FFN): TTFT must be dominated by model
+    # compute, not by the engine's fixed round-trip, or the adoption-
+    # vs-warm ratio measures dispatch overhead instead of the plane
+    cfg = gpt.GPTConfig(vocab_size=512, max_seq=512, d_model=384,
+                        n_heads=8, n_layers=6, d_ff=4096, remat=False,
+                        dtype=jnp.float32)
+    ecfg = EngineConfig(max_slots=8, kv_block_size=16, n_blocks=512,
+                        default_max_new=8)
+    n_prefixes = 4 if args.quick else 6
+    prefix_tokens = 448
+    # the storm must keep the holders WARM, not saturated: a prefix
+    # fetch runs on the holder's loop thread, so a holder pinned at
+    # 100% decode makes every adoption wait out a full iteration —
+    # that measures queueing, not the plane.  Short generations at a
+    # rate the box can absorb leave the loop idle between requests
+    storm_rate = 1.5
+    storm_max_new = 2
+    corpus_rng = np.random.default_rng(1800)
+    prefixes = [corpus_rng.integers(0, cfg.vocab_size,
+                                    prefix_tokens).tolist()
+                for _ in range(n_prefixes)]
+
+    def loadavg():
+        return round(os.getloadavg()[0], 2)
+
+    def mk_req(r, pfx, max_new=4):
+        sfx = r.integers(0, cfg.vocab_size,
+                         int(r.integers(4, 9))).tolist()
+        return {"prompt": pfx + sfx, "max_tokens": max_new,
+                "temperature": 0.0, "priority": "interactive"}
+
+    def probe_req(r, pfx):
+        # TTFT proxy: a 1-token greedy request's full latency is
+        # prefill (or adoption) + one decode step — the first token
+        sfx = r.integers(0, cfg.vocab_size, 6).tolist()
+        return {"prompt": pfx + sfx, "max_tokens": 1,
+                "temperature": 0.0}
+
+    # ---- A/B arms: plane on vs plane off, identical seeds -------------
+    def arm(enabled: bool):
+        la0 = loadavg()
+        dep = build_gpt_deployment(cfg=cfg, engine_cfg=ecfg, seed=0,
+                                   num_replicas=2, warm_on_init=True)
+        serve.run(dep, use_actors=False, http=False)
+        f = fleet_mod.enable("v1", fleet_mod.FleetConfig(
+            rate=500, burst=64, seed=18, cluster_prefix=enabled))
+        st = f.state
+        rw = np.random.default_rng(1801)
+        # warm every prefix on EVERY starting replica (direct _call:
+        # the probe baseline must be a true local hit on whichever
+        # warm body we probe — with the plane on the second body
+        # adopts remotely; with it off each pays its own prefill,
+        # exactly the current behavior)
+        for pfx in prefixes:
+            for rep in list(st.replicas):
+                f._call(rep, (mk_req(rw, pfx),), {}, "__call__")
+        if f.prefix is not None:
+            # direct _call skips the post-call publish drain the
+            # f.remote path does — drain explicitly so the storm's
+            # route_hint sees the warm holders from its first request
+            for rep in list(st.replicas):
+                f.prefix.publish_from(rep)
+        pre_join_hits = (f.prefix.counters()["prefix_remote_hits"]
+                        if f.prefix is not None else 0)
+        storm = PrefixStorm(
+            f, prefixes,
+            lambda r, pfx: mk_req(r, pfx, max_new=storm_max_new),
+            rate=storm_rate, seed=1802)
+        storm.start()
+        time.sleep(1.5)                     # the storm is established…
+        before = {x.tag for x in st.replicas}
+        t0 = time.perf_counter()
+        st.scale_to(3)                      # …and the COLD replica joins
+        join_s = time.perf_counter() - t0
+        cold = next(x for x in st.replicas if x.tag not in before)
+        warms = [x for x in st.replicas if x.tag in before]
+        rp = np.random.default_rng(1803)
+        warm_ttft, cold_ttft = [], []
+        for i, pfx in enumerate(prefixes):
+            q = probe_req(rp, pfx)
+            t1 = time.perf_counter()
+            f._call(warms[i % len(warms)], (q,), {}, "__call__")
+            warm_ttft.append(time.perf_counter() - t1)
+        for pfx in prefixes:
+            q = probe_req(rp, pfx)
+            t1 = time.perf_counter()
+            f._call(cold, (q,), {}, "__call__")
+            cold_ttft.append(time.perf_counter() - t1)
+        storm.stop()
+        storm.join(timeout=180)
+        snap = f.fleet_snapshot()
+        events = f.events()
+        adopt_events = {k: sum(1 for e in events if e["kind"] == k)
+                        for k in ("adopt_begin", "adopt_complete",
+                                  "adopt_fallback")}
+        leaks = _leak_audit(f)
+        serve.shutdown()
+        ratio = _pct(cold_ttft, 50) / max(_pct(warm_ttft, 50), 1e-9)
+        return {
+            "plane": "on" if enabled else "off",
+            "storm": {"offered": storm.offered,
+                      "completed": storm.completed,
+                      "errors": storm.errors,
+                      "rate_req_s": storm_rate},
+            "cold_join_s": round(join_s, 3),
+            "warm_ttft_s": [round(x, 5) for x in warm_ttft],
+            "cold_ttft_s": [round(x, 5) for x in cold_ttft],
+            "warm_ttft_p50_s": round(_pct(warm_ttft, 50), 5),
+            "cold_ttft_p50_s": round(_pct(cold_ttft, 50), 5),
+            "cold_warm_ttft_p50_ratio": round(ratio, 3),
+            "remote_hits_pre_join": pre_join_hits,
+            # the PLANE's counters only (engines also report local
+            # prefix_hit_* stats, plane or no plane — those are not
+            # what absent-when-disabled is about)
+            "counters": {k: snap[k] for k in (
+                "prefix_remote_hits", "prefix_remote_fetch_failures",
+                "prefix_fallback_recomputes",
+                "prefix_directory_entries") if k in snap},
+            "adopt_events": adopt_events,
+            "block_leaks": leaks,
+            "loadavg_1m": [la0, loadavg()],
+        }
+
+    print("prefix-cluster arm A: plane ON (adoption)")
+    adopt = arm(enabled=True)
+    print(f"  cold/warm TTFT p50 ratio "
+          f"{adopt['cold_warm_ttft_p50_ratio']}  "
+          f"remote_hits {adopt['counters'].get('prefix_remote_hits')}")
+    print("prefix-cluster arm B: plane OFF (baseline)")
+    base = arm(enabled=False)
+    print(f"  cold/warm TTFT p50 ratio "
+          f"{base['cold_warm_ttft_p50_ratio']}")
+
+    # ---- chaos pass: holders killed / drained mid-fetch ---------------
+    # prompt i pays prefill on replica i, so the three holders are
+    # distinct by construction; the scripted fault then kills the
+    # first holder and drains the second AT the prefix_fetch choke
+    # point — both adoptions must silently downgrade to local
+    # recompute and stay token-exact against the oracle
+    def chaos_pass():
+        la0 = loadavg()
+        dep = build_gpt_deployment(cfg=cfg, engine_cfg=ecfg, seed=0,
+                                   num_replicas=3, warm_on_init=True)
+        serve.run(dep, use_actors=False, http=False)
+        f = fleet_mod.enable("v1", fleet_mod.FleetConfig(
+            rate=500, burst=64, seed=19, cluster_prefix=True))
+        r = np.random.default_rng(1807)
+        reqs = [mk_req(r, prefixes[i]) for i in range(3)]
+        params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+
+        def oracle(q):
+            out = gpt.generate(params, cfg,
+                               jnp.asarray([q["prompt"]], jnp.int32),
+                               max_new=q["max_tokens"], temperature=0.0)
+            return np.asarray(out)[0, len(q["prompt"]):].tolist()
+
+        refs = [oracle(q) for q in reqs]
+        reps = list(f.state.replicas)
+        parity, errors = [], []
+
+        def serve_on(rep, q, ref, label):
+            try:
+                out = f._call(rep, (q,), {}, "__call__")
+                parity.append(out["tokens"] == ref)
+            except Exception as e:   # noqa: BLE001 — accounted
+                errors.append(f"{label}: {str(e)[:120]}")
+
+        for i, q in enumerate(reqs):                 # publish
+            serve_on(reps[i], q, refs[i], f"publish#{i}")
+            # direct _call skips the post-call publish drain that the
+            # routed path runs — drain explicitly so the directory
+            # knows holder i before the adoptions fire
+            f.prefix.publish_from(reps[i])
+        serve_on(reps[0], reqs[2], refs[2], "clean adopt")
+        calls = {"n": 0}
+
+        def chaos_fn(ctx):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                f.kill_replica(ctx["holder_replica"])
+            else:
+                f.state.drain_replicas(
+                    1, deadline_s=10.0,
+                    replicas=[ctx["holder_replica"]])
+                raise RuntimeError("holder drained mid-adoption")
+
+        plan = fi.FaultPlan()
+        plan.add(fi.Rule("prefix_fetch", "script", fn=chaos_fn,
+                         times=2))
+        fi.install(plan)
+        try:
+            serve_on(reps[1], reqs[0], refs[0], "kill arm")
+            serve_on(reps[2], reqs[1], refs[1], "drain arm")
+        finally:
+            fi.uninstall()
+        counters = dict(f.prefix.counters())
+        directory_entries = len(f.prefix.directory)
+        leaks = _leak_audit(f)
+        serve.shutdown()
+        return {
+            "requests": len(parity) + len(errors),
+            "token_exact": sum(bool(p) for p in parity),
+            "errors": errors,
+            "counters": counters,
+            "directory_entries_after": directory_entries,
+            "block_leaks": leaks,
+            "loadavg_1m": [la0, loadavg()],
+        }
+
+    print("prefix-cluster chaos pass: kill + drain mid-fetch")
+    chaos = chaos_pass()
+    print(f"  {chaos['token_exact']}/{chaos['requests']} token-exact, "
+          f"errors={chaos['errors']}, counters={chaos['counters']}")
+
+    ac, cc = adopt["counters"], chaos["counters"]
+    gates = {
+        # the cold replica actually adopted: remote hits moved past
+        # what the second warm body's startup adoption already counted
+        "adopt_remote_hits_positive":
+            ac.get("prefix_remote_hits", 0)
+            > adopt["remote_hits_pre_join"],
+        "adopt_cold_ttft_within_1p3x_warm":
+            adopt["cold_warm_ttft_p50_ratio"] <= 1.3,
+        # fallback-total baseline: no plane, no keys, and the cold
+        # replica pays full prefill (the gap adoption closes)
+        "baseline_plane_absent": base["counters"] == {},
+        "baseline_cold_pays_full_prefill":
+            base["cold_warm_ttft_p50_ratio"]
+            > adopt["cold_warm_ttft_p50_ratio"],
+        "storm_zero_request_errors":
+            adopt["storm"]["errors"] == [] and base["storm"]["errors"]
+            == [] and adopt["storm"]["offered"]
+            == adopt["storm"]["completed"],
+        "no_block_leaks": (adopt["block_leaks"] == []
+                           and base["block_leaks"] == []
+                           and chaos["block_leaks"] == []),
+        "chaos_all_token_exact":
+            chaos["errors"] == []
+            and chaos["token_exact"] == chaos["requests"],
+        "chaos_failures_counted_and_recomputed": (
+            cc.get("prefix_remote_fetch_failures", 0) >= 2
+            and cc.get("prefix_fallback_recomputes", 0) >= 2
+            and cc.get("prefix_remote_hits", 0) >= 1),
+    }
+    artifact = {
+        "round": 18,
+        "mode": "prefix_cluster",
+        "quick": bool(args.quick),
+        "_conditions": {
+            "backend": jax.default_backend(),
+            "physical_cores": os.cpu_count(),
+            "note": "same-run A/B; only ratios are portable across "
+                    "days (PERF.md box-variance caveat)",
+        },
+        "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                  "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
+                  "vocab": cfg.vocab_size, "max_seq": cfg.max_seq},
+        "engine": {"max_slots": ecfg.max_slots,
+                   "kv_block_size": ecfg.kv_block_size,
+                   "n_blocks": ecfg.n_blocks},
+        "corpus": {"n_prefixes": n_prefixes,
+                   "prefix_tokens": prefix_tokens,
+                   "suffix_tokens": "4-8 random per request",
+                   "ttft_probe": "1-token greedy request latency "
+                                 "(prefill/adoption + first decode)"},
+        "adopt": adopt,
+        "baseline": base,
+        "chaos": chaos,
+        "ab": {
+            "cold_warm_ttft_p50_ratio": {
+                "adopt": adopt["cold_warm_ttft_p50_ratio"],
+                "baseline": base["cold_warm_ttft_p50_ratio"]},
+            "remote_hits": {
+                "adopt": ac.get("prefix_remote_hits", 0),
+                "baseline": 0},
+        },
+        "acceptance": gates,
+    }
+    out = json.dumps(artifact, indent=1)
+    print(out)
+    with open(out_path, "w") as fo:
+        fo.write(out + "\n")
+    ok = all(gates.values())
+    print("\nacceptance: " + ", ".join(
+        f"{k}={'PASS' if v else 'FAIL'}" for k, v in gates.items()))
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------------------ main
 
 
@@ -362,7 +744,13 @@ def main():
     ap.add_argument("--events-out", default=None,
                     help="Fleet.dump_events JSON (feed to `ray_tpu "
                          "timeline --serve-events`)")
+    ap.add_argument("--prefix-cluster", action="store_true",
+                    help="cluster prefix plane proof harness -> "
+                         "SERVE_r18.json (cold-replica adoption A/B "
+                         "+ kill/drain chaos pass)")
     args = ap.parse_args()
+    if args.prefix_cluster:
+        return prefix_cluster_main(args)
 
     import jax
     import jax.numpy as jnp

@@ -120,6 +120,8 @@ HOT_GATES: dict = {
         "aliases": ("_fr",),
         "functions": {
             "HeadService._h_cluster_submit": "gate",
+            # cluster prefix directory: _fr ingress note per publish
+            "HeadService._h_prefix_publish": "gate",
             "HeadService.__init__": "cold",
         },
     },
@@ -149,6 +151,16 @@ HOT_GATES: dict = {
         "functions": {
             "InferenceEngine._chaos": "gate",
             "InferenceEngine._fr_note": "gate",
+        },
+    },
+    # cluster prefix plane: the adoption path's chaos hook
+    # (prefix_dir_lookup / prefix_fetch / prefix_install choke points)
+    # — one helper so every other plane function stays alias-free; it
+    # runs once per routed request when the plane is on, never when off
+    "ray_tpu.serve.fleet.prefix_directory": {
+        "aliases": ("_fi",),
+        "functions": {
+            "PrefixPlane._chaos": "gate",
         },
     },
     # serve controller: the drain state machine's chaos hook

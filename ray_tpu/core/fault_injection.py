@@ -467,6 +467,21 @@ class FaultPlan:
             draft is discarded, the block-charge rollback path is
             exercised, and output stays token-exact); raising instead
             injects a verify-step failure into the recovery path
+          * ``prefix_dir_lookup`` — the cluster prefix plane consulted
+            the head directory for a request's prompt (ctx:
+            {"deployment", "keys", "tokens"}); raising forces a
+            directory miss (the request routes by occupancy alone)
+          * ``prefix_fetch``      — a replica is about to pull cached
+            K/V blocks from a directory-confirmed holder (ctx:
+            {"deployment", "holder", "replica", "key", "n_tokens",
+            "holder_replica"}).  A scripted ``fn(ctx)`` can raise to
+            fail the transfer, or kill/drain ``holder_replica`` to
+            prove the mid-fetch death path — either way the adopter
+            silently falls back to chunked-prefill recompute
+          * ``prefix_install``    — fetched blocks are about to be
+            installed into the adopter's pool/trie (ctx: same as
+            ``prefix_fetch``); raising exercises the install-failure
+            fallback (fresh blocks freed, no refcount leak)
 
         A scripted ``fn(ctx)`` can raise to inject a pool failure at
         the exact choke point — the engine's recovery path (fail

@@ -598,6 +598,12 @@ class HeadService(ClusterStoreMixin, EventLoopService):
                                                  or []):
                 self._reset_stuck_pg_2pc(pg_id, info)
         self._try_place_pending_pgs()
+        # cluster prefix directory: every prefix advertised from that
+        # node is gone with its pools — a fetch aimed there would only
+        # burn the adopter's fallback budget
+        d = getattr(self, "_prefix_dir", None)
+        if d is not None:
+            d.invalidate_node(node_hex)
         self._publish("node_state", {"node_id": node_hex, "state": "dead",
                                      "cause": cause})
         self._broadcast_view()
@@ -652,10 +658,79 @@ class HeadService(ClusterStoreMixin, EventLoopService):
             if c is not None:
                 self._push(c, {"t": "node_drain",
                                "deadline_s": deadline_s})
+            # a DRAINING node's replicas stop serving prefix fetches the
+            # moment the drain begins (same rule as the fleet-level
+            # drain_replicas hook) — not when teardown finishes
+            d = getattr(self, "_prefix_dir", None)
+            if d is not None:
+                d.invalidate_node(node_hex)
             self._publish("node_state", {"node_id": node_hex,
                                          "state": "draining"})
             self._broadcast_view()
         return None
+
+    # ------------------------------------- cluster prefix directory
+    # Head-registered half of the serve fleet's cluster prefix plane
+    # (serve/fleet/prefix_directory.py): multi-node fleets publish
+    # prompt-chunk-hash → holder entries here and look them up before
+    # routing, so any node's replicas can adopt a prefix a peer
+    # already paid for.  The directory is ADVISORY — holders
+    # re-validate generation + trie liveness at extract time — so the
+    # head never holds KV bytes, only bookkeeping (and stays jax-free:
+    # the module imports nothing from the inference stack).  Entries
+    # die with their node (_node_dead) or at drain begin
+    # (_begin_node_drain).  The wire vocabulary (prefix_publish /
+    # prefix_lookup / prefix_invalidate) rides the raw envelope like
+    # every other control message — no proto change.
+
+    @property
+    def prefix_dir(self):
+        d = getattr(self, "_prefix_dir", None)
+        if d is None:
+            from ray_tpu.serve.fleet.prefix_directory import \
+                PrefixDirectory
+            d = self._prefix_dir = PrefixDirectory()
+        return d
+
+    def _h_prefix_publish(self, rec: ClientRec, m: dict) -> None:
+        n = self.prefix_dir.publish(
+            list(m["keys"]), holder=m["holder"],
+            n_tokens=int(m["n_tokens"]),
+            generation=int(m.get("generation", 0)),
+            block_size=int(m["block_size"]),
+            node=m.get("node") or rec.node_hex or "",
+            blocks=tuple(m.get("blocks") or ()),
+            engine=m.get("engine") or "")
+        r = _fr._active
+        if r is not None:
+            r.note_ingress({"t": time.time(), "kind": "prefix_publish",
+                            "holder": m["holder"], "entries": n})
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True, published=n)
+
+    def _h_prefix_lookup(self, rec: ClientRec, m: dict) -> None:
+        hit = self.prefix_dir.lookup(list(m["keys"]))
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True, hit=hit)
+
+    def _h_prefix_invalidate(self, rec: ClientRec, m: dict) -> None:
+        """One message, three scopes: ``key`` purges a single stale
+        entry, ``holder`` (+ optional ``stale_generation``) drops a
+        replica's entries, ``node`` drops a machine's."""
+        d = self.prefix_dir
+        if m.get("key"):
+            n = int(d.purge(m["key"]))
+        elif m.get("holder") and m.get("stale_generation") is not None:
+            n = d.invalidate_stale(m["holder"],
+                                   int(m["stale_generation"]))
+        elif m.get("holder"):
+            n = d.invalidate_holder(m["holder"])
+        elif m.get("node"):
+            n = d.invalidate_node(m["node"])
+        else:
+            n = 0
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True, invalidated=n)
 
     def request_drain(self, node_hex: str,
                       deadline_s: float = 30.0) -> None:

@@ -738,6 +738,30 @@ class NodeService(NodeWorkersMixin, NodeTransferMixin, NodeSchedMixin,
             return
         super()._h_kv_keys(rec, m)
 
+    # -- cluster prefix directory (the head hosts it; see core/head.py
+    # _h_prefix_* and serve/fleet/prefix_directory.py).  Standalone
+    # nodes answer with benign no-ops: a single-node session has
+    # exactly one fleet process, whose in-proc directory already IS the
+    # whole prefix plane — there is nothing cluster-scope to mirror.
+
+    def _h_prefix_publish(self, rec, m):
+        if self._cluster_scope(rec, m):
+            return
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True, published=0)
+
+    def _h_prefix_lookup(self, rec, m):
+        if self._cluster_scope(rec, m):
+            return
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True, hit=None)
+
+    def _h_prefix_invalidate(self, rec, m):
+        if self._cluster_scope(rec, m):
+            return
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True, invalidated=0)
+
     def _h_subscribe(self, rec, m):
         ch = m["channel"]
         if self.head_conn is not None and ch not in self._head_subs:

@@ -487,6 +487,43 @@ class NodeTransferMixin:
         self._reply(rec, m["reqid"], stats=self.store.stats(),
                     num_objects=len(self.objects))
 
+    # -- cluster prefix plane: block-fetch conduit ---------------------------
+
+    def _h_block_fetch(self, rec, m):
+        """Replica→replica prefix-block fetch (the transfer half of
+        serve/fleet/prefix_directory.py for multi-node fleets): a peer
+        adopting a prefix asks this NODE for the K/V bytes of a prefix
+        an engine in this process holds, by engine name.  The bytes
+        ride the reply's raw envelope over the same peer plane as
+        object chunks — no new transport.  Every failure (unknown
+        engine, stale generation, evicted prefix, dead engine) replies
+        with the error NAME so the caller re-raises the typed
+        PrefixTransferError shape and takes its local-recompute
+        fallback; a fetch is never allowed to wedge the peer loop."""
+        try:
+            from ray_tpu.inference import engine as _eng
+            eng = _eng._ENGINES.get(m["engine"])
+            if eng is None:
+                raise KeyError(f"no engine {m['engine']!r} in this process")
+            payload = eng.prefix_extract(list(m["tokens"]),
+                                         int(m.get("generation", 0)))
+        except Exception as e:
+            if "reqid" in m:
+                self._reply(rec, m["reqid"],
+                            error=f"{type(e).__name__}: {e}",
+                            error_type=type(e).__name__)
+            return
+        import numpy as _np
+        k = _np.ascontiguousarray(payload["k"])
+        v = _np.ascontiguousarray(payload["v"])
+        if "reqid" in m:
+            self._reply(rec, m["reqid"], ok=True,
+                        n_tokens=int(payload["n_tokens"]),
+                        block_size=int(payload["block_size"]),
+                        generation=int(payload["generation"]),
+                        shape=list(k.shape), dtype=str(k.dtype),
+                        k=k.tobytes(), v=v.tobytes())
+
     # -- automatic object lifetime (owner-based release) --------------------
 
     def _h_release_refs(self, rec, m):

@@ -183,6 +183,28 @@ def _write_blocks(pool: jax.Array, table: jax.Array, new: jax.Array):
     return pool.at[:, table].set(n.astype(pool.dtype))
 
 
+@jax.jit
+def _gather_blocks(pool_k: jax.Array, pool_v: jax.Array,
+                   table: jax.Array):
+    """Both pools' block chains in ONE fused call — the eager two-step
+    (k then v, each its own dispatch + device_get) dominated prefix
+    extraction latency, not the bytes."""
+    return pool_k[:, table], pool_v[:, table]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _install_blocks(pool_k: jax.Array, pool_v: jax.Array,
+                    table: jax.Array, new_k: jax.Array,
+                    new_v: jax.Array):
+    """pools [L, N, h, bs, hd] <- new [L, T, h, bs, hd] at table [T]:
+    the adopted-prefix scatter, taking the transfer payload's layout
+    directly (no eager transpose/reshape copies) and landing both
+    pools in ONE dispatch.  The caller owns ``table``'s ids
+    exclusively (refcount 1, freshly alloc'd), so no CoW is needed."""
+    return (pool_k.at[:, table].set(new_k.astype(pool_k.dtype)),
+            pool_v.at[:, table].set(new_v.astype(pool_v.dtype)))
+
+
 class BlockPool:
     """Refcounted fixed-size token-block pool (the paged KV cache).
 
@@ -248,6 +270,10 @@ class BlockPool:
         # pop() -> block 1 first; id 0 (scratch) is never in the list
         self._free = list(range(self.n_blocks, 0, -1))
         self._rc = [0] * (self.n_blocks + 1)
+        # bumped by every reset(): block ids published before a reset
+        # (e.g. to the cluster prefix directory) are fenced by this —
+        # a recovered pool's old ids must never be served remotely
+        self.generation = 0
 
     @property
     def heads_shards(self) -> int:
@@ -352,6 +378,31 @@ class BlockPool:
         self.k = _copy_block(self.k, s, d)
         self.v = _copy_block(self.v, s, d)
 
+    def read_blocks(self, ids) -> tuple:
+        """Gather a block chain's K/V to host arrays — the EXPORT side
+        of replica→replica prefix transfer.  Returns ``(k, v)`` of shape
+        ``[L, T, h, bs, hd]`` each (T = len(ids)), fully replicated
+        host-side so the bytes can ride the object plane regardless of
+        the holder's mesh layout."""
+        t = jnp.asarray(list(ids), jnp.int32)
+        k, v = jax.device_get(_gather_blocks(self.k, self.v, t))
+        return np.asarray(k), np.asarray(v)
+
+    def write_blocks_at(self, ids, k_new, v_new) -> None:
+        """Scatter fetched block K/V (``read_blocks`` layout,
+        ``[L, T, h, bs, hd]``) into freshly-allocated local blocks —
+        the INSTALL side of prefix adoption.  The caller owns ``ids``
+        exclusively (refcount 1, just alloc'd), so no CoW is needed;
+        with a mesh the ``.at[].set`` lands sharded through the pool's
+        own sharding."""
+        t = jnp.asarray(list(ids), jnp.int32)
+        L, T = self.k.shape[0], t.shape[0]
+        h, bs, hd = self.k.shape[2], self.k.shape[3], self.k.shape[4]
+        k_new = jnp.asarray(k_new, self.dtype).reshape(L, T, h, bs, hd)
+        v_new = jnp.asarray(v_new, self.dtype).reshape(L, T, h, bs, hd)
+        self.k, self.v = _install_blocks(self.k, self.v, t,
+                                         k_new, v_new)
+
     def write_prefill(self, table, k_new: jax.Array,
                       v_new: jax.Array) -> None:
         """Seed a request's blocks from a FULL prefill ([L, h, S, hd]
@@ -389,6 +440,7 @@ class BlockPool:
         with self._lock:
             self._free = list(range(self.n_blocks, 0, -1))
             self._rc = [0] * (self.n_blocks + 1)
+            self.generation += 1
 
     # ------------------------------------------------------------- stats
 
@@ -415,6 +467,7 @@ class BlockPool:
             "bytes_total": self.bytes_total(),
             "bytes_per_device": self.bytes_total() // shards,
             "tp_shards": shards,
+            "generation": self.generation,
         }
 
 

@@ -134,7 +134,9 @@ class EngineConfig:
 # from there); re-exported here for the engine's own API surface.
 from ray_tpu.serve.qos import (PRIORITY_BATCH,           # noqa: F401
                                PRIORITY_INTERACTIVE, EngineDrainingError,
-                               ReplicaDeadError, parse_priority)
+                               PrefixInstallPressure, PrefixUnavailable,
+                               ReplicaDeadError, StalePrefixGeneration,
+                               parse_priority)
 
 
 class EngineStoppedError(ReplicaDeadError):
@@ -390,6 +392,16 @@ class InferenceEngine:
         self._cond = threading.Condition()
         self._stopped = False
         self._draining = False
+        # cross-thread op queue: the pool arrays and the radix trie are
+        # loop-thread-only, so the cluster prefix plane's extract/
+        # install calls enqueue closures here and the loop runs them
+        # between passes (_run_op / _run_ops_locked) — same serialization
+        # as every other trie/pool touch, no new locking
+        self._ops: list = []
+        # prefixes published to the LOCAL trie since the last
+        # prefix_export() drain — what the fleet forwards to the
+        # cluster directory (bounded; oldest dropped first)
+        self._prefix_outbox: list = []
 
         # metrics (guarded by _cond's lock via _mlock simplicity: own lock)
         self._mlock = threading.Lock()
@@ -503,12 +515,15 @@ class InferenceEngine:
             # (waiting alone must not spin when the pool is handed out;
             # paged admission retries at the idle tick because block
             # availability also depends on evictable cached prefixes)
-            while (not self._stopped and not self._active.any()
+            while (not self._stopped and not self._ops
+                   and not self._active.any()
                    and not (self._paged and self._prefilling)
                    and not (self._waiting and self._admission_possible())):
                 self._cond.wait(self.engine_cfg.idle_wait_s)
             if self._stopped:
                 return False
+            if self._ops:
+                self._run_ops_locked()
             # reap cancelled waiters even when the pool is full:
             # zombies must not consume max_waiting backpressure
             # (a burst of timed-out clients would otherwise make
@@ -574,6 +589,14 @@ class InferenceEngine:
             pending = list(self._slot_req.values()) + self._waiting
             self._slot_req.clear()
             self._waiting.clear()
+            ops, self._ops = self._ops, []
+            for _fn, box in ops:
+                # a queued prefix op on a dying engine resolves as a
+                # dead-replica error — the prefix plane maps it to its
+                # local-recompute fallback like every other failure
+                box["error"] = EngineStoppedError("engine shut down")
+                box["done"] = True
+            self._cond.notify_all()
         err = EngineStoppedError("engine shut down")
         for r in pending:
             if not r.done:
@@ -927,6 +950,9 @@ class InferenceEngine:
                 * self.pool.block_size
             if full > 0:
                 self._insert_prefix(row, req.prompt[:full])
+                self._note_prefix_published(
+                    req.prompt[:full],
+                    self._row_blocks[row][:full // self.pool.block_size])
         tok = int(gpt.sample_token(last_logits,
                                    temperature=req.temperature,
                                    rng=req._next_rng()))
@@ -1369,6 +1395,177 @@ class InferenceEngine:
             if not r.done:
                 r._finish(err)
 
+    # ------------------------------------------- cluster prefix plane
+
+    def _run_ops_locked(self) -> None:
+        """Execute queued cross-thread ops on the loop thread (called
+        under ``_cond``).  Op errors resolve into the caller's box, the
+        loop itself never dies for a bad op.  Op closures must not take
+        ``_cond`` (they run holding it) — pool/trie access is safe, the
+        row/slot helpers are not."""
+        while self._ops:
+            fn, box = self._ops.pop(0)
+            try:
+                box["result"] = fn()
+            except BaseException as e:
+                box["error"] = e
+            box["done"] = True
+        self._cond.notify_all()
+
+    def _run_op(self, fn, timeout: float = 10.0):
+        """Run ``fn`` on the loop thread and wait for its result — the
+        bridge that lets another thread (the fleet's prefix plane)
+        touch the loop-thread-only pool/trie.  Raises the op's own
+        error, EngineStoppedError on a dead engine, PrefixUnavailable
+        on timeout — all of which the caller treats as 'recompute
+        locally'."""
+        box = {"done": False, "result": None, "error": None}
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            if self._stopped:
+                raise EngineStoppedError("engine is shut down")
+            self._ops.append((fn, box))
+            self._cond.notify_all()
+            while not box["done"]:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise PrefixUnavailable(
+                        f"engine op timed out after {timeout}s")
+                self._cond.wait(left)
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
+
+    def _note_prefix_published(self, tokens: np.ndarray, blocks) -> None:
+        """Record a local-trie publication for the cluster directory
+        (drained by ``prefix_export``).  Bounded: a fleet that never
+        drains costs at most 64 stale records, not unbounded growth."""
+        with self._mlock:
+            if len(self._prefix_outbox) >= 64:
+                self._prefix_outbox.pop(0)
+            self._prefix_outbox.append({
+                "tokens": [int(t) for t in tokens],
+                "blocks": [int(b) for b in blocks],
+                "block_size": self.pool.block_size,
+                "generation": self.pool.generation,
+                # conduit address: lets a FOREIGN fleet process fetch
+                # through the node plane's block_fetch handler, which
+                # resolves this name in the module engine registry
+                "engine": self.name,
+            })
+
+    def prefix_export(self) -> list:
+        """Drain the prefix publication outbox (cluster-directory feed).
+        Empty on non-paged / no-trie engines — the plane then has
+        nothing to advertise for this replica."""
+        if not self._paged or self.trie is None:
+            return []
+        with self._mlock:
+            out, self._prefix_outbox = self._prefix_outbox, []
+        return out
+
+    def prefix_extract(self, tokens, generation: int) -> dict:
+        """EXPORT side of replica→replica prefix adoption: gather the
+        K/V bytes of a cached block-aligned prefix to host arrays.
+        Re-validates everything the directory advertised — the pool
+        generation (StalePrefixGeneration when a donated-pool recovery
+        reset it: old block ids must never be served) and the live trie
+        (PrefixUnavailable when eviction raced the fetch).  Runs on the
+        loop thread via the op queue; a dying engine resolves the op as
+        EngineStoppedError.  All three are PrefixTransferError /
+        ReplicaDeadError shapes the adopter maps to local recompute."""
+        if not self._paged or self.trie is None:
+            raise PrefixUnavailable("engine has no prefix index")
+        toks = np.asarray(list(tokens), np.int32)
+        bs = self.pool.block_size
+        n = int(toks.size)
+        if n < bs or n % bs:
+            raise PrefixUnavailable(
+                f"prefix length {n} is not block-aligned (bs={bs})")
+        want = int(generation)
+
+        def op():
+            if want != self.pool.generation:
+                raise StalePrefixGeneration(
+                    f"pool generation is {self.pool.generation}, entry "
+                    f"advertised {want} (pool was reset since publish)")
+            # the trie's match caps at len-1 (the last token's logits
+            # always rerun); one probe token past the prefix lets the
+            # full chain match
+            probe = np.concatenate([toks, np.zeros(1, np.int32)])
+            ids, hit = self.trie.match(probe)
+            try:
+                if hit < n:
+                    raise PrefixUnavailable(
+                        f"only {hit}/{n} prefix tokens still cached "
+                        "(evicted since publish)")
+                k, v = self.pool.read_blocks(ids[:n // bs])
+            finally:
+                for bid in ids:
+                    self.pool.decref(bid)
+            return {"k": k, "v": v, "generation": self.pool.generation,
+                    "n_tokens": n, "block_size": bs}
+        return self._run_op(op)
+
+    def prefix_install(self, tokens, payload: dict) -> dict:
+        """INSTALL side of prefix adoption: write fetched block K/V
+        into freshly-allocated local blocks and publish them to the
+        local trie — the next admission's match then adopts them under
+        the normal refcount/CoW rules, indistinguishable from a locally
+        computed prefix.  Never preempts live rows: under block
+        pressure it evicts unreferenced cached prefixes only, then
+        gives up with PrefixInstallPressure (adoption is an
+        optimization; real work is not)."""
+        if not self._paged or self.trie is None:
+            raise PrefixUnavailable("engine has no prefix index")
+        toks = np.asarray(list(tokens), np.int32)
+        bs = self.pool.block_size
+        n = int(toks.size)
+        if n < bs or n % bs:
+            raise PrefixUnavailable(
+                f"prefix length {n} is not block-aligned (bs={bs})")
+        if int(payload.get("block_size", -1)) != bs:
+            raise PrefixUnavailable(
+                f"holder block_size {payload.get('block_size')} != "
+                f"local {bs} (geometry mismatch)")
+        n_b = n // bs
+        k_new, v_new = payload["k"], payload["v"]
+        expect = (self.cfg.n_layers, n_b, self.cfg.n_heads, bs,
+                  self.cfg.head_dim)
+        if tuple(np.shape(k_new)) != expect \
+                or tuple(np.shape(v_new)) != expect:
+            raise PrefixUnavailable(
+                f"payload shape {np.shape(k_new)} != expected {expect}")
+
+        def op():
+            probe = np.concatenate([toks, np.zeros(1, np.int32)])
+            ids, hit = self.trie.match(probe)
+            for bid in ids:
+                self.pool.decref(bid)
+            if hit >= n:
+                return {"installed": 0, "already": True}
+            fresh = []
+            for _ in range(n_b):
+                bid = self.pool.alloc()
+                while bid is None and self.trie.evict(1):
+                    bid = self.pool.alloc()
+                if bid is None:
+                    for b in fresh:
+                        self.pool.decref(b)
+                    raise PrefixInstallPressure(
+                        f"pool cannot hold a {n_b}-block adopted prefix "
+                        "without preempting live requests")
+                fresh.append(bid)
+            self.pool.write_blocks_at(fresh, k_new, v_new)
+            self.trie.insert(toks, fresh)
+            # the trie holds its own references now (and dedupe dropped
+            # any chunk it already had); releasing ours frees exactly
+            # the duplicates — the leak audit in tests pins this
+            for b in fresh:
+                self.pool.decref(b)
+            return {"installed": n_b, "already": False}
+        return self._run_op(op)
+
     def stats(self) -> dict:
         with self._cond:
             waiting = len(self._waiting)
@@ -1457,6 +1654,9 @@ class InferenceEngine:
                                     if lookup_toks else 0.0),
                 "preemptions": preemptions,
                 "peak_active_requests": peak,
+                # fences remotely-advertised block ids across donated-
+                # pool recoveries (cluster prefix plane)
+                "pool_generation": pool["generation"],
             })
         else:
             cache = self.cache.stats()

@@ -280,6 +280,42 @@ class GPTServer:
             "draining": self._draining,
         }
 
+    # --------------------------------------------- cluster prefix plane
+    # Replica-body surface of serve/fleet/prefix_directory.py: the fleet
+    # calls these through the same handle plumbing as __call__, so for
+    # actor replicas the K/V payload rides the existing object/transfer
+    # plane.  All failure modes are typed (PrefixTransferError /
+    # ReplicaDeadError shapes) and the plane maps every one of them to
+    # local-recompute fallback.
+
+    def prefix_export(self) -> list:
+        """Drain all resident engines' prefix publication outboxes
+        (tagged with the request ``model`` for multiplexed replicas)."""
+        if self._closed:
+            return []
+        out = []
+        if self._mux is not None:
+            for mid, eng in zip(self._mux.loaded_models(),
+                                self._mux.loaded_bodies()):
+                for ex in eng.prefix_export():
+                    ex["model"] = mid
+                    out.append(ex)
+        elif self.engine is not None:
+            out.extend(self.engine.prefix_export())
+        return out
+
+    def prefix_extract(self, model, tokens, generation: int) -> dict:
+        """Holder side of replica→replica prefix adoption (see
+        InferenceEngine.prefix_extract for the validation ladder)."""
+        req = {"model": model} if model is not None else {}
+        return self._engine_for(req).prefix_extract(tokens, generation)
+
+    def prefix_install(self, model, tokens, payload: dict) -> dict:
+        """Adopter side: install fetched K/V blocks into the local
+        radix index (see InferenceEngine.prefix_install)."""
+        req = {"model": model} if model is not None else {}
+        return self._engine_for(req).prefix_install(tokens, payload)
+
     def loaded_variants(self) -> list:
         return self._mux.loaded_models() if self._mux is not None else []
 

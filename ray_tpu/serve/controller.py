@@ -277,6 +277,12 @@ class DeploymentState:
                 self.fleet.note("drain_begin", replica=r.tag,
                                 reason=reason,
                                 deadline_s=round(float(deadline_s), 3))
+                if getattr(self.fleet, "prefix", None) is not None:
+                    # cluster prefix plane: a DRAINING holder serves no
+                    # fetches — drop its directory entries NOW (not at
+                    # teardown), so adoptions stop targeting it the
+                    # moment the drain begins
+                    self.fleet.prefix.invalidate_holder(r.tag)
             self._drain_chaos("replica_drain", replica=r)
         if moved:
             self._membership_changed()

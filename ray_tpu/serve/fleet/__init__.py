@@ -82,6 +82,7 @@ def metrics_snapshot() -> list:
     resumed_fail, resumed_scale, drained, drain_to = {}, {}, {}, {}
     blocks, butil, phit, saccept = {}, {}, {}, {}
     meshdev, tpsh = {}, {}
+    prem_hit, prem_fail, prem_fallback = {}, {}, {}
     for name, st in list(ctrl.deployments.items()):
         f = getattr(st, "fleet", None)
         if f is None:
@@ -103,6 +104,13 @@ def metrics_snapshot() -> list:
         saccept[key] = float(snap.get("spec_accept_rate", 0.0))
         meshdev[key] = float(snap.get("mesh_devices", 1))
         tpsh[key] = float(snap.get("tp_shards", 1))
+        # cluster prefix plane counters: keys exist only when the
+        # deployment's FleetConfig enabled cluster_prefix (OFF keeps
+        # the snapshot — and therefore this exporter — byte-identical)
+        if "prefix_remote_hits" in snap:
+            prem_hit[key] = float(snap["prefix_remote_hits"])
+            prem_fail[key] = float(snap["prefix_remote_fetch_failures"])
+            prem_fallback[key] = float(snap["prefix_fallback_recomputes"])
     if not admitted:
         return []
     return [
@@ -145,7 +153,17 @@ def metrics_snapshot() -> list:
         ("serve_fleet_tp_shards", "gauge",
          "Widest tensor-parallel shard count across live replicas",
          tpsh),
-    ]
+    ] + ([
+        ("serve_fleet_prefix_remote_hits_total", "counter",
+         "Prefixes adopted from a remote holder via the cluster "
+         "prefix directory", prem_hit),
+        ("serve_fleet_prefix_remote_fetch_failures_total", "counter",
+         "Remote prefix fetches that failed (holder died/drained, "
+         "stale generation, install pressure)", prem_fail),
+        ("serve_fleet_prefix_fallback_recomputes_total", "counter",
+         "Requests that fell back to local chunked-prefill recompute "
+         "after a failed adoption", prem_fallback),
+    ] if prem_hit else [])
 
 
 __all__ = [

@@ -96,6 +96,13 @@ class FleetConfig:
     drain_deadline_s: float = 30.0       # DRAINING -> forced kill+resume
     seed: int = 0                        # router's p2c rng
     keep_events: int = 8192
+    # cluster prefix plane (serve/fleet/prefix_directory.py): directory
+    # + prefix-affinity routing + replica→replica block adoption.  OFF
+    # by default — with it off, the fleet path is byte-identical to the
+    # plane not existing (every hook gates on ``fleet.prefix is None``)
+    cluster_prefix: bool = False
+    prefix_directory_capacity: int = 4096
+    prefix_fetch_timeout_s: float = 5.0  # extract/install per-hop cap
 
 
 @dataclass
@@ -138,6 +145,12 @@ class Fleet:
         self.counters = FleetCounters()
         self._clock = threading.Lock()
         self._events: deque = deque(maxlen=self.cfg.keep_events)
+        self.prefix = None
+        if self.cfg.cluster_prefix:
+            from ray_tpu.serve.fleet.prefix_directory import PrefixPlane
+            self.prefix = PrefixPlane(
+                self, capacity=self.cfg.prefix_directory_capacity,
+                fetch_timeout_s=self.cfg.prefix_fetch_timeout_s)
 
     # ----------------------------------------------------------- event trail
 
@@ -229,6 +242,12 @@ class Fleet:
         # compatibility aggregate (the split fields are authoritative)
         counters["resumed"] = (counters["resumed_failure"]
                                + counters["resumed_scale_down"])
+        if self.prefix is not None:
+            # cluster prefix plane: remote hits / fetch failures /
+            # fallback recomputes + live directory size (all zero-less
+            # ABSENT when the plane is off, so plane-less snapshots
+            # stay byte-identical to previous rounds)
+            counters.update(self.prefix.counters())
         return {
             "replicas": len(reps),
             "total_slots": slots,
@@ -292,6 +311,14 @@ class Fleet:
 
     def _call(self, replica, args: tuple, kwargs: dict,
               timeout: Optional[float] = None):
+        if self.prefix is not None:
+            # cluster prefix adoption runs before EVERY replica call
+            # (first route and resume re-routes alike): if the
+            # directory knows a peer holding this prompt's prefix,
+            # fetch + install it here so the engine's admission match
+            # adopts it.  before_call NEVER raises — any failure is a
+            # counted, silent downgrade to local recompute
+            self.prefix.before_call(replica, args)
         if replica.is_actor:
             import ray_tpu
             ref = replica.impl.handle_request.remote("__call__", args,
@@ -308,6 +335,8 @@ class Fleet:
         like to the router.  The controller's self-heal tick replaces
         it."""
         self.note("chaos_kill", replica=replica.tag)
+        if self.prefix is not None:
+            self.prefix.invalidate_holder(replica.tag)
         try:
             if replica.is_actor:
                 import ray_tpu
@@ -358,8 +387,11 @@ class _FleetResponse:
             if fleet.cfg.retry_on_replica_failure else 0
         try:
             for attempt in range(attempts + 1):
+                prefer = (fleet.prefix.route_hint(self._args)
+                          if fleet.prefix is not None else None)
                 replica = fleet.router.assign(self._model,
-                                              exclude=tuple(exclude))
+                                              exclude=tuple(exclude),
+                                              prefer=prefer)
                 fleet.note("route", replica=replica.tag,
                            model=self._model, attempt=attempt,
                            priority=self._priority)
@@ -373,6 +405,8 @@ class _FleetResponse:
                         raise
                     # replica died before/while handling: mark, re-route
                     fleet.router.mark_dead(replica)
+                    if fleet.prefix is not None:
+                        fleet.prefix.invalidate_holder(replica.tag)
                     exclude.append(replica.tag)
                     if attempt >= attempts:
                         raise
@@ -402,6 +436,10 @@ class _FleetResponse:
                         fleet._count("cancelled")
                     return _FleetStream(gen, never_started)
                 fleet.router.release(replica)
+                if fleet.prefix is not None:
+                    # advertise what this replica's engines published to
+                    # their local tries while serving (best-effort)
+                    fleet.prefix.publish_from(replica)
                 self._account(False, t0, state)
                 return out
             raise ReplicaDeadError(      # pragma: no cover (loop exits)
@@ -488,6 +526,8 @@ def fleet_stream(fleet: Fleet, gen: Iterator, replica, args, kwargs,
                         emitted += 1
                 finished = True
                 fleet._count("completed")
+                if fleet.prefix is not None and held is not None:
+                    fleet.prefix.publish_from(held)
                 if state is not None:
                     state.record_request(time.perf_counter() - t0, False)
                 return
@@ -497,6 +537,8 @@ def fleet_stream(fleet: Fleet, gen: Iterator, replica, args, kwargs,
                 dead_tag = held.tag
                 kind = _resume_kind(e, held)
                 fleet.router.mark_dead(held)
+                if fleet.prefix is not None:
+                    fleet.prefix.invalidate_holder(dead_tag)
                 fleet.router.release(held)
                 held = None
                 exclude.append(dead_tag)
@@ -526,6 +568,8 @@ def fleet_stream(fleet: Fleet, gen: Iterator, replica, args, kwargs,
                         dead_tag = held.tag
                         kind = _resume_kind(e2, held)
                         fleet.router.mark_dead(held)
+                        if fleet.prefix is not None:
+                            fleet.prefix.invalidate_holder(dead_tag)
                         fleet.router.release(held)
                         held = None
                         exclude.append(dead_tag)

@@ -1,0 +1,567 @@
+"""Cluster-scope prefix cache: directory + fault-tolerant adoption.
+
+The radix prefix index (inference/cache.RadixIndex) is replica-LOCAL:
+a replica that already paid prefill for a shared prompt head helps its
+own later requests, but a peer replica — or a cold replica that just
+joined — pays the whole prefill again.  This module makes the prefix
+plane CLUSTER-scope (the DistServe/Splitwise shape on ROADMAP item 2):
+
+  * ``chunk_keys``      — rolling content hash per block-sized prompt
+    chunk; key ``i`` identifies the whole prefix through chunk ``i``,
+    so one lookup finds the longest published prefix of a prompt.
+  * ``PrefixDirectory`` — prompt-chunk-hash → {holder replica, block
+    ids, generation}, LRU-bounded like the local RadixIndex.  Pure
+    bookkeeping, jax-free (the head hosts one for multi-node fleets —
+    core/head.py ``_h_prefix_publish``/``_h_prefix_lookup``/
+    ``_h_prefix_invalidate`` speak the wire vocabulary over the
+    existing envelope plane).
+  * ``PrefixPlane``     — the per-fleet orchestrator: publishes what
+    replicas' engines report after prefill, hints the router toward a
+    directory-confirmed holder (prefix-affinity routing), and — on a
+    directory hit on a NON-holder replica — fetches the K/V block
+    bytes from the holder and installs them into the adopter's radix
+    index under the normal CoW/refcount rules, so the very next
+    admission adopts them like any locally-cached prefix.
+
+The robustness contract (the reason this rides the fault plane): every
+failure — lookup raced an invalidation, holder died mid-fetch, stale
+pool generation, block pressure at the receiver — downgrades SILENTLY
+to the chunked-prefill recompute the engine would have run anyway.
+``adopt()`` never raises into the request path; disabling the
+directory (or injecting 100% fetch failure at the ``prefix_fetch``
+chaos point) reproduces replica-local behavior byte-identically.
+
+Invalidation rules (who may serve what):
+
+  * replica killed (``Fleet.kill_replica`` / route-time dead-mark) →
+    ``invalidate_holder`` drops every entry it published.
+  * replica DRAINING (``DeploymentState.drain_replicas``) → same, and
+    the router's affinity hint skips a draining holder IMMEDIATELY via
+    its lifecycle — never via a dead-mark whose DEAD_TTL_S expiry
+    would resurrect it.
+  * holder pool reset (donated-buffer recovery) → the pool GENERATION
+    bumps; ``prefix_extract`` rejects the stale generation with the
+    typed error and the plane purges that generation's entries — a
+    recovered pool's old block ids are never served.
+
+Chaos points (``FaultPlan.on_infer``): ``prefix_dir_lookup``,
+``prefix_fetch``, ``prefix_install`` — scripted fns may raise (inject
+the failure) or kill/drain the holder mid-adoption (ctx carries the
+holder handle); the gate discipline is the house standard (one global
+load + ``is None`` branch when disarmed, enforced by ``ray_tpu lint``
+via analysis/hotpath_registry.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+from ray_tpu.core import fault_injection as _fi
+from ray_tpu.serve.qos import (PrefixInstallPressure, PrefixTransferError,
+                               PrefixUnavailable, StalePrefixGeneration)
+
+__all__ = [
+    "chunk_keys", "PrefixDirectory", "PrefixPlane",
+    "PrefixTransferError", "StalePrefixGeneration", "PrefixUnavailable",
+    "PrefixInstallPressure",
+]
+
+
+def chunk_keys(tokens, block_size: int) -> list:
+    """Rolling chunk-hash chain for a token sequence: one hex key per
+    FULL block, where key ``i`` digests everything through chunk ``i``
+    — so equal keys mean equal whole prefixes, and the longest match
+    is found by walking a prompt's own key list back to front.  Only
+    full blocks are published/looked up (partial tails are written by
+    decode and never shared — the same rule the local RadixIndex
+    publication follows)."""
+    bs = int(block_size)
+    if bs < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    h = hashlib.blake2b(digest_size=16)
+    keys = []
+    for i in range(len(tokens) // bs):
+        chunk = tokens[i * bs:(i + 1) * bs]
+        h.update(b"".join(
+            int(t).to_bytes(8, "little", signed=True) for t in chunk))
+        keys.append(h.copy().hexdigest())
+    return keys
+
+
+class PrefixDirectory:
+    """Prompt-chunk-hash → {holder, block ids, generation} with LRU
+    eviction — the cluster-scope analogue of the replica-local radix
+    index.  Thread-safe (fleet pool threads publish/lookup/invalidate
+    concurrently; the head's event loop is single-threaded but shares
+    the class).  The directory is ADVISORY: extraction re-validates
+    against the holder's live trie and pool generation, so a stale
+    entry costs one failed fetch, never wrong bytes."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        # key -> {"holder", "node", "generation", "n_tokens", "blocks"}
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self.published = 0
+        self.hits = 0
+        self.misses = 0
+        self.invalidated = 0
+        self.evicted = 0
+
+    def publish(self, keys: list, *, holder: str, n_tokens: int,
+                generation: int, block_size: int, node: str = "",
+                blocks: tuple = (), engine: str = "") -> int:
+        """Register one prefix chain: ``keys[i]`` covers the first
+        ``(i + 1) * block_size`` tokens.  Later publishes of the same
+        key overwrite (freshest holder/generation wins).  ``engine`` is
+        the holder's conduit address (the engine-registry name the node
+        plane's ``block_fetch`` resolves — empty for in-proc-only
+        topologies).  Returns the number of entries registered."""
+        bs = int(block_size)
+        n = 0
+        with self._lock:
+            for i, key in enumerate(keys):
+                covered = (i + 1) * bs
+                if covered > int(n_tokens):
+                    break
+                self._entries[key] = {
+                    "holder": holder, "node": node,
+                    "generation": int(generation),
+                    "n_tokens": covered,
+                    "blocks": tuple(blocks[:i + 1]),
+                    "engine": engine,
+                }
+                self._entries.move_to_end(key)
+                n += 1
+            self.published += n
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evicted += 1
+        return n
+
+    def lookup(self, keys: list) -> Optional[dict]:
+        """Longest published prefix of the chain ``keys`` (walked back
+        to front).  Returns a COPY of the entry + its key, or None."""
+        with self._lock:
+            for key in reversed(keys):
+                e = self._entries.get(key)
+                if e is not None:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return {"key": key, **e}
+            self.misses += 1
+            return None
+
+    def purge(self, key: str) -> bool:
+        with self._lock:
+            if self._entries.pop(key, None) is not None:
+                self.invalidated += 1
+                return True
+            return False
+
+    def invalidate_holder(self, holder: str) -> int:
+        """Drop every entry a replica published (death / drain)."""
+        return self._invalidate(lambda e: e["holder"] == holder)
+
+    def invalidate_node(self, node: str) -> int:
+        """Drop every entry hosted on a node (node death / drain — the
+        head's ``_node_dead``/``_begin_node_drain`` hook)."""
+        return self._invalidate(lambda e: e["node"] == node)
+
+    def invalidate_stale(self, holder: str, stale_generation: int) -> int:
+        """Drop a holder's entries at (or before) a generation its pool
+        reset has invalidated — the donated-pool recovery rule: a reset
+        pool's old block ids must never be served."""
+        g = int(stale_generation)
+        return self._invalidate(
+            lambda e: e["holder"] == holder and e["generation"] <= g)
+
+    def _invalidate(self, pred) -> int:
+        with self._lock:
+            drop = [k for k, e in self._entries.items() if pred(e)]
+            for k in drop:
+                del self._entries[k]
+            self.invalidated += len(drop)
+            return len(drop)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "published": self.published,
+                "hits": self.hits,
+                "misses": self.misses,
+                "invalidated": self.invalidated,
+                "evicted": self.evicted,
+            }
+
+
+class PrefixPlane:
+    """Per-fleet adoption orchestrator: directory + publish + affinity
+    hint + the fetch/install path, with the total-fallback contract.
+
+    Installed by ``Fleet`` when ``FleetConfig.cluster_prefix`` is on;
+    ``None`` otherwise — every call site gates on that, so the default
+    fleet path is byte-identical with the plane absent."""
+
+    def __init__(self, fleet, *, capacity: int = 4096,
+                 fetch_timeout_s: float = 5.0):
+        self.fleet = fleet
+        self.directory = PrefixDirectory(capacity=capacity)
+        self.fetch_timeout_s = float(fetch_timeout_s)
+        self._lock = threading.Lock()
+        self._block_size: Optional[int] = None
+        # (replica_tag, key) pairs known adopted/held — a cheap memo so
+        # a hot shared prefix is fetched ONCE per replica, not once per
+        # request.  Never consulted for correctness: a pool reset on
+        # the adopter just means the next admission recomputes locally.
+        self._adopted: set = set()
+        self._adopt_seq = itertools.count(1)
+        # the three ISSUE counters (merged into fleet_snapshot and the
+        # serve_fleet_prefix_* /metrics series)
+        self.remote_hits = 0
+        self.remote_fetch_failures = 0
+        self.fallback_recomputes = 0
+
+    # ------------------------------------------------------------- chaos
+
+    def _chaos(self, point: str, **ctx) -> Optional[dict]:
+        """Fault-plane hook (prefix_dir_lookup / prefix_fetch /
+        prefix_install): zero-overhead gate when no plan is installed.
+        Returns the ctx when a plan ran (a scripted fn may have mutated
+        it or killed/drained the holder it carries)."""
+        fi = _fi._active
+        if fi is None:
+            return None
+        ctx["deployment"] = self.fleet.name
+        fi.on_infer(point, ctx)
+        return ctx
+
+    def _count(self, field_name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field_name, getattr(self, field_name) + n)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "prefix_remote_hits": self.remote_hits,
+                "prefix_remote_fetch_failures": self.remote_fetch_failures,
+                "prefix_fallback_recomputes": self.fallback_recomputes,
+                "prefix_directory_entries": len(self.directory),
+            }
+
+    def _keys(self, model, tokens) -> list:
+        """Directory keys are MODEL-scoped: two multiplexed variants
+        sharing a token prefix hold different K/V, so the model id is
+        folded into every chunk key."""
+        with self._lock:
+            bs = self._block_size
+        if bs is None:
+            return []
+        return [f"{model or ''}|{k}" for k in chunk_keys(tokens, bs)]
+
+    # ----------------------------------------------------------- publish
+
+    def publish_from(self, replica) -> int:
+        """Drain a replica's prefix outbox (what its engines published
+        to their local radix index since last drain) into the
+        directory.  Best-effort: a dead/drained body publishes
+        nothing."""
+        try:
+            exports = self._body_call(replica, "prefix_export", ())
+        except Exception:
+            return 0
+        n = 0
+        for ex in exports or ():
+            tokens = ex.get("tokens") or ()
+            bs = int(ex.get("block_size", 0))
+            if not tokens or bs < 1:
+                continue
+            with self._lock:
+                if self._block_size is None:
+                    self._block_size = bs
+                elif self._block_size != bs:
+                    continue     # mixed-geometry fleet: only one plane
+            keys = self._keys(ex.get("model"), tokens)
+            gen = int(ex.get("generation", 0))
+            eng = ex.get("engine") or ""
+            n += self.directory.publish(
+                keys, holder=replica.tag, n_tokens=len(tokens),
+                generation=gen, block_size=bs,
+                blocks=tuple(ex.get("blocks") or ()), engine=eng)
+            with self._lock:
+                for key in keys:
+                    self._adopted.add((replica.tag, key))
+            # mirror to the head-registered directory so OTHER fleet
+            # processes (multi-node serving) can find this prefix; the
+            # local node proxies the message head-ward (standalone
+            # nodes answer it as a benign no-op)
+            self._head_send({"t": "prefix_publish", "keys": keys,
+                             "holder": replica.tag,
+                             "n_tokens": len(tokens), "generation": gen,
+                             "block_size": bs, "engine": eng})
+        return n
+
+    def invalidate_holder(self, tag: str) -> int:
+        """Replica left the serving set (killed / draining / torn
+        down): its entries must stop routing fetches at it.  This fleet
+        OWNS its replica tags, so the drop mirrors to the head
+        directory too (a foreign fleet's holders are never ours to
+        invalidate)."""
+        self._head_send({"t": "prefix_invalidate", "holder": tag})
+        return self.directory.invalidate_holder(tag)
+
+    # ------------------------------------------------------------ lookup
+
+    def _req_model(self, args: tuple):
+        req = args[0] if args and isinstance(args[0], dict) else None
+        return req.get("model") if req is not None else None
+
+    def _prompt_tokens(self, args: tuple) -> Optional[list]:
+        """Token-id prompt out of a request envelope; None when there
+        is nothing hashable (string prompts would need the replica's
+        vocab to encode — they simply skip the cluster plane and take
+        the local path)."""
+        req = args[0] if args and isinstance(args[0], dict) else None
+        if req is None:
+            return None
+        prompt = req.get("prompt")
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            return None
+        try:
+            return [int(t) for t in prompt]
+        except (TypeError, ValueError):
+            return None
+
+    def _lookup(self, tokens: list, model=None) -> Optional[dict]:
+        with self._lock:
+            bs = self._block_size
+        if bs is None or len(tokens) <= bs:
+            return None
+        # the last prompt token always runs prefill (its logits sample
+        # the first output token — the RadixIndex cap), so never look
+        # up the full prompt's chain
+        keys = self._keys(model, tokens[:len(tokens) - 1])
+        if not keys:
+            return None
+        try:
+            self._chaos("prefix_dir_lookup", keys=len(keys))
+        except BaseException:
+            return None          # injected lookup failure: local path
+        hit = self.directory.lookup(keys)
+        if hit is None:
+            # this fleet never published it — ask the head-registered
+            # directory (a sibling fleet process may have).  Remote
+            # hits carry no routable replica handle; adoption then
+            # goes through the node block-fetch conduit.
+            hit = self._head_lookup(keys)
+            if hit is not None:
+                hit["remote"] = True
+        if hit is not None:
+            hit["block_size"] = bs
+        return hit
+
+    def route_hint(self, args: tuple) -> Optional[str]:
+        """Directory-confirmed holder tag for this request's prompt —
+        the router's prefix-affinity preference.  Advisory only: the
+        router re-checks lifecycle/occupancy and falls through to p2c
+        when the holder is saturated, draining or dead."""
+        tokens = self._prompt_tokens(args)
+        if tokens is None:
+            return None
+        hit = self._lookup(tokens, self._req_model(args))
+        return hit["holder"] if hit is not None else None
+
+    # ----------------------------------------------------------- adoption
+
+    def before_call(self, replica, args: tuple) -> None:
+        """The adoption choke point (Fleet._call runs it before every
+        replica call when the plane is enabled): on a directory hit
+        whose holder is NOT the serving replica, fetch the K/V block
+        bytes from the holder and install them into the adopter's
+        radix index, so the engine's normal admission match adopts them
+        with the usual CoW/refcount rules.  TOTAL fallback: every
+        failure is counted, noted, and swallowed — the request then
+        recomputes its prefill locally, exactly as if the plane did
+        not exist."""
+        tokens = self._prompt_tokens(args)
+        if tokens is None:
+            return
+        model = self._req_model(args)
+        hit = self._lookup(tokens, model)
+        if hit is None:
+            return
+        key = hit["key"]
+        with self._lock:
+            if (replica.tag, key) in self._adopted:
+                return           # already holds it (published or adopted)
+        if hit["holder"] == replica.tag:
+            return
+        holder = self._find_replica(hit["holder"])
+        if holder is None and not hit.get("remote"):
+            # OUR holder left the membership between publish and now:
+            # entry is dead weight, drop it (locally and at the head)
+            self.invalidate_holder(hit["holder"])
+            return
+        n = int(hit["n_tokens"])
+        aid = next(self._adopt_seq)
+        fleet = self.fleet
+        fleet.note("adopt_begin", replica=replica.tag,
+                   holder=hit["holder"], adopt=aid, tokens=n)
+        try:
+            self._chaos("prefix_fetch", replica=replica.tag,
+                        holder=hit["holder"], holder_replica=holder,
+                        key=key, tokens=n)
+            if holder is not None:
+                payload = self._body_call(
+                    holder, "prefix_extract",
+                    (model, tokens[:n], int(hit["generation"])))
+            else:
+                # head-directory hit from a sibling fleet process:
+                # fetch over the node object/transfer plane instead
+                payload = self._conduit_fetch(hit, tokens[:n])
+            self._chaos("prefix_install", replica=replica.tag,
+                        holder=hit["holder"], key=key, tokens=n)
+            self._body_call(
+                replica, "prefix_install",
+                (model, tokens[:n], payload))
+        except StalePrefixGeneration:
+            # donated-pool recovery on the holder: purge everything
+            # that generation advertised, then recompute locally.
+            # Generation truth is global — mirror the drop to the head
+            # so sibling fleets stop chasing the same dead entries
+            self.directory.invalidate_stale(hit["holder"],
+                                            hit["generation"])
+            self._head_send({"t": "prefix_invalidate",
+                             "holder": hit["holder"],
+                             "stale_generation": hit["generation"]})
+            self._count("remote_fetch_failures")
+            self._count("fallback_recomputes")
+            fleet.note("adopt_fallback", replica=replica.tag,
+                       holder=hit["holder"], adopt=aid,
+                       reason="stale_generation")
+        except Exception as e:
+            # holder died mid-fetch, drain raced in, transfer timeout,
+            # receiver block pressure, eviction raced the fetch — all
+            # one outcome: silent downgrade to local recompute
+            if isinstance(e, PrefixUnavailable):
+                self.directory.purge(key)
+                self._head_send({"t": "prefix_invalidate", "key": key})
+            self._count("remote_fetch_failures")
+            self._count("fallback_recomputes")
+            fleet.note("adopt_fallback", replica=replica.tag,
+                       holder=hit["holder"], adopt=aid,
+                       reason=type(e).__name__)
+        else:
+            self._count("remote_hits")
+            with self._lock:
+                self._adopted.add((replica.tag, key))
+            fleet.note("adopt_complete", replica=replica.tag,
+                       holder=hit["holder"], adopt=aid, tokens=n)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _head_client(self):
+        """The connected runtime's node client (the message then
+        proxies head-ward via the node's cluster-scope routing), or
+        None for pure in-proc serving with no ``ray_tpu.init()`` —
+        there the local directory IS the whole plane."""
+        try:
+            import ray_tpu
+            if not ray_tpu.is_initialized():
+                return None
+            return ray_tpu.get_runtime().client
+        except Exception:
+            return None
+
+    def _head_send(self, msg: dict) -> None:
+        """Best-effort head-directory mirror: a lost mirror costs a
+        sibling fleet one recomputed prefill (or one doomed fetch that
+        falls back), never correctness — so failures are swallowed and
+        the bound on the request path is one short round-trip."""
+        client = self._head_client()
+        if client is None:
+            return
+        try:
+            client.request(msg, timeout=min(2.0, self.fetch_timeout_s))
+        except Exception:
+            pass
+
+    def _head_lookup(self, keys: list) -> Optional[dict]:
+        client = self._head_client()
+        if client is None:
+            return None
+        try:
+            reply = client.request(
+                {"t": "prefix_lookup", "keys": keys},
+                timeout=min(2.0, self.fetch_timeout_s))
+        except Exception:
+            return None
+        hit = reply.get("hit")
+        return dict(hit) if isinstance(hit, dict) else None
+
+    def _conduit_fetch(self, hit: dict, tokens: list) -> dict:
+        """Fetch a foreign holder's K/V bytes over the node
+        object/transfer plane (core/node_transfer.py
+        ``_h_block_fetch``), addressed by the engine-registry name the
+        holder published.  Typed prefix errors are reconstructed from
+        the reply's error name so the caller's fallback ladder (stale
+        → invalidate generation, unavailable → purge key) behaves
+        exactly as for an in-fleet fetch."""
+        client = self._head_client()
+        if client is None or not hit.get("engine"):
+            raise PrefixUnavailable(
+                f"no conduit to foreign holder {hit['holder']!r}")
+        reply = client.request(
+            {"t": "block_fetch", "engine": hit["engine"],
+             "tokens": list(tokens),
+             "generation": int(hit["generation"])},
+            timeout=self.fetch_timeout_s)
+        err = reply.get("error")
+        if err:
+            if reply.get("error_type") == "StalePrefixGeneration":
+                raise StalePrefixGeneration(err)
+            raise PrefixUnavailable(err)
+        import numpy as np
+        try:
+            dt = np.dtype(reply["dtype"])
+        except TypeError:
+            import ml_dtypes   # bfloat16 et al (registered by jax)
+            dt = np.dtype(getattr(ml_dtypes, reply["dtype"]))
+        shape = tuple(reply["shape"])
+        return {
+            "k": np.frombuffer(reply["k"], dtype=dt).reshape(shape),
+            "v": np.frombuffer(reply["v"], dtype=dt).reshape(shape),
+            "generation": int(reply["generation"]),
+            "n_tokens": int(reply["n_tokens"]),
+            "block_size": int(reply["block_size"]),
+        }
+
+    def _find_replica(self, tag: str):
+        state = self.fleet.state
+        with state._lock:
+            for r in state.replicas:
+                if r.tag == tag and r.lifecycle == "active":
+                    return r
+        return None
+
+    def _body_call(self, replica, method: str, args: tuple):
+        """Replica-body method call.  In-process bodies are direct
+        calls; actor replicas go through the core runtime — the K/V
+        payload then rides the existing object/transfer plane
+        (core/node_transfer.py), the same conduit owner_handoff uses.
+        Typed prefix errors survive the hop either way."""
+        if replica.is_actor:
+            import ray_tpu
+            ref = replica.impl.handle_request.remote(method, args, {})
+            return ray_tpu.get(ref, timeout=self.fetch_timeout_s)
+        return replica.impl.handle_request(method, args, {})

@@ -190,14 +190,35 @@ class OccupancyRouter:
 
     def assign(self, model: Optional[str] = None, *,
                timeout: float = 30.0,
-               exclude: tuple = ()) -> ReplicaHandle:
+               exclude: tuple = (),
+               prefer: Optional[str] = None) -> ReplicaHandle:
         """Pick a replica (p2c on occupancy), increment its ongoing
-        count.  ``exclude`` skips tags (retry-after-failure path)."""
+        count.  ``exclude`` skips tags (retry-after-failure path).
+
+        ``prefer`` is the prefix-affinity hint (a directory-confirmed
+        prefix HOLDER's tag): when that replica is live, active and
+        unsaturated it wins outright — serving there reuses cached KV
+        with no transfer at all.  The preference is judged by
+        ``_score``, so a DRAINING holder is skipped IMMEDIATELY via its
+        lifecycle/probe (never dead-marked — a mark's DEAD_TTL_S expiry
+        must not resurrect a deliberate drain), and a saturated or dead
+        holder falls through to the normal occupancy pick."""
         maxq = self._state.deployment.options.max_concurrent_queries
         deadline = time.monotonic() + timeout
+        first_pass = True
         while True:
             live = [r for r in self.live_replicas()
                     if r.tag not in exclude]
+            if prefer is not None and first_pass:
+                # honored once: if the holder cannot take the request
+                # NOW, balance beats affinity (the adoption path will
+                # warm whoever the p2c pick lands on)
+                first_pass = False
+                held = [r for r in live if r.tag == prefer]
+                if held and self._score(held[0], maxq) is not None:
+                    with self._state._lock:
+                        held[0].ongoing += 1
+                    return held[0]
             cands = live
             if model is not None and live:
                 held = self.holders(live, model)

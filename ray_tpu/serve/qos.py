@@ -53,3 +53,35 @@ class EngineDrainingError(ReplicaDeadError):
     ``resumed_scale_down``, never as a failure resume, and never as a
     500.  Lives here (jax-free) so the generic fleet machinery can
     classify without importing the inference stack."""
+
+
+class PrefixTransferError(RuntimeError):
+    """Base of the cluster-prefix-plane failure vocabulary.  EVERY
+    subclass means the same thing to the fleet layer: the remote
+    adoption is off, fall back to local chunked-prefill recompute — a
+    prefix transfer failure is NEVER a request error (the robustness
+    spine of the cluster prefix cache).  Typed so the plane can also
+    tell *why* (purge a stale directory entry vs count a fetch
+    failure); jax-free so the directory/head never import the
+    inference stack."""
+
+
+class StalePrefixGeneration(PrefixTransferError):
+    """The holder's block pool was reset (donated-buffer recovery)
+    since the directory entry was published: its generation counter
+    moved on, so the advertised blocks no longer hold the advertised
+    tokens.  The caller must purge the directory entry — a recovered
+    pool's old block ids must never be served."""
+
+
+class PrefixUnavailable(PrefixTransferError):
+    """The holder no longer caches the requested prefix (LRU-evicted
+    under pool pressure, or the engine has no radix index / geometry
+    mismatch).  Benign: the adopter recomputes locally."""
+
+
+class PrefixInstallPressure(PrefixTransferError):
+    """The ADOPTER could not find blocks for the fetched prefix without
+    preempting live requests — adoption is an optimization and never
+    preempts real work for hoped-for reuse.  The fetched bytes are
+    dropped and the request recomputes locally."""

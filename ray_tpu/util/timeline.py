@@ -13,7 +13,9 @@ python/ray/experimental/state + _private/profiling.py):
   * serve-fleet ingress      → admission/shed/route/resume/scale events
     (serve/fleet): queued admissions render as ``X`` slices (the queue
     wait is visible time), everything else as ``i`` instants, one track
-    per event kind
+    per event kind; drain begin/settle pairs and cluster-prefix
+    adoption begin/complete/fallback pairs merge into single ``X``
+    slices so their durations read straight off the trace
   * inference-engine request slices → one ``X`` per completed request
     (pid "engine", tid = engine name) spanning submit→finish, with
     speculative-decoding accept/reject counts — and, for meshed
@@ -87,6 +89,11 @@ def build_trace(task_events: Iterable = (), records: Iterable = (),
     # so the drain DURATION is visible time; unpaired events fall back
     # to instants below
     drain_open: dict = {}    # replica tag -> begin event
+    # prefix-adoption pairing: an adopt_begin and its settling
+    # adopt_complete / adopt_fallback (same adopt id) render as ONE
+    # slice — the remote fetch+install cost is visible time, and a
+    # fallback slice carries the failure reason in args
+    adopt_open: dict = {}    # adopt id -> begin event
     for g in ingress:
         # g: fleet ingress event — {"t", "kind", "deployment", ...}
         # (serve/fleet/ingress.py Fleet.note); an admit that waited in
@@ -118,6 +125,25 @@ def build_trace(task_events: Iterable = (), records: Iterable = (),
                 "pid": "ingress", "tid": "admit", "args": args,
             })
             continue
+        if kind == "adopt_begin" and g.get("adopt") is not None:
+            adopt_open[g["adopt"]] = g
+            continue
+        if kind in ("adopt_complete", "adopt_fallback") \
+                and g.get("adopt") in adopt_open:
+            begin = adopt_open.pop(g["adopt"])
+            t0 = float(begin.get("t", 0.0)) * 1e6
+            args["outcome"] = kind
+            args.setdefault("holder", begin.get("holder"))
+            args.setdefault("replica", begin.get("replica"))
+            args.setdefault("tokens", begin.get("tokens"))
+            ev.append({
+                "name": f"ingress:adopt:{begin.get('holder', '?')}"
+                        f"->{begin.get('replica', '?')}",
+                "cat": "ingress", "ph": "X",
+                "ts": t0, "dur": max(0.0, ts - t0),
+                "pid": "ingress", "tid": "adopt", "args": args,
+            })
+            continue
         if kind == "drain_begin" and g.get("replica") is not None:
             drain_open[g["replica"]] = g
             continue
@@ -145,6 +171,16 @@ def build_trace(task_events: Iterable = (), records: Iterable = (),
             "name": "ingress:drain_begin", "cat": "ingress", "ph": "i",
             "s": "g", "ts": float(begin.get("t", 0.0)) * 1e6,
             "pid": "ingress", "tid": "drain",
+            "args": {k: v for k, v in begin.items()
+                     if k not in ("t", "kind")},
+        })
+    for aid, begin in adopt_open.items():
+        # adoption still in flight (or its settle event was evicted):
+        # show the begin rather than dropping it
+        ev.append({
+            "name": "ingress:adopt_begin", "cat": "ingress", "ph": "i",
+            "s": "g", "ts": float(begin.get("t", 0.0)) * 1e6,
+            "pid": "ingress", "tid": "adopt",
             "args": {k: v for k, v in begin.items()
                      if k not in ("t", "kind")},
         })

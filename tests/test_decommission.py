@@ -27,6 +27,17 @@ def _total_recons(nodes) -> int:
                for lin in n.lineage.values())
 
 
+def _wait_for(cond, timeout=30.0, what="condition"):
+    """Event-polled wait (deflake: fixed sleeps raced the scheduler on
+    loaded CI machines — poll the actual observable instead)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {what}")
+
+
 def test_node_decommission_e2e_8_nodes(cluster):
     """The acceptance e2e: drain one member of an 8-node cluster while
     it holds queued work, the only copy of a task result, AND a
@@ -77,9 +88,14 @@ def test_node_decommission_e2e_8_nodes(cluster):
         pytest.fail("producer never settled at the owner")
 
     # mid-drain load: more pool tasks than instantaneous capacity, so
-    # some are QUEUED on the victim when the drain begins
+    # some are QUEUED on the victim when the drain begins — wait for
+    # work to actually LAND there (queued or running), not a fixed
+    # sleep that races the scheduler on loaded machines
     refs = [work.remote(i) for i in range(30)]
-    time.sleep(0.1)
+    _wait_for(lambda: (victim.runnable_cpu or victim.runnable_zero
+                       or any(rec.current_task is not None
+                              for rec in victim.clients.values())),
+              what="pool work to land on the victim")
     res = ray_tpu.drain_node(victim.node_id.hex(), deadline_s=30)
     assert res.get("draining")
 
@@ -153,7 +169,10 @@ def test_drain_waits_for_queued_actor_calls(cluster):
     # holds a drain open, but here the queue is the point)
     assert ray_tpu.get(a.step.remote(-1), timeout=120) == -1
     refs = [a.step.remote(i) for i in range(5)]   # 1 running + 4 queued
-    time.sleep(0.2)
+    # the regression is about the QUEUE: wait until calls are actually
+    # parked on the victim's actor record before draining
+    _wait_for(lambda: any(ar.queue for ar in victim.actors.values()),
+              what="actor calls to queue on the victim")
     ray_tpu.drain_node(victim.node_id.hex(), deadline_s=30)
     assert ray_tpu.get(refs, timeout=120) == list(range(5))
     cluster.wait_node_gone(victim, timeout=60)

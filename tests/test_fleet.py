@@ -189,6 +189,52 @@ def test_router_skips_stopped_and_prefers_model_holders():
     assert r.assign().tag == "fake#2"
 
 
+def test_router_prefix_affinity_prefers_holder():
+    """The ``prefer`` hint (cluster prefix plane): a directory-confirmed
+    holder wins outright over a less-loaded replica — serving there
+    reuses cached KV with no transfer at all."""
+    from ray_tpu.serve.fleet.router import OccupancyRouter
+    st = _fake_state([
+        {"max_slots": 8, "active_slots": 6, "waiting_requests": 2,
+         "stopped": False, "models": []},               # busy holder
+        {"max_slots": 8, "active_slots": 0, "waiting_requests": 0,
+         "stopped": False, "models": []},               # idle
+    ])
+    r = OccupancyRouter(st, seed=1)
+    assert r.assign(prefer="fake#0").tag == "fake#0"
+    # unknown/dead preference degrades to the normal occupancy pick
+    assert r.assign(prefer="nope#9").tag == "fake#1"
+
+
+def test_router_prefer_skips_draining_holder_without_dead_mark():
+    """Regression (drain vs dead-mark): a DRAINING prefix holder is
+    skipped IMMEDIATELY — via lifecycle or its body's draining flag —
+    and must NEVER be dead-marked, because a dead-mark expires after
+    DEAD_TTL_S and expiry must not resurrect a deliberate drain."""
+    from ray_tpu.serve.fleet.router import OccupancyRouter
+    stats = [
+        {"max_slots": 8, "active_slots": 0, "waiting_requests": 0,
+         "stopped": False, "models": []},               # the holder
+        {"max_slots": 8, "active_slots": 4, "waiting_requests": 1,
+         "stopped": False, "models": []},
+    ]
+    st = _fake_state(stats)
+    # controller-visible drain: lifecycle flips, holder leaves live set
+    st.replicas[0].lifecycle = "draining"
+    r = OccupancyRouter(st, seed=1)
+    assert r.assign(prefer="fake#0").tag == "fake#1"
+    with r._mlock:
+        assert "fake#0" not in r._dead
+    # body-first drain: lifecycle still active but the engine already
+    # reports draining (the membership move is racing) — same outcome
+    st.replicas[0].lifecycle = "active"
+    stats[0]["draining"] = True
+    r2 = OccupancyRouter(st, seed=1)
+    assert r2.assign(prefer="fake#0").tag == "fake#1"
+    with r2._mlock:
+        assert "fake#0" not in r2._dead
+
+
 # --------------------------------------------------------------- multiplex
 
 

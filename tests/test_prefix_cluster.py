@@ -1,0 +1,465 @@
+"""Cluster-wide prefix plane: directory bookkeeping, cross-replica
+adoption with greedy token parity, and the fault ladder — holder killed
+mid-fetch, stale pool generation, drain racing an adoption, install
+under block pressure.  Every failure must downgrade SILENTLY to local
+chunked-prefill recompute (the request still completes token-exact),
+and no failure path may leak a block refcount.
+
+Everything runs on CPU with GPTConfig.tiny at f32 (greedy argmax parity
+must not hinge on bf16 ties)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu import serve
+from ray_tpu.core import fault_injection as fi
+from ray_tpu.inference import EngineConfig, InferenceEngine, \
+    build_gpt_deployment
+from ray_tpu.models import gpt
+from ray_tpu.serve import fleet
+from ray_tpu.serve.fleet import FleetConfig
+from ray_tpu.serve.fleet.prefix_directory import (PrefixDirectory,
+                                                  chunk_keys)
+from ray_tpu.serve.qos import (PrefixInstallPressure, PrefixUnavailable,
+                               StalePrefixGeneration)
+
+pytestmark = [pytest.mark.serve_fleet, pytest.mark.chaos]
+
+CFG = gpt.GPTConfig.tiny(dtype=jnp.float32, max_seq=64)
+SEED = 0
+
+
+@pytest.fixture(autouse=True)
+def _cleanup():
+    yield
+    fi.uninstall()
+    serve.shutdown()
+
+
+def _ref_tokens(prompt, max_new):
+    params = gpt.init_params(CFG, jax.random.PRNGKey(SEED))
+    out = gpt.generate(params, CFG, jnp.asarray([prompt], jnp.int32),
+                       max_new=max_new, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _run_fleet(num_replicas=2, **fleet_kw):
+    dep = build_gpt_deployment(
+        cfg=CFG,
+        engine_cfg=EngineConfig(max_slots=4, kv_block_size=4,
+                                default_max_new=8),
+        seed=SEED, num_replicas=num_replicas)
+    serve.run(dep, use_actors=False, http=False)
+    fleet_kw.setdefault("cluster_prefix", True)
+    return fleet.enable("v1", FleetConfig(rate=500, burst=64, **fleet_kw))
+
+
+def _req(prompt, max_new=6):
+    return {"prompt": list(prompt), "max_tokens": max_new,
+            "temperature": 0.0}
+
+
+def _engine(replica) -> InferenceEngine:
+    return replica.impl._user.engine
+
+
+def _serve_on(f, replica, prompt, max_new=6):
+    """Route a request at a SPECIFIC replica through the fleet call
+    path (adoption hook included) — the deterministic way to make a
+    non-holder serve a directory-published prompt."""
+    return f._call(replica, (_req(prompt, max_new),), {}, "__call__")
+
+
+def _assert_no_block_leaks(f):
+    """Leak audit: with no requests in flight, every live block in
+    every replica's pool must be accounted to its radix trie — a
+    failed fetch/install that forgot a decref shows up here as
+    blocks_used > cached trie nodes."""
+    for r in f.state.replicas:
+        eng = _engine(r)
+        if getattr(eng, "_stopped", False):
+            continue
+        stats = eng.pool.stats()
+        assert stats["blocks_used"] == eng.trie.cached_blocks, (
+            f"{r.tag}: {stats['blocks_used']} blocks used but trie "
+            f"holds {eng.trie.cached_blocks}")
+
+
+def _holder_and_other(f, prompt):
+    hit = f.prefix.directory.lookup(f.prefix._keys(None, prompt[:-1]))
+    assert hit is not None, "prompt never published"
+    holder = next(r for r in f.state.replicas if r.tag == hit["holder"])
+    other = next(r for r in f.state.replicas if r.tag != hit["holder"])
+    return holder, other
+
+
+# ------------------------------------------------------------- chunk keys
+
+
+def test_chunk_keys_rolling_prefix_property():
+    a = chunk_keys([1, 2, 3, 4, 5, 6, 7, 8], 4)
+    b = chunk_keys([1, 2, 3, 4, 9, 9, 9, 9], 4)
+    assert len(a) == len(b) == 2
+    assert a[0] == b[0]               # shared first chunk, same key
+    assert a[1] != b[1]               # divergence poisons the chain
+    # partial tails are never keyed (decode writes them; not shareable)
+    assert len(chunk_keys([1, 2, 3, 4, 5], 4)) == 1
+    # the chain is position-dependent: same chunk content at a
+    # different depth must not collide
+    c = chunk_keys([1, 2, 3, 4, 1, 2, 3, 4], 4)
+    assert c[1] != c[0]
+
+
+def test_directory_lru_overwrite_and_invalidation_scopes():
+    d = PrefixDirectory(capacity=3)
+    d.publish(["k1", "k2"], holder="r0", n_tokens=8, generation=0,
+              block_size=4, node="nA")
+    d.publish(["k3"], holder="r1", n_tokens=4, generation=2,
+              block_size=4, node="nB")
+    # longest-prefix lookup walks back to front
+    hit = d.lookup(["k1", "k2", "kX"])
+    assert hit["key"] == "k2" and hit["n_tokens"] == 8
+    # overwrite: freshest holder wins
+    d.publish(["k1"], holder="r1", n_tokens=4, generation=5,
+              block_size=4)
+    assert d.lookup(["k1"])["holder"] == "r1"
+    # capacity eviction is LRU
+    d.publish(["k4", "k5"], holder="r0", n_tokens=8, generation=0,
+              block_size=4)
+    assert len(d) == 3 and d.stats()["evicted"] == 2
+    # stale-generation invalidation: <= g only
+    d.publish(["g1"], holder="r9", n_tokens=4, generation=1,
+              block_size=4)
+    d.publish(["g2"], holder="r9", n_tokens=4, generation=3,
+              block_size=4)
+    assert d.invalidate_stale("r9", 2) == 1
+    assert d.lookup(["g1"]) is None and d.lookup(["g2"]) is not None
+    # node scope
+    d2 = PrefixDirectory()
+    d2.publish(["n1"], holder="rA", n_tokens=4, generation=0,
+               block_size=4, node="host1")
+    d2.publish(["n2"], holder="rB", n_tokens=4, generation=0,
+               block_size=4, node="host2")
+    assert d2.invalidate_node("host1") == 1
+    assert d2.lookup(["n1"]) is None and d2.lookup(["n2"]) is not None
+
+
+# -------------------------------------------------------------- adoption
+
+
+def test_adopt_across_replicas_token_parity():
+    """The tentpole happy path: replica A pays prefill, replica B
+    adopts A's blocks through the directory+fetch+install path, and
+    B's output is token-exact vs the full-recompute oracle."""
+    f = _run_fleet()
+    prompt = list(range(1, 21))
+    r1 = f.remote((_req(prompt),), {}).result(timeout=120)
+    assert len(f.prefix.directory) > 0
+    holder, other = _holder_and_other(f, prompt)
+    r2 = _serve_on(f, other, prompt)
+    assert r2["tokens"] == r1["tokens"] == _ref_tokens(prompt, 6)
+    c = f.prefix.counters()
+    assert c["prefix_remote_hits"] == 1
+    assert c["prefix_remote_fetch_failures"] == 0
+    # the adopter's engine saw a REAL prefix hit at admission
+    st = other.impl.handle_request("fleet_stats", (), {})
+    assert st["prefix_hit_tokens"] >= 16
+    # adoption memo: the same prompt again fetches nothing new
+    _serve_on(f, other, prompt)
+    assert f.prefix.counters()["prefix_remote_hits"] == 1
+    # snapshot carries the plane counters; timeline merges the pair
+    # into one X slice
+    snap = f.fleet_snapshot()
+    assert snap["prefix_remote_hits"] == 1
+    from ray_tpu.util.timeline import build_trace
+    tr = build_trace(ingress=f.events())
+    adopt = [e for e in tr["traceEvents"]
+             if e.get("tid") == "adopt" and e["ph"] == "X"]
+    assert len(adopt) == 1
+    assert adopt[0]["args"]["outcome"] == "adopt_complete"
+    _assert_no_block_leaks(f)
+
+
+def test_route_hint_prefers_holder_no_transfer():
+    """Prefix-affinity routing: a repeated prompt routes TO the holder
+    (where the blocks already live) — no adoption fetch at all."""
+    f = _run_fleet()
+    prompt = list(range(5, 25))
+    f.remote((_req(prompt),), {}).result(timeout=120)
+    holder, _ = _holder_and_other(f, prompt)
+    for _i in range(3):
+        f.remote((_req(prompt),), {}).result(timeout=120)
+    assert f.prefix.counters()["prefix_remote_hits"] == 0
+    assert not any(e["kind"] == "adopt_begin" for e in f.events())
+    st = holder.impl.handle_request("fleet_stats", (), {})
+    assert st["prefix_hit_tokens"] > 0
+
+
+def test_disabled_plane_is_absent():
+    """Fallback-total baseline: with cluster_prefix off the fleet has
+    no plane, snapshots carry no prefix_* keys, and output matches the
+    oracle (current behavior, byte-identical)."""
+    f = _run_fleet(cluster_prefix=False)
+    prompt = list(range(3, 19))
+    out = f.remote((_req(prompt),), {}).result(timeout=120)
+    assert out["tokens"] == _ref_tokens(prompt, 6)
+    assert f.prefix is None
+    # the plane's three counters are ABSENT (not zero) — plane-less
+    # snapshots stay byte-identical to previous rounds
+    snap = f.fleet_snapshot()
+    for k in ("prefix_remote_hits", "prefix_remote_fetch_failures",
+              "prefix_fallback_recomputes", "prefix_directory_entries"):
+        assert k not in snap
+
+
+# ------------------------------------------------------------ fault ladder
+
+
+def test_holder_killed_mid_fetch_falls_back_token_exact():
+    """The headline chaos arm: the holder dies at the prefix_fetch
+    choke point.  The adopter silently recomputes — request completes,
+    token-exact, failure counted, no leak."""
+    f = _run_fleet()
+    prompt = list(range(7, 27))
+    ref = f.remote((_req(prompt),), {}).result(timeout=120)["tokens"]
+    holder, other = _holder_and_other(f, prompt)
+
+    def kill_holder(ctx):
+        f.kill_replica(ctx["holder_replica"])
+
+    plan = fi.FaultPlan()
+    plan.add(fi.Rule("prefix_fetch", "script", fn=kill_holder))
+    fi.install(plan)
+    out = _serve_on(f, other, prompt)
+    assert out["tokens"] == ref == _ref_tokens(prompt, 6)
+    c = f.prefix.counters()
+    assert c["prefix_remote_hits"] == 0
+    assert c["prefix_remote_fetch_failures"] == 1
+    assert c["prefix_fallback_recomputes"] == 1
+    assert any(e["kind"] == "adopt_fallback" for e in f.events())
+    # the kill also invalidated the holder's directory entries
+    assert len(f.prefix.directory) == 0
+    _assert_no_block_leaks(f)
+
+
+def test_injected_fetch_failure_full_rate_reproduces_local_path():
+    """100% injected fetch failure == plane effectively off: every
+    request completes token-exact via local recompute."""
+    f = _run_fleet()
+    prompt = list(range(11, 31))
+    ref = f.remote((_req(prompt),), {}).result(timeout=120)["tokens"]
+    _, other = _holder_and_other(f, prompt)
+
+    def boom(ctx):
+        raise RuntimeError("injected transfer failure")
+
+    plan = fi.FaultPlan()
+    plan.add(fi.Rule("prefix_fetch", "script", fn=boom, times=None))
+    fi.install(plan)
+    for _i in range(2):
+        assert _serve_on(f, other, prompt)["tokens"] == ref
+    c = f.prefix.counters()
+    assert c["prefix_remote_hits"] == 0
+    assert c["prefix_remote_fetch_failures"] == 2
+    _assert_no_block_leaks(f)
+
+
+def test_stale_generation_rejected_and_entries_purged():
+    """Donated-pool recovery rule: a directory entry advertising a
+    generation the holder's pool has left behind is rejected with the
+    typed error, the plane purges that generation's entries, and the
+    request recomputes token-exact."""
+    f = _run_fleet()
+    prompt = list(range(2, 22))
+    ref = f.remote((_req(prompt),), {}).result(timeout=120)["tokens"]
+    holder, other = _holder_and_other(f, prompt)
+    # simulate publish-then-reset: entries advertise a generation the
+    # pool no longer serves
+    with f.prefix.directory._lock:
+        for e in f.prefix.directory._entries.values():
+            e["generation"] = 7
+    out = _serve_on(f, other, prompt)
+    assert out["tokens"] == ref
+    c = f.prefix.counters()
+    assert c["prefix_remote_fetch_failures"] == 1
+    assert any(e["kind"] == "adopt_fallback"
+               and e.get("reason") == "stale_generation"
+               for e in f.events())
+    # invalidate_stale dropped the whole advertised generation
+    assert len(f.prefix.directory) == 0
+    _assert_no_block_leaks(f)
+
+
+def test_drain_invalidates_holder_entries_immediately():
+    """DRAINING is not DEAD: the moment the controller moves the
+    holder to draining, its directory entries are gone — an adoption
+    can no longer target it, and requests recompute locally."""
+    f = _run_fleet()
+    prompt = list(range(9, 29))
+    ref = f.remote((_req(prompt),), {}).result(timeout=120)["tokens"]
+    holder, other = _holder_and_other(f, prompt)
+    f.state.drain_replicas(1, deadline_s=30.0, replicas=[holder])
+    assert len(f.prefix.directory) == 0
+    assert f.prefix.route_hint((_req(prompt),)) is None
+    out = _serve_on(f, other, prompt)
+    assert out["tokens"] == ref
+    assert f.prefix.counters()["prefix_remote_hits"] == 0
+
+
+def test_drain_racing_adoption_falls_back():
+    """The drain lands BETWEEN lookup and fetch (the window the
+    directory cannot close): the fetch fails on the draining body and
+    the adopter recomputes token-exact."""
+    f = _run_fleet()
+    prompt = list(range(13, 33))
+    ref = f.remote((_req(prompt),), {}).result(timeout=120)["tokens"]
+    holder, other = _holder_and_other(f, prompt)
+
+    def drain_now(ctx):
+        f.state.drain_replicas(1, deadline_s=30.0,
+                               replicas=[ctx["holder_replica"]])
+        raise RuntimeError("holder drained mid-adoption")
+
+    plan = fi.FaultPlan()
+    plan.add(fi.Rule("prefix_fetch", "script", fn=drain_now))
+    fi.install(plan)
+    out = _serve_on(f, other, prompt)
+    assert out["tokens"] == ref
+    assert f.prefix.counters()["prefix_fallback_recomputes"] == 1
+    _assert_no_block_leaks(f)
+
+
+def test_install_failure_injected_falls_back():
+    """Chaos at the prefix_install choke point: fetched bytes are
+    dropped on the floor, the adopter recomputes, nothing leaks."""
+    f = _run_fleet()
+    prompt = list(range(17, 37))
+    ref = f.remote((_req(prompt),), {}).result(timeout=120)["tokens"]
+    _, other = _holder_and_other(f, prompt)
+
+    def boom(ctx):
+        raise RuntimeError("injected install failure")
+
+    plan = fi.FaultPlan()
+    plan.add(fi.Rule("prefix_install", "script", fn=boom))
+    fi.install(plan)
+    out = _serve_on(f, other, prompt)
+    assert out["tokens"] == ref
+    assert f.prefix.counters()["prefix_remote_fetch_failures"] == 1
+    _assert_no_block_leaks(f)
+
+
+# ---------------------------------------------------- engine-level contract
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt.init_params(CFG, jax.random.PRNGKey(SEED))
+
+
+def _warm_engine(params, n_blocks=None):
+    eng = InferenceEngine(params, CFG, EngineConfig(
+        max_slots=2, kv_block_size=4, n_blocks=n_blocks))
+    eng.generate([1, 2, 3, 4, 5, 6, 7, 8, 9], max_new=4, timeout=300)
+    return eng
+
+
+def test_engine_extract_validates_generation_and_coverage(params):
+    eng = _warm_engine(params)
+    try:
+        toks = [1, 2, 3, 4, 5, 6, 7, 8]
+        out = eng.prefix_extract(toks, eng.pool.generation)
+        assert out["n_tokens"] == 8 and out["block_size"] == 4
+        assert np.shape(out["k"])[1] == 2        # two blocks
+        # stale generation is a TYPED rejection, not bytes
+        with pytest.raises(StalePrefixGeneration):
+            eng.prefix_extract(toks, eng.pool.generation + 1)
+        # a prefix the trie does not fully hold is unavailable
+        with pytest.raises(PrefixUnavailable):
+            eng.prefix_extract([91, 92, 93, 94], eng.pool.generation)
+        # unaligned asks are rejected up front
+        with pytest.raises(PrefixUnavailable):
+            eng.prefix_extract([1, 2, 3], eng.pool.generation)
+        # extraction holds no refs afterwards
+        assert eng.pool.stats()["blocks_used"] == eng.trie.cached_blocks
+    finally:
+        eng.shutdown()
+
+
+def test_engine_install_roundtrip_and_idempotence(params):
+    src = _warm_engine(params)
+    dst = InferenceEngine(params, CFG, EngineConfig(
+        max_slots=2, kv_block_size=4))
+    try:
+        toks = [1, 2, 3, 4, 5, 6, 7, 8]
+        payload = src.prefix_extract(toks, src.pool.generation)
+        r = dst.prefix_install(toks, payload)
+        assert r["installed"] == 2 and not r["already"]
+        # idempotent: a re-install adopts the existing chain
+        r2 = dst.prefix_install(toks, payload)
+        assert r2["already"]
+        assert dst.pool.stats()["blocks_used"] == dst.trie.cached_blocks
+        # the installed blocks serve a real admission hit + parity
+        out = dst.generate(toks + [9], max_new=4, timeout=300)
+        assert out == _ref_tokens(toks + [9], 4)
+        assert dst.stats()["prefix_hit_tokens"] >= 8
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+def test_engine_install_geometry_mismatch_rejected(params):
+    src = _warm_engine(params)
+    dst = InferenceEngine(params, CFG, EngineConfig(
+        max_slots=2, kv_block_size=4))
+    try:
+        toks = [1, 2, 3, 4, 5, 6, 7, 8]
+        payload = src.prefix_extract(toks, src.pool.generation)
+        bad = dict(payload)
+        bad["block_size"] = 8
+        with pytest.raises(PrefixUnavailable):
+            dst.prefix_install(toks, bad)
+        bad2 = dict(payload)
+        bad2["k"] = np.asarray(payload["k"])[:, :1]   # truncated blocks
+        with pytest.raises(PrefixUnavailable):
+            dst.prefix_install(toks, bad2)
+        assert dst.pool.n_free == dst.pool.n_blocks
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+def test_engine_install_under_block_pressure_never_preempts(params):
+    """Adoption is strictly OPPORTUNISTIC: when the receiver cannot
+    allocate the blocks (even after evicting unreferenced prefixes) it
+    raises the typed pressure error and frees what it took — it never
+    preempts real work, and the pool is bit-for-bit unchanged."""
+    src = _warm_engine(params)
+    # a 4-block pool cannot take a 6-block prefix no matter what
+    dst = InferenceEngine(params, CFG, EngineConfig(
+        max_slots=2, kv_block_size=4, n_blocks=4, max_seq=16))
+    try:
+        toks = list(range(1, 25))                    # 24 tokens, 6 blocks
+        src.generate(toks + [30], max_new=2, timeout=300)
+        payload = src.prefix_extract(toks, src.pool.generation)
+        free_before = dst.pool.n_free
+        with pytest.raises(PrefixInstallPressure):
+            dst.prefix_install(toks, payload)
+        assert dst.pool.n_free == free_before       # nothing leaked
+        assert dst.pool.stats()["blocks_used"] == dst.trie.cached_blocks
+    finally:
+        src.shutdown()
+        dst.shutdown()
+
+
+def test_engine_ops_rejected_after_shutdown(params):
+    eng = _warm_engine(params)
+    eng.shutdown()
+    from ray_tpu.inference.engine import EngineStoppedError
+    with pytest.raises((EngineStoppedError, PrefixUnavailable)):
+        eng.prefix_extract([1, 2, 3, 4], eng.pool.generation)

@@ -134,6 +134,31 @@ class TestMessages:
         assert m["objects"][0]["data"] == b"bytes"
         assert m["objects"][0]["locations"] == {"aa": "x:1"}
 
+    def test_prefix_plane_messages_roundtrip(self):
+        """The cluster-prefix wire vocabulary (prefix_publish /
+        prefix_lookup / prefix_invalidate / block_fetch) rides the
+        typed Raw envelope — pinned here so the shapes can't drift
+        silently (serve/fleet/prefix_directory.py speaks them, the
+        head and node plane answer them)."""
+        m = roundtrip({"t": "prefix_publish", "reqid": 3,
+                       "keys": ["m|" + "a" * 32, "m|" + "b" * 32],
+                       "holder": "v1#0", "n_tokens": 32,
+                       "generation": 2, "block_size": 16,
+                       "engine": "engine-7"})
+        assert m["t"] == "prefix_publish" and m["generation"] == 2
+        assert m["keys"][1].startswith("m|b") and m["block_size"] == 16
+        m = roundtrip({"t": "prefix_lookup", "reqid": 4,
+                       "keys": ["|" + "c" * 32]})
+        assert m["t"] == "prefix_lookup" and len(m["keys"]) == 1
+        m = roundtrip({"t": "prefix_invalidate", "reqid": 5,
+                       "holder": "v1#0", "stale_generation": 1})
+        assert m["t"] == "prefix_invalidate"
+        assert m["stale_generation"] == 1
+        m = roundtrip({"t": "block_fetch", "reqid": 6,
+                       "engine": "engine-7",
+                       "tokens": [1, 2, 3, 4], "generation": 0})
+        assert m["t"] == "block_fetch" and m["tokens"] == [1, 2, 3, 4]
+
     def test_empty_oneof_arm_selected(self):
         # an all-defaults message must still carry its type
         m = roundtrip({"t": "get_objects", "object_ids": []})
