@@ -1,0 +1,153 @@
+"""Elastic data plane benchmark → DATA_r19.json.
+
+Same-box, same-run A/B receipts for the streaming executor's
+back-pressure accounting (PR 19 tentpole): the SAME
+map → streaming-shuffle → map plan driven once with the legacy
+fixed-count admission (``max_in_flight=4``, byte_budget None) and once
+with the byte-derived budget (``derive_byte_budget(store_fraction)`` —
+block byte sizes vs the configured object-store capacity).
+
+The honest claim is BOUNDED MEMORY, not speed: the fixed-count arm's
+buffered bytes scale with whatever block size the pipeline happens to
+produce, while the byte arm's MAP operators peak under
+``budget + one block`` (the admit-when-empty progress block) no matter
+the block size.  The shuffle operator is the documented exception —
+its all-to-all barrier inherently holds every block's parts between
+the map and reduce phases, so its footprint is REPORTED (and shows up
+near dataset size in both arms) rather than capped.  Both arms must
+produce the identical row multiset
+(the shuffle seed is resolved at plan build).  Wall-clock ratios on a
+shared box are noise; loadavg is stamped so a loaded box is visible in
+the artifact (PERF.md box-variance caveat).
+
+Run:  python benchmarks/data_bench.py [--rows 200000] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+STORE_BYTES = 64 * 1024 * 1024
+# a deliberately tight fraction so the byte budget BINDS on this
+# dataset (2 MiB budget vs 1 MiB blocks): the A/B contrast is the
+# point, not a roomy ceiling that never admits back-pressure
+STORE_FRACTION = 1 / 32
+
+
+def _run_arm(ds, blocks, *, max_in_flight, byte_budget):
+    """Execute the plan's operator graph once; returns throughput and
+    the per-operator buffering accounting."""
+    from ray_tpu.data.execution import (StreamingExecutor,
+                                        build_operator_chain)
+    ops = build_operator_chain(ds._stages, max_in_flight=max_in_flight,
+                              byte_budget=byte_budget)
+    ex = StreamingExecutor(ops)
+    t0 = time.perf_counter()
+    rows = 0
+    checksum = 0.0
+    for blk in ex.execute(list(blocks)):
+        rows += len(blk["x"])
+        checksum += float(blk["x"].sum())
+    wall = time.perf_counter() - t0
+    stats = ex.stats()
+    return {
+        "rows": rows,
+        "checksum": round(checksum, 3),
+        "wall_s": round(wall, 3),
+        "rows_per_s": round(rows / wall, 1),
+        "peak_buffered_bytes": max(s["peak_buffered_bytes"]
+                                   for s in stats),
+        "per_operator": [{k: s[k] for k in
+                          ("operator", "outputs", "bytes_out",
+                           "peak_buffered_bytes")} for s in stats],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=2_097_152)
+    ap.add_argument("--blocks", type=int, default=16)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "DATA_r19.json"))
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.data import Dataset
+    from ray_tpu.data.execution import derive_byte_budget
+
+    ray_tpu.init(num_cpus=4, num_tpus=0, object_store_memory=STORE_BYTES)
+    try:
+        per = args.rows // args.blocks
+        blocks = [{"x": (np.arange(per, dtype=np.float64)
+                         + i * per)} for i in range(args.blocks)]
+        ds = (Dataset(blocks)
+              .map_batches(lambda b: {"x": b["x"] * 3.0})
+              .streaming_shuffle(num_partitions=args.blocks, seed=19)
+              .map_batches(lambda b: {"x": b["x"] + 1.0}))
+        # the largest block the graph moves: P == blocks keeps the
+        # reduce-side output blocks the same size as the source blocks,
+        # so "budget + one block" is the honest bound end to end
+        block_bytes = per * 8
+        budget = derive_byte_budget(STORE_FRACTION)
+
+        l0 = os.getloadavg()[0]
+        fixed = _run_arm(ds, ds._resolve_blocks(),
+                         max_in_flight=4, byte_budget=None)
+        byte = _run_arm(ds, ds._resolve_blocks(),
+                        max_in_flight=4, byte_budget=budget)
+
+        def map_peaks(arm):
+            return [o["peak_buffered_bytes"] for o in arm["per_operator"]
+                    if o["operator"].startswith("map")]
+        # the one-block term carries a 5% allowance: reduce-side merged
+        # blocks wobble around the nominal size (multinomial partition
+        # split), so "one block" is not exactly rows/P * itemsize
+        bound = budget + int(block_bytes * 1.05)
+        bounded = all(p <= bound for p in map_peaks(byte))
+        doc = {
+            "round": 19,
+            "bench": "elastic_data_plane",
+            "rows": args.rows,
+            "blocks": args.blocks,
+            "block_bytes": block_bytes,
+            "object_store_bytes": STORE_BYTES,
+            "store_fraction": STORE_FRACTION,
+            "derived_byte_budget": budget,
+            "map_peak_bound": bound,
+            "arms": {"fixed_count": fixed, "byte_budget": byte},
+            # reported, not gated (scheduler noise could flip it on a
+            # loaded box): the byte arm's worst map peak vs fixed's
+            "byte_vs_fixed_map_peak_ratio": round(
+                max(map_peaks(byte)) / max(1, max(map_peaks(fixed))), 3),
+            "gates": {
+                "row_parity": fixed["rows"] == byte["rows"] == args.rows,
+                "checksum_parity":
+                    abs(fixed["checksum"] - byte["checksum"]) < 1e-6,
+                "byte_arm_maps_bounded": bounded,
+            },
+            "loadavg_1m_before": round(l0, 2),
+            "loadavg_1m_after": round(os.getloadavg()[0], 2),
+        }
+        doc["ok"] = all(doc["gates"].values())
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(doc["gates"], indent=2))
+        print("wrote", args.out, "ok =", doc["ok"])
+        return 0 if doc["ok"] else 1
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

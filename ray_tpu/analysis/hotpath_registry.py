@@ -172,4 +172,31 @@ HOT_GATES: dict = {
             "DeploymentState._drain_chaos": "gate",
         },
     },
+    # streaming data plane: the operator graph's chaos hook
+    # (data_dispatch per block admission, data_shuffle_reduce per
+    # reducer dispatch) — one helper on the operator base class so
+    # every other executor function stays alias-free; it runs once per
+    # block, the hottest data-plane rate
+    "ray_tpu.data.execution": {
+        "aliases": ("_fi",),
+        "functions": {
+            "PhysicalOperator._chaos": "gate",
+        },
+    },
+    # trainer streamed ingest: the per-step data_dispatch point on the
+    # member-side shard iterator
+    "ray_tpu.train.ingest": {
+        "aliases": ("_fi",),
+        "functions": {
+            "DatasetShard._chaos": "gate",
+        },
+    },
+    # elastic gang: the gang_readmit choke point at the re-admission
+    # boundary (driver-side, so scripted schedules are deterministic)
+    "ray_tpu.parallel.gang": {
+        "aliases": ("_fi",),
+        "functions": {
+            "MultiHostGang._chaos": "gate",
+        },
+    },
 }

@@ -26,6 +26,14 @@ already passes through:
     deterministic "head dies mid-operation") at the N-th matching
     service message or tick, or drop the message outright.
 
+Higher layers add their own gated points on the same contract:
+serve/drain (``serve_route``, ``serve_stream``, ``replica_drain*``,
+``node_drain*``), inference (``infer_admit``, ``infer_block_alloc``,
+``infer_speculate``, ``prefix_dir_lookup``, ``prefix_fetch``,
+``prefix_install``), the streaming data plane (``data_dispatch``,
+``data_shuffle_reduce`` — see ``on_data``), and elastic gang
+membership (``gang_readmit`` — see ``on_gang``).
+
 Zero-overhead contract: when no plan is installed (the default,
 production state) every hook is a single module-global ``is None``
 check — nothing else executes on the hot path.  The acceptance gate
@@ -489,6 +497,40 @@ class FaultPlan:
         is chaos-provable like everything else
         (tests/test_paged_cache.py)."""
         self._scripted_ctx_rules(point, ctx, ctx.get("engine"))
+
+    def on_data(self, point: str, ctx: dict) -> None:
+        """Scripted triggers in the streaming data plane (gated through
+        ``data.execution.PhysicalOperator._chaos`` and the trainer's
+        ``train.ingest.DatasetShard._chaos``).  Points:
+
+          * ``data_dispatch``       — a block entered a streaming
+            operator (ctx: {"operator", "idx", "port", "nbytes"}), or
+            a trainer-side ingest shard fetched its next step batch
+            (ctx: {"shard", "rank", "step", "epoch"}).  A scripted
+            ``fn(ctx)`` can raise to fail the pipeline or the training
+            step at an EXACT block/step — the elastic-recovery path is
+            what's under test — or kill a gang member's process to
+            script a mid-epoch shrink with no wall-clock race
+          * ``data_shuffle_reduce`` — the streaming shuffle is about to
+            dispatch the merge for one partition (ctx: {"operator",
+            "partition", "num_parts"}); raising fails the shuffle at
+            the all-to-all barrier, ``delay`` simulates a straggling
+            reducer the budget accounting must absorb
+        """
+        self._scripted_ctx_rules(
+            point, ctx, ctx.get("operator") or ctx.get("shard"))
+
+    def on_gang(self, point: str, ctx: dict) -> None:
+        """Scripted triggers at gang-membership choke points (gated
+        through ``parallel.gang.MultiHostGang._chaos``).  Points:
+
+          * ``gang_readmit`` — replacement members are about to be
+            re-admitted at a re-gang boundary (ctx: {"world",
+            "target", "want"}); raising forces the readmission-failure
+            path — the elastic trainer must keep making progress at
+            the shrunken world instead of crashing
+        """
+        self._scripted_ctx_rules(point, ctx, ctx.get("world"))
 
     def on_service_tick(self, svc) -> None:
         fire = []

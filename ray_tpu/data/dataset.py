@@ -46,6 +46,50 @@ class _BlockWorker:
     def run(self, blk, idx):
         return _apply_stages(blk, self._stages, idx)
 
+    def run_sized(self, blk, idx):
+        """run() plus output metadata — dispatched with num_returns=2 so
+        the streaming executor fetches only the tiny meta dict for byte
+        accounting while the block ref flows downstream."""
+        out = _apply_stages(blk, self._stages, idx)
+        try:
+            nbytes = int(B.size_bytes(out))
+        except Exception:
+            nbytes = 0
+        return out, {"rows": int(B.num_rows(out)), "bytes": nbytes}
+
+
+class _ShuffleMarker:
+    """Stage-list marker for an in-stream all-to-all shuffle
+    (``Dataset.streaming_shuffle``).  Not callable: every execution
+    path SEGMENTS the stage list at it — the streaming executor builds
+    a ``ShuffleOperator`` (data/execution.py), inline paths run the
+    same seeded exchange via ``shuffle_blocks`` between segments — so
+    both paths produce identical rows for the seed resolved at marker
+    creation."""
+
+    def __init__(self, num_partitions: int, seed: int):
+        self.num_partitions = int(num_partitions)
+        self.seed = int(seed)
+
+    def __call__(self, *a, **k):   # pragma: no cover - guard
+        raise TypeError("_ShuffleMarker is a plan marker, not a stage; "
+                        "execution paths must segment at it")
+
+
+def _split_at_markers(stages: list) -> list:
+    """Stage list → list of marker-free segments (len == markers + 1)."""
+    segs: list = [[]]
+    for st in stages:
+        if isinstance(st, _ShuffleMarker):
+            segs.append([])
+        else:
+            segs[-1].append(st)
+    return segs
+
+
+def _markers_of(stages: list) -> list:
+    return [st for st in stages if isinstance(st, _ShuffleMarker)]
+
 
 class Dataset:
     def __init__(self, blocks: list, stages: Optional[list] = None):
@@ -457,6 +501,23 @@ class Dataset:
         return Dataset([B.slice_block(shuffled, s, s + per)
                         for s in range(0, n, per)] or [{}])
 
+    def streaming_shuffle(self, *, num_partitions: Optional[int] = None,
+                          seed: Optional[int] = None) -> "Dataset":
+        """Global random shuffle INSIDE the lazy plan (reference: the
+        all-to-all op in the streaming topology, not an eager barrier
+        like ``random_shuffle``).  Upstream stages stream into the
+        shuffle's map side under the operator budget; downstream stages
+        consume merged partitions as they reduce.  The seed (resolved
+        here, so repeated iterations and the inline fallback replay the
+        same permutation) and partition count pin the exchange: same
+        seed + same block order → identical output rows on every
+        execution path."""
+        P = int(num_partitions) if num_partitions else \
+            (len(self._blocks) or 8)
+        base = (int(np.random.SeedSequence().entropy) % (2 ** 31)
+                if seed is None else int(seed))
+        return self._with_stage(_ShuffleMarker(P, base))
+
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         """Global sort. Multi-block datasets on a live runtime use the
         distributed sample-sort (data/shuffle.py, reference:
@@ -503,6 +564,21 @@ class Dataset:
                 i += 1
             out[name] = v
         return Dataset([out])
+
+    def union_streaming(self, other: "Dataset") -> "Dataset":
+        """Lazy union that stays a streaming plan: both sides run as
+        independent operator chains feeding a ``UnionOperator`` in one
+        graph (eager ``union`` materializes both sides first).  Falls
+        back to the eager equivalent when the runtime is down."""
+        return _MultiDataset("union", self, other)
+
+    def zip_streaming(self, other: "Dataset") -> "Dataset":
+        """Lazy column-zip that stays a streaming plan: a stateful
+        row-aligning ``ZipOperator`` joins the two chains block by
+        block, so neither side is ever fully materialized.  Row order
+        and the ``_1`` name-clash rule match eager ``zip``; unequal
+        total row counts raise the same ``ValueError``."""
+        return _MultiDataset("zip", self, other)
 
     def split_at_indices(self, indices: list[int]) -> list["Dataset"]:
         full = B.concat(self._materialize())
@@ -585,21 +661,44 @@ class Dataset:
         return out
 
     def _iter_staged_blocks(self, parallelism: str = "inline",
-                            max_in_flight: int = 4) -> Iterator:
+                            max_in_flight: int = 4,
+                            byte_budget: Optional[int] = None) -> Iterator:
         """Blocks with stages applied, one at a time (streaming shape).
-        parallelism="streaming" runs stages as remote tasks with at most
-        max_in_flight blocks submitted — op-level backpressure
+        parallelism="streaming" runs stages as remote tasks with
+        op-level backpressure — at most max_in_flight blocks submitted,
+        or ``byte_budget`` buffered bytes per operator when set
         (reference: streaming_executor.py:31)."""
         if parallelism == "streaming" and self._stages:
-            from ray_tpu.data.execution import (StreamingExecutor,
-                                                build_operator_chain)
-            ops = build_operator_chain(self._stages,
-                                       max_in_flight=max_in_flight)
-            yield from StreamingExecutor(ops).execute(
-                self._resolve_blocks())
+            import ray_tpu
+            if ray_tpu.is_initialized():
+                from ray_tpu.data.execution import (StreamingExecutor,
+                                                    build_operator_chain)
+                ops = build_operator_chain(self._stages,
+                                           max_in_flight=max_in_flight,
+                                           byte_budget=byte_budget)
+                yield from StreamingExecutor(ops).execute(
+                    self._resolve_blocks())
+                return
+        segments = _split_at_markers(self._stages)
+        if len(segments) == 1:
+            for i, blk in enumerate(self._resolve_blocks()):
+                yield _apply_stages(blk, self._stages, i)
             return
-        for i, blk in enumerate(self._resolve_blocks()):
-            yield _apply_stages(blk, self._stages, i)
+        # inline fallback with in-plan shuffles: fold segment by
+        # segment, running the SAME seeded exchange between them that
+        # the streaming ShuffleOperator runs (shuffle_blocks inlines
+        # when the runtime is down) — identical rows either way
+        from ray_tpu.data.shuffle import shuffle_blocks
+        blocks = self._resolve_blocks()
+        for seg, marker in zip(segments[:-1], _markers_of(self._stages)):
+            if seg:
+                blocks = [_apply_stages(b, seg, i)
+                          for i, b in enumerate(blocks)]
+            blocks = shuffle_blocks(blocks,
+                                    num_partitions=marker.num_partitions,
+                                    seed=marker.seed)
+        for i, blk in enumerate(blocks):
+            yield _apply_stages(blk, segments[-1], i)
 
     def _materialize(self, parallelism: str = "inline",
                      num_actors: int = 2) -> list:
@@ -614,6 +713,12 @@ class Dataset:
         if parallelism == "streaming":
             return list(self._iter_staged_blocks("streaming",
                                                  max_in_flight=num_actors))
+        if parallelism in ("tasks", "actors") and _markers_of(stages):
+            # in-plan shuffles need segmented execution; the streaming
+            # graph (or its inline fallback) is the path that has it
+            import ray_tpu
+            return list(self._iter_staged_blocks(
+                "streaming" if ray_tpu.is_initialized() else "inline"))
         if parallelism == "tasks":
             import ray_tpu
             task = ray_tpu.remote(_apply_stages)
@@ -673,49 +778,45 @@ class Dataset:
                      drop_last: bool = False,
                      shuffle_seed: Optional[int] = None,
                      parallelism: str = "inline",
-                     max_in_flight: int = 4) -> Iterator[dict]:
+                     max_in_flight: int = 4,
+                     byte_budget: Optional[int] = None) -> Iterator[dict]:
         """Stream column-dict batches; stages run block-by-block
         (streaming-executor shape: no global materialization).
         parallelism="streaming" pushes stage work to remote tasks with a
         bounded in-flight window — the consumer's pace throttles
-        submission."""
-        carry: Optional[dict] = None
+        submission; ``byte_budget`` switches the operators from fixed
+        counts to byte-derived backpressure (derive_byte_budget)."""
         blocks = self._resolve_blocks()
         order = list(range(len(blocks)))
         if shuffle_seed is not None:
             np.random.default_rng(shuffle_seed).shuffle(order)
 
-        if parallelism == "streaming" and self._stages:
+        if _markers_of(self._stages):
+            # in-plan shuffle: segmented execution owns block indices
+            staged_iter = Dataset(
+                [blocks[bi] for bi in order],
+                self._stages)._iter_staged_blocks(
+                    parallelism, max_in_flight, byte_budget)
+        elif parallelism == "streaming" and self._stages:
             from ray_tpu.data.execution import (StreamingExecutor,
                                                 build_operator_chain)
             ops = build_operator_chain(self._stages,
-                                       max_in_flight=max_in_flight)
+                                       max_in_flight=max_in_flight,
+                                       byte_budget=byte_budget)
             staged_iter = StreamingExecutor(ops).execute(
                 (blocks[bi] for bi in order), indices=order)
         else:
             staged_iter = (_apply_stages(blocks[bi], self._stages, bi)
                            for bi in order)
 
-        for blk in staged_iter:
-            if carry is not None:
-                blk = B.concat([carry, blk])
-                carry = None
-            n = B.num_rows(blk)
-            s = 0
-            while n - s >= batch_size:
-                yield dict(B.to_columns(B.slice_block(blk, s,
-                                                      s + batch_size)))
-                s += batch_size
-            if s < n:
-                carry = dict(B.to_columns(B.slice_block(blk, s, n)))
-        if carry is not None and not drop_last:
-            yield carry
+        yield from _batches_from(staged_iter, batch_size, drop_last)
 
     def iter_batches_sharded(self, mesh, *, batch_size: int = 256,
                              prefetch: int = 2,
                              repeat: bool = False,
                              parallelism: str = "inline",
-                             max_in_flight: int = 4) -> Iterator:
+                             max_in_flight: int = 4,
+                             byte_budget: Optional[int] = None) -> Iterator:
         """Device-feeding iterator: each host batch is device_put with the
         mesh's batch sharding (data axes), with a prefetch depth so the
         H2D transfer of batch k+1 overlaps step k (the analogue of
@@ -732,7 +833,8 @@ class Dataset:
                 yield from self.iter_batches(batch_size=batch_size,
                                              drop_last=True,
                                              parallelism=parallelism,
-                                             max_in_flight=max_in_flight)
+                                             max_in_flight=max_in_flight,
+                                             byte_budget=byte_budget)
                 if not repeat:
                     return
 
@@ -748,4 +850,122 @@ class Dataset:
 
     def __repr__(self):
         return (f"Dataset(num_blocks={len(self._blocks)}, "
+                f"stages={len(self._stages)})")
+
+
+def _batches_from(staged_iter, batch_size: int,
+                  drop_last: bool) -> Iterator[dict]:
+    """Re-block a stream of blocks into fixed-size column-dict batches
+    (carry-over across block boundaries) — shared by every
+    iter_batches surface so single- and multi-input plans batch
+    identically."""
+    carry: Optional[dict] = None
+    for blk in staged_iter:
+        if carry is not None:
+            blk = B.concat([carry, blk])
+            carry = None
+        n = B.num_rows(blk)
+        s = 0
+        while n - s >= batch_size:
+            yield dict(B.to_columns(B.slice_block(blk, s,
+                                                  s + batch_size)))
+            s += batch_size
+        if s < n:
+            carry = dict(B.to_columns(B.slice_block(blk, s, n)))
+    if carry is not None and not drop_last:
+        yield carry
+
+
+class _MultiDataset(Dataset):
+    """Two upstream Datasets joined by a multi-input streaming operator
+    (``zip_streaming`` / ``union_streaming``), plus tail stages applied
+    to the joined stream.  With parallelism="streaming" on a live
+    runtime the whole thing is ONE operator graph — two source chains
+    feeding a Zip/UnionOperator feeding the tail — otherwise it lowers
+    to the eager equivalent (same rows, same errors)."""
+
+    def __init__(self, kind: str, left: Dataset, right: Dataset,
+                 stages: Optional[list] = None):
+        super().__init__([], stages or [])
+        self._kind = kind
+        self._left = left
+        self._right = right
+
+    def _with_stage(self, fn) -> "Dataset":
+        return _MultiDataset(self._kind, self._left, self._right,
+                             self._stages + [fn])
+
+    def _eager(self) -> Dataset:
+        joined = (self._left.zip(self._right) if self._kind == "zip"
+                  else self._left.union(self._right))
+        return Dataset(joined._blocks, joined._stages + self._stages)
+
+    def _iter_staged_blocks(self, parallelism: str = "inline",
+                            max_in_flight: int = 4,
+                            byte_budget: Optional[int] = None) -> Iterator:
+        import ray_tpu
+        if parallelism != "streaming" or not ray_tpu.is_initialized():
+            yield from self._eager()._iter_staged_blocks(
+                "inline" if parallelism == "streaming" else parallelism,
+                max_in_flight, byte_budget)
+            return
+        from ray_tpu.data import execution as X
+        if self._kind == "zip":
+            join = X.ZipOperator(max_in_flight=max_in_flight,
+                                 byte_budget=byte_budget)
+        else:
+            join = X.UnionOperator(2, max_in_flight=max_in_flight,
+                                   byte_budget=byte_budget)
+        ops: list = []
+        branch_owns = []
+        for port, side in enumerate((self._left, self._right)):
+            chain = X.build_operator_chain(side._stages,
+                                           max_in_flight=max_in_flight,
+                                           byte_budget=byte_budget)
+            branch = [X.SourceOperator(
+                enumerate(side._resolve_blocks()),
+                name=f"source[{port}]")] + chain
+            for a, b in zip(branch, branch[1:]):
+                a.connect(b)
+            branch[-1].connect(join, port=port)
+            branch_owns.append(branch[-1].owns_outputs)
+            ops.extend(branch)
+        if self._kind == "union":
+            # union passes inputs through; it only owns its outputs if
+            # every branch owned theirs (a bare source branch doesn't)
+            join.owns_outputs = all(branch_owns)
+        tail = X.build_operator_chain(self._stages,
+                                      max_in_flight=max_in_flight,
+                                      byte_budget=byte_budget)
+        prev = join
+        for t in tail:
+            prev.connect(t)
+            prev = t
+        yield from X.StreamingExecutor(ops + [join] + tail).execute_graph()
+
+    def _materialize(self, parallelism: str = "inline",
+                     num_actors: int = 2) -> list:
+        if parallelism == "streaming":
+            return list(self._iter_staged_blocks(
+                "streaming", max_in_flight=num_actors))
+        return self._eager()._materialize(parallelism, num_actors)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     drop_last: bool = False,
+                     shuffle_seed: Optional[int] = None,
+                     parallelism: str = "inline",
+                     max_in_flight: int = 4,
+                     byte_budget: Optional[int] = None) -> Iterator[dict]:
+        if shuffle_seed is not None:
+            raise ValueError("shuffle_seed is not supported on a "
+                             "zip/union streaming plan; shuffle the "
+                             "inputs (or streaming_shuffle the result)")
+        yield from _batches_from(
+            self._iter_staged_blocks(parallelism, max_in_flight,
+                                     byte_budget),
+            batch_size, drop_last)
+
+    def __repr__(self):
+        return (f"_MultiDataset(kind={self._kind!r}, "
+                f"left={self._left!r}, right={self._right!r}, "
                 f"stages={len(self._stages)})")

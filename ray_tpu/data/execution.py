@@ -3,29 +3,86 @@
 The real analogue of the reference's streaming executor
 (reference: python/ray/data/_internal/execution/streaming_executor.py:31,
 operators/map_operator.py, operators/task_pool_map_operator.py,
-operators/actor_pool_map_operator.py): a linear chain of physical
-operators, each with its OWN in-flight budget, connected by bounded
-queues.  The driver-side scheduling loop moves ready outputs downstream,
-dispatches work only into operators with both input and budget, and
-yields final blocks at the consumer's pace — so a slow consumer
-backpressures every operator transitively and the object store never
-holds more than the sum of the per-operator budgets.
+operators/actor_pool_map_operator.py): a DAG of physical operators,
+each with its OWN buffering budget, connected by bounded queues.  The
+driver-side scheduling loop moves ready outputs downstream, dispatches
+work only into operators with both input and budget, and yields final
+blocks at the consumer's pace — so a slow consumer backpressures every
+operator transitively and the object store never holds more than the
+sum of the per-operator budgets.
 
-Blocks travel between operators as ObjectRefs: a task-pool operator's
-output ref feeds the next operator's task/actor call as a plain argument
-(resolved executor-side), so intermediate blocks never surface to the
-driver.  Refs are dropped as soon as a block leaves its last operator,
-which releases store memory — datasets much larger than the store budget
-stream through it.
+Topology: each operator feeds at most ONE consumer (a tree converging
+on the sink), but an operator may expose several input PORTS —
+``ZipOperator`` / ``UnionOperator`` join two upstream chains, and
+``ShuffleOperator`` is an in-stream all-to-all barrier riding the same
+seeded kernels as ``data/shuffle.py`` (identical output for identical
+seed + input order, so eager and streaming execution can't skew a
+seeded run).
+
+Budgets come in two flavors:
+
+  * byte-derived (``byte_budget=``, see ``derive_byte_budget``): the
+    operator admits inputs while the bytes it is responsible for —
+    in-flight work, the in-order release buffer, and the ready-output
+    queue — stay under the budget, with a floor of one item so a
+    single oversized block still makes progress.  This is the capacity
+    signal the store actually enforces, and the default for the
+    elastic ingest path.
+  * legacy fixed counts (``max_in_flight=``, byte_budget None): kept
+    for callers that tuned block counts.  Both flavors charge the
+    reorder buffer against admission, so one straggler task parks at
+    most a budget's worth of completed blocks, never an epoch
+    (the pre-r19 ``_OrderedOut`` was unbounded).
+
+Blocks travel between operators as ObjectRefs with their exact byte
+size piggybacked (map tasks return ``(block, meta)`` in two store
+slots; the driver fetches only the tiny meta).  Refs are dropped as
+soon as a block leaves its last operator, which releases store memory
+— datasets much larger than the store budget stream through it.  The
+executor logs a per-operator buffer snapshot (where every byte is
+parked) on a coarse cadence via the ``ray_tpu.data`` logger.
+
+Chaos: ``PhysicalOperator._chaos`` gates the ``data_dispatch`` /
+``data_shuffle_reduce`` points (zero-overhead when the plane is
+disarmed — one global load + is-None branch, pinned by
+analysis/hotpath_registry.py like the serve points).
 """
 
 from __future__ import annotations
 
 import heapq
+import logging
 import time
 from typing import Any, Callable, Iterator, Optional
 
-from ray_tpu.data.dataset import _apply_stages, _BlockWorker
+import numpy as np
+
+from ray_tpu.core import fault_injection as _fi
+from ray_tpu.data import block as B
+from ray_tpu.data.dataset import (_apply_stages, _BlockWorker,
+                                  _ShuffleMarker)
+from ray_tpu.data.shuffle import _merge_shuffled, _split_random
+
+logger = logging.getLogger("ray_tpu.data")
+
+# sentinel: a completed slot that produced no block (empty zip prefix,
+# empty shuffle partition) — consumes its sequence number so in-order
+# release keeps moving, but is never emitted downstream
+_SKIP = object()
+
+
+def derive_byte_budget(store_fraction: float = 0.25) -> int:
+    """Per-operator buffering budget derived from the node's object
+    store capacity instead of a guessed block count.  ``store_fraction``
+    is the slice of the store one operator may pin; the default quarter
+    keeps a three-operator chain plus the consumer inside capacity."""
+    store = 2 << 30
+    try:
+        from ray_tpu._config import get_config
+        store = int(get_config().object_store_memory) or store
+    except Exception:
+        pass
+    return max(1 << 20, int(store * float(store_fraction)))
 
 
 def _free_now(payload) -> None:
@@ -43,81 +100,221 @@ def _free_now(payload) -> None:
             pass
 
 
+def _size_of(blk) -> int:
+    try:
+        return int(B.size_bytes(blk))
+    except Exception:
+        return 0
+
+
+def _payload_bytes(payload) -> int:
+    from ray_tpu.core.object_ref import ObjectRef
+    return 0 if isinstance(payload, ObjectRef) else _size_of(payload)
+
+
+def _apply_stages_sized(blk, stages, idx: int):
+    """``_apply_stages`` plus exact output metadata.  Dispatched with
+    ``num_returns=2`` so the block and the tiny meta dict land in
+    separate store slots: the driver fetches only the meta for byte
+    accounting while the block ref flows downstream unresolved."""
+    out = _apply_stages(blk, stages, idx)
+    return out, {"rows": int(B.num_rows(out)), "bytes": _size_of(out)}
+
+
+def _split_sized(blk, P: int, seed: int, block_index: int):
+    """Map side of the streaming shuffle: the eager exchange's seeded
+    ``_split_random`` with a per-part byte report appended as the last
+    of P+1 returns."""
+    parts = _split_random(blk, P, seed, block_index)
+    if P == 1:
+        parts = (parts,)
+    meta = {"rows": int(sum(B.num_rows(p) for p in parts)),
+            "part_bytes": [_size_of(p) for p in parts]}
+    return (*parts, meta)
+
+
+def _merge_shuffled_sized(*parts, seed: int = 0):
+    out = _merge_shuffled(*parts, seed=seed)
+    return out, {"rows": int(B.num_rows(out)), "bytes": _size_of(out)}
+
+
 class _OrderedOut:
     """Release completed items in input order (head-of-line buffering —
     keeps execution deterministic for index-seeded stages and batch
-    carry; the reference's preserve_order option)."""
+    carry; the reference's preserve_order option).
+
+    Tracks the count AND bytes it is holding: a straggler at sequence k
+    parks every later completion here, so operator admission charges
+    this buffer against the budget — one slow task can stall intake,
+    it can no longer buffer an epoch of blocks."""
 
     def __init__(self):
         self._heap: list = []
         self._next = 0
+        self.buffered = 0
+        self.buffered_bytes = 0
 
-    def put(self, seq: int, item) -> None:
-        heapq.heappush(self._heap, (seq, item))
+    def put(self, seq: int, item, nbytes: int = 0) -> None:
+        heapq.heappush(self._heap, (seq, nbytes, item))
+        self.buffered += 1
+        self.buffered_bytes += nbytes
 
     def pop_ready(self) -> list:
         out = []
         while self._heap and self._heap[0][0] == self._next:
-            out.append(heapq.heappop(self._heap)[1])
+            _seq, nbytes, item = heapq.heappop(self._heap)
+            self.buffered -= 1
+            self.buffered_bytes -= nbytes
+            out.append((item, nbytes))
             self._next += 1
         return out
 
 
 class PhysicalOperator:
-    """One stage of the streaming graph.  Subclasses implement dispatch
-    over the core runtime; the executor only sees queues + budgets."""
+    """One node of the streaming graph.  Subclasses implement dispatch
+    over the core runtime; the executor only sees queues + budgets.
 
-    def __init__(self, name: str, max_in_flight: int = 4):
+    Multi-input operators raise ``num_ports``; the executor wires
+    upstream operators to (consumer, port) pairs via ``connect`` and
+    closes each port independently with ``inputs_done(port)``."""
+
+    def __init__(self, name: str, max_in_flight: int = 4,
+                 byte_budget: Optional[int] = None):
         self.name = name
         self.max_in_flight = max(1, max_in_flight)
-        self.outqueue: list = []           # ready (idx, payload) tuples
+        self.byte_budget = byte_budget
+        self.outqueue: list = []       # ready (idx, payload, nbytes)
+        self.outqueue_bytes = 0
+        self.bytes_in_flight = 0
         self._ordered = _OrderedOut()
         self._seq = 0
-        self._inputs_done = False
+        self._out_auto = 0             # auto index for idx=None emits
+        self.num_ports = 1
+        self._ports_done: set = set()
+        self.downstream: Optional[tuple] = None   # (consumer, port)
+        self.owns_outputs = True       # outputs are pipeline-owned refs
         self.stats = {"inputs": 0, "outputs": 0, "submitted": 0,
-                      "peak_in_flight": 0, "wall_s": 0.0}
+                      "peak_in_flight": 0, "bytes_in": 0, "bytes_out": 0,
+                      "peak_buffered_bytes": 0, "wall_s": 0.0}
         self._t0 = time.perf_counter()
+
+    # -- wiring
+
+    def connect(self, consumer: "PhysicalOperator",
+                port: int = 0) -> "PhysicalOperator":
+        self.downstream = (consumer, port)
+        return consumer
 
     # -- executor-facing surface
 
-    def can_accept(self) -> bool:
-        """Backpressure: bounded in-flight AND bounded ready-output."""
-        return (self.in_flight() < self.max_in_flight
+    def buffered_bytes(self) -> int:
+        """Bytes this operator is currently responsible for."""
+        return (self.bytes_in_flight + self._ordered.buffered_bytes
+                + self.outqueue_bytes)
+
+    def buffered_count(self) -> int:
+        return (self.in_flight() + self._ordered.buffered
+                + len(self.outqueue))
+
+    def can_accept(self, port: int = 0) -> bool:
+        """Backpressure: byte budget when configured (floor of one item
+        so a single oversized block still progresses), legacy fixed
+        counts otherwise.  Both charge the reorder buffer."""
+        if self.byte_budget is not None:
+            if self.buffered_count() == 0:
+                return True
+            return self.buffered_bytes() < self.byte_budget
+        return (self.in_flight() + self._ordered.buffered
+                < self.max_in_flight
                 and len(self.outqueue) < self.max_in_flight)
 
-    def add_input(self, idx: int, payload, owned: bool = False) -> None:
+    def add_input(self, idx: int, payload, owned: bool = False,
+                  port: int = 0, nbytes: Optional[int] = None) -> None:
         """owned=True marks a ref PRODUCED by this pipeline (safe to free
         once consumed); source refs belong to the Dataset and must
-        survive re-iteration."""
+        survive re-iteration.  ``nbytes`` is the producer-reported block
+        size (driver-side blocks are measured here)."""
+        if nbytes is None:
+            nbytes = _payload_bytes(payload)
         self.stats["inputs"] += 1
-        self._dispatch(self._seq, idx, payload, owned)
+        self.stats["bytes_in"] += nbytes
+        self._chaos("data_dispatch", idx=idx, port=port, nbytes=nbytes)
+        self._dispatch(self._seq, idx, payload, owned, port, nbytes)
         self._seq += 1
         self.stats["submitted"] += 1
         self.stats["peak_in_flight"] = max(self.stats["peak_in_flight"],
                                            self.in_flight())
+        self._note_peak()
 
-    def inputs_done(self) -> None:
-        self._inputs_done = True
+    def inputs_done(self, port: int = 0) -> None:
+        self._ports_done.add(port)
+        if self.all_inputs_done():
+            self._on_inputs_done()
+
+    def port_done(self, port: int = 0) -> bool:
+        return port in self._ports_done
+
+    def all_inputs_done(self) -> bool:
+        return len(self._ports_done) >= self.num_ports
 
     def has_next(self) -> bool:
         return bool(self.outqueue)
 
     def get_next(self):
         self.stats["outputs"] += 1
-        return self.outqueue.pop(0)
+        idx, payload, nbytes = self.outqueue.pop(0)
+        self.outqueue_bytes -= nbytes
+        self.stats["bytes_out"] += nbytes
+        return idx, payload, nbytes
 
     def completed(self) -> bool:
-        done = (self._inputs_done and self.in_flight() == 0
-                and not self.outqueue)
-        if done:
+        done = (self.all_inputs_done() and self.in_flight() == 0
+                and not self.outqueue and self._ordered.buffered == 0)
+        if done and not self.stats["wall_s"]:
             self.stats["wall_s"] = round(time.perf_counter() - self._t0, 3)
         return done
 
-    def _complete(self, seq: int, idx: int, payload) -> None:
-        self._ordered.put(seq, (idx, payload))
-        self.outqueue.extend(self._ordered.pop_ready())
+    def snapshot(self) -> dict:
+        """Where this operator's bytes are parked right now (the
+        log()-visible accounting surface)."""
+        return {"operator": self.name,
+                "in_flight": self.in_flight(),
+                "in_flight_bytes": self.bytes_in_flight,
+                "reorder_bytes": self._ordered.buffered_bytes,
+                "outqueue_bytes": self.outqueue_bytes}
 
-    # -- subclass surface
+    def _note_peak(self) -> None:
+        self.stats["peak_buffered_bytes"] = max(
+            self.stats["peak_buffered_bytes"], self.buffered_bytes())
+
+    def _complete(self, seq: int, idx: Optional[int], payload,
+                  nbytes: int = 0) -> None:
+        self._ordered.put(seq, (idx, payload), nbytes)
+        for (item, nb) in self._ordered.pop_ready():
+            i, p = item
+            if p is _SKIP:
+                continue
+            if i is None:
+                i = self._out_auto
+                self._out_auto += 1
+            self.outqueue.append((i, p, nb))
+            self.outqueue_bytes += nb
+        self._note_peak()
+
+    def _chaos(self, point: str, **ctx) -> Optional[dict]:
+        """Chaos-plane trigger (hotpath_registry contract: disarmed =
+        one global load + is-None branch)."""
+        fi = _fi._active
+        if fi is None:
+            return None
+        ctx["operator"] = self.name
+        fi.on_data(point, ctx)
+        return ctx
+
+    # -- hooks / subclass surface
+
+    def _on_inputs_done(self) -> None:
+        """Subclass hook: the last input port just closed."""
 
     def in_flight(self) -> int:
         raise NotImplementedError
@@ -129,24 +326,67 @@ class PhysicalOperator:
         """Collect finished work without blocking."""
         raise NotImplementedError
 
-    def _dispatch(self, seq: int, idx: int, payload, owned: bool) -> None:
+    def _dispatch(self, seq: int, idx: int, payload, owned: bool,
+                  port: int, nbytes: int) -> None:
         raise NotImplementedError
 
     def shutdown(self) -> None:
         pass
 
 
+class SourceOperator(PhysicalOperator):
+    """Feeds driver-side blocks into the graph lazily: one item is
+    pulled from the source iterator only when queried, and the executor
+    only queries when the consumer has budget — so a slow pipeline
+    never materializes the source ahead of need."""
+
+    def __init__(self, items, name: str = "source"):
+        super().__init__(name, max_in_flight=1)
+        self._it = iter(items)
+        self._exhausted = False
+        self.owns_outputs = False    # source blocks belong to the Dataset
+        self.inputs_done()           # no upstream port to wait for
+
+    def in_flight(self) -> int:
+        return 0
+
+    def in_flight_refs(self) -> list:
+        return []
+
+    def poll(self) -> None:
+        pass
+
+    def has_next(self) -> bool:
+        if not self.outqueue and not self._exhausted:
+            try:
+                idx, blk = next(self._it)
+            except StopIteration:
+                self._exhausted = True
+            else:
+                nb = _payload_bytes(blk)
+                self.outqueue.append((idx, blk, nb))
+                self.outqueue_bytes += nb
+                self.stats["inputs"] += 1
+        return bool(self.outqueue)
+
+    def completed(self) -> bool:
+        return self._exhausted and not self.outqueue
+
+
 class TaskMapOperator(PhysicalOperator):
     """Stage group executed as stateless remote tasks (reference:
-    task_pool_map_operator.py)."""
+    task_pool_map_operator.py).  Tasks return ``(block, meta)`` in two
+    store slots; only the meta is fetched driver-side."""
 
     def __init__(self, stages: list, max_in_flight: int = 4,
+                 byte_budget: Optional[int] = None,
                  name: str = "map(tasks)"):
-        super().__init__(name, max_in_flight)
+        super().__init__(name, max_in_flight, byte_budget)
         self._stages = stages
-        self._pending: dict = {}    # ref -> (seq, idx)
+        self._pending: dict = {}    # block ref -> pending tuple
         import ray_tpu
-        self._task = ray_tpu.remote(_apply_stages)
+        self._task = ray_tpu.remote(_apply_stages_sized).options(
+            num_returns=2)
 
     def in_flight(self) -> int:
         return len(self._pending)
@@ -154,9 +394,11 @@ class TaskMapOperator(PhysicalOperator):
     def in_flight_refs(self) -> list:
         return list(self._pending)
 
-    def _dispatch(self, seq: int, idx: int, payload, owned: bool) -> None:
-        ref = self._task.remote(payload, self._stages, idx)
-        self._pending[ref] = (seq, idx, payload if owned else None)
+    def _dispatch(self, seq, idx, payload, owned, port, nbytes):
+        blk_ref, meta_ref = self._task.remote(payload, self._stages, idx)
+        self._pending[blk_ref] = (seq, idx, payload if owned else None,
+                                  meta_ref, nbytes)
+        self.bytes_in_flight += nbytes
 
     def poll(self) -> None:
         if not self._pending:
@@ -165,10 +407,18 @@ class TaskMapOperator(PhysicalOperator):
         ready, _ = ray_tpu.wait(list(self._pending),
                                 num_returns=len(self._pending), timeout=0)
         for ref in ready:
-            seq, idx, consumed = self._pending.pop(ref)
+            seq, idx, consumed, meta_ref, est = self._pending.pop(ref)
+            self.bytes_in_flight -= est
             _free_now(consumed)
+            try:
+                meta = ray_tpu.get(meta_ref, timeout=60)
+            except Exception:
+                # the task failed; the error rides the block ref and
+                # surfaces at the consumer's resolve
+                meta = {"bytes": est}
+            _free_now(meta_ref)
             # pass the REF downstream: the block stays in the store
-            self._complete(seq, idx, ref)
+            self._complete(seq, idx, ref, int(meta.get("bytes") or 0))
 
 
 class ActorPoolMapOperator(PhysicalOperator):
@@ -177,14 +427,16 @@ class ActorPoolMapOperator(PhysicalOperator):
 
     def __init__(self, stages: list, pool_size: int = 2,
                  max_tasks_per_actor: int = 2,
+                 byte_budget: Optional[int] = None,
                  name: str = "map(actors)"):
-        super().__init__(name, pool_size * max_tasks_per_actor)
+        super().__init__(name, pool_size * max_tasks_per_actor,
+                         byte_budget)
         self._stages = stages
         self._pool_size = max(1, pool_size)
         self._per_actor = max(1, max_tasks_per_actor)
         self._actors: list = []
         self._load: dict = {}       # actor index -> in-flight count
-        self._pending: dict = {}    # ref -> (seq, idx, actor_index)
+        self._pending: dict = {}    # block ref -> pending tuple
 
     def _ensure_pool(self) -> None:
         if self._actors:
@@ -201,12 +453,15 @@ class ActorPoolMapOperator(PhysicalOperator):
     def in_flight_refs(self) -> list:
         return list(self._pending)
 
-    def _dispatch(self, seq: int, idx: int, payload, owned: bool) -> None:
+    def _dispatch(self, seq, idx, payload, owned, port, nbytes):
         self._ensure_pool()
         ai = min(self._load, key=self._load.get)
-        ref = self._actors[ai].run.remote(payload, idx)
+        blk_ref, meta_ref = self._actors[ai].run_sized.options(
+            num_returns=2).remote(payload, idx)
         self._load[ai] += 1
-        self._pending[ref] = (seq, idx, ai, payload if owned else None)
+        self._pending[blk_ref] = (seq, idx, ai, payload if owned else None,
+                                  meta_ref, nbytes)
+        self.bytes_in_flight += nbytes
 
     def poll(self) -> None:
         if not self._pending:
@@ -215,10 +470,16 @@ class ActorPoolMapOperator(PhysicalOperator):
         ready, _ = ray_tpu.wait(list(self._pending),
                                 num_returns=len(self._pending), timeout=0)
         for ref in ready:
-            seq, idx, ai, consumed = self._pending.pop(ref)
+            seq, idx, ai, consumed, meta_ref, est = self._pending.pop(ref)
             self._load[ai] -= 1
+            self.bytes_in_flight -= est
             _free_now(consumed)
-            self._complete(seq, idx, ref)
+            try:
+                meta = ray_tpu.get(meta_ref, timeout=60)
+            except Exception:
+                meta = {"bytes": est}
+            _free_now(meta_ref)
+            self._complete(seq, idx, ref, int(meta.get("bytes") or 0))
 
     def shutdown(self) -> None:
         import ray_tpu
@@ -230,67 +491,430 @@ class ActorPoolMapOperator(PhysicalOperator):
         self._actors = []
 
 
+class UnionOperator(PhysicalOperator):
+    """Streaming ordered concat of N input ports: port 0's stream
+    passes through as it arrives; a later port's blocks park here
+    (budget-bounded via ``can_accept``) until every earlier port
+    completes, preserving the eager ``Dataset.union`` block order.  No
+    remote work — refs pass through unresolved.  ``owns_outputs`` must
+    be set by the graph builder to the AND of the upstream flags, since
+    outputs are whatever the inputs were."""
+
+    def __init__(self, num_inputs: int = 2, max_in_flight: int = 4,
+                 byte_budget: Optional[int] = None, name: str = "union"):
+        super().__init__(name, max_in_flight, byte_budget)
+        self.num_ports = max(2, int(num_inputs))
+        self._emit_port = 0
+        self._buf: dict = {p: [] for p in range(1, self.num_ports)}
+        self._buf_bytes = 0
+
+    def in_flight(self) -> int:
+        return 0
+
+    def in_flight_refs(self) -> list:
+        return []
+
+    def poll(self) -> None:
+        self._advance()
+
+    def buffered_bytes(self) -> int:
+        return self._buf_bytes + self.outqueue_bytes
+
+    def buffered_count(self) -> int:
+        return (len(self.outqueue)
+                + sum(len(b) for b in self._buf.values()))
+
+    def can_accept(self, port: int = 0) -> bool:
+        if port <= self._emit_port:
+            if self.byte_budget is not None:
+                return (not self.outqueue
+                        or self.outqueue_bytes < self.byte_budget)
+            return len(self.outqueue) < self.max_in_flight
+        # not this port's turn yet: bounded parking
+        if self.byte_budget is not None:
+            return (not self._buf[port]
+                    or self.buffered_bytes() < self.byte_budget)
+        return len(self._buf[port]) < self.max_in_flight
+
+    def _dispatch(self, seq, idx, payload, owned, port, nbytes):
+        if port <= self._emit_port:
+            self._emit(payload, nbytes)
+        else:
+            self._buf[port].append((payload, nbytes))
+            self._buf_bytes += nbytes
+        self._note_peak()
+
+    def _emit(self, payload, nbytes) -> None:
+        self.outqueue.append((self._out_auto, payload, nbytes))
+        self._out_auto += 1
+        self.outqueue_bytes += nbytes
+
+    def inputs_done(self, port: int = 0) -> None:
+        super().inputs_done(port)
+        self._advance()
+
+    def _advance(self) -> None:
+        while (self._emit_port in self._ports_done
+               and self._emit_port + 1 < self.num_ports):
+            self._emit_port += 1
+            for payload, nbytes in self._buf.pop(self._emit_port, []):
+                self._buf_bytes -= nbytes
+                self._emit(payload, nbytes)
+
+    def completed(self) -> bool:
+        return (self.all_inputs_done() and not self.outqueue
+                and not any(self._buf.values()))
+
+    def snapshot(self) -> dict:
+        s = super().snapshot()
+        s["parked_bytes"] = self._buf_bytes
+        return s
+
+
+class _ZipWorker:
+    """Stateful row-aligner for the streaming zip: carries the
+    unconsumed row tail of each side and emits the aligned prefix on
+    every push.  Clashing right-side column names get the same ``_1``
+    suffix as eager ``Dataset.zip``."""
+
+    def __init__(self):
+        self._carry = [None, None]
+
+    def push(self, side: int, blk):
+        cols = dict(B.to_columns(blk))
+        prev = self._carry[side]
+        if prev is None or B.num_rows(prev) == 0:
+            merged = cols
+        elif B.num_rows(cols) == 0:
+            merged = prev
+        else:
+            merged = dict(B.to_columns(B.concat([prev, cols])))
+        self._carry[side] = merged
+        a, b = self._carry
+        n = (min(B.num_rows(a), B.num_rows(b))
+             if a is not None and b is not None else 0)
+        if n == 0:
+            return {}, {"rows": 0, "bytes": 0}
+        out = dict(B.to_columns(B.slice_block(a, 0, n)))
+        for k, v in dict(B.to_columns(B.slice_block(b, 0, n))).items():
+            name, i = k, 1
+            while name in out:
+                name = f"{k}_{i}"
+                i += 1
+            out[name] = v
+        self._carry = [dict(B.to_columns(B.slice_block(a, n,
+                                                       B.num_rows(a)))),
+                       dict(B.to_columns(B.slice_block(b, n,
+                                                       B.num_rows(b))))]
+        return out, {"rows": n, "bytes": _size_of(out)}
+
+    def leftovers(self):
+        a, b = self._carry
+        return (0 if a is None else int(B.num_rows(a)),
+                0 if b is None else int(B.num_rows(b)))
+
+
+class ZipOperator(PhysicalOperator):
+    """Streaming column-zip of two in-order input streams.  One
+    stateful ``_ZipWorker`` actor owns the row-carry state; its pushes
+    execute in submission order (actor semantics), so the emitted ROW
+    stream is deterministic no matter how the two sides interleave —
+    block boundaries are not, so apply index-seeded stages before the
+    zip, not after.  Mismatched total row counts raise ``ValueError``
+    exactly like eager ``Dataset.zip``."""
+
+    def __init__(self, max_in_flight: int = 4,
+                 byte_budget: Optional[int] = None, name: str = "zip"):
+        super().__init__(name, max_in_flight, byte_budget)
+        self.num_ports = 2
+        self._worker = None
+        self._pending: dict = {}    # block ref -> pending tuple
+        self._accepted = {0: 0, 1: 0}
+        self._checked = False
+
+    def _ensure_worker(self):
+        if self._worker is None:
+            import ray_tpu
+            self._worker = ray_tpu.remote(_ZipWorker).remote()
+        return self._worker
+
+    def can_accept(self, port: int = 0) -> bool:
+        if not super().can_accept(port):
+            return False
+        # per-port fairness: rows only align once BOTH sides delivered
+        # them, so don't let one side monopolize the budget — unless
+        # the other side already finished.
+        other = 1 - port
+        if other in self._ports_done:
+            return True
+        return (self._accepted[port] - self._accepted[other]
+                < max(2, self.max_in_flight))
+
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    def in_flight_refs(self) -> list:
+        return list(self._pending)
+
+    def _dispatch(self, seq, idx, payload, owned, port, nbytes):
+        w = self._ensure_worker()
+        blk_ref, meta_ref = w.push.options(num_returns=2).remote(
+            port, payload)
+        self._accepted[port] += 1
+        self._pending[blk_ref] = (seq, payload if owned else None,
+                                  meta_ref, nbytes)
+        self.bytes_in_flight += nbytes
+
+    def poll(self) -> None:
+        if not self._pending:
+            return
+        import ray_tpu
+        ready, _ = ray_tpu.wait(list(self._pending),
+                                num_returns=len(self._pending), timeout=0)
+        for ref in ready:
+            seq, consumed, meta_ref, est = self._pending.pop(ref)
+            self.bytes_in_flight -= est
+            _free_now(consumed)
+            try:
+                meta = ray_tpu.get(meta_ref, timeout=60)
+            except Exception:
+                meta = {"rows": 1, "bytes": est}   # error rides the ref
+            _free_now(meta_ref)
+            if not meta.get("rows"):
+                _free_now(ref)
+                self._complete(seq, None, _SKIP, 0)
+            else:
+                self._complete(seq, None, ref,
+                               int(meta.get("bytes") or 0))
+
+    def completed(self) -> bool:
+        done = super().completed()
+        if done and not self._checked:
+            self._checked = True
+            if self._worker is not None:
+                import ray_tpu
+                la, lb = ray_tpu.get(self._worker.leftovers.remote(),
+                                     timeout=60)
+                if la or lb:
+                    raise ValueError(
+                        "zip requires equal row counts (unmatched rows:"
+                        f" left={la}, right={lb})")
+        return done
+
+    def shutdown(self) -> None:
+        if self._worker is not None:
+            import ray_tpu
+            try:
+                ray_tpu.kill(self._worker)
+            except Exception:
+                pass
+            self._worker = None
+
+
+class ShuffleOperator(PhysicalOperator):
+    """Streaming all-to-all shuffle: map-side partition (sized
+    ``num_returns=P+1`` split tasks riding ``data/shuffle.py``'s seeded
+    kernels) → reduce-side merge dispatched once the last input's parts
+    land.  Output rows are IDENTICAL to the eager ``shuffle_blocks``
+    exchange for the same seed and input order (same per-mapper part
+    ordering, same per-partition reducer seeds, empty partitions
+    dropped), so eager and streaming execution of a seeded plan agree.
+
+    The map side honors the operator budget; the partition buffer —
+    every block's P parts awaiting the all-to-all barrier — inherently
+    holds the dataset between phases, so that footprint is REPORTED
+    (``snapshot()["part_bytes"]``) rather than capped.  Chaos:
+    ``data_shuffle_reduce`` fires per reducer dispatch."""
+
+    def __init__(self, num_partitions: int = 8,
+                 seed: Optional[int] = None, max_in_flight: int = 4,
+                 byte_budget: Optional[int] = None,
+                 name: Optional[str] = None):
+        P = max(1, int(num_partitions))
+        super().__init__(name or f"shuffle(P={P})", max_in_flight,
+                         byte_budget)
+        self._P = P
+        self._seed = (int(np.random.SeedSequence().entropy) % (2 ** 31)
+                      if seed is None else int(seed))
+        self._map_pending: dict = {}     # meta ref -> (seq, parts, ...)
+        self._reduce_pending: dict = {}  # block ref -> (p, meta ref)
+        self._parts: dict = {}           # map seq -> [P part refs]
+        self._order: list = []
+        self._part_bytes = 0
+        self._reduced = False
+        import ray_tpu
+        self._mapper = ray_tpu.remote(_split_sized).options(
+            num_returns=P + 1)
+        self._reducer = ray_tpu.remote(_merge_shuffled_sized).options(
+            num_returns=2)
+
+    def in_flight(self) -> int:
+        return len(self._map_pending) + len(self._reduce_pending)
+
+    def in_flight_refs(self) -> list:
+        return list(self._map_pending) + list(self._reduce_pending)
+
+    def _dispatch(self, seq, idx, payload, owned, port, nbytes):
+        # seq is the arrival position — the eager exchange's block
+        # index, which seeds the per-block split rng
+        refs = self._mapper.remote(payload, self._P, self._seed, seq)
+        parts, meta_ref = list(refs[:-1]), refs[-1]
+        self._map_pending[meta_ref] = (seq, parts,
+                                       payload if owned else None, nbytes)
+        self.bytes_in_flight += nbytes
+
+    def poll(self) -> None:
+        import ray_tpu
+        if self._map_pending:
+            ready, _ = ray_tpu.wait(list(self._map_pending),
+                                    num_returns=len(self._map_pending),
+                                    timeout=0)
+            for mref in ready:
+                seq, parts, consumed, est = self._map_pending.pop(mref)
+                self.bytes_in_flight -= est
+                _free_now(consumed)
+                try:
+                    meta = ray_tpu.get(mref, timeout=60)
+                    self._part_bytes += int(
+                        sum(meta.get("part_bytes", [])))
+                except Exception:
+                    pass   # the error rides the part refs into reduce
+                _free_now(mref)
+                self._parts[seq] = parts
+        if (self.all_inputs_done() and not self._map_pending
+                and not self._reduced):
+            self._dispatch_reducers()
+        if self._reduce_pending:
+            ready, _ = ray_tpu.wait(list(self._reduce_pending),
+                                    num_returns=len(self._reduce_pending),
+                                    timeout=0)
+            for bref in ready:
+                p, meta_ref = self._reduce_pending.pop(bref)
+                try:
+                    meta = ray_tpu.get(meta_ref, timeout=60)
+                except Exception:
+                    meta = {"rows": 1, "bytes": 0}  # error rides the ref
+                _free_now(meta_ref)
+                for s in self._order:
+                    _free_now(self._parts[s][p])
+                if not meta.get("rows"):
+                    # drop empty partitions, matching shuffle_blocks
+                    _free_now(bref)
+                    self._complete(p, None, _SKIP, 0)
+                else:
+                    self._complete(p, None, bref,
+                                   int(meta.get("bytes") or 0))
+
+    def _dispatch_reducers(self) -> None:
+        self._reduced = True
+        self._order = sorted(self._parts)
+        self.stats["part_bytes"] = self._part_bytes
+        if not self._order:
+            return
+        for p in range(self._P):
+            self._chaos("data_shuffle_reduce", partition=p,
+                        num_parts=len(self._order))
+            blk_ref, meta_ref = self._reducer.remote(
+                *[self._parts[s][p] for s in self._order],
+                seed=self._seed + 1000 + p)
+            self._reduce_pending[blk_ref] = (p, meta_ref)
+
+    def completed(self) -> bool:
+        if not self._reduced:
+            return False
+        return super().completed()
+
+    def snapshot(self) -> dict:
+        s = super().snapshot()
+        s["part_bytes"] = self._part_bytes
+        return s
+
+
 class StreamingExecutor:
-    """Drives an operator chain over an input block iterator.
+    """Drives an operator DAG.
 
     Pull-based: the consumer's next() powers one scheduling round —
-    move outputs downstream where the next operator has budget, dispatch
-    inputs, yield what reaches the end.  When nothing is ready, block on
-    the union of all operators' in-flight refs (no busy spin)."""
+    move outputs downstream where the consumer has budget, dispatch
+    inputs, yield what reaches the sink.  When nothing is ready, block
+    on the union of all operators' in-flight refs (no busy spin).
 
-    def __init__(self, operators: list, get_timeout: float = 600.0):
+    ``execute(blocks)`` keeps the legacy linear-chain surface (an
+    implicit SourceOperator feeds the constructor's operator list);
+    ``execute_graph()`` runs a pre-wired DAG whose sources are
+    SourceOperators and whose last operator is the sink."""
+
+    def __init__(self, operators: list, get_timeout: float = 600.0,
+                 log_every_s: float = 5.0):
         assert operators, "need at least one operator"
         self.operators = operators
         self.get_timeout = get_timeout
+        self.log_every_s = log_every_s
 
     def stats(self) -> list:
         return [{"operator": op.name, **op.stats} for op in self.operators]
 
+    def snapshot(self) -> list:
+        """Per-operator accounting of what is buffered where."""
+        return [op.snapshot() for op in self.operators]
+
     def execute(self, blocks, indices=None) -> Iterator:
+        src = SourceOperator(zip(indices, blocks) if indices is not None
+                             else enumerate(blocks))
+        ops = [src] + list(self.operators)
+        for a, b in zip(ops, ops[1:]):
+            if a.downstream is None:
+                a.connect(b)
+        return self._run(ops)
+
+    def execute_graph(self) -> Iterator:
+        return self._run(list(self.operators))
+
+    def _run(self, ops: list) -> Iterator:
         import ray_tpu
-        ops = self.operators
-        it = iter(zip(indices, blocks) if indices is not None
-                  else enumerate(blocks))
-        src_exhausted = False
+        sink = ops[-1]
+        assert sink.downstream is None, "last operator must be the sink"
+        last_log = time.perf_counter()
         try:
             while True:
                 progressed = False
                 for op in ops:
                     op.poll()
-                # move data downstream (last hop first so freed budget
-                # propagates upstream within one round)
-                for i in range(len(ops) - 2, -1, -1):
-                    while ops[i].has_next() and ops[i + 1].can_accept():
-                        idx, payload = ops[i].get_next()
-                        ops[i + 1].add_input(idx, payload, owned=True)
+                # move data downstream (downstream-first so freed
+                # budget propagates upstream within one round);
+                # ``can_accept`` is checked BEFORE ``has_next`` so lazy
+                # sources don't pull ahead of the consumer's budget
+                for op in reversed(ops):
+                    if op.downstream is None:
+                        continue
+                    consumer, port = op.downstream
+                    while consumer.can_accept(port) and op.has_next():
+                        idx, payload, nbytes = op.get_next()
+                        consumer.add_input(idx, payload,
+                                           owned=op.owns_outputs,
+                                           port=port, nbytes=nbytes)
                         progressed = True
-                    if ops[i].completed() and not ops[i + 1]._inputs_done:
-                        ops[i + 1].inputs_done()
+                    if op.completed() and not consumer.port_done(port):
+                        consumer.inputs_done(port)
                         progressed = True
-                # feed the head operator from the (lazy) source
-                while not src_exhausted and ops[0].can_accept():
-                    try:
-                        idx, blk = next(it)
-                    except StopIteration:
-                        src_exhausted = True
-                        ops[0].inputs_done()
-                        break
-                    ops[0].add_input(idx, blk)
-                    progressed = True
-                # drain the tail: yield resolved blocks at consumer pace
-                while ops[-1].has_next():
-                    _idx, payload = ops[-1].get_next()
+                # drain the sink: yield resolved blocks at consumer pace
+                while sink.has_next():
+                    _idx, payload, _nb = sink.get_next()
                     if isinstance(payload, ray_tpu.ObjectRef):
                         blk = ray_tpu.get(payload,
                                           timeout=self.get_timeout)
-                        _free_now(payload)   # eager store release
+                        if sink.owns_outputs:
+                            _free_now(payload)   # eager store release
                     else:
                         blk = payload
                     del payload
                     yield blk
                     progressed = True
-                if all(op.completed() for op in ops) and src_exhausted:
+                if all(op.completed() for op in ops):
                     return
+                now = time.perf_counter()
+                if now - last_log >= self.log_every_s:
+                    last_log = now
+                    logger.info("streaming buffers: %s", self.snapshot())
                 if not progressed:
                     refs = [r for op in ops for r in op.in_flight_refs()]
                     if refs:
@@ -302,13 +926,14 @@ class StreamingExecutor:
                 op.shutdown()
 
 
-def build_operator_chain(stages: list, *, max_in_flight: int = 4
-                         ) -> list:
+def build_operator_chain(stages: list, *, max_in_flight: int = 4,
+                         byte_budget: Optional[int] = None) -> list:
     """Compile a fused stage list into physical operators: consecutive
     stages with the same compute strategy share one operator (stage
     fusion — reference: _internal/planner fusion of compatible maps).
     A stage carries its strategy via ``_compute``/``_pool_size`` attrs
-    set by Dataset.map_batches(compute=...)."""
+    set by Dataset.map_batches(compute=...).  ``_ShuffleMarker`` stages
+    split the chain with a streaming all-to-all ShuffleOperator."""
     ops: list = []
     group: list = []
     group_kind: Optional[tuple] = None
@@ -322,12 +947,20 @@ def build_operator_chain(stages: list, *, max_in_flight: int = 4
             ops.append(ActorPoolMapOperator(
                 group, pool_size=kind[1] or 2,
                 max_tasks_per_actor=kind[2] or 2,
+                byte_budget=byte_budget,
                 name=f"map(actors x{kind[1] or 2})"))
         else:
-            ops.append(TaskMapOperator(group, max_in_flight=max_in_flight))
+            ops.append(TaskMapOperator(group, max_in_flight=max_in_flight,
+                                       byte_budget=byte_budget))
         group, group_kind = [], None
 
     for st in stages:
+        if isinstance(st, _ShuffleMarker):
+            flush()
+            ops.append(ShuffleOperator(
+                num_partitions=st.num_partitions or 8, seed=st.seed,
+                max_in_flight=max_in_flight, byte_budget=byte_budget))
+            continue
         kind = (getattr(st, "_compute", "tasks"),
                 getattr(st, "_pool_size", 0),
                 getattr(st, "_max_tasks_per_actor", 0))

@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional
 import jax
 from jax.sharding import Mesh
 
+from ray_tpu.core import fault_injection as _fi
 from ray_tpu.parallel.mesh import batch_sharding, create_mesh, mesh_shape
 
 
@@ -403,6 +404,17 @@ class MultiHostGang:
             except Exception:
                 pass
 
+    def _chaos(self, point: str, **ctx) -> None:
+        """Chaos-plane trigger at gang-membership boundaries
+        (hotpath_registry contract: disarmed = one global load +
+        is-None branch).  Runs driver-side, so scripted schedules fire
+        deterministically in-process."""
+        fi = _fi._active
+        if fi is None:
+            return
+        ctx.setdefault("world", self.num_members)
+        fi.on_gang(point, ctx)
+
     def readmit(self, count: Optional[int] = None) -> int:
         """Grow the gang back toward ``target_members`` with REPLACEMENT
         member actors (fresh processes), re-initializing the whole world
@@ -414,6 +426,8 @@ class MultiHostGang:
             if count is None else count
         if want <= 0:
             return self.num_members
+        self._chaos("gang_readmit", target=self.target_members,
+                    want=want)
         world = self.num_members + want
         fresh = [
             self._actor_cls.remote(rank=self.num_members + j, world=world,

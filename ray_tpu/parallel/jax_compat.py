@@ -55,20 +55,32 @@ def shard_map(f=None, **kw):
 #     ``distributed_initialize(resilient=True)`` builds the client with a
 #     no-op callback and ``shutdown_on_destruction=False`` so member
 #     death is an ERROR the gang layer handles, not process suicide.
-#   * FAST detection: heartbeat interval/threshold knobs (seconds, not
-#     the stock ~100 s window) so a dead member poisons collectives
-#     quickly and reform isn't hostage to a long timeout.
+#     The coordination service must additionally never DECLARE a member
+#     dead: this XLA propagates "unhealthy task" findings to every
+#     surviving client through error polling, and the agent's polling
+#     thread terminates the process (uncatchable std::bad_cast inside
+#     the C++->Python callback hop) when it hands the error over — so
+#     heartbeat-miss detection is effectively disabled on both sides
+#     (``max_missing_heartbeats`` ~ 10^7) and membership health belongs
+#     to the gang layer alone (actor death watch + ping probes; a dead
+#     peer still poisons in-flight collectives via gloo's own TCP
+#     errors, which surface as ordinary Python exceptions).
 #   * ABANDON: ``distributed_abandon()`` force-leaves a (possibly
-#     poisoned) world without the collective shutdown barrier — the
-#     barrier can never complete once a peer is dead — then
-#     ``clear_backends()`` drops the cached global-device view so the
-#     next initialize sees the NEW world.
+#     poisoned) world.  It must not attempt ANY shutdown handshake:
+#     the collective shutdown barrier can never complete once a peer is
+#     dead, and its timeout error would be propagated to the surviving
+#     clients' polling threads — the same process-killing path as
+#     above.  The old client/service are instead parked in a
+#     module-level list (a deliberate, bounded leak: one pair per
+#     re-gang) so not even a destructor runs against the old world;
+#     ``clear_backends()`` then drops the cached global-device view so
+#     the next initialize sees the NEW world.
 
 
 def distributed_initialize(coordinator_address: str, num_processes: int,
                            process_id: int, *, resilient: bool = True,
                            heartbeat_interval_s: int = 1,
-                           max_missing_heartbeats: int = 5,
+                           max_missing_heartbeats: int = 10_000_000,
                            init_timeout_s: int = 120) -> str:
     """Initialize jax.distributed; returns "resilient" when the
     peer-death-survivable client was installed, "plain" when this jax's
@@ -129,14 +141,25 @@ def distributed_initialize(coordinator_address: str, num_processes: int,
         return "plain"
 
 
-def distributed_abandon(timeout_s: float = 20.0) -> None:
-    """Leave the current jax.distributed world WITHOUT requiring the
-    collective shutdown barrier to succeed (it can't once a member is
-    dead).  The barrier attempt runs on a bounded side thread: with the
-    dead peer already marked by the coordination service it fails fast;
-    a wedged one is abandoned to the daemon thread."""
-    import threading
+# worlds left behind by distributed_abandon().  Holding the references
+# forever is the point: calling .shutdown() on either object — or even
+# letting its destructor run — talks to a world with a dead member, and
+# the resulting barrier-timeout error comes back through the surviving
+# clients' error-polling threads as process termination (see the module
+# comment above).  One (client, service) pair leaks per re-gang; the
+# old client keeps heartbeating the old service quietly, generating no
+# errors, until the process exits.
+_abandoned_worlds: list = []
 
+
+def distributed_abandon(timeout_s: float = 20.0) -> None:
+    """Leave the current jax.distributed world WITHOUT any shutdown
+    handshake: the collective shutdown barrier can never complete once
+    a peer is dead, and even ATTEMPTING it propagates a timeout error
+    that kills the surviving peers' polling threads.  The old
+    client/service pair is parked (never shut down, never destroyed) so
+    the old world stays silent; the global_state slots are cleared so
+    the next distributed_initialize builds a fresh world."""
     try:
         from jax._src import distributed
         st = distributed.global_state
@@ -144,27 +167,14 @@ def distributed_abandon(timeout_s: float = 20.0) -> None:
         import jax
         jax.distributed.shutdown()
         return
-    client, service = st.client, st.service
+    if st.client is not None or st.service is not None:
+        _abandoned_worlds.append((st.client, st.service))
     st.client = None
     st.service = None
     st.preemption_sync_manager = None
     st.process_id = None
     st.num_processes = None
     st.coordinator_address = None
-
-    def quiet_shutdown(obj):
-        try:
-            obj.shutdown()
-        except Exception:
-            pass
-
-    for obj in (client, service):
-        if obj is None:
-            continue
-        t = threading.Thread(target=quiet_shutdown, args=(obj,),
-                             daemon=True)
-        t.start()
-        t.join(timeout=timeout_s)
 
 
 def clear_backends() -> None:

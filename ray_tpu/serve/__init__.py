@@ -138,6 +138,17 @@ def start_metrics_exporter(port: int = 0):
 
 def shutdown() -> None:
     global _controller, _proxy
+    import sys
+    # join the fleet ingress worker threads FIRST: a parked worker still
+    # holds its last request's replica/engine frame, and tearing the
+    # controller down under it turns that into a GC-window race (lazy:
+    # only when the fleet layer was ever imported)
+    fleet_mod = sys.modules.get("ray_tpu.serve.fleet")
+    if fleet_mod is not None:
+        try:
+            fleet_mod.join_worker_threads()
+        except Exception:
+            pass
     if _proxy is not None:
         _proxy.stop()
         _proxy = None

@@ -71,6 +71,26 @@ def get(deployment_name: str) -> Optional[Fleet]:
                    "fleet", None)
 
 
+def join_worker_threads(cancel_pending: bool = True) -> None:
+    """Deterministically retire the fleet ingress worker pool: swap the
+    shared ThreadPoolExecutor out under its lock, then JOIN every
+    worker thread.
+
+    A parked worker keeps its last request's frame (replica + engine
+    locals) alive until the interpreter recycles the thread, so
+    GC-window assertions — block-leak audits, weakref liveness checks —
+    race it roughly 1 run in 4; no sleep length fixes that, only a
+    join does.  Safe to call any time: in-flight requests finish first
+    (``wait=True``), queued-but-unstarted ones are cancelled when
+    ``cancel_pending``, and the pool is re-created lazily by the next
+    request.  ``serve.shutdown()`` calls this automatically."""
+    from ray_tpu.serve.fleet.ingress import _FleetResponse
+    with _FleetResponse._pool_lock:
+        pool, _FleetResponse._pool = _FleetResponse._pool, None
+    if pool is not None:
+        pool.shutdown(wait=True, cancel_futures=cancel_pending)
+
+
 def metrics_snapshot() -> list:
     """Fleet ingress gauges/counters in the exporter's tuple format,
     one labeled series per fleet-enabled deployment."""
@@ -169,6 +189,6 @@ def metrics_snapshot() -> list:
 __all__ = [
     "AdmissionController", "Fleet", "FleetConfig", "ModelMultiplexer",
     "NoReplicaError", "OccupancyRouter", "ShedError", "TokenBucket",
-    "UnknownModelError", "enable", "disable", "get", "metrics_snapshot",
-    "parse_priority",
+    "UnknownModelError", "enable", "disable", "get",
+    "join_worker_threads", "metrics_snapshot", "parse_priority",
 ]

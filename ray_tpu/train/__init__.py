@@ -23,6 +23,8 @@ from ray_tpu.train.torch_trainer import (TorchConfig, TorchTrainer,
 from ray_tpu.train.trainer import (BaseTrainer, DataParallelTrainer,
                                    TrainingFailedError)
 from ray_tpu.train import session
+from ray_tpu.train.ingest import (DatasetShard, SampleLedger, merge_ledgers,
+                                  shard_range, validate_ledger)
 
 __all__ = [
     "Checkpoint", "CheckpointManager", "AsyncCheckpointer",
@@ -35,6 +37,8 @@ __all__ = [
     "prepare_model", "TransformersTrainer",
     "TensorflowTrainer", "TensorflowConfig", "build_tf_config",
     "HorovodTrainer", "HorovodConfig", "build_horovod_env",
+    "DatasetShard", "SampleLedger", "merge_ledgers", "shard_range",
+    "validate_ledger",
 ]
 
 from ray_tpu import usage_stats as _usage_stats

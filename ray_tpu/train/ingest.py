@@ -1,0 +1,373 @@
+"""Streamed-ingest training data plane: epoch spool + elastic shards +
+exactly-once sample ledger.
+
+The multihost trainer can't ship a live Dataset iterator into gang
+member processes (the driver owns the object store; members are
+separate processes that may die and be replaced mid-epoch).  This
+module makes ingest elastic with three pieces:
+
+  * spool — the driver runs the dataset's STREAMING plan once
+    (operator graph, in-plan shuffle, byte budgets — data/execution.py)
+    and spools the resulting blocks to shared storage with a
+    row-offset manifest.  Peak driver memory is the operator budgets,
+    never the epoch; members read rows positionally.
+  * pure-function sharding — the global sample range of step ``s`` is
+    ``[s*B, (s+1)*B)`` of the spooled epoch order, and rank ``r`` of
+    world ``W`` takes the near-even contiguous sub-slice
+    (``shard_range``).  Data position is a function of (step, world)
+    and nothing else, so a gang resize re-shards AUTOMATICALLY at the
+    resume step boundary, and the per-step global batch is identical
+    across any resize history — loss parity with an undisturbed run by
+    construction.
+  * ledger — every shard appends the step-stamped contiguous range it
+    delivered to a per-rank, per-attempt JSON file (atomic rewrite).
+    ``merge_ledgers`` folds the files; ``validate_ledger`` applies the
+    checkpoint-consistency rule — for each step the HIGHEST attempt
+    that delivered it is the surviving delivery, earlier attempts'
+    entries for that step were rolled back with the step itself — and
+    proves zero dropped / zero double-fed samples over the trained
+    prefix.
+
+Chaos: ``DatasetShard._chaos`` fires ``data_dispatch`` per step fetch
+(ctx: {"shard", "rank", "step", "epoch"}) through the same
+zero-overhead gate contract as every other plane
+(analysis/hotpath_registry.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import glob
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.core import fault_injection as _fi
+from ray_tpu.data import block as B
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def shard_range(step: int, global_batch: int, rank: int,
+                world: int) -> tuple:
+    """Epoch-local sample range rank ``rank`` of ``world`` consumes at
+    step ``step``: the near-even contiguous sub-slice of the step's
+    global range ``[step*B, (step+1)*B)``.  Pure function of its
+    arguments — THE re-sharding rule: after a resize, every rank of the
+    new world computes its slice from the resume step alone, and the
+    union over ranks is exactly the global range for any world size."""
+    base = step * global_batch
+    per, extra = divmod(global_batch, world)
+    start = base + rank * per + min(rank, extra)
+    return start, start + per + (1 if rank < extra else 0)
+
+
+@dataclass
+class LedgerEntry:
+    shard: int       # rank that delivered the range
+    step: int        # global step (epochs included)
+    start: int       # epoch-local sample position, inclusive
+    stop: int        # epoch-local sample position, exclusive
+    attempt: int     # trainer attempt that delivered it
+    epoch: int
+
+    def to_list(self) -> list:
+        return [self.shard, self.step, self.start, self.stop,
+                self.attempt, self.epoch]
+
+    @staticmethod
+    def from_list(v) -> "LedgerEntry":
+        return LedgerEntry(*[int(x) for x in v])
+
+
+class SampleLedger:
+    """Step-stamped record of delivered sample ranges.  Wire form (a
+    typed Raw-envelope message, pinned in tests/test_schema.py)::
+
+        {"t": "sample_ledger", "epoch": E,
+         "entries": [[shard, step, start, stop, attempt, epoch], ...]}
+    """
+
+    def __init__(self, entries: Optional[list] = None):
+        self.entries: list = list(entries or [])
+
+    def record(self, shard: int, step: int, start: int, stop: int,
+               attempt: int = 0, epoch: int = 0) -> LedgerEntry:
+        e = LedgerEntry(shard, step, start, stop, attempt, epoch)
+        self.entries.append(e)
+        return e
+
+    def merge(self, other: "SampleLedger") -> "SampleLedger":
+        self.entries.extend(other.entries)
+        return self
+
+    def to_wire(self, epoch: int = 0) -> dict:
+        return {"t": "sample_ledger", "epoch": int(epoch),
+                "entries": [e.to_list() for e in self.entries]}
+
+    @staticmethod
+    def from_wire(m: dict) -> "SampleLedger":
+        if m.get("t") == "sample_ledger":
+            return SampleLedger([LedgerEntry.from_list(v)
+                                 for v in m.get("entries", [])])
+        raise ValueError(f"not a sample_ledger message: {m.get('t')!r}")
+
+    def save(self, path: str) -> None:
+        _atomic_write_json(path, self.to_wire())
+
+    @staticmethod
+    def load(path: str) -> "SampleLedger":
+        with open(path) as f:
+            return SampleLedger.from_wire(json.load(f))
+
+    def max_step(self) -> int:
+        return max((e.step for e in self.entries), default=-1)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+def merge_ledgers(ledger_dir: str,
+                  save_to: Optional[str] = None) -> SampleLedger:
+    """Fold every per-rank/attempt ledger file in ``ledger_dir`` into
+    one SampleLedger (the driver-side view after any number of
+    attempts and resizes)."""
+    out = SampleLedger()
+    for p in sorted(glob.glob(os.path.join(ledger_dir, "*.json"))):
+        if os.path.basename(p).startswith("merged"):
+            continue
+        try:
+            out.merge(SampleLedger.load(p))
+        except Exception:
+            continue   # a rank died mid-rewrite; its tmp never landed
+    if save_to is not None:
+        out.save(save_to)
+    return out
+
+
+def validate_ledger(ledger: SampleLedger, steps: int,
+                    global_batch: int) -> dict:
+    """Exactly-once proof over the trained prefix ``[0, steps)``.
+
+    Checkpoint-consistency rule: for each step, the HIGHEST attempt
+    that recorded deliveries is the surviving one — lower attempts'
+    entries for that step were rolled back together with the step when
+    the trainer restored an earlier checkpoint.  The surviving ranges
+    must tile the step's global range exactly: any gap is a dropped
+    sample, any overlap a double-feed."""
+    spe_pos = {}   # step -> list of (start, stop) from surviving attempt
+    by_step: dict = {}
+    for e in ledger.entries:
+        if 0 <= e.step < steps:
+            by_step.setdefault(e.step, []).append(e)
+    missing, double = [], []
+    for s in range(steps):
+        es = by_step.get(s, [])
+        lo = s * global_batch
+        hi = lo + global_batch
+        if not es:
+            missing.append([s, lo, hi])
+            continue
+        amax = max(e.attempt for e in es)
+        ranges = sorted((e.start, e.stop) for e in es
+                        if e.attempt == amax)
+        spe_pos[s] = ranges
+        pos = lo
+        for (a, b) in ranges:
+            if a < pos:
+                double.append([s, a, min(b, pos)])
+            elif a > pos:
+                missing.append([s, pos, a])
+            pos = max(pos, b)
+        if pos < hi:
+            missing.append([s, pos, hi])
+        elif pos > hi:
+            double.append([s, hi, pos])
+    return {"ok": not missing and not double,
+            "steps": steps, "global_batch": global_batch,
+            "missing": missing, "double_fed": double}
+
+
+@dataclass
+class EpochManifest:
+    """Row-offset index over a spooled epoch: ``row_offsets[i]`` is the
+    epoch-local position of block i's first row (len = nblocks + 1)."""
+    path: str
+    block_files: list
+    row_offsets: list
+    total_rows: int
+    columns: list = field(default_factory=list)
+    epoch: int = 0
+
+    def save(self) -> None:
+        _atomic_write_json(self.path, {
+            "t": "ingest_manifest", "epoch": self.epoch,
+            "block_files": self.block_files,
+            "row_offsets": self.row_offsets,
+            "total_rows": self.total_rows, "columns": self.columns})
+
+    @staticmethod
+    def load(path: str) -> "EpochManifest":
+        with open(path) as f:
+            m = json.load(f)
+        if m.get("t") == "ingest_manifest":
+            return EpochManifest(path=path, block_files=m["block_files"],
+                                 row_offsets=m["row_offsets"],
+                                 total_rows=int(m["total_rows"]),
+                                 columns=list(m.get("columns", [])),
+                                 epoch=int(m.get("epoch", 0)))
+        raise ValueError(f"not an ingest_manifest: {m.get('t')!r}")
+
+
+def spool_epoch(ds, out_dir: str, *, epoch: int = 0,
+                max_in_flight: int = 4,
+                byte_budget: Optional[int] = None) -> EpochManifest:
+    """Run the dataset's streaming plan and spool the output blocks
+    (numeric columns, npz) plus a row-offset manifest under
+    ``out_dir``.  Uses the operator-graph executor when the runtime is
+    up (in-plan shuffles, byte budgets) and the seeded inline fallback
+    otherwise — either way the spooled ROW ORDER is identical for a
+    seeded plan."""
+    import ray_tpu
+    os.makedirs(out_dir, exist_ok=True)
+    mode = "streaming" if ray_tpu.is_initialized() else "inline"
+    files, offsets, columns = [], [0], []
+    i = 0
+    for blk in ds._iter_staged_blocks(mode, max_in_flight, byte_budget):
+        cols = dict(B.to_columns(blk))
+        n = int(B.num_rows(cols)) if cols else 0
+        if n == 0:
+            continue
+        p = os.path.join(out_dir, f"block-{i:05d}.npz")
+        np.savez(p, **{k: np.asarray(v) for k, v in cols.items()})
+        files.append(os.path.basename(p))
+        offsets.append(offsets[-1] + n)
+        columns = sorted(cols)
+        i += 1
+    man = EpochManifest(path=os.path.join(out_dir, "manifest.json"),
+                        block_files=files, row_offsets=offsets,
+                        total_rows=offsets[-1], columns=columns,
+                        epoch=epoch)
+    man.save()
+    return man
+
+
+def ensure_spooled(ds, out_dir: str, **kw) -> EpochManifest:
+    """Spool once per run: a pre-existing manifest wins (attempt
+    restarts and readmissions must replay the SAME epoch order)."""
+    path = os.path.join(out_dir, "manifest.json")
+    if os.path.exists(path):
+        return EpochManifest.load(path)
+    return spool_epoch(ds, out_dir, **kw)
+
+
+class DatasetShard:
+    """Member-side view of a spooled epoch: yields this rank's
+    contiguous sub-slice of each step's global batch and records every
+    delivered range in the per-rank ledger file BEFORE handing the
+    batch out (a died-mid-step delivery is superseded by the retry's
+    higher attempt under the validate_ledger rule).
+
+    Reading is positional over the manifest's row offsets, so a
+    (rank, world) re-shard is O(1) — no data movement, the next
+    ``iter_batches(start_step=...)`` simply computes different slices.
+    """
+
+    def __init__(self, manifest_path: str, *, rank: int, world: int,
+                 global_batch: int, ledger_dir: str, attempt: int = 0,
+                 epochs: int = 1, name: str = "train"):
+        self.manifest = EpochManifest.load(manifest_path)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.global_batch = int(global_batch)
+        self.epochs = max(1, int(epochs))
+        self.attempt = int(attempt)
+        self.name = name
+        self.ledger = SampleLedger()
+        self._dir = os.path.dirname(self.manifest.path)
+        self._ledger_path = os.path.join(
+            ledger_dir, f"{name}-rank{rank}-attempt{attempt}.json")
+        os.makedirs(ledger_dir, exist_ok=True)
+        self._cache: dict = {}   # block idx -> column dict (tiny LRU)
+
+    # -- geometry
+
+    @property
+    def steps_per_epoch(self) -> int:
+        """Full global batches per epoch (the ragged tail is dropped,
+        like drop_last — a partial step would change shape under
+        resize)."""
+        return self.manifest.total_rows // self.global_batch
+
+    @property
+    def total_steps(self) -> int:
+        return self.steps_per_epoch * self.epochs
+
+    def _chaos(self, point: str, **ctx) -> None:
+        """Chaos-plane trigger (hotpath_registry contract: disarmed =
+        one global load + is-None branch)."""
+        fi = _fi._active
+        if fi is None:
+            return
+        ctx["shard"] = self.name
+        fi.on_data(point, ctx)
+
+    # -- positional reads
+
+    def _block_cols(self, bi: int) -> dict:
+        cols = self._cache.get(bi)
+        if cols is None:
+            p = os.path.join(self._dir, self.manifest.block_files[bi])
+            with np.load(p, allow_pickle=False) as z:
+                cols = {k: z[k] for k in z.files}
+            if len(self._cache) >= 2:   # ranges advance sequentially
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[bi] = cols
+        return cols
+
+    def read_rows(self, start: int, stop: int) -> dict:
+        """Rows [start, stop) of the spooled epoch order as a column
+        dict (crosses block boundaries as needed)."""
+        offs = self.manifest.row_offsets
+        parts = []
+        pos = start
+        while pos < stop:
+            bi = bisect.bisect_right(offs, pos) - 1
+            lo, hi = offs[bi], offs[bi + 1]
+            take = min(stop, hi) - pos
+            cols = self._block_cols(bi)
+            parts.append({k: v[pos - lo:pos - lo + take]
+                          for k, v in cols.items()})
+            pos += take
+        if not parts:
+            return {k: np.empty(0) for k in self.manifest.columns}
+        if len(parts) == 1:
+            return parts[0]
+        return {k: np.concatenate([p[k] for p in parts])
+                for k in parts[0]}
+
+    # -- the training feed
+
+    def iter_batches(self, start_step: int = 0) -> Iterator[tuple]:
+        """Yield ``(global_step, batch)`` from ``start_step`` (the
+        restored checkpoint's next step) to the end of the last epoch.
+        Every yield is ledger-recorded and flushed first."""
+        spe = self.steps_per_epoch
+        for s in range(int(start_step), self.total_steps):
+            ep, es = divmod(s, spe)
+            self._chaos("data_dispatch", rank=self.rank, step=s,
+                        epoch=ep)
+            g0, g1 = shard_range(es, self.global_batch, self.rank,
+                                 self.world)
+            self.ledger.record(self.rank, s, g0, g1,
+                               attempt=self.attempt, epoch=ep)
+            self.ledger.save(self._ledger_path)
+            yield s, self.read_rows(g0, g1)

@@ -25,6 +25,7 @@ class _SessionState:
     latest_checkpoint: Optional[Checkpoint] = None
     checkpoint_cb: Any = None     # callable(dict) -> path, set by trainer
     stop_requested: bool = False
+    dataset_shards: dict = field(default_factory=dict)  # name -> DatasetShard
 
 
 _local = threading.local()
@@ -69,6 +70,17 @@ def get_checkpoint() -> Optional[Checkpoint]:
     """Checkpoint to resume from, if the trainer restored one
     (reference: session.get_checkpoint)."""
     return _state().latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's streamed-ingest shard (a ``train.ingest.DatasetShard``)
+    when the trainer was given ``datasets=`` on a multi-host gang
+    (reference: session.get_dataset_shard).  ``shard.iter_batches(
+    start_step=...)`` yields ``(step, batch)`` with exactly-once ledger
+    accounting; after an elastic resize the SAME call re-shards
+    automatically because data position is a pure function of
+    (step, rank, world) — see train/ingest.py."""
+    return _state().dataset_shards.get(name)
 
 
 def get_world_rank() -> int:

@@ -20,6 +20,7 @@ from typing import Any, Callable, Optional
 import jax
 
 from ray_tpu.parallel.gang import GangConfig, MultiHostGang, TpuGang
+from ray_tpu.train import ingest as _ingest
 from ray_tpu.train import session as _session
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
@@ -115,6 +116,7 @@ class DataParallelTrainer(BaseTrainer):
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[dict] = None,
+                 dataset_config: Optional[dict] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         super().__init__(scaling_config=scaling_config,
                          run_config=run_config,
@@ -122,6 +124,11 @@ class DataParallelTrainer(BaseTrainer):
         self._loop = train_loop_per_worker
         self._loop_config = train_loop_config or {}
         self._datasets = datasets or {}
+        # streamed-ingest knobs for multi-host datasets= (train/ingest.py):
+        # global_batch_size (default 32), epochs (1), byte_budget (None =
+        # byte-derived from the object store at spool time)
+        self._dataset_config = dict(dataset_config or {})
+        self._ingest_attempt = -1
         self._gang: Optional[TpuGang] = None
         # set by an elastic shrink so the immediately following RESUME
         # attempt runs at the reduced size; replacements are re-admitted
@@ -221,12 +228,16 @@ class DataParallelTrainer(BaseTrainer):
         with ``scaling_config.elastic`` the gang re-forms IN PLACE from
         the survivors (same pids) and fit() resumes from the latest
         checkpoint; otherwise — or when recovery fails — fit() re-forms
-        a fresh gang (reference: backend_executor.py:571)."""
-        if self._datasets:
-            raise NotImplementedError(
-                "datasets= with num_hosts>1: iterate data inside the "
-                "train loop (each member sees the same iterator and "
-                "feeds its own shard via shard_batch)")
+        a fresh gang (reference: backend_executor.py:571).
+
+        ``datasets=`` rides the elastic data plane (train/ingest.py):
+        the driver spools each dataset's streaming plan ONCE per fit
+        (attempt restarts replay the same epoch order), members read
+        positionally via ``session.get_dataset_shard(name)``, and every
+        delivered range lands in a per-rank/attempt sample ledger.  A
+        mid-epoch shrink or readmission changes ``world`` for the next
+        attempt, and the pure-function sharding re-shards the stream at
+        the resume step boundary with no data movement."""
         sc = self.scaling_config
         if (getattr(sc, "elastic", False) and not self._elastic_shrunk
                 and gang.num_members < gang.target_members):
@@ -252,6 +263,19 @@ class DataParallelTrainer(BaseTrainer):
         mesh_axes = dict(self.scaling_config.mesh)
         world = gang.num_members
         loop_cfg = dict(self._loop_config)
+        self._ingest_attempt += 1
+        shard_specs = {}   # plain values only — this dict rides the closure
+        for name, ds in self._datasets.items():
+            dc = self._dataset_config
+            spool_dir = os.path.join(run_dir, "ingest", name)
+            man = _ingest.ensure_spooled(
+                ds, spool_dir, byte_budget=dc.get("byte_budget"))
+            shard_specs[name] = {
+                "manifest": man.path,
+                "global_batch": int(dc.get("global_batch_size", 32)),
+                "epochs": int(dc.get("epochs", 1)),
+                "ledger_dir": os.path.join(spool_dir, "ledger"),
+                "attempt": self._ingest_attempt}
         trainer = self
         self._gang = None   # actor handles must not ride the closure
 
@@ -286,6 +310,15 @@ class DataParallelTrainer(BaseTrainer):
             mst = _s._start(world_rank=rank, world_size=world,
                             checkpoint_cb=ckpt_cb,
                             latest_checkpoint=latest)
+            if shard_specs:
+                from ray_tpu.train import ingest as _ing
+                for nm, spec in shard_specs.items():
+                    mst.dataset_shards[nm] = _ing.DatasetShard(
+                        spec["manifest"], rank=rank, world=world,
+                        global_batch=spec["global_batch"],
+                        ledger_dir=spec["ledger_dir"],
+                        attempt=spec["attempt"],
+                        epochs=spec["epochs"], name=nm)
             stopped = False
             try:
                 # the member-local gang spans the GLOBAL device set
@@ -317,4 +350,10 @@ class DataParallelTrainer(BaseTrainer):
             self._gang = None
             raise
         self._gang = gang
+        for nm, spec in shard_specs.items():
+            # fold the per-rank/attempt ledgers into the audit artifact
+            # ("merged*" names are excluded from future merges)
+            _ingest.merge_ledgers(
+                spec["ledger_dir"],
+                save_to=os.path.join(spec["ledger_dir"], "merged.json"))
         st.results.extend(outs[0]["results"])

@@ -1,0 +1,272 @@
+"""Elastic streamed ingest (train/ingest.py): pure-function sharding,
+the exactly-once sample ledger, spool/manifest positional reads, the
+per-step data_dispatch chaos point, and the driver-side gang_readmit
+chaos point.  The full kill-shrink-regrow trainer flow lives in
+test_data_chaos_e2e.py behind ``slow``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import fault_injection as fi
+from ray_tpu.data import Dataset
+from ray_tpu.train.ingest import (DatasetShard, SampleLedger, ensure_spooled,
+                                  merge_ledgers, shard_range, spool_epoch,
+                                  validate_ledger)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    fi.uninstall()
+
+
+def _spool(tmp_path, n=128):
+    ds = Dataset.range(n).map_batches(
+        lambda b: {"x": b["id"], "y": b["id"] * 2.0})
+    return ensure_spooled(ds, str(tmp_path / "spool"))
+
+
+# ---------------------------------------------------------------------------
+# pure-function sharding
+
+
+def test_shard_range_tiles_every_world_size():
+    """THE re-sharding rule: for any world size the per-rank slices
+    tile the step's global range exactly — so a resize needs no data
+    movement and no negotiation, just the new (rank, world)."""
+    for world in (1, 2, 3, 4, 5, 7, 8):
+        for step in (0, 1, 9):
+            got = sorted(x for r in range(world)
+                         for x in range(*shard_range(step, 16, r, world)))
+            assert got == list(range(step * 16, (step + 1) * 16)), \
+                (world, step)
+
+
+def test_shard_range_is_contiguous_and_near_even():
+    sizes = [b - a for (a, b) in
+             (shard_range(0, 19, r, 4) for r in range(4))]
+    assert sorted(sizes) == [4, 5, 5, 5]
+
+
+# ---------------------------------------------------------------------------
+# ledger audit rules
+
+
+def _led(entries):
+    led = SampleLedger()
+    for e in entries:
+        led.record(*e[:4], attempt=e[4], epoch=e[5] if len(e) > 5 else 0)
+    return led
+
+
+def test_validate_ledger_clean_run():
+    led = _led([(r, s, *shard_range(s, 8, r, 2), 0, 0)
+                for s in range(4) for r in range(2)])
+    v = validate_ledger(led, 4, 8)
+    assert v["ok"] and not v["missing"] and not v["double_fed"]
+
+
+def test_validate_ledger_detects_gap_and_double_feed():
+    led = _led([(0, 0, 0, 4, 0, 0)])            # rank 1's half missing
+    v = validate_ledger(led, 1, 8)
+    assert not v["ok"] and v["missing"] == [[0, 4, 8]]
+
+    led = _led([(0, 0, 0, 5, 0, 0), (1, 0, 3, 8, 0, 0)])  # [3,5) twice
+    v = validate_ledger(led, 1, 8)
+    assert not v["ok"] and v["double_fed"] == [[0, 3, 5]]
+
+
+def test_validate_ledger_higher_attempt_supersedes():
+    """Checkpoint-consistency: a step delivered by attempt 0 at world 2
+    AND re-delivered by attempt 1 at world 3 counts ONCE — the highest
+    attempt is the surviving delivery, the rolled-back one is not a
+    double-feed."""
+    led = _led([(r, 1, *shard_range(1, 12, r, 2), 0, 0) for r in range(2)]
+               + [(r, 1, *shard_range(1, 12, r, 3), 1, 0)
+                  for r in range(3)]
+               + [(r, 0, *shard_range(0, 12, r, 2), 0, 0)
+                  for r in range(2)])
+    v = validate_ledger(led, 2, 12)
+    assert v["ok"], v
+    # and a PARTIAL higher attempt exposes the gap it left
+    led.record(0, 0, *shard_range(0, 12, 0, 3), attempt=1)
+    v = validate_ledger(led, 2, 12)
+    assert not v["ok"] and v["missing"]
+
+
+def test_ledger_wire_roundtrip_and_files(tmp_path):
+    led = _led([(0, 0, 0, 8, 0, 0), (0, 1, 8, 16, 0, 0)])
+    m = led.to_wire(epoch=0)
+    assert m["t"] == "sample_ledger"
+    assert SampleLedger.from_wire(m).to_wire() == m
+    with pytest.raises(ValueError, match="sample_ledger"):
+        SampleLedger.from_wire({"t": "prefix_publish"})
+    p = str(tmp_path / "rank0-attempt0.json")
+    led.save(p)
+    assert SampleLedger.load(p).max_step() == 1
+    # merged output must not feed back into future merges
+    merged = merge_ledgers(str(tmp_path),
+                           save_to=str(tmp_path / "merged.json"))
+    assert len(merged) == 2
+    assert len(merge_ledgers(str(tmp_path))) == 2
+
+
+# ---------------------------------------------------------------------------
+# spool + positional shard reads
+
+
+def test_spool_and_shard_exactly_once_across_resize(tmp_path):
+    """The tentpole invariant, unit-sized: world 2 delivers steps 0..2,
+    a 'shrink' resumes at step 3 with world 1 and a higher attempt —
+    the merged ledger proves every sample of the epoch delivered
+    exactly once, and the batches re-shard with no data movement."""
+    man = _spool(tmp_path, n=128)
+    assert man.total_rows == 128 and man.row_offsets[-1] == 128
+    ld = str(tmp_path / "ledger")
+
+    seen = []
+    for r in range(2):
+        sh = DatasetShard(man.path, rank=r, world=2, global_batch=16,
+                          ledger_dir=ld, attempt=0)
+        assert sh.steps_per_epoch == 8
+        for step, batch in sh.iter_batches():
+            if step >= 3:
+                break
+            seen.extend(np.asarray(batch["x"]).tolist())
+            assert np.array_equal(batch["y"], batch["x"] * 2.0)
+
+    sh = DatasetShard(man.path, rank=0, world=1, global_batch=16,
+                      ledger_dir=ld, attempt=1)
+    for step, batch in sh.iter_batches(start_step=3):
+        seen.extend(np.asarray(batch["x"]).tolist())
+    assert sorted(seen) == list(range(128))
+
+    merged = merge_ledgers(ld)
+    v = validate_ledger(merged, 8, 16)
+    # steps 0..2 at attempt 0 world 2; 3..7 at attempt 1 world 1 — but
+    # attempt 0's break left step 3 recorded-and-rolled-back: the
+    # supersede rule absorbs it
+    assert v["ok"], v
+
+
+def test_shard_reads_cross_block_boundaries(tmp_path):
+    man = _spool(tmp_path, n=100)        # 8 blocks of 12/13 rows
+    sh = DatasetShard(man.path, rank=0, world=1, global_batch=25,
+                      ledger_dir=str(tmp_path / "led"))
+    rows = sh.read_rows(10, 40)          # spans >= 2 blocks
+    assert np.array_equal(rows["x"], np.arange(10, 40))
+
+
+def test_spool_is_idempotent_and_manifest_pinned(tmp_path):
+    man1 = _spool(tmp_path)
+    man2 = _spool(tmp_path)              # must NOT respool
+    assert man2.block_files == man1.block_files
+    with open(man1.path) as f:
+        m = json.load(f)
+    assert m["t"] == "ingest_manifest"
+    assert m["row_offsets"][0] == 0 and m["total_rows"] == 128
+
+
+def test_multi_epoch_steps_and_epoch_local_ranges(tmp_path):
+    man = _spool(tmp_path, n=64)
+    ld = str(tmp_path / "led")
+    sh = DatasetShard(man.path, rank=0, world=1, global_batch=32,
+                      ledger_dir=ld, epochs=2)
+    assert sh.total_steps == 4
+    trail = [(step, int(batch["x"][0])) for step, batch
+             in sh.iter_batches()]
+    # global step keeps counting, epoch-local position wraps
+    assert trail == [(0, 0), (1, 32), (2, 0), (3, 32)]
+    eps = {e.step: e.epoch for e in sh.ledger.entries}
+    assert eps == {0: 0, 1: 0, 2: 1, 3: 1}
+
+
+# ---------------------------------------------------------------------------
+# chaos points
+
+
+def test_shard_data_dispatch_fires_per_step(tmp_path):
+    man = _spool(tmp_path, n=64)
+    plan = fi.FaultPlan()
+    seen = []
+    plan.script(lambda ctx: seen.append(dict(ctx)),
+                point="data_dispatch", nth=None, times=1000)
+    fi.install(plan)
+    try:
+        sh = DatasetShard(man.path, rank=1, world=2, global_batch=16,
+                          ledger_dir=str(tmp_path / "led"))
+        list(sh.iter_batches())
+    finally:
+        fi.uninstall()
+    assert [c["step"] for c in seen] == list(range(4))
+    assert all(c["shard"] == "train" and c["rank"] == 1 for c in seen)
+
+
+def test_shard_scripted_failure_at_exact_step(tmp_path):
+    """A raising rule kills the feed at the scripted step — the member
+    dies BEFORE recording the step, so the ledger shows the rollback
+    the e2e audit relies on."""
+    man = _spool(tmp_path, n=64)
+    ld = str(tmp_path / "led")
+
+    def boom(ctx):
+        raise RuntimeError(f"scripted ingest fault at step {ctx['step']}")
+
+    plan = fi.FaultPlan()
+    plan.script(boom, point="data_dispatch", nth=3, times=1)
+    fi.install(plan)
+    sh = DatasetShard(man.path, rank=0, world=1, global_batch=16,
+                      ledger_dir=ld)
+    with pytest.raises(RuntimeError, match="at step 2"):
+        list(sh.iter_batches())
+    fi.uninstall()
+    assert sh.ledger.max_step() == 1     # step 2 never recorded
+
+
+def test_gang_readmit_chaos_point_scripted_failure():
+    """Driver-side gang_readmit: a scripted raise at the re-admission
+    boundary exercises the readmission-failure path BEFORE any
+    replacement actor spawns; disarmed, the same readmit succeeds."""
+    ray_tpu.init(num_cpus=6, num_tpus=0)
+    gang = None
+    try:
+        from ray_tpu.parallel.gang import MultiHostGang
+        import signal
+        import time
+        gang = MultiHostGang(3, cpu_backend=True, devices_per_member=1)
+        pids = gang.member_pids()
+        os.kill(pids[1], signal.SIGKILL)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if gang.alive_ranks() == [0, 2]:
+                break
+            time.sleep(0.2)
+        assert gang.alive_ranks() == [0, 2]
+        gang.reform([0, 2])
+        assert gang.num_members == 2 and gang.target_members == 3
+
+        def boom(ctx):
+            raise RuntimeError(
+                f"scripted readmit fault (world={ctx['world']}, "
+                f"want={ctx['want']})")
+
+        plan = fi.FaultPlan()
+        plan.script(boom, point="gang_readmit", nth=None, times=1)
+        fi.install(plan)
+        with pytest.raises(RuntimeError, match="scripted readmit fault"):
+            gang.readmit()
+        assert gang.num_members == 2     # no side effects before the gate
+        assert any(p == "gang_readmit" for (p, _a, _d) in plan.log)
+        fi.uninstall()
+        assert gang.readmit() == 3       # disarmed: readmission works
+    finally:
+        if gang is not None:
+            gang.shutdown()
+        ray_tpu.shutdown()

@@ -31,6 +31,11 @@ SEED = 0
 def _cleanup():
     yield
     fault_injection.uninstall()
+    # join (not sleep past) the ingress worker threads so no parked
+    # frame still references this test's replicas when the next test's
+    # GC-window assertions run; serve.shutdown() joins too, but the
+    # explicit call keeps the ordering obvious here
+    fleet.join_worker_threads()
     serve.shutdown()
 
 

@@ -79,7 +79,13 @@ def _assert_no_block_leaks(f):
     """Leak audit: with no requests in flight, every live block in
     every replica's pool must be accounted to its radix trie — a
     failed fetch/install that forgot a decref shows up here as
-    blocks_used > cached trie nodes."""
+    blocks_used > cached trie nodes.
+
+    Join the ingress worker threads first: "no requests in flight"
+    is only deterministic once the pool thread that served the last
+    request has fully unwound its frame (the decrefs happen on ITS
+    stack, after our result() already returned)."""
+    fleet.join_worker_threads()
     for r in f.state.replicas:
         eng = _engine(r)
         if getattr(eng, "_stopped", False):

@@ -159,6 +159,29 @@ class TestMessages:
                        "tokens": [1, 2, 3, 4], "generation": 0})
         assert m["t"] == "block_fetch" and m["tokens"] == [1, 2, 3, 4]
 
+    def test_elastic_ingest_messages_roundtrip(self):
+        """The elastic data plane's accounting vocabulary
+        (sample_ledger / ingest_manifest) rides the typed Raw envelope
+        — pinned here so the shapes can't drift silently
+        (train/ingest.py writes them per step and per spool; the merge
+        / validate audit path reads them back).  Ledger entries are
+        positional 6-lists: [shard, step, start, stop, attempt,
+        epoch]."""
+        from ray_tpu.train.ingest import SampleLedger
+        m = roundtrip({"t": "sample_ledger", "epoch": 0,
+                       "entries": [[0, 3, 48, 56, 1, 0],
+                                   [1, 3, 56, 64, 1, 0]]})
+        assert m["t"] == "sample_ledger" and len(m["entries"]) == 2
+        assert m["entries"][0] == [0, 3, 48, 56, 1, 0]
+        led = SampleLedger.from_wire(m)   # codec output feeds the audit
+        assert led.max_step() == 3 and len(led) == 2
+        m = roundtrip({"t": "ingest_manifest", "epoch": 1,
+                       "block_files": ["block-00000.npz"],
+                       "row_offsets": [0, 128], "total_rows": 128,
+                       "columns": ["x", "y"]})
+        assert m["t"] == "ingest_manifest" and m["row_offsets"] == [0, 128]
+        assert m["columns"] == ["x", "y"] and m["total_rows"] == 128
+
     def test_empty_oneof_arm_selected(self):
         # an all-defaults message must still carry its type
         m = roundtrip({"t": "get_objects", "object_ids": []})

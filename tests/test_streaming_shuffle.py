@@ -1,0 +1,245 @@
+"""Streaming shuffle + multi-input operators + byte-derived budgets
+(data/execution.py, PR 19 tentpole piece 1).
+
+Pins the elastic data plane's driver-side guarantees:
+
+  * seeded replay — a ``streaming_shuffle`` plan yields the SAME row
+    stream on every execution path (inline fallback vs operator graph)
+    and on every repetition, because the permutation seed and partition
+    count are resolved once at plan-build time;
+  * zip/union as GRAPH operators (both branches stream; nothing is
+    materialized eagerly) with eager-path parity;
+  * byte-derived back-pressure — budgets from block byte sizes and the
+    configured object-store fraction, not fixed in-flight counts, with
+    the reorder buffer counted against the budget (the _OrderedOut
+    unbounded-growth fix);
+  * the new chaos points fire with the documented ctx shapes and a
+    raising rule fails the run at the exact scripted block.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import fault_injection as fi
+
+STORE_BUDGET = 48 * 1024 * 1024
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4, num_tpus=0,
+                 object_store_memory=STORE_BUDGET)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    yield
+    fi.uninstall()
+
+
+def _rows(ds, **kw):
+    out = []
+    for b in ds.iter_batches(batch_size=64, **kw):
+        out.extend(np.asarray(b["x"]).tolist())
+    return out
+
+
+def _base(n=200):
+    from ray_tpu.data import Dataset
+    return Dataset.range(n, parallelism=8).map_batches(
+        lambda b: {"x": b["id"] * 3})
+
+
+# ---------------------------------------------------------------------------
+# streaming shuffle: plan marker + seeded replay
+
+
+def test_streaming_shuffle_streaming_matches_inline(rt):
+    """THE parity pin: the operator-graph execution of a shuffle plan
+    (map-side partition -> reduce-side merge) produces exactly the rows
+    of the inline fallback (shuffle_blocks between segment folds) —
+    same seed, same input order, same permutation."""
+    ds = _base().streaming_shuffle(seed=42).map_batches(
+        lambda b: {"x": b["x"] + 1})
+    assert _rows(ds, parallelism="streaming") == _rows(ds)
+
+
+def test_streaming_shuffle_replay_is_deterministic(rt):
+    """Seed resolution happens ONCE at plan-build time (entropy when
+    seed=None), so repeated iterations of the same plan — the elastic
+    trainer's re-spool / re-shard path — replay identically."""
+    ds = _base().streaming_shuffle()          # no explicit seed
+    first = _rows(ds, parallelism="streaming")
+    assert sorted(first) == sorted(_rows(_base()))   # a permutation
+    assert _rows(ds, parallelism="streaming") == first
+    assert _rows(ds) == first                 # inline agrees too
+    # a different plan object draws a different seed
+    assert _rows(_base().streaming_shuffle()) != first
+
+
+def test_streaming_shuffle_is_a_graph_operator(rt):
+    """The shuffle runs INSIDE the streaming graph: build_operator_chain
+    segments the plan at the marker and the executor reports the
+    shuffle op's stats alongside the maps."""
+    from ray_tpu.data.execution import (ShuffleOperator, StreamingExecutor,
+                                        build_operator_chain)
+    ds = _base(120).streaming_shuffle(num_partitions=4, seed=7)
+    ops = build_operator_chain(ds._stages)
+    kinds = [type(o).__name__ for o in ops]
+    assert "ShuffleOperator" in kinds
+    shuf = next(o for o in ops if isinstance(o, ShuffleOperator))
+    ex = StreamingExecutor(ops)
+    got = [float(x) for blk in ex.execute(ds._resolve_blocks())
+           for x in blk["x"]]
+    assert sorted(got) == [float(3 * i) for i in range(120)]
+    st = next(s for s in ex.stats() if s["operator"].startswith("shuffle"))
+    assert st["operator"] == "shuffle(P=4)"
+    assert st["inputs"] == 8                  # every source block mapped
+    assert shuf.completed()
+
+
+# ---------------------------------------------------------------------------
+# multi-input operators in the graph
+
+
+def test_zip_streaming_matches_eager_zip(rt):
+    left = _base(96)
+    right = _base(96).map_batches(lambda b: {"y": b["x"] * 10})
+    zs = left.zip_streaming(right).map_batches(
+        lambda b: {"x": b["x"] + b["y"]})
+    ze = left.zip(right).map_batches(lambda b: {"x": b["x"] + b["y"]})
+    assert _rows(zs, parallelism="streaming") == _rows(ze)
+
+
+def test_zip_streaming_column_collision_suffix(rt):
+    """Same-named columns get the eager zip's ``_1`` suffix rule."""
+    left = _base(64)
+    zs = left.zip_streaming(_base(64))
+    got = next(iter(zs.iter_batches(batch_size=8,
+                                    parallelism="streaming")))
+    assert set(got) == {"x", "x_1"}
+    assert np.array_equal(got["x"], got["x_1"])
+
+
+def test_zip_streaming_unequal_rows_raises(rt):
+    zs = _base(96).zip_streaming(_base(80))
+    with pytest.raises(ValueError, match="equal row counts"):
+        _rows(zs, parallelism="streaming")
+
+
+def test_union_streaming_matches_eager_union(rt):
+    left = _base(72)
+    right = _base(72).map_batches(lambda b: {"x": b["x"] + 1000})
+    us = left.union_streaming(right)
+    ue = left.union(right)
+    assert _rows(us, parallelism="streaming") == _rows(ue)
+
+
+# ---------------------------------------------------------------------------
+# byte-derived budgets + the reorder-buffer cap
+
+
+def test_derive_byte_budget_from_store_config(rt):
+    from ray_tpu.data.execution import derive_byte_budget
+    assert derive_byte_budget(0.25) == STORE_BUDGET // 4
+    assert derive_byte_budget(0.5) == STORE_BUDGET // 2
+
+
+def test_byte_budget_bounds_buffering(rt):
+    """Byte mode: admission is driven by buffered BYTES (reorder heap +
+    outqueue + in-flight estimates), bounded by budget plus the one
+    admit-when-empty progress block."""
+    from ray_tpu.data.execution import (StreamingExecutor,
+                                        build_operator_chain)
+    rows = 1 << 15                            # ~256 KiB x-column blocks
+    from ray_tpu.data import Dataset
+    blocks = [{"x": np.full(rows, float(i), np.float32)}
+              for i in range(16)]
+    ds = Dataset(blocks).map_batches(lambda b: {"x": b["x"] * 2})
+    block_bytes = rows * 4
+    budget = 2 * block_bytes
+    ops = build_operator_chain(ds._stages, byte_budget=budget)
+    ex = StreamingExecutor(ops)
+    n = sum(1 for _ in ex.execute(ds._resolve_blocks()))
+    assert n == 16
+    for s in ex.stats():
+        assert s["bytes_in"] > 0 and s["bytes_out"] > 0
+        assert s["peak_buffered_bytes"] <= budget + block_bytes, s
+
+
+def test_ordered_out_reorder_buffer_is_accounted(rt):
+    """The _OrderedOut fix: out-of-order completions are COUNTED (items
+    and bytes) while parked, and drain strictly in sequence once the
+    gap fills — the byte/count admission gates see them, so a straggler
+    can no longer grow the reorder heap unboundedly."""
+    from ray_tpu.data.execution import _OrderedOut
+    o = _OrderedOut()
+    for seq in range(1, 6):                   # seq 0 is the straggler
+        o.put(seq, f"item{seq}", nbytes=100)
+    assert o.pop_ready() == []
+    assert o.buffered == 5 and o.buffered_bytes == 500
+    o.put(0, "item0", nbytes=100)
+    drained = o.pop_ready()
+    assert [it for (it, _nb) in drained] == [f"item{s}" for s in range(6)]
+    assert sum(nb for (_it, nb) in drained) == 600
+    assert o.buffered == 0 and o.buffered_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos points (driver-side, deterministic)
+
+
+def test_data_dispatch_chaos_point_fires_with_ctx(rt):
+    plan = fi.FaultPlan()
+    seen = []
+    plan.script(lambda ctx: seen.append(dict(ctx)),
+                point="data_dispatch", nth=None, times=1000)
+    fi.install(plan)
+    try:
+        _rows(_base(64), parallelism="streaming")
+    finally:
+        fi.uninstall()
+    assert seen, "data_dispatch never fired"
+    assert {"operator", "idx", "port"} <= set(seen[0])
+    assert any(p == "data_dispatch" for (p, _a, _d) in plan.log)
+
+
+def test_data_shuffle_reduce_chaos_point_covers_partitions(rt):
+    plan = fi.FaultPlan()
+    seen = []
+    plan.script(lambda ctx: seen.append(dict(ctx)),
+                point="data_shuffle_reduce", nth=None, times=1000)
+    fi.install(plan)
+    try:
+        _rows(_base(64).streaming_shuffle(num_partitions=4, seed=3),
+              parallelism="streaming")
+    finally:
+        fi.uninstall()
+    parts = {c["partition"] for c in seen}
+    assert parts == {0, 1, 2, 3}
+    # num_parts = map-side parts feeding each reducer (one per block)
+    assert all(c["num_parts"] == 8 for c in seen)
+
+
+def test_data_dispatch_scripted_failure_is_exact(rt):
+    """A raising rule fails the run at the exact scripted admission —
+    the deterministic stand-in for 'kill the map worker at block N'."""
+    def boom(ctx):
+        raise RuntimeError(f"scripted data fault at idx={ctx.get('idx')}")
+
+    plan = fi.FaultPlan()
+    plan.script(boom, point="data_dispatch", nth=3, times=1)
+    fi.install(plan)
+    try:
+        with pytest.raises(RuntimeError, match="scripted data fault"):
+            _rows(_base(64), parallelism="streaming")
+    finally:
+        fi.uninstall()
+    # disarmed: the same plan replays clean
+    assert sorted(_rows(_base(64), parallelism="streaming")) == \
+        [float(3 * i) for i in range(64)]
